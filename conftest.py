@@ -1,0 +1,21 @@
+"""Repo-root pytest config.
+
+Must run before JAX initializes its backends: forces an 8-device virtual CPU
+platform so multi-device sharding/sync tests run without TPU hardware
+(the JAX analogue of the reference's multi-process gloo-on-localhost test
+strategy, reference utils/test_utils/metric_class_tester.py:292-341).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Some images expose an experimental TPU plugin that wins default-backend even
+# when tests want CPU; pin default placement to the virtual CPU mesh.
+jax.config.update("jax_default_device", jax.devices("cpu")[0])

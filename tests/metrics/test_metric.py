@@ -1,0 +1,204 @@
+"""Base-class state semantics tests.
+
+Mirrors the contract exercised by reference tests/metrics/test_metric.py:
+state add/reset/state_dict/load/to/device via the Dummy metrics.
+"""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torcheval_tpu.metrics.metric import DefaultStateDict, MergeKind, Metric
+from torcheval_tpu.utils.test_utils import (
+    DummySumDictStateMetric,
+    DummySumListStateMetric,
+    DummySumMetric,
+)
+
+
+def test_add_state_registers_defaults():
+    m = DummySumMetric()
+    assert set(m._state_name_to_default) == {"sum"}
+    np.testing.assert_allclose(np.asarray(m.sum), 0.0)
+
+
+def test_add_state_rejects_bad_types():
+    class Bad(Metric):
+        def __init__(self):
+            super().__init__()
+            self._add_state("x", "nope")
+
+        def update(self):
+            return self
+
+        def compute(self):
+            return None
+
+    with pytest.raises(TypeError):
+        Bad()
+
+    class BadList(Metric):
+        def __init__(self):
+            super().__init__()
+            self._add_state("x", [1, 2])
+
+        def update(self):
+            return self
+
+        def compute(self):
+            return None
+
+    with pytest.raises(TypeError):
+        BadList()
+
+
+def test_update_compute_reset_tensor_state():
+    m = DummySumMetric()
+    m.update(1.0).update(2.0)
+    np.testing.assert_allclose(np.asarray(m.compute()), 3.0)
+    # compute is idempotent
+    np.testing.assert_allclose(np.asarray(m.compute()), 3.0)
+    m.reset()
+    np.testing.assert_allclose(np.asarray(m.compute()), 0.0)
+
+
+def test_list_state_update_and_reset():
+    m = DummySumListStateMetric()
+    m.update(jnp.array([1.0, 2.0])).update(jnp.array([3.0]))
+    np.testing.assert_allclose(np.asarray(m.compute()), 6.0)
+    m.reset()
+    assert m.x == []
+    np.testing.assert_allclose(np.asarray(m.compute()), 0.0)
+
+
+def test_dict_state_update_and_reset():
+    m = DummySumDictStateMetric()
+    m.update("a", 1.0).update("a", 2.0).update("b", 5.0)
+    out = m.compute()
+    np.testing.assert_allclose(np.asarray(out["a"]), 3.0)
+    np.testing.assert_allclose(np.asarray(out["b"]), 5.0)
+    m.reset()
+    assert dict(m.x) == {}
+    # defaultdict semantics restored after reset
+    np.testing.assert_allclose(np.asarray(m.x["zzz"]), 0.0)
+
+
+def test_state_dict_load_state_dict_roundtrip():
+    m = DummySumMetric()
+    m.update(4.0)
+    sd = m.state_dict()
+    m2 = DummySumMetric()
+    m2.load_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(m2.compute()), 4.0)
+
+    # strict mode catches mismatches
+    with pytest.raises(RuntimeError, match="missing keys"):
+        m2.load_state_dict({}, strict=True)
+    with pytest.raises(RuntimeError, match="unexpected"):
+        m2.load_state_dict({"sum": jnp.zeros(()), "bogus": 1}, strict=True)
+    # non-strict ignores them
+    m2.load_state_dict({"bogus": 1}, strict=False)
+
+
+def test_state_dict_is_snapshot():
+    m = DummySumListStateMetric()
+    m.update(jnp.array([1.0]))
+    sd = m.state_dict()
+    m.update(jnp.array([2.0]))
+    assert len(sd["x"]) == 1
+
+
+def test_merge_state_sum():
+    a = DummySumMetric().update(1.0)
+    b = DummySumMetric().update(2.0)
+    c = DummySumMetric().update(3.0)
+    a.merge_state([b, c])
+    np.testing.assert_allclose(np.asarray(a.compute()), 6.0)
+    # peers unchanged
+    np.testing.assert_allclose(np.asarray(b.compute()), 2.0)
+    # merged metric still updatable
+    a.update(1.0)
+    np.testing.assert_allclose(np.asarray(a.compute()), 7.0)
+
+
+def test_merge_state_list_extend():
+    a = DummySumListStateMetric().update(jnp.array([1.0]))
+    b = DummySumListStateMetric().update(jnp.array([2.0, 3.0]))
+    a.merge_state([b])
+    np.testing.assert_allclose(np.asarray(a.compute()), 6.0)
+    assert len(b.x) == 1
+
+
+def test_merge_state_dict_union():
+    a = DummySumDictStateMetric().update("x", 1.0)
+    b = DummySumDictStateMetric().update("x", 2.0).update("y", 7.0)
+    a.merge_state([b])
+    np.testing.assert_allclose(np.asarray(a.x["x"]), 3.0)
+    np.testing.assert_allclose(np.asarray(a.x["y"]), 7.0)
+
+
+def test_to_device_moves_states():
+    cpus = jax.devices("cpu")
+    m = DummySumMetric(device=cpus[0]).update(2.0)
+    m.to(cpus[1])
+    assert m.device == cpus[1]
+    assert list(m.sum.devices()) == [cpus[1]]
+    np.testing.assert_allclose(np.asarray(m.compute()), 2.0)
+
+
+def test_cross_device_merge():
+    cpus = jax.devices("cpu")
+    a = DummySumMetric(device=cpus[0]).update(1.0)
+    b = DummySumMetric(device=cpus[2]).update(5.0)
+    a.merge_state([b])
+    np.testing.assert_allclose(np.asarray(a.compute()), 6.0)
+    assert list(a.sum.devices()) == [cpus[0]]
+
+
+def test_device_string_constructor():
+    m = DummySumMetric(device="cpu:3")
+    assert m.device == jax.devices("cpu")[3]
+
+
+def test_pickle_roundtrip_all_state_kinds():
+    metrics = [
+        DummySumMetric().update(2.0),
+        DummySumListStateMetric().update(jnp.array([1.0, 2.0])),
+        DummySumDictStateMetric().update("k", 3.0),
+    ]
+    for m in metrics:
+        m2 = pickle.loads(pickle.dumps(m))
+        expected, got = m.compute(), m2.compute()
+        if isinstance(expected, dict):
+            assert set(expected) == set(got)
+            for k in expected:
+                np.testing.assert_allclose(np.asarray(expected[k]), np.asarray(got[k]))
+        else:
+            np.testing.assert_allclose(np.asarray(expected), np.asarray(got))
+
+
+def test_default_state_dict_pickles():
+    d = DefaultStateDict("cpu:0")
+    d["a"] = jnp.ones(())
+    d2 = pickle.loads(pickle.dumps(d))
+    np.testing.assert_allclose(np.asarray(d2["a"]), 1.0)
+    np.testing.assert_allclose(np.asarray(d2["new"]), 0.0)
+
+
+def test_custom_merge_kind_requires_override():
+    class NoMerge(Metric):
+        def __init__(self):
+            super().__init__()
+            self._add_state("s", jnp.zeros(()), merge=MergeKind.CUSTOM)
+
+        def update(self):
+            return self
+
+        def compute(self):
+            return self.s
+
+    with pytest.raises(NotImplementedError):
+        NoMerge().merge_state([NoMerge()])

@@ -1,0 +1,12 @@
+"""torcheval_tpu: a TPU-native model-evaluation metrics framework.
+
+A ground-up JAX/XLA re-design with the capability surface of the reference
+metrics library (see SURVEY.md): ~40 class metrics with
+update/compute/merge_state/reset deferred semantics, ~50 stateless functional
+metrics, a distributed sync toolkit lowering to XLA collectives over ICI/DCN,
+and model-introspection tools (module summaries, FLOP counting).
+"""
+
+from torcheval_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,55 @@
+"""Global configuration for torcheval_tpu.
+
+The reference library performs eager, value-dependent input validation (e.g.
+``torch.max(target)`` range checks, reference
+torcheval/metrics/functional/classification/confusion_matrix.py:267-281).
+On TPU, reading a value off the device forces a host<->device sync in the hot
+``update()`` path, which would blow the <1% step-overhead budget. We therefore
+split validation into two tiers:
+
+- *shape/dtype checks*: free under JAX (shapes are static metadata) — always on.
+- *value checks*: require device->host readback — gated behind
+  ``debug_validation`` (env ``TORCHEVAL_TPU_DEBUG``), default off.
+
+There is deliberately no config-file/flag system beyond this: the reference
+uses plain constructor kwargs (SURVEY.md section 5.6) and so do we.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Iterator
+
+_debug_validation: bool = os.environ.get("TORCHEVAL_TPU_DEBUG", "").lower() in (
+    "1",
+    "true",
+    "yes",
+    "on",
+)
+
+
+def debug_validation_enabled() -> bool:
+    """True when value-level (device-sync-forcing) input validation is on."""
+    return _debug_validation
+
+
+def set_debug_validation(enabled: bool) -> None:
+    global _debug_validation
+    _debug_validation = bool(enabled)
+
+
+@contextmanager
+def debug_validation(enabled: bool = True) -> Iterator[None]:
+    """Context manager enabling value-level input validation.
+
+    >>> with debug_validation():
+    ...     metric.update(inputs, targets)   # raises on out-of-range values
+    """
+    global _debug_validation
+    prev = _debug_validation
+    _debug_validation = enabled
+    try:
+        yield
+    finally:
+        _debug_validation = prev

@@ -1,0 +1,128 @@
+"""Input coercion: bring user inputs onto the JAX/TPU side.
+
+The reference accepts ``torch.Tensor`` everywhere. We keep that front-end —
+torch tensors are accepted at every ``update()``/functional boundary and
+converted zero-copy via DLPack where possible (CPU tensors, torch-xla TPU
+tensors on TPU-VM hosts), falling back to a NumPy copy. NumPy arrays, Python
+scalars and sequences are also accepted, mirroring ``torch.as_tensor``
+semantics at the reference's API boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # torch is an optional front-end, never a requirement.
+    import torch as _torch
+except Exception:  # pragma: no cover - torch is present in CI images
+    _torch = None
+
+TensorLike = Any  # jax.Array | np.ndarray | torch.Tensor | scalar | sequence
+
+
+def is_torch_tensor(x: Any) -> bool:
+    return _torch is not None and isinstance(x, _torch.Tensor)
+
+
+def to_jax(
+    x: TensorLike,
+    *,
+    dtype: Optional[jnp.dtype] = None,
+    device: Optional[jax.Device] = None,
+) -> jax.Array:
+    """Coerce ``x`` to a ``jax.Array``.
+
+    torch tensors go through DLPack (zero-copy when the producer framework
+    allows it); everything else through ``jnp.asarray``. When ``device`` is
+    given the result is moved there — the metric-device input boundary of the
+    reference's ``input.to(self.device)`` (H2D copy if needed; no-op when the
+    array already lives there).
+
+    Aliasing contract: when the source tensor already lives on ``device``,
+    the returned array may share its buffer (exactly like the reference,
+    where ``tensor.to(device)`` returns the same tensor and buffered metrics
+    store it). Callers that keep updating a preallocated torch buffer after
+    passing it to a buffering metric must pass a copy themselves.
+    """
+    if isinstance(x, jax.Array):
+        arr = x if dtype is None else x.astype(dtype)
+    elif is_torch_tensor(x):
+        t = x.detach()
+        try:
+            arr = jnp.from_dlpack(t.contiguous())
+        except Exception:
+            arr = jnp.asarray(t.cpu().numpy())
+        if dtype is not None:
+            arr = arr.astype(dtype)
+    else:
+        arr = jnp.asarray(x, dtype=dtype)
+    if device is not None and arr.devices() != {device}:
+        arr = jax.device_put(arr, device)
+    return arr
+
+
+def to_jax_float(
+    x: TensorLike, *, device: Optional[jax.Device] = None
+) -> jax.Array:
+    """Coerce to a floating array (leaves existing float dtypes alone)."""
+    arr = to_jax(x, device=device)
+    if not jnp.issubdtype(arr.dtype, jnp.floating):
+        arr = arr.astype(jnp.float32)
+    return arr
+
+
+def canonicalize_device(
+    device: Union[jax.Device, str, None],
+) -> jax.Device:
+    """Resolve ``device`` to a concrete ``jax.Device``.
+
+    ``None`` resolves to the session default (``jax_default_device`` config if
+    set, else the first device of the default backend) — the analogue of the
+    reference defaulting metric state to CPU (reference
+    torcheval/metrics/metric.py:44-47), except our default is the accelerator.
+    Strings accept ``"cpu"``, ``"tpu"``, ``"cpu:3"`` etc.
+    """
+    if device is None:
+        default = jax.config.jax_default_device
+        if default is None:
+            return jax.local_devices()[0]
+        if isinstance(default, jax.Device):
+            return default
+        return canonicalize_device(default)  # `jax.default_device("cpu")` str form
+    if isinstance(device, jax.Device):
+        return device
+    if isinstance(device, str):
+        if ":" in device:
+            platform, _, index_s = device.partition(":")
+            index = int(index_s)
+        else:
+            platform, index = device, 0
+        devices = jax.devices(platform)
+        if not 0 <= index < len(devices):
+            raise ValueError(
+                f"Device {device!r} out of range: backend {platform!r} has "
+                f"{len(devices)} devices."
+            )
+        return devices[index]
+    raise TypeError(f"Cannot interpret {device!r} as a jax.Device")
+
+
+def device_descriptor(device: jax.Device) -> str:
+    """A picklable string naming a device, resolvable by canonicalize_device."""
+    return f"{device.platform}:{device.id}"
+
+
+def resolve_device_descriptor(descriptor: str) -> jax.Device:
+    platform, _, index_s = descriptor.partition(":")
+    index = int(index_s or 0)
+    for d in jax.devices(platform):
+        if d.id == index:
+            return d
+    raise ValueError(
+        f"Device descriptor {descriptor!r} does not resolve on this host: "
+        f"no {platform!r} device with id {index}."
+    )

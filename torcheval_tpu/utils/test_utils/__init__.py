@@ -1,0 +1,11 @@
+from torcheval_tpu.utils.test_utils.dummy_metric import (
+    DummySumDictStateMetric,
+    DummySumListStateMetric,
+    DummySumMetric,
+)
+
+__all__ = [
+    "DummySumMetric",
+    "DummySumListStateMetric",
+    "DummySumDictStateMetric",
+]

@@ -1,0 +1,61 @@
+"""Minimal one-per-state-type metrics for base/toolkit/sync tests.
+
+Parity with reference torcheval/utils/test_utils/dummy_metric.py: a tensor
+state, a list state, and a dict state variant of a trivial sum metric.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TDummySumMetric = TypeVar("TDummySumMetric")
+
+
+class DummySumMetric(Metric[jax.Array]):
+    """Sums scalar updates into a tensor state."""
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("sum", jnp.zeros(()), merge=MergeKind.SUM)
+
+    def update(self, x) -> "DummySumMetric":
+        self.sum = self.sum + self._input_float(x)
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.sum
+
+
+class DummySumListStateMetric(Metric[jax.Array]):
+    """Buffers every update in a list state."""
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("x", [], merge=MergeKind.EXTEND)
+
+    def update(self, x) -> "DummySumListStateMetric":
+        self.x.append(self._input_float(x))
+        return self
+
+    def compute(self) -> jax.Array:
+        return jnp.asarray(sum(t.sum() for t in self.x))
+
+
+class DummySumDictStateMetric(Metric[jax.Array]):
+    """Keyed sums in a dict state."""
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("x", {}, merge=MergeKind.SUM)
+
+    def update(self, k: str, v) -> "DummySumDictStateMetric":
+        self.x[k] = self.x[k] + self._input_float(v)
+        return self
+
+    def compute(self):
+        return self.x
