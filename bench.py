@@ -1,0 +1,100 @@
+"""Benchmark: metric update throughput on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workload (BASELINE.md config 1/3): MulticlassAccuracy updates inside a jitted
+eval step — batch 1024 x 100 classes per update, counters accumulated on
+device, no host syncs. The baseline is the reference torcheval (torch, CPU —
+the only backend it can use here) on the identical workload;
+``vs_baseline`` = ours / reference (higher is better).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_ours(batch: int, num_classes: int, n_iters: int) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from torcheval_tpu.metrics.functional.classification.accuracy import (
+        _multiclass_accuracy_update,
+    )
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(size=(batch, num_classes)).astype(np.float32))
+    t = jnp.asarray(rng.integers(0, num_classes, size=(batch,)))
+
+    @jax.jit
+    def step(state, x, t):
+        nc, nt = _multiclass_accuracy_update(x, t, "micro", None, 1)
+        return (state[0] + nc, state[1] + nt)
+
+    state = (jnp.zeros(()), jnp.zeros(()))
+    state = step(state, x, t)  # compile
+    jax.block_until_ready(state)
+
+    start = time.perf_counter()
+    for _ in range(n_iters):
+        state = step(state, x, t)
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - start
+    return n_iters / elapsed
+
+
+def bench_reference(batch: int, num_classes: int, n_iters: int) -> float:
+    sys.path.insert(0, "/root/reference")
+    import torch
+
+    from torcheval.metrics import MulticlassAccuracy
+
+    rng = np.random.default_rng(0)
+    x = torch.tensor(rng.uniform(size=(batch, num_classes)).astype(np.float32))
+    t = torch.tensor(rng.integers(0, num_classes, size=(batch,)))
+    metric = MulticlassAccuracy()
+    metric.update(x, t)  # warm
+    start = time.perf_counter()
+    for _ in range(n_iters):
+        metric.update(x, t)
+    elapsed = time.perf_counter() - start
+    return n_iters / elapsed
+
+
+def main() -> None:
+    batch, num_classes, n_iters = 1024, 100, 200
+    ours = bench_ours(batch, num_classes, n_iters)
+    try:
+        import types, importlib.machinery
+
+        if "torchvision" not in sys.modules:
+            tv = types.ModuleType("torchvision")
+            tv.__spec__ = importlib.machinery.ModuleSpec("torchvision", None)
+            tv.models = types.ModuleType("torchvision.models")
+            tv.models.__spec__ = importlib.machinery.ModuleSpec(
+                "torchvision.models", None
+            )
+            sys.modules["torchvision"] = tv
+            sys.modules["torchvision.models"] = tv.models
+        ref = bench_reference(batch, num_classes, n_iters)
+        vs_baseline = ours / ref
+    except Exception:
+        vs_baseline = None
+    print(
+        json.dumps(
+            {
+                "metric": "MulticlassAccuracy jitted update throughput "
+                f"(batch={batch}, classes={num_classes})",
+                "value": round(ours, 1),
+                "unit": "updates/s",
+                "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

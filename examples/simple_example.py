@@ -1,0 +1,68 @@
+"""Single-device training loop with in-loop metrics.
+
+Parity workload: reference examples/simple_example.py — train a tiny model,
+call ``metric.update`` per batch (async, no host sync), ``compute`` per epoch,
+``reset`` between epochs.
+"""
+
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from torcheval_tpu.metrics import MulticlassAccuracy, Mean, Throughput
+from torcheval_tpu.models import TransformerLM, init_params
+
+import time
+
+VOCAB, BATCH, SEQ, STEPS, EPOCHS = 64, 8, 16, 12, 2
+
+
+def main() -> None:
+    model = TransformerLM(vocab_size=VOCAB, d_model=32, n_heads=2, n_layers=1)
+    params = init_params(model, batch=BATCH, seq=SEQ)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, targets):
+        def loss_fn(p):
+            logits = model.apply(p, tokens)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, targets[..., None], -1).squeeze(-1)
+            return jnp.mean(nll), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, logits
+
+    accuracy = MulticlassAccuracy()
+    loss_mean = Mean()
+    tput = Throughput()
+
+    key = jax.random.PRNGKey(0)
+    for epoch in range(EPOCHS):
+        t0 = time.perf_counter()
+        for step in range(STEPS):
+            key, k1 = jax.random.split(key)
+            tokens = jax.random.randint(k1, (BATCH, SEQ), 0, VOCAB)
+            targets = jnp.roll(tokens, -1, axis=-1)
+            params, opt_state, loss, logits = train_step(
+                params, opt_state, tokens, targets
+            )
+            accuracy.update(logits.reshape(-1, VOCAB), targets.reshape(-1))
+            loss_mean.update(loss)
+        tput.update(STEPS * BATCH * SEQ, time.perf_counter() - t0)
+        print(
+            f"epoch {epoch}: loss={float(loss_mean.compute()):.4f} "
+            f"acc={float(accuracy.compute()):.4f} "
+            f"throughput={tput.compute():.0f} tok/s"
+        )
+        accuracy.reset()
+        loss_mean.reset()
+
+
+if __name__ == "__main__":
+    main()

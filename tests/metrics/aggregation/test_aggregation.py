@@ -1,0 +1,161 @@
+"""Aggregation metric tests (AUC/Cat/Max/Mean/Min/Sum/Throughput) vs the
+reference oracle, via the shared MetricClassTester harness."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import AUC, Cat, Max, Mean, Min, Sum, Throughput
+from torcheval_tpu.metrics import functional as F
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+    assert_result_close,
+)
+
+REF_M, REF_F = load_reference_metrics()
+RNG = np.random.default_rng(42)
+
+
+class TestSum(MetricClassTester):
+    def test_sum_class(self):
+        inputs = [RNG.normal(size=(5,)).astype(np.float32) for _ in range(8)]
+        expected = REF_M.Sum().update(torch.tensor(np.concatenate(inputs))).compute()
+        self.run_class_implementation_tests(
+            metric=Sum(),
+            state_names={"weighted_sum"},
+            update_kwargs={"input": inputs},
+            compute_result=np.asarray(expected),
+        )
+
+    def test_sum_weighted(self):
+        x = RNG.normal(size=(6,)).astype(np.float32)
+        w = RNG.uniform(size=(6,)).astype(np.float32)
+        ours = F.sum(jnp.asarray(x), jnp.asarray(w))
+        ref = REF_F.sum(torch.tensor(x), torch.tensor(w))
+        assert_result_close(ours, np.asarray(ref))
+        assert_result_close(F.sum(jnp.asarray(x), 2), np.asarray(REF_F.sum(torch.tensor(x), 2)))
+
+    def test_sum_weight_shape_mismatch_raises(self):
+        with pytest.raises(ValueError, match="Weight must be"):
+            F.sum(jnp.ones(3), jnp.ones(4))
+
+
+class TestMean(MetricClassTester):
+    def test_mean_class(self):
+        inputs = [RNG.normal(size=(4,)).astype(np.float32) for _ in range(8)]
+        weights = [RNG.uniform(0.1, 1.0, size=(4,)).astype(np.float32) for _ in range(8)]
+        ref = REF_M.Mean()
+        for x, w in zip(inputs, weights):
+            ref.update(torch.tensor(x), weight=torch.tensor(w))
+        self.run_class_implementation_tests(
+            metric=Mean(),
+            state_names={"weighted_sum", "weights"},
+            update_kwargs={
+                "input": inputs,
+                "weight": [jnp.asarray(w) for w in weights],
+            },
+            compute_result=np.asarray(ref.compute()),
+        )
+
+    def test_mean_functional_scalar_weight(self):
+        x = RNG.normal(size=(7,)).astype(np.float32)
+        assert_result_close(
+            F.mean(jnp.asarray(x), 0.3),
+            np.asarray(REF_F.mean(torch.tensor(x), 0.3)),
+        )
+
+
+class TestMaxMin(MetricClassTester):
+    def test_max_class(self):
+        inputs = [RNG.normal(size=(3, 2)).astype(np.float32) for _ in range(8)]
+        self.run_class_implementation_tests(
+            metric=Max(),
+            state_names={"max"},
+            update_kwargs={"input": inputs},
+            compute_result=np.max(np.stack(inputs)),
+        )
+
+    def test_min_class(self):
+        inputs = [RNG.normal(size=(5,)).astype(np.float32) for _ in range(8)]
+        self.run_class_implementation_tests(
+            metric=Min(),
+            state_names={"min"},
+            update_kwargs={"input": inputs},
+            compute_result=np.min(np.stack(inputs)),
+        )
+
+
+class TestCat(MetricClassTester):
+    def test_cat_class(self):
+        inputs = [RNG.normal(size=(2, 3)).astype(np.float32) for _ in range(8)]
+        self.run_class_implementation_tests(
+            metric=Cat(),
+            state_names={"dim", "inputs"},
+            update_kwargs={"input": inputs},
+            compute_result=np.concatenate(inputs, axis=0),
+        )
+
+    def test_cat_empty(self):
+        assert Cat().compute().size == 0
+
+    def test_cat_dim1(self):
+        m = Cat(dim=1)
+        m.update(jnp.ones((2, 2))).update(jnp.zeros((2, 1)))
+        assert m.compute().shape == (2, 3)
+
+
+class TestAUC(MetricClassTester):
+    def test_auc_class_vs_reference(self):
+        xs = [np.sort(RNG.uniform(size=(4,))).astype(np.float32) for _ in range(8)]
+        ys = [RNG.uniform(size=(4,)).astype(np.float32) for _ in range(8)]
+        ref = REF_M.AUC()
+        for x, y in zip(xs, ys):
+            ref.update(torch.tensor(x), torch.tensor(y))
+        self.run_class_implementation_tests(
+            metric=AUC(),
+            state_names={"x", "y"},
+            update_kwargs={"x": xs, "y": ys},
+            compute_result=np.asarray(ref.compute()),
+            atol=1e-4,
+        )
+
+    def test_auc_functional(self):
+        x = np.sort(RNG.uniform(size=(6,))).astype(np.float32)
+        y = RNG.uniform(size=(6,)).astype(np.float32)
+        assert_result_close(
+            F.auc(jnp.asarray(x), jnp.asarray(y)),
+            np.asarray(REF_F.auc(torch.tensor(x), torch.tensor(y))).reshape(-1),
+            atol=1e-5,
+        )
+
+    def test_auc_shape_mismatch_raises(self):
+        with pytest.raises(ValueError, match="same shape"):
+            F.auc(jnp.ones(3), jnp.ones(4))
+
+
+class TestThroughput(MetricClassTester):
+    def test_throughput_class(self):
+        nums = [64, 32, 128, 64, 16, 64, 32, 64]
+        times = [2.0, 1.0, 4.0, 2.0, 0.5, 2.0, 1.0, 2.0]
+        # merge across ranks: sum(items) / max(per-rank summed elapsed)
+        per_rank_elapsed = [sum(times[r * 2 : (r + 1) * 2]) for r in range(4)]
+        merge_expected = sum(nums) / max(per_rank_elapsed)
+        self.run_class_implementation_tests(
+            metric=Throughput(),
+            state_names={"num_total", "elapsed_time_sec"},
+            update_kwargs={"num_processed": nums, "elapsed_time_sec": times},
+            compute_result=sum(nums) / sum(times),
+            merge_and_compute_result=merge_expected,
+        )
+
+    def test_throughput_functional(self):
+        assert F.throughput(64, 2.0) == 32.0
+        with pytest.raises(ValueError, match="non-negative"):
+            F.throughput(-1, 1.0)
+        with pytest.raises(ValueError, match="positive"):
+            F.throughput(5, 0.0)
+
+    def test_throughput_no_update_warns(self):
+        assert Throughput().compute() == 0.0

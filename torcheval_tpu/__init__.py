@@ -1,10 +1,13 @@
 """torcheval_tpu: a TPU-native model-evaluation metrics framework.
 
-A ground-up JAX/XLA re-design with the capability surface of the reference
-metrics library (see SURVEY.md): ~40 class metrics with
-update/compute/merge_state/reset deferred semantics, ~50 stateless functional
-metrics, a distributed sync toolkit lowering to XLA collectives over ICI/DCN,
-and model-introspection tools (module summaries, FLOP counting).
+A ground-up JAX/XLA re-design of the reference metrics library's capability
+surface (see SURVEY.md): class metrics with update/compute/merge_state/reset
+deferred semantics over device-resident state, their stateless functional
+siblings as jitted XLA kernels, and a distributed sync toolkit that lowers
+state merges to XLA collectives over ICI/DCN — including an in-jit path
+(``torcheval_tpu.metrics.sharded``) that fuses metric sync into the training
+step itself. See ``torcheval_tpu.metrics.__all__`` for the currently
+implemented metric inventory.
 """
 
 from torcheval_tpu.version import __version__

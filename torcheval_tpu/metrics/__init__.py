@@ -1,0 +1,28 @@
+from torcheval_tpu.metrics import functional
+from torcheval_tpu.metrics.aggregation import AUC, Cat, Max, Mean, Min, Sum, Throughput
+from torcheval_tpu.metrics.classification import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MultilabelAccuracy,
+    TopKMultilabelAccuracy,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+__all__ = [
+    # base interface
+    "Metric",
+    # functional metrics
+    "functional",
+    # class metrics
+    "AUC",
+    "BinaryAccuracy",
+    "Cat",
+    "Max",
+    "Mean",
+    "Min",
+    "MulticlassAccuracy",
+    "MultilabelAccuracy",
+    "Sum",
+    "Throughput",
+    "TopKMultilabelAccuracy",
+]

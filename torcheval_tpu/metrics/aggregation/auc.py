@@ -1,0 +1,72 @@
+"""AUC class metric.
+
+Parity: reference torcheval/metrics/aggregation/auc.py:23-155 (list-buffered
+x/y states, `_prepare_for_merge_state` concatenation).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.aggregation.auc import (
+    _auc_compute,
+    _auc_update_input_check,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TAUC = TypeVar("TAUC", bound="AUC")
+
+
+class AUC(Metric[jax.Array]):
+    """Trapezoidal AUC of arbitrary (x, y) curves, buffered across updates.
+
+    Args:
+        reorder: stably sort buffered x before integrating (default True,
+            matching the reference class default).
+        n_tasks: number of independent curves per update.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import AUC
+        >>> metric = AUC()
+        >>> metric.update(jnp.array([0., .5, 1.]), jnp.array([1., .5, 0.]))
+        >>> metric.compute()
+        Array([0.5], dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        reorder: bool = True,
+        n_tasks: int = 1,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        self.reorder = reorder
+        self.n_tasks = n_tasks
+        self._add_state("x", [], merge=MergeKind.EXTEND)
+        self._add_state("y", [], merge=MergeKind.EXTEND)
+
+    def update(self: TAUC, x, y) -> TAUC:
+        x, y = self._input(x), self._input(y)
+        _auc_update_input_check(x, y, self.n_tasks)
+        self.x.append(jnp.atleast_2d(x))
+        self.y.append(jnp.atleast_2d(y))
+        return self
+
+    def compute(self) -> jax.Array:
+        if not self.x:
+            return jnp.zeros((0,))
+        return _auc_compute(
+            jnp.concatenate(self.x, axis=1),
+            jnp.concatenate(self.y, axis=1),
+            self.reorder,
+        )
+
+    def _prepare_for_merge_state(self) -> None:
+        if self.x:
+            self.x = [jnp.concatenate(self.x, axis=1)]
+            self.y = [jnp.concatenate(self.y, axis=1)]

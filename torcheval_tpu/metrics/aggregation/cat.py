@@ -1,0 +1,50 @@
+"""Cat class metric: concatenation accumulator.
+
+Parity: reference torcheval/metrics/aggregation/cat.py:19-97 (note: ``dim``
+is registered as an int state; merge compacts buffers into one array).
+"""
+
+from __future__ import annotations
+
+from typing import TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TCat = TypeVar("TCat", bound="Cat")
+
+
+class Cat(Metric[jax.Array]):
+    """Concatenate all updated inputs along ``dim``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import Cat
+        >>> metric = Cat()
+        >>> metric.update(jnp.array([1., 2.])).update(jnp.array([3.]))
+        >>> metric.compute()
+        Array([1., 2., 3.], dtype=float32)
+    """
+
+    def __init__(self, *, dim: int = 0, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("dim", dim, merge=MergeKind.CUSTOM)
+        self._add_state("inputs", [], merge=MergeKind.EXTEND)
+
+    def update(self: TCat, input) -> TCat:
+        self.inputs.append(self._input(input))
+        return self
+
+    def compute(self) -> jax.Array:
+        if not self.inputs:
+            return jnp.zeros((0,))
+        return jnp.concatenate(self.inputs, axis=self.dim)
+
+    def _merge_custom_state(self, name, mine, theirs):
+        return mine  # `dim` is configuration carried as state; keep ours
+
+    def _prepare_for_merge_state(self) -> None:
+        if self.inputs:
+            self.inputs = [jnp.concatenate(self.inputs, axis=self.dim)]

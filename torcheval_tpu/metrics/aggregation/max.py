@@ -1,0 +1,37 @@
+"""Max class metric.
+
+Parity: reference torcheval/metrics/aggregation/max.py:19-63.
+"""
+
+from __future__ import annotations
+
+from typing import TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TMax = TypeVar("TMax", bound="Max")
+
+
+class Max(Metric[jax.Array]):
+    """Running maximum over all elements of all updates.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import Max
+        >>> Max().update(jnp.array([1., 5., 2.])).compute()
+        Array(5., dtype=float32)
+    """
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("max", jnp.float32(-jnp.inf), merge=MergeKind.MAX)
+
+    def update(self: TMax, input) -> TMax:
+        self.max = jnp.maximum(self.max, jnp.max(self._input_float(input)))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.max

@@ -1,0 +1,41 @@
+"""Mean class metric (weighted).
+
+Parity: reference torcheval/metrics/aggregation/mean.py:20-105.
+"""
+
+from __future__ import annotations
+
+from typing import TypeVar, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.aggregation.mean import _mean_update
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TMean = TypeVar("TMean", bound="Mean")
+
+
+class Mean(Metric[jax.Array]):
+    """Weighted mean of all updated values.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import Mean
+        >>> Mean().update(jnp.array([2., 3.])).compute()
+        Array(2.5, dtype=float32)
+    """
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("weighted_sum", jnp.zeros(()), merge=MergeKind.SUM)
+        self._add_state("weights", jnp.zeros(()), merge=MergeKind.SUM)
+
+    def update(self: TMean, input, *, weight: Union[float, int, jax.Array] = 1.0) -> TMean:
+        weighted_sum, weights = _mean_update(self._input(input), weight)
+        self.weighted_sum = self.weighted_sum + weighted_sum
+        self.weights = self.weights + weights
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.weighted_sum / self.weights

@@ -1,0 +1,37 @@
+"""Min class metric.
+
+Parity: reference torcheval/metrics/aggregation/min.py:19-63.
+"""
+
+from __future__ import annotations
+
+from typing import TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TMin = TypeVar("TMin", bound="Min")
+
+
+class Min(Metric[jax.Array]):
+    """Running minimum over all elements of all updates.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import Min
+        >>> Min().update(jnp.array([1., 5., 2.])).compute()
+        Array(1., dtype=float32)
+    """
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("min", jnp.float32(jnp.inf), merge=MergeKind.MIN)
+
+    def update(self: TMin, input) -> TMin:
+        self.min = jnp.minimum(self.min, jnp.min(self._input_float(input)))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.min

@@ -1,0 +1,38 @@
+"""Sum class metric (weighted).
+
+Parity: reference torcheval/metrics/aggregation/sum.py:19-88.
+"""
+
+from __future__ import annotations
+
+from typing import TypeVar, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.aggregation.sum import _sum_update
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TSum = TypeVar("TSum", bound="Sum")
+
+
+class Sum(Metric[jax.Array]):
+    """Weighted sum of all updated values.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import Sum
+        >>> Sum().update(jnp.array([2., 3.])).compute()
+        Array(5., dtype=float32)
+    """
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("weighted_sum", jnp.zeros(()), merge=MergeKind.SUM)
+
+    def update(self: TSum, input, *, weight: Union[float, int, jax.Array] = 1.0) -> TSum:
+        self.weighted_sum = self.weighted_sum + _sum_update(self._input(input), weight)
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.weighted_sum

@@ -1,0 +1,177 @@
+"""Accuracy class metrics.
+
+Parity: reference torcheval/metrics/classification/accuracy.py
+(MulticlassAccuracy :32, BinaryAccuracy :151, MultilabelAccuracy :215,
+TopKMultilabelAccuracy :317). The classes only own counter accumulation;
+all math lives in the jitted functional kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.accuracy import (
+    _accuracy_compute,
+    _accuracy_param_check,
+    _accuracy_update_input_check,
+    _binary_accuracy_update,
+    _binary_accuracy_update_input_check,
+    _multiclass_accuracy_update,
+    _multilabel_accuracy_param_check,
+    _multilabel_accuracy_update,
+    _multilabel_accuracy_update_input_check,
+    _topk_multilabel_accuracy_param_check,
+    _topk_multilabel_accuracy_update,
+    _topk_multilabel_accuracy_update_input_check,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TAccuracy = TypeVar("TAccuracy", bound="MulticlassAccuracy")
+
+
+class MulticlassAccuracy(Metric[jax.Array]):
+    """Accuracy for multiclass classification; O(1) counter states.
+
+    Args:
+        average: ``"micro"`` | ``"macro"`` | ``"none"``/``None``.
+        num_classes: required for non-micro averaging.
+        k: top-k correctness (needs 2-D score inputs).
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import MulticlassAccuracy
+        >>> metric = MulticlassAccuracy()
+        >>> metric.update(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        average: Optional[str] = "micro",
+        num_classes: Optional[int] = None,
+        k: int = 1,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _accuracy_param_check(average, num_classes, k)
+        self.average = average
+        self.num_classes = num_classes
+        self.k = k
+        if average == "micro":
+            self._add_state("num_correct", jnp.zeros(()), merge=MergeKind.SUM)
+            self._add_state("num_total", jnp.zeros(()), merge=MergeKind.SUM)
+        else:
+            assert num_classes is not None
+            self._add_state(
+                "num_correct", jnp.zeros(num_classes), merge=MergeKind.SUM
+            )
+            self._add_state(
+                "num_total", jnp.zeros(num_classes), merge=MergeKind.SUM
+            )
+
+    def update(self: TAccuracy, input, target) -> TAccuracy:
+        input, target = self._input(input), self._input(target)
+        _accuracy_update_input_check(input, target, self.num_classes, self.k)
+        num_correct, num_total = _multiclass_accuracy_update(
+            input, target, self.average, self.num_classes, self.k
+        )
+        self.num_correct = self.num_correct + num_correct
+        self.num_total = self.num_total + num_total
+        return self
+
+    def compute(self) -> jax.Array:
+        return _accuracy_compute(self.num_correct, self.num_total, self.average)
+
+
+class BinaryAccuracy(MulticlassAccuracy):
+    """Binary accuracy with score binarization at ``threshold``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import BinaryAccuracy
+        >>> metric = BinaryAccuracy()
+        >>> metric.update(jnp.array([0.9, 0.2, 0.6, 0.1]), jnp.array([1, 0, 0, 1]))
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
+
+    def __init__(self, *, threshold: float = 0.5, device=None) -> None:
+        super().__init__(device=device)
+        self.threshold = threshold
+
+    def update(self, input, target) -> "BinaryAccuracy":
+        input, target = self._input(input), self._input(target)
+        _binary_accuracy_update_input_check(input, target)
+        num_correct, num_total = _binary_accuracy_update(
+            input, target, float(self.threshold)
+        )
+        self.num_correct = self.num_correct + num_correct
+        self.num_total = self.num_total + num_total
+        return self
+
+
+class MultilabelAccuracy(MulticlassAccuracy):
+    """Multilabel accuracy under one of five matching criteria.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import MultilabelAccuracy
+        >>> metric = MultilabelAccuracy()
+        >>> metric.update(jnp.array([[0.1, 0.9], [0.8, 0.9]]),
+        ...               jnp.array([[0, 1], [1, 1]]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        threshold: float = 0.5,
+        criteria: str = "exact_match",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _multilabel_accuracy_param_check(criteria)
+        self.threshold = threshold
+        self.criteria = criteria
+
+    def update(self, input, target) -> "MultilabelAccuracy":
+        input, target = self._input(input), self._input(target)
+        _multilabel_accuracy_update_input_check(input, target)
+        num_correct, num_total = _multilabel_accuracy_update(
+            input, target, float(self.threshold), self.criteria
+        )
+        self.num_correct = self.num_correct + num_correct
+        self.num_total = self.num_total + num_total
+        return self
+
+
+class TopKMultilabelAccuracy(MulticlassAccuracy):
+    """Multilabel accuracy with top-k binarization of scores."""
+
+    def __init__(
+        self,
+        *,
+        criteria: str = "exact_match",
+        k: int = 2,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _topk_multilabel_accuracy_param_check(criteria, k)
+        self.criteria = criteria
+        self.k = k
+
+    def update(self, input, target) -> "TopKMultilabelAccuracy":
+        input, target = self._input(input), self._input(target)
+        _topk_multilabel_accuracy_update_input_check(input, target, self.k)
+        num_correct, num_total = _topk_multilabel_accuracy_update(
+            input, target, self.criteria, self.k
+        )
+        self.num_correct = self.num_correct + num_correct
+        self.num_total = self.num_total + num_total
+        return self

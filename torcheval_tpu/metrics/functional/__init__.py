@@ -1,0 +1,18 @@
+from torcheval_tpu.metrics.functional.aggregation import auc, mean, sum, throughput
+from torcheval_tpu.metrics.functional.classification import (
+    binary_accuracy,
+    multiclass_accuracy,
+    multilabel_accuracy,
+    topk_multilabel_accuracy,
+)
+
+__all__ = [
+    "auc",
+    "binary_accuracy",
+    "mean",
+    "multiclass_accuracy",
+    "multilabel_accuracy",
+    "sum",
+    "throughput",
+    "topk_multilabel_accuracy",
+]

@@ -1,0 +1,6 @@
+from torcheval_tpu.metrics.functional.aggregation.auc import auc
+from torcheval_tpu.metrics.functional.aggregation.mean import mean
+from torcheval_tpu.metrics.functional.aggregation.sum import sum
+from torcheval_tpu.metrics.functional.aggregation.throughput import throughput
+
+__all__ = ["auc", "mean", "sum", "throughput"]

@@ -1,0 +1,80 @@
+"""Trapezoidal AUC over arbitrary curves.
+
+Parity: reference torcheval/metrics/functional/aggregation/auc.py
+(`auc`, `_auc_compute` trapezoidal rule with optional stable x-sort,
+`_auc_update_input_check`). TPU-first: the sort + trapezoid run as one
+jitted XLA kernel over the (n_tasks, n_points) batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.tensor_utils import trapezoid
+from torcheval_tpu.utils.convert import to_jax
+
+
+@partial(jax.jit, static_argnames=("reorder",))
+def _auc_compute_jit(x: jax.Array, y: jax.Array, reorder: bool) -> jax.Array:
+    if reorder:
+        order = jnp.argsort(x, axis=1, stable=True)
+        x = jnp.take_along_axis(x, order, axis=1)
+        y = jnp.take_along_axis(y, order, axis=1)
+    return trapezoid(y, x, axis=1)
+
+
+def _auc_compute(x: jax.Array, y: jax.Array, reorder: bool = False) -> jax.Array:
+    if x.size == 0 or y.size == 0:
+        return jnp.zeros((0,))
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[None, :]
+    return _auc_compute_jit(x, y, reorder)
+
+
+def _auc_update_input_check(x: jax.Array, y: jax.Array, n_tasks: int = 1) -> None:
+    size_x, size_y = x.shape, y.shape
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[None, :]
+    if x.size == 0 or y.size == 0:
+        raise ValueError(
+            f"The `x` and `y` should have atleast 1 element, got shapes "
+            f"{size_x} and {size_y}."
+        )
+    if x.shape != y.shape:
+        raise ValueError(
+            f"Expected the same shape in `x` and `y` tensor but got shapes "
+            f"{size_x} and {size_y}."
+        )
+    if x.shape[0] != n_tasks or y.shape[0] != n_tasks:
+        raise ValueError(
+            f"Expected `x` dim_1={x.shape[0]} and `y` dim_1={y.shape[0]} have "
+            f"first dimension equals to n_tasks={n_tasks}."
+        )
+
+
+def auc(x, y, reorder: bool = False) -> jax.Array:
+    """Compute AUC of (x, y) point curves with the trapezoidal rule.
+
+    Class version: ``torcheval_tpu.metrics.AUC``.
+
+    Args:
+        x: x-coordinates, shape (n,) or (n_tasks, n).
+        y: y-coordinates, same shape.
+        reorder: sort x (stably) before integrating.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import auc
+        >>> auc(jnp.array([0., .1, .5, 1.]), jnp.array([1., 1., .5, 0.]))
+        Array([0.575], dtype=float32)
+    """
+    x, y = to_jax(x), to_jax(y)
+    _auc_update_input_check(x, y, n_tasks=1 if x.ndim == 1 else x.shape[0])
+    return _auc_compute(x, y, reorder)

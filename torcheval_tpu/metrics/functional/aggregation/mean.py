@@ -1,0 +1,54 @@
+"""Weighted mean.
+
+Parity: reference torcheval/metrics/functional/aggregation/mean.py:13-65
+(`mean`, `_mean_update` returning (weighted_sum, weights)).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import is_torch_tensor, to_jax_float
+
+
+@jax.jit
+def _weighted_sum_pair(input: jax.Array, weight: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    return jnp.sum(weight * input), jnp.sum(weight)
+
+
+@jax.jit
+def _scalar_weight_pair(input: jax.Array, weight: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    return weight * jnp.sum(input), weight * input.size
+
+
+def _mean_update(input, weight: Union[float, int, jax.Array]) -> Tuple[jax.Array, jax.Array]:
+    input = to_jax_float(input)
+    if isinstance(weight, (float, int)) and not is_torch_tensor(weight):
+        return _scalar_weight_pair(input, jnp.float32(weight))
+    weight_arr = to_jax_float(weight)
+    if weight_arr.shape == input.shape:
+        return _weighted_sum_pair(input, weight_arr)
+    raise ValueError(
+        "Weight must be either a float value or a tensor that matches the "
+        f"input tensor size. Got {weight} instead."
+    )
+
+
+def mean(input, weight: Union[float, int, jax.Array] = 1.0) -> jax.Array:
+    """Weighted mean: ``sum(weight * input) / sum(weight)``.
+
+    Class version: ``torcheval_tpu.metrics.Mean``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import mean
+        >>> mean(jnp.array([2., 3.]))
+        Array(2.5, dtype=float32)
+        >>> mean(jnp.array([2., 3.]), jnp.array([0.2, 0.8]))
+        Array(2.8, dtype=float32)
+    """
+    weighted_sum, weights = _mean_update(input, weight)
+    return weighted_sum / weights

@@ -1,0 +1,49 @@
+"""Weighted sum.
+
+Parity: reference torcheval/metrics/functional/aggregation/sum.py:13-58
+(`sum`, `_sum_update`).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import is_torch_tensor, to_jax_float
+
+
+@jax.jit
+def _weighted_total(input: jax.Array, weight: jax.Array) -> jax.Array:
+    return jnp.sum(input * weight)
+
+
+def _sum_update(input, weight: Union[float, int, jax.Array]) -> jax.Array:
+    input = to_jax_float(input)
+    if isinstance(weight, (float, int)) and not is_torch_tensor(weight):
+        return _weighted_total(input, jnp.float32(weight))
+    weight_arr = to_jax_float(weight)
+    if weight_arr.shape == input.shape:
+        return _weighted_total(input, weight_arr)
+    raise ValueError(
+        "Weight must be either a float value or an int value or a tensor "
+        f"that matches the input tensor size. Got {weight} instead."
+    )
+
+
+def sum(input, weight: Union[float, int, jax.Array] = 1.0) -> jax.Array:
+    """Weighted sum: ``sum(weight * input)``.
+
+    Class version: ``torcheval_tpu.metrics.Sum``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import sum
+        >>> sum(jnp.array([2., 3.]))
+        Array(5., dtype=float32)
+        >>> sum(jnp.array([2., 3.]), 0.5)
+        Array(2.5, dtype=float32)
+    """
+    return _sum_update(input, weight)

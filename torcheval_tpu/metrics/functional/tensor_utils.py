@@ -1,0 +1,37 @@
+"""Shared numeric helpers for functional metrics.
+
+Parity targets: reference torcheval/metrics/functional/tensor_utils.py
+(`_riemann_integral`, `_create_threshold_tensor`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def riemann_integral(x: jax.Array, y: jax.Array) -> jax.Array:
+    """Left-Riemann integral of y(x): ``-sum((x[1:]-x[:-1]) * y[:-1])``
+    (reference tensor_utils.py:12-16; the sign matches the reference's
+    descending-x convention). Works on trailing axis for batched inputs."""
+    return -jnp.sum((x[..., 1:] - x[..., :-1]) * y[..., :-1], axis=-1)
+
+
+def trapezoid(y: jax.Array, x: jax.Array, axis: int = -1) -> jax.Array:
+    """Trapezoidal rule along ``axis`` (torch.trapz equivalent)."""
+    x = jnp.moveaxis(x, axis, -1)
+    y = jnp.moveaxis(y, axis, -1)
+    dx = x[..., 1:] - x[..., :-1]
+    return jnp.sum(dx * (y[..., 1:] + y[..., :-1]) / 2.0, axis=-1)
+
+
+def create_threshold_tensor(
+    threshold: Union[int, List[float], jax.Array],
+) -> jax.Array:
+    """int n -> linspace(0, 1, n); list/array -> as-is
+    (reference tensor_utils.py:19-33)."""
+    if isinstance(threshold, int):
+        return jnp.linspace(0.0, 1.0, threshold)
+    return jnp.asarray(threshold, dtype=jnp.float32)

@@ -1,0 +1,180 @@
+"""Tensor-level state sync primitives.
+
+Parity: reference torcheval/metrics/synclib.py:32-291 — the pickle-free sync
+protocol operating on *state dicts* rather than Metric objects, with:
+
+- a deterministic (alphabetical) traversal order so every rank issues
+  collectives in the same sequence (reference synclib.py:32-47);
+- ragged cross-rank payloads handled by exchanging shape metadata first and
+  padding tensors to a common static shape (the reference's dummy-tensor
+  padding, synclib.py:159-178 — which is exactly what XLA's static-shape
+  collectives require anyway);
+- int/float/object states exchanged host-side (reference synclib.py:201-213).
+
+All functions take a ``ProcessGroup``; under ``LocalReplicaGroup`` the
+"collectives" are in-process list operations, under ``MultiHostGroup`` they
+ride ICI/DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from torcheval_tpu.distributed import LocalReplicaGroup, ProcessGroup
+from torcheval_tpu.metrics.metric import TState
+
+# A "metric states" payload: {metric_name: {state_name: TState}}
+MetricStates = Dict[str, Dict[str, TState]]
+
+
+def metrics_traversal_order(metric_states: MetricStates) -> List[Tuple[str, str]]:
+    """Deterministic (metric, state) visit order — the cross-rank ordering
+    contract (reference synclib.py:32-47)."""
+    order: List[Tuple[str, str]] = []
+    for metric_name in sorted(metric_states.keys()):
+        for state_name in sorted(metric_states[metric_name].keys()):
+            order.append((metric_name, state_name))
+    return order
+
+
+def _is_array(x: Any) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+def _gather_ragged(
+    group: ProcessGroup, values: Any
+) -> List[List[np.ndarray]]:
+    """Gather a per-rank *list of arrays* whose lengths/shapes may differ.
+
+    ``values``: this rank's list (or the per-rank list-of-lists under a
+    LocalReplicaGroup). Returns every rank's list on every rank.
+
+    Protocol (static-shape friendly): 1) allgather [(shape, dtype), ...]
+    metadata; 2) pad each rank's payload to the max flat size; 3) allgather
+    the padded buffer; 4) slice/reshape per the metadata.
+    """
+    local_mode = isinstance(group, LocalReplicaGroup)
+
+    def meta_of(lst):
+        return [(tuple(a.shape), str(np.asarray(a).dtype)) for a in lst]
+
+    if local_mode:
+        metas = [meta_of(lst) for lst in values]
+    else:
+        metas = group.allgather_object(meta_of(values))
+
+    def flat_bytes(meta):
+        total = 0
+        for shape, dtype in meta:
+            total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        return total
+
+    max_bytes = max((flat_bytes(m) for m in metas), default=0)
+    if max_bytes == 0:
+        return [[] for _ in range(group.world_size)]
+
+    def pad(lst):
+        if not lst:
+            flat = np.zeros(0, dtype=np.uint8)
+        else:
+            flat = np.concatenate(
+                [np.ascontiguousarray(np.asarray(a)).reshape(-1).view(np.uint8) for a in lst]
+            )
+        out = np.zeros(max_bytes, dtype=np.uint8)
+        out[: flat.size] = flat
+        return out
+
+    if local_mode:
+        gathered = [pad(lst) for lst in values]
+    else:
+        gathered = group.allgather_array(pad(values))
+
+    results: List[List[np.ndarray]] = []
+    for rank, meta in enumerate(metas):
+        buf = np.asarray(gathered[rank])
+        out, offset = [], 0
+        for shape, dtype in meta:
+            nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+            arr = buf[offset : offset + nbytes].view(np.dtype(dtype)).reshape(shape)
+            out.append(arr)
+            offset += nbytes
+        results.append(out)
+    return results
+
+
+def _sync_tensor_state(group: ProcessGroup, value: Any) -> List[np.ndarray]:
+    """One tensor state per rank (shapes may differ, e.g. concatenated
+    buffers of different per-rank example counts)."""
+    if isinstance(group, LocalReplicaGroup):
+        payload = [[v] for v in value]  # per-replica singleton lists
+    else:
+        payload = [value]  # this rank's singleton list
+    return [lst[0] for lst in _gather_ragged(group, payload)]
+
+
+def _sync_list_state(group: ProcessGroup, value: Any) -> List[List[np.ndarray]]:
+    return _gather_ragged(group, value)
+
+
+def _sync_dict_state(group: ProcessGroup, value: Any) -> List[Dict[Any, np.ndarray]]:
+    """Dict states: key sets may differ per rank. Keys travel with the
+    metadata gather; tensor payloads ride the ragged protocol in sorted-key
+    order (reference synclib.py:181-198)."""
+    if isinstance(group, LocalReplicaGroup):
+        keys_per_rank = [sorted(d.keys()) for d in value]
+        lists = [[np.asarray(d[k]) for k in ks] for d, ks in zip(value, keys_per_rank)]
+        gathered = _gather_ragged(group, lists)
+    else:
+        keys_per_rank = group.allgather_object(sorted(value.keys()))
+        local_list = [np.asarray(value[k]) for k in sorted(value.keys())]
+        gathered = _gather_ragged(group, local_list)
+    return [
+        dict(zip(ks, arrs)) for ks, arrs in zip(keys_per_rank, gathered)
+    ]
+
+
+def _sync_obj_state(group: ProcessGroup, value: Any) -> List[Any]:
+    return group.allgather_object(value)
+
+
+def sync_states(
+    metric_states: Any, process_group: ProcessGroup
+) -> List[MetricStates]:
+    """Gather every rank's metric states to every rank.
+
+    Under ``MultiHostGroup``: ``metric_states`` is this process's
+    ``{metric_name: state_dict}``; returns the per-rank list (reference
+    synclib.py:216-291 semantics).
+    Under ``LocalReplicaGroup``: ``metric_states`` is already the per-replica
+    list ``[{metric_name: state_dict}, ...]``; returned re-assembled in the
+    same deterministic traversal order to exercise the identical protocol.
+    """
+    local_mode = isinstance(process_group, LocalReplicaGroup)
+    template = metric_states[0] if local_mode else metric_states
+    order = metrics_traversal_order(template)
+    world = process_group.world_size
+
+    synced: List[MetricStates] = [
+        {m: {} for m in template} for _ in range(world)
+    ]
+    for metric_name, state_name in order:
+        if local_mode:
+            value = [ms[metric_name][state_name] for ms in metric_states]
+            probe = value[0]
+        else:
+            value = metric_states[metric_name][state_name]
+            probe = value
+        if _is_array(probe):
+            gathered = _sync_tensor_state(process_group, value)
+        elif isinstance(probe, list):
+            gathered = _sync_list_state(process_group, value)
+        elif isinstance(probe, dict):
+            gathered = _sync_dict_state(process_group, value)
+        else:
+            gathered = _sync_obj_state(process_group, value)
+        for rank in range(world):
+            synced[rank][metric_name][state_name] = gathered[rank]
+    return synced

@@ -1,0 +1,7 @@
+from torcheval_tpu.models.transformer import (
+    TransformerLM,
+    init_params,
+    param_specs,
+)
+
+__all__ = ["TransformerLM", "init_params", "param_specs"]

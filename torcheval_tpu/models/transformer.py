@@ -1,0 +1,93 @@
+"""A small Flax transformer LM used by examples, benchmarks and the
+multi-chip dry-run.
+
+The reference is a metrics library whose examples drive small ``nn.Module``s
+(reference examples/simple_example.py, distributed_example.py); this is our
+equivalent workload generator, written mesh-aware so metrics can be exercised
+under real dp/tp shardings:
+
+- parameters carry ``PartitionSpec``s (``param_specs``) sharding attention
+  heads and MLP hidden over the ``tp`` axis,
+- the batch axis shards over ``dp``; under ``pjit`` XLA inserts the
+  tp-reduction and dp-metric collectives automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class Block(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm()(x)
+        h = nn.SelfAttention(
+            num_heads=self.n_heads,
+            qkv_features=self.d_model,
+            use_bias=False,
+            deterministic=True,
+        )(h, mask=nn.make_causal_mask(jnp.ones(h.shape[:2], dtype=bool)))
+        x = x + h
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(self.d_ff, use_bias=False)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, use_bias=False)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 128
+    max_len: int = 128
+
+    @nn.compact
+    def __call__(self, tokens):
+        pos = jnp.arange(tokens.shape[-1])
+        x = nn.Embed(self.vocab_size, self.d_model)(tokens)
+        x = x + nn.Embed(self.max_len, self.d_model)(pos)
+        for _ in range(self.n_layers):
+            x = Block(self.d_model, self.n_heads, self.d_ff)(x)
+        x = nn.LayerNorm()(x)
+        return nn.Dense(self.vocab_size, use_bias=False)(x)
+
+
+def init_params(model: TransformerLM, batch: int = 2, seq: int = 16, seed: int = 0):
+    tokens = jnp.zeros((batch, seq), dtype=jnp.int32)
+    return model.init(jax.random.PRNGKey(seed), tokens)
+
+
+def param_specs(params: Dict[str, Any]) -> Dict[str, Any]:
+    """PartitionSpecs for tensor parallelism over a ``tp`` mesh axis.
+
+    2-D kernels shard their output features over tp (input-features for the
+    down-projections, detected by name); embeddings shard features over tp;
+    everything else (LayerNorm scales, 1-D params) replicates.
+    """
+
+    def spec_for(path, leaf):
+        names = [getattr(k, "key", str(k)) for k in path]
+        if leaf.ndim < 2:
+            return P()
+        joined = "/".join(names)
+        if "Embed" in joined:
+            return P(None, "tp")
+        if "out" in joined or "Dense_1" in joined:
+            # attention out-proj and MLP down-proj: contract over sharded dim
+            return P("tp", None)
+        if leaf.ndim >= 2:
+            return P(*([None] * (leaf.ndim - 1) + ["tp"]))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

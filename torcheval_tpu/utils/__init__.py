@@ -1,0 +1,15 @@
+from torcheval_tpu.utils.random_data import (
+    get_rand_data_binary,
+    get_rand_data_binned_binary,
+    get_rand_data_multiclass,
+    get_rand_data_multilabel,
+)
+
+# Note: the reference defines get_rand_data_multilabel but forgets to export
+# it (reference utils/__init__.py:8-17); we export all four.
+__all__ = [
+    "get_rand_data_binary",
+    "get_rand_data_binned_binary",
+    "get_rand_data_multiclass",
+    "get_rand_data_multilabel",
+]

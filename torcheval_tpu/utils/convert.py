@@ -101,13 +101,18 @@ def canonicalize_device(
             index = int(index_s)
         else:
             platform, index = device, 0
-        devices = jax.devices(platform)
-        if not 0 <= index < len(devices):
-            raise ValueError(
-                f"Device {device!r} out of range: backend {platform!r} has "
-                f"{len(devices)} devices."
-            )
-        return devices[index]
+        devices = jax.local_devices(backend=platform)
+        # resolve by device id (stable, matches device_descriptor); fall back
+        # to list position for platforms whose local ids are not 0-based.
+        for d in devices:
+            if d.id == index:
+                return d
+        if 0 <= index < len(devices):
+            return devices[index]
+        raise ValueError(
+            f"Device {device!r} out of range: backend {platform!r} has "
+            f"{len(devices)} local devices."
+        )
     raise TypeError(f"Cannot interpret {device!r} as a jax.Device")
 
 
@@ -119,10 +124,10 @@ def device_descriptor(device: jax.Device) -> str:
 def resolve_device_descriptor(descriptor: str) -> jax.Device:
     platform, _, index_s = descriptor.partition(":")
     index = int(index_s or 0)
-    for d in jax.devices(platform):
+    for d in jax.local_devices(backend=platform):
         if d.id == index:
             return d
     raise ValueError(
         f"Device descriptor {descriptor!r} does not resolve on this host: "
-        f"no {platform!r} device with id {index}."
+        f"no local {platform!r} device with id {index}."
     )

@@ -1,0 +1,88 @@
+"""Random dataset generators for tests and examples.
+
+Parity: reference torcheval/utils/random_data.py:12-161
+(`get_rand_data_binary/multiclass/multilabel/binned_binary`), re-based on
+``jax.random`` keys instead of the torch global RNG.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def get_rand_data_binary(
+    num_updates: int,
+    num_tasks: int,
+    batch_size: int,
+    *,
+    key: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Random (input, target) pairs for binary metrics.
+
+    Returns input scores in [0, 1) and integer 0/1 targets, each shaped
+    (num_updates, num_tasks, batch_size) — squeezed to
+    (num_updates, batch_size) when num_tasks == 1.
+    """
+    key = key if key is not None else jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    shape = (num_updates, num_tasks, batch_size)
+    input = jax.random.uniform(k1, shape)
+    targets = jax.random.randint(k2, shape, 0, 2)
+    if num_tasks == 1:
+        input, targets = input.squeeze(1), targets.squeeze(1)
+    return input, targets
+
+
+def get_rand_data_multiclass(
+    num_updates: int,
+    num_classes: int,
+    batch_size: int,
+    *,
+    key: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Random (input, target) for multiclass metrics: scores shaped
+    (num_updates, batch_size, num_classes), integer class targets."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    input = jax.random.uniform(k1, (num_updates, batch_size, num_classes))
+    targets = jax.random.randint(k2, (num_updates, batch_size), 0, num_classes)
+    return input, targets
+
+
+def get_rand_data_multilabel(
+    num_updates: int,
+    num_labels: int,
+    batch_size: int,
+    *,
+    key: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Random (input, target) for multilabel metrics: scores and 0/1 targets
+    shaped (num_updates, batch_size, num_labels)."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    shape = (num_updates, batch_size, num_labels)
+    input = jax.random.uniform(k1, shape)
+    targets = jax.random.randint(k2, shape, 0, 2)
+    return input, targets
+
+
+def get_rand_data_binned_binary(
+    num_updates: int,
+    num_tasks: int,
+    batch_size: int,
+    num_bins: int,
+    *,
+    key: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Random binary data plus a sorted threshold tensor in [0, 1]."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    input, targets = get_rand_data_binary(
+        num_updates, num_tasks, batch_size, key=k1
+    )
+    thresholds = jnp.sort(jax.random.uniform(k2, (num_bins,)))
+    thresholds = thresholds.at[0].set(0.0).at[-1].set(1.0)
+    return input, targets, thresholds
