@@ -1,0 +1,242 @@
+"""Counter-family class metric tests (ConfusionMatrix / F1 / Precision /
+Recall / NormalizedEntropy) vs the reference oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import f1_score as sk_f1
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import (
+    BinaryConfusionMatrix,
+    BinaryF1Score,
+    BinaryNormalizedEntropy,
+    BinaryPrecision,
+    BinaryRecall,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from torcheval_tpu.metrics import functional as F
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+    assert_result_close,
+)
+
+REF_M, REF_F = load_reference_metrics()
+RNG = np.random.default_rng(33)
+N_UP, BATCH, C = 8, 12, 4
+
+
+def _ref_result(metric, update_args):
+    for args in update_args:
+        metric.update(*[torch.tensor(np.asarray(a)) for a in args])
+    return np.asarray(metric.compute())
+
+
+class TestConfusionMatrix(MetricClassTester):
+    @pytest.mark.parametrize("normalize", [None, "pred", "true", "all"])
+    def test_multiclass_cm(self, normalize):
+        inputs = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        targets = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        expected = _ref_result(
+            REF_M.MulticlassConfusionMatrix(C, normalize=normalize),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassConfusionMatrix(C, normalize=normalize),
+            state_names={"confusion_matrix"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_binary_cm(self):
+        inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [RNG.integers(0, 2, BATCH) for _ in range(N_UP)]
+        expected = _ref_result(
+            REF_M.BinaryConfusionMatrix(), list(zip(inputs, targets))
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryConfusionMatrix(),
+            state_names={"confusion_matrix"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_vs_sklearn(self):
+        pred = RNG.integers(0, C, 100)
+        true = RNG.integers(0, C, 100)
+        ours = F.multiclass_confusion_matrix(
+            jnp.asarray(pred), jnp.asarray(true), num_classes=C
+        )
+        assert_result_close(ours, sk_confusion_matrix(true, pred))
+
+    def test_score_input_argmax(self):
+        scores = RNG.uniform(size=(50, C)).astype(np.float32)
+        true = RNG.integers(0, C, 50)
+        ours = F.multiclass_confusion_matrix(
+            jnp.asarray(scores), jnp.asarray(true), num_classes=C
+        )
+        assert_result_close(ours, sk_confusion_matrix(true, scores.argmax(1)))
+
+    def test_param_checks(self):
+        with pytest.raises(ValueError, match="at least two"):
+            MulticlassConfusionMatrix(1)
+        with pytest.raises(ValueError, match="normalize must be"):
+            MulticlassConfusionMatrix(3, normalize="rows")
+
+    def test_normalized_view(self):
+        m = MulticlassConfusionMatrix(2)
+        m.update(jnp.array([0, 1, 1]), jnp.array([0, 1, 0]))
+        norm = m.normalized("all")
+        assert float(jnp.sum(norm)) == pytest.approx(1.0)
+
+
+class TestF1Score(MetricClassTester):
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    def test_multiclass_f1(self, average):
+        inputs = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        targets = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        kwargs = {"average": average}
+        if average != "micro":
+            kwargs["num_classes"] = C
+        expected = _ref_result(
+            REF_M.MulticlassF1Score(**kwargs), list(zip(inputs, targets))
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassF1Score(**kwargs),
+            state_names={"num_tp", "num_label", "num_prediction"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_binary_f1(self):
+        inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [RNG.integers(0, 2, BATCH) for _ in range(N_UP)]
+        expected = _ref_result(REF_M.BinaryF1Score(), list(zip(inputs, targets)))
+        self.run_class_implementation_tests(
+            metric=BinaryF1Score(),
+            state_names={"num_tp", "num_label", "num_prediction"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_vs_sklearn(self):
+        pred = RNG.integers(0, C, 100)
+        true = RNG.integers(0, C, 100)
+        for avg in ["micro", "macro", "weighted"]:
+            assert_result_close(
+                F.multiclass_f1_score(
+                    jnp.asarray(pred), jnp.asarray(true), num_classes=C, average=avg
+                ),
+                sk_f1(true, pred, average=avg),
+            )
+
+
+class TestPrecisionRecall(MetricClassTester):
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    def test_multiclass_precision(self, average):
+        inputs = [
+            RNG.uniform(size=(BATCH, C)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        kwargs = {"average": average}
+        if average != "micro":
+            kwargs["num_classes"] = C
+        expected = _ref_result(
+            REF_M.MulticlassPrecision(**kwargs), list(zip(inputs, targets))
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassPrecision(**kwargs),
+            state_names={"num_tp", "num_fp", "num_label"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    def test_multiclass_recall(self, average):
+        inputs = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        targets = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        kwargs = {"average": average}
+        if average != "micro":
+            kwargs["num_classes"] = C
+        expected = _ref_result(
+            REF_M.MulticlassRecall(**kwargs), list(zip(inputs, targets))
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassRecall(**kwargs),
+            state_names={"num_tp", "num_labels", "num_predictions"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_binary_precision_recall(self):
+        inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [RNG.integers(0, 2, BATCH) for _ in range(N_UP)]
+        expected_p = _ref_result(REF_M.BinaryPrecision(), list(zip(inputs, targets)))
+        self.run_class_implementation_tests(
+            metric=BinaryPrecision(),
+            state_names={"num_tp", "num_fp", "num_label"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected_p,
+        )
+        expected_r = _ref_result(REF_M.BinaryRecall(), list(zip(inputs, targets)))
+        self.run_class_implementation_tests(
+            metric=BinaryRecall(),
+            state_names={"num_tp", "num_true_labels"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected_r,
+        )
+
+
+class TestNormalizedEntropy(MetricClassTester):
+    @pytest.mark.parametrize("from_logits", [False, True])
+    def test_ne(self, from_logits):
+        if from_logits:
+            inputs = [
+                ((RNG.uniform(size=BATCH) - 0.5) * 4).astype(np.float32)
+                for _ in range(N_UP)
+            ]
+        else:
+            inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [
+            RNG.integers(0, 2, BATCH).astype(np.float32) for _ in range(N_UP)
+        ]
+        expected = _ref_result(
+            REF_M.BinaryNormalizedEntropy(from_logits=from_logits),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryNormalizedEntropy(from_logits=from_logits),
+            state_names={"total_entropy", "num_examples", "num_positive"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+            atol=1e-4,
+        )
+
+    def test_ne_weighted_multitask(self):
+        x = RNG.uniform(size=(2, 20)).astype(np.float32)
+        t = RNG.integers(0, 2, (2, 20)).astype(np.float32)
+        w = RNG.uniform(0.5, 2.0, (2, 20)).astype(np.float32)
+        ours = F.binary_normalized_entropy(
+            jnp.asarray(x), jnp.asarray(t), weight=jnp.asarray(w), num_tasks=2
+        )
+        ref = REF_F.binary_normalized_entropy(
+            torch.tensor(x), torch.tensor(t), weight=torch.tensor(w), num_tasks=2
+        )
+        assert_result_close(ours, np.asarray(ref), atol=1e-4)
+
+    def test_prob_range_check_gated_by_debug_validation(self):
+        from torcheval_tpu.config import debug_validation
+
+        # value check forces a host sync, so it only runs in debug mode
+        with debug_validation():
+            with pytest.raises(ValueError, match="probability"):
+                F.binary_normalized_entropy(
+                    jnp.array([1.5, 0.2]), jnp.array([1.0, 0.0])
+                )
+        # off by default: no sync, no raise
+        F.binary_normalized_entropy(jnp.array([1.5, 0.2]), jnp.array([1.0, 0.0]))

@@ -1,0 +1,270 @@
+"""Curve-family class metric tests (AUROC/AUPRC/PRC/RecallAtFixedPrecision)
+vs the reference oracle, via the shared harness."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from sklearn.metrics import roc_auc_score
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import (
+    BinaryAUPRC,
+    BinaryAUROC,
+    BinaryPrecisionRecallCurve,
+    BinaryRecallAtFixedPrecision,
+    MulticlassAUPRC,
+    MulticlassAUROC,
+    MulticlassPrecisionRecallCurve,
+    MultilabelAUPRC,
+    MultilabelPrecisionRecallCurve,
+    MultilabelRecallAtFixedPrecision,
+)
+from torcheval_tpu.metrics import functional as F
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+    assert_result_close,
+)
+
+REF_M, REF_F = load_reference_metrics()
+RNG = np.random.default_rng(21)
+N_UP, BATCH, C = 8, 10, 4
+
+
+def _to_np(x):
+    if isinstance(x, (list, tuple)):
+        return [np.asarray(v) for v in x]
+    return np.asarray(x)
+
+
+def _ref_class_result(metric, update_args):
+    for args in update_args:
+        metric.update(*[torch.tensor(np.asarray(a)) for a in args])
+    out = metric.compute()
+    if isinstance(out, tuple):
+        return tuple(_to_np(v) for v in out)
+    return _to_np(out)
+
+
+class TestBinaryAUROC(MetricClassTester):
+    def test_binary_auroc_with_ties_and_weights(self):
+        inputs = [
+            RNG.choice([0.1, 0.4, 0.7, 0.9], size=BATCH).astype(np.float32)
+            for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, 2, BATCH) for _ in range(N_UP)]
+        ref = REF_M.BinaryAUROC()
+        for x, t in zip(inputs, targets):
+            ref.update(torch.tensor(x), torch.tensor(t))
+        self.run_class_implementation_tests(
+            metric=BinaryAUROC(),
+            state_names={"inputs", "targets", "weights"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=np.asarray(ref.compute()),
+        )
+
+    def test_multi_task(self):
+        inputs = [RNG.uniform(size=(2, BATCH)).astype(np.float32) for _ in range(N_UP)]
+        targets = [RNG.integers(0, 2, (2, BATCH)) for _ in range(N_UP)]
+        expected = _ref_class_result(
+            REF_M.BinaryAUROC(num_tasks=2), list(zip(inputs, targets))
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryAUROC(num_tasks=2),
+            state_names={"inputs", "targets", "weights"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_vs_sklearn(self):
+        x = RNG.uniform(size=200).astype(np.float32)
+        t = RNG.integers(0, 2, 200)
+        assert_result_close(
+            F.binary_auroc(jnp.asarray(x), jnp.asarray(t)), roc_auc_score(t, x)
+        )
+
+    def test_degenerate_all_positive(self):
+        out = F.binary_auroc(jnp.array([0.2, 0.8]), jnp.array([1, 1]))
+        assert float(out) == 0.5
+
+    def test_fused_approximate_kernel(self):
+        # without ties the approximation is exact
+        x = np.sort(RNG.uniform(size=50).astype(np.float32))
+        t = RNG.integers(0, 2, 50)
+        exact = F.binary_auroc(jnp.asarray(x), jnp.asarray(t))
+        approx = F.binary_auroc(jnp.asarray(x), jnp.asarray(t), use_fused=True)
+        assert_result_close(exact, approx)
+
+    def test_input_checks(self):
+        with pytest.raises(ValueError, match="same shape"):
+            F.binary_auroc(jnp.ones(3), jnp.ones(4))
+        with pytest.raises(ValueError, match="num_tasks = 1"):
+            F.binary_auroc(jnp.ones((2, 3)), jnp.ones((2, 3)))
+
+
+class TestMulticlassAUROC(MetricClassTester):
+    @pytest.mark.parametrize("average", ["macro", None])
+    def test_multiclass_auroc(self, average):
+        inputs = [
+            RNG.uniform(size=(BATCH, C)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        expected = _ref_class_result(
+            REF_M.MulticlassAUROC(num_classes=C, average=average),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassAUROC(num_classes=C, average=average),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_param_checks(self):
+        with pytest.raises(ValueError, match="`average`"):
+            MulticlassAUROC(num_classes=3, average="weighted")
+        with pytest.raises(ValueError, match="at least 2"):
+            MulticlassAUROC(num_classes=1)
+
+
+class TestAUPRC(MetricClassTester):
+    def test_binary_auprc(self):
+        inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [RNG.integers(0, 2, BATCH) for _ in range(N_UP)]
+        expected = _ref_class_result(REF_M.BinaryAUPRC(), list(zip(inputs, targets)))
+        self.run_class_implementation_tests(
+            metric=BinaryAUPRC(),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    @pytest.mark.parametrize("average", ["macro", None])
+    def test_multiclass_auprc(self, average):
+        inputs = [
+            RNG.uniform(size=(BATCH, C)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        expected = _ref_class_result(
+            REF_M.MulticlassAUPRC(num_classes=C, average=average),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassAUPRC(num_classes=C, average=average),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_multilabel_auprc(self):
+        inputs = [
+            RNG.uniform(size=(BATCH, 3)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, 2, (BATCH, 3)) for _ in range(N_UP)]
+        expected = _ref_class_result(
+            REF_M.MultilabelAUPRC(num_labels=3), list(zip(inputs, targets))
+        )
+        self.run_class_implementation_tests(
+            metric=MultilabelAUPRC(num_labels=3),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+
+class TestPrecisionRecallCurve(MetricClassTester):
+    def test_binary_prc(self):
+        inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [RNG.integers(0, 2, BATCH) for _ in range(N_UP)]
+        expected = _ref_class_result(
+            REF_M.BinaryPrecisionRecallCurve(), list(zip(inputs, targets))
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryPrecisionRecallCurve(),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_multiclass_prc(self):
+        inputs = [
+            RNG.uniform(size=(BATCH, C)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        expected = _ref_class_result(
+            REF_M.MulticlassPrecisionRecallCurve(num_classes=C),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassPrecisionRecallCurve(num_classes=C),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_multilabel_prc(self):
+        inputs = [
+            RNG.uniform(size=(BATCH, 3)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, 2, (BATCH, 3)) for _ in range(N_UP)]
+        expected = _ref_class_result(
+            REF_M.MultilabelPrecisionRecallCurve(num_labels=3),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=MultilabelPrecisionRecallCurve(num_labels=3),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_no_positive_examples_recall_is_one(self):
+        p, r, t = F.binary_precision_recall_curve(
+            jnp.array([0.3, 0.6]), jnp.array([0, 0])
+        )
+        assert np.all(np.asarray(r)[:-1] == 1.0)
+
+
+class TestRecallAtFixedPrecision(MetricClassTester):
+    def test_binary(self):
+        inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [RNG.integers(0, 2, BATCH) for _ in range(N_UP)]
+        expected = _ref_class_result(
+            REF_M.BinaryRecallAtFixedPrecision(min_precision=0.5),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryRecallAtFixedPrecision(min_precision=0.5),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_multilabel(self):
+        inputs = [
+            RNG.uniform(size=(BATCH, 3)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, 2, (BATCH, 3)) for _ in range(N_UP)]
+        expected = _ref_class_result(
+            REF_M.MultilabelRecallAtFixedPrecision(num_labels=3, min_precision=0.4),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=MultilabelRecallAtFixedPrecision(num_labels=3, min_precision=0.4),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_reference_docstring_case(self):
+        r, t = F.binary_recall_at_fixed_precision(
+            jnp.array([0.1, 0.4, 0.6, 0.6, 0.6, 0.35, 0.8]),
+            jnp.array([0, 0, 1, 1, 1, 1, 1]),
+            min_precision=0.5,
+        )
+        assert float(r) == 1.0
+        assert float(t) == pytest.approx(0.35)
+
+    def test_min_precision_validation(self):
+        with pytest.raises(ValueError, match="min_precision"):
+            BinaryRecallAtFixedPrecision(min_precision=1.5)

@@ -1,0 +1,39 @@
+"""Regression test for recall-at-fixed-precision with logit-valued
+(negative) scores: the ineligible-slot fill must not shadow legitimate
+negative thresholds (found in code review; verified against the oracle)."""
+
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import functional as F
+
+REF_M, REF_F = load_reference_metrics()
+
+
+def test_negative_logit_scores_match_reference():
+    x = np.array([-2.0, -1.5], dtype=np.float32)
+    t = np.array([1, 1])
+    ours = F.binary_recall_at_fixed_precision(
+        jnp.asarray(x), jnp.asarray(t), min_precision=0.5
+    )
+    ref = REF_F.binary_recall_at_fixed_precision(
+        torch.tensor(x), torch.tensor(t), min_precision=0.5
+    )
+    np.testing.assert_allclose(np.asarray(ours[0]), np.asarray(ref[0]))
+    np.testing.assert_allclose(np.asarray(ours[1]), np.asarray(ref[1]))
+
+
+def test_no_recall_attainable_terminal_sentinel():
+    # all negatives: max recall is 0, terminal threshold sentinel -1 -> 1.0
+    x = np.array([0.3, 0.6], dtype=np.float32)
+    t = np.array([0, 0])
+    ours = F.binary_recall_at_fixed_precision(
+        jnp.asarray(x), jnp.asarray(t), min_precision=0.9
+    )
+    ref = REF_F.binary_recall_at_fixed_precision(
+        torch.tensor(x), torch.tensor(t), min_precision=0.9
+    )
+    np.testing.assert_allclose(np.asarray(ours[0]), np.asarray(ref[0]))
+    np.testing.assert_allclose(np.asarray(ours[1]), np.asarray(ref[1]))

@@ -4,10 +4,63 @@ from torcheval_tpu.metrics.classification.accuracy import (
     MultilabelAccuracy,
     TopKMultilabelAccuracy,
 )
+from torcheval_tpu.metrics.classification.auprc import (
+    BinaryAUPRC,
+    MulticlassAUPRC,
+    MultilabelAUPRC,
+)
+from torcheval_tpu.metrics.classification.auroc import BinaryAUROC, MulticlassAUROC
+from torcheval_tpu.metrics.classification.binary_normalized_entropy import (
+    BinaryNormalizedEntropy,
+)
+from torcheval_tpu.metrics.classification.confusion_matrix import (
+    BinaryConfusionMatrix,
+    MulticlassConfusionMatrix,
+)
+from torcheval_tpu.metrics.classification.f1_score import (
+    BinaryF1Score,
+    MulticlassF1Score,
+)
+from torcheval_tpu.metrics.classification.precision import (
+    BinaryPrecision,
+    MulticlassPrecision,
+)
+from torcheval_tpu.metrics.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torcheval_tpu.metrics.classification.recall import (
+    BinaryRecall,
+    MulticlassRecall,
+)
+from torcheval_tpu.metrics.classification.recall_at_fixed_precision import (
+    BinaryRecallAtFixedPrecision,
+    MultilabelRecallAtFixedPrecision,
+)
 
 __all__ = [
     "BinaryAccuracy",
+    "BinaryAUPRC",
+    "BinaryAUROC",
+    "BinaryConfusionMatrix",
+    "BinaryF1Score",
+    "BinaryNormalizedEntropy",
+    "BinaryPrecision",
+    "BinaryPrecisionRecallCurve",
+    "BinaryRecall",
+    "BinaryRecallAtFixedPrecision",
     "MulticlassAccuracy",
+    "MulticlassAUPRC",
+    "MulticlassAUROC",
+    "MulticlassConfusionMatrix",
+    "MulticlassF1Score",
+    "MulticlassPrecision",
+    "MulticlassPrecisionRecallCurve",
+    "MulticlassRecall",
     "MultilabelAccuracy",
+    "MultilabelAUPRC",
+    "MultilabelPrecisionRecallCurve",
+    "MultilabelRecallAtFixedPrecision",
     "TopKMultilabelAccuracy",
 ]

@@ -1,0 +1,150 @@
+"""AUPRC class metrics.
+
+Parity: reference torcheval/metrics/classification/auprc.py (BinaryAUPRC :31,
+MulticlassAUPRC :154, MultilabelAUPRC :296) — example-buffering states.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.auprc import (
+    _binary_auprc_kernel,
+    _binary_auprc_update_input_check,
+    _multiclass_auprc_kernel,
+    _multiclass_auprc_param_check,
+    _multiclass_auprc_update_input_check,
+    _multilabel_auprc_kernel,
+    _multilabel_auprc_param_check,
+    _multilabel_auprc_update_input_check,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+T = TypeVar("T")
+
+
+class _BufferedPairMetric(Metric[jax.Array]):
+    """Shared buffered (inputs, targets) plumbing for curve metrics."""
+
+    _concat_axis = 0
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("inputs", [], merge=MergeKind.EXTEND)
+        self._add_state("targets", [], merge=MergeKind.EXTEND)
+
+    def _append(self, input: jax.Array, target: jax.Array) -> None:
+        self.inputs.append(input)
+        self.targets.append(target)
+
+    def _concat(self):
+        if not self.inputs:
+            raise RuntimeError(
+                f"{type(self).__name__} has no data: call update() before "
+                "compute()."
+            )
+        return (
+            jnp.concatenate(self.inputs, axis=self._concat_axis),
+            jnp.concatenate(self.targets, axis=self._concat_axis),
+        )
+
+    def _prepare_for_merge_state(self) -> None:
+        if self.inputs:
+            self.inputs = [jnp.concatenate(self.inputs, axis=self._concat_axis)]
+            self.targets = [jnp.concatenate(self.targets, axis=self._concat_axis)]
+
+
+class BinaryAUPRC(_BufferedPairMetric):
+    """AUPRC (average precision by Riemann sum) for binary classification.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import BinaryAUPRC
+        >>> metric = BinaryAUPRC()
+        >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]),
+        ...               jnp.array([1, 0, 1, 1]))
+        >>> metric.compute()
+        Array(0.9167, dtype=float32)
+    """
+
+    _concat_axis = -1
+
+    def __init__(self, *, num_tasks: int = 1, device=None) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        self.num_tasks = num_tasks
+
+    def update(self, input, target) -> "BinaryAUPRC":
+        input, target = self._input(input), self._input(target)
+        _binary_auprc_update_input_check(input, target, self.num_tasks)
+        self._append(input, target)
+        return self
+
+    def compute(self) -> jax.Array:
+        inputs, targets = self._concat()
+        return _binary_auprc_kernel(inputs, targets)
+
+
+class MulticlassAUPRC(_BufferedPairMetric):
+    """One-vs-rest AUPRC for multiclass classification."""
+
+    def __init__(
+        self,
+        *,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _multiclass_auprc_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+
+    def update(self, input, target) -> "MulticlassAUPRC":
+        input, target = self._input(input), self._input(target)
+        _multiclass_auprc_update_input_check(input, target, self.num_classes)
+        self._append(input, target)
+        return self
+
+    def compute(self) -> jax.Array:
+        inputs, targets = self._concat()
+        auprcs = _multiclass_auprc_kernel(inputs, targets)
+        if self.average == "macro":
+            return jnp.mean(auprcs)
+        return auprcs
+
+
+class MultilabelAUPRC(_BufferedPairMetric):
+    """Per-label AUPRC for multilabel classification."""
+
+    def __init__(
+        self,
+        *,
+        num_labels: int,
+        average: Optional[str] = "macro",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _multilabel_auprc_param_check(num_labels, average)
+        self.num_labels = num_labels
+        self.average = average
+
+    def update(self, input, target) -> "MultilabelAUPRC":
+        input, target = self._input(input), self._input(target)
+        _multilabel_auprc_update_input_check(input, target, self.num_labels)
+        self._append(input, target)
+        return self
+
+    def compute(self) -> jax.Array:
+        inputs, targets = self._concat()
+        auprcs = _multilabel_auprc_kernel(inputs, targets)
+        if self.average == "macro":
+            return jnp.mean(auprcs)
+        return auprcs

@@ -1,0 +1,144 @@
+"""AUROC class metrics.
+
+Parity: reference torcheval/metrics/classification/auroc.py (BinaryAUROC :34
+with example-buffer states + optional fused kernel; MulticlassAUROC :158).
+O(n) example-buffering metrics: updates append to device-resident lists;
+``_prepare_for_merge_state`` concatenates buffers to minimize sync
+collectives (reference auroc.py:150-155).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    _binary_auroc_compute,
+    _binary_auroc_update_input_check,
+    _multiclass_auroc_compute_jit,
+    _multiclass_auroc_param_check,
+    _multiclass_auroc_update_input_check,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TBinaryAUROC = TypeVar("TBinaryAUROC", bound="BinaryAUROC")
+
+
+class BinaryAUROC(Metric[jax.Array]):
+    """AUROC for binary classification (optionally multi-task, weighted).
+
+    Args:
+        num_tasks: number of independent tasks.
+        use_fused: opt-in approximate sort-free kernel (analogue of the
+            reference's fbgemm path); ``use_fbgemm`` accepted as alias.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import BinaryAUROC
+        >>> metric = BinaryAUROC()
+        >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]), jnp.array([0, 0, 1, 1]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        device=None,
+        use_fused: bool = False,
+        use_fbgemm: Optional[bool] = None,
+    ) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(f"`num_tasks` value should be greater than and equal to 1, but received {num_tasks}. ")
+        self.num_tasks = num_tasks
+        self.use_fused = use_fused if use_fbgemm is None else use_fbgemm
+        self._add_state("inputs", [], merge=MergeKind.EXTEND)
+        self._add_state("targets", [], merge=MergeKind.EXTEND)
+        self._add_state("weights", [], merge=MergeKind.EXTEND)
+
+    def update(
+        self: TBinaryAUROC, input, target, *, weight=None
+    ) -> TBinaryAUROC:
+        input, target = self._input(input), self._input(target)
+        weight = self._input(weight) if weight is not None else None
+        _binary_auroc_update_input_check(input, target, self.num_tasks, weight)
+        self.inputs.append(input)
+        self.targets.append(target)
+        self.weights.append(
+            weight if weight is not None else jnp.ones_like(input, dtype=jnp.float32)
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        if not self.inputs:
+            raise RuntimeError(
+                "BinaryAUROC has no data: call update() before compute()."
+            )
+        return _binary_auroc_compute(
+            jnp.concatenate(self.inputs, axis=-1),
+            jnp.concatenate(self.targets, axis=-1),
+            jnp.concatenate(self.weights, axis=-1),
+            self.use_fused,
+        )
+
+    def _prepare_for_merge_state(self) -> None:
+        if self.inputs:
+            self.inputs = [jnp.concatenate(self.inputs, axis=-1)]
+            self.targets = [jnp.concatenate(self.targets, axis=-1)]
+            self.weights = [jnp.concatenate(self.weights, axis=-1)]
+
+
+TMulticlassAUROC = TypeVar("TMulticlassAUROC", bound="MulticlassAUROC")
+
+
+class MulticlassAUROC(Metric[jax.Array]):
+    """One-vs-rest AUROC for multiclass classification.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import MulticlassAUROC
+        >>> metric = MulticlassAUROC(num_classes=4)
+    """
+
+    def __init__(
+        self,
+        *,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _multiclass_auroc_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+        self._add_state("inputs", [], merge=MergeKind.EXTEND)
+        self._add_state("targets", [], merge=MergeKind.EXTEND)
+
+    def update(self: TMulticlassAUROC, input, target) -> TMulticlassAUROC:
+        input, target = self._input(input), self._input(target)
+        _multiclass_auroc_update_input_check(input, target, self.num_classes)
+        self.inputs.append(input)
+        self.targets.append(target)
+        return self
+
+    def compute(self) -> jax.Array:
+        if not self.inputs:
+            raise RuntimeError(
+                "MulticlassAUROC has no data: call update() before compute()."
+            )
+        aurocs = _multiclass_auroc_compute_jit(
+            jnp.concatenate(self.inputs, axis=0),
+            jnp.concatenate(self.targets, axis=0),
+        )
+        if self.average == "macro":
+            return jnp.mean(aurocs)
+        return aurocs
+
+    def _prepare_for_merge_state(self) -> None:
+        if self.inputs:
+            self.inputs = [jnp.concatenate(self.inputs, axis=0)]
+            self.targets = [jnp.concatenate(self.targets, axis=0)]

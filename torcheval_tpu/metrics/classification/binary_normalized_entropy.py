@@ -1,0 +1,79 @@
+"""Binary normalized entropy class metric.
+
+Parity: reference torcheval/metrics/classification/binary_normalized_entropy.py
+(:22-160) — per-task counter states (total_entropy, num_examples,
+num_positive) with SUM merge.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+    _baseline_update,
+    _binary_normalized_entropy_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TNormalizedEntropy = TypeVar("TNormalizedEntropy", bound="BinaryNormalizedEntropy")
+
+
+class BinaryNormalizedEntropy(Metric[jax.Array]):
+    """Normalized entropy (cross entropy / baseline entropy), optionally
+    multi-task and weighted.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import BinaryNormalizedEntropy
+        >>> metric = BinaryNormalizedEntropy()
+        >>> metric.update(jnp.array([0.2, 0.3]), jnp.array([1.0, 0.0]))
+        >>> metric.compute()
+        Array([1.046], dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        from_logits: bool = False,
+        num_tasks: int = 1,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        self.from_logits = from_logits
+        self.num_tasks = num_tasks
+        self._add_state(
+            "total_entropy", jnp.zeros(num_tasks), merge=MergeKind.SUM
+        )
+        self._add_state(
+            "num_examples", jnp.zeros(num_tasks), merge=MergeKind.SUM
+        )
+        self._add_state(
+            "num_positive", jnp.zeros(num_tasks), merge=MergeKind.SUM
+        )
+
+    def update(
+        self: TNormalizedEntropy, input, target, *, weight=None
+    ) -> TNormalizedEntropy:
+        input, target = self._input(input), self._input(target)
+        weight = self._input(weight) if weight is not None else None
+        cross_entropy, num_positive, num_examples = (
+            _binary_normalized_entropy_update(
+                input, target, self.from_logits, self.num_tasks, weight
+            )
+        )
+        self.total_entropy = self.total_entropy + jnp.atleast_1d(cross_entropy)
+        self.num_positive = self.num_positive + jnp.atleast_1d(num_positive)
+        self.num_examples = self.num_examples + jnp.atleast_1d(num_examples)
+        return self
+
+    def compute(self) -> jax.Array:
+        baseline = _baseline_update(self.num_positive, self.num_examples)
+        return (self.total_entropy / self.num_examples) / baseline

@@ -1,0 +1,93 @@
+"""Confusion matrix class metrics.
+
+Parity: reference torcheval/metrics/classification/confusion_matrix.py
+(Multiclass :26, Binary :216) — a single (C, C) counter state with SUM merge.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_update,
+    _confusion_matrix_compute,
+    _confusion_matrix_param_check,
+    _confusion_matrix_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TMulticlassConfusionMatrix = TypeVar(
+    "TMulticlassConfusionMatrix", bound="MulticlassConfusionMatrix"
+)
+
+
+class MulticlassConfusionMatrix(Metric[jax.Array]):
+    """Multiclass confusion matrix; entry (i, j) counts true class i
+    predicted as class j.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import MulticlassConfusionMatrix
+        >>> metric = MulticlassConfusionMatrix(4)
+        >>> metric.update(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
+    """
+
+    def __init__(
+        self,
+        num_classes: int,
+        *,
+        normalize: Optional[str] = None,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _confusion_matrix_param_check(num_classes, normalize)
+        self.num_classes = num_classes
+        self.normalize = normalize
+        self._add_state(
+            "confusion_matrix",
+            jnp.zeros((num_classes, num_classes), dtype=jnp.int32),
+            merge=MergeKind.SUM,
+        )
+
+    def update(
+        self: TMulticlassConfusionMatrix, input, target
+    ) -> TMulticlassConfusionMatrix:
+        input, target = self._input(input), self._input(target)
+        self.confusion_matrix = self.confusion_matrix + _confusion_matrix_update(
+            input, target, self.num_classes
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        return _confusion_matrix_compute(self.confusion_matrix, self.normalize)
+
+    def normalized(self, normalize: Optional[str] = None) -> jax.Array:
+        """Return the matrix under a different normalization
+        (reference confusion_matrix.py:198-206)."""
+        _confusion_matrix_param_check(self.num_classes, normalize)
+        return _confusion_matrix_compute(self.confusion_matrix, normalize)
+
+
+class BinaryConfusionMatrix(MulticlassConfusionMatrix):
+    """2x2 confusion matrix for binary classification with thresholded
+    score inputs."""
+
+    def __init__(
+        self,
+        *,
+        threshold: float = 0.5,
+        normalize: Optional[str] = None,
+        device=None,
+    ) -> None:
+        super().__init__(num_classes=2, normalize=normalize, device=device)
+        self.threshold = threshold
+
+    def update(self, input, target) -> "BinaryConfusionMatrix":
+        input, target = self._input(input), self._input(target)
+        self.confusion_matrix = self.confusion_matrix + _binary_confusion_matrix_update(
+            input, target, self.threshold
+        )
+        return self

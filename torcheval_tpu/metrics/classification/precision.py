@@ -1,0 +1,88 @@
+"""Precision class metrics.
+
+Parity: reference torcheval/metrics/classification/precision.py
+(Multiclass :25, Binary :159) — O(1) counter states with SUM merge.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.precision import (
+    _binary_precision_update,
+    _precision_compute,
+    _precision_param_check,
+    _precision_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TPrecision = TypeVar("TPrecision", bound="MulticlassPrecision")
+
+
+class MulticlassPrecision(Metric[jax.Array]):
+    """Precision for multiclass classification.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import MulticlassPrecision
+        >>> metric = MulticlassPrecision()
+        >>> metric.update(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "micro",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _precision_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+        shape = () if average == "micro" else (num_classes,)
+        self._add_state("num_tp", jnp.zeros(shape), merge=MergeKind.SUM)
+        self._add_state("num_fp", jnp.zeros(shape), merge=MergeKind.SUM)
+        self._add_state(
+            "num_label",
+            jnp.zeros(()) if average == "micro" else jnp.zeros(shape),
+            merge=MergeKind.SUM,
+        )
+
+    def update(self: TPrecision, input, target) -> TPrecision:
+        input, target = self._input(input), self._input(target)
+        num_tp, num_fp, num_label = _precision_update(
+            input, target, self.num_classes, self.average
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_fp = self.num_fp + num_fp
+        self.num_label = self.num_label + num_label
+        return self
+
+    def compute(self) -> jax.Array:
+        return _precision_compute(
+            self.num_tp, self.num_fp, self.num_label, self.average
+        )
+
+
+class BinaryPrecision(MulticlassPrecision):
+    """Binary precision with thresholded score inputs."""
+
+    def __init__(self, *, threshold: float = 0.5, device=None) -> None:
+        super().__init__(device=device)
+        self.threshold = threshold
+
+    def update(self, input, target) -> "BinaryPrecision":
+        input, target = self._input(input), self._input(target)
+        num_tp, num_fp, num_label = _binary_precision_update(
+            input, target, self.threshold
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_fp = self.num_fp + num_fp
+        self.num_label = self.num_label + num_label
+        return self

@@ -1,0 +1,100 @@
+"""Precision-recall curve class metrics.
+
+Parity: reference torcheval/metrics/classification/precision_recall_curve.py
+(Binary :32, Multiclass :125, Multilabel :237) — example-buffering states.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, TypeVar
+
+import jax
+
+from torcheval_tpu.metrics.classification.auprc import _BufferedPairMetric
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_update_input_check,
+    _multiclass_precision_recall_curve_update_input_check,
+    _multilabel_precision_recall_curve_update_input_check,
+    multiclass_precision_recall_curve,
+    multilabel_precision_recall_curve,
+)
+
+T = TypeVar("T")
+
+
+class BinaryPrecisionRecallCurve(_BufferedPairMetric):
+    """Precision-recall curve for binary classification.
+
+    ``compute`` returns ``(precision, recall, thresholds)``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import BinaryPrecisionRecallCurve
+        >>> metric = BinaryPrecisionRecallCurve()
+        >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]),
+        ...               jnp.array([0, 0, 1, 1]))
+    """
+
+    _concat_axis = -1
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+
+    def update(self, input, target) -> "BinaryPrecisionRecallCurve":
+        input, target = self._input(input), self._input(target)
+        _binary_precision_recall_curve_update_input_check(input, target)
+        self._append(input, target)
+        return self
+
+    def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        inputs, targets = self._concat()
+        return _binary_precision_recall_curve_compute(inputs, targets)
+
+
+class MulticlassPrecisionRecallCurve(_BufferedPairMetric):
+    """Per-class precision-recall curves for multiclass classification."""
+
+    def __init__(self, *, num_classes: Optional[int] = None, device=None) -> None:
+        super().__init__(device=device)
+        self.num_classes = num_classes
+
+    def update(self, input, target) -> "MulticlassPrecisionRecallCurve":
+        input, target = self._input(input), self._input(target)
+        _multiclass_precision_recall_curve_update_input_check(
+            input, target, self.num_classes
+        )
+        self._append(input, target)
+        return self
+
+    def compute(
+        self,
+    ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+        inputs, targets = self._concat()
+        return multiclass_precision_recall_curve(
+            inputs, targets, num_classes=self.num_classes
+        )
+
+
+class MultilabelPrecisionRecallCurve(_BufferedPairMetric):
+    """Per-label precision-recall curves for multilabel classification."""
+
+    def __init__(self, *, num_labels: Optional[int] = None, device=None) -> None:
+        super().__init__(device=device)
+        self.num_labels = num_labels
+
+    def update(self, input, target) -> "MultilabelPrecisionRecallCurve":
+        input, target = self._input(input), self._input(target)
+        _multilabel_precision_recall_curve_update_input_check(
+            input, target, self.num_labels
+        )
+        self._append(input, target)
+        return self
+
+    def compute(
+        self,
+    ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+        inputs, targets = self._concat()
+        return multilabel_precision_recall_curve(
+            inputs, targets, num_labels=self.num_labels
+        )

@@ -1,0 +1,100 @@
+"""Recall class metrics.
+
+Parity: reference torcheval/metrics/classification/recall.py
+(BinaryRecall :26, MulticlassRecall :117) — O(1) counter states.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.recall import (
+    _binary_recall_update,
+    _recall_compute,
+    _recall_param_check,
+    _recall_update,
+)
+from torcheval_tpu.metrics.functional.tensor_utils import nan_safe_divide
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TRecall = TypeVar("TRecall", bound="MulticlassRecall")
+
+
+class MulticlassRecall(Metric[jax.Array]):
+    """Recall for multiclass classification.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import MulticlassRecall
+        >>> metric = MulticlassRecall()
+        >>> metric.update(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "micro",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _recall_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+        shape = () if average == "micro" else (num_classes,)
+        self._add_state("num_tp", jnp.zeros(shape), merge=MergeKind.SUM)
+        self._add_state("num_labels", jnp.zeros(shape), merge=MergeKind.SUM)
+        self._add_state("num_predictions", jnp.zeros(shape), merge=MergeKind.SUM)
+
+    def update(self: TRecall, input, target) -> TRecall:
+        input, target = self._input(input), self._input(target)
+        num_tp, num_labels, num_predictions = _recall_update(
+            input, target, self.num_classes, self.average
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_labels = self.num_labels + num_labels
+        self.num_predictions = self.num_predictions + num_predictions
+        return self
+
+    def compute(self) -> jax.Array:
+        return _recall_compute(
+            self.num_tp, self.num_labels, self.num_predictions, self.average
+        )
+
+
+class BinaryRecall(Metric[jax.Array]):
+    """Binary recall with thresholded score inputs.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import BinaryRecall
+        >>> metric = BinaryRecall()
+        >>> metric.update(jnp.array([0.9, 0.2, 0.6, 0.1]), jnp.array([1, 0, 1, 1]))
+        >>> metric.compute()
+        Array(0.6667, dtype=float32)
+    """
+
+    def __init__(self, *, threshold: float = 0.5, device=None) -> None:
+        super().__init__(device=device)
+        self.threshold = threshold
+        self._add_state("num_tp", jnp.zeros(()), merge=MergeKind.SUM)
+        self._add_state("num_true_labels", jnp.zeros(()), merge=MergeKind.SUM)
+
+    def update(self, input, target) -> "BinaryRecall":
+        input, target = self._input(input), self._input(target)
+        num_tp, num_true_labels = _binary_recall_update(
+            input, target, self.threshold
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_true_labels = self.num_true_labels + num_true_labels
+        return self
+
+    def compute(self) -> jax.Array:
+        return jnp.nan_to_num(
+            nan_safe_divide(self.num_tp, self.num_true_labels)
+        )

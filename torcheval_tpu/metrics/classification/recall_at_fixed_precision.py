@@ -1,0 +1,87 @@
+"""Recall-at-fixed-precision class metrics.
+
+Parity: reference torcheval/metrics/classification/recall_at_fixed_precision.py
+(Binary :29, Multilabel :108) — example-buffering states.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+
+from torcheval_tpu.metrics.classification.auprc import _BufferedPairMetric
+from torcheval_tpu.metrics.functional.classification.recall_at_fixed_precision import (
+    _binary_rafp_kernel,
+    _binary_recall_at_fixed_precision_update_input_check,
+    _multilabel_rafp_kernel,
+)
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _multilabel_precision_recall_curve_update_input_check,
+)
+
+
+class BinaryRecallAtFixedPrecision(_BufferedPairMetric):
+    """Max recall such that precision >= min_precision; returns
+    ``(recall, threshold)``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import BinaryRecallAtFixedPrecision
+        >>> metric = BinaryRecallAtFixedPrecision(min_precision=0.5)
+    """
+
+    _concat_axis = -1
+
+    def __init__(self, *, min_precision: float, device=None) -> None:
+        super().__init__(device=device)
+        if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+            raise ValueError(
+                "Expected min_precision to be a float in the [0, 1] range"
+                f" but got {min_precision}."
+            )
+        self.min_precision = min_precision
+
+    def update(self, input, target) -> "BinaryRecallAtFixedPrecision":
+        input, target = self._input(input), self._input(target)
+        _binary_recall_at_fixed_precision_update_input_check(
+            input, target, self.min_precision
+        )
+        self._append(input, target)
+        return self
+
+    def compute(self) -> Tuple[jax.Array, jax.Array]:
+        inputs, targets = self._concat()
+        return _binary_rafp_kernel(inputs, targets, float(self.min_precision))
+
+
+class MultilabelRecallAtFixedPrecision(_BufferedPairMetric):
+    """Per-label max recall at fixed precision; returns
+    ``(recalls, thresholds)`` lists."""
+
+    def __init__(
+        self, *, num_labels: int, min_precision: float, device=None
+    ) -> None:
+        super().__init__(device=device)
+        if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+            raise ValueError(
+                "Expected min_precision to be a float in the [0, 1] range"
+                f" but got {min_precision}."
+            )
+        self.num_labels = num_labels
+        self.min_precision = min_precision
+
+    def update(self, input, target) -> "MultilabelRecallAtFixedPrecision":
+        input, target = self._input(input), self._input(target)
+        _multilabel_precision_recall_curve_update_input_check(
+            input, target, self.num_labels
+        )
+        self._append(input, target)
+        return self
+
+    def compute(self) -> Tuple[List[jax.Array], List[jax.Array]]:
+        inputs, targets = self._concat()
+        recalls, thresholds = _multilabel_rafp_kernel(
+            inputs, targets, float(self.min_precision)
+        )
+        return list(recalls), list(thresholds)

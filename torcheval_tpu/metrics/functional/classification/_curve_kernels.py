@@ -1,0 +1,170 @@
+"""Shared fixed-shape kernels for sort/threshold-curve metrics
+(AUROC / AUPRC / precision-recall curves / recall@precision).
+
+The reference compacts tie runs with data-dependent ``masked_scatter``
+(reference functional/classification/auroc.py:115-152,
+precision_recall_curve.py:209-232) — shapes depend on the number of distinct
+thresholds, which XLA cannot compile. The TPU reformulation used here keeps
+every array at the static sample count ``n``:
+
+1. sort scores descending; cumsum weighted TP/FP;
+2. mark tie-run *ends* (``threshold[i] != threshold[i+1]``, last element
+   always an end);
+3. propagate each run-end's cumulative values backwards over its run with a
+   reverse ``cummin`` (cumsums are nondecreasing, so the nearest run-end to
+   the right is the suffix minimum of run-end values);
+4. integrate over the resulting curve: consecutive duplicate points have
+   ``dx == 0`` and contribute nothing, so trapezoid/Riemann sums equal the
+   reference's compacted-curve integrals exactly.
+
+One fused XLA program per metric; no host syncs; vmap-able over tasks,
+classes, and labels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _run_end_mask(sorted_scores: jax.Array) -> jax.Array:
+    """True at the last element of each equal-score run (axis -1)."""
+    neq = sorted_scores[..., 1:] != sorted_scores[..., :-1]
+    last = jnp.ones(sorted_scores.shape[:-1] + (1,), dtype=bool)
+    return jnp.concatenate([neq, last], axis=-1)
+
+
+def _propagate_run_end(values: jax.Array, is_end: jax.Array) -> jax.Array:
+    """Replace every element with its tie-run end's value.
+
+    ``values`` must be nondecreasing along axis -1 (cumulative sums are).
+    """
+    masked = jnp.where(is_end, values, jnp.inf)
+    suffix_min = jnp.flip(
+        jax.lax.cummin(jnp.flip(masked, axis=-1), axis=values.ndim - 1),
+        axis=-1,
+    )
+    return suffix_min
+
+
+def roc_cumulators(
+    input: jax.Array,
+    target: jax.Array,
+    weight: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sorted thresholds + tie-compacted cumulative TP/FP (static shapes).
+
+    Returns (threshold_sorted, cum_tp, cum_fp, is_run_end), each shaped like
+    ``input`` with axis -1 in descending-score order.
+    """
+    order = jnp.argsort(-input, axis=-1, stable=True)
+    threshold = jnp.take_along_axis(input, order, axis=-1)
+    starget = jnp.take_along_axis(target, order, axis=-1).astype(jnp.float32)
+    if weight is None:
+        sweight = jnp.ones_like(starget)
+    else:
+        sweight = jnp.take_along_axis(weight, order, axis=-1).astype(jnp.float32)
+    cum_tp = jnp.cumsum(sweight * starget, axis=-1)
+    cum_fp = jnp.cumsum(sweight * (1.0 - starget), axis=-1)
+    is_end = _run_end_mask(threshold)
+    cum_tp = _propagate_run_end(cum_tp, is_end)
+    cum_fp = _propagate_run_end(cum_fp, is_end)
+    return threshold, cum_tp, cum_fp, is_end
+
+
+def auroc_from_cumulators(cum_tp: jax.Array, cum_fp: jax.Array) -> jax.Array:
+    """Trapezoidal AUROC over the (FP, TP) curve, with the (0, 0) origin
+    prepended (the reference's right-aligned zero padding supplies it,
+    reference auroc.py:136-150). Degenerate all-pos/all-neg -> 0.5."""
+    zeros = jnp.zeros(cum_tp.shape[:-1] + (1,), cum_tp.dtype)
+    y = jnp.concatenate([zeros, cum_tp], axis=-1)
+    x = jnp.concatenate([zeros, cum_fp], axis=-1)
+    dx = x[..., 1:] - x[..., :-1]
+    area = jnp.sum(dx * (y[..., 1:] + y[..., :-1]) / 2.0, axis=-1)
+    factor = cum_tp[..., -1] * cum_fp[..., -1]
+    return jnp.where(factor == 0, 0.5, area / jnp.where(factor == 0, 1.0, factor))
+
+
+def prc_arrays(
+    input: jax.Array, target: jax.Array, pos_label: int = 1
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Full-length precision/recall/threshold arrays in ascending-threshold
+    order, plus the validity mask marking the reference's compacted points
+    (reference `_compute_for_each_class`, precision_recall_curve.py:209-232).
+
+    The appended (precision=1, recall=0) terminal point is NOT included;
+    integrators append it themselves. Recall is NaN-corrected to 1.0 when the
+    target has no positive examples.
+    """
+    order = jnp.argsort(-input, axis=-1, stable=True)
+    threshold = jnp.take_along_axis(input, order, axis=-1)
+    hit = (jnp.take_along_axis(target, order, axis=-1) == pos_label).astype(
+        jnp.float32
+    )
+    num_tp = jnp.cumsum(hit, axis=-1)
+    num_fp = jnp.cumsum(1.0 - hit, axis=-1)
+    is_end = _run_end_mask(threshold)
+    num_tp = _propagate_run_end(num_tp, is_end)
+    num_fp = _propagate_run_end(num_fp, is_end)
+    precision = num_tp / (num_tp + num_fp)
+    total_tp = num_tp[..., -1:]
+    recall = jnp.where(total_tp == 0, 1.0, num_tp / jnp.where(total_tp == 0, 1.0, total_tp))
+    # ascending-threshold order, as the reference returns (flip of the
+    # descending sort)
+    return (
+        jnp.flip(precision, axis=-1),
+        jnp.flip(recall, axis=-1),
+        jnp.flip(threshold, axis=-1),
+        jnp.flip(is_end, axis=-1),
+    )
+
+
+def auprc_from_prc(
+    precision: jax.Array, recall: jax.Array
+) -> jax.Array:
+    """Left-Riemann AUPRC over ascending-threshold (descending-recall) curve
+    points with the terminal (p=1, r=0) appended (reference auprc.py:239-251
+    + tensor_utils.py:12-16). Duplicate tie-run points contribute 0."""
+    ones = jnp.ones(precision.shape[:-1] + (1,), precision.dtype)
+    zeros = jnp.zeros(recall.shape[:-1] + (1,), recall.dtype)
+    p = jnp.concatenate([precision, ones], axis=-1)
+    r = jnp.concatenate([recall, zeros], axis=-1)
+    return -jnp.sum((r[..., 1:] - r[..., :-1]) * p[..., :-1], axis=-1)
+
+
+def recall_at_precision_from_arrays(
+    precision: jax.Array,
+    recall: jax.Array,
+    threshold: jax.Array,
+    is_end: jax.Array,
+    min_precision: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Max recall subject to precision >= min_precision, and the largest
+    threshold attaining it (reference recall_at_fixed_precision.py:132-141).
+
+    Operates on the padded arrays; non-run-end duplicates are masked out of
+    the recall max (they duplicate a valid point so would not change it) and
+    of the threshold argmax (where they could otherwise select a duplicate's
+    threshold, which differs from the compacted point's).
+    The appended terminal point (recall 0, threshold -1) participates,
+    matching the reference's sentinel.
+    """
+    ok = is_end & (precision >= min_precision)
+    # terminal point: precision 1 >= min_precision always; recall 0
+    max_recall = jnp.max(
+        jnp.where(ok, recall, 0.0), axis=-1, initial=0.0
+    )
+    # the reference's threshold step filters by recall only, not precision;
+    # ineligible slots fill with -inf (NOT the -1 terminal sentinel, which
+    # would shadow legitimate negative/logit-valued thresholds). The terminal
+    # (recall=0, threshold=-1) point only competes when max_recall == 0.
+    eligible = is_end & (recall == max_recall[..., None])
+    candidate = jnp.max(
+        jnp.where(eligible, threshold, -jnp.inf), axis=-1, initial=-jnp.inf
+    )
+    best = jnp.where(
+        max_recall == 0, jnp.maximum(candidate, -1.0), candidate
+    )
+    return max_recall, jnp.abs(best)

@@ -1,0 +1,196 @@
+"""AUPRC (area under the precision-recall curve, Riemann integral).
+
+Parity: reference torcheval/metrics/functional/classification/auprc.py
+(binary :16-100 multi-task; multiclass :103-170 macro/None; multilabel
+:173-236; compute :239-295 + tensor_utils `_riemann_integral`). Unlike the
+reference — which loops tasks/classes in Python calling the compacting curve
+kernel — the whole computation here is one jitted, vmapped, fixed-shape XLA
+program (tie-run duplicates integrate to zero; see ``_curve_kernels``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+    auprc_from_prc,
+    prc_arrays,
+)
+from torcheval_tpu.utils.convert import to_jax
+
+
+@jax.jit
+def _binary_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
+    p, r, _, _ = prc_arrays(input, target, 1)
+    return auprc_from_prc(p, r)
+
+
+def _binary_auprc_update_input_check(
+    input: jax.Array, target: jax.Array, num_tasks: int
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same shape, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if num_tasks == 1:
+        if input.ndim == 2 and input.shape[0] > 1:
+            raise ValueError(
+                "`num_tasks = 1`, `input` and `target` are expected to be "
+                "one-dimensional tensors or 1xN tensors, but got shape "
+                f"input: {input.shape}, target: {target.shape}."
+            )
+        if input.ndim > 2:
+            raise ValueError(
+                f"input should be at most two-dimensional, got shape {input.shape}."
+            )
+    elif input.ndim != 2 or input.shape[0] != num_tasks:
+        raise ValueError(
+            f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
+            f"({num_tasks}, num_samples), but got shape ({input.shape})."
+        )
+
+
+def binary_auprc(input, target, *, num_tasks: int = 1) -> jax.Array:
+    """Compute AUPRC for binary classification.
+
+    Class version: ``torcheval_tpu.metrics.BinaryAUPRC``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import binary_auprc
+        >>> binary_auprc(jnp.array([0.1, 0.5, 0.7, 0.8]), jnp.array([1, 0, 1, 1]))
+        Array(0.9167, dtype=float32)
+    """
+    input, target = to_jax(input), to_jax(target)
+    _binary_auprc_update_input_check(input, target, num_tasks)
+    return _binary_auprc_kernel(input, target)  # batches over rows if 2-D
+
+
+def _multiclass_auprc_param_check(num_classes: int, average: Optional[str]) -> None:
+    average_options = ("macro", "none", None)
+    if average not in average_options:
+        raise ValueError(
+            f"`average` was not in the allowed value of {average_options}, "
+            f"got {average}."
+        )
+    if num_classes < 2:
+        raise ValueError(f"`num_classes` has to be at least 2, got {num_classes}.")
+
+
+def _multiclass_auprc_update_input_check(
+    input: jax.Array, target: jax.Array, num_classes: int
+) -> None:
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if input.ndim != 2 or input.shape[1] != num_classes:
+        raise ValueError(
+            f"input should have shape of (num_sample, num_classes), "
+            f"got {input.shape} and num_classes={num_classes}."
+        )
+
+
+@jax.jit
+def _multiclass_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
+    num_classes = input.shape[1]
+    scores = input.T
+    pos = jnp.arange(num_classes)
+
+    def per_class(s, c):
+        p, r, _, _ = prc_arrays(s, (target == c).astype(jnp.int32), 1)
+        return auprc_from_prc(p, r)
+
+    return jax.vmap(per_class)(scores, pos)
+
+
+def multiclass_auprc(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> jax.Array:
+    """Compute one-vs-rest AUPRC for multiclass classification.
+
+    Class version: ``torcheval_tpu.metrics.MulticlassAUPRC``.
+    """
+    input, target = to_jax(input), to_jax(target)
+    if num_classes is None and input.ndim == 2:
+        num_classes = input.shape[1]
+    _multiclass_auprc_param_check(num_classes, average)
+    _multiclass_auprc_update_input_check(input, target, num_classes)
+    auprcs = _multiclass_auprc_kernel(input, target)
+    if average == "macro":
+        return jnp.mean(auprcs)
+    return auprcs
+
+
+def _multilabel_auprc_param_check(num_labels: int, average: Optional[str]) -> None:
+    average_options = ("macro", "none", None)
+    if average not in average_options:
+        raise ValueError(
+            f"`average` was not in the allowed value of {average_options}, "
+            f"got {average}."
+        )
+    if num_labels < 1:
+        raise ValueError(f"`num_labels` has to be at least 1, got {num_labels}.")
+
+
+def _multilabel_auprc_update_input_check(
+    input: jax.Array, target: jax.Array, num_labels: int
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "Expected both input.shape and target.shape to have the same shape"
+            f" but got {input.shape} and {target.shape}."
+        )
+    if input.ndim != 2 or input.shape[1] != num_labels:
+        raise ValueError(
+            f"input should have shape of (num_sample, num_labels), "
+            f"got {input.shape} and num_labels={num_labels}."
+        )
+
+
+@jax.jit
+def _multilabel_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
+    def per_label(s, t):
+        p, r, _, _ = prc_arrays(s, t, 1)
+        return auprc_from_prc(p, r)
+
+    return jax.vmap(per_label)(input.T, target.T)
+
+
+def multilabel_auprc(
+    input,
+    target,
+    *,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> jax.Array:
+    """Compute per-label AUPRC for multilabel classification.
+
+    Class version: ``torcheval_tpu.metrics.MultilabelAUPRC``.
+    """
+    input, target = to_jax(input), to_jax(target)
+    if input.ndim != 2:
+        raise ValueError(
+            f"input should be a two-dimensional tensor, got shape {input.shape}."
+        )
+    if num_labels is None:
+        num_labels = input.shape[1]
+    _multilabel_auprc_param_check(num_labels, average)
+    _multilabel_auprc_update_input_check(input, target, num_labels)
+    auprcs = _multilabel_auprc_kernel(input, target)
+    if average == "macro":
+        return jnp.mean(auprcs)
+    return auprcs

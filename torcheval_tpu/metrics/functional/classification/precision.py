@@ -1,0 +1,189 @@
+"""Precision (binary / multiclass).
+
+Parity: reference torcheval/metrics/functional/classification/precision.py
+(multiclass :56-178 with micro/macro/weighted/None; binary :16-53,221-235).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.tensor_utils import nan_safe_divide
+from torcheval_tpu.utils.convert import to_jax
+
+_logger: logging.Logger = logging.getLogger(__name__)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _precision_update_jit(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    if input.ndim == 2:
+        input = jnp.argmax(input, axis=1)
+    if average == "micro":
+        num_tp = jnp.sum(input == target).astype(jnp.float32)
+        num_fp = jnp.sum(input != target).astype(jnp.float32)
+        return num_tp, num_fp, jnp.zeros(())
+    ones = jnp.ones_like(target, dtype=jnp.float32)
+    num_label = jax.ops.segment_sum(ones, target, num_segments=num_classes)
+    tp_mask = (input == target).astype(jnp.float32)
+    num_tp = jax.ops.segment_sum(tp_mask, target, num_segments=num_classes)
+    num_fp = jax.ops.segment_sum(
+        1.0 - tp_mask, input.astype(target.dtype), num_segments=num_classes
+    )
+    return num_tp, num_fp, num_label
+
+
+@partial(jax.jit, static_argnames=("average",))
+def _precision_compute_jit(
+    num_tp: jax.Array,
+    num_fp: jax.Array,
+    num_label: jax.Array,
+    average: Optional[str],
+) -> jax.Array:
+    denom = num_tp + num_fp
+    precision = jnp.nan_to_num(nan_safe_divide(num_tp, denom))
+    if average == "micro":
+        return precision
+    if average == "macro":
+        mask = (num_label != 0) | (denom != 0)
+        return jnp.sum(jnp.where(mask, precision, 0.0)) / jnp.maximum(
+            jnp.sum(mask), 1
+        )
+    if average == "weighted":
+        return jnp.sum(precision * (num_label / jnp.sum(num_label)))
+    return precision
+
+
+def _precision_param_check(
+    num_classes: Optional[int], average: Optional[str]
+) -> None:
+    average_options = ("micro", "macro", "weighted", None)
+    if average not in average_options:
+        raise ValueError(
+            f"`average` was not in the allowed value of {average_options}, "
+            f"got {average}."
+        )
+    if average != "micro" and (num_classes is None or num_classes <= 0):
+        raise ValueError(
+            f"num_classes should be a positive number when average={average}, "
+            f"got num_classes={num_classes}."
+        )
+
+
+def _precision_update_input_check(
+    input: jax.Array, target: jax.Array, num_classes: Optional[int]
+) -> None:
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if not input.ndim == 1 and not (
+        input.ndim == 2 and (num_classes is None or input.shape[1] == num_classes)
+    ):
+        raise ValueError(
+            "input should have shape of (num_sample,) or "
+            f"(num_sample, num_classes), got {input.shape}."
+        )
+
+
+def _precision_update(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    _precision_update_input_check(input, target, num_classes)
+    return _precision_update_jit(input, target, num_classes, average)
+
+
+def _precision_compute(
+    num_tp: jax.Array,
+    num_fp: jax.Array,
+    num_label: jax.Array,
+    average: Optional[str],
+) -> jax.Array:
+    if average in (None, "None"):
+        denom = num_tp + num_fp
+        if bool(jnp.any((denom == 0) & (num_label == 0))):
+            _logger.warning(
+                "One or more classes have zero instances in both the "
+                "predictions and the ground truth labels. Precision is "
+                "still logged as zero."
+            )
+    return _precision_compute_jit(num_tp, num_fp, num_label, average)
+
+
+def multiclass_precision(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+    average: Optional[str] = "micro",
+) -> jax.Array:
+    """Compute precision for multiclass classification.
+
+    Class version: ``torcheval_tpu.metrics.MulticlassPrecision``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import multiclass_precision
+        >>> multiclass_precision(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
+        Array(0.5, dtype=float32)
+    """
+    input, target = to_jax(input), to_jax(target)
+    _precision_param_check(num_classes, average)
+    num_tp, num_fp, num_label = _precision_update(
+        input, target, num_classes, average
+    )
+    return _precision_compute(num_tp, num_fp, num_label, average)
+
+
+@partial(jax.jit, static_argnames=("threshold",))
+def _binary_precision_update_jit(
+    input: jax.Array, target: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    pred = jnp.where(input < threshold, 0, 1)
+    num_tp = jnp.sum(pred * target, axis=-1).astype(jnp.float32)
+    num_fp = jnp.sum(pred, axis=-1).astype(jnp.float32) - num_tp
+    return num_tp, num_fp, jnp.zeros(())
+
+
+def _binary_precision_update_input_check(
+    input: jax.Array, target: jax.Array
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same dimensions, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+
+
+def _binary_precision_update(
+    input: jax.Array, target: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    _binary_precision_update_input_check(input, target)
+    return _binary_precision_update_jit(input, target, float(threshold))
+
+
+def binary_precision(input, target, *, threshold: float = 0.5) -> jax.Array:
+    """Compute precision for binary classification.
+
+    Class version: ``torcheval_tpu.metrics.BinaryPrecision``.
+    """
+    input, target = to_jax(input), to_jax(target)
+    num_tp, num_fp, num_label = _binary_precision_update(input, target, threshold)
+    return _precision_compute(num_tp, num_fp, num_label, "micro")

@@ -1,0 +1,200 @@
+"""Precision-recall curves (binary / multiclass / multilabel).
+
+Parity: reference torcheval/metrics/functional/classification/
+precision_recall_curve.py (binary :16-100; multiclass :103-178; multilabel
+:237-310; `_compute_for_each_class` :209-232). The curve math runs as one
+fixed-shape jitted kernel (vmapped over classes/labels); the data-dependent
+tie compaction — whose output length is the number of distinct thresholds —
+happens on host at the API boundary, where the reference also materializes
+Python lists.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+    prc_arrays,
+)
+from torcheval_tpu.utils.convert import to_jax
+
+
+_prc_arrays_jit = jax.jit(prc_arrays, static_argnames=("pos_label",))
+
+
+def _compact(
+    precision: np.ndarray,
+    recall: np.ndarray,
+    threshold: np.ndarray,
+    is_end: np.ndarray,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Host-side tie compaction + terminal point append
+    (reference `_compute_for_each_class` tail, :222-232)."""
+    p = precision[is_end]
+    r = recall[is_end]
+    t = threshold[is_end]
+    p = np.concatenate([p, np.ones(1, p.dtype)])
+    r = np.concatenate([r, np.zeros(1, r.dtype)])
+    return jnp.asarray(p), jnp.asarray(r), jnp.asarray(t)
+
+
+def _binary_precision_recall_curve_compute(
+    input: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    precision, recall, threshold, is_end = (
+        np.asarray(x) for x in _prc_arrays_jit(input, target)
+    )
+    return _compact(precision, recall, threshold, is_end)
+
+
+def _binary_precision_recall_curve_update_input_check(
+    input: jax.Array, target: jax.Array
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same shape, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if input.ndim != 1:
+        raise ValueError(
+            f"input should be a one-dimensional tensor, got shape {input.shape}."
+        )
+
+
+def binary_precision_recall_curve(
+    input, target
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Precision-recall pairs and thresholds for binary classification.
+
+    Class version: ``torcheval_tpu.metrics.BinaryPrecisionRecallCurve``.
+
+    Returns ``(precision, recall, thresholds)`` with ascending thresholds;
+    the final (precision=1, recall=0) point has no threshold.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import binary_precision_recall_curve
+        >>> p, r, t = binary_precision_recall_curve(
+        ...     jnp.array([0.1, 0.5, 0.7, 0.8]), jnp.array([0, 0, 1, 1]))
+    """
+    input, target = to_jax(input), to_jax(target)
+    _binary_precision_recall_curve_update_input_check(input, target)
+    return _binary_precision_recall_curve_compute(input, target)
+
+
+def _multiclass_prc_full(
+    input: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """vmapped per-class curve arrays: scores (N, C) -> (C, N) batched."""
+    num_classes = input.shape[1]
+    scores = input.T
+    targets = jnp.broadcast_to(target, (num_classes, target.shape[0]))
+    pos = jnp.arange(num_classes)
+
+    def per_class(s, t, c):
+        return prc_arrays(s, (t == c).astype(jnp.int32), 1)
+
+    return jax.vmap(per_class)(scores, targets, pos)
+
+
+_multiclass_prc_full_jit = jax.jit(_multiclass_prc_full)
+
+
+def _multiclass_precision_recall_curve_update_input_check(
+    input: jax.Array, target: jax.Array, num_classes: Optional[int]
+) -> None:
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if not (
+        input.ndim == 2 and (num_classes is None or input.shape[1] == num_classes)
+    ):
+        raise ValueError(
+            "input should have shape of (num_sample, num_classes), "
+            f"got {input.shape} and num_classes={num_classes}."
+        )
+
+
+def multiclass_precision_recall_curve(
+    input, target, *, num_classes: Optional[int] = None
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    """Per-class precision-recall curves for multiclass classification.
+
+    Class version: ``torcheval_tpu.metrics.MulticlassPrecisionRecallCurve``.
+    Returns lists of (precision, recall, thresholds), one entry per class.
+    """
+    input, target = to_jax(input), to_jax(target)
+    if num_classes is None and input.ndim == 2:
+        num_classes = input.shape[1]
+    _multiclass_precision_recall_curve_update_input_check(input, target, num_classes)
+    p_full, r_full, t_full, end_full = (
+        np.asarray(x) for x in _multiclass_prc_full_jit(input, target)
+    )
+    precisions, recalls, thresholds = [], [], []
+    for c in range(num_classes):
+        p, r, t = _compact(p_full[c], r_full[c], t_full[c], end_full[c])
+        precisions.append(p)
+        recalls.append(r)
+        thresholds.append(t)
+    return precisions, recalls, thresholds
+
+
+def _multilabel_prc_full(
+    input: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    return jax.vmap(lambda s, t: prc_arrays(s, t, 1))(input.T, target.T)
+
+
+_multilabel_prc_full_jit = jax.jit(_multilabel_prc_full)
+
+
+def _multilabel_precision_recall_curve_update_input_check(
+    input: jax.Array, target: jax.Array, num_labels: Optional[int]
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "Expected both input.shape and target.shape to have the same shape"
+            f" but got {input.shape} and {target.shape}."
+        )
+    if input.ndim != 2:
+        raise ValueError(
+            f"input should be a two-dimensional tensor, got shape {input.shape}."
+        )
+    if num_labels is not None and input.shape[1] != num_labels:
+        raise ValueError(
+            f"input should have shape of (num_sample, num_labels), "
+            f"got {input.shape} and num_labels={num_labels}."
+        )
+
+
+def multilabel_precision_recall_curve(
+    input, target, *, num_labels: Optional[int] = None
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    """Per-label precision-recall curves for multilabel classification.
+
+    Class version: ``torcheval_tpu.metrics.MultilabelPrecisionRecallCurve``.
+    """
+    input, target = to_jax(input), to_jax(target)
+    if num_labels is None and input.ndim == 2:
+        num_labels = input.shape[1]
+    _multilabel_precision_recall_curve_update_input_check(input, target, num_labels)
+    p_full, r_full, t_full, end_full = (
+        np.asarray(x) for x in _multilabel_prc_full_jit(input, target)
+    )
+    precisions, recalls, thresholds = [], [], []
+    for l in range(num_labels):
+        p, r, t = _compact(p_full[l], r_full[l], t_full[l], end_full[l])
+        precisions.append(p)
+        recalls.append(r)
+        thresholds.append(t)
+    return precisions, recalls, thresholds

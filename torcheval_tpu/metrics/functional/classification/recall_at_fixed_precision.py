@@ -1,0 +1,102 @@
+"""Recall at fixed precision.
+
+Parity: reference torcheval/metrics/functional/classification/
+recall_at_fixed_precision.py (binary :22-75; multilabel :77-131;
+`_recall_at_precision` :132-141). Fully on-device: the max-recall /
+best-threshold selection runs over the padded curve arrays with validity
+masks instead of the reference's boolean indexing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+    prc_arrays,
+    recall_at_precision_from_arrays,
+)
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_update_input_check,
+    _multilabel_precision_recall_curve_update_input_check,
+)
+from torcheval_tpu.utils.convert import to_jax
+
+
+@partial(jax.jit, static_argnames=("min_precision",))
+def _binary_rafp_kernel(
+    input: jax.Array, target: jax.Array, min_precision: float
+) -> Tuple[jax.Array, jax.Array]:
+    p, r, t, is_end = prc_arrays(input, target, 1)
+    return recall_at_precision_from_arrays(p, r, t, is_end, min_precision)
+
+
+def _binary_recall_at_fixed_precision_update_input_check(
+    input: jax.Array, target: jax.Array, min_precision: float
+) -> None:
+    _binary_precision_recall_curve_update_input_check(input, target)
+    if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+        raise ValueError(
+            "Expected min_precision to be a float in the [0, 1] range"
+            f" but got {min_precision}."
+        )
+
+
+def binary_recall_at_fixed_precision(
+    input, target, *, min_precision: float
+) -> Tuple[jax.Array, jax.Array]:
+    """Max recall subject to ``precision >= min_precision``, with the best
+    threshold attaining it.
+
+    Class version: ``torcheval_tpu.metrics.BinaryRecallAtFixedPrecision``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import binary_recall_at_fixed_precision
+        >>> binary_recall_at_fixed_precision(
+        ...     jnp.array([0.1, 0.4, 0.6, 0.6, 0.6, 0.35, 0.8]),
+        ...     jnp.array([0, 0, 1, 1, 1, 1, 1]), min_precision=0.5)
+        (Array(1., dtype=float32), Array(0.35, dtype=float32))
+    """
+    input, target = to_jax(input), to_jax(target)
+    _binary_recall_at_fixed_precision_update_input_check(
+        input, target, min_precision
+    )
+    return _binary_rafp_kernel(input, target, float(min_precision))
+
+
+@partial(jax.jit, static_argnames=("min_precision",))
+def _multilabel_rafp_kernel(
+    input: jax.Array, target: jax.Array, min_precision: float
+) -> Tuple[jax.Array, jax.Array]:
+    def per_label(s, t):
+        p, r, th, is_end = prc_arrays(s, t, 1)
+        return recall_at_precision_from_arrays(p, r, th, is_end, min_precision)
+
+    return jax.vmap(per_label)(input.T, target.T)
+
+
+def multilabel_recall_at_fixed_precision(
+    input, target, *, num_labels: int, min_precision: float
+) -> Tuple[List[jax.Array], List[jax.Array]]:
+    """Per-label max recall at fixed precision.
+
+    Class version: ``torcheval_tpu.metrics.MultilabelRecallAtFixedPrecision``.
+    Returns (recalls, thresholds) as lists with one entry per label.
+    """
+    input, target = to_jax(input), to_jax(target)
+    if num_labels is None and input.ndim == 2:
+        num_labels = input.shape[1]
+    _multilabel_precision_recall_curve_update_input_check(input, target, num_labels)
+    if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+        raise ValueError(
+            "Expected min_precision to be a float in the [0, 1] range"
+            f" but got {min_precision}."
+        )
+    recalls, thresholds = _multilabel_rafp_kernel(
+        input, target, float(min_precision)
+    )
+    return list(recalls), list(thresholds)

@@ -12,6 +12,16 @@ import jax
 import jax.numpy as jnp
 
 
+def nan_safe_divide(a: jax.Array, b: jax.Array) -> jax.Array:
+    """``a / b`` yielding NaN (not inf / a trace error) where ``b == 0``.
+
+    The shared zero-denominator convention for counter metrics (precision,
+    recall, F1): callers ``jnp.nan_to_num`` the result where the reference
+    maps NaN to 0.
+    """
+    return jnp.where(b == 0, jnp.nan, a / jnp.where(b == 0, 1.0, b))
+
+
 def riemann_integral(x: jax.Array, y: jax.Array) -> jax.Array:
     """Left-Riemann integral of y(x): ``-sum((x[1:]-x[:-1]) * y[:-1])``
     (reference tensor_utils.py:12-16; the sign matches the reference's
