@@ -1,0 +1,251 @@
+"""Binned-family tests (binned AUROC / AUPRC / PRC) vs the reference oracle
+and vs the exact (unbinned) metrics on grid-aligned scores."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import (
+    BinaryBinnedAUPRC,
+    BinaryBinnedAUROC,
+    BinaryBinnedPrecisionRecallCurve,
+    MulticlassBinnedAUPRC,
+    MulticlassBinnedAUROC,
+    MulticlassBinnedPrecisionRecallCurve,
+    MultilabelBinnedAUPRC,
+    MultilabelBinnedPrecisionRecallCurve,
+)
+from torcheval_tpu.metrics import functional as F
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+    assert_result_close,
+)
+
+REF_M, REF_F = load_reference_metrics()
+RNG = np.random.default_rng(44)
+N_UP, BATCH, C = 8, 12, 4
+THR = np.array([0.0, 0.25, 0.5, 0.75, 1.0], dtype=np.float32)
+
+
+def _ref_result(metric, update_args):
+    for args in update_args:
+        metric.update(*[torch.tensor(np.asarray(a)) for a in args])
+    out = metric.compute()
+    if isinstance(out, tuple):
+        return tuple(
+            [np.asarray(v) for v in o] if isinstance(o, list) else np.asarray(o)
+            for o in out
+        )
+    return np.asarray(out)
+
+
+class TestBinaryBinnedAUROC(MetricClassTester):
+    def test_class(self):
+        inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [RNG.integers(0, 2, BATCH) for _ in range(N_UP)]
+        expected = _ref_result(
+            REF_M.BinaryBinnedAUROC(threshold=torch.tensor(THR)),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryBinnedAUROC(threshold=jnp.asarray(THR)),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_threshold_validation(self):
+        with pytest.raises(ValueError, match="sorted"):
+            BinaryBinnedAUROC(threshold=jnp.array([0.5, 0.2]))
+        with pytest.raises(ValueError, match="range of"):
+            BinaryBinnedAUROC(threshold=jnp.array([0.1, 1.5]))
+
+
+class TestMulticlassBinnedAUROC(MetricClassTester):
+    def test_matches_exact_on_grid_scores(self):
+        # the reference kernel is buggy (class-axis reduction; see docstring)
+        # so the oracle is our exact multiclass AUROC on grid-aligned scores.
+        grid = np.linspace(0, 1, 21)
+        inputs = [
+            RNG.choice(grid, size=(BATCH, C)).astype(np.float32)
+            for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        exact = F.multiclass_auroc(
+            jnp.asarray(np.concatenate(inputs)),
+            jnp.asarray(np.concatenate(targets)),
+            num_classes=C,
+            average="macro",
+        )
+        thr = jnp.asarray(grid.astype(np.float32))
+        self.run_class_implementation_tests(
+            metric=MulticlassBinnedAUROC(num_classes=C, threshold=thr),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=(np.asarray(exact), np.asarray(thr)),
+        )
+
+
+class TestBinnedAUPRC(MetricClassTester):
+    def test_binary_class(self):
+        inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [RNG.integers(0, 2, BATCH) for _ in range(N_UP)]
+        expected = _ref_result(
+            REF_M.BinaryBinnedAUPRC(threshold=torch.tensor(THR)),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryBinnedAUPRC(threshold=jnp.asarray(THR)),
+            state_names={"num_tp", "num_fp", "num_fn"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_binary_multitask(self):
+        inputs = [
+            RNG.uniform(size=(2, BATCH)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, 2, (2, BATCH)) for _ in range(N_UP)]
+        expected = _ref_result(
+            REF_M.BinaryBinnedAUPRC(num_tasks=2, threshold=torch.tensor(THR)),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryBinnedAUPRC(num_tasks=2, threshold=jnp.asarray(THR)),
+            state_names={"num_tp", "num_fp", "num_fn"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    @pytest.mark.parametrize("optimization", ["vectorized", "memory"])
+    @pytest.mark.parametrize("average", ["macro", None])
+    def test_multiclass_class(self, optimization, average):
+        inputs = [
+            RNG.uniform(size=(BATCH, C)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        expected = _ref_result(
+            REF_M.MulticlassBinnedAUPRC(
+                num_classes=C,
+                threshold=torch.tensor(THR),
+                average=average,
+                optimization=optimization,
+            ),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassBinnedAUPRC(
+                num_classes=C,
+                threshold=jnp.asarray(THR),
+                average=average,
+                optimization=optimization,
+            ),
+            state_names={"num_tp", "num_fp", "num_fn"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_multilabel_class(self):
+        inputs = [
+            RNG.uniform(size=(BATCH, 3)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, 2, (BATCH, 3)) for _ in range(N_UP)]
+        expected = _ref_result(
+            REF_M.MultilabelBinnedAUPRC(
+                num_labels=3, threshold=torch.tensor(THR)
+            ),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=MultilabelBinnedAUPRC(num_labels=3, threshold=jnp.asarray(THR)),
+            state_names={"num_tp", "num_fp", "num_fn"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_bad_optimization(self):
+        with pytest.raises(ValueError, match="vectorized"):
+            MulticlassBinnedAUPRC(num_classes=3, optimization="fast")
+
+
+class TestBinnedPRC(MetricClassTester):
+    def test_binary_class(self):
+        inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [RNG.integers(0, 2, BATCH) for _ in range(N_UP)]
+        expected = _ref_result(
+            REF_M.BinaryBinnedPrecisionRecallCurve(threshold=torch.tensor(THR)),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryBinnedPrecisionRecallCurve(threshold=jnp.asarray(THR)),
+            state_names={"num_tp", "num_fp", "num_fn"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    @pytest.mark.parametrize("optimization", ["vectorized", "memory"])
+    def test_multiclass_class(self, optimization):
+        inputs = [
+            RNG.uniform(size=(BATCH, C)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, C, BATCH) for _ in range(N_UP)]
+        expected = _ref_result(
+            REF_M.MulticlassBinnedPrecisionRecallCurve(
+                num_classes=C, threshold=torch.tensor(THR), optimization=optimization
+            ),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassBinnedPrecisionRecallCurve(
+                num_classes=C, threshold=jnp.asarray(THR), optimization=optimization
+            ),
+            state_names={"num_tp", "num_fp", "num_fn"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_multilabel_class(self):
+        inputs = [
+            RNG.uniform(size=(BATCH, 3)).astype(np.float32) for _ in range(N_UP)
+        ]
+        targets = [RNG.integers(0, 2, (BATCH, 3)) for _ in range(N_UP)]
+        expected = _ref_result(
+            REF_M.MultilabelBinnedPrecisionRecallCurve(
+                num_labels=3, threshold=torch.tensor(THR)
+            ),
+            list(zip(inputs, targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=MultilabelBinnedPrecisionRecallCurve(
+                num_labels=3, threshold=jnp.asarray(THR)
+            ),
+            state_names={"num_tp", "num_fp", "num_fn"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=expected,
+        )
+
+    def test_reference_docstring_case(self):
+        p, r, t = F.binary_binned_precision_recall_curve(
+            jnp.array([0.2, 0.8]),
+            jnp.array([0, 1]),
+            threshold=jnp.array([0.0, 0.5, 1.0]),
+        )
+        assert_result_close(p, [0.5, 1.0, 1.0, 1.0])
+        assert_result_close(r, [1.0, 1.0, 0.0, 0.0])
+
+    def test_inputs_below_all_thresholds_dropped(self):
+        # searchsorted index -1 must not corrupt bin 0
+        p, r, t = F.binary_binned_precision_recall_curve(
+            jnp.array([0.1, 0.9]),
+            jnp.array([1, 1]),
+            threshold=jnp.array([0.5, 1.0]),
+        )
+        ref = REF_F.binary_binned_precision_recall_curve(
+            torch.tensor([0.1, 0.9]),
+            torch.tensor([1, 1]),
+            threshold=torch.tensor([0.5, 1.0]),
+        )
+        assert_result_close(p, np.asarray(ref[0]))
+        assert_result_close(r, np.asarray(ref[1]))

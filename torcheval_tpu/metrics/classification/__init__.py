@@ -10,6 +10,20 @@ from torcheval_tpu.metrics.classification.auprc import (
     MultilabelAUPRC,
 )
 from torcheval_tpu.metrics.classification.auroc import BinaryAUROC, MulticlassAUROC
+from torcheval_tpu.metrics.classification.binned_auprc import (
+    BinaryBinnedAUPRC,
+    MulticlassBinnedAUPRC,
+    MultilabelBinnedAUPRC,
+)
+from torcheval_tpu.metrics.classification.binned_auroc import (
+    BinaryBinnedAUROC,
+    MulticlassBinnedAUROC,
+)
+from torcheval_tpu.metrics.classification.binned_precision_recall_curve import (
+    BinaryBinnedPrecisionRecallCurve,
+    MulticlassBinnedPrecisionRecallCurve,
+    MultilabelBinnedPrecisionRecallCurve,
+)
 from torcheval_tpu.metrics.classification.binary_normalized_entropy import (
     BinaryNormalizedEntropy,
 )
@@ -43,6 +57,9 @@ __all__ = [
     "BinaryAccuracy",
     "BinaryAUPRC",
     "BinaryAUROC",
+    "BinaryBinnedAUPRC",
+    "BinaryBinnedAUROC",
+    "BinaryBinnedPrecisionRecallCurve",
     "BinaryConfusionMatrix",
     "BinaryF1Score",
     "BinaryNormalizedEntropy",
@@ -53,6 +70,9 @@ __all__ = [
     "MulticlassAccuracy",
     "MulticlassAUPRC",
     "MulticlassAUROC",
+    "MulticlassBinnedAUPRC",
+    "MulticlassBinnedAUROC",
+    "MulticlassBinnedPrecisionRecallCurve",
     "MulticlassConfusionMatrix",
     "MulticlassF1Score",
     "MulticlassPrecision",
@@ -60,6 +80,8 @@ __all__ = [
     "MulticlassRecall",
     "MultilabelAccuracy",
     "MultilabelAUPRC",
+    "MultilabelBinnedAUPRC",
+    "MultilabelBinnedPrecisionRecallCurve",
     "MultilabelPrecisionRecallCurve",
     "MultilabelRecallAtFixedPrecision",
     "TopKMultilabelAccuracy",

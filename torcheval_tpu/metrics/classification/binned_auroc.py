@@ -1,0 +1,110 @@
+"""Binned AUROC class metrics.
+
+Parity: reference torcheval/metrics/classification/binned_auroc.py
+(BinaryBinnedAUROC :31 with buffered inputs/targets, MulticlassBinnedAUROC
+:153). Returns ``(auroc, threshold)`` from compute.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.classification.auprc import _BufferedPairMetric
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    _binary_auroc_update_input_check,
+    _multiclass_auroc_update_input_check,
+)
+from torcheval_tpu.metrics.functional.classification.binned_auroc import (
+    DEFAULT_NUM_THRESHOLD,
+    _binary_binned_auroc_compute_jit,
+    _binary_binned_auroc_param_check,
+    _multiclass_binned_auroc_compute_jit,
+    _multiclass_binned_auroc_param_check,
+)
+from torcheval_tpu.metrics.functional.tensor_utils import create_threshold_tensor
+
+
+class BinaryBinnedAUROC(_BufferedPairMetric):
+    """Binned AUROC for binary classification.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import BinaryBinnedAUROC
+        >>> metric = BinaryBinnedAUROC(threshold=5)
+        >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]),
+        ...               jnp.array([0, 0, 1, 1]))
+        >>> auroc, thresholds = metric.compute()
+    """
+
+    _concat_axis = -1
+
+    _extra_device_attrs = ("threshold",)
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        threshold: Union[int, List[float], jax.Array] = DEFAULT_NUM_THRESHOLD,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        threshold = jax.device_put(create_threshold_tensor(threshold), self.device)
+        _binary_binned_auroc_param_check(num_tasks, threshold)
+        self.num_tasks = num_tasks
+        self.threshold = threshold
+
+    def update(self, input, target) -> "BinaryBinnedAUROC":
+        input, target = self._input(input), self._input(target)
+        _binary_auroc_update_input_check(input, target, self.num_tasks)
+        self._append(input, target)
+        return self
+
+    def compute(self) -> Tuple[jax.Array, jax.Array]:
+        inputs, targets = self._concat()
+        return (
+            _binary_binned_auroc_compute_jit(inputs, targets, self.threshold),
+            self.threshold,
+        )
+
+
+class MulticlassBinnedAUROC(_BufferedPairMetric):
+    """Binned one-vs-rest AUROC for multiclass classification.
+
+    See the functional docstring for the documented divergence from the
+    reference's (buggy) class-axis reduction.
+    """
+
+    _extra_device_attrs = ("threshold",)
+
+    def __init__(
+        self,
+        *,
+        num_classes: int,
+        threshold: Union[int, List[float], jax.Array] = DEFAULT_NUM_THRESHOLD,
+        average: Optional[str] = "macro",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        threshold = jax.device_put(create_threshold_tensor(threshold), self.device)
+        _multiclass_binned_auroc_param_check(num_classes, threshold, average)
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.average = average
+
+    def update(self, input, target) -> "MulticlassBinnedAUROC":
+        input, target = self._input(input), self._input(target)
+        _multiclass_auroc_update_input_check(input, target, self.num_classes)
+        self._append(input, target)
+        return self
+
+    def compute(self) -> Tuple[jax.Array, jax.Array]:
+        inputs, targets = self._concat()
+        auroc = _multiclass_binned_auroc_compute_jit(
+            inputs, targets, self.threshold
+        )
+        if self.average == "macro":
+            return jnp.mean(auroc), self.threshold
+        return auroc, self.threshold

@@ -1,0 +1,163 @@
+"""Binned precision-recall curve class metrics — counter states.
+
+Parity: reference torcheval/metrics/classification/
+binned_precision_recall_curve.py (Binary :31, Multiclass :140, Multilabel
+:278).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
+    DEFAULT_NUM_THRESHOLD,
+    _binary_binned_compute_jit,
+    _binary_binned_precision_recall_curve_update,
+    _binned_precision_recall_curve_param_check,
+    _multiclass_binned_precision_recall_curve_compute,
+    _multiclass_binned_precision_recall_curve_update,
+    _multilabel_binned_precision_recall_curve_update,
+    _optimization_param_check,
+)
+from torcheval_tpu.metrics.functional.tensor_utils import create_threshold_tensor
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+
+class BinaryBinnedPrecisionRecallCurve(
+    Metric[Tuple[jax.Array, jax.Array, jax.Array]]
+):
+    """Binned precision-recall curve for binary classification.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import BinaryBinnedPrecisionRecallCurve
+        >>> metric = BinaryBinnedPrecisionRecallCurve(
+        ...     threshold=jnp.array([0.0, 0.5, 1.0]))
+        >>> metric.update(jnp.array([0.2, 0.8]), jnp.array([0, 1]))
+        >>> precision, recall, thresholds = metric.compute()
+    """
+
+    _extra_device_attrs = ("threshold",)
+
+    def __init__(
+        self,
+        *,
+        threshold: Union[int, List[float], jax.Array] = DEFAULT_NUM_THRESHOLD,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        threshold = jax.device_put(create_threshold_tensor(threshold), self.device)
+        _binned_precision_recall_curve_param_check(threshold)
+        self.threshold = threshold
+        num_t = threshold.shape[0]
+        self._add_state("num_tp", jnp.zeros(num_t), merge=MergeKind.SUM)
+        self._add_state("num_fp", jnp.zeros(num_t), merge=MergeKind.SUM)
+        self._add_state("num_fn", jnp.zeros(num_t), merge=MergeKind.SUM)
+
+    def update(self, input, target) -> "BinaryBinnedPrecisionRecallCurve":
+        input, target = self._input(input), self._input(target)
+        tp, fp, fn = _binary_binned_precision_recall_curve_update(
+            input, target, self.threshold
+        )
+        self.num_tp = self.num_tp + tp
+        self.num_fp = self.num_fp + fp
+        self.num_fn = self.num_fn + fn
+        return self
+
+    def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        precision, recall = _binary_binned_compute_jit(
+            self.num_tp, self.num_fp, self.num_fn
+        )
+        return precision, recall, self.threshold
+
+
+class MulticlassBinnedPrecisionRecallCurve(
+    Metric[Tuple[List[jax.Array], List[jax.Array], jax.Array]]
+):
+    """Binned per-class precision-recall curves for multiclass
+    classification, with selectable update kernel (``optimization``)."""
+
+    _extra_device_attrs = ("threshold",)
+
+    def __init__(
+        self,
+        *,
+        num_classes: int,
+        threshold: Union[int, List[float], jax.Array] = DEFAULT_NUM_THRESHOLD,
+        optimization: str = "vectorized",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        threshold = jax.device_put(create_threshold_tensor(threshold), self.device)
+        _binned_precision_recall_curve_param_check(threshold)
+        _optimization_param_check(optimization)
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.optimization = optimization
+        num_t = threshold.shape[0]
+        self._add_state("num_tp", jnp.zeros((num_t, num_classes)), merge=MergeKind.SUM)
+        self._add_state("num_fp", jnp.zeros((num_t, num_classes)), merge=MergeKind.SUM)
+        self._add_state("num_fn", jnp.zeros((num_t, num_classes)), merge=MergeKind.SUM)
+
+    def update(self, input, target) -> "MulticlassBinnedPrecisionRecallCurve":
+        input, target = self._input(input), self._input(target)
+        tp, fp, fn = _multiclass_binned_precision_recall_curve_update(
+            input, target, self.num_classes, self.threshold, self.optimization
+        )
+        self.num_tp = self.num_tp + tp
+        self.num_fp = self.num_fp + fp
+        self.num_fn = self.num_fn + fn
+        return self
+
+    def compute(self) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
+        return _multiclass_binned_precision_recall_curve_compute(
+            self.num_tp, self.num_fp, self.num_fn, self.threshold
+        )
+
+
+class MultilabelBinnedPrecisionRecallCurve(
+    Metric[Tuple[List[jax.Array], List[jax.Array], jax.Array]]
+):
+    """Binned per-label precision-recall curves for multilabel
+    classification."""
+
+    _extra_device_attrs = ("threshold",)
+
+    def __init__(
+        self,
+        *,
+        num_labels: int,
+        threshold: Union[int, List[float], jax.Array] = DEFAULT_NUM_THRESHOLD,
+        optimization: str = "vectorized",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        threshold = jax.device_put(create_threshold_tensor(threshold), self.device)
+        _binned_precision_recall_curve_param_check(threshold)
+        _optimization_param_check(optimization)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.optimization = optimization
+        num_t = threshold.shape[0]
+        self._add_state("num_tp", jnp.zeros((num_t, num_labels)), merge=MergeKind.SUM)
+        self._add_state("num_fp", jnp.zeros((num_t, num_labels)), merge=MergeKind.SUM)
+        self._add_state("num_fn", jnp.zeros((num_t, num_labels)), merge=MergeKind.SUM)
+
+    def update(self, input, target) -> "MultilabelBinnedPrecisionRecallCurve":
+        input, target = self._input(input), self._input(target)
+        tp, fp, fn = _multilabel_binned_precision_recall_curve_update(
+            input, target, self.num_labels, self.threshold, self.optimization
+        )
+        self.num_tp = self.num_tp + tp
+        self.num_fp = self.num_fp + fp
+        self.num_fn = self.num_fn + fn
+        return self
+
+    def compute(self) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
+        precision, recall = _binary_binned_compute_jit(
+            self.num_tp.T, self.num_fp.T, self.num_fn.T
+        )
+        return list(precision), list(recall), self.threshold

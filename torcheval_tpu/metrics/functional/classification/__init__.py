@@ -13,6 +13,20 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
     binary_auroc,
     multiclass_auroc,
 )
+from torcheval_tpu.metrics.functional.classification.binned_auprc import (
+    binary_binned_auprc,
+    multiclass_binned_auprc,
+    multilabel_binned_auprc,
+)
+from torcheval_tpu.metrics.functional.classification.binned_auroc import (
+    binary_binned_auroc,
+    multiclass_binned_auroc,
+)
+from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
+    binary_binned_precision_recall_curve,
+    multiclass_binned_precision_recall_curve,
+    multilabel_binned_precision_recall_curve,
+)
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     binary_normalized_entropy,
 )
@@ -46,6 +60,9 @@ __all__ = [
     "binary_accuracy",
     "binary_auprc",
     "binary_auroc",
+    "binary_binned_auprc",
+    "binary_binned_auroc",
+    "binary_binned_precision_recall_curve",
     "binary_confusion_matrix",
     "binary_f1_score",
     "binary_normalized_entropy",
@@ -56,6 +73,9 @@ __all__ = [
     "multiclass_accuracy",
     "multiclass_auprc",
     "multiclass_auroc",
+    "multiclass_binned_auprc",
+    "multiclass_binned_auroc",
+    "multiclass_binned_precision_recall_curve",
     "multiclass_confusion_matrix",
     "multiclass_f1_score",
     "multiclass_precision",
@@ -63,6 +83,8 @@ __all__ = [
     "multiclass_recall",
     "multilabel_accuracy",
     "multilabel_auprc",
+    "multilabel_binned_auprc",
+    "multilabel_binned_precision_recall_curve",
     "multilabel_precision_recall_curve",
     "multilabel_recall_at_fixed_precision",
     "topk_multilabel_accuracy",

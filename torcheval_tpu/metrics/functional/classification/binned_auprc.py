@@ -1,0 +1,197 @@
+"""Binned AUPRC: Riemann AUPRC over a fixed threshold grid.
+
+Parity: reference torcheval/metrics/functional/classification/binned_auprc.py
+(binary :27-112; multiclass :140-259; multilabel :282-400). Built on the
+binned PRC counters; per-task/class/label integrals are vmapped, not Python
+loops.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+    auprc_from_prc,
+)
+from torcheval_tpu.metrics.functional.classification.auprc import (
+    _binary_auprc_update_input_check,
+    _multiclass_auprc_update_input_check,
+    _multilabel_auprc_update_input_check,
+)
+from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
+    _binary_binned_update_jit,
+    _binned_precision_recall_curve_param_check,
+    _binary_binned_compute_jit,
+    _multiclass_binned_precision_recall_curve_update,
+    _multilabel_binned_precision_recall_curve_update,
+    _optimization_param_check,
+)
+from torcheval_tpu.metrics.functional.tensor_utils import create_threshold_tensor
+from torcheval_tpu.utils.convert import to_jax
+
+DEFAULT_NUM_THRESHOLD = 100
+
+
+@jax.jit
+def _binned_auprc_from_counts(
+    num_tp: jax.Array, num_fp: jax.Array, num_fn: jax.Array
+) -> jax.Array:
+    """(..., T) counters -> Riemann AUPRC per leading batch dims.
+
+    The binned PRC compute already appends the terminal (1, 0) point, so the
+    Riemann sum runs over (precision, recall) directly (reference
+    binned_auprc.py:86-112)."""
+    precision, recall = _binary_binned_compute_jit(num_tp, num_fp, num_fn)
+    integral = -jnp.sum(
+        (recall[..., 1:] - recall[..., :-1]) * precision[..., :-1], axis=-1
+    )
+    return jnp.nan_to_num(integral, nan=0.0)
+
+
+def _binned_auprc_threshold_bounds_check(threshold: jax.Array) -> None:
+    """AUPRC grids must span [0, 1] or the Riemann integral silently
+    truncates (reference binned_auprc.py:133-137 enforces this)."""
+    import numpy as np
+
+    t = np.asarray(threshold)
+    if t[0] != 0.0:
+        raise ValueError("First value in `threshold` should be 0.")
+    if t[-1] != 1.0:
+        raise ValueError("Last value in `threshold` should be 1.")
+
+
+def _binary_binned_auprc_param_check(num_tasks: int, threshold: jax.Array) -> None:
+    if num_tasks < 1:
+        raise ValueError(
+            "`num_tasks` value should be greater than and equal to 1, but "
+            f"received {num_tasks}. "
+        )
+    _binned_precision_recall_curve_param_check(threshold)
+    _binned_auprc_threshold_bounds_check(threshold)
+
+
+def _binary_binned_auprc_compute(
+    input: jax.Array, target: jax.Array, num_tasks: int, threshold: jax.Array
+) -> jax.Array:
+    if num_tasks == 1 and input.ndim == 1:
+        num_tp, num_fp, num_fn = _binary_binned_update_jit(input, target, threshold)
+        return _binned_auprc_from_counts(num_tp, num_fp, num_fn)
+    counts = jax.vmap(
+        lambda x, t: _binary_binned_update_jit(x, t, threshold)
+    )(input, target)
+    return _binned_auprc_from_counts(*counts)
+
+
+def binary_binned_auprc(
+    input,
+    target,
+    *,
+    num_tasks: int = 1,
+    threshold: Union[int, List[float], jax.Array] = DEFAULT_NUM_THRESHOLD,
+) -> Tuple[jax.Array, jax.Array]:
+    """Binned AUPRC for binary classification; returns (auprc, threshold).
+
+    Class version: ``torcheval_tpu.metrics.BinaryBinnedAUPRC``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import binary_binned_auprc
+        >>> binary_binned_auprc(jnp.array([0.1, 0.5, 0.7, 0.8]),
+        ...                     jnp.array([1, 0, 1, 1]), threshold=5)
+    """
+    input, target = to_jax(input), to_jax(target)
+    threshold = create_threshold_tensor(threshold)
+    _binary_binned_auprc_param_check(num_tasks, threshold)
+    _binary_auprc_update_input_check(input, target, num_tasks)
+    return _binary_binned_auprc_compute(input, target, num_tasks, threshold), threshold
+
+
+def _multiclass_binned_auprc_param_check(
+    num_classes: int, threshold: jax.Array, average: Optional[str]
+) -> None:
+    average_options = ("macro", "none", None)
+    if average not in average_options:
+        raise ValueError(
+            f"`average` was not in the allowed value of {average_options}, "
+            f"got {average}."
+        )
+    if num_classes < 2:
+        raise ValueError("`num_classes` has to be at least 2.")
+    _binned_precision_recall_curve_param_check(threshold)
+    _binned_auprc_threshold_bounds_check(threshold)
+
+
+def multiclass_binned_auprc(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+    threshold: Union[int, List[float], jax.Array] = DEFAULT_NUM_THRESHOLD,
+    average: Optional[str] = "macro",
+    optimization: str = "vectorized",
+) -> Tuple[jax.Array, jax.Array]:
+    """Binned one-vs-rest AUPRC for multiclass classification.
+
+    Class version: ``torcheval_tpu.metrics.MulticlassBinnedAUPRC``.
+    """
+    input, target = to_jax(input), to_jax(target)
+    _optimization_param_check(optimization)
+    threshold = create_threshold_tensor(threshold)
+    if num_classes is None and input.ndim == 2:
+        num_classes = input.shape[1]
+    _multiclass_binned_auprc_param_check(num_classes, threshold, average)
+    _multiclass_auprc_update_input_check(input, target, num_classes)
+    num_tp, num_fp, num_fn = _multiclass_binned_precision_recall_curve_update(
+        input, target, num_classes, threshold, optimization
+    )
+    auprc = _binned_auprc_from_counts(num_tp.T, num_fp.T, num_fn.T)
+    if average == "macro":
+        return jnp.mean(auprc), threshold
+    return auprc, threshold
+
+
+def _multilabel_binned_auprc_param_check(
+    num_labels: int, threshold: jax.Array, average: Optional[str]
+) -> None:
+    average_options = ("macro", "none", None)
+    if average not in average_options:
+        raise ValueError(
+            f"`average` was not in the allowed value of {average_options}, "
+            f"got {average}."
+        )
+    if num_labels < 2:
+        raise ValueError("`num_labels` has to be at least 2.")
+    _binned_precision_recall_curve_param_check(threshold)
+    _binned_auprc_threshold_bounds_check(threshold)
+
+
+def multilabel_binned_auprc(
+    input,
+    target,
+    *,
+    num_labels: Optional[int] = None,
+    threshold: Union[int, List[float], jax.Array] = DEFAULT_NUM_THRESHOLD,
+    average: Optional[str] = "macro",
+    optimization: str = "vectorized",
+) -> Tuple[jax.Array, jax.Array]:
+    """Binned per-label AUPRC for multilabel classification.
+
+    Class version: ``torcheval_tpu.metrics.MultilabelBinnedAUPRC``.
+    """
+    input, target = to_jax(input), to_jax(target)
+    _optimization_param_check(optimization)
+    threshold = create_threshold_tensor(threshold)
+    if num_labels is None and input.ndim == 2:
+        num_labels = input.shape[1]
+    _multilabel_binned_auprc_param_check(num_labels, threshold, average)
+    _multilabel_auprc_update_input_check(input, target, num_labels)
+    num_tp, num_fp, num_fn = _multilabel_binned_precision_recall_curve_update(
+        input, target, num_labels, threshold, optimization
+    )
+    auprc = _binned_auprc_from_counts(num_tp.T, num_fp.T, num_fn.T)
+    if average == "macro":
+        return jnp.mean(auprc), threshold
+    return auprc, threshold

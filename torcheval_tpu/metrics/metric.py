@@ -313,11 +313,19 @@ class Metric(Generic[TComputeReturn], ABC):
 
     # ---------------------------------------------------------------- devices
 
+    # array-valued config attributes (e.g. binned metrics' `threshold`) that
+    # must travel with the states on to(); subclasses append names here.
+    _extra_device_attrs: tuple = ()
+
     def to(self: TSelf, device: Union[jax.Device, str], *args: Any, **kwargs: Any) -> TSelf:
         """Move all array states to ``device`` (reference metric.py:212-248)."""
         target = canonicalize_device(device)
         for name in self._state_name_to_default:
             setattr(self, name, self._place_state(getattr(self, name), target))
+        for name in self._extra_device_attrs:
+            value = getattr(self, name, None)
+            if isinstance(value, jax.Array):
+                setattr(self, name, jax.device_put(value, target))
         self._device = target
         return self
 
