@@ -1,0 +1,168 @@
+"""Regression metric tests (MeanSquaredError, R2Score) vs the reference
+oracle, via the shared MetricClassTester harness."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import MeanSquaredError, R2Score
+from torcheval_tpu.metrics import functional as F
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+    assert_result_close,
+)
+
+REF_M, REF_F = load_reference_metrics()
+RNG = np.random.default_rng(7)
+
+
+def _ref_mse(inputs, targets, weights=None, **kwargs):
+    ref = REF_M.MeanSquaredError(**kwargs)
+    for i, (x, t) in enumerate(zip(inputs, targets)):
+        sw = None if weights is None else torch.tensor(weights[i])
+        ref.update(torch.tensor(x), torch.tensor(t), sample_weight=sw)
+    return np.asarray(ref.compute())
+
+
+class TestMeanSquaredError(MetricClassTester):
+    def test_mse_1d(self):
+        inputs = [RNG.uniform(size=(5,)).astype(np.float32) for _ in range(8)]
+        targets = [RNG.uniform(size=(5,)).astype(np.float32) for _ in range(8)]
+        self.run_class_implementation_tests(
+            metric=MeanSquaredError(),
+            state_names={"sum_squared_error", "sum_weight"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=_ref_mse(inputs, targets),
+        )
+
+    def test_mse_multioutput_raw_values(self):
+        inputs = [RNG.uniform(size=(4, 3)).astype(np.float32) for _ in range(8)]
+        targets = [RNG.uniform(size=(4, 3)).astype(np.float32) for _ in range(8)]
+        self.run_class_implementation_tests(
+            metric=MeanSquaredError(multioutput="raw_values"),
+            state_names={"sum_squared_error", "sum_weight"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=_ref_mse(inputs, targets, multioutput="raw_values"),
+        )
+
+    def test_mse_sample_weight(self):
+        inputs = [RNG.uniform(size=(6, 2)).astype(np.float32) for _ in range(8)]
+        targets = [RNG.uniform(size=(6, 2)).astype(np.float32) for _ in range(8)]
+        weights = [RNG.uniform(0.1, 1.0, size=(6,)).astype(np.float32) for _ in range(8)]
+        self.run_class_implementation_tests(
+            metric=MeanSquaredError(),
+            state_names={"sum_squared_error", "sum_weight"},
+            update_kwargs={
+                "input": inputs,
+                "target": targets,
+                "sample_weight": [jnp.asarray(w) for w in weights],
+            },
+            compute_result=_ref_mse(inputs, targets, weights),
+        )
+
+    def test_mse_functional_vs_reference(self):
+        x = RNG.uniform(size=(32, 4)).astype(np.float32)
+        t = RNG.uniform(size=(32, 4)).astype(np.float32)
+        w = RNG.uniform(0.1, 1.0, size=(32,)).astype(np.float32)
+        for kwargs in (
+            {},
+            {"multioutput": "raw_values"},
+        ):
+            assert_result_close(
+                F.mean_squared_error(jnp.asarray(x), jnp.asarray(t), **kwargs),
+                np.asarray(REF_F.mean_squared_error(torch.tensor(x), torch.tensor(t), **kwargs)),
+            )
+        assert_result_close(
+            F.mean_squared_error(
+                jnp.asarray(x), jnp.asarray(t), sample_weight=jnp.asarray(w)
+            ),
+            np.asarray(
+                REF_F.mean_squared_error(
+                    torch.tensor(x), torch.tensor(t), sample_weight=torch.tensor(w)
+                )
+            ),
+        )
+
+    def test_mse_invalid_inputs(self):
+        with pytest.raises(ValueError, match="multioutput"):
+            F.mean_squared_error(jnp.ones(3), jnp.ones(3), multioutput="bogus")
+        with pytest.raises(ValueError, match="same size"):
+            F.mean_squared_error(jnp.ones(3), jnp.ones(4))
+        with pytest.raises(ValueError, match="1D or 2D"):
+            F.mean_squared_error(jnp.ones((2, 2, 2)), jnp.ones((2, 2, 2)))
+        with pytest.raises(ValueError, match="sample_weight"):
+            F.mean_squared_error(
+                jnp.ones(3), jnp.ones(3), sample_weight=jnp.ones(4)
+            )
+
+
+def _ref_r2(inputs, targets, **kwargs):
+    ref = REF_M.R2Score(**kwargs)
+    for x, t in zip(inputs, targets):
+        ref.update(torch.tensor(x), torch.tensor(t))
+    return np.asarray(ref.compute())
+
+
+class TestR2Score(MetricClassTester):
+    def test_r2_1d(self):
+        inputs = [RNG.uniform(size=(5,)).astype(np.float32) for _ in range(8)]
+        targets = [RNG.uniform(size=(5,)).astype(np.float32) for _ in range(8)]
+        self.run_class_implementation_tests(
+            metric=R2Score(),
+            state_names={
+                "sum_squared_obs",
+                "sum_obs",
+                "sum_squared_residual",
+                "num_obs",
+            },
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=_ref_r2(inputs, targets),
+            atol=1e-4,
+            rtol=1e-4,
+        )
+
+    @pytest.mark.parametrize(
+        "multioutput", ["uniform_average", "raw_values", "variance_weighted"]
+    )
+    def test_r2_multioutput(self, multioutput):
+        inputs = [RNG.uniform(size=(4, 3)).astype(np.float32) for _ in range(8)]
+        targets = [RNG.uniform(size=(4, 3)).astype(np.float32) for _ in range(8)]
+        self.run_class_implementation_tests(
+            metric=R2Score(multioutput=multioutput),
+            state_names={
+                "sum_squared_obs",
+                "sum_obs",
+                "sum_squared_residual",
+                "num_obs",
+            },
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=_ref_r2(inputs, targets, multioutput=multioutput),
+            atol=1e-4,
+            rtol=1e-4,
+        )
+
+    def test_r2_adjusted(self):
+        x = RNG.uniform(size=(16,)).astype(np.float32)
+        t = RNG.uniform(size=(16,)).astype(np.float32)
+        assert_result_close(
+            F.r2_score(jnp.asarray(x), jnp.asarray(t), num_regressors=3),
+            np.asarray(
+                REF_F.r2_score(torch.tensor(x), torch.tensor(t), num_regressors=3)
+            ),
+            atol=1e-4,
+            rtol=1e-4,
+        )
+
+    def test_r2_invalid_inputs(self):
+        with pytest.raises(ValueError, match="multioutput"):
+            F.r2_score(jnp.ones(3), jnp.ones(3), multioutput="bogus")
+        with pytest.raises(ValueError, match="num_regressors"):
+            F.r2_score(jnp.ones(3), jnp.ones(3), num_regressors=-1)
+        with pytest.raises(ValueError, match="no enough data"):
+            F.r2_score(jnp.ones(1), jnp.ones(1))
+        with pytest.raises(ValueError, match="smaller than n_samples"):
+            F.r2_score(jnp.ones(4), jnp.ones(4), num_regressors=3)
+        with pytest.raises(ValueError, match="same size"):
+            F.r2_score(jnp.ones(3), jnp.ones(4))

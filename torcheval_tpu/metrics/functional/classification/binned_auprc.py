@@ -12,10 +12,8 @@ from typing import List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from torcheval_tpu.metrics.functional.classification._curve_kernels import (
-    auprc_from_prc,
-)
 from torcheval_tpu.metrics.functional.classification.auprc import (
     _binary_auprc_update_input_check,
     _multiclass_auprc_update_input_check,
@@ -54,8 +52,6 @@ def _binned_auprc_from_counts(
 def _binned_auprc_threshold_bounds_check(threshold: jax.Array) -> None:
     """AUPRC grids must span [0, 1] or the Riemann integral silently
     truncates (reference binned_auprc.py:133-137 enforces this)."""
-    import numpy as np
-
     t = np.asarray(threshold)
     if t[0] != 0.0:
         raise ValueError("First value in `threshold` should be 0.")
@@ -138,7 +134,6 @@ def multiclass_binned_auprc(
     Class version: ``torcheval_tpu.metrics.MulticlassBinnedAUPRC``.
     """
     input, target = to_jax(input), to_jax(target)
-    _optimization_param_check(optimization)
     threshold = create_threshold_tensor(threshold)
     if num_classes is None and input.ndim == 2:
         num_classes = input.shape[1]
@@ -182,7 +177,6 @@ def multilabel_binned_auprc(
     Class version: ``torcheval_tpu.metrics.MultilabelBinnedAUPRC``.
     """
     input, target = to_jax(input), to_jax(target)
-    _optimization_param_check(optimization)
     threshold = create_threshold_tensor(threshold)
     if num_labels is None and input.ndim == 2:
         num_labels = input.shape[1]

@@ -15,6 +15,9 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_update_input_check,
     _multiclass_auroc_update_input_check,
 )
+from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
+    _binned_precision_recall_curve_param_check,
+)
 from torcheval_tpu.metrics.functional.tensor_utils import (
     create_threshold_tensor,
     trapezoid,
@@ -24,25 +27,13 @@ from torcheval_tpu.utils.convert import to_jax
 DEFAULT_NUM_THRESHOLD = 200
 
 
-def _binned_auroc_threshold_check(threshold: jax.Array) -> None:
-    import numpy as np
-
-    t = np.asarray(threshold)
-    if (np.diff(t) < 0.0).any():
-        raise ValueError("The `threshold` should be a sorted tensor.")
-    if (t < 0.0).any() or (t > 1.0).any():
-        raise ValueError(
-            "The values in `threshold` should be in the range of [0, 1]."
-        )
-
-
 def _binary_binned_auroc_param_check(num_tasks: int, threshold: jax.Array) -> None:
     if num_tasks < 1:
         raise ValueError(
             "`num_tasks` value should be greater than and equal to 1, but "
             f"received {num_tasks}. "
         )
-    _binned_auroc_threshold_check(threshold)
+    _binned_precision_recall_curve_param_check(threshold)
 
 
 @jax.jit
@@ -119,7 +110,7 @@ def _multiclass_binned_auroc_param_check(
         )
     if num_classes < 2:
         raise ValueError(f"`num_classes` has to be at least 2, got {num_classes}.")
-    _binned_auroc_threshold_check(threshold)
+    _binned_precision_recall_curve_param_check(threshold)
 
 
 @jax.jit

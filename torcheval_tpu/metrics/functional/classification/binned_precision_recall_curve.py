@@ -19,6 +19,7 @@ from typing import List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
     _binary_precision_recall_curve_update_input_check,
@@ -40,8 +41,6 @@ def _binned_precision_recall_curve_param_check(threshold: jax.Array) -> None:
             f"The `threshold` should be a one-dimensional tensor, got shape "
             f"{threshold.shape}."
         )
-    import numpy as np
-
     t = np.asarray(threshold)
     if (np.diff(t) < 0.0).any():
         raise ValueError("The `threshold` should be a sorted tensor.")
@@ -213,7 +212,6 @@ def multiclass_binned_precision_recall_curve(
     ``torcheval_tpu.metrics.MulticlassBinnedPrecisionRecallCurve``.
     """
     input, target = to_jax(input), to_jax(target)
-    _optimization_param_check(optimization)
     threshold = create_threshold_tensor(threshold)
     _binned_precision_recall_curve_param_check(threshold)
     if num_classes is None and input.ndim == 2:
@@ -293,7 +291,6 @@ def multilabel_binned_precision_recall_curve(
     ``torcheval_tpu.metrics.MultilabelBinnedPrecisionRecallCurve``.
     """
     input, target = to_jax(input), to_jax(target)
-    _optimization_param_check(optimization)
     threshold = create_threshold_tensor(threshold)
     _binned_precision_recall_curve_param_check(threshold)
     if num_labels is None and input.ndim == 2:

@@ -1,0 +1,141 @@
+"""R-squared score (plain / adjusted / variance-weighted).
+
+Parity: reference torcheval/metrics/functional/regression/r2_score.py
+(`r2_score` :14-90, `_update` :100-109, `_compute` :138-166,
+`_r2_score_param_check` :169-181). Sufficient statistics
+(sum y^2, sum y, rss, n) are accumulated on device; only `compute` reads the
+scalar ``num_obs`` back to the host for the sample-count guard checks, which
+the reference also performs eagerly (its compute :116-126).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import to_jax_float
+
+
+@jax.jit
+def _update(
+    input: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    sum_squared_obs = jnp.sum(jnp.square(target), axis=0)
+    sum_obs = jnp.sum(target, axis=0)
+    sum_squared_residual = jnp.sum(jnp.square(target - input), axis=0)
+    return sum_squared_obs, sum_obs, sum_squared_residual, jnp.float32(target.shape[0])
+
+
+def _r2_score_update(
+    input, target
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    input = to_jax_float(input)
+    target = to_jax_float(target)
+    _r2_score_update_input_check(input, target)
+    return _update(input, target)
+
+
+@partial(jax.jit, static_argnames=("multioutput", "num_regressors"))
+def _compute(
+    sum_squared_obs: jax.Array,
+    sum_obs: jax.Array,
+    rss: jax.Array,
+    num_obs: jax.Array,
+    multioutput: str,
+    num_regressors: int,
+) -> jax.Array:
+    tss = sum_squared_obs - jnp.square(sum_obs) / num_obs
+    r_squared = 1 - (rss / tss)
+    if multioutput == "uniform_average":
+        r_squared = jnp.mean(r_squared)
+    elif multioutput == "variance_weighted":
+        r_squared = jnp.sum(r_squared * tss / jnp.sum(tss))
+    if num_regressors != 0:
+        r_squared = 1 - (1 - r_squared) * (num_obs - 1) / (
+            num_obs - num_regressors - 1
+        )
+    return r_squared
+
+
+def _r2_score_compute(
+    sum_squared_obs: jax.Array,
+    sum_obs: jax.Array,
+    rss: jax.Array,
+    num_obs: jax.Array,
+    multioutput: str,
+    num_regressors: int,
+) -> jax.Array:
+    n = float(num_obs)
+    if n < 2:
+        raise ValueError(
+            "There is no enough data for computing. Needs at least two "
+            "samples to calculate r2 score."
+        )
+    if num_regressors >= n - 1:
+        raise ValueError(
+            "The `num_regressors` must be smaller than n_samples - 1, "
+            f"got num_regressors={num_regressors}, n_samples={n}."
+        )
+    return _compute(sum_squared_obs, sum_obs, rss, num_obs, multioutput, num_regressors)
+
+
+def _r2_score_param_check(multioutput: str, num_regressors: int) -> None:
+    if multioutput not in ("raw_values", "uniform_average", "variance_weighted"):
+        raise ValueError(
+            "The `multioutput` must be either `raw_values` or "
+            "`uniform_average` or `variance_weighted`, "
+            f"got multioutput={multioutput}."
+        )
+    if not isinstance(num_regressors, int) or num_regressors < 0:
+        raise ValueError(
+            "The `num_regressors` must an integer larger or equal to zero, "
+            f"got num_regressors={num_regressors}."
+        )
+
+
+def _r2_score_update_input_check(input: jax.Array, target: jax.Array) -> None:
+    if input.ndim >= 3 or target.ndim >= 3:
+        raise ValueError(
+            "The dimension `input` and `target` should be 1D or 2D, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same size, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+
+
+def r2_score(
+    input,
+    target,
+    *,
+    multioutput: str = "uniform_average",
+    num_regressors: int = 0,
+) -> jax.Array:
+    """R-squared score of ``input`` vs ``target``.
+
+    Class version: ``torcheval_tpu.metrics.R2Score``.
+
+    Args:
+        input: predicted values, shape (n_sample,) or (n_sample, n_output).
+        target: ground-truth values, same shape as input.
+        multioutput: ``uniform_average`` | ``raw_values`` |
+            ``variance_weighted``.
+        num_regressors: number of independent variables (adjusted R2 when
+            nonzero).
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import r2_score
+        >>> r2_score(jnp.array([0., 2., 1., 3.]), jnp.array([0., 1., 2., 3.]))
+        Array(0.6, dtype=float32)
+    """
+    _r2_score_param_check(multioutput, num_regressors)
+    sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(input, target)
+    return _r2_score_compute(
+        sum_squared_obs, sum_obs, rss, num_obs, multioutput, num_regressors
+    )

@@ -1,0 +1,81 @@
+"""MeanSquaredError class metric.
+
+Parity: reference torcheval/metrics/regression/mean_squared_error.py:23-143.
+States are scalar-or-per-output sums that broadcast under addition, so the
+declarative SUM merge covers the reference's ndim-promotion branch
+(reference :166-173) for free.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.regression.mean_squared_error import (
+    _mean_squared_error_compute,
+    _mean_squared_error_param_check,
+    _mean_squared_error_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TMeanSquaredError = TypeVar("TMeanSquaredError", bound="MeanSquaredError")
+
+
+class MeanSquaredError(Metric[jax.Array]):
+    """Mean squared error over all updates.
+
+    Functional version: ``torcheval_tpu.metrics.functional.mean_squared_error``.
+
+    Args:
+        multioutput: ``uniform_average`` [default] or ``raw_values``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import MeanSquaredError
+        >>> metric = MeanSquaredError()
+        >>> metric.update(jnp.array([0.9, 0.5, 0.3, 0.5]),
+        ...               jnp.array([0.5, 0.8, 0.2, 0.8]))
+        >>> metric.compute()
+        Array(0.0875, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        multioutput: str = "uniform_average",
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        super().__init__(device=device)
+        _mean_squared_error_param_check(multioutput)
+        self.multioutput = multioutput
+        self._add_state("sum_squared_error", jnp.zeros(()), merge=MergeKind.SUM)
+        self._add_state("sum_weight", jnp.zeros(()), merge=MergeKind.SUM)
+
+    def update(
+        self: TMeanSquaredError,
+        input,
+        target,
+        *,
+        sample_weight=None,
+    ) -> TMeanSquaredError:
+        """Accumulate one batch.
+
+        Args:
+            input: predictions, shape (n_sample,) or (n_sample, n_output).
+            target: ground truth, same shape.
+            sample_weight: optional (n_sample,) weights.
+        """
+        sum_squared_error, sum_weight = _mean_squared_error_update(
+            self._input_float(input), self._input_float(target), sample_weight
+        )
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.sum_weight = self.sum_weight + sum_weight
+        return self
+
+    def compute(self) -> jax.Array:
+        """MSE; NaN if no updates have happened."""
+        return _mean_squared_error_compute(
+            self.sum_squared_error, self.multioutput, self.sum_weight
+        )

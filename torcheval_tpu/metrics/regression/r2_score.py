@@ -1,0 +1,83 @@
+"""R2Score class metric.
+
+Parity: reference torcheval/metrics/regression/r2_score.py:23-164. Sufficient
+statistics broadcast under addition (scalar default + per-output update), so
+the SUM merge kind reproduces the reference's ndim-promotion merge
+(reference :152-164).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.regression.r2_score import (
+    _r2_score_compute,
+    _r2_score_param_check,
+    _r2_score_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TR2Score = TypeVar("TR2Score", bound="R2Score")
+
+
+class R2Score(Metric[jax.Array]):
+    """R-squared score over all updates.
+
+    Functional version: ``torcheval_tpu.metrics.functional.r2_score``.
+
+    Args:
+        multioutput: ``uniform_average`` [default] | ``raw_values`` |
+            ``variance_weighted``.
+        num_regressors: number of independent variables used; nonzero gives
+            the adjusted R-squared score.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import R2Score
+        >>> metric = R2Score()
+        >>> metric.update(jnp.array([0., 2., 1., 3.]),
+        ...               jnp.array([0., 1., 2., 3.]))
+        >>> metric.compute()
+        Array(0.6, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        multioutput: str = "uniform_average",
+        num_regressors: int = 0,
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        super().__init__(device=device)
+        _r2_score_param_check(multioutput, num_regressors)
+        self.multioutput = multioutput
+        self.num_regressors = num_regressors
+        self._add_state("sum_squared_obs", jnp.zeros(()), merge=MergeKind.SUM)
+        self._add_state("sum_obs", jnp.zeros(()), merge=MergeKind.SUM)
+        self._add_state("sum_squared_residual", jnp.zeros(()), merge=MergeKind.SUM)
+        self._add_state("num_obs", jnp.zeros(()), merge=MergeKind.SUM)
+
+    def update(self: TR2Score, input, target) -> TR2Score:
+        """Accumulate one batch of predictions and ground truth."""
+        sum_squared_obs, sum_obs, sum_squared_residual, num_obs = _r2_score_update(
+            self._input_float(input), self._input_float(target)
+        )
+        self.sum_squared_obs = self.sum_squared_obs + sum_squared_obs
+        self.sum_obs = self.sum_obs + sum_obs
+        self.sum_squared_residual = self.sum_squared_residual + sum_squared_residual
+        self.num_obs = self.num_obs + num_obs
+        return self
+
+    def compute(self) -> jax.Array:
+        """R2 score; raises if fewer than two samples were observed."""
+        return _r2_score_compute(
+            self.sum_squared_obs,
+            self.sum_obs,
+            self.sum_squared_residual,
+            self.num_obs,
+            self.multioutput,
+            self.num_regressors,
+        )
