@@ -1,0 +1,293 @@
+"""Ranking metric tests (CTR, HitRate, ReciprocalRank, RetrievalPrecision,
+WeightedCalibration + functional-only frequency_at_k / num_collisions) vs the
+reference oracle, via the shared MetricClassTester harness."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import (
+    ClickThroughRate,
+    HitRate,
+    ReciprocalRank,
+    RetrievalPrecision,
+    WeightedCalibration,
+)
+from torcheval_tpu.metrics import functional as F
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+    assert_result_close,
+)
+
+REF_M, REF_F = load_reference_metrics()
+RNG = np.random.default_rng(11)
+
+
+class TestClickThroughRate(MetricClassTester):
+    def test_ctr_class(self):
+        inputs = [RNG.integers(0, 2, size=(16,)).astype(np.float32) for _ in range(8)]
+        weights = [RNG.uniform(0.1, 1.0, size=(16,)).astype(np.float32) for _ in range(8)]
+        ref = REF_M.ClickThroughRate()
+        for x, w in zip(inputs, weights):
+            ref.update(torch.tensor(x), torch.tensor(w))
+        self.run_class_implementation_tests(
+            metric=ClickThroughRate(),
+            state_names={"click_total", "weight_total"},
+            update_kwargs={
+                "input": inputs,
+                "weights": [jnp.asarray(w) for w in weights],
+            },
+            compute_result=np.asarray(ref.compute()),
+        )
+
+    def test_ctr_multitask(self):
+        inputs = [RNG.integers(0, 2, size=(2, 8)).astype(np.float32) for _ in range(8)]
+        ref = REF_M.ClickThroughRate(num_tasks=2)
+        for x in inputs:
+            ref.update(torch.tensor(x))
+        self.run_class_implementation_tests(
+            metric=ClickThroughRate(num_tasks=2),
+            state_names={"click_total", "weight_total"},
+            update_kwargs={"input": inputs},
+            compute_result=np.asarray(ref.compute()),
+        )
+
+    def test_ctr_functional(self):
+        x = RNG.integers(0, 2, size=(20,)).astype(np.float32)
+        w = RNG.uniform(0.5, 2.0, size=(20,)).astype(np.float32)
+        assert_result_close(
+            F.click_through_rate(jnp.asarray(x), jnp.asarray(w)),
+            np.asarray(REF_F.click_through_rate(torch.tensor(x), torch.tensor(w))),
+        )
+
+    def test_ctr_invalid(self):
+        with pytest.raises(ValueError, match="one or two dimensional"):
+            F.click_through_rate(jnp.ones((2, 2, 2)))
+        with pytest.raises(ValueError, match="same shape"):
+            F.click_through_rate(jnp.ones(4), jnp.ones(5))
+        with pytest.raises(ValueError, match="num_tasks = 2"):
+            F.click_through_rate(jnp.ones(4), num_tasks=2)
+
+
+class TestHitRate(MetricClassTester):
+    def test_hit_rate_class(self):
+        inputs = [RNG.uniform(size=(6, 5)).astype(np.float32) for _ in range(8)]
+        targets = [RNG.integers(0, 5, size=(6,)) for _ in range(8)]
+        ref = REF_M.HitRate(k=3)
+        for x, t in zip(inputs, targets):
+            ref.update(torch.tensor(x), torch.tensor(t))
+        self.run_class_implementation_tests(
+            metric=HitRate(k=3),
+            state_names={"scores"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=np.asarray(ref.compute()),
+        )
+
+    def test_hit_rate_functional(self):
+        x = RNG.uniform(size=(10, 4)).astype(np.float32)
+        t = RNG.integers(0, 4, size=(10,))
+        for k in (None, 1, 2, 10):
+            assert_result_close(
+                F.hit_rate(jnp.asarray(x), jnp.asarray(t), k=k),
+                np.asarray(REF_F.hit_rate(torch.tensor(x), torch.tensor(t), k=k)),
+            )
+
+    def test_hit_rate_invalid(self):
+        with pytest.raises(ValueError, match="one-dimensional"):
+            F.hit_rate(jnp.ones((2, 2)), jnp.ones((2, 2)))
+        with pytest.raises(ValueError, match="positive"):
+            F.hit_rate(jnp.ones((2, 2)), jnp.zeros(2, dtype=jnp.int32), k=0)
+
+
+class TestReciprocalRank(MetricClassTester):
+    def test_reciprocal_rank_class(self):
+        inputs = [RNG.uniform(size=(6, 5)).astype(np.float32) for _ in range(8)]
+        targets = [RNG.integers(0, 5, size=(6,)) for _ in range(8)]
+        ref = REF_M.ReciprocalRank()
+        for x, t in zip(inputs, targets):
+            ref.update(torch.tensor(x), torch.tensor(t))
+        self.run_class_implementation_tests(
+            metric=ReciprocalRank(),
+            state_names={"scores"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=np.asarray(ref.compute()),
+        )
+
+    def test_reciprocal_rank_functional_topk(self):
+        x = RNG.uniform(size=(10, 4)).astype(np.float32)
+        t = RNG.integers(0, 4, size=(10,))
+        for k in (None, 2):
+            assert_result_close(
+                F.reciprocal_rank(jnp.asarray(x), jnp.asarray(t), k=k),
+                np.asarray(
+                    REF_F.reciprocal_rank(torch.tensor(x), torch.tensor(t), k=k)
+                ),
+            )
+
+
+class TestWeightedCalibration(MetricClassTester):
+    def test_weighted_calibration_class(self):
+        inputs = [RNG.uniform(size=(12,)).astype(np.float32) for _ in range(8)]
+        targets = [RNG.integers(0, 2, size=(12,)).astype(np.float32) for _ in range(8)]
+        weights = [RNG.uniform(0.1, 2.0, size=(12,)).astype(np.float32) for _ in range(8)]
+        ref = REF_M.WeightedCalibration()
+        for x, t, w in zip(inputs, targets, weights):
+            ref.update(torch.tensor(x), torch.tensor(t), torch.tensor(w))
+        self.run_class_implementation_tests(
+            metric=WeightedCalibration(),
+            state_names={"weighted_input_sum", "weighted_target_sum"},
+            update_kwargs={
+                "input": inputs,
+                "target": targets,
+                "weight": [jnp.asarray(w) for w in weights],
+            },
+            compute_result=np.asarray(ref.compute()),
+        )
+
+    def test_weighted_calibration_multitask_functional(self):
+        x = RNG.uniform(size=(2, 10)).astype(np.float32)
+        t = RNG.integers(0, 2, size=(2, 10)).astype(np.float32)
+        assert_result_close(
+            F.weighted_calibration(jnp.asarray(x), jnp.asarray(t), num_tasks=2),
+            np.asarray(
+                REF_F.weighted_calibration(torch.tensor(x), torch.tensor(t), num_tasks=2)
+            ),
+        )
+
+    def test_weighted_calibration_zero_target_returns_empty(self):
+        m = WeightedCalibration()
+        m.update(jnp.array([0.5, 0.5]), jnp.array([0.0, 0.0]))
+        assert m.compute().shape == (0,)
+
+
+class TestRetrievalPrecision(MetricClassTester):
+    def test_retrieval_precision_single_query(self):
+        inputs = [RNG.uniform(size=(8,)).astype(np.float32) for _ in range(8)]
+        targets = [RNG.integers(0, 2, size=(8,)).astype(np.float32) for _ in range(8)]
+        ref = REF_M.RetrievalPrecision(k=3)
+        for x, t in zip(inputs, targets):
+            ref.update(torch.tensor(x), torch.tensor(t))
+        self.run_class_implementation_tests(
+            metric=RetrievalPrecision(k=3),
+            state_names={"topk", "target"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=np.asarray(ref.compute()),
+        )
+
+    def test_retrieval_precision_multi_query(self):
+        inputs = [RNG.uniform(size=(10,)).astype(np.float32) for _ in range(4)]
+        targets = [RNG.integers(0, 2, size=(10,)).astype(np.float32) for _ in range(4)]
+        indexes = [RNG.integers(0, 3, size=(10,)) for _ in range(4)]
+        ref = REF_M.RetrievalPrecision(k=2, num_queries=3, avg="macro")
+        ours = RetrievalPrecision(k=2, num_queries=3, avg="macro")
+        for x, t, i in zip(inputs, targets, indexes):
+            ref.update(torch.tensor(x), torch.tensor(t), torch.tensor(i))
+            ours.update(jnp.asarray(x), jnp.asarray(t), jnp.asarray(i))
+        assert_result_close(ours.compute(), np.asarray(ref.compute()))
+
+    def test_retrieval_precision_merge(self):
+        xs = [RNG.uniform(size=(6,)).astype(np.float32) for _ in range(2)]
+        ts = [RNG.integers(0, 2, size=(6,)).astype(np.float32) for _ in range(2)]
+        ref_a = REF_M.RetrievalPrecision(k=2)
+        ref_b = REF_M.RetrievalPrecision(k=2)
+        ref_a.update(torch.tensor(xs[0]), torch.tensor(ts[0]))
+        ref_b.update(torch.tensor(xs[1]), torch.tensor(ts[1]))
+        ref_a.merge_state([ref_b])
+        a = RetrievalPrecision(k=2).update(jnp.asarray(xs[0]), jnp.asarray(ts[0]))
+        b = RetrievalPrecision(k=2).update(jnp.asarray(xs[1]), jnp.asarray(ts[1]))
+        a.merge_state([b])
+        assert_result_close(a.compute(), np.asarray(ref_a.compute()))
+
+    def test_retrieval_precision_functional(self):
+        x = np.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2], dtype=np.float32)
+        t = np.array([0, 0, 1, 1, 1, 0, 1], dtype=np.float32)
+        for kwargs in (
+            {},
+            {"k": 2},
+            {"k": 4},
+            {"k": 10},
+            {"k": 10, "limit_k_to_size": True},
+        ):
+            assert_result_close(
+                F.retrieval_precision(jnp.asarray(x), jnp.asarray(t), **kwargs),
+                np.asarray(
+                    REF_F.retrieval_precision(torch.tensor(x), torch.tensor(t), **kwargs)
+                ),
+            )
+
+    def test_retrieval_precision_empty_target_actions(self):
+        x = jnp.array([0.5, 0.3])
+        t = jnp.array([0.0, 0.0])
+        assert float(RetrievalPrecision(k=1).update(x, t).compute()[0]) == 0.0
+        assert (
+            float(
+                RetrievalPrecision(empty_target_action="pos", k=1)
+                .update(x, t)
+                .compute()[0]
+            )
+            == 1.0
+        )
+        assert np.isnan(
+            float(
+                RetrievalPrecision(empty_target_action="skip", k=1)
+                .update(x, t)
+                .compute()[0]
+            )
+        )
+        with pytest.raises(ValueError, match="no positive value"):
+            RetrievalPrecision(empty_target_action="err", k=1).update(x, t).compute()
+
+    def test_retrieval_precision_invalid_params(self):
+        with pytest.raises(ValueError, match="positive integer"):
+            RetrievalPrecision(k=0)
+        with pytest.raises(ValueError, match="limit_k_to_size"):
+            RetrievalPrecision(limit_k_to_size=True)
+        with pytest.raises(ValueError, match="empty_target_action"):
+            RetrievalPrecision(empty_target_action="bogus")
+        with pytest.raises(ValueError, match="indexes"):
+            RetrievalPrecision(num_queries=2).update(jnp.ones(2), jnp.ones(2))
+
+
+class TestFunctionalOnly:
+    def test_frequency_at_k(self):
+        x = RNG.uniform(size=(12,)).astype(np.float32)
+        assert_result_close(
+            F.frequency_at_k(jnp.asarray(x), 0.5),
+            np.asarray(REF_F.frequency_at_k(torch.tensor(x), 0.5)),
+        )
+        with pytest.raises(ValueError, match="negative"):
+            F.frequency_at_k(jnp.ones(3), -1.0)
+
+    def test_num_collisions(self):
+        x = np.array([3, 4, 1, 3, 1, 1, 5])
+        assert_result_close(
+            F.num_collisions(jnp.asarray(x)),
+            np.asarray(REF_F.num_collisions(torch.tensor(x))),
+        )
+        with pytest.raises(ValueError, match="integer"):
+            F.num_collisions(jnp.ones(3, dtype=jnp.float32))
+
+
+class TestReviewRegressions:
+    def test_out_of_range_indexes_ignored(self):
+        ours = RetrievalPrecision(k=2, num_queries=2)
+        ours.update(
+            jnp.array([0.5, 0.3, 0.9, 0.1]),
+            jnp.array([1.0, 0.0, 1.0, 1.0]),
+            jnp.array([0, 1, -1, 1]),
+        )
+        ref = REF_M.RetrievalPrecision(k=2, num_queries=2)
+        ref.update(
+            torch.tensor([0.5, 0.3, 0.9, 0.1]),
+            torch.tensor([1.0, 0.0, 1.0, 1.0]),
+            torch.tensor([0, 1, -1, 1]),
+        )
+        assert_result_close(ours.compute(), np.asarray(ref.compute()))
+
+    def test_num_tasks_validation(self):
+        with pytest.raises(ValueError, match="num_tasks"):
+            ClickThroughRate(num_tasks=0)
+        with pytest.raises(ValueError, match="num_tasks"):
+            WeightedCalibration(num_tasks=0)

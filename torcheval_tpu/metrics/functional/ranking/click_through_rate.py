@@ -1,0 +1,113 @@
+"""Click-through rate.
+
+Parity: reference torcheval/metrics/functional/ranking/click_through_rate.py
+(`click_through_rate` :13-57, `_click_through_rate_update` :60-75,
+`_click_through_rate_compute` :78-85 incl. the tiny-eps zero-weight guard,
+`_click_through_rate_input_check` :88-109).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import to_jax, to_jax_float
+
+
+@jax.jit
+def _ctr_update_weighted(
+    input: jax.Array, weights: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    weights = weights.astype(jnp.float32)
+    return jnp.sum(input * weights, axis=-1), jnp.sum(weights, axis=-1)
+
+
+@jax.jit
+def _ctr_update_scalar(
+    input: jax.Array, weight: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    click_total = weight * jnp.sum(input, axis=-1).astype(jnp.float32)
+    weight_total = weight * input.shape[-1] * jnp.ones_like(click_total)
+    return click_total, weight_total
+
+
+def _click_through_rate_update(
+    input, weights: Union[jax.Array, float, int] = 1.0, *, num_tasks: int
+) -> Tuple[jax.Array, jax.Array]:
+    input = to_jax(input)
+    is_scalar = isinstance(weights, (float, int))
+    weights_arr = None if is_scalar else to_jax_float(weights)
+    _click_through_rate_input_check(input, weights_arr, is_scalar, num_tasks=num_tasks)
+    if is_scalar:
+        return _ctr_update_scalar(input, jnp.float32(weights))
+    return _ctr_update_weighted(input, weights_arr)
+
+
+@jax.jit
+def _click_through_rate_compute(
+    click_total: jax.Array, weight_total: jax.Array
+) -> jax.Array:
+    # tiny-eps guard: zero weight (no events) yields CTR 0.0, not a NaN
+    eps = jnp.finfo(jnp.float32).tiny
+    return click_total / (weight_total + eps)
+
+
+def _click_through_rate_input_check(
+    input: jax.Array,
+    weights: Optional[jax.Array],
+    is_scalar_weight: bool,
+    *,
+    num_tasks: int,
+) -> None:
+    if input.ndim != 1 and input.ndim != 2:
+        raise ValueError(
+            "`input` should be a one or two dimensional tensor, got shape "
+            f"{input.shape}."
+        )
+    if not is_scalar_weight and weights.shape != input.shape:
+        raise ValueError(
+            "tensor `weights` should have the same shape as tensor `input`, "
+            f"got shapes {weights.shape} and {input.shape}, respectively."
+        )
+    if num_tasks == 1:
+        if input.ndim > 1:
+            raise ValueError(
+                "`num_tasks = 1`, `input` is expected to be one-dimensional "
+                f"tensor, but got shape ({input.shape})."
+            )
+    elif input.ndim == 1 or input.shape[0] != num_tasks:
+        raise ValueError(
+            f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
+            f"({num_tasks}, num_samples), but got shape ({input.shape})."
+        )
+
+
+def click_through_rate(
+    input,
+    weights: Optional[Union[jax.Array, float, int]] = None,
+    *,
+    num_tasks: int = 1,
+) -> jax.Array:
+    """Click-through rate from a series of click (1) / skip (0) events.
+
+    Class version: ``torcheval_tpu.metrics.ClickThroughRate``.
+
+    Args:
+        input: click events of shape (num_events,) or (num_tasks, num_events).
+        weights: optional per-event weights, same shape as input.
+        num_tasks: number of tasks.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import click_through_rate
+        >>> click_through_rate(jnp.array([0, 1, 0, 1, 1, 0, 0, 1]))
+        Array(0.5, dtype=float32)
+    """
+    if weights is None:
+        weights = 1.0
+    click_total, weight_total = _click_through_rate_update(
+        input, weights, num_tasks=num_tasks
+    )
+    return _click_through_rate_compute(click_total, weight_total)

@@ -1,0 +1,35 @@
+"""Frequency @ k.
+
+Parity: reference torcheval/metrics/functional/ranking/frequency.py
+(`frequency_at_k` :12-36, `_frequency_input_check` :39-47).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import to_jax
+
+
+def _frequency_input_check(input: jax.Array, k: float) -> None:
+    if input.ndim != 1:
+        raise ValueError(
+            f"input should be a one-dimensional tensor, got shape {input.shape}."
+        )
+    if k < 0:
+        raise ValueError(f"k should not be negative, got {k}.")
+
+
+def frequency_at_k(input, k: float) -> jax.Array:
+    """Binary indicator of which frequencies are below threshold ``k``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import frequency_at_k
+        >>> frequency_at_k(jnp.array([0.3, 0.1, 0.6]), k=0.5)
+        Array([1., 1., 0.], dtype=float32)
+    """
+    input = to_jax(input)
+    _frequency_input_check(input, k)
+    return (input < k).astype(jnp.float32)

@@ -1,0 +1,68 @@
+"""Hit rate @ k.
+
+Parity: reference torcheval/metrics/functional/ranking/hit_rate.py
+(`hit_rate` :12-45, `_hit_rate_input_check` :48-66). Uses the sort-free
+rank-count trick (count of strictly-greater scores) — same as the reference's
+gather/gt/sum, which is also the MXU/VPU-friendly formulation on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import to_jax
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _hit_rate_jit(input: jax.Array, target: jax.Array, k: int) -> jax.Array:
+    y_score = jnp.take_along_axis(input, target[:, None], axis=-1)
+    rank = jnp.sum(input > y_score, axis=-1)
+    return (rank < k).astype(jnp.float32)
+
+
+def _hit_rate_input_check(
+    input: jax.Array, target: jax.Array, k: Optional[int] = None
+) -> None:
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if input.ndim != 2:
+        raise ValueError(
+            f"input should be a two-dimensional tensor, got shape {input.shape}."
+        )
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "`input` and `target` should have the same minibatch dimension, "
+            f"got shapes {input.shape} and {target.shape}, respectively."
+        )
+    if k is not None and k <= 0:
+        raise ValueError(f"k should be None or positive, got {k}.")
+
+
+def hit_rate(input, target, *, k: Optional[int] = None) -> jax.Array:
+    """Per-example hit rate of the target class among the top-k predictions.
+
+    Class version: ``torcheval_tpu.metrics.HitRate``.
+
+    Args:
+        input: predicted scores of shape (num_samples, num_classes).
+        target: ground-truth class indices of shape (num_samples,).
+        k: number of top classes considered; None means all (hit rate 1.0).
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import hit_rate
+        >>> hit_rate(jnp.array([[0.3, 0.1, 0.6], [0.5, 0.2, 0.3]]),
+        ...          jnp.array([2, 1]), k=2)
+        Array([1., 0.], dtype=float32)
+    """
+    input, target = to_jax(input), to_jax(target)
+    _hit_rate_input_check(input, target, k)
+    if k is None or k >= input.shape[-1]:
+        return jnp.ones(target.shape, dtype=jnp.float32)
+    return _hit_rate_jit(input, target, k)

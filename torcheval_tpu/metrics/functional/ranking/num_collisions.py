@@ -1,0 +1,42 @@
+"""Number of id collisions.
+
+Parity: reference torcheval/metrics/functional/ranking/num_collisions.py
+(`num_collisions` :12-37, `_num_collisions_input_check` :40-55). The
+reference materializes an (N, N) repeat_interleave copy; here the pairwise
+equality is a single broadcast compare the XLA fusion keeps in registers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import to_jax
+
+
+@jax.jit
+def _num_collisions_jit(input: jax.Array) -> jax.Array:
+    return jnp.sum(input[None, :] == input[:, None], axis=1) - 1
+
+
+def _num_collisions_input_check(input: jax.Array) -> None:
+    if input.ndim != 1:
+        raise ValueError(
+            f"input should be a one-dimensional tensor, got shape {input.shape}."
+        )
+    if not jnp.issubdtype(input.dtype, jnp.integer):
+        raise ValueError(f"input should be an integer tensor, got {input.dtype}.")
+
+
+def num_collisions(input) -> jax.Array:
+    """Per-id count of other occurrences of the same id.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import num_collisions
+        >>> num_collisions(jnp.array([3, 4, 2, 3]))
+        Array([1, 0, 0, 1], dtype=int32)
+    """
+    input = to_jax(input)
+    _num_collisions_input_check(input)
+    return _num_collisions_jit(input)

@@ -1,0 +1,67 @@
+"""Reciprocal rank.
+
+Parity: reference torcheval/metrics/functional/ranking/reciprocal_rank.py
+(`reciprocal_rank` :12-47, `_reciprocal_rank_input_check` :50-63). Sort-free
+rank via strictly-greater count, jitted with the top-k cutoff folded into the
+same kernel (the reference mutates in place post-hoc).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import to_jax
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _reciprocal_rank_jit(
+    input: jax.Array, target: jax.Array, k: Optional[int]
+) -> jax.Array:
+    y_score = jnp.take_along_axis(input, target[:, None], axis=-1)
+    rank = jnp.sum(input > y_score, axis=-1)
+    score = 1.0 / (rank + 1.0)
+    if k is not None:
+        score = jnp.where(rank >= k, 0.0, score)
+    return score
+
+
+def _reciprocal_rank_input_check(input: jax.Array, target: jax.Array) -> None:
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if input.ndim != 2:
+        raise ValueError(
+            f"input should be a two-dimensional tensor, got shape {input.shape}."
+        )
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "`input` and `target` should have the same minibatch dimension, "
+            f"got shapes {input.shape} and {target.shape}, respectively."
+        )
+
+
+def reciprocal_rank(input, target, *, k: Optional[int] = None) -> jax.Array:
+    """Per-example reciprocal rank of the target class.
+
+    Class version: ``torcheval_tpu.metrics.ReciprocalRank``.
+
+    Args:
+        input: predicted scores of shape (num_samples, num_classes).
+        target: ground-truth class indices of shape (num_samples,).
+        k: consider only the top-k classes; examples ranked below k score 0.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import reciprocal_rank
+        >>> reciprocal_rank(jnp.array([[0.3, 0.1, 0.6], [0.5, 0.2, 0.3]]),
+        ...                 jnp.array([2, 1]))
+        Array([1.        , 0.33333334], dtype=float32)
+    """
+    input, target = to_jax(input), to_jax(target)
+    _reciprocal_rank_input_check(input, target)
+    return _reciprocal_rank_jit(input, target, k)

@@ -1,0 +1,122 @@
+"""Retrieval precision (precision @ k).
+
+Parity: reference torcheval/metrics/functional/ranking/retrieval_precision.py
+(`retrieval_precision` :7-83, `_retrieval_precision_param_check` :86-96,
+`_retrieval_precision_update_input_check` :99-119,
+`_retrieval_precision_compute`/`get_topk`/count helpers :122-162).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import to_jax
+
+
+def _retrieval_precision_param_check(
+    k: Optional[int] = None, limit_k_to_size: bool = False
+) -> None:
+    if k is not None and k <= 0:
+        raise ValueError(f"k must be a positive integer, got k={k}.")
+    if limit_k_to_size and k is None:
+        raise ValueError(
+            "when limit_k_to_size is True, k must be a positive (>0) integer."
+        )
+
+
+def _retrieval_precision_update_input_check(
+    input: jax.Array,
+    target: jax.Array,
+    num_tasks: int = 1,
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "input and target must be of the same shape, got "
+            f"input.shape={input.shape} and target.shape={target.shape}."
+        )
+    if num_tasks == 1:
+        if input.ndim != 1:
+            raise ValueError(
+                "input and target should be one dimensional tensors, "
+                f"got input and target dimensions={input.ndim}."
+            )
+    elif input.ndim != 2 or input.shape[0] != num_tasks:
+        raise ValueError(
+            "input and target should be two dimensional tensors with "
+            f"{num_tasks} rows, got input and target shape={input.shape}."
+        )
+
+
+@partial(jax.jit, static_argnames=("k",))
+def get_topk(t: jax.Array, k: Optional[int]) -> Tuple[jax.Array, jax.Array]:
+    """Top-k values and indices along the last axis (ties unordered, as in the
+    reference's ``torch.topk``)."""
+    nb_samples = t.shape[-1]
+    if k is None:
+        k = nb_samples
+    return jax.lax.top_k(t, min(k, nb_samples))
+
+
+def _compute_nb_relevant_items_retrieved(
+    input: jax.Array, k: Optional[int], target: jax.Array
+) -> jax.Array:
+    _, topk_idx = get_topk(input, k)
+    return jnp.sum(jnp.take_along_axis(target, topk_idx, axis=-1), axis=-1)
+
+
+def _compute_total_number_items_retrieved(
+    input: jax.Array, k: Optional[int] = None, limit_k_to_size: bool = False
+) -> int:
+    nb_samples = input.shape[-1]
+    if k is None:
+        return nb_samples
+    if limit_k_to_size:
+        return min(k, nb_samples)
+    return k
+
+
+def _retrieval_precision_compute(
+    input: jax.Array,
+    target: jax.Array,
+    k: Optional[int] = None,
+    limit_k_to_size: bool = False,
+) -> jax.Array:
+    nb_relevant = _compute_nb_relevant_items_retrieved(input, k, target)
+    nb_retrieved = _compute_total_number_items_retrieved(input, k, limit_k_to_size)
+    return nb_relevant / nb_retrieved
+
+
+def retrieval_precision(
+    input,
+    target,
+    k: Optional[int] = None,
+    limit_k_to_size: bool = False,
+    num_tasks: int = 1,
+) -> jax.Array:
+    """Proportion of relevant items among the top-k retrieved items.
+
+    Class version: ``torcheval_tpu.metrics.RetrievalPrecision``.
+
+    Args:
+        input: predicted relevance scores, shape (num_samples,) or
+            (num_tasks, num_samples).
+        target: 0/1 relevance labels, same shape.
+        k: number of retrieved elements considered (None = all).
+        limit_k_to_size: clamp k to the number of samples.
+        num_tasks: number of independent tasks (rows).
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import retrieval_precision
+        >>> retrieval_precision(jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2]),
+        ...                     jnp.array([0, 0, 1, 1, 1, 0, 1]), k=2)
+        Array(0.5, dtype=float32)
+    """
+    input, target = to_jax(input), to_jax(target)
+    _retrieval_precision_param_check(k, limit_k_to_size)
+    _retrieval_precision_update_input_check(input, target, num_tasks)
+    return _retrieval_precision_compute(input, target, k, limit_k_to_size)

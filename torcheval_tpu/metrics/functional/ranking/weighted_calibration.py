@@ -1,0 +1,97 @@
+"""Weighted calibration: sum(w * pred) / sum(w * label).
+
+Parity: reference torcheval/metrics/functional/ranking/weighted_calibration.py
+(`weighted_calibration` :12-57, `_weighted_calibration_update` :60-78,
+`_weighted_calibration_input_check` :93-113).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import to_jax, to_jax_float
+
+
+@jax.jit
+def _wc_update_scalar(
+    input: jax.Array, target: jax.Array, weight: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    return weight * jnp.sum(input, axis=-1), weight * jnp.sum(target, axis=-1)
+
+
+@jax.jit
+def _wc_update_tensor(
+    input: jax.Array, target: jax.Array, weight: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    return jnp.sum(weight * input, axis=-1), jnp.sum(weight * target, axis=-1)
+
+
+def _weighted_calibration_update(
+    input,
+    target,
+    weight: Union[float, int, jax.Array],
+    *,
+    num_tasks: int,
+) -> Tuple[jax.Array, jax.Array]:
+    input, target = to_jax_float(input), to_jax_float(target)
+    _weighted_calibration_input_check(input, target, weight, num_tasks)
+    if isinstance(weight, (float, int)):
+        return _wc_update_scalar(input, target, jnp.float32(weight))
+    weight = to_jax_float(weight)
+    if weight.shape == input.shape:
+        return _wc_update_tensor(input, target, weight)
+    raise ValueError(
+        "Weight must be either a float value or a tensor that matches the "
+        f"input tensor size. Got {weight} instead."
+    )
+
+
+def _weighted_calibration_input_check(
+    input: jax.Array,
+    target: jax.Array,
+    weight: Union[float, int, jax.Array],
+    num_tasks: int,
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            f"`input` shape ({input.shape}) is different from `target` shape "
+            f"({target.shape})"
+        )
+    if num_tasks == 1:
+        if input.ndim > 1:
+            raise ValueError(
+                "`num_tasks = 1`, `input` is expected to be one-dimensional "
+                f"tensor, but got shape ({input.shape})."
+            )
+    elif input.ndim == 1 or input.shape[0] != num_tasks:
+        raise ValueError(
+            f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
+            f"({num_tasks}, num_samples), but got shape ({input.shape})."
+        )
+
+
+def weighted_calibration(
+    input,
+    target,
+    weight: Union[float, int, jax.Array] = 1.0,
+    *,
+    num_tasks: int = 1,
+) -> jax.Array:
+    """Weighted calibration = sum(input * weight) / sum(target * weight).
+
+    Class version: ``torcheval_tpu.metrics.WeightedCalibration``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import weighted_calibration
+        >>> weighted_calibration(jnp.array([0.8, 0.4, 0.3, 0.8, 0.7, 0.6]),
+        ...                      jnp.array([1, 1, 0, 0, 1, 0]))
+        Array(1.2, dtype=float32)
+    """
+    weighted_input_sum, weighted_target_sum = _weighted_calibration_update(
+        input, target, weight, num_tasks=num_tasks
+    )
+    return weighted_input_sum / weighted_target_sum

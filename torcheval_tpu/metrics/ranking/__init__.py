@@ -1,0 +1,13 @@
+from torcheval_tpu.metrics.ranking.click_through_rate import ClickThroughRate
+from torcheval_tpu.metrics.ranking.hit_rate import HitRate
+from torcheval_tpu.metrics.ranking.reciprocal_rank import ReciprocalRank
+from torcheval_tpu.metrics.ranking.retrieval_precision import RetrievalPrecision
+from torcheval_tpu.metrics.ranking.weighted_calibration import WeightedCalibration
+
+__all__ = [
+    "ClickThroughRate",
+    "HitRate",
+    "ReciprocalRank",
+    "RetrievalPrecision",
+    "WeightedCalibration",
+]

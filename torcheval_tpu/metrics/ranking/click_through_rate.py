@@ -1,0 +1,71 @@
+"""ClickThroughRate class metric.
+
+Parity: reference torcheval/metrics/ranking/click_through_rate.py:23-113.
+Per-task counters sync with one psum. The reference holds float64 counters;
+we keep float32 on TPU (see SURVEY.md section 7 "hard parts") — CTR counters
+are bounded by event counts, well within f32 for realistic streams.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.ranking.click_through_rate import (
+    _click_through_rate_compute,
+    _click_through_rate_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TClickThroughRate = TypeVar("TClickThroughRate", bound="ClickThroughRate")
+
+
+class ClickThroughRate(Metric[jax.Array]):
+    """Weighted click-through rate, optionally multi-task.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import ClickThroughRate
+        >>> metric = ClickThroughRate()
+        >>> metric.update(jnp.array([0, 1, 0, 1, 1, 0, 0, 1]))
+        >>> metric.compute()
+        Array([0.5], dtype=float32)
+    """
+
+    def __init__(
+        self, *, num_tasks: int = 1, device: Optional[jax.Device] = None
+    ) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        self.num_tasks = num_tasks
+        self._add_state(
+            "click_total", jnp.zeros(num_tasks), merge=MergeKind.SUM
+        )
+        self._add_state(
+            "weight_total", jnp.zeros(num_tasks), merge=MergeKind.SUM
+        )
+
+    def update(
+        self: TClickThroughRate,
+        input,
+        weights: Union[jax.Array, float, int] = 1.0,
+    ) -> TClickThroughRate:
+        """Accumulate click events (and optional per-event weights)."""
+        if not isinstance(weights, (float, int)):
+            weights = self._input_float(weights)
+        click_total, weight_total = _click_through_rate_update(
+            self._input(input), weights, num_tasks=self.num_tasks
+        )
+        self.click_total = self.click_total + click_total
+        self.weight_total = self.weight_total + weight_total
+        return self
+
+    def compute(self) -> jax.Array:
+        """CTR per task; 0.0 for tasks with no updates."""
+        return _click_through_rate_compute(self.click_total, self.weight_total)

@@ -1,0 +1,55 @@
+"""HitRate class metric.
+
+Parity: reference torcheval/metrics/ranking/hit_rate.py:19-90. Buffers
+per-example scores; ``compute`` concatenates.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.ranking.hit_rate import hit_rate
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+THitRate = TypeVar("THitRate", bound="HitRate")
+
+
+class HitRate(Metric[jax.Array]):
+    """Concatenated per-example hit-rate scores.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import HitRate
+        >>> metric = HitRate(k=2)
+        >>> metric.update(jnp.array([[0.3, 0.1, 0.6], [0.5, 0.2, 0.3]]),
+        ...               jnp.array([2, 1]))
+        >>> metric.compute()
+        Array([1., 0.], dtype=float32)
+    """
+
+    def __init__(
+        self, *, k: Optional[int] = None, device: Optional[jax.Device] = None
+    ) -> None:
+        super().__init__(device=device)
+        self.k = k
+        self._add_state("scores", [], merge=MergeKind.EXTEND)
+
+    def update(self: THitRate, input, target) -> THitRate:
+        """Score one batch of predictions against targets."""
+        self.scores.append(
+            hit_rate(self._input(input), self._input(target), k=self.k)
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        """All per-example scores; empty array before any update."""
+        if not self.scores:
+            return jnp.zeros(0)
+        return jnp.concatenate(self.scores, axis=0)
+
+    def _prepare_for_merge_state(self) -> None:
+        if self.scores:
+            self.scores = [jnp.concatenate(self.scores, axis=0)]

@@ -1,0 +1,55 @@
+"""ReciprocalRank class metric.
+
+Parity: reference torcheval/metrics/ranking/reciprocal_rank.py:20-92. Buffers
+per-example reciprocal-rank scores (MRR = mean of compute()).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.ranking.reciprocal_rank import reciprocal_rank
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TReciprocalRank = TypeVar("TReciprocalRank", bound="ReciprocalRank")
+
+
+class ReciprocalRank(Metric[jax.Array]):
+    """Concatenated per-example reciprocal ranks.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import ReciprocalRank
+        >>> metric = ReciprocalRank()
+        >>> metric.update(jnp.array([[0.3, 0.1, 0.6], [0.5, 0.2, 0.3]]),
+        ...               jnp.array([2, 1]))
+        >>> metric.compute()
+        Array([1.        , 0.33333334], dtype=float32)
+    """
+
+    def __init__(
+        self, *, k: Optional[int] = None, device: Optional[jax.Device] = None
+    ) -> None:
+        super().__init__(device=device)
+        self.k = k
+        self._add_state("scores", [], merge=MergeKind.EXTEND)
+
+    def update(self: TReciprocalRank, input, target) -> TReciprocalRank:
+        """Score one batch of predictions against targets."""
+        self.scores.append(
+            reciprocal_rank(self._input(input), self._input(target), k=self.k)
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        """All per-example scores; empty array before any update."""
+        if not self.scores:
+            return jnp.zeros(0)
+        return jnp.concatenate(self.scores, axis=0)
+
+    def _prepare_for_merge_state(self) -> None:
+        if self.scores:
+            self.scores = [jnp.concatenate(self.scores, axis=0)]

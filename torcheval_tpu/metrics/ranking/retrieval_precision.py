@@ -1,0 +1,160 @@
+"""RetrievalPrecision class metric (multi-query precision @ k).
+
+Parity: reference torcheval/metrics/ranking/retrieval_precision.py:26-199.
+State is a *bounded* per-query buffer: after each update only the running
+top-k scores (and their labels) are kept (reference update_single_query
+:142-149), so the buffer never exceeds ``k`` entries per query — TPU-friendly
+by construction. Merge is per-query concatenation (reference :181-199);
+``compute`` re-ranks the merged buffers.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, TypeVar, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.functional.ranking.retrieval_precision import (
+    _retrieval_precision_compute,
+    _retrieval_precision_param_check,
+    _retrieval_precision_update_input_check,
+    get_topk,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TRetrievalPrecision = TypeVar("TRetrievalPrecision", bound="RetrievalPrecision")
+
+
+class RetrievalPrecision(Metric[jax.Array]):
+    """Retrieval precision @ k over one or more query streams.
+
+    Args:
+        empty_target_action: behavior for queries whose targets contain no
+            positive: ``neg`` -> 0.0, ``pos`` -> 1.0, ``skip`` -> NaN,
+            ``err`` -> raise.
+        k: number of retrieved elements considered (None = all).
+        limit_k_to_size: clamp k to the buffered size.
+        num_queries: number of independent query streams; updates route
+            samples with the ``indexes`` argument.
+        avg: ``macro`` averages over queries; ``none``/None returns the
+            per-query vector.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import RetrievalPrecision
+        >>> metric = RetrievalPrecision(k=2)
+        >>> metric.update(jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2]),
+        ...               jnp.array([0, 0, 1, 1, 1, 0, 1]))
+        >>> metric.compute()
+        Array([0.5], dtype=float32)
+    """
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        k: Optional[int] = None,
+        limit_k_to_size: bool = False,
+        num_queries: int = 1,
+        avg: Optional[str] = None,
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        _retrieval_precision_param_check(k, limit_k_to_size)
+        if empty_target_action not in ("neg", "pos", "skip", "err"):
+            raise ValueError(
+                "empty_target_action must be one of 'neg', 'pos', 'skip', "
+                f"'err', got {empty_target_action}."
+            )
+        if avg not in ("macro", "none", None):
+            raise ValueError(f"avg must be 'macro', 'none' or None, got {avg}.")
+        super().__init__(device=device)
+        self.empty_target_action = empty_target_action
+        self.num_queries = num_queries
+        self.k = k
+        self.limit_k_to_size = limit_k_to_size
+        self.avg = avg
+        self._add_state(
+            "topk", [jnp.zeros(0) for _ in range(num_queries)], merge=MergeKind.CUSTOM
+        )
+        self._add_state(
+            "target", [jnp.zeros(0) for _ in range(num_queries)], merge=MergeKind.CUSTOM
+        )
+
+    def update(
+        self: TRetrievalPrecision,
+        input,
+        target,
+        indexes=None,
+    ) -> TRetrievalPrecision:
+        """Accumulate scores/labels, routed per query by ``indexes``."""
+        input, target = self._input(input), self._input(target)
+        _retrieval_precision_update_input_check(input, target)
+        if self.num_queries == 1:
+            self._update_single_query(0, input, target)
+            return self
+        if indexes is None:
+            raise ValueError(
+                "`indexes` must be passed during update() when num_queries > 1."
+            )
+        # query routing is data-dependent (dynamic shapes) -> eager host-side
+        # partition, as in the reference's Python loop (reference :134-140)
+        # out-of-range indexes are ignored, as in the reference's
+        # `for i in range(num_queries): if i in indexes` loop (reference :138)
+        idx = np.asarray(indexes)
+        for i in np.unique(idx):
+            if 0 <= i < self.num_queries:
+                mask = idx == i
+                self._update_single_query(int(i), input[mask], target[mask])
+        return self
+
+    def _update_single_query(self, i: int, input: jax.Array, target: jax.Array) -> None:
+        batch_preds = jnp.concatenate([self.topk[i], input.astype(jnp.float32)])
+        batch_targets = jnp.concatenate([self.target[i], target.astype(jnp.float32)])
+        topk_vals, topk_idx = get_topk(batch_preds, self.k)
+        self.topk[i] = topk_vals
+        self.target[i] = jnp.take_along_axis(batch_targets, topk_idx, axis=-1)
+
+    def compute(self) -> jax.Array:
+        """Per-query retrieval precision, or its macro average."""
+        rp = []
+        for i in range(self.num_queries):
+            if self.target[i].shape[-1] == 0:
+                rp.append(jnp.array([jnp.nan]))
+            elif not bool(jnp.any(self.target[i] == 1)):
+                if self.empty_target_action == "pos":
+                    rp.append(jnp.array([1.0]))
+                elif self.empty_target_action == "neg":
+                    rp.append(jnp.array([0.0]))
+                elif self.empty_target_action == "skip":
+                    rp.append(jnp.array([jnp.nan]))
+                else:  # "err"
+                    raise ValueError(
+                        f"no positive value found in target={self.target[i]}."
+                    )
+            else:
+                rp.append(
+                    _retrieval_precision_compute(
+                        self.topk[i], self.target[i], self.k, self.limit_k_to_size
+                    ).reshape(-1)
+                )
+        result = jnp.concatenate(rp)
+        if self.avg == "macro":
+            return jnp.nanmean(result)
+        return result
+
+    def merge_state(
+        self: TRetrievalPrecision, metrics: Iterable[TRetrievalPrecision]
+    ) -> TRetrievalPrecision:
+        """Per-query buffer concatenation (reference :181-199)."""
+        metrics = list(metrics)
+        for i in range(self.num_queries):
+            self.topk[i] = jnp.concatenate(
+                [self.topk[i]]
+                + [jax.device_put(m.topk[i], self._device) for m in metrics]
+            )
+            self.target[i] = jnp.concatenate(
+                [self.target[i]]
+                + [jax.device_put(m.target[i], self._device) for m in metrics]
+            )
+        return self

@@ -1,0 +1,74 @@
+"""WeightedCalibration class metric.
+
+Parity: reference torcheval/metrics/ranking/weighted_calibration.py:20-123.
+Per-task counters (float32 on TPU; reference uses float64, see
+click_through_rate.py note).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.ranking.weighted_calibration import (
+    _weighted_calibration_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TWeightedCalibration = TypeVar("TWeightedCalibration", bound="WeightedCalibration")
+
+
+class WeightedCalibration(Metric[jax.Array]):
+    """sum(weight * input) / sum(weight * target), optionally multi-task.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import WeightedCalibration
+        >>> metric = WeightedCalibration()
+        >>> metric.update(jnp.array([0.8, 0.4, 0.3, 0.8, 0.7, 0.6]),
+        ...               jnp.array([1, 1, 0, 0, 1, 0]))
+        >>> metric.compute()
+        Array([1.2], dtype=float32)
+    """
+
+    def __init__(
+        self, *, num_tasks: int = 1, device: Optional[jax.Device] = None
+    ) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        self.num_tasks = num_tasks
+        self._add_state(
+            "weighted_input_sum", jnp.zeros(num_tasks), merge=MergeKind.SUM
+        )
+        self._add_state(
+            "weighted_target_sum", jnp.zeros(num_tasks), merge=MergeKind.SUM
+        )
+
+    def update(
+        self: TWeightedCalibration,
+        input,
+        target,
+        weight: Union[float, int, jax.Array] = 1.0,
+    ) -> TWeightedCalibration:
+        """Accumulate one batch of predictions / binary targets / weights."""
+        if not isinstance(weight, (float, int)):
+            weight = self._input_float(weight)
+        weighted_input_sum, weighted_target_sum = _weighted_calibration_update(
+            self._input(input), self._input(target), weight, num_tasks=self.num_tasks
+        )
+        self.weighted_input_sum = self.weighted_input_sum + weighted_input_sum
+        self.weighted_target_sum = self.weighted_target_sum + weighted_target_sum
+        return self
+
+    def compute(self) -> jax.Array:
+        """Calibration per task; empty array if any task has zero target sum
+        (reference weighted_calibration.py:104-105)."""
+        if bool(jnp.any(self.weighted_target_sum == 0.0)):
+            return jnp.zeros(0)
+        return self.weighted_input_sum / self.weighted_target_sum
