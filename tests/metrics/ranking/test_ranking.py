@@ -291,3 +291,15 @@ class TestReviewRegressions:
             ClickThroughRate(num_tasks=0)
         with pytest.raises(ValueError, match="num_tasks"):
             WeightedCalibration(num_tasks=0)
+
+    def test_debug_validation_target_range(self):
+        from torcheval_tpu.config import set_debug_validation
+
+        set_debug_validation(True)
+        try:
+            with pytest.raises(ValueError, match="target values"):
+                F.hit_rate(jnp.array([[0.3, 0.1, 0.6]]), jnp.array([5]), k=2)
+            with pytest.raises(ValueError, match="target values"):
+                F.reciprocal_rank(jnp.array([[0.3, 0.1, 0.6]]), jnp.array([5]))
+        finally:
+            set_debug_validation(False)

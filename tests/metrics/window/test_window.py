@@ -1,0 +1,272 @@
+"""Window metric tests vs the reference oracle. Windowed metrics have
+bespoke ring-buffer/merge semantics, so each test drives ours and the
+reference through identical update/merge sequences and compares outputs."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import (
+    WindowedBinaryAUROC,
+    WindowedBinaryNormalizedEntropy,
+    WindowedClickThroughRate,
+    WindowedMeanSquaredError,
+    WindowedWeightedCalibration,
+)
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+    assert_result_close,
+)
+
+REF_M, _ = load_reference_metrics()
+RNG = np.random.default_rng(23)
+
+
+def _drive(ours, ref, update_args_seq):
+    """Apply the same update sequence to both metrics, compare compute()."""
+    for args in update_args_seq:
+        ours.update(*[jnp.asarray(a) for a in args])
+        ref.update(*[torch.tensor(a) for a in args])
+    ours_result = ours.compute()
+    ref_result = ref.compute()
+    if isinstance(ref_result, tuple):
+        for o, r in zip(ours_result, ref_result):
+            assert_result_close(o, np.asarray(r), atol=1e-4, rtol=1e-4)
+    else:
+        assert_result_close(ours_result, np.asarray(ref_result), atol=1e-4, rtol=1e-4)
+
+
+class TestWindowedClickThroughRate(MetricClassTester):
+    @pytest.mark.parametrize("enable_lifetime", [True, False])
+    @pytest.mark.parametrize("n_updates", [2, 3, 7])
+    def test_windowed_ctr(self, enable_lifetime, n_updates):
+        updates = [
+            (RNG.integers(0, 2, size=(8,)).astype(np.float32),)
+            for _ in range(n_updates)
+        ]
+        _drive(
+            WindowedClickThroughRate(
+                max_num_updates=3, enable_lifetime=enable_lifetime
+            ),
+            REF_M.WindowedClickThroughRate(
+                max_num_updates=3, enable_lifetime=enable_lifetime
+            ),
+            updates,
+        )
+
+    def test_windowed_ctr_harness(self):
+        inputs = [RNG.integers(0, 2, size=(8,)).astype(np.float32) for _ in range(8)]
+        ref = REF_M.WindowedClickThroughRate(max_num_updates=4)
+        for x in inputs:
+            ref.update(torch.tensor(x))
+        expected = tuple(np.asarray(r) for r in ref.compute())
+        # merge path: reference merge concatenates each replica's window
+        # (2 updates per rank < max 4, so every rank's columns survive)
+        ref_ranks = [REF_M.WindowedClickThroughRate(max_num_updates=4) for _ in range(4)]
+        for i, x in enumerate(inputs):
+            ref_ranks[i // 2].update(torch.tensor(x))
+        ref_ranks[0].merge_state(ref_ranks[1:])
+        merge_expected = tuple(np.asarray(r) for r in ref_ranks[0].compute())
+        self.run_class_implementation_tests(
+            metric=WindowedClickThroughRate(max_num_updates=4),
+            state_names={
+                "max_num_updates",
+                "total_updates",
+                "click_total",
+                "weight_total",
+                "windowed_click_total",
+                "windowed_weight_total",
+            },
+            update_kwargs={"input": inputs},
+            compute_result=expected,
+            merge_and_compute_result=merge_expected,
+        )
+
+    def test_windowed_ctr_multitask(self):
+        updates = [
+            (RNG.integers(0, 2, size=(2, 6)).astype(np.float32),) for _ in range(5)
+        ]
+        _drive(
+            WindowedClickThroughRate(num_tasks=2, max_num_updates=2),
+            REF_M.WindowedClickThroughRate(num_tasks=2, max_num_updates=2),
+            updates,
+        )
+
+
+class TestWindowedNormalizedEntropy(MetricClassTester):
+    @pytest.mark.parametrize("enable_lifetime", [True, False])
+    def test_windowed_ne(self, enable_lifetime):
+        updates = [
+            (
+                RNG.uniform(0.1, 0.9, size=(6,)).astype(np.float32),
+                RNG.integers(0, 2, size=(6,)).astype(np.float32),
+            )
+            for _ in range(5)
+        ]
+        _drive(
+            WindowedBinaryNormalizedEntropy(
+                max_num_updates=2, enable_lifetime=enable_lifetime
+            ),
+            REF_M.WindowedBinaryNormalizedEntropy(
+                max_num_updates=2, enable_lifetime=enable_lifetime
+            ),
+            updates,
+        )
+
+    def test_windowed_ne_multitask_merge(self):
+        def make(ref=False):
+            cls = (
+                REF_M.WindowedBinaryNormalizedEntropy
+                if ref
+                else WindowedBinaryNormalizedEntropy
+            )
+            return cls(num_tasks=2, max_num_updates=3)
+
+        updates = [
+            (
+                RNG.uniform(0.1, 0.9, size=(2, 4)).astype(np.float32),
+                RNG.integers(0, 2, size=(2, 4)).astype(np.float32),
+            )
+            for _ in range(4)
+        ]
+        ours_a, ours_b = make(), make()
+        ref_a, ref_b = make(ref=True), make(ref=True)
+        for x, t in updates[:2]:
+            ours_a.update(jnp.asarray(x), jnp.asarray(t))
+            ref_a.update(torch.tensor(x), torch.tensor(t))
+        for x, t in updates[2:]:
+            ours_b.update(jnp.asarray(x), jnp.asarray(t))
+            ref_b.update(torch.tensor(x), torch.tensor(t))
+        ours_a.merge_state([ours_b])
+        ref_a.merge_state([ref_b])
+        for o, r in zip(ours_a.compute(), ref_a.compute()):
+            assert_result_close(o, np.asarray(r), atol=1e-4, rtol=1e-4)
+        # merged metric remains updatable, cursor wraps identically
+        x, t = updates[0]
+        ours_a.update(jnp.asarray(x), jnp.asarray(t))
+        ref_a.update(torch.tensor(x), torch.tensor(t))
+        for o, r in zip(ours_a.compute(), ref_a.compute()):
+            assert_result_close(o, np.asarray(r), atol=1e-4, rtol=1e-4)
+
+
+class TestWindowedMeanSquaredError(MetricClassTester):
+    @pytest.mark.parametrize("enable_lifetime", [True, False])
+    @pytest.mark.parametrize("n_updates", [1, 4])
+    def test_windowed_mse(self, enable_lifetime, n_updates):
+        updates = [
+            (
+                RNG.uniform(size=(6,)).astype(np.float32),
+                RNG.uniform(size=(6,)).astype(np.float32),
+            )
+            for _ in range(n_updates)
+        ]
+        _drive(
+            WindowedMeanSquaredError(
+                max_num_updates=2, enable_lifetime=enable_lifetime
+            ),
+            REF_M.WindowedMeanSquaredError(
+                max_num_updates=2, enable_lifetime=enable_lifetime
+            ),
+            updates,
+        )
+
+    def test_windowed_mse_multitask(self):
+        updates = [
+            (
+                RNG.uniform(size=(5, 3)).astype(np.float32),
+                RNG.uniform(size=(5, 3)).astype(np.float32),
+            )
+            for _ in range(4)
+        ]
+        _drive(
+            WindowedMeanSquaredError(num_tasks=3, max_num_updates=2),
+            REF_M.WindowedMeanSquaredError(num_tasks=3, max_num_updates=2),
+            updates,
+        )
+
+    def test_windowed_mse_num_tasks_shape_check(self):
+        m = WindowedMeanSquaredError(num_tasks=2)
+        with pytest.raises(ValueError, match="num_tasks = 2"):
+            m.update(jnp.ones(4), jnp.ones(4))
+        with pytest.raises(ValueError, match="num_tasks = 1"):
+            WindowedMeanSquaredError().update(jnp.ones((4, 2)), jnp.ones((4, 2)))
+
+
+class TestWindowedWeightedCalibration(MetricClassTester):
+    @pytest.mark.parametrize("enable_lifetime", [True, False])
+    def test_windowed_wc(self, enable_lifetime):
+        updates = [
+            (
+                RNG.uniform(size=(6,)).astype(np.float32),
+                RNG.integers(0, 2, size=(6,)).astype(np.float32),
+            )
+            for _ in range(5)
+        ]
+        _drive(
+            WindowedWeightedCalibration(
+                max_num_updates=2, enable_lifetime=enable_lifetime
+            ),
+            REF_M.WindowedWeightedCalibration(
+                max_num_updates=2, enable_lifetime=enable_lifetime
+            ),
+            updates,
+        )
+
+
+class TestWindowedBinaryAUROC(MetricClassTester):
+    @pytest.mark.parametrize("batch", [3, 5, 11])
+    def test_windowed_auroc_insert_cases(self, batch):
+        # batches chosen to hit: fits-in-rest, wraps, oversized (>= max 10)
+        updates = [
+            (
+                RNG.uniform(size=(batch,)).astype(np.float32),
+                RNG.integers(0, 2, size=(batch,)).astype(np.float32),
+            )
+            for _ in range(4)
+        ]
+        _drive(
+            WindowedBinaryAUROC(max_num_samples=10),
+            REF_M.WindowedBinaryAUROC(max_num_samples=10),
+            updates,
+        )
+
+    def test_windowed_auroc_multitask(self):
+        updates = [
+            (
+                RNG.uniform(size=(2, 4)).astype(np.float32),
+                RNG.integers(0, 2, size=(2, 4)).astype(np.float32),
+            )
+            for _ in range(3)
+        ]
+        _drive(
+            WindowedBinaryAUROC(num_tasks=2, max_num_samples=6),
+            REF_M.WindowedBinaryAUROC(num_tasks=2, max_num_samples=6),
+            updates,
+        )
+
+    def test_windowed_auroc_merge(self):
+        def pair():
+            return (
+                RNG.uniform(size=(4,)).astype(np.float32),
+                RNG.integers(0, 2, size=(4,)).astype(np.float32),
+            )
+
+        ours = [WindowedBinaryAUROC(max_num_samples=6) for _ in range(3)]
+        refs = [REF_M.WindowedBinaryAUROC(max_num_samples=6) for _ in range(3)]
+        for o, r in zip(ours, refs):
+            x, t = pair()
+            o.update(jnp.asarray(x), jnp.asarray(t))
+            r.update(torch.tensor(x), torch.tensor(t))
+        ours[0].merge_state(ours[1:])
+        refs[0].merge_state(refs[1:])
+        assert_result_close(
+            ours[0].compute(), np.asarray(refs[0].compute()), atol=1e-4, rtol=1e-4
+        )
+
+    def test_windowed_auroc_param_validation(self):
+        with pytest.raises(ValueError, match="num_tasks"):
+            WindowedBinaryAUROC(num_tasks=0)
+        with pytest.raises(ValueError, match="max_num_samples"):
+            WindowedBinaryAUROC(max_num_samples=0)

@@ -14,7 +14,22 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.config import debug_validation_enabled
 from torcheval_tpu.utils.convert import to_jax
+
+
+def _debug_check_target_range(input: jax.Array, target: jax.Array) -> None:
+    """Value-level label validation (forces a host sync, so debug-tier only;
+    the reference's gather raises eagerly on out-of-range targets, which a
+    jitted take_along_axis would silently clamp instead)."""
+    if not debug_validation_enabled():
+        return
+    lo, hi = int(jnp.min(target)), int(jnp.max(target))
+    if lo < 0 or hi >= input.shape[-1]:
+        raise ValueError(
+            f"target values must be in [0, {input.shape[-1]}), got range "
+            f"[{lo}, {hi}]."
+        )
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -63,6 +78,7 @@ def hit_rate(input, target, *, k: Optional[int] = None) -> jax.Array:
     """
     input, target = to_jax(input), to_jax(target)
     _hit_rate_input_check(input, target, k)
+    _debug_check_target_range(input, target)
     if k is None or k >= input.shape[-1]:
         return jnp.ones(target.shape, dtype=jnp.float32)
     return _hit_rate_jit(input, target, k)

@@ -14,6 +14,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional.ranking.hit_rate import (
+    _debug_check_target_range,
+)
 from torcheval_tpu.utils.convert import to_jax
 
 
@@ -64,4 +67,5 @@ def reciprocal_rank(input, target, *, k: Optional[int] = None) -> jax.Array:
     """
     input, target = to_jax(input), to_jax(target)
     _reciprocal_rank_input_check(input, target)
+    _debug_check_target_range(input, target)
     return _reciprocal_rank_jit(input, target, k)

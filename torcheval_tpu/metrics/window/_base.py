@@ -1,0 +1,145 @@
+"""Shared machinery for windowed metrics.
+
+The reference implements five windowed metrics, four of which
+(CTR / NE / MSE / WeightedCalibration, reference torcheval/metrics/window/)
+share one structure: per-``update()`` sufficient statistics are written into a
+fixed-shape (num_tasks, max_num_updates) ring buffer — the windowed value is
+computed from the buffer's column sums, and an optional lifetime accumulator
+runs alongside (e.g. reference window/normalized_entropy.py:118-144 update,
+:232-296 merge). The reference duplicates the cursor/merge logic per class;
+here it lives once.
+
+TPU notes: the ring buffer is exactly the fixed-shape state XLA wants — a
+column write is one ``dynamic_update_slice`` and the windowed sums reduce the
+whole buffer (unfilled columns are zero, so full-buffer sums equal the
+reference's valid-prefix sums, reference window/mean_squared_error.py:168-169
+relies on the same invariant). Merge packs valid columns of all replicas into
+an enlarged buffer, matching the reference's concatenating merge; column
+*order* never matters because every consumer is a sum.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TWindowed = TypeVar("TWindowed", bound="WindowedTaskCounterMetric")
+
+
+class WindowedTaskCounterMetric(Metric):
+    """Base for windowed metrics whose state is per-update counters.
+
+    Subclasses call ``_init_window_states(counter_names, ...)`` in
+    ``__init__``, feed each update's counter values through ``_record``, and
+    build ``compute`` from ``_windowed_counter_sums`` / the lifetime states.
+    """
+
+    def _init_window_states(
+        self,
+        counter_names: Sequence[str],
+        *,
+        num_tasks: int,
+        max_num_updates: int,
+        enable_lifetime: bool,
+        lifetime_defaults: Optional[Sequence] = None,
+    ) -> None:
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        if max_num_updates < 1:
+            raise ValueError(
+                "`max_num_updates` value should be greater than and equal to "
+                f"1, but received {max_num_updates}. "
+            )
+        self.num_tasks = num_tasks
+        self.enable_lifetime = enable_lifetime
+        self._counter_names = tuple(counter_names)
+        self._add_state("max_num_updates", max_num_updates, merge=MergeKind.CUSTOM)
+        self.next_inserted = 0
+        self._add_state("total_updates", 0, merge=MergeKind.CUSTOM)
+        if enable_lifetime:
+            if lifetime_defaults is None:
+                lifetime_defaults = [jnp.zeros(num_tasks) for _ in counter_names]
+            for name, default in zip(counter_names, lifetime_defaults):
+                self._add_state(name, default, merge=MergeKind.CUSTOM)
+        for name in counter_names:
+            self._add_state(
+                f"windowed_{name}",
+                jnp.zeros((num_tasks, max_num_updates)),
+                merge=MergeKind.CUSTOM,
+            )
+
+    # ------------------------------------------------------------- accumulate
+
+    def _record(self, counter_values: Sequence[jax.Array]) -> None:
+        """Write one update's counters into the ring (and lifetime) states."""
+        if self.enable_lifetime:
+            for name, value in zip(self._counter_names, counter_values):
+                # `+` broadcasts the reference's scalar->vector state
+                # promotion (reference window/mean_squared_error.py:141-145)
+                setattr(self, name, getattr(self, name) + value)
+        col = self.next_inserted
+        for name, value in zip(self._counter_names, counter_values):
+            buf = getattr(self, f"windowed_{name}")
+            setattr(self, f"windowed_{name}", buf.at[:, col].set(value))
+        self.next_inserted = (col + 1) % self.max_num_updates
+        self.total_updates += 1
+
+    def _windowed_counter_sums(self) -> List[jax.Array]:
+        """Per-task sums over the window, shape (num_tasks,) each."""
+        return [
+            jnp.sum(getattr(self, f"windowed_{name}"), axis=-1)
+            for name in self._counter_names
+        ]
+
+    # ------------------------------------------------------------------ merge
+
+    def merge_state(self: TWindowed, metrics: Iterable[TWindowed]) -> TWindowed:
+        """Pack all replicas' valid window columns into an enlarged buffer
+        (reference window/normalized_entropy.py:232-296). ``max_num_updates``
+        itself is unchanged, matching the reference: the merged metric's
+        *window* keeps its own size while the merged buffer holds every
+        replica's live columns."""
+        metrics = list(metrics)
+        merged_cols = self.max_num_updates + sum(m.max_num_updates for m in metrics)
+        cur_size = min(self.total_updates, self.max_num_updates)
+        new_bufs = {}
+        for name in self._counter_names:
+            buf = jnp.zeros((self.num_tasks, merged_cols))
+            mine = getattr(self, f"windowed_{name}")
+            new_bufs[name] = buf.at[:, :cur_size].set(mine[:, :cur_size])
+        idx = cur_size
+        for m in metrics:
+            if self.enable_lifetime:
+                for name in self._counter_names:
+                    setattr(
+                        self,
+                        name,
+                        getattr(self, name)
+                        + jax.device_put(getattr(m, name), self._device),
+                    )
+            size = min(m.total_updates, m.max_num_updates)
+            for name in self._counter_names:
+                theirs = jax.device_put(
+                    getattr(m, f"windowed_{name}")[:, :size], self._device
+                )
+                new_bufs[name] = new_bufs[name].at[:, idx : idx + size].set(theirs)
+            idx += size
+            self.total_updates += m.total_updates
+        for name in self._counter_names:
+            setattr(self, f"windowed_{name}", new_bufs[name])
+        self.next_inserted = idx % self.max_num_updates
+        return self
+
+    # ---------------------------------------------------------------- compute
+
+    def _empty_result(self):
+        if self.enable_lifetime:
+            return jnp.zeros(0), jnp.zeros(0)
+        return jnp.zeros(0)

@@ -1,0 +1,83 @@
+"""WindowedClickThroughRate.
+
+Parity: reference torcheval/metrics/window/click_through_rate.py:23-215.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, TypeVar, Union
+
+import jax
+
+from torcheval_tpu.metrics.functional.ranking.click_through_rate import (
+    _click_through_rate_compute,
+    _click_through_rate_update,
+)
+from torcheval_tpu.metrics.window._base import WindowedTaskCounterMetric
+
+TWindowedClickThroughRate = TypeVar(
+    "TWindowedClickThroughRate", bound="WindowedClickThroughRate"
+)
+
+
+class WindowedClickThroughRate(
+    WindowedTaskCounterMetric
+):
+    """CTR over the last ``max_num_updates`` updates (+ optional lifetime).
+
+    ``compute()`` returns ``(lifetime, windowed)`` when
+    ``enable_lifetime=True``, else just the windowed value.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import WindowedClickThroughRate
+        >>> metric = WindowedClickThroughRate(max_num_updates=2)
+        >>> metric.update(jnp.array([0., 1., 1., 1.]))
+        >>> metric.update(jnp.array([0., 1., 0., 1.]))
+        >>> metric.update(jnp.array([0., 0., 0., 1.]))
+        >>> metric.compute()
+        (Array([0.5833...], dtype=float32), Array([0.375], dtype=float32))
+    """
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        max_num_updates: int = 100,
+        enable_lifetime: bool = True,
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        super().__init__(device=device)
+        self._init_window_states(
+            ("click_total", "weight_total"),
+            num_tasks=num_tasks,
+            max_num_updates=max_num_updates,
+            enable_lifetime=enable_lifetime,
+        )
+
+    def update(
+        self: TWindowedClickThroughRate,
+        input,
+        weights: Union[jax.Array, float, int] = 1.0,
+    ) -> TWindowedClickThroughRate:
+        """Accumulate one update's click events into the window."""
+        if not isinstance(weights, (float, int)):
+            weights = self._input_float(weights)
+        click_total, weight_total = _click_through_rate_update(
+            self._input(input), weights, num_tasks=self.num_tasks
+        )
+        self._record((click_total, weight_total))
+        return self
+
+    def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
+        """Windowed (and lifetime) CTR per task; empty before any update."""
+        if self.total_updates == 0:
+            return self._empty_result()
+        click_sum, weight_sum = self._windowed_counter_sums()
+        windowed = _click_through_rate_compute(click_sum, weight_sum)
+        if self.enable_lifetime:
+            lifetime = _click_through_rate_compute(
+                self.click_total, self.weight_total
+            )
+            return lifetime, windowed
+        return windowed

@@ -1,0 +1,105 @@
+"""WindowedMeanSquaredError.
+
+Parity: reference torcheval/metrics/window/mean_squared_error.py:23-265.
+Note the reference's windowed-MSE task layout is (num_samples, num_tasks)
+columns (reference :255-264), unlike CTR/NE's (num_tasks, num_samples) rows.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, TypeVar, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.regression.mean_squared_error import (
+    _mean_squared_error_compute,
+    _mean_squared_error_param_check,
+    _mean_squared_error_update,
+)
+from torcheval_tpu.metrics.window._base import WindowedTaskCounterMetric
+
+TWindowedMeanSquaredError = TypeVar(
+    "TWindowedMeanSquaredError", bound="WindowedMeanSquaredError"
+)
+
+
+class WindowedMeanSquaredError(WindowedTaskCounterMetric):
+    """MSE over the last ``max_num_updates`` updates (+ optional lifetime).
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import WindowedMeanSquaredError
+        >>> metric = WindowedMeanSquaredError(max_num_updates=2)
+        >>> metric.update(jnp.array([0.9, 0.5]), jnp.array([0.5, 0.8]))
+        >>> metric.update(jnp.array([0.3, 0.5]), jnp.array([0.2, 0.8]))
+        >>> metric.compute()
+        (Array(0.0875, dtype=float32), Array(0.0875, dtype=float32))
+    """
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        max_num_updates: int = 100,
+        enable_lifetime: bool = True,
+        multioutput: str = "uniform_average",
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        super().__init__(device=device)
+        _mean_squared_error_param_check(multioutput)
+        self.multioutput = multioutput
+        self._init_window_states(
+            ("sum_squared_error", "sum_weight"),
+            num_tasks=num_tasks,
+            max_num_updates=max_num_updates,
+            enable_lifetime=enable_lifetime,
+            # scalar lifetime defaults: broadcast-promote to per-output
+            # vectors on first multioutput update (reference :92-96, 141-145)
+            lifetime_defaults=(jnp.zeros(()), jnp.zeros(())),
+        )
+
+    def _window_input_check(self, input: jax.Array) -> None:
+        if self.num_tasks == 1:
+            if input.ndim > 1:
+                raise ValueError(
+                    "`num_tasks = 1`, `input` is expected to be "
+                    f"one-dimensional tensor, but got shape ({input.shape})."
+                )
+        elif input.ndim == 1 or input.shape[1] != self.num_tasks:
+            raise ValueError(
+                f"`num_tasks = {self.num_tasks}`, `input`'s shape is expected "
+                f"to be (num_samples, {self.num_tasks}), but got shape "
+                f"({input.shape})."
+            )
+
+    def update(
+        self: TWindowedMeanSquaredError,
+        input,
+        target,
+        *,
+        sample_weight: Optional[jax.Array] = None,
+    ) -> TWindowedMeanSquaredError:
+        """Accumulate one batch's squared-error sums into the window."""
+        input, target = self._input_float(input), self._input_float(target)
+        sum_squared_error, sum_weight = _mean_squared_error_update(
+            input, target, sample_weight
+        )
+        self._window_input_check(input)
+        self._record((sum_squared_error, sum_weight))
+        return self
+
+    def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
+        """Windowed (and lifetime) MSE; empty before any update."""
+        if self.total_updates == 0:
+            return self._empty_result()
+        sse_sum, weight_sum = self._windowed_counter_sums()
+        windowed = _mean_squared_error_compute(
+            sse_sum, self.multioutput, weight_sum
+        ).squeeze()
+        if self.enable_lifetime:
+            lifetime = _mean_squared_error_compute(
+                self.sum_squared_error, self.multioutput, self.sum_weight
+            ).squeeze()
+            return lifetime, windowed
+        return windowed

@@ -1,0 +1,82 @@
+"""WindowedWeightedCalibration.
+
+Parity: reference torcheval/metrics/window/weighted_calibration.py:20-252
+(note its eps-clamped denominator, :160-176 — unlike the non-windowed class,
+zero target sums yield a large finite value rather than an empty tensor).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, TypeVar, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.ranking.weighted_calibration import (
+    _weighted_calibration_update,
+)
+from torcheval_tpu.metrics.window._base import WindowedTaskCounterMetric
+
+TWindowedWeightedCalibration = TypeVar(
+    "TWindowedWeightedCalibration", bound="WindowedWeightedCalibration"
+)
+
+_EPS = float(jnp.finfo(jnp.float64).eps)
+
+
+class WindowedWeightedCalibration(WindowedTaskCounterMetric):
+    """Weighted calibration over the last ``max_num_updates`` updates.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import WindowedWeightedCalibration
+        >>> metric = WindowedWeightedCalibration(max_num_updates=2,
+        ...                                      enable_lifetime=False)
+        >>> metric.update(jnp.array([0.8, 0.4]), jnp.array([1., 1.]))
+        >>> metric.compute()
+        Array([0.6], dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        max_num_updates: int = 100,
+        enable_lifetime: bool = True,
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        super().__init__(device=device)
+        self._init_window_states(
+            ("weighted_input_sum", "weighted_target_sum"),
+            num_tasks=num_tasks,
+            max_num_updates=max_num_updates,
+            enable_lifetime=enable_lifetime,
+        )
+
+    def update(
+        self: TWindowedWeightedCalibration,
+        input,
+        target,
+        weight: Union[float, int, jax.Array] = 1.0,
+    ) -> TWindowedWeightedCalibration:
+        """Accumulate one batch into the window."""
+        if not isinstance(weight, (float, int)):
+            weight = self._input_float(weight)
+        sums = _weighted_calibration_update(
+            self._input(input), self._input(target), weight, num_tasks=self.num_tasks
+        )
+        self._record(sums)
+        return self
+
+    def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
+        """Windowed (and lifetime) calibration; empty before any update."""
+        if self.total_updates == 0:
+            return self._empty_result()
+        input_sum, target_sum = self._windowed_counter_sums()
+        windowed = input_sum / jnp.maximum(target_sum, _EPS)
+        if self.enable_lifetime:
+            lifetime = self.weighted_input_sum / jnp.maximum(
+                self.weighted_target_sum, _EPS
+            )
+            return lifetime, windowed
+        return windowed
