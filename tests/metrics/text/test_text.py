@@ -1,0 +1,276 @@
+"""Text metric tests (BLEU, Perplexity, WER, WIL, WIP) vs the reference
+oracle, via the shared MetricClassTester harness."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import (
+    BLEUScore,
+    Perplexity,
+    WordErrorRate,
+    WordInformationLost,
+    WordInformationPreserved,
+)
+from torcheval_tpu.metrics import functional as F
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+    assert_result_close,
+)
+
+REF_M, REF_F = load_reference_metrics()
+RNG = np.random.default_rng(11)
+
+CANDIDATES = [
+    "the squirrel is eating the nut",
+    "the cat is on the mat",
+    "i like ice cream and apple pie",
+    "the quick brown fox jumps over the lazy dog",
+    "hello world how are you doing today",
+    "a stitch in time saves nine they say",
+    "to be or not to be that is the question",
+    "all that glitters is not gold my friend",
+]
+REFERENCES = [
+    ["a squirrel is eating a nut", "the squirrel is eating a tasty nut"],
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["i like apple pie with ice cream on top", "i like ice cream with my apple pie"],
+    ["a quick brown fox jumped over the lazy dog"],
+    ["hello world how are you today", "hi world how are you doing"],
+    ["a stitch in time saves nine", "they say a stitch in time saves nine"],
+    ["to be or not to be that is a question"],
+    ["all that glitters is not gold", "everything that glitters is not gold"],
+]
+PREDS = [
+    "this is the prediction",
+    "there is an other sample",
+    "hello world",
+    "welcome to the facebook",
+    "the weather is nice today",
+    "speech recognition systems are imperfect",
+    "one two three four five",
+    "jax runs on tensor processing units",
+]
+TARGETS = [
+    "this is the reference",
+    "there is another one",
+    "hello metaverse",
+    "welcome to meta",
+    "the weather was nice yesterday",
+    "speech recognition systems are not perfect",
+    "one two three four five six",
+    "jax runs well on tensor processing units",
+]
+
+
+class TestBLEUScore(MetricClassTester):
+    def _ref_bleu(self, n_gram, weights=None):
+        metric = REF_M.BLEUScore(n_gram=n_gram, weights=weights)
+        for i in range(0, 8, 2):
+            metric.update(CANDIDATES[i : i + 2], REFERENCES[i : i + 2])
+        return np.asarray(metric.compute())
+
+    @pytest.mark.parametrize("n_gram", [1, 2, 3, 4])
+    def test_bleu(self, n_gram):
+        self.run_class_implementation_tests(
+            metric=BLEUScore(n_gram=n_gram),
+            state_names={
+                "input_len",
+                "target_len",
+                "matches_by_order",
+                "possible_matches_by_order",
+            },
+            update_kwargs={
+                "input": [[c] for c in CANDIDATES],
+                "target": [[r] for r in REFERENCES],
+            },
+            compute_result=self._ref_bleu(n_gram),
+        )
+
+    def test_bleu_weights(self):
+        weights = [0.1, 0.2, 0.3, 0.4]
+        self.run_class_implementation_tests(
+            metric=BLEUScore(n_gram=4, weights=jnp.array(weights)),
+            state_names={
+                "input_len",
+                "target_len",
+                "matches_by_order",
+                "possible_matches_by_order",
+            },
+            update_kwargs={
+                "input": [[c] for c in CANDIDATES],
+                "target": [[r] for r in REFERENCES],
+            },
+            compute_result=self._ref_bleu(4, torch.tensor(weights)),
+        )
+
+    def test_bleu_functional(self):
+        ours = F.bleu_score(CANDIDATES, REFERENCES, n_gram=4)
+        ref = REF_F.bleu_score(CANDIDATES, REFERENCES, n_gram=4)
+        assert_result_close(ours, np.asarray(ref))
+
+    def test_bleu_no_update_returns_zero(self):
+        assert float(BLEUScore(n_gram=4).compute()) == 0.0
+
+    def test_bleu_invalid_params(self):
+        with pytest.raises(ValueError, match="n_gram should be 1, 2, 3, or 4"):
+            BLEUScore(n_gram=5)
+        with pytest.raises(ValueError, match="length of weights"):
+            BLEUScore(n_gram=4, weights=jnp.array([0.5, 0.5]))
+        with pytest.raises(ValueError, match="same sizes"):
+            F.bleu_score(["a b c d"], [["a b"], ["c d"]])
+        with pytest.raises(ValueError, match="too short"):
+            F.bleu_score(["a b"], [["a b c d"]], n_gram=4)
+
+
+class TestPerplexity(MetricClassTester):
+    def _data(self, vocab=7, seq=5, batch=3):
+        inputs = [
+            RNG.normal(size=(batch, seq, vocab)).astype(np.float32)
+            for _ in range(8)
+        ]
+        targets = [RNG.integers(0, vocab, size=(batch, seq)) for _ in range(8)]
+        return inputs, targets
+
+    def _ref_ppl(self, inputs, targets, ignore_index=None):
+        metric = REF_M.Perplexity(ignore_index=ignore_index)
+        for x, t in zip(inputs, targets):
+            metric.update(torch.tensor(x), torch.tensor(t))
+        return np.asarray(metric.compute())
+
+    def test_perplexity(self):
+        inputs, targets = self._data()
+        self.run_class_implementation_tests(
+            metric=Perplexity(),
+            state_names={"sum_log_probs", "num_total"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=self._ref_ppl(inputs, targets),
+            atol=1e-4,
+            rtol=1e-4,
+        )
+
+    def test_perplexity_ignore_index(self):
+        inputs, targets = self._data()
+        self.run_class_implementation_tests(
+            metric=Perplexity(ignore_index=3),
+            state_names={"sum_log_probs", "num_total"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=self._ref_ppl(inputs, targets, ignore_index=3),
+            atol=1e-4,
+            rtol=1e-4,
+        )
+
+    def test_perplexity_functional(self):
+        inputs, targets = self._data(vocab=4, seq=3, batch=2)
+        ours = F.perplexity(inputs[0], targets[0])
+        ref = REF_F.perplexity(torch.tensor(inputs[0]), torch.tensor(targets[0]))
+        assert_result_close(ours, np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+    def test_perplexity_invalid_inputs(self):
+        with pytest.raises(ValueError, match="two-dimensional"):
+            F.perplexity(np.zeros((2, 3, 4)), np.zeros((2, 3, 1), dtype=int))
+        with pytest.raises(ValueError, match="three-dimensional"):
+            F.perplexity(np.zeros((2, 3)), np.zeros((2, 3), dtype=int))
+        with pytest.raises(ValueError, match="first dimension"):
+            F.perplexity(np.zeros((2, 3, 4)), np.zeros((3, 3), dtype=int))
+        with pytest.raises(ValueError, match="second dimension"):
+            F.perplexity(np.zeros((2, 3, 4)), np.zeros((2, 4), dtype=int))
+
+
+class TestWordErrorRate(MetricClassTester):
+    def test_wer(self):
+        metric = REF_M.WordErrorRate()
+        metric.update(PREDS, TARGETS)
+        self.run_class_implementation_tests(
+            metric=WordErrorRate(),
+            state_names={"errors", "total"},
+            update_kwargs={
+                "input": [[p] for p in PREDS],
+                "target": [[t] for t in TARGETS],
+            },
+            compute_result=np.asarray(metric.compute()),
+        )
+
+    def test_wer_functional(self):
+        ours = F.word_error_rate(PREDS, TARGETS)
+        ref = REF_F.word_error_rate(PREDS, TARGETS)
+        assert_result_close(ours, np.asarray(ref))
+        # single-string form
+        assert_result_close(
+            F.word_error_rate("hello world", "hello there world"),
+            np.asarray(REF_F.word_error_rate("hello world", "hello there world")),
+        )
+
+    def test_wer_invalid_inputs(self):
+        with pytest.raises(ValueError, match="same type"):
+            F.word_error_rate("abc", ["abc"])
+        with pytest.raises(ValueError, match="same length"):
+            F.word_error_rate(["a", "b"], ["a"])
+
+
+class TestWordInformationLost(MetricClassTester):
+    def test_wil(self):
+        metric = REF_M.WordInformationLost()
+        metric.update(PREDS, TARGETS)
+        self.run_class_implementation_tests(
+            metric=WordInformationLost(),
+            state_names={"correct_total", "target_total", "preds_total"},
+            update_kwargs={
+                "input": [[p] for p in PREDS],
+                "target": [[t] for t in TARGETS],
+            },
+            compute_result=np.asarray(metric.compute()),
+        )
+
+    def test_wil_functional(self):
+        ours = F.word_information_lost(PREDS, TARGETS)
+        ref = REF_F.word_information_lost(PREDS, TARGETS)
+        assert_result_close(ours, np.asarray(ref), atol=1e-6, rtol=1e-5)
+
+
+class TestWordInformationPreserved(MetricClassTester):
+    def test_wip(self):
+        metric = REF_M.WordInformationPreserved()
+        metric.update(PREDS, TARGETS)
+        self.run_class_implementation_tests(
+            metric=WordInformationPreserved(),
+            state_names={"correct_total", "input_total", "target_total"},
+            update_kwargs={
+                "input": [[p] for p in PREDS],
+                "target": [[t] for t in TARGETS],
+            },
+            compute_result=np.asarray(metric.compute()),
+        )
+
+    def test_wip_functional(self):
+        ours = F.word_information_preserved(PREDS, TARGETS)
+        ref = REF_F.word_information_preserved(PREDS, TARGETS)
+        assert_result_close(ours, np.asarray(ref), atol=1e-6, rtol=1e-5)
+
+
+def test_edit_distance_matches_reference_dp():
+    """Our vectorized DP equals the reference's pure-Python DP on random
+    token sequences (including empty sequences)."""
+    from torcheval_tpu.metrics.functional.text.helper import _edit_distance
+
+    def ref_dp(a, b):
+        dp = [[0] * (len(b) + 1) for _ in range(len(a) + 1)]
+        for i in range(len(a) + 1):
+            dp[i][0] = i
+        for j in range(len(b) + 1):
+            dp[0][j] = j
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                if a[i - 1] == b[j - 1]:
+                    dp[i][j] = dp[i - 1][j - 1]
+                else:
+                    dp[i][j] = min(dp[i - 1][j], dp[i][j - 1], dp[i - 1][j - 1]) + 1
+        return dp[-1][-1]
+
+    vocab = list("abcdefg")
+    for _ in range(50):
+        a = [vocab[i] for i in RNG.integers(0, len(vocab), RNG.integers(0, 12))]
+        b = [vocab[i] for i in RNG.integers(0, len(vocab), RNG.integers(0, 12))]
+        assert _edit_distance(a, b) == ref_dp(a, b), (a, b)

@@ -1,0 +1,158 @@
+"""BLEU score.
+
+Parity: reference torcheval/metrics/functional/text/bleu.py (`bleu_score`
+:13-62, `_bleu_score_update` :65-111, `_bleu_score_compute` :114-137,
+brevity penalty :140-146, `_get_ngrams` :149-162). N-gram counting is
+host-side string processing (as in the reference); the per-update result is
+a small fixed-size vector of counters that accumulates on device.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _get_ngrams(sentence: Sequence[str], n_gram: int) -> Counter:
+    if n_gram not in (1, 2, 3, 4):
+        raise ValueError(f"n_gram should be 1, 2, 3, or 4, got {n_gram}.")
+    ngram_counts: Counter = Counter()
+    for n_val in range(1, n_gram + 1):
+        for i in range(0, len(sentence) - n_val + 1):
+            ngram_counts[tuple(sentence[i : i + n_val])] += 1
+    return ngram_counts
+
+
+def _bleu_score_update(
+    input: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int,
+) -> Tuple[float, float, np.ndarray, np.ndarray]:
+    """Clipped n-gram matches and possible matches per order for one batch.
+
+    Returns host-side counters (floats / numpy vectors); the caller
+    accumulates them into device state.
+    """
+    input_ = [input] if isinstance(input, str) else input
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+
+    if len(input_) != len(target_):
+        raise ValueError(
+            "Input and target corpus should have same sizes, but input "
+            f"corpus size = {len(input_)}, target corpus size = {len(target_)} "
+        )
+
+    input_len = 0.0
+    target_len = 0.0
+    matches_by_order = np.zeros(n_gram, dtype=np.float64)
+    possible_matches_by_order = np.zeros(n_gram, dtype=np.float64)
+
+    for candidate, references in zip(input_, target_):
+        candidate_tokenized = candidate.split()
+        references_tokenized = [ref.split() for ref in references]
+
+        len_candidate = len(candidate_tokenized)
+        len_reference = min(len(ref) for ref in references_tokenized)
+        input_len += len_candidate
+        target_len += len_reference
+
+        candidate_ngram_counter = _get_ngrams(candidate_tokenized, n_gram)
+        reference_ngram_counter: Counter = Counter()
+        for ref in references_tokenized:
+            reference_ngram_counter |= _get_ngrams(ref, n_gram)
+        overlap = candidate_ngram_counter & reference_ngram_counter
+
+        for ngram in overlap:
+            matches_by_order[len(ngram) - 1] += overlap[ngram]
+
+        for i in range(n_gram):
+            if len_candidate - i > 0:
+                possible_matches_by_order[i] += len_candidate - i
+
+    if np.min(possible_matches_by_order) == 0:
+        raise ValueError(
+            "the input is too short to find all n-gram matches with "
+            f"n_gram={n_gram}"
+        )
+
+    return input_len, target_len, matches_by_order, possible_matches_by_order
+
+
+def _bleu_score_compute(
+    input_len: jax.Array,
+    target_len: jax.Array,
+    matches_by_order: jax.Array,
+    possible_matches_by_order: jax.Array,
+    n_gram: int,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    if weights is not None:
+        weights = jnp.asarray(weights)
+        if n_gram != weights.shape[0]:
+            raise ValueError(
+                "the length of weights should equal n_gram, got "
+                f"len(weights)={weights.shape[0]}, n_gram={n_gram}"
+            )
+    if weights is None:
+        weights = jnp.full((n_gram,), 1 / n_gram, dtype=jnp.float32)
+
+    input_len = jnp.asarray(input_len, dtype=jnp.float32)
+    target_len = jnp.asarray(target_len, dtype=jnp.float32)
+    matches = jnp.asarray(matches_by_order, dtype=jnp.float32)
+    possible = jnp.asarray(possible_matches_by_order, dtype=jnp.float32)
+
+    precisions = matches / possible
+    geometric_mean = jnp.exp(jnp.sum(weights * jnp.log(precisions)))
+    brevity_penalty = jnp.where(
+        input_len > target_len, 1.0, jnp.exp(1 - target_len / input_len)
+    )
+    return brevity_penalty * geometric_mean
+
+
+def bleu_score(
+    input: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """BLEU score of translations against (multi-)references.
+
+    Class version: ``torcheval_tpu.metrics.BLEUScore``.
+
+    Args:
+        input: translations to score — a string or sequence of strings.
+        target: list of references for each translation; requires
+            ``len(input) == len(target)``.
+        n_gram: maximum n-gram order, in {1, 2, 3, 4}.
+        weights: optional per-order weight distribution of length ``n_gram``
+            (uniform if unspecified).
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import bleu_score
+        >>> candidates = ["the squirrel is eating the nut"]
+        >>> references = [["a squirrel is eating a nut",
+        ...                "the squirrel is eating a tasty nut"]]
+        >>> bleu_score(candidates, references, n_gram=4)
+        Array(0.53728497, dtype=float32)
+    """
+    if n_gram not in (1, 2, 3, 4):
+        raise ValueError(f"n_gram should be 1, 2, 3, or 4, got {n_gram}.")
+    (
+        input_len,
+        target_len,
+        matches_by_order,
+        possible_matches_by_order,
+    ) = _bleu_score_update(input, target, n_gram)
+    return _bleu_score_compute(
+        jnp.asarray(input_len),
+        jnp.asarray(target_len),
+        jnp.asarray(matches_by_order),
+        jnp.asarray(possible_matches_by_order),
+        n_gram,
+        weights,
+    )

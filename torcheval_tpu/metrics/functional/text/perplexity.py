@@ -1,0 +1,132 @@
+"""Perplexity.
+
+Parity: reference torcheval/metrics/functional/text/perplexity.py
+(`perplexity` :14-63, `_perplexity_update` :66-107, `_compute` :110-115,
+input check :118-155). TPU-native redesign of the hot path: the reference
+materializes an (N*S, N*S) matrix via ``probs[:, target].diagonal()``
+(reference perplexity.py:103) — quadratic memory in token count. Here the
+per-token target log-probability is one fused jitted kernel:
+``log_softmax`` + ``take_along_axis`` + masked sum, linear memory, no host
+sync. ``ignore_index`` tokens contribute zero via masking (fixed shapes —
+no boolean gather) instead of the reference's shape-changing ``probs[mask]``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.config import debug_validation_enabled
+from torcheval_tpu.utils.convert import to_jax, to_jax_float
+
+
+@partial(jax.jit, static_argnames=("ignore_index",))
+def _perplexity_update_jit(
+    input: jax.Array,
+    target: jax.Array,
+    ignore_index: Optional[int],
+) -> Tuple[jax.Array, jax.Array]:
+    log_probs = jax.nn.log_softmax(input.reshape(-1, input.shape[-1]), axis=-1)
+    flat_target = target.reshape(-1)
+    token_log_probs = jnp.take_along_axis(
+        log_probs, flat_target[:, None], axis=-1
+    ).squeeze(-1)
+    if ignore_index is not None:
+        keep = flat_target != ignore_index
+        token_log_probs = jnp.where(keep, token_log_probs, 0.0)
+        num_total = jnp.sum(keep).astype(jnp.float32)
+    else:
+        num_total = jnp.float32(flat_target.shape[0])
+    return -jnp.sum(token_log_probs), num_total
+
+
+def _perplexity_update(
+    input,
+    target,
+    ignore_index: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Summed negative log-likelihood and token count for one batch."""
+    input = to_jax_float(input)
+    target = to_jax(target)
+    _perplexity_input_check(input, target, ignore_index)
+    return _perplexity_update_jit(input, target, ignore_index)
+
+
+def _perplexity_compute(
+    sum_log_probs: jax.Array, num_total: jax.Array
+) -> jax.Array:
+    return jnp.exp(sum_log_probs / num_total)
+
+
+def _perplexity_input_check(
+    input: jax.Array,
+    target: jax.Array,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if target.ndim != 2:
+        raise ValueError(
+            f"target should be a two-dimensional tensor, got shape "
+            f"{target.shape}."
+        )
+    if input.ndim != 3:
+        raise ValueError(
+            f"input should be a three-dimensional tensor, got shape "
+            f"{input.shape}."
+        )
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension "
+            f"(i.e., batch size), got shapes {input.shape} and {target.shape} "
+            "instead."
+        )
+    if input.shape[1] != target.shape[1]:
+        raise ValueError(
+            "The `input` and `target` should have the same second dimension "
+            f"(i.e., sequence length), got shapes {input.shape} and "
+            f"{target.shape} instead."
+        )
+    if debug_validation_enabled():
+        # Value check needs a device->host readback; debug-mode only
+        # (reference does it eagerly: perplexity.py:145-155).
+        checked = target
+        if ignore_index is not None:
+            checked = jnp.where(target == ignore_index, 0, target)
+        max_label = int(jnp.max(checked))
+        if input.shape[2] <= max_label:
+            raise ValueError(
+                "Class labels in `target` tensor cannot be larger than "
+                f"vocab_size minus one, got vocab size of {input.shape[2]} "
+                f"and target label of {max_label}."
+            )
+
+
+def perplexity(
+    input,
+    target,
+    ignore_index: Optional[int] = None,
+) -> jax.Array:
+    """Perplexity: ``exp(sum of negative log likelihood / number of tokens)``.
+
+    Class version: ``torcheval_tpu.metrics.Perplexity``.
+
+    Args:
+        input: unnormalized scores (logits) per token, shape
+            (n_samples, seq_len, vocab_size).
+        target: ground-truth vocab indices, shape (n_samples, seq_len).
+        ignore_index: if specified, target tokens with this value are
+            excluded from the calculation.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import perplexity
+        >>> input = jnp.array([[[0.3659, 0.7025, 0.3104],
+        ...                     [0.0097, 0.6577, 0.1947]]])
+        >>> target = jnp.array([[2, 1]])
+        >>> perplexity(input, target)
+        Array(2.7593, dtype=float32)
+    """
+    sum_log_probs, num_total = _perplexity_update(input, target, ignore_index)
+    return _perplexity_compute(sum_log_probs, num_total)

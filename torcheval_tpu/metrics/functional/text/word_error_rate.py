@@ -1,0 +1,69 @@
+"""Word error rate.
+
+Parity: reference torcheval/metrics/functional/text/word_error_rate.py
+(`word_error_rate` :13-39, `_update` :42-66, `_compute` :69-81, input check
+:109-119). Host-side string processing with vectorized edit distance
+(see helper.py); counters are host floats.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.text.helper import (
+    _edit_distance,
+    _text_input_check,
+)
+
+
+def _word_error_rate_update(
+    input: Union[str, List[str]],
+    target: Union[str, List[str]],
+) -> Tuple[float, float]:
+    """Summed edit distance and reference-token count for the batch."""
+    _text_input_check(input, target)
+    if isinstance(input, str):
+        input = [input]
+    if isinstance(target, str):
+        target = [target]
+    errors = 0.0
+    total = 0.0
+    for ipt, tgt in zip(input, target):
+        ipt_tokens = ipt.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(ipt_tokens, tgt_tokens)
+        total += len(tgt_tokens)
+    return errors, total
+
+
+def _word_error_rate_compute(errors: float, total: float) -> jax.Array:
+    # divide as arrays: 0/0 -> NaN (reference returns tensor(nan) pre-update)
+    return jnp.asarray(errors, dtype=jnp.float32) / jnp.asarray(
+        total, dtype=jnp.float32
+    )
+
+
+def word_error_rate(
+    input: Union[str, List[str]],
+    target: Union[str, List[str]],
+) -> jax.Array:
+    """Word error rate of predicted vs reference word sequence(s).
+
+    Class version: ``torcheval_tpu.metrics.WordErrorRate``.
+
+    Args:
+        input: predicted word sequence(s) — a string or list of strings.
+        target: reference word sequence(s) — a string or list of strings.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import word_error_rate
+        >>> word_error_rate(["hello world", "welcome to the facebook"],
+        ...                 ["hello metaverse", "welcome to meta"])
+        Array(0.6, dtype=float32)
+    """
+    errors, total = _word_error_rate_update(input, target)
+    return _word_error_rate_compute(errors, total)

@@ -1,0 +1,63 @@
+"""Word information lost.
+
+Parity: reference torcheval/metrics/functional/text/word_information_lost.py
+(`_wil_update` :14-37, `_wil_compute` :40-51, `word_information_lost` :54-79).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.text.helper import (
+    _get_errors_and_totals,
+    _text_input_check,
+)
+
+
+def _wil_update(
+    input: Union[str, List[str]],
+    target: Union[str, List[str]],
+) -> Tuple[float, float, float]:
+    """Returns (correct_total, target_total, input_total) for the batch."""
+    _text_input_check(input, target)
+    errors, max_total, target_total, input_total = _get_errors_and_totals(
+        input, target
+    )
+    return max_total - errors, target_total, input_total
+
+
+def _wil_compute(
+    correct_total: float, target_total: float, preds_total: float
+) -> jax.Array:
+    correct = jnp.asarray(correct_total, dtype=jnp.float32)
+    return 1 - (
+        (correct / jnp.asarray(target_total, dtype=jnp.float32))
+        * (correct / jnp.asarray(preds_total, dtype=jnp.float32))
+    )
+
+
+def word_information_lost(
+    input: Union[str, List[str]],
+    target: Union[str, List[str]],
+) -> jax.Array:
+    """Word information lost rate of predicted vs reference sequence(s).
+
+    Class version: ``torcheval_tpu.metrics.WordInformationLost``.
+
+    Args:
+        input: transcription(s) to score — a string or list of strings.
+        target: reference(s) — a string or list of strings.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import word_information_lost
+        >>> word_information_lost(
+        ...     ["this is the prediction", "there is an other sample"],
+        ...     ["this is the reference", "there is another one"])
+        Array(0.6528, dtype=float32)
+    """
+    correct_total, target_total, preds_total = _wil_update(input, target)
+    return _wil_compute(correct_total, target_total, preds_total)

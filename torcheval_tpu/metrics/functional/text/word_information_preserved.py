@@ -1,0 +1,67 @@
+"""Word information preserved.
+
+Parity: reference
+torcheval/metrics/functional/text/word_information_preserved.py
+(`word_information_preserved` :14-44, `_update` :47-61, `_compute` :64-76,
+input check :79-90).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.text.helper import (
+    _get_errors_and_totals,
+    _text_input_check,
+)
+
+
+def _word_information_preserved_update(
+    input: Union[str, List[str]],
+    target: Union[str, List[str]],
+) -> Tuple[float, float, float]:
+    """Returns (correct_total, target_total, input_total) for the batch."""
+    _text_input_check(input, target)
+    errors, max_total, target_total, input_total = _get_errors_and_totals(
+        input, target
+    )
+    return max_total - errors, target_total, input_total
+
+
+def _word_information_preserved_compute(
+    correct_total: float, target_total: float, input_total: float
+) -> jax.Array:
+    correct = jnp.asarray(correct_total, dtype=jnp.float32)
+    return (correct / jnp.asarray(target_total, dtype=jnp.float32)) * (
+        correct / jnp.asarray(input_total, dtype=jnp.float32)
+    )
+
+
+def word_information_preserved(
+    input: Union[str, List[str]],
+    target: Union[str, List[str]],
+) -> jax.Array:
+    """Word information preserved score of predicted vs reference sequence(s).
+
+    Class version: ``torcheval_tpu.metrics.WordInformationPreserved``.
+
+    Args:
+        input: predicted word sequence(s) — a string or list of strings.
+        target: reference word sequence(s) — a string or list of strings.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics.functional import (
+        ...     word_information_preserved)
+        >>> word_information_preserved(
+        ...     ["hello world", "welcome to the facebook"],
+        ...     ["hello metaverse", "welcome to meta"])
+        Array(0.3, dtype=float32)
+    """
+    correct, target_total, input_total = _word_information_preserved_update(
+        input, target
+    )
+    return _word_information_preserved_compute(correct, target_total, input_total)

@@ -1,0 +1,113 @@
+"""BLEUScore class metric.
+
+Parity: reference torcheval/metrics/text/bleu.py:22-141. N-gram matching is
+host-side (as in the reference); states are a fixed-size counter vector on
+device plus host float lengths, all SUM-merged — so distributed sync is one
+psum.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, TypeVar, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.text.bleu import (
+    _bleu_score_compute,
+    _bleu_score_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TBLEUScore = TypeVar("TBLEUScore", bound="BLEUScore")
+
+
+class BLEUScore(Metric[jax.Array]):
+    """BLEU score over all updates.
+
+    Functional version: ``torcheval_tpu.metrics.functional.bleu_score``.
+
+    Args:
+        n_gram: maximum n-gram order, in {1, 2, 3, 4}.
+        weights: optional per-order weight distribution of length ``n_gram``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import BLEUScore
+        >>> metric = BLEUScore(n_gram=4)
+        >>> candidates = ["the squirrel is eating the nut",
+        ...               "the cat is on the mat"]
+        >>> references = [["a squirrel is eating a nut",
+        ...                "the squirrel is eating a tasty nut"],
+        ...               ["there is a cat on the mat",
+        ...                "a cat is on the mat"]]
+        >>> metric.update(candidates, references)
+        >>> metric.compute()
+        Array(0.65341892, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        n_gram: int,
+        weights: Optional[jax.Array] = None,
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        super().__init__(device=device)
+        if n_gram not in (1, 2, 3, 4):
+            raise ValueError(f"n_gram should be 1, 2, 3, or 4, got {n_gram}.")
+        if weights is not None and n_gram != len(weights):
+            raise ValueError(
+                "the length of weights should equal n_gram, got "
+                f"len(weights)={len(weights)}, n_gram={n_gram}"
+            )
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.n_gram = n_gram
+        self._add_state("input_len", 0.0, merge=MergeKind.SUM)
+        self._add_state("target_len", 0.0, merge=MergeKind.SUM)
+        self._add_state(
+            "matches_by_order",
+            jnp.zeros(n_gram, dtype=jnp.float32),
+            merge=MergeKind.SUM,
+        )
+        self._add_state(
+            "possible_matches_by_order",
+            jnp.zeros(n_gram, dtype=jnp.float32),
+            merge=MergeKind.SUM,
+        )
+
+    def update(
+        self: TBLEUScore,
+        input: Union[str, Sequence[str]],
+        target: Sequence[Union[str, Sequence[str]]],
+    ) -> TBLEUScore:
+        """Accumulate one batch of translations + references."""
+        (
+            input_len,
+            target_len,
+            matches_by_order,
+            possible_matches_by_order,
+        ) = _bleu_score_update(input, target, self.n_gram)
+        self.input_len += input_len
+        self.target_len += target_len
+        self.matches_by_order = self.matches_by_order + self._input_float(
+            matches_by_order
+        )
+        self.possible_matches_by_order = (
+            self.possible_matches_by_order
+            + self._input_float(possible_matches_by_order)
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        """Running BLEU score; 0.0 before any update."""
+        if float(jnp.sum(self.matches_by_order)) == 0.0:
+            return jnp.zeros((), dtype=jnp.float32)
+        return _bleu_score_compute(
+            jnp.asarray(self.input_len),
+            jnp.asarray(self.target_len),
+            self.matches_by_order,
+            self.possible_matches_by_order,
+            self.n_gram,
+            self.weights,
+        )

@@ -1,0 +1,72 @@
+"""Perplexity class metric.
+
+Parity: reference torcheval/metrics/text/perplexity.py:22-141. Two scalar
+device counters (negative log-likelihood sum + token count), accumulated by
+one fused jitted kernel per update — the states psum in a single collective.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.text.perplexity import (
+    _perplexity_compute,
+    _perplexity_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TPerplexity = TypeVar("TPerplexity", bound="Perplexity")
+
+
+class Perplexity(Metric[jax.Array]):
+    """Perplexity: exp(summed NLL / number of tokens) over all updates.
+
+    Functional version: ``torcheval_tpu.metrics.functional.perplexity``.
+
+    Args:
+        ignore_index: if specified, target tokens with this value are
+            excluded from the calculation.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import Perplexity
+        >>> metric = Perplexity()
+        >>> input = jnp.array([[[0.3659, 0.7025, 0.3104],
+        ...                     [0.0097, 0.6577, 0.1947]]])
+        >>> target = jnp.array([[2, 1]])
+        >>> metric.update(input, target)
+        >>> metric.compute()
+        Array(2.7593, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        ignore_index: Optional[int] = None,
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        super().__init__(device=device)
+        self.ignore_index = ignore_index
+        self._add_state("sum_log_probs", jnp.zeros(()), merge=MergeKind.SUM)
+        self._add_state("num_total", jnp.zeros(()), merge=MergeKind.SUM)
+
+    def update(self: TPerplexity, input, target) -> TPerplexity:
+        """Accumulate one batch.
+
+        Args:
+            input: logits, shape (n_samples, seq_len, vocab_size).
+            target: vocab indices, shape (n_samples, seq_len).
+        """
+        sum_log_probs, num_total = _perplexity_update(
+            self._input_float(input), self._input(target), self.ignore_index
+        )
+        self.sum_log_probs = self.sum_log_probs + sum_log_probs
+        self.num_total = self.num_total + num_total
+        return self
+
+    def compute(self) -> jax.Array:
+        """Running perplexity."""
+        return _perplexity_compute(self.sum_log_probs, self.num_total)

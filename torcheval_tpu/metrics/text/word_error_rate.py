@@ -1,0 +1,56 @@
+"""WordErrorRate class metric.
+
+Parity: reference torcheval/metrics/text/word_error_rate.py:22-114. Host
+float counters (exact double precision; the text DP runs on host anyway),
+SUM-merged through the sync layer's int/float path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, TypeVar, Union
+
+import jax
+
+from torcheval_tpu.metrics.functional.text.word_error_rate import (
+    _word_error_rate_compute,
+    _word_error_rate_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TWordErrorRate = TypeVar("TWordErrorRate", bound="WordErrorRate")
+
+
+class WordErrorRate(Metric[jax.Array]):
+    """Word error rate over all updates.
+
+    Functional version: ``torcheval_tpu.metrics.functional.word_error_rate``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import WordErrorRate
+        >>> metric = WordErrorRate()
+        >>> metric.update(["this is the prediction", "there is an other sample"],
+        ...               ["this is the reference", "there is another one"])
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
+
+    def __init__(self, *, device: Optional[jax.Device] = None) -> None:
+        super().__init__(device=device)
+        self._add_state("errors", 0.0, merge=MergeKind.SUM)
+        self._add_state("total", 0.0, merge=MergeKind.SUM)
+
+    def update(
+        self: TWordErrorRate,
+        input: Union[str, List[str]],
+        target: Union[str, List[str]],
+    ) -> TWordErrorRate:
+        """Accumulate edit distances for one batch of sentence pairs."""
+        errors, total = _word_error_rate_update(input, target)
+        self.errors += errors
+        self.total += total
+        return self
+
+    def compute(self) -> jax.Array:
+        """Running word error rate."""
+        return _word_error_rate_compute(self.errors, self.total)

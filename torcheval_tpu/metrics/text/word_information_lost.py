@@ -1,0 +1,61 @@
+"""WordInformationLost class metric.
+
+Parity: reference torcheval/metrics/text/word_information_lost.py:23-103.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, TypeVar, Union
+
+import jax
+
+from torcheval_tpu.metrics.functional.text.word_information_lost import (
+    _wil_compute,
+    _wil_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TWordInformationLost = TypeVar(
+    "TWordInformationLost", bound="WordInformationLost"
+)
+
+
+class WordInformationLost(Metric[jax.Array]):
+    """Word information lost rate over all updates (0 = perfect).
+
+    Functional version:
+    ``torcheval_tpu.metrics.functional.word_information_lost``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import WordInformationLost
+        >>> metric = WordInformationLost()
+        >>> metric.update(["this is the prediction", "there is an other sample"],
+        ...               ["this is the reference", "there is another one"])
+        >>> metric.compute()
+        Array(0.6528, dtype=float32)
+    """
+
+    def __init__(self, *, device: Optional[jax.Device] = None) -> None:
+        super().__init__(device=device)
+        self._add_state("correct_total", 0.0, merge=MergeKind.SUM)
+        self._add_state("target_total", 0.0, merge=MergeKind.SUM)
+        self._add_state("preds_total", 0.0, merge=MergeKind.SUM)
+
+    def update(
+        self: TWordInformationLost,
+        input: Union[str, List[str]],
+        target: Union[str, List[str]],
+    ) -> TWordInformationLost:
+        """Accumulate one batch of sentence pairs."""
+        correct_total, target_total, preds_total = _wil_update(input, target)
+        self.correct_total += correct_total
+        self.target_total += target_total
+        self.preds_total += preds_total
+        return self
+
+    def compute(self) -> jax.Array:
+        """Running word information lost score."""
+        return _wil_compute(
+            self.correct_total, self.target_total, self.preds_total
+        )

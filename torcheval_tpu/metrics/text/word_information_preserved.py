@@ -1,0 +1,63 @@
+"""WordInformationPreserved class metric.
+
+Parity: reference torcheval/metrics/text/word_information_preserved.py:22-106.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, TypeVar, Union
+
+import jax
+
+from torcheval_tpu.metrics.functional.text.word_information_preserved import (
+    _word_information_preserved_compute,
+    _word_information_preserved_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TWordInformationPreserved = TypeVar(
+    "TWordInformationPreserved", bound="WordInformationPreserved"
+)
+
+
+class WordInformationPreserved(Metric[jax.Array]):
+    """Word information preserved score over all updates (1 = perfect).
+
+    Functional version:
+    ``torcheval_tpu.metrics.functional.word_information_preserved``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import WordInformationPreserved
+        >>> metric = WordInformationPreserved()
+        >>> metric.update(["hello world", "welcome to the facebook"],
+        ...               ["hello metaverse", "welcome to meta"])
+        >>> metric.compute()
+        Array(0.3, dtype=float32)
+    """
+
+    def __init__(self, *, device: Optional[jax.Device] = None) -> None:
+        super().__init__(device=device)
+        self._add_state("correct_total", 0.0, merge=MergeKind.SUM)
+        self._add_state("input_total", 0.0, merge=MergeKind.SUM)
+        self._add_state("target_total", 0.0, merge=MergeKind.SUM)
+
+    def update(
+        self: TWordInformationPreserved,
+        input: Union[str, List[str]],
+        target: Union[str, List[str]],
+    ) -> TWordInformationPreserved:
+        """Accumulate one batch of sentence pairs."""
+        correct, target_total, input_total = (
+            _word_information_preserved_update(input, target)
+        )
+        self.correct_total += correct
+        self.target_total += target_total
+        self.input_total += input_total
+        return self
+
+    def compute(self) -> jax.Array:
+        """Running word information preserved score."""
+        return _word_information_preserved_compute(
+            self.correct_total, self.target_total, self.input_total
+        )
