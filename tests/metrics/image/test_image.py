@@ -1,0 +1,202 @@
+"""Image metric tests (PSNR, FID) vs the reference oracle."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import FrechetInceptionDistance, PeakSignalNoiseRatio
+from torcheval_tpu.metrics import functional as F
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+    assert_result_close,
+)
+
+REF_M, REF_F = load_reference_metrics()
+RNG = np.random.default_rng(23)
+
+PSNR_STATES = {
+    "data_range",
+    "num_observations",
+    "sum_squared_error",
+    "min_target",
+    "max_target",
+}
+
+
+class TestPeakSignalNoiseRatio(MetricClassTester):
+    def _ref_psnr(self, inputs, targets, data_range=None):
+        metric = REF_M.PeakSignalNoiseRatio(data_range=data_range)
+        for x, t in zip(inputs, targets):
+            metric.update(torch.tensor(x), torch.tensor(t))
+        return np.asarray(metric.compute())
+
+    def _data(self):
+        inputs = [
+            RNG.uniform(size=(2, 3, 8, 8)).astype(np.float32) for _ in range(8)
+        ]
+        targets = [
+            RNG.uniform(size=(2, 3, 8, 8)).astype(np.float32) for _ in range(8)
+        ]
+        return inputs, targets
+
+    def test_psnr_fixed_range(self):
+        inputs, targets = self._data()
+        self.run_class_implementation_tests(
+            metric=PeakSignalNoiseRatio(data_range=1.0),
+            state_names=PSNR_STATES,
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=self._ref_psnr(inputs, targets, data_range=1.0),
+        )
+
+    def test_psnr_auto_range(self):
+        inputs, targets = self._data()
+        self.run_class_implementation_tests(
+            metric=PeakSignalNoiseRatio(),
+            state_names=PSNR_STATES,
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=self._ref_psnr(inputs, targets),
+        )
+
+    def test_psnr_functional(self):
+        x = RNG.uniform(size=(2, 3, 4, 4)).astype(np.float32)
+        t = RNG.uniform(size=(2, 3, 4, 4)).astype(np.float32)
+        assert_result_close(
+            F.peak_signal_noise_ratio(x, t),
+            np.asarray(REF_F.peak_signal_noise_ratio(torch.tensor(x), torch.tensor(t))),
+        )
+        assert_result_close(
+            F.peak_signal_noise_ratio(x, t, data_range=0.5),
+            np.asarray(
+                REF_F.peak_signal_noise_ratio(
+                    torch.tensor(x), torch.tensor(t), data_range=0.5
+                )
+            ),
+        )
+
+    def test_psnr_invalid(self):
+        with pytest.raises(ValueError, match="needs to be positive"):
+            PeakSignalNoiseRatio(data_range=-1.0)
+        with pytest.raises(ValueError, match="either `None` or `float`"):
+            PeakSignalNoiseRatio(data_range=1)
+        with pytest.raises(ValueError, match="same shape"):
+            F.peak_signal_noise_ratio(np.zeros((2, 3)), np.zeros((3, 2)))
+
+
+FEATURE_DIM = 16
+_PROJ = RNG.normal(size=(3 * 6 * 6, FEATURE_DIM)).astype(np.float32)
+
+
+def _jax_extractor(images: jax.Array) -> jax.Array:
+    return images.reshape(images.shape[0], -1) @ jnp.asarray(_PROJ)
+
+
+class _TorchExtractor(torch.nn.Module):
+    def forward(self, x):
+        return x.reshape(x.shape[0], -1) @ torch.tensor(_PROJ)
+
+
+class TestFrechetInceptionDistance(MetricClassTester):
+    def _ref_fid(self, batches, flags):
+        metric = REF_M.FrechetInceptionDistance(
+            model=_TorchExtractor(), feature_dim=FEATURE_DIM
+        )
+        for imgs, is_real in zip(batches, flags):
+            metric.update(torch.tensor(imgs), is_real=is_real)
+        return np.asarray(metric.compute())
+
+    def test_fid_matches_reference(self):
+        batches = [
+            RNG.uniform(size=(4, 3, 6, 6)).astype(np.float32) for _ in range(8)
+        ]
+        flags = [True, False] * 4
+        ours = FrechetInceptionDistance(
+            model=_jax_extractor, feature_dim=FEATURE_DIM
+        )
+        for imgs, is_real in zip(batches, flags):
+            ours.update(imgs, is_real=is_real)
+        assert_result_close(
+            ours.compute(), self._ref_fid(batches, flags), atol=1e-2, rtol=1e-3
+        )
+
+    def test_fid_class_harness(self):
+        batches = [
+            RNG.uniform(size=(4, 3, 6, 6)).astype(np.float32) for _ in range(8)
+        ]
+        flags = [True, False] * 4
+        self.run_class_implementation_tests(
+            metric=FrechetInceptionDistance(
+                model=_jax_extractor, feature_dim=FEATURE_DIM
+            ),
+            state_names={
+                "real_sum",
+                "real_cov_sum",
+                "fake_sum",
+                "fake_cov_sum",
+                "num_real_images",
+                "num_fake_images",
+            },
+            update_kwargs={"images": batches, "is_real": flags},
+            compute_result=self._ref_fid(batches, flags),
+            atol=1e-2,
+            rtol=1e-3,
+        )
+
+    def test_fid_no_updates_warns_and_returns_zero(self):
+        metric = FrechetInceptionDistance(
+            model=_jax_extractor, feature_dim=FEATURE_DIM
+        )
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            result = metric.compute()
+        assert float(result) == 0.0
+        assert any("requires at least 1" in str(x.message) for x in w)
+
+    def test_fid_invalid(self):
+        with pytest.raises(RuntimeError, match="positive integer"):
+            FrechetInceptionDistance(model=_jax_extractor, feature_dim=0)
+        with pytest.raises(RuntimeError, match="2048"):
+            FrechetInceptionDistance(feature_dim=64)
+        metric = FrechetInceptionDistance(
+            model=_jax_extractor, feature_dim=FEATURE_DIM
+        )
+        with pytest.raises(ValueError, match="4D"):
+            metric.update(np.zeros((3, 6, 6), dtype=np.float32), is_real=True)
+        with pytest.raises(ValueError, match="3 channels"):
+            metric.update(np.zeros((2, 1, 6, 6), dtype=np.float32), is_real=True)
+        with pytest.raises(ValueError, match="type bool"):
+            metric.update(np.zeros((2, 3, 6, 6), dtype=np.float32), is_real=1)
+
+
+def test_inception_v3_architecture_shapes():
+    """The Flax InceptionV3 port produces 2048-d features and its parameter
+    tree matches torchvision's layer structure (spot-checked shapes)."""
+    from torcheval_tpu.models.inception import InceptionV3, init_inception_params
+
+    variables = init_inception_params()
+    model = InceptionV3()
+    x = jnp.zeros((2, 299, 299, 3), dtype=jnp.float32)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 2048)
+
+    params = variables["params"]
+    # stem convs
+    assert params["Conv2d_1a_3x3"]["conv"]["kernel"].shape == (3, 3, 3, 32)
+    assert params["Conv2d_4a_3x3"]["conv"]["kernel"].shape == (3, 3, 80, 192)
+    # one block from each inception family
+    assert params["Mixed_5b"]["branch5x5_2"]["conv"]["kernel"].shape == (
+        5, 5, 48, 64,
+    )
+    assert params["Mixed_6b"]["branch7x7_2"]["conv"]["kernel"].shape == (
+        1, 7, 128, 128,
+    )
+    assert params["Mixed_7c"]["branch3x3_2a"]["conv"]["kernel"].shape == (
+        1, 3, 384, 384,
+    )
+    # total parameter count matches torchvision inception_v3 trunk
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert 21_000_000 < n_params < 26_000_000

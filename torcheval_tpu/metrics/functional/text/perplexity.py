@@ -37,9 +37,9 @@ def _perplexity_update_jit(
     if ignore_index is not None:
         keep = flat_target != ignore_index
         token_log_probs = jnp.where(keep, token_log_probs, 0.0)
-        num_total = jnp.sum(keep).astype(jnp.float32)
+        num_total = jnp.sum(keep).astype(jnp.int32)
     else:
-        num_total = jnp.float32(flat_target.shape[0])
+        num_total = jnp.int32(flat_target.shape[0])
     return -jnp.sum(token_log_probs), num_total
 
 
@@ -58,7 +58,7 @@ def _perplexity_update(
 def _perplexity_compute(
     sum_log_probs: jax.Array, num_total: jax.Array
 ) -> jax.Array:
-    return jnp.exp(sum_log_probs / num_total)
+    return jnp.exp(sum_log_probs / num_total.astype(jnp.float32))
 
 
 def _perplexity_input_check(

@@ -1,0 +1,4 @@
+from torcheval_tpu.metrics.image.fid import FrechetInceptionDistance
+from torcheval_tpu.metrics.image.psnr import PeakSignalNoiseRatio
+
+__all__ = ["FrechetInceptionDistance", "PeakSignalNoiseRatio"]

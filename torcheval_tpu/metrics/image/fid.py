@@ -1,0 +1,282 @@
+"""FrechetInceptionDistance class metric.
+
+Parity: reference torcheval/metrics/image/fid.py:53-284. Streaming
+sufficient statistics (feature sum + uncentered covariance sum per
+distribution), SUM-merged — distributed sync is a single psum of
+O(feature_dim^2) state regardless of image count.
+
+TPU-native differences from the reference:
+
+- The Frechet term ``tr sqrt(S1 S2)`` is computed via the real-symmetric
+  reformulation ``tr sqrt(sqrt(S1) S2 sqrt(S1))`` using two ``eigh`` calls,
+  because the reference's complex ``torch.linalg.eigvals`` (fid.py:221) has
+  no TPU lowering. For PSD covariance matrices the two are mathematically
+  identical.
+- The default feature extractor is the Flax InceptionV3 port
+  (``torcheval_tpu.models.inception``) wrapped with the same bilinear
+  299x299 resize as the reference's ``FIDInceptionV3`` (fid.py:45-50);
+  pretrained torchvision weights are imported when available. Any callable
+  ``images (N, 3, H, W) -> activations (N, feature_dim)`` is accepted in
+  its place.
+- Activation extraction + state accumulation is one jitted program; images
+  arrive NCHW (reference layout) and are transposed to NHWC for TPU convs.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, Iterable, Optional, TypeVar, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.config import debug_validation_enabled
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TFrechetInceptionDistance = TypeVar(
+    "TFrechetInceptionDistance", bound="FrechetInceptionDistance"
+)
+
+FeatureExtractor = Callable[[jax.Array], jax.Array]
+
+
+class FIDInceptionV3:
+    """The Flax InceptionV3 port wrapped for FID: NCHW input, bilinear
+    299x299 resize, 2048-d pooled features (reference fid.py:28-50)."""
+
+    def __init__(self) -> None:
+        from torcheval_tpu.models.inception import (
+            InceptionV3,
+            load_torchvision_inception_params,
+        )
+
+        try:
+            self.variables = load_torchvision_inception_params()
+        except ImportError as e:
+            raise ImportError(
+                "You must have torchvision installed to use FID with "
+                "pretrained InceptionV3 weights; pass a custom `model` "
+                "callable otherwise."
+            ) from e
+        self._module = InceptionV3()
+        self._apply = jax.jit(
+            lambda variables, x: self._module.apply(variables, x)
+        )
+
+    def __call__(self, images: jax.Array) -> jax.Array:
+        x = jnp.transpose(images, (0, 2, 3, 1))  # NCHW -> NHWC for TPU convs
+        x = jax.image.resize(
+            x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear"
+        )
+        return self._apply(self.variables, x)
+
+    def to(self, device: jax.Device) -> "FIDInceptionV3":
+        self.variables = jax.device_put(self.variables, device)
+        return self
+
+
+@jax.jit
+def _fid_accumulate(activations: jax.Array):
+    return (
+        jnp.sum(activations, axis=0),
+        jnp.matmul(activations.T, activations),
+        jnp.int32(activations.shape[0]),
+    )
+
+
+@jax.jit
+def _frechet_distance(
+    real_sum: jax.Array,
+    real_cov_sum: jax.Array,
+    num_real: jax.Array,
+    fake_sum: jax.Array,
+    fake_cov_sum: jax.Array,
+    num_fake: jax.Array,
+) -> jax.Array:
+    num_real = num_real.astype(jnp.float32)
+    num_fake = num_fake.astype(jnp.float32)
+    real_mean = real_sum / num_real
+    fake_mean = fake_sum / num_fake
+    real_cov = (
+        real_cov_sum - num_real * jnp.outer(real_mean, real_mean)
+    ) / (num_real - 1)
+    fake_cov = (
+        fake_cov_sum - num_fake * jnp.outer(fake_mean, fake_mean)
+    ) / (num_fake - 1)
+
+    mean_diff_squared = jnp.sum(jnp.square(real_mean - fake_mean))
+    trace_sum = jnp.trace(real_cov) + jnp.trace(fake_cov)
+
+    # tr sqrt(S1 S2) == tr sqrt(sqrt(S1) S2 sqrt(S1)) for PSD S1, S2 —
+    # all-real eigh path (TPU has no complex eigvals kernel).
+    evals1, evecs1 = jnp.linalg.eigh(real_cov)
+    sqrt_real = (evecs1 * jnp.sqrt(jnp.maximum(evals1, 0.0))) @ evecs1.T
+    inner = sqrt_real @ fake_cov @ sqrt_real
+    inner = (inner + inner.T) / 2  # symmetrize numerical noise
+    inner_evals = jnp.linalg.eigvalsh(inner)
+    sqrt_eigenvals_sum = jnp.sum(jnp.sqrt(jnp.maximum(inner_evals, 0.0)))
+
+    return mean_diff_squared + trace_sum - 2 * sqrt_eigenvals_sum
+
+
+class FrechetInceptionDistance(Metric[jax.Array]):
+    """Frechet Inception Distance between real and generated image
+    distributions (https://arxiv.org/pdf/1706.08500.pdf).
+
+    Args:
+        model: callable mapping images ``(N, 3, H, W)`` to activations
+            ``(N, feature_dim)``. If ``None``, the Flax InceptionV3 port
+            with torchvision pretrained weights is used.
+        feature_dim: activation dimensionality (2048 for InceptionV3).
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import FrechetInceptionDistance
+        >>> metric = FrechetInceptionDistance(model=my_extractor,
+        ...                                   feature_dim=64)
+        >>> metric.update(real_images, is_real=True)
+        >>> metric.update(generated_images, is_real=False)
+        >>> metric.compute()
+    """
+
+    def __init__(
+        self,
+        model: Optional[FeatureExtractor] = None,
+        feature_dim: int = 2048,
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        super().__init__(device=device)
+        self._FID_parameter_check(model=model, feature_dim=feature_dim)
+        if model is None:
+            model = FIDInceptionV3()
+        self.model = model
+        if hasattr(self.model, "to"):
+            self.model.to(self._device)
+
+        self._add_state(
+            "real_sum", jnp.zeros(feature_dim), merge=MergeKind.SUM
+        )
+        self._add_state(
+            "real_cov_sum",
+            jnp.zeros((feature_dim, feature_dim)),
+            merge=MergeKind.SUM,
+        )
+        self._add_state(
+            "fake_sum", jnp.zeros(feature_dim), merge=MergeKind.SUM
+        )
+        self._add_state(
+            "fake_cov_sum",
+            jnp.zeros((feature_dim, feature_dim)),
+            merge=MergeKind.SUM,
+        )
+        self._add_state(
+            "num_real_images", jnp.zeros((), dtype=jnp.int32),
+            merge=MergeKind.SUM,
+        )
+        self._add_state(
+            "num_fake_images", jnp.zeros((), dtype=jnp.int32),
+            merge=MergeKind.SUM,
+        )
+
+    def update(
+        self: TFrechetInceptionDistance, images, is_real: bool
+    ) -> TFrechetInceptionDistance:
+        """Accumulate a batch of real or generated images (N, 3, H, W)."""
+        # dtype-preserving conversion FIRST so the float32 check below sees
+        # the caller's dtype (uint8 images must fail, reference fid.py:266).
+        images = self._input(images)
+        self._FID_update_input_check(images=images, is_real=is_real)
+        images = images.astype(jnp.float32)
+        activations = self.model(images)
+        act_sum, act_cov, batch = _fid_accumulate(activations)
+        if is_real:
+            self.num_real_images = self.num_real_images + batch
+            self.real_sum = self.real_sum + act_sum
+            self.real_cov_sum = self.real_cov_sum + act_cov
+        else:
+            self.num_fake_images = self.num_fake_images + batch
+            self.fake_sum = self.fake_sum + act_sum
+            self.fake_cov_sum = self.fake_cov_sum + act_cov
+        return self
+
+    def compute(self) -> jax.Array:
+        """FID on the accumulated statistics; 0.0 (with a warning) until at
+        least one real and one fake image have been seen."""
+        num_real = int(self.num_real_images)
+        num_fake = int(self.num_fake_images)
+        if num_real == 0 or num_fake == 0:
+            warnings.warn(
+                "Computing FID requires at least 1 real image and 1 fake "
+                f"image, but currently running with {num_real} real images "
+                f"and {num_fake} fake images. Returning 0.0",
+                RuntimeWarning,
+            )
+            return jnp.zeros(())
+        # The eigendecompositions run on host CPU: feature_dim^2 state is
+        # tiny next to the accumulation traffic, compute() is the rare path,
+        # and TPU eigh lowering is slow for these shapes (same division as
+        # the reference, whose torch.linalg.eigvals is a host LAPACK call
+        # on CPU tensors, fid.py:221).
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:  # JAX_PLATFORMS excludes cpu
+            cpu = self._device
+        return _frechet_distance(
+            jax.device_put(self.real_sum, cpu),
+            jax.device_put(self.real_cov_sum, cpu),
+            jax.device_put(self.num_real_images, cpu),
+            jax.device_put(self.fake_sum, cpu),
+            jax.device_put(self.fake_cov_sum, cpu),
+            jax.device_put(self.num_fake_images, cpu),
+        )
+
+    def _FID_parameter_check(
+        self, model: Optional[FeatureExtractor], feature_dim: int
+    ) -> None:
+        if feature_dim is None or feature_dim <= 0:
+            raise RuntimeError("feature_dim has to be a positive integer")
+        if model is None and feature_dim != 2048:
+            raise RuntimeError(
+                "When the default Inception v3 model is used, feature_dim "
+                "needs to be set to 2048"
+            )
+
+    def _FID_update_input_check(self, images: jax.Array, is_real: bool) -> None:
+        if images.ndim != 4:
+            raise ValueError(
+                f"Expected 4D tensor as input. But input has {images.ndim} "
+                "dimenstions."
+            )
+        if images.shape[1] != 3:
+            raise ValueError(
+                f"Expected 3 channels as input. Got {images.shape[1]}."
+            )
+        if type(is_real) != bool:  # noqa: E721 — parity with reference
+            raise ValueError(
+                f"Expected 'real' to be of type bool but got {type(is_real)}.",
+            )
+        if isinstance(self.model, FIDInceptionV3):
+            if images.dtype != jnp.float32:
+                raise ValueError(
+                    "When default inception-v3 model is used, images expected "
+                    f"to be `float32`, but got {images.dtype}."
+                )
+            if debug_validation_enabled():
+                # value range check forces a device sync; debug-mode only
+                # (the reference does it eagerly, fid.py:271-274)
+                if float(jnp.min(images)) < 0 or float(jnp.max(images)) > 1:
+                    raise ValueError(
+                        "When default inception-v3 model is used, images are "
+                        "expected to be in the [0, 1] interval"
+                    )
+
+    def to(
+        self: TFrechetInceptionDistance,
+        device: Union[str, jax.Device],
+        *args: Any,
+        **kwargs: Any,
+    ) -> TFrechetInceptionDistance:
+        super().to(device, *args, **kwargs)
+        if hasattr(self.model, "to"):
+            self.model.to(self._device)
+        return self

@@ -1,0 +1,103 @@
+"""PeakSignalNoiseRatio class metric.
+
+Parity: reference torcheval/metrics/image/psnr.py:24-131. Counter states
+(sum of squared error + observation count) plus running min/max of the
+target when ``data_range`` is auto — SUM/MIN/MAX merge kinds, with the
+derived ``data_range`` recomputed after merging.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.image.psnr import (
+    _psnr_compute,
+    _psnr_param_check,
+    _psnr_update,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+TPeakSignalNoiseRatio = TypeVar(
+    "TPeakSignalNoiseRatio", bound="PeakSignalNoiseRatio"
+)
+
+
+class PeakSignalNoiseRatio(Metric[jax.Array]):
+    """PSNR between accumulated input and target images.
+
+    Functional version:
+    ``torcheval_tpu.metrics.functional.peak_signal_noise_ratio``.
+
+    Args:
+        data_range: the range of the input images; if ``None``, the observed
+            ``target.max() - target.min()`` over all updates is used.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import PeakSignalNoiseRatio
+        >>> metric = PeakSignalNoiseRatio()
+        >>> input = jnp.array([[0.1, 0.2], [0.3, 0.4]])
+        >>> metric.update(input, input * 0.9)
+        >>> metric.compute()
+        Array(19.8767, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        data_range: Optional[float] = None,
+        *,
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        super().__init__(device=device)
+        _psnr_param_check(data_range=data_range)
+        if data_range is None:
+            self.auto_range = True
+            data_range = 0.0
+        else:
+            self.auto_range = False
+        # data_range is derived from min/max when auto; identical across
+        # replicas when fixed — MAX merge is the identity in that case.
+        self._add_state(
+            "data_range", jnp.float32(data_range), merge=MergeKind.MAX
+        )
+        self._add_state("num_observations", jnp.zeros(()), merge=MergeKind.SUM)
+        self._add_state("sum_squared_error", jnp.zeros(()), merge=MergeKind.SUM)
+        self._add_state(
+            "min_target", jnp.float32(jnp.inf), merge=MergeKind.MIN
+        )
+        self._add_state(
+            "max_target", jnp.float32(-jnp.inf), merge=MergeKind.MAX
+        )
+
+    def update(
+        self: TPeakSignalNoiseRatio, input, target
+    ) -> TPeakSignalNoiseRatio:
+        """Accumulate one batch of image pairs, shape (N, C, H, W)."""
+        input = self._input_float(input)
+        target = self._input_float(target)
+        sum_squared_error, num_observations = _psnr_update(input, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.num_observations = self.num_observations + num_observations
+        if self.auto_range:
+            self.min_target = jnp.minimum(jnp.min(target), self.min_target)
+            self.max_target = jnp.maximum(jnp.max(target), self.max_target)
+            self.data_range = self.max_target - self.min_target
+        return self
+
+    def merge_state(
+        self: TPeakSignalNoiseRatio,
+        metrics: Iterable[TPeakSignalNoiseRatio],
+    ) -> TPeakSignalNoiseRatio:
+        super().merge_state(metrics)
+        if self.auto_range:
+            self.data_range = self.max_target - self.min_target
+        return self
+
+    def compute(self) -> jax.Array:
+        """Running PSNR."""
+        return _psnr_compute(
+            self.sum_squared_error, self.num_observations, self.data_range
+        )

@@ -46,6 +46,8 @@ class BLEUScore(Metric[jax.Array]):
         Array(0.65341892, dtype=float32)
     """
 
+    _extra_device_attrs = ("weights",)
+
     def __init__(
         self,
         *,

@@ -51,7 +51,12 @@ class Perplexity(Metric[jax.Array]):
         super().__init__(device=device)
         self.ignore_index = ignore_index
         self._add_state("sum_log_probs", jnp.zeros(()), merge=MergeKind.SUM)
-        self._add_state("num_total", jnp.zeros(()), merge=MergeKind.SUM)
+        # token count is an exact int32 counter (a float32 counter would
+        # stop incrementing at 2^24; the reference holds float64 states,
+        # text/perplexity.py:80-85)
+        self._add_state(
+            "num_total", jnp.zeros((), dtype=jnp.int32), merge=MergeKind.SUM
+        )
 
     def update(self: TPerplexity, input, target) -> TPerplexity:
         """Accumulate one batch.
