@@ -1,0 +1,21 @@
+from torcheval_tpu.tools.flops import (
+    FlopCounter,
+    count_flops,
+    count_flops_backward,
+)
+from torcheval_tpu.tools.module_summary import (
+    ModuleSummary,
+    get_module_summary,
+    get_summary_table,
+    prune_module_summary,
+)
+
+__all__ = [
+    "FlopCounter",
+    "ModuleSummary",
+    "count_flops",
+    "count_flops_backward",
+    "get_module_summary",
+    "get_summary_table",
+    "prune_module_summary",
+]

@@ -1,0 +1,228 @@
+"""FLOP counting for Flax modules and jittable functions.
+
+Parity: reference torcheval/tools/flops.py:147-335 (`flop_mapping`,
+`FlopTensorDispatchMode`). The reference intercepts aten calls with a
+``TorchDispatchMode`` and estimates FLOPs from a 7-op lookup table
+(mm/bmm/addmm/matmul/convolution + backwards). The TPU-native design asks
+the compiler instead: every captured (sub)module is lowered with XLA and
+``compiled.cost_analysis()`` returns the exact post-fusion FLOP count —
+covering every op, not just matmul/conv. Per-module attribution uses Flax
+method interceptors (``nn.intercept_methods``) the way the reference uses
+forward hooks + a module-name stack (reference flops.py:243-311).
+
+Semantics notes (differences from the reference, both favorable):
+- counts are exact program FLOPs after XLA fusion/simplification;
+- backward counts are ``flops(grad(fn)) - flops(fn)`` — the reference
+  instead tags its 7 op kinds during an eager ``.mean().backward()``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _cost_analysis(lowered) -> Dict[str, float]:
+    ca = lowered.compile().cost_analysis()
+    if isinstance(ca, list):  # older jax returns one dict per device program
+        ca = ca[0]
+    return ca or {}
+
+
+def count_flops(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> float:
+    """Exact XLA FLOP count of one call of a jittable function.
+
+    Args may be arrays or ``jax.ShapeDtypeStruct`` avals — nothing is
+    executed, only lowered and compiled.
+
+    >>> count_flops(lambda a, b: a @ b,
+    ...             jax.ShapeDtypeStruct((128, 64), jnp.float32),
+    ...             jax.ShapeDtypeStruct((64, 32), jnp.float32))
+    524288.0
+    """
+    lowered = jax.jit(fn).lower(*args, **kwargs)
+    return float(_cost_analysis(lowered).get("flops", 0.0))
+
+
+def count_flops_backward(
+    fn: Callable[..., Any], *args: Any, **kwargs: Any
+) -> float:
+    """FLOPs of the backward pass of ``fn`` w.r.t. all array arguments.
+
+    Defined as ``flops(grad(mean(fn))) − flops(fn)`` — the gradient program
+    re-runs the primal, so the difference is the backward work. The mean
+    reduction mirrors the reference's ``res.mean().backward()``
+    (reference tools/module_summary.py:266-269).
+    """
+
+    def scalar_fn(*a: Any, **k: Any) -> jax.Array:
+        out = fn(*a, **k)
+        leaves = [
+            x for x in jax.tree_util.tree_leaves(out)
+            if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jnp.inexact)
+        ]
+        return sum(jnp.mean(x) for x in leaves)
+
+    diffable = tuple(
+        i for i, a in enumerate(args)
+        if isinstance(a, (jax.Array, jax.ShapeDtypeStruct, np.ndarray))
+        or isinstance(a, (dict, list, tuple))
+    )
+    if not diffable:
+        return 0.0
+    grad_fn = jax.grad(scalar_fn, argnums=diffable, allow_int=True)
+    total = count_flops(grad_fn, *args, **kwargs)
+    fwd = count_flops(fn, *args, **kwargs)
+    return max(total - fwd, 0.0)
+
+
+class ModuleCall(NamedTuple):
+    """One captured submodule invocation."""
+
+    path: Tuple[str, ...]
+    type_name: str
+    module: Any  # unbound flax module clone
+    in_avals: Tuple[Any, ...]
+    in_arrays: Tuple[Any, ...]
+    out_avals: Tuple[Any, ...]
+    kwargs: Dict[str, Any]
+
+
+def capture_module_calls(
+    module, variables, *args: Any, keep_arrays: bool = False, **kwargs: Any
+) -> Tuple[List[ModuleCall], Any]:
+    """Run one forward of a Flax module, recording every submodule call
+    (path, unbound clone, input/output avals). Returns ``(calls, output)``.
+
+    ``keep_arrays=True`` additionally stores each call's concrete input
+    arrays (needed for per-module timing); left off by default so captured
+    activations don't stay device-resident.
+
+    The JAX analogue of the reference's forward pre/post hook
+    instrumentation (reference flops.py:243-311 / module_summary.py:668-725).
+    """
+    import flax.linen as nn
+
+    calls: List[ModuleCall] = []
+
+    def _aval(x: Any) -> Any:
+        if isinstance(x, (jax.Array, np.ndarray)):
+            return jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype)
+        return x
+
+    def interceptor(next_fun, f_args, f_kwargs, context):
+        if context.method_name != "__call__":
+            return next_fun(*f_args, **f_kwargs)
+        out = next_fun(*f_args, **f_kwargs)
+        out_leaves = tuple(
+            _aval(x)
+            for x in jax.tree_util.tree_leaves(out)
+            if isinstance(x, (jax.Array, np.ndarray))
+        )
+        calls.append(
+            ModuleCall(
+                path=tuple(context.module.path),
+                type_name=type(context.module).__name__,
+                module=context.module.clone(parent=None),
+                in_avals=tuple(_aval(a) for a in f_args),
+                in_arrays=tuple(f_args) if keep_arrays else (),
+                out_avals=out_leaves,
+                kwargs=dict(f_kwargs),
+            )
+        )
+        return out
+
+    with nn.intercept_methods(interceptor):
+        out = module.apply(variables, *args, **kwargs)
+    return calls, out
+
+
+def _subtree(variables: Dict[str, Any], path: Tuple[str, ...]) -> Dict[str, Any]:
+    """Restrict a variables dict to one submodule's subtree."""
+    sub: Dict[str, Any] = {}
+    for collection, tree in variables.items():
+        node = tree
+        ok = True
+        for key in path:
+            if not isinstance(node, dict) or key not in node:
+                ok = False
+                break
+            node = node[key]
+        if ok:
+            sub[collection] = node
+    return sub
+
+
+def module_flops(
+    call: ModuleCall, variables: Dict[str, Any], backward: bool = False
+) -> float:
+    """FLOPs of one captured submodule call (forward, or backward-only)."""
+    sub_vars = _subtree(variables, call.path)
+    sub_avals = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype)
+        if isinstance(x, (jax.Array, np.ndarray))
+        else x,
+        sub_vars,
+    )
+
+    def apply_fn(v, *a):
+        return call.module.apply(v, *a, **call.kwargs)
+
+    if backward:
+        return count_flops_backward(apply_fn, sub_avals, *call.in_avals)
+    return count_flops(apply_fn, sub_avals, *call.in_avals)
+
+
+class FlopCounter:
+    """Per-module FLOP counts for a Flax module forward (+ backward).
+
+    The reference analogue is ``FlopTensorDispatchMode`` (flops.py:173-335):
+    ``flop_counts`` maps the dotted module path (``""`` for the root) to its
+    exact XLA FLOP count, parents inclusive of children — the same
+    attribution the reference's module-stack produces.
+
+    >>> fc = FlopCounter(module, variables)
+    >>> out = fc.run(x)
+    >>> fc.flop_counts[""], fc.flop_counts["encoder"]
+    """
+
+    def __init__(self, module, variables) -> None:
+        self.module = module
+        self.variables = variables
+        self.flop_counts: Dict[str, float] = {}
+        self.flop_counts_backward: Dict[str, float] = {}
+        self._calls: List[ModuleCall] = []
+
+    def run(self, *args: Any, backward: bool = False, **kwargs: Any) -> Any:
+        """Forward the wrapped module, populating ``flop_counts`` (and
+        ``flop_counts_backward`` when requested)."""
+        self._calls, out = capture_module_calls(
+            self.module, self.variables, *args, **kwargs
+        )
+        self.flop_counts = {}
+        self.flop_counts_backward = {}
+        for call in self._calls:
+            name = ".".join(call.path)
+            try:
+                self.flop_counts[name] = (
+                    self.flop_counts.get(name, 0.0)
+                    + module_flops(call, self.variables)
+                )
+            except Exception:
+                self.flop_counts[name] = -1.0  # not independently lowerable
+            if backward:
+                try:
+                    self.flop_counts_backward[name] = (
+                        self.flop_counts_backward.get(name, 0.0)
+                        + module_flops(call, self.variables, backward=True)
+                    )
+                except Exception:
+                    self.flop_counts_backward[name] = -1.0
+        return out
+
+    def reset(self) -> None:
+        self.flop_counts = {}
+        self.flop_counts_backward = {}
