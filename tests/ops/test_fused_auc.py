@@ -1,0 +1,111 @@
+"""Fused approximate-AUC op tests: all three backends (pure XLA, C++ XLA
+custom-call, Pallas-interpret) against the exact AUROC kernel and the
+reference oracle."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics.functional import binary_auroc
+from torcheval_tpu.ops import fused_auc, fused_auc_histogram
+
+REF_M, REF_F = load_reference_metrics()
+RNG = np.random.default_rng(31)
+
+BACKENDS = ["xla", "native", "pallas"]
+
+
+def _informative(n, tasks=None):
+    shape = (n,) if tasks is None else (tasks, n)
+    s = RNG.random(shape).astype(np.float32)
+    t = (RNG.random(shape) < s).astype(np.float32)
+    return s, t
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_fused_auc_close_to_exact(backend):
+    s, t = _informative(20000)
+    w = RNG.random(20000).astype(np.float32)
+    exact = float(binary_auroc(s, t, weight=w))
+    fused = float(fused_auc(s, t, w, backend=backend))
+    assert abs(fused - exact) < 1e-3
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_fused_auc_multitask(backend):
+    s, t = _informative(5000, tasks=3)
+    exact = np.asarray(binary_auroc(s, t, num_tasks=3))
+    fused = np.asarray(fused_auc(s, t, backend=backend))
+    assert fused.shape == (3,)
+    np.testing.assert_allclose(fused, exact, atol=1e-3)
+
+
+def test_backends_agree_exactly():
+    """All histogram backends compute the identical sufficient statistic."""
+    s, t = _informative(4097)  # non-multiple of the pallas chunk
+    w = RNG.random(4097).astype(np.float32)
+    hists = {
+        b: np.asarray(fused_auc_histogram(s, t, w, backend=b, num_bins=512))
+        for b in BACKENDS
+    }
+    np.testing.assert_allclose(hists["xla"], hists["native"], atol=1e-3)
+    np.testing.assert_allclose(hists["xla"], hists["pallas"], atol=1e-3)
+    # mass conservation: total histogram weight == total sample weight
+    np.testing.assert_allclose(hists["xla"].sum(), w.sum(), rtol=1e-5)
+
+
+def test_fused_matches_reference_oracle():
+    s, t = _informative(10000)
+    ref = float(REF_F.binary_auroc(torch.tensor(s), torch.tensor(t)))
+    for backend in BACKENDS:
+        assert abs(float(fused_auc(s, t, backend=backend)) - ref) < 1e-3
+
+
+def test_fused_degenerate_and_perfect():
+    assert float(fused_auc(jnp.array([0.2, 0.8]), jnp.array([1, 1]))) == 0.5
+    assert float(fused_auc(jnp.array([0.2, 0.8]), jnp.array([0, 0]))) == 0.5
+    assert (
+        float(fused_auc(jnp.array([0.1, 0.5, 0.7, 0.8]), jnp.array([0, 0, 1, 1])))
+        == 1.0
+    )
+    # all-tied scores -> 0.5
+    assert float(fused_auc(jnp.full(10, 0.5), jnp.arange(10) % 2)) == 0.5
+
+
+def test_binary_auroc_use_fused_flag():
+    s, t = _informative(8000)
+    exact = float(binary_auroc(s, t))
+    fused = float(binary_auroc(s, t, use_fused=True))
+    legacy_alias = float(binary_auroc(s, t, use_fbgemm=True))
+    assert abs(fused - exact) < 1e-3
+    assert fused == legacy_alias
+
+
+def test_invalid_backend():
+    with pytest.raises(ValueError, match="backend must be"):
+        fused_auc(jnp.zeros(4), jnp.zeros(4), backend="cuda")
+
+
+def test_small_weights_not_shrunk():
+    """Regression: Wp*Wn < 1 must not scale the AUC (denom clamp bug)."""
+    v = fused_auc(
+        jnp.array([0.1, 0.9]), jnp.array([0.0, 1.0]), jnp.array([0.1, 0.1])
+    )
+    assert float(v) == 1.0
+
+
+def test_unbounded_scores_logits():
+    """Regression: scores outside [0, 1] (logits) are rank-normalized, not
+    clamped into the edge bins."""
+    logits = jnp.array([1.5, 2.5, 3.5, -4.0])
+    target = jnp.array([0, 1, 1, 0])
+    assert float(fused_auc(logits, target)) == 1.0
+    s, t = _informative(5000)
+    wide = s * 80.0 - 40.0  # same ranks, logit-like range
+    np.testing.assert_allclose(
+        float(fused_auc(wide, t)), float(fused_auc(s, t)), atol=2e-3
+    )

@@ -6,9 +6,12 @@ compute kernels :115-235). Tie handling via the static-shape run-end
 propagation in ``_curve_kernels`` (exact parity with the reference's
 masked_scatter compaction).
 
-``use_fused=True`` selects the sort-free approximate kernel — the analogue of
-the reference's opt-in fbgemm_gpu CUDA AUC (reference auroc.py:161-173),
-which skips tie masking; ``use_fbgemm`` is accepted as an alias.
+``use_fused=True`` selects the sort-free fused kernel
+(``torcheval_tpu.ops.fused_auc``: Pallas on TPU, C++ XLA custom-call on CPU)
+— the analogue of the reference's opt-in fbgemm_gpu CUDA AUC (reference
+auroc.py:161-173); ``use_fbgemm`` is accepted as an alias. The fused kernel
+min/max-normalizes scores per task (AUC is rank-invariant) and is exact up
+to its bin resolution.
 """
 
 from __future__ import annotations
@@ -33,30 +36,17 @@ def _binary_auroc_compute_jit(
     return auroc_from_cumulators(cum_tp, cum_fp)
 
 
-@jax.jit
-def _binary_auroc_approx_jit(
-    input: jax.Array, target: jax.Array, weight: Optional[jax.Array]
-) -> jax.Array:
-    # fbgemm-style approximation: sorted trapezoid WITHOUT tie compaction.
-    order = jnp.argsort(-input, axis=-1, stable=True)
-    starget = jnp.take_along_axis(target, order, axis=-1).astype(jnp.float32)
-    if weight is None:
-        sweight = jnp.ones_like(starget)
-    else:
-        sweight = jnp.take_along_axis(weight, order, axis=-1).astype(jnp.float32)
-    cum_tp = jnp.cumsum(sweight * starget, axis=-1)
-    cum_fp = jnp.cumsum(sweight * (1.0 - starget), axis=-1)
-    return auroc_from_cumulators(cum_tp, cum_fp)
-
-
 def _binary_auroc_compute(
     input: jax.Array,
     target: jax.Array,
     weight: Optional[jax.Array] = None,
     use_fused: bool = False,
 ) -> jax.Array:
-    kernel = _binary_auroc_approx_jit if use_fused else _binary_auroc_compute_jit
-    return kernel(input, target, weight)
+    if use_fused:
+        from torcheval_tpu.ops import fused_auc
+
+        return fused_auc(input, target, weight)
+    return _binary_auroc_compute_jit(input, target, weight)
 
 
 def _binary_auroc_update_input_check(

@@ -1,0 +1,6 @@
+from torcheval_tpu.ops.fused_auc import (
+    fused_auc,
+    fused_auc_histogram,
+)
+
+__all__ = ["fused_auc", "fused_auc_histogram"]

@@ -1,0 +1,78 @@
+"""Native (C++) op library: build-on-first-use loader.
+
+Compiles ``fused_auc.cc`` against the XLA FFI headers shipped with jaxlib
+(``jax.ffi.include_dir()``) into a shared library cached next to the source,
+and registers the handlers with XLA's CPU backend. The loader degrades
+gracefully: if no C++ toolchain is available, callers fall back to the pure
+XLA implementation (mirroring the reference's optional fbgemm_gpu import
+guard, reference functional/classification/auroc.py:12-21).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "fused_auc.cc")
+_LIB = os.path.join(os.path.dirname(__file__), "libtorcheval_tpu_native.so")
+
+_lock = threading.Lock()
+_registered: Optional[bool] = None
+
+
+def _build() -> bool:
+    import jax.ffi
+
+    cmd = [
+        "g++",
+        "-O3",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        f"-I{jax.ffi.include_dir()}",
+        _SRC,
+        "-o",
+        _LIB,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return True
+    except Exception as e:  # missing toolchain / headers: degrade
+        _logger.info("native fused_auc build skipped: %s", e)
+        return False
+
+
+def ensure_registered() -> bool:
+    """Build (if needed) and register the native handlers with XLA CPU.
+    Returns True when the ``torcheval_fused_auc_histogram`` FFI target is
+    usable."""
+    global _registered
+    with _lock:
+        if _registered is not None:
+            return _registered
+        try:
+            import jax.ffi
+
+            if not os.path.exists(_LIB) or os.path.getmtime(
+                _LIB
+            ) < os.path.getmtime(_SRC):
+                if not _build():
+                    _registered = False
+                    return False
+            lib = ctypes.cdll.LoadLibrary(_LIB)
+            jax.ffi.register_ffi_target(
+                "torcheval_fused_auc_histogram",
+                jax.ffi.pycapsule(lib.FusedAucHistogram),
+                platform="cpu",
+            )
+            _registered = True
+        except Exception as e:
+            _logger.info("native fused_auc registration skipped: %s", e)
+            _registered = False
+        return _registered

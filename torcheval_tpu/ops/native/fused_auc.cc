@@ -1,0 +1,70 @@
+// Fused AUC histogram — C++ XLA custom-call (CPU host kernel).
+//
+// The native component of the fused approximate-AUC op: the TPU path is the
+// Pallas kernel in torcheval_tpu/ops/fused_auc.py; this is the host-side
+// equivalent, registered with XLA through the FFI API so it participates in
+// jit programs on the CPU backend. Parity target: the role of fbgemm_gpu's
+// fused CUDA AUC kernel in the reference
+// (torcheval/metrics/functional/classification/auroc.py:161-173).
+//
+// Inputs:  scores (T, N) f32 in [0, 1] (clamped), labels (T, N) f32 {0, 1},
+//          weights (T, N) f32.
+// Outputs: hist (T, 2, B) f32 — per task, row 0 = positive-weight histogram,
+//          row 1 = negative-weight histogram over B equal score bins.
+//
+// Build: g++ -O3 -march=native -shared -fPIC (see native/build.py).
+
+#include <algorithm>
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error FusedAucHistogramImpl(ffi::Buffer<ffi::F32> scores,
+                                        ffi::Buffer<ffi::F32> labels,
+                                        ffi::Buffer<ffi::F32> weights,
+                                        ffi::ResultBuffer<ffi::F32> hist) {
+  const auto dims = scores.dimensions();
+  if (dims.size() != 2) {
+    return ffi::Error::InvalidArgument("scores must be rank 2 (tasks, n)");
+  }
+  const int64_t num_tasks = dims[0];
+  const int64_t n = dims[1];
+  const auto hist_dims = hist->dimensions();
+  if (hist_dims.size() != 3 || hist_dims[0] != num_tasks ||
+      hist_dims[1] != 2) {
+    return ffi::Error::InvalidArgument("hist must be (tasks, 2, bins)");
+  }
+  const int64_t bins = hist_dims[2];
+
+  const float* s = scores.typed_data();
+  const float* l = labels.typed_data();
+  const float* w = weights.typed_data();
+  float* h = hist->typed_data();
+  std::fill(h, h + num_tasks * 2 * bins, 0.0f);
+
+  for (int64_t t = 0; t < num_tasks; ++t) {
+    float* pos = h + t * 2 * bins;
+    float* neg = pos + bins;
+    const int64_t base = t * n;
+    for (int64_t i = 0; i < n; ++i) {
+      float sc = s[base + i];
+      sc = sc < 0.0f ? 0.0f : (sc > 1.0f ? 1.0f : sc);
+      int64_t b = static_cast<int64_t>(sc * static_cast<float>(bins));
+      if (b >= bins) b = bins - 1;
+      const float wi = w[base + i];
+      const float li = l[base + i];
+      pos[b] += wi * li;
+      neg[b] += wi * (1.0f - li);
+    }
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(FusedAucHistogram, FusedAucHistogramImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());

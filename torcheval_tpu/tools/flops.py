@@ -66,11 +66,18 @@ def count_flops_backward(
         ]
         return sum(jnp.mean(x) for x in leaves)
 
-    diffable = tuple(
-        i for i, a in enumerate(args)
-        if isinstance(a, (jax.Array, jax.ShapeDtypeStruct, np.ndarray))
-        or isinstance(a, (dict, list, tuple))
-    )
+    def _is_diffable(a: Any) -> bool:
+        if isinstance(a, (jax.Array, jax.ShapeDtypeStruct, np.ndarray)):
+            return True
+        if isinstance(a, (dict, list, tuple)):
+            leaves = jax.tree_util.tree_leaves(a)
+            return bool(leaves) and all(
+                isinstance(x, (jax.Array, jax.ShapeDtypeStruct, np.ndarray))
+                for x in leaves
+            )
+        return False
+
+    diffable = tuple(i for i, a in enumerate(args) if _is_diffable(a))
     if not diffable:
         return 0.0
     grad_fn = jax.grad(scalar_fn, argnums=diffable, allow_int=True)
