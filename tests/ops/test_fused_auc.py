@@ -90,6 +90,19 @@ def test_invalid_backend():
         fused_auc(jnp.zeros(4), jnp.zeros(4), backend="cuda")
 
 
+def test_1d_weight_broadcasts_over_tasks():
+    """Regression: a 1-D weight with (tasks, n) scores must broadcast
+    identically on every backend (the native kernel indexes a dense
+    (tasks, n) buffer)."""
+    s, t = _informative(1000, tasks=3)
+    w = RNG.random(1000).astype(np.float32)
+    vals = [
+        np.asarray(fused_auc(s, t, w, backend=b)) for b in BACKENDS
+    ]
+    np.testing.assert_allclose(vals[0], vals[1], atol=1e-4)
+    np.testing.assert_allclose(vals[0], vals[2], atol=1e-4)
+
+
 def test_small_weights_not_shrunk():
     """Regression: Wp*Wn < 1 must not scale the AUC (denom clamp bug)."""
     v = fused_auc(

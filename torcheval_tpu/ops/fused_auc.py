@@ -57,11 +57,17 @@ def _as_2d(
 ) -> Tuple[jax.Array, jax.Array, jax.Array, bool]:
     squeeze = input.ndim == 1
     scores = jnp.atleast_2d(input).astype(jnp.float32)
-    labels = jnp.atleast_2d(target).astype(jnp.float32)
+    # broadcast labels/weights to the full (tasks, n) shape: the native C++
+    # kernel indexes [t*n + i] and must never see a smaller buffer
+    labels = jnp.broadcast_to(
+        jnp.atleast_2d(target).astype(jnp.float32), scores.shape
+    )
     if weight is None:
         weights = jnp.ones_like(scores)
     else:
-        weights = jnp.atleast_2d(weight).astype(jnp.float32)
+        weights = jnp.broadcast_to(
+            jnp.atleast_2d(weight).astype(jnp.float32), scores.shape
+        )
     return scores, labels, weights, squeeze
 
 
@@ -109,23 +115,30 @@ def _histogram_xla(
 
 # ------------------------------------------------------------------ pallas
 
-def _hist_kernel(scores_ref, wpos_ref, wneg_ref, hist_ref):
-    """One grid step: bin a (1, CHUNK) score block and accumulate the
-    (2, bins) histogram via an MXU contraction against the one-hot bins."""
+_BIN_TILE = 512  # (CHUNK, _BIN_TILE) f32 one-hot = 2 MiB, well under VMEM
+
+
+def _hist_kernel(num_bins, scores_ref, wpos_ref, wneg_ref, hist_ref):
+    """One grid step: bin a (1, CHUNK) score block and accumulate this
+    step's (2, BIN_TILE) histogram slab via an MXU contraction against the
+    tile-local one-hot bins. Bin tiling keeps the one-hot intermediate at
+    CHUNK x BIN_TILE (2 MiB) regardless of total bin count."""
     from jax.experimental import pallas as pl
 
-    num_bins = hist_ref.shape[2]
-    j = pl.program_id(1)
+    bin_tile = hist_ref.shape[2]
+    tile_start = pl.program_id(1) * bin_tile
+    k = pl.program_id(2)  # chunk index — innermost, sweeps the samples
 
-    @pl.when(j == 0)
+    @pl.when(k == 0)
     def _init():
         hist_ref[...] = jnp.zeros_like(hist_ref)
 
     s = jnp.clip(scores_ref[0, :], 0.0, 1.0)
     bins = jnp.minimum((s * num_bins).astype(jnp.int32), num_bins - 1)
+    local = bins - tile_start  # in [0, bin_tile) iff the bin is in this tile
     onehot = (
-        bins[:, None]
-        == jax.lax.broadcasted_iota(jnp.int32, (s.shape[0], num_bins), 1)
+        local[:, None]
+        == jax.lax.broadcasted_iota(jnp.int32, (s.shape[0], bin_tile), 1)
     ).astype(jnp.float32)
     stacked = jnp.concatenate(
         [wpos_ref[0, :][None, :], wneg_ref[0, :][None, :]], axis=0
@@ -156,21 +169,25 @@ def _histogram_pallas(
     wpos = weights * labels
     wneg = weights * (1.0 - labels)
 
-    grid = (num_tasks, n_padded // _CHUNK)
-    return pl.pallas_call(
-        _hist_kernel,
+    bin_tile = min(_BIN_TILE, num_bins)
+    bins_padded = -(-num_bins // bin_tile) * bin_tile  # top pad bins stay 0
+
+    grid = (num_tasks, bins_padded // bin_tile, n_padded // _CHUNK)
+    hist = pl.pallas_call(
+        functools.partial(_hist_kernel, num_bins),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, _CHUNK), lambda t, j: (t, j)),
-            pl.BlockSpec((1, _CHUNK), lambda t, j: (t, j)),
-            pl.BlockSpec((1, _CHUNK), lambda t, j: (t, j)),
+            pl.BlockSpec((1, _CHUNK), lambda t, b, k: (t, k)),
+            pl.BlockSpec((1, _CHUNK), lambda t, b, k: (t, k)),
+            pl.BlockSpec((1, _CHUNK), lambda t, b, k: (t, k)),
         ],
-        out_specs=pl.BlockSpec((1, 2, num_bins), lambda t, j: (t, 0, 0)),
+        out_specs=pl.BlockSpec((1, 2, bin_tile), lambda t, b, k: (t, 0, b)),
         out_shape=jax.ShapeDtypeStruct(
-            (num_tasks, 2, num_bins), jnp.float32
+            (num_tasks, 2, bins_padded), jnp.float32
         ),
         interpret=interpret,
     )(scores, wpos, wneg)
+    return hist[:, :, :num_bins]
 
 
 # ------------------------------------------------------------------ native

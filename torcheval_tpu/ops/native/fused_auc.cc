@@ -31,6 +31,13 @@ static ffi::Error FusedAucHistogramImpl(ffi::Buffer<ffi::F32> scores,
   }
   const int64_t num_tasks = dims[0];
   const int64_t n = dims[1];
+  const auto ldims = labels.dimensions();
+  const auto wdims = weights.dimensions();
+  if (ldims.size() != 2 || ldims[0] != num_tasks || ldims[1] != n ||
+      wdims.size() != 2 || wdims[0] != num_tasks || wdims[1] != n) {
+    return ffi::Error::InvalidArgument(
+        "labels/weights must match scores shape (tasks, n)");
+  }
   const auto hist_dims = hist->dimensions();
   if (hist_dims.size() != 3 || hist_dims[0] != num_tasks ||
       hist_dims[1] != 2) {

@@ -67,13 +67,15 @@ def count_flops_backward(
         return sum(jnp.mean(x) for x in leaves)
 
     def _is_diffable(a: Any) -> bool:
-        if isinstance(a, (jax.Array, jax.ShapeDtypeStruct, np.ndarray)):
+        kinds = (jax.Array, jax.ShapeDtypeStruct, np.ndarray)
+        if isinstance(a, kinds):
             return True
         if isinstance(a, (dict, list, tuple)):
+            # a pytree qualifies if it holds at least one array AND nothing
+            # grad can't trace (shape tuples of python ints must stay static)
             leaves = jax.tree_util.tree_leaves(a)
-            return bool(leaves) and all(
-                isinstance(x, (jax.Array, jax.ShapeDtypeStruct, np.ndarray))
-                for x in leaves
+            return any(isinstance(x, kinds) for x in leaves) and all(
+                isinstance(x, (*kinds, float, int)) for x in leaves
             )
         return False
 
