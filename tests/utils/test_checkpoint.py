@@ -1,0 +1,164 @@
+"""Checkpoint/resume round-trips through the Orbax-backed helpers, across
+every TState kind (tensor counters, list buffers, dict states, int/float,
+windowed ring buffers)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    MulticlassAccuracy,
+    Throughput,
+    WindowedBinaryNormalizedEntropy,
+    WordErrorRate,
+)
+from torcheval_tpu.utils import load_metric_state, save_metric_state
+from torcheval_tpu.utils.test_utils.dummy_metric import DummySumDictStateMetric
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    assert_result_close,
+)
+
+RNG = np.random.default_rng(3)
+
+
+def _roundtrip(tmp_path, metric, fresh):
+    save_metric_state(metric, str(tmp_path / "ck"))
+    load_metric_state(fresh, str(tmp_path / "ck"))
+    return fresh
+
+
+def test_counter_state_roundtrip(tmp_path):
+    m = MulticlassAccuracy()
+    m.update(jnp.asarray(RNG.random((16, 4)), jnp.float32), jnp.asarray(RNG.integers(0, 4, 16)))
+    restored = _roundtrip(tmp_path, m, MulticlassAccuracy())
+    assert_result_close(restored.compute(), m.compute())
+    # resumable: updates continue after restore
+    restored.update(jnp.zeros((4, 4)), jnp.zeros(4, dtype=jnp.int32))
+
+
+def test_list_buffer_state_roundtrip(tmp_path):
+    m = BinaryAUROC()
+    for _ in range(3):
+        x = RNG.random(20).astype(np.float32)
+        m.update(x, (RNG.random(20) < x).astype(np.float32))
+    restored = _roundtrip(tmp_path, m, BinaryAUROC())
+    assert_result_close(restored.compute(), m.compute())
+
+
+def test_empty_list_state_roundtrip(tmp_path):
+    m = BinaryAUROC()  # no updates: empty buffers
+    restored = _roundtrip(tmp_path, m, BinaryAUROC())
+    assert restored.inputs == []
+
+
+def test_float_state_roundtrip(tmp_path):
+    m = Throughput()
+    m.update(100, 2.5)
+    restored = _roundtrip(tmp_path, m, Throughput())
+    assert_result_close(restored.compute(), m.compute())
+
+
+def test_host_float_text_state_roundtrip(tmp_path):
+    m = WordErrorRate()
+    m.update(["a b c"], ["a b d"])
+    restored = _roundtrip(tmp_path, m, WordErrorRate())
+    assert_result_close(restored.compute(), m.compute())
+
+
+def test_dict_state_roundtrip(tmp_path):
+    m = DummySumDictStateMetric()
+    m.update("a", jnp.asarray(2.0))
+    m.update("b", jnp.asarray(3.0))
+    restored = _roundtrip(tmp_path, m, DummySumDictStateMetric())
+    assert_result_close(restored.compute(), m.compute())
+    # restored dict keeps auto-zero semantics for unseen keys
+    restored.update("c", jnp.asarray(1.0))
+
+
+def test_window_ring_buffer_roundtrip(tmp_path):
+    m = WindowedBinaryNormalizedEntropy(max_num_updates=4)
+    for _ in range(6):
+        x = np.clip(RNG.random(10), 0.01, 0.99).astype(np.float64)
+        m.update(x, (RNG.random(10) < 0.5).astype(np.float64))
+    restored = _roundtrip(
+        tmp_path, m, WindowedBinaryNormalizedEntropy(max_num_updates=4)
+    )
+    assert_result_close(restored.compute(), m.compute())
+
+
+def test_collection_roundtrip(tmp_path):
+    acc = MulticlassAccuracy()
+    acc.update(jnp.asarray(RNG.random((8, 3)), jnp.float32), jnp.asarray(RNG.integers(0, 3, 8)))
+    auroc = BinaryAUROC()
+    x = RNG.random(16).astype(np.float32)
+    auroc.update(x, (RNG.random(16) < x).astype(np.float32))
+    save_metric_state({"acc": acc, "auroc": auroc}, str(tmp_path / "coll"))
+    fresh = {"acc": MulticlassAccuracy(), "auroc": BinaryAUROC()}
+    load_metric_state(fresh, str(tmp_path / "coll"))
+    assert_result_close(fresh["acc"].compute(), acc.compute())
+    assert_result_close(fresh["auroc"].compute(), auroc.compute())
+
+
+def test_collection_strict_mismatch_both_directions(tmp_path):
+    acc = MulticlassAccuracy()
+    save_metric_state({"acc": acc}, str(tmp_path / "c2"))
+    # collection requests a metric the checkpoint lacks
+    with pytest.raises(RuntimeError, match="missing state for \\['other'\\]"):
+        load_metric_state(
+            {"acc": MulticlassAccuracy(), "other": BinaryAUROC()},
+            str(tmp_path / "c2"),
+        )
+    # checkpoint holds state the collection doesn't claim
+    save_metric_state(
+        {"acc": acc, "extra": MulticlassAccuracy()}, str(tmp_path / "c3")
+    )
+    with pytest.raises(RuntimeError, match="unclaimed saved state"):
+        load_metric_state({"acc": MulticlassAccuracy()}, str(tmp_path / "c3"))
+    # non-strict: loads what exists
+    load_metric_state(
+        {"acc": MulticlassAccuracy(), "other": BinaryAUROC()},
+        str(tmp_path / "c2"),
+        strict=False,
+    )
+
+
+def test_single_vs_collection_kind_mismatch(tmp_path):
+    acc = MulticlassAccuracy()
+    save_metric_state({"acc": acc}, str(tmp_path / "coll"))
+    with pytest.raises(RuntimeError, match="holds a metric collection"):
+        load_metric_state(MulticlassAccuracy(), str(tmp_path / "coll"))
+    save_metric_state(acc, str(tmp_path / "single"))
+    with pytest.raises(RuntimeError, match="holds a single metric"):
+        load_metric_state(
+            {"acc": MulticlassAccuracy()}, str(tmp_path / "single")
+        )
+
+
+def test_window_cursor_survives_resume(tmp_path):
+    """Regression: a restored windowed metric must keep overwriting the
+    OLDEST ring column; a parallel uninterrupted metric is the oracle."""
+    rng = np.random.default_rng(8)
+    batches = [
+        (
+            np.clip(rng.random(10), 0.01, 0.99).astype(np.float64),
+            (rng.random(10) < 0.5).astype(np.float64),
+        )
+        for _ in range(10)
+    ]
+    uninterrupted = WindowedBinaryNormalizedEntropy(max_num_updates=4)
+    first = WindowedBinaryNormalizedEntropy(max_num_updates=4)
+    for x, t in batches[:6]:
+        uninterrupted.update(x, t)
+        first.update(x, t)
+    save_metric_state(first, str(tmp_path / "cursor"))
+    resumed = load_metric_state(
+        WindowedBinaryNormalizedEntropy(max_num_updates=4),
+        str(tmp_path / "cursor"),
+    )
+    assert resumed.next_inserted == first.next_inserted == 2
+    for x, t in batches[6:]:
+        uninterrupted.update(x, t)
+        resumed.update(x, t)
+    assert_result_close(resumed.compute(), uninterrupted.compute())

@@ -30,7 +30,38 @@ from torcheval_tpu.metrics.metric import MergeKind, Metric
 TWindowed = TypeVar("TWindowed", bound="WindowedTaskCounterMetric")
 
 
-class WindowedTaskCounterMetric(Metric):
+class RingCursorSerializationMixin:
+    """Snapshot/restore of the ring-buffer write cursor.
+
+    The cursor is a plain attribute (state-registry parity with the
+    reference, window/normalized_entropy.py:100), but a resumed metric must
+    not overwrite the wrong column — so ``state_dict`` carries it explicitly
+    and ``load_state_dict`` restores (or re-derives) it.
+    """
+
+    _cursor_attr = "next_inserted"
+    _cursor_total_state = "total_updates"
+    _cursor_capacity_state = "max_num_updates"
+
+    def state_dict(self):
+        snapshot = super().state_dict()
+        snapshot[self._cursor_attr] = getattr(self, self._cursor_attr)
+        return snapshot
+
+    def load_state_dict(self, state_dict, strict: bool = True) -> None:
+        state_dict = dict(state_dict)
+        cursor = state_dict.pop(self._cursor_attr, None)
+        super().load_state_dict(state_dict, strict=strict)
+        if cursor is None:
+            # legacy snapshot without a cursor: re-derive (exact for any
+            # never-merged history)
+            cursor = getattr(self, self._cursor_total_state) % getattr(
+                self, self._cursor_capacity_state
+            )
+        setattr(self, self._cursor_attr, int(cursor))
+
+
+class WindowedTaskCounterMetric(RingCursorSerializationMixin, Metric):
     """Base for windowed metrics whose state is per-update counters.
 
     Subclasses call ``_init_window_states(counter_names, ...)`` in

@@ -21,11 +21,12 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_update_input_check,
 )
 from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.window._base import RingCursorSerializationMixin
 
 TWindowedBinaryAUROC = TypeVar("TWindowedBinaryAUROC", bound="WindowedBinaryAUROC")
 
 
-class WindowedBinaryAUROC(Metric[jax.Array]):
+class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
     """AUROC over the last ``max_num_samples`` samples.
 
     Examples::
@@ -37,6 +38,9 @@ class WindowedBinaryAUROC(Metric[jax.Array]):
         >>> metric.compute()
         Array(0.6666667, dtype=float32)
     """
+
+    _cursor_total_state = "total_samples"
+    _cursor_capacity_state = "max_num_samples"
 
     def __init__(
         self,

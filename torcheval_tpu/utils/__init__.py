@@ -1,3 +1,7 @@
+from torcheval_tpu.utils.checkpoint import (
+    load_metric_state,
+    save_metric_state,
+)
 from torcheval_tpu.utils.random_data import (
     get_rand_data_binary,
     get_rand_data_binned_binary,
@@ -12,4 +16,6 @@ __all__ = [
     "get_rand_data_binned_binary",
     "get_rand_data_multiclass",
     "get_rand_data_multilabel",
+    "load_metric_state",
+    "save_metric_state",
 ]
