@@ -1,0 +1,6 @@
+from torcheval_tpu.parallel.ring_attention import (
+    dense_reference_attention,
+    ring_attention,
+)
+
+__all__ = ["dense_reference_attention", "ring_attention"]

@@ -1,0 +1,130 @@
+"""Ring attention: exact attention over sequence-sharded inputs.
+
+Long-context evaluation shards the sequence axis across devices; computing
+exact attention then requires every query block to see every key/value
+block. Ring attention does this with O(seq/P) memory per device and P-1
+``lax.ppermute`` hops over the ICI ring: each step combines the resident
+query block with the currently-held K/V block using the online-softmax
+(flash) accumulation, then rotates K/V to the next device — communication
+fully overlappable with compute by XLA.
+
+The reference has no sequence parallelism (it is a metrics library;
+SURVEY.md section 5.7) — this primitive exists so the *evaluation* stack
+(flagship model forward + metric updates, see ``__graft_entry__``) scales to
+long sequences the way the surrounding TPU training stack does. The
+blockwise formulation follows the public ring-attention recipe (Liu et al.,
+2023, arXiv:2310.01889).
+
+Use inside ``shard_map`` over a mesh with a sequence axis::
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(None, "sp", None, None),) * 3,
+             out_specs=P(None, "sp", None, None))
+    def attn(q, k, v):
+        return ring_attention(q, k, v, axis_name="sp", causal=True)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/max() NaN-free
+
+
+def _block_attend(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offset: jax.Array,
+    kv_offset: jax.Array,
+    causal: bool,
+    scale: float,
+):
+    """Scores of one (q-block, kv-block) pair with global-position causal
+    masking. Shapes: q (B, nq, H, D), k/v (B, nk, H, D)."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        kv_pos = kv_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+    return scores
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact multi-head attention over a sequence-sharded (B, S/P, H, D)
+    layout; must be called inside ``shard_map``/``pjit`` with ``axis_name``
+    naming the sequence mesh axis.
+
+    Returns the local (B, S/P, H, D) output block. Numerically equivalent to
+    dense softmax attention over the gathered sequence (online-softmax
+    accumulation is exact, not approximate).
+    """
+    num_shards = lax.psum(1, axis_name)
+    my_index = lax.axis_index(axis_name)
+    batch, nq, heads, dim = q.shape
+    scale = scale if scale is not None else dim ** -0.5
+    block = nq  # equal-size sequence blocks per device
+
+    q_offset = my_index * block
+
+    # running online-softmax state
+    acc = jnp.zeros((batch, heads, nq, dim), jnp.float32)
+    denom = jnp.zeros((batch, heads, nq), jnp.float32)
+    running_max = jnp.full((batch, heads, nq), NEG_INF, jnp.float32)
+
+    def step(carry, _):
+        acc, denom, running_max, k_blk, v_blk, kv_index = carry
+        kv_offset = kv_index * block
+        scores = _block_attend(q, k_blk, v_blk, q_offset, kv_offset, causal, scale)
+        blk_max = jnp.max(scores, axis=-1)
+        new_max = jnp.maximum(running_max, blk_max)
+        correction = jnp.exp(running_max - new_max)
+        p = jnp.exp(scores - new_max[..., None])
+        denom = denom * correction + jnp.sum(p, axis=-1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        # rotate K/V one hop around the ring (device i -> i+1)
+        perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        kv_index = lax.ppermute(kv_index, axis_name, perm)
+        return (acc, denom, new_max, k_blk, v_blk, kv_index), None
+
+    carry = (acc, denom, running_max, k, v, my_index)
+    carry, _ = lax.scan(step, carry, None, length=num_shards)
+    acc, denom, _, _, _, _ = carry
+
+    # fully-masked rows cannot occur under causal=True (each q sees itself);
+    # guard anyway so non-causal edge shards stay finite
+    out = acc / jnp.maximum(denom, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def dense_reference_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Unsharded oracle with identical semantics (tests / single device)."""
+    dim = q.shape[-1]
+    scale = scale if scale is not None else dim ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        nq, nk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.arange(nq)[:, None] >= jnp.arange(nk)[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
