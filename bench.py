@@ -1,30 +1,62 @@
-"""Benchmark: metric update throughput on the local accelerator.
+"""Benchmark suite: BASELINE.md configs on the local accelerator.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line to stdout:
 
-Workload (BASELINE.md config 1/3): MulticlassAccuracy updates inside a jitted
-eval step — batch 1024 x 100 classes per update, counters accumulated on
-device, no host syncs. The baseline is the reference torcheval (torch, CPU —
-the only backend it can use here) on the identical workload;
-``vs_baseline`` = ours / reference (higher is better).
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "platform": "tpu"|"cpu", "configs": {name: {...} per BASELINE config}}
+
+The headline (metric/value/vs_baseline) is BASELINE config 1 — jitted
+MulticlassAccuracy update throughput vs the reference torcheval on torch CPU
+(the only backend the reference can use here); ``vs_baseline`` = ours / ref
+(higher is better). The ``configs`` field carries all five BASELINE.md
+configs, each with its own value/unit/vs_baseline.
+
+Robustness contract (VERDICT round 1): the parent process NEVER imports JAX —
+every measurement runs in a subprocess, so a hung/unclaimable TPU backend
+cannot prevent the JSON line from being printed. The TPU is probed first
+(with one retry); on failure every config falls back to a CPU-only child
+with the TPU plugin registration scrubbed from the environment.
 """
 
+from __future__ import annotations
+
+import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+# ---------------------------------------------------------------------------
+# Child-process workloads ("ours": torcheval_tpu on jax)
+# ---------------------------------------------------------------------------
 
 
-def bench_ours(batch: int, num_classes: int, n_iters: int) -> float:
+def _timed_loop(fn, min_time=3.0, max_iters=500):
+    """Run fn() repeatedly; return iterations/sec over >=min_time of work."""
+    fn()  # warm (compile)
+    n, start = 0, time.perf_counter()
+    while True:
+        fn()
+        n += 1
+        elapsed = time.perf_counter() - start
+        if elapsed >= min_time or n >= max_iters:
+            return n / elapsed
+
+
+def run_accuracy_update():
+    """Config 1: MulticlassAccuracy jitted update throughput."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from torcheval_tpu.metrics.functional.classification.accuracy import (
         _multiclass_accuracy_update,
     )
 
+    batch, num_classes = 1024, 100
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.uniform(size=(batch, num_classes)).astype(np.float32))
     t = jnp.asarray(rng.integers(0, num_classes, size=(batch,)))
@@ -35,65 +67,554 @@ def bench_ours(batch: int, num_classes: int, n_iters: int) -> float:
         return (state[0] + nc, state[1] + nt)
 
     state = (jnp.zeros(()), jnp.zeros(()))
-    state = step(state, x, t)  # compile
-    jax.block_until_ready(state)
 
-    start = time.perf_counter()
-    for _ in range(n_iters):
+    def body():
+        nonlocal state
         state = step(state, x, t)
-    jax.block_until_ready(state)
-    elapsed = time.perf_counter() - start
-    return n_iters / elapsed
+        jax.block_until_ready(state)
+
+    ups = _timed_loop(body)
+    return {
+        "metric": f"MulticlassAccuracy jitted update throughput "
+        f"(batch={batch}, classes={num_classes})",
+        "value": round(ups, 1),
+        "unit": "updates/s",
+    }
 
 
-def bench_reference(batch: int, num_classes: int, n_iters: int) -> float:
+def run_auroc_compute():
+    """Config 2: BinaryAUROC + BinaryAUPRC deferred compute on buffered data."""
+    import jax
+    import numpy as np
+
+    from torcheval_tpu.metrics import BinaryAUPRC, BinaryAUROC
+
+    n_total, n_updates = 1 << 18, 16
+    rng = np.random.default_rng(0)
+    xs = rng.uniform(size=(n_updates, n_total // n_updates)).astype(np.float32)
+    ts = rng.integers(0, 2, size=xs.shape).astype(np.float32)
+
+    auroc, auprc = BinaryAUROC(), BinaryAUPRC()
+    for i in range(n_updates):
+        auroc.update(xs[i], ts[i])
+        auprc.update(xs[i], ts[i])
+
+    def body():
+        jax.block_until_ready((auroc.compute(), auprc.compute()))
+
+    cps = _timed_loop(body, min_time=3.0, max_iters=50)
+    return {
+        "metric": f"BinaryAUROC+AUPRC deferred compute ({n_total} samples)",
+        "value": round(cps, 2),
+        "unit": "computes/s",
+    }
+
+
+def run_sync_overhead():
+    """Config 3: in-jit psum metric sync overhead as % of step time.
+
+    Runs an 8-device data-parallel eval step (matmul model) twice — with and
+    without the in-step metric state sync — on a Mesh, and reports the wall
+    clock overhead percentage. North star (BASELINE.md): <1%.
+    """
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from torcheval_tpu.metrics.functional.classification.accuracy import (
+        _multiclass_accuracy_update,
+    )
+    from torcheval_tpu.metrics.sharded import sync_states_in_jit
+
+    devs = jax.devices()
+    n = len(devs) if len(devs) >= 2 else 1
+    if n == 1:
+        # Single real chip: a 1-device mesh still exercises the code path;
+        # the collective is a no-op but the program structure is identical.
+        pass
+    mesh = Mesh(np.array(devs[:n]), ("dp",))
+
+    batch, d, classes = 64 * n, 512, 512
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.normal(size=(d, d)).astype(np.float32) * 0.05)
+    w2 = jnp.asarray(rng.normal(size=(d, classes)).astype(np.float32) * 0.05)
+    x = jax.device_put(
+        jnp.asarray(rng.normal(size=(batch, d)).astype(np.float32)),
+        NamedSharding(mesh, P("dp", None)),
+    )
+    y = jax.device_put(
+        jnp.asarray(rng.integers(0, classes, size=(batch,))),
+        NamedSharding(mesh, P("dp")),
+    )
+
+    def model(x, w1, w2):
+        return jnp.tanh(x @ w1) @ w2
+
+    @jax.jit
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("dp", None), P("dp"), P(), P(), P()),
+        out_specs=(P(), P()),
+    )
+    def step_sync(x, y, w1, w2, state):
+        logits = model(x, w1, w2)
+        nc, nt = _multiclass_accuracy_update(logits, y, "micro", None, 1)
+        local = {"nc": state["nc"] + nc, "nt": state["nt"] + nt}
+        synced = sync_states_in_jit(local, "dp")
+        s = jax.lax.psum(jnp.sum(logits), "dp")
+        return s, synced
+
+    @jax.jit
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("dp", None), P(), P()),
+        out_specs=P(),
+    )
+    def step_plain(x, w1, w2):
+        logits = model(x, w1, w2)
+        s = jnp.sum(logits)
+        return jax.lax.psum(s, "dp") / jax.lax.psum(1, "dp")
+
+    state = {"nc": jnp.zeros(()), "nt": jnp.zeros(())}
+
+    def body_sync():
+        jax.block_until_ready(step_sync(x, y, w1, w2, state))
+
+    def body_plain():
+        jax.block_until_ready(step_plain(x, w1, w2))
+
+    plain_ips = _timed_loop(body_plain, min_time=2.0)
+    sync_ips = _timed_loop(body_sync, min_time=2.0)
+    overhead_pct = max(0.0, (1.0 / sync_ips - 1.0 / plain_ips) * plain_ips * 100.0)
+    return {
+        "metric": f"in-jit psum metric sync overhead ({n}-device dp mesh)",
+        "value": round(overhead_pct, 3),
+        "unit": "% of step time",
+        "lower_is_better": True,
+        "step_per_s_plain": round(plain_ips, 1),
+        "step_per_s_with_metric_sync": round(sync_ips, 1),
+    }
+
+
+def run_text_eval():
+    """Config 4: Perplexity (jitted, device) + BLEU (host strings) eval loop."""
+    import jax
+    import numpy as np
+
+    from torcheval_tpu.metrics import BLEUScore, Perplexity
+
+    batch, seq, vocab = 8, 128, 8192
+    rng = np.random.default_rng(0)
+    logits = np.asarray(rng.normal(size=(batch, seq, vocab)).astype(np.float32))
+    targets = np.asarray(rng.integers(0, vocab, size=(batch, seq)))
+    words = ["the", "cat", "sat", "on", "a", "mat", "dog", "ran"]
+    cands = [" ".join(rng.choice(words, size=12)) for _ in range(32)]
+    refs = [[" ".join(rng.choice(words, size=12))] for _ in range(32)]
+
+    ppl = Perplexity()
+    bleu = BLEUScore(n_gram=4)
+    import jax.numpy as jnp
+
+    jlogits = jnp.asarray(logits)
+    jtargets = jnp.asarray(targets)
+
+    def body():
+        ppl.update(jlogits, jtargets)
+        bleu.update(cands, refs)
+        jax.block_until_ready(ppl.state_dict())
+
+    ups = _timed_loop(body, min_time=3.0, max_iters=200)
+    return {
+        "metric": f"Perplexity+BLEU eval loop (batch={batch}, seq={seq}, "
+        f"vocab={vocab}, 32 sent/update)",
+        "value": round(ups, 2),
+        "unit": "updates/s",
+    }
+
+
+def run_fid():
+    """Config 5: FrechetInceptionDistance update throughput (InceptionV3 fwd
+    + streaming mean/cov accumulation). Random-init weights: throughput is
+    weight-agnostic."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torcheval_tpu.metrics import FrechetInceptionDistance
+    from torcheval_tpu.models.inception import InceptionV3
+
+    batch = 16
+    rng = np.random.default_rng(0)
+    imgs = np.asarray(
+        rng.uniform(size=(batch, 3, 299, 299)).astype(np.float32)
+    )
+    module = InceptionV3()
+    variables = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3))
+    )
+    apply = jax.jit(module.apply)
+
+    def model(images):  # (N, 3, H, W) -> (N, 2048)
+        x = jnp.transpose(images, (0, 2, 3, 1))
+        x = jax.image.resize(
+            x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear",
+            antialias=False,
+        )
+        return apply(variables, x)
+
+    fid = FrechetInceptionDistance(model=model)
+    jimgs = jnp.asarray(imgs)
+
+    def body():
+        fid.update(jimgs, is_real=True)
+        jax.block_until_ready(fid.state_dict())
+
+    ups = _timed_loop(body, min_time=3.0, max_iters=50)
+    return {
+        "metric": f"FID update throughput (InceptionV3 fwd, batch={batch})",
+        "value": round(ups * batch, 1),
+        "unit": "images/s",
+    }
+
+
+def run_probe():
+    """Tiny op on the default backend — proves the platform is claimable."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones(()) + 1
+    jax.block_until_ready(x)
+    return {"metric": "probe", "value": 1, "unit": "ok",
+            "backend": jax.default_backend()}
+
+
+# ---------------------------------------------------------------------------
+# Reference baselines (torch CPU — the only backend the reference runs here)
+# ---------------------------------------------------------------------------
+
+
+def _stub_torchvision():
+    import importlib.machinery
+    import types
+
+    if "torchvision" not in sys.modules:
+        tv = types.ModuleType("torchvision")
+        tv.__spec__ = importlib.machinery.ModuleSpec("torchvision", None)
+        tv.models = types.ModuleType("torchvision.models")
+        tv.models.__spec__ = importlib.machinery.ModuleSpec(
+            "torchvision.models", None
+        )
+        sys.modules["torchvision"] = tv
+        sys.modules["torchvision.models"] = tv.models
+
+
+def ref_accuracy_update():
     sys.path.insert(0, "/root/reference")
+    _stub_torchvision()
+    import numpy as np
     import torch
 
     from torcheval.metrics import MulticlassAccuracy
 
+    batch, num_classes = 1024, 100
     rng = np.random.default_rng(0)
     x = torch.tensor(rng.uniform(size=(batch, num_classes)).astype(np.float32))
     t = torch.tensor(rng.integers(0, num_classes, size=(batch,)))
     metric = MulticlassAccuracy()
-    metric.update(x, t)  # warm
-    start = time.perf_counter()
-    for _ in range(n_iters):
-        metric.update(x, t)
-    elapsed = time.perf_counter() - start
-    return n_iters / elapsed
+    return {"value": _timed_loop(lambda: metric.update(x, t))}
 
 
-def main() -> None:
-    batch, num_classes, n_iters = 1024, 100, 200
-    ours = bench_ours(batch, num_classes, n_iters)
-    try:
-        import types, importlib.machinery
+def ref_auroc_compute():
+    sys.path.insert(0, "/root/reference")
+    _stub_torchvision()
+    import numpy as np
+    import torch
 
-        if "torchvision" not in sys.modules:
-            tv = types.ModuleType("torchvision")
-            tv.__spec__ = importlib.machinery.ModuleSpec("torchvision", None)
-            tv.models = types.ModuleType("torchvision.models")
-            tv.models.__spec__ = importlib.machinery.ModuleSpec(
-                "torchvision.models", None
-            )
-            sys.modules["torchvision"] = tv
-            sys.modules["torchvision.models"] = tv.models
-        ref = bench_reference(batch, num_classes, n_iters)
-        vs_baseline = ours / ref
-    except Exception:
-        vs_baseline = None
-    print(
-        json.dumps(
-            {
-                "metric": "MulticlassAccuracy jitted update throughput "
-                f"(batch={batch}, classes={num_classes})",
-                "value": round(ours, 1),
-                "unit": "updates/s",
-                "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
-            }
-        )
+    from torcheval.metrics import BinaryAUPRC, BinaryAUROC
+
+    n_total, n_updates = 1 << 18, 16
+    rng = np.random.default_rng(0)
+    xs = torch.tensor(
+        rng.uniform(size=(n_updates, n_total // n_updates)).astype(np.float32)
     )
+    ts = torch.tensor(
+        rng.integers(0, 2, size=tuple(xs.shape)).astype(np.float32)
+    )
+    auroc, auprc = BinaryAUROC(), BinaryAUPRC()
+    for i in range(n_updates):
+        auroc.update(xs[i], ts[i])
+        auprc.update(xs[i], ts[i])
+    return {
+        "value": _timed_loop(
+            lambda: (auroc.compute(), auprc.compute()), min_time=3.0,
+            max_iters=50,
+        )
+    }
+
+
+def ref_sync_overhead():
+    """Reference sync cost: 4-process gloo sync_and_compute vs local step.
+
+    Measures the reference's own distributed mechanism (pickle +
+    all_gather_object over gloo) on this host, as % overhead of the same
+    matmul eval step.
+    """
+    import torch  # noqa: F401  (import check before spawning workers)
+
+    # gloo busy-waits; on a small-core host more workers just thrash.
+    nproc = 2
+    code_overhead = _REF_SYNC_WORKER
+    out = subprocess.run(
+        [sys.executable, "-c", code_overhead, str(nproc)],
+        capture_output=True, text=True, timeout=240,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"ref sync worker failed: {out.stderr[-800:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+_REF_SYNC_WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, "/root/reference")
+import torch
+import torch.distributed as dist
+import torch.multiprocessing as mp
+
+def work(rank, nproc, port, q):
+    os.environ["MASTER_ADDR"] = "127.0.0.1"
+    os.environ["MASTER_PORT"] = str(port)
+    torch.set_num_threads(2)
+    dist.init_process_group("gloo", rank=rank, world_size=nproc)
+    from torcheval.metrics import MulticlassAccuracy
+    from torcheval.metrics.toolkit import sync_and_compute
+    torch.manual_seed(rank)
+    batch, d, classes = 64, 512, 512
+    x = torch.randn(batch, d)
+    w1 = torch.randn(d, d) * 0.05
+    w2 = torch.randn(d, classes) * 0.05
+    y = torch.randint(0, classes, (batch,))
+    metric = MulticlassAccuracy()
+    def step_plain():
+        return torch.tanh(x @ w1) @ w2
+    def step_sync():
+        logits = step_plain()
+        metric.update(logits, y)
+        return sync_and_compute(metric)
+    for fn in (step_plain, step_sync):
+        fn()
+    # FIXED iteration counts: step_sync contains collectives, so every rank
+    # must issue the same number of calls or the job deadlocks.
+    def rate(fn, n_iters):
+        start = time.perf_counter()
+        for _ in range(n_iters):
+            fn()
+        return n_iters / (time.perf_counter() - start)
+    dist.barrier()
+    plain = rate(step_plain, 30)
+    dist.barrier()
+    sync = rate(step_sync, 10)
+    if rank == 0:
+        overhead = max(0.0, (1.0/sync - 1.0/plain) * plain * 100.0)
+        q.put({"value": overhead, "step_per_s_plain": plain,
+               "step_per_s_with_metric_sync": sync})
+    dist.destroy_process_group()
+
+if __name__ == "__main__":
+    nproc = int(sys.argv[1])
+    import socket
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    ctx = mp.get_context("spawn")
+    q = ctx.SimpleQueue()
+    procs = [ctx.Process(target=work, args=(r, nproc, port, q))
+             for r in range(nproc)]
+    for p in procs: p.start()
+    res = q.get()
+    for p in procs: p.join(60)
+    print(json.dumps(res))
+"""
+
+
+def ref_text_eval():
+    sys.path.insert(0, "/root/reference")
+    _stub_torchvision()
+    import numpy as np
+    import torch
+
+    from torcheval.metrics import BLEUScore, Perplexity
+
+    batch, seq, vocab = 8, 128, 8192
+    rng = np.random.default_rng(0)
+    logits = torch.tensor(
+        rng.normal(size=(batch, seq, vocab)).astype(np.float32)
+    )
+    targets = torch.tensor(rng.integers(0, vocab, size=(batch, seq)))
+    words = ["the", "cat", "sat", "on", "a", "mat", "dog", "ran"]
+    cands = [" ".join(rng.choice(words, size=12)) for _ in range(32)]
+    refs = [[" ".join(rng.choice(words, size=12))] for _ in range(32)]
+    ppl, bleu = Perplexity(), BLEUScore(n_gram=4)
+
+    def body():
+        ppl.update(logits, targets)
+        bleu.update(cands, refs)
+
+    return {"value": _timed_loop(body, min_time=3.0, max_iters=200)}
+
+
+# ---------------------------------------------------------------------------
+# Orchestration
+# ---------------------------------------------------------------------------
+
+CONFIGS = {
+    "accuracy_update": (run_accuracy_update, "ref_accuracy_update"),
+    "auroc_compute": (run_auroc_compute, "ref_auroc_compute"),
+    "sync_overhead": (run_sync_overhead, "ref_sync_overhead"),
+    "text_eval": (run_text_eval, "ref_text_eval"),
+    "fid": (run_fid, None),  # reference needs torchvision (absent here)
+}
+
+REF_FNS = {
+    "ref_accuracy_update": ref_accuracy_update,
+    "ref_auroc_compute": ref_auroc_compute,
+    "ref_sync_overhead": ref_sync_overhead,
+    "ref_text_eval": ref_text_eval,
+}
+
+
+def _cpu_env():
+    env = dict(os.environ)
+    # The TPU PJRT plugin registers from sitecustomize only when this is
+    # set; scrubbing it gives a pure CPU JAX that cannot hang on the relay.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8"
+        ).strip()
+    return env
+
+
+def _run_child(config, platform, timeout):
+    env = _cpu_env() if platform == "cpu" else dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--child", config],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=timeout, cwd=REPO,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{config}@{platform} rc={proc.returncode}: {proc.stderr[-500:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _run_ref_child(refname, timeout):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--ref", refname],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=timeout, cwd=REPO,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{refname} rc={proc.returncode}: {proc.stderr[-500:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", help="run one config in-process (ours)")
+    ap.add_argument("--ref", help="run one reference baseline in-process")
+    ap.add_argument("--only", help="comma-separated config subset (parent)")
+    args = ap.parse_args()
+
+    if args.child:
+        fn = run_probe if args.child == "probe" else CONFIGS[args.child][0]
+        print(json.dumps(fn()))
+        return
+    if args.ref:
+        print(json.dumps(REF_FNS[args.ref]()))
+        return
+
+    # ---- parent: never imports jax ----
+    t0 = time.monotonic()
+    names = list(CONFIGS) if not args.only else args.only.split(",")
+
+    platform = "cpu"
+    for attempt in range(2):  # probe TPU, retry once
+        try:
+            res = _run_child("probe", "tpu", timeout=180)
+            platform = "tpu" if res.get("backend") not in (None, "cpu") else "cpu"
+            break
+        except Exception as e:  # noqa: BLE001
+            print(f"# tpu probe attempt {attempt + 1} failed: {e}",
+                  file=sys.stderr)
+    print(f"# platform: {platform}", file=sys.stderr)
+
+    configs_out = {}
+    for name in names:
+        _, refname = CONFIGS[name]
+        # sync_overhead needs a multi-device mesh: with one real TPU chip the
+        # virtual 8-device CPU platform is the honest measurement.
+        plat = "cpu" if name == "sync_overhead" else platform
+        entry = None
+        for p in dict.fromkeys([plat, "cpu"]):  # fall back to cpu once
+            try:
+                entry = _run_child(name, p, timeout=420)
+                entry["platform"] = p
+                break
+            except Exception as e:  # noqa: BLE001
+                print(f"# {name}@{p} failed: {e}", file=sys.stderr)
+        if entry is None:
+            configs_out[name] = {"error": "all platforms failed"}
+            continue
+
+        if refname is not None:
+            try:
+                ref = _run_ref_child(refname, timeout=420)
+                if entry.get("lower_is_better"):
+                    entry["vs_baseline"] = (
+                        round(ref["value"] / entry["value"], 2)
+                        if entry["value"] > 0 else None
+                    )
+                    entry["baseline_value"] = round(ref["value"], 3)
+                else:
+                    entry["vs_baseline"] = round(entry["value"] / ref["value"], 2)
+                    entry["baseline_value"] = round(ref["value"], 2)
+                for k in ("step_per_s_plain", "step_per_s_with_metric_sync"):
+                    if k in ref:
+                        entry[f"baseline_{k}"] = round(ref[k], 1)
+            except Exception as e:  # noqa: BLE001
+                entry["vs_baseline"] = None
+                entry["vs_baseline_error"] = str(e)[-300:]
+        else:
+            entry["vs_baseline"] = None
+            entry["vs_baseline_note"] = (
+                "reference requires torchvision (not installed in this image)"
+            )
+        configs_out[name] = entry
+        print(f"# {name}: {json.dumps(entry)}", file=sys.stderr)
+
+    head = configs_out.get("accuracy_update") or next(
+        (v for v in configs_out.values() if "value" in v), {}
+    )
+    out = {
+        "metric": head.get(
+            "metric", "MulticlassAccuracy jitted update throughput"
+        ),
+        "value": head.get("value"),
+        "unit": head.get("unit", "updates/s"),
+        "vs_baseline": head.get("vs_baseline"),
+        "platform": platform,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "configs": configs_out,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

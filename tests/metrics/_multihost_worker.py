@@ -1,0 +1,104 @@
+"""Worker for the multi-process MultiHostGroup sync test.
+
+Spawned by ``test_multihost.py`` with ``jax.distributed.initialize`` over a
+localhost coordinator — the JAX analogue of the reference's spawned gloo
+workers (reference utils/test_utils/metric_class_tester.py:292-341,
+tests/metrics/test_synclib.py:74-419).
+
+Each rank builds metrics with *asymmetric* states (different buffer lengths
+including an empty rank, disjoint dict keys, rank-dependent scalars), runs
+the real ``MultiHostGroup`` collectives, and prints one JSON result line the
+parent compares across ranks and against expected values.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> None:
+    coord, nproc, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nproc, process_id=rank
+    )
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torcheval_tpu.distributed import MultiHostGroup, default_process_group
+    from torcheval_tpu.metrics import MulticlassAccuracy, Throughput
+    from torcheval_tpu.metrics.toolkit import (
+        get_synced_state_dict,
+        sync_and_compute,
+        sync_and_compute_collection,
+    )
+    from torcheval_tpu.utils.test_utils.dummy_metric import (
+        DummySumDictStateMetric,
+        DummySumListStateMetric,
+        DummySumMetric,
+    )
+
+    group = default_process_group()
+    assert isinstance(group, MultiHostGroup), type(group)
+    assert group.world_size == nproc and group.rank == rank
+
+    results = {}
+
+    # --- raw collective legs -------------------------------------------------
+    arrs = group.allgather_array(jnp.asarray([rank, rank + 1]))
+    results["allgather_array"] = [a.tolist() for a in arrs]
+
+    # rank-dependent pickle sizes exercise the padded-bytes protocol
+    objs = group.allgather_object({"rank": rank, "blob": "x" * (17 * rank)})
+    results["allgather_object_ok"] = objs == [
+        {"rank": r, "blob": "x" * (17 * r)} for r in range(nproc)
+    ]
+
+    # --- tensor state --------------------------------------------------------
+    m_sum = DummySumMetric()
+    m_sum.update(jnp.asarray(float(rank + 1)))
+    results["sum"] = float(sync_and_compute(m_sum, group))
+
+    # --- list state, asymmetric lengths (rank 0 stays EMPTY) ----------------
+    m_list = DummySumListStateMetric()
+    for i in range(rank):
+        m_list.update(jnp.asarray(float(i + 1)))
+    results["list_sum"] = float(sync_and_compute(m_list, group))
+
+    # --- dict state, disjoint + overlapping keys ----------------------------
+    m_dict = DummySumDictStateMetric()
+    m_dict.update(f"k{rank}", jnp.asarray(1.0))
+    m_dict.update("shared", jnp.asarray(float(rank)))
+    d = sync_and_compute(m_dict, group)
+    results["dict"] = {k: float(v) for k, v in sorted(d.items())}
+
+    # --- float states (host-side allgather_object path) ---------------------
+    m_tp = Throughput()
+    m_tp.update(num_processed=10 * (rank + 1), elapsed_time_sec=float(rank + 1))
+    results["throughput"] = float(sync_and_compute(m_tp, group))
+
+    # --- real metric + single batched collection exchange -------------------
+    rng = np.random.default_rng(rank)
+    x = jnp.asarray(rng.uniform(size=(32, 5)).astype(np.float32))
+    t = jnp.asarray(rng.integers(0, 5, size=(32,)))
+    acc = MulticlassAccuracy()
+    acc.update(x, t)
+    m_sum2 = DummySumMetric()
+    m_sum2.update(jnp.asarray(float(rank)))
+    coll = sync_and_compute_collection({"acc": acc, "sum": m_sum2}, group)
+    results["coll_acc"] = float(coll["acc"])
+    results["coll_sum"] = float(coll["sum"])
+
+    # --- synced state dict (checkpoint payload) -----------------------------
+    sd = get_synced_state_dict(m_sum, group)
+    results["synced_state_dict_sum"] = float(sd["sum"])
+
+    print("RESULT " + json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -92,7 +92,7 @@ class TestCat(MetricClassTester):
         inputs = [RNG.normal(size=(2, 3)).astype(np.float32) for _ in range(8)]
         self.run_class_implementation_tests(
             metric=Cat(),
-            state_names={"dim", "inputs"},
+            state_names={"dim", "inputs", "_num_samples"},
             update_kwargs={"input": inputs},
             compute_result=np.concatenate(inputs, axis=0),
         )
@@ -115,7 +115,7 @@ class TestAUC(MetricClassTester):
             ref.update(torch.tensor(x), torch.tensor(y))
         self.run_class_implementation_tests(
             metric=AUC(),
-            state_names={"x", "y"},
+            state_names={"x", "y", "_num_samples"},
             update_kwargs={"x": xs, "y": ys},
             compute_result=np.asarray(ref.compute()),
             atol=1e-4,

@@ -51,7 +51,7 @@ class TestBinaryBinnedAUROC(MetricClassTester):
         )
         self.run_class_implementation_tests(
             metric=BinaryBinnedAUROC(threshold=jnp.asarray(THR)),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=expected,
         )
@@ -82,7 +82,7 @@ class TestMulticlassBinnedAUROC(MetricClassTester):
         thr = jnp.asarray(grid.astype(np.float32))
         self.run_class_implementation_tests(
             metric=MulticlassBinnedAUROC(num_classes=C, threshold=thr),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=(np.asarray(exact), np.asarray(thr)),
         )

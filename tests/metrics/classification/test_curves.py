@@ -58,7 +58,7 @@ class TestBinaryAUROC(MetricClassTester):
             ref.update(torch.tensor(x), torch.tensor(t))
         self.run_class_implementation_tests(
             metric=BinaryAUROC(),
-            state_names={"inputs", "targets", "weights"},
+            state_names={"inputs", "targets", "weights", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=np.asarray(ref.compute()),
         )
@@ -71,7 +71,7 @@ class TestBinaryAUROC(MetricClassTester):
         )
         self.run_class_implementation_tests(
             metric=BinaryAUROC(num_tasks=2),
-            state_names={"inputs", "targets", "weights"},
+            state_names={"inputs", "targets", "weights", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=expected,
         )
@@ -115,7 +115,7 @@ class TestMulticlassAUROC(MetricClassTester):
         )
         self.run_class_implementation_tests(
             metric=MulticlassAUROC(num_classes=C, average=average),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=expected,
         )
@@ -134,7 +134,7 @@ class TestAUPRC(MetricClassTester):
         expected = _ref_class_result(REF_M.BinaryAUPRC(), list(zip(inputs, targets)))
         self.run_class_implementation_tests(
             metric=BinaryAUPRC(),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=expected,
         )
@@ -151,7 +151,7 @@ class TestAUPRC(MetricClassTester):
         )
         self.run_class_implementation_tests(
             metric=MulticlassAUPRC(num_classes=C, average=average),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=expected,
         )
@@ -166,7 +166,7 @@ class TestAUPRC(MetricClassTester):
         )
         self.run_class_implementation_tests(
             metric=MultilabelAUPRC(num_labels=3),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=expected,
         )
@@ -181,7 +181,7 @@ class TestPrecisionRecallCurve(MetricClassTester):
         )
         self.run_class_implementation_tests(
             metric=BinaryPrecisionRecallCurve(),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=expected,
         )
@@ -197,7 +197,7 @@ class TestPrecisionRecallCurve(MetricClassTester):
         )
         self.run_class_implementation_tests(
             metric=MulticlassPrecisionRecallCurve(num_classes=C),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=expected,
         )
@@ -213,7 +213,7 @@ class TestPrecisionRecallCurve(MetricClassTester):
         )
         self.run_class_implementation_tests(
             metric=MultilabelPrecisionRecallCurve(num_labels=3),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=expected,
         )
@@ -235,7 +235,7 @@ class TestRecallAtFixedPrecision(MetricClassTester):
         )
         self.run_class_implementation_tests(
             metric=BinaryRecallAtFixedPrecision(min_precision=0.5),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=expected,
         )
@@ -251,7 +251,7 @@ class TestRecallAtFixedPrecision(MetricClassTester):
         )
         self.run_class_implementation_tests(
             metric=MultilabelRecallAtFixedPrecision(num_labels=3, min_precision=0.4),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=expected,
         )

@@ -1,0 +1,101 @@
+"""FID pipeline parity checks (reference torcheval/metrics/image/fid.py:28-50).
+
+Three layers, by what this image can run:
+
+1. resize parity: ``jax.image.resize(..., antialias=False)`` vs the
+   reference's ``F.interpolate(mode='bilinear', align_corners=False)`` —
+   torch is available, so this runs everywhere.
+2. transform_input: the torchvision channelwise affine applied by
+   ``inception_v3(weights='DEFAULT')`` (ADVICE round-1 high finding) —
+   verified against a hand-computed transform.
+3. pooled-feature parity with real torchvision weights — skipped unless
+   torchvision is installed (not in this image); runs in CI with weights.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+RNG = np.random.default_rng(3)
+
+try:
+    import torch
+    import torch.nn.functional as F
+
+    HAVE_TORCH = True
+except Exception:
+    HAVE_TORCH = False
+
+try:
+    import torchvision  # noqa: F401
+
+    HAVE_TORCHVISION = True
+except Exception:
+    HAVE_TORCHVISION = False
+
+
+@pytest.mark.skipif(not HAVE_TORCH, reason="torch unavailable")
+@pytest.mark.parametrize("hw", [(64, 64), (512, 640)])  # up- and downscale
+def test_resize_matches_reference_interpolate(hw):
+    h, w = hw
+    img = RNG.uniform(size=(2, 3, h, w)).astype(np.float32)
+
+    ref = F.interpolate(
+        torch.tensor(img), size=(299, 299), mode="bilinear",
+        align_corners=False,
+    ).numpy()
+
+    x = jnp.transpose(jnp.asarray(img), (0, 2, 3, 1))
+    ours = jax.image.resize(
+        x, (2, 299, 299, 3), method="bilinear", antialias=False
+    )
+    ours = np.transpose(np.asarray(ours), (0, 3, 1, 2))
+    np.testing.assert_allclose(ours, ref, atol=2e-5)
+
+
+def test_transform_input_affine():
+    """InceptionV3.transform_input applies torchvision's channelwise remap
+    of [0,1] pixels to the ImageNet scale the pretrained weights expect."""
+    from torcheval_tpu.models.inception import InceptionV3
+
+    x = jnp.asarray(RNG.uniform(size=(1, 299, 299, 3)).astype(np.float32))
+
+    with_t = InceptionV3(transform_input=True)
+    without_t = InceptionV3(transform_input=False)
+    params = with_t.init(jax.random.PRNGKey(0), x)
+
+    manual = jnp.concatenate(
+        [
+            x[..., 0:1] * (0.229 / 0.5) + (0.485 - 0.5) / 0.5,
+            x[..., 1:2] * (0.224 / 0.5) + (0.456 - 0.5) / 0.5,
+            x[..., 2:3] * (0.225 / 0.5) + (0.406 - 0.5) / 0.5,
+        ],
+        axis=-1,
+    )
+    a = with_t.apply(params, x)
+    b = without_t.apply(params, manual)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.skipif(
+    not HAVE_TORCHVISION, reason="torchvision (pretrained weights) unavailable"
+)
+def test_pooled_features_match_torchvision():
+    """End-to-end: imported weights + [0,1] images -> pooled 2048-d features
+    within tolerance of the torch model (reference fid.py:28-50)."""
+    from torcheval_tpu.metrics.image.fid import FIDInceptionV3
+    from torchvision import models
+
+    imgs = RNG.uniform(size=(4, 3, 299, 299)).astype(np.float32)
+
+    torch_model = models.inception_v3(weights="DEFAULT")
+    torch_model.fc = torch.nn.Identity()
+    torch_model.eval()
+    with torch.no_grad():
+        ref_feats = torch_model(torch.tensor(imgs)).numpy()
+
+    ours = FIDInceptionV3()(jnp.asarray(imgs))
+    np.testing.assert_allclose(np.asarray(ours), ref_feats, atol=1e-3)

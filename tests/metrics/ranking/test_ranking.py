@@ -80,7 +80,7 @@ class TestHitRate(MetricClassTester):
             ref.update(torch.tensor(x), torch.tensor(t))
         self.run_class_implementation_tests(
             metric=HitRate(k=3),
-            state_names={"scores"},
+            state_names={"scores", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=np.asarray(ref.compute()),
         )
@@ -110,7 +110,7 @@ class TestReciprocalRank(MetricClassTester):
             ref.update(torch.tensor(x), torch.tensor(t))
         self.run_class_implementation_tests(
             metric=ReciprocalRank(),
-            state_names={"scores"},
+            state_names={"scores", "_num_samples"},
             update_kwargs={"input": inputs, "target": targets},
             compute_result=np.asarray(ref.compute()),
         )

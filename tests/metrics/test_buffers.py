@@ -1,0 +1,211 @@
+"""Fixed-shape growable buffer layer tests (metrics/_buffer.py).
+
+Pins the round-2 design goals from SURVEY §7: power-of-2 preallocated device
+buffers with valid-count masking, so O(n) example-buffering metrics compile
+O(log n) XLA programs across arbitrarily many updates (the reference's
+list-append pattern — reference classification/auroc.py:87-89 — recompiles
+per distinct total length).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics import (
+    AUC,
+    BinaryAUPRC,
+    BinaryAUROC,
+    BinaryPrecisionRecallCurve,
+    Cat,
+    MulticlassAUROC,
+)
+from torcheval_tpu.metrics._buffer import MIN_CAPACITY, _write_at, next_capacity
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    _binary_auroc_compute_jit,
+)
+from torcheval_tpu.metrics.toolkit import sync_and_compute
+from torcheval_tpu.distributed import LocalReplicaGroup
+
+RNG = np.random.default_rng(7)
+
+
+def test_next_capacity():
+    assert next_capacity(1) == MIN_CAPACITY
+    assert next_capacity(MIN_CAPACITY) == MIN_CAPACITY
+    assert next_capacity(MIN_CAPACITY + 1) == 2 * MIN_CAPACITY
+    assert next_capacity(1000) == 1024
+    assert next_capacity(1024) == 1024
+    assert next_capacity(1025) == 2048
+
+
+def test_update_compiles_o_log_n():
+    """100 growing updates must stay within the O(log n) compile budget."""
+    batch = 37
+    writes_before = _write_at._cache_size()
+    computes_before = _binary_auroc_compute_jit._cache_size()
+
+    m = BinaryAUROC()
+    for i in range(100):
+        x = RNG.random(batch).astype(np.float32)
+        t = (RNG.random(batch) < 0.5).astype(np.float32)
+        m.update(jnp.asarray(x), jnp.asarray(t))
+        if i % 10 == 0:
+            m.compute()
+
+    assert m.num_samples == 100 * batch
+    # distinct capacities touched: 64..4096 -> 7; one write program per
+    # (capacity, batch-shape) pair
+    assert _write_at._cache_size() - writes_before <= 8
+    # compute kernel compiles once per capacity, NOT per count
+    assert _binary_auroc_compute_jit._cache_size() - computes_before <= 8
+
+
+def test_buffer_growth_preserves_values():
+    m = BinaryAUROC()
+    xs, ts = [], []
+    for batch in (5, MIN_CAPACITY, 200, 1):  # crosses two growth boundaries
+        x = RNG.random(batch).astype(np.float32)
+        t = (RNG.random(batch) < 0.4).astype(np.float32)
+        xs.append(x)
+        ts.append(t)
+        m.update(jnp.asarray(x), jnp.asarray(t))
+    x_all, t_all = np.concatenate(xs), np.concatenate(ts)
+    assert m.num_samples == x_all.size
+    expected = skm.roc_auc_score(t_all, x_all)
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_padding_is_neutral_at_every_count():
+    """Results at non-power-of-2 counts equal unpadded oracles."""
+    x = RNG.random(147).astype(np.float32)
+    t = (RNG.random(147) < 0.5).astype(np.float32)
+
+    auroc, auprc, prc = BinaryAUROC(), BinaryAUPRC(), BinaryPrecisionRecallCurve()
+    for m in (auroc, auprc, prc):
+        m.update(jnp.asarray(x), jnp.asarray(t))
+    np.testing.assert_allclose(
+        float(auroc.compute()), skm.roc_auc_score(t, x), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(auprc.compute()), skm.average_precision_score(t, x), atol=1e-5
+    )
+    p, r, th = prc.compute()
+    rp, rr, rt = skm.precision_recall_curve(t, x)
+    np.testing.assert_allclose(np.asarray(p), rp, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r), rr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(th), rt, atol=1e-6)
+
+
+def test_multiclass_auroc_mask():
+    x = RNG.random((83, 5)).astype(np.float32)
+    x /= x.sum(axis=1, keepdims=True)
+    t = RNG.integers(0, 5, 83)
+    m = MulticlassAUROC(num_classes=5)
+    m.update(jnp.asarray(x[:40]), jnp.asarray(t[:40]))
+    m.update(jnp.asarray(x[40:]), jnp.asarray(t[40:]))
+    expected = skm.roc_auc_score(
+        t, x, multi_class="ovr", average="macro", labels=list(range(5))
+    )
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_merge_asymmetric_and_empty():
+    x1 = RNG.random(31).astype(np.float32)
+    t1 = (RNG.random(31) < 0.5).astype(np.float32)
+    x2 = RNG.random(97).astype(np.float32)
+    t2 = (RNG.random(97) < 0.5).astype(np.float32)
+
+    a, b, empty = BinaryAUROC(), BinaryAUROC(), BinaryAUROC()
+    a.update(jnp.asarray(x1), jnp.asarray(t1))
+    b.update(jnp.asarray(x2), jnp.asarray(t2))
+    a.merge_state([b, empty])
+    assert a.num_samples == 128
+    expected = skm.roc_auc_score(
+        np.concatenate([t1, t2]), np.concatenate([x1, x2])
+    )
+    np.testing.assert_allclose(float(a.compute()), expected, atol=1e-5)
+
+    # merging INTO an empty metric adopts peer data
+    c = BinaryAUROC()
+    peer = BinaryAUROC()
+    peer.update(jnp.asarray(x1), jnp.asarray(t1))
+    c.merge_state([peer])
+    np.testing.assert_allclose(
+        float(c.compute()), skm.roc_auc_score(t1, x1), atol=1e-5
+    )
+    # peers unchanged
+    assert peer.num_samples == 31
+
+
+def test_state_dict_roundtrip_preserves_buffer():
+    m = BinaryAUROC()
+    x = RNG.random(70).astype(np.float32)
+    t = (RNG.random(70) < 0.5).astype(np.float32)
+    m.update(jnp.asarray(x), jnp.asarray(t))
+    sd = m.state_dict()
+    assert sd["_num_samples"] == 70
+    fresh = BinaryAUROC()
+    fresh.load_state_dict(sd)
+    np.testing.assert_allclose(
+        float(fresh.compute()), float(m.compute()), atol=1e-7
+    )
+    # restored metric keeps growing correctly
+    fresh.update(jnp.asarray(x), jnp.asarray(t))
+    assert fresh.num_samples == 140
+
+
+def test_toolkit_sync_buffered_ragged_counts():
+    """Eager toolkit sync over replicas with different (and zero) counts."""
+    datas = [(31, 0.3), (5, 0.7), (0, 0.0)]
+    replicas, all_x, all_t = [], [], []
+    for n, p in datas:
+        m = BinaryAUPRC()
+        if n:
+            x = RNG.random(n).astype(np.float32)
+            t = (RNG.random(n) < p).astype(np.float32)
+            m.update(jnp.asarray(x), jnp.asarray(t))
+            all_x.append(x)
+            all_t.append(t)
+        replicas.append(m)
+    import jax
+
+    group = LocalReplicaGroup(devices=jax.devices("cpu")[: len(replicas)])
+    result = sync_and_compute(replicas, group)
+    expected = skm.average_precision_score(
+        np.concatenate(all_t), np.concatenate(all_x)
+    )
+    np.testing.assert_allclose(float(result), expected, atol=1e-5)
+
+
+def test_cat_and_auc_growth():
+    cat = Cat(dim=1)
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = np.arange(6, 10, dtype=np.float32).reshape(2, 2)
+    cat.update(jnp.asarray(a))
+    cat.update(jnp.asarray(b))
+    np.testing.assert_array_equal(
+        np.asarray(cat.compute()), np.concatenate([a, b], axis=1)
+    )
+
+    auc = AUC()
+    auc.update(jnp.asarray([0.0, 1.0]), jnp.asarray([1.0, 1.0]))
+    auc.update(jnp.asarray([2.0]), jnp.asarray([1.0]))
+    np.testing.assert_allclose(np.asarray(auc.compute()), [2.0], atol=1e-6)
+
+    # unsorted x with reorder=True across growth boundary
+    auc2 = AUC(reorder=True)
+    xs = RNG.permutation(np.linspace(0, 1, 100)).astype(np.float32)
+    ys = np.ones(100, dtype=np.float32)
+    auc2.update(jnp.asarray(xs[:70]), jnp.asarray(ys[:70]))
+    auc2.update(jnp.asarray(xs[70:]), jnp.asarray(ys[70:]))
+    np.testing.assert_allclose(np.asarray(auc2.compute()), [1.0], atol=1e-5)
+
+
+def test_compute_before_update_raises():
+    with pytest.raises(RuntimeError, match="has no data"):
+        BinaryAUROC().compute()
+    with pytest.raises(RuntimeError, match="has no data"):
+        MulticlassAUROC(num_classes=3).compute()

@@ -47,10 +47,10 @@ def test_list_buffer_state_roundtrip(tmp_path):
     assert_result_close(restored.compute(), m.compute())
 
 
-def test_empty_list_state_roundtrip(tmp_path):
+def test_empty_buffer_state_roundtrip(tmp_path):
     m = BinaryAUROC()  # no updates: empty buffers
     restored = _roundtrip(tmp_path, m, BinaryAUROC())
-    assert restored.inputs == []
+    assert restored.num_samples == 0
 
 
 def test_float_state_roundtrip(tmp_path):

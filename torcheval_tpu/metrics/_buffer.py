@@ -1,0 +1,205 @@
+"""Fixed-shape growable device buffers for O(n) example-buffering metrics.
+
+The reference buffers examples in Python lists of tensors and concatenates at
+compute time (reference torcheval/metrics/classification/auroc.py:87-89,
+150-155) — on TPU that is a recompile factory: every distinct total length is
+a new XLA program. This layer replaces list states with **preallocated
+power-of-2 device buffers plus a valid-sample count**:
+
+- ``update`` writes the batch at offset ``count`` with
+  ``lax.dynamic_update_slice`` (offset is traced, so one compiled program per
+  (capacity, batch-shape) pair);
+- the buffer doubles when full (one pad program per (old, new) capacity
+  pair) — across ``n`` samples that is O(log n) compiles total;
+- slots at index >= count permanently hold a *neutral fill* (score ``-inf``,
+  weight ``0``, target ``-1``/``0``) chosen per metric so the jitted compute
+  kernels can run over the **full** buffer unchanged: padded entries sort to
+  the end, carry zero weight/mass, and contribute nothing to cumulative
+  sums or integrals. ``compute`` therefore also compiles O(log n) times.
+
+This also discharges the in-jit sync precondition of
+``torcheval_tpu.metrics.sharded``: under SPMD every replica performs the same
+update sequence, so per-replica buffers have identical (power-of-2) shapes
+and ``lax.all_gather`` of buffer states is well-formed; interleaved padding
+in the gathered result is harmless to the pad-neutral kernels.
+
+States registered per buffered metric: one array state per buffer (shared
+sample axis) and one host-side int state ``_num_samples``; both travel
+through ``state_dict``/sync like any other state, and ``merge_state``
+re-appends peers' valid regions.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+MIN_CAPACITY = 64
+
+
+def next_capacity(n: int) -> int:
+    """Smallest power of two >= n (and >= MIN_CAPACITY)."""
+    if n <= MIN_CAPACITY:
+        return MIN_CAPACITY
+    return 1 << (n - 1).bit_length()
+
+
+@partial(jax.jit, static_argnames=("axis",))
+def _write_at(buf: jax.Array, batch: jax.Array, count, *, axis: int) -> jax.Array:
+    start = tuple(
+        count if d == axis else 0 for d in range(buf.ndim)
+    )
+    return lax.dynamic_update_slice(buf, batch.astype(buf.dtype), start)
+
+
+class _BufferSpec:
+    """One named device buffer: sample axis position + neutral fill value."""
+
+    __slots__ = ("name", "fill", "axis")
+
+    def __init__(self, name: str, fill: float, axis: int) -> None:
+        self.name = name
+        self.fill = fill
+        self.axis = axis  # sample axis (may be negative)
+
+
+class BufferedExamplesMetric(Metric[jax.Array]):
+    """Base for metrics that buffer raw examples across updates.
+
+    Subclasses declare their buffers with :meth:`_add_buffer` (all buffers
+    share one sample count) and append with :meth:`_append`. Padding slots
+    beyond ``_num_samples`` always hold each buffer's neutral fill, so
+    pad-neutral kernels may consume :meth:`_padded` directly; exact-shape
+    consumers use :meth:`_valid`.
+    """
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._buffer_specs: Dict[str, _BufferSpec] = {}
+        self._add_state("_num_samples", 0, merge=MergeKind.CUSTOM)
+
+    # ------------------------------------------------------------- declaration
+
+    def _add_buffer(self, name: str, *, fill: float, axis: int = -1) -> None:
+        self._buffer_specs[name] = _BufferSpec(name, fill, axis)
+        # 0-size sentinel: real dtype/row-shape fixed lazily by the first
+        # append (e.g. num_classes may be unknown until then).
+        self._add_state(name, jnp.zeros((0,)), merge=MergeKind.CUSTOM)
+
+    # -------------------------------------------------------------- appending
+
+    def _append(self, **batches: jax.Array) -> None:
+        """Append one batch to every buffer (same sample count each)."""
+        specs = self._buffer_specs
+        if set(batches) != set(specs):
+            raise ValueError(
+                f"expected batches for {sorted(specs)}, got {sorted(batches)}"
+            )
+        first = next(iter(batches.values()))
+        spec0 = specs[next(iter(batches))]
+        n_new = first.shape[spec0.axis]
+        count = self._num_samples
+        needed = count + n_new
+        for name, batch in batches.items():
+            spec = specs[name]
+            buf = getattr(self, name)
+            if batch.shape[spec.axis] != n_new:
+                raise ValueError(
+                    f"buffer {name!r}: batch sample count "
+                    f"{batch.shape[spec.axis]} != {n_new}"
+                )
+            buf = self._ensure_capacity(buf, spec, batch, needed)
+            axis = spec.axis if spec.axis >= 0 else buf.ndim + spec.axis
+            buf = _write_at(buf, batch, count, axis=axis)
+            setattr(self, name, buf)
+        self._num_samples = needed
+
+    def _ensure_capacity(
+        self, buf: jax.Array, spec: _BufferSpec, batch: jax.Array, needed: int
+    ) -> jax.Array:
+        axis = spec.axis if spec.axis >= 0 else batch.ndim + spec.axis
+        if buf.size == 0 and buf.ndim == 1 and self._num_samples == 0:
+            # lazy init: row shape/dtype from the first batch
+            shape = list(batch.shape)
+            shape[axis] = next_capacity(needed)
+            return jnp.full(shape, spec.fill, dtype=batch.dtype)
+        cap = buf.shape[axis]
+        if needed <= cap:
+            return buf
+        new_cap = next_capacity(needed)
+        pad = [(0, 0)] * buf.ndim
+        pad[axis] = (0, new_cap - cap)
+        return jnp.pad(buf, pad, constant_values=spec.fill)
+
+    # ------------------------------------------------------------------ access
+
+    @property
+    def num_samples(self) -> int:
+        return self._num_samples
+
+    def _padded(self) -> Tuple[jax.Array, ...]:
+        """Full-capacity buffers (padding = neutral fills), declaration order."""
+        self._require_data()
+        return tuple(getattr(self, name) for name in self._buffer_specs)
+
+    def _valid(self) -> Tuple[jax.Array, ...]:
+        """Exact-size views sliced to the valid count (declaration order)."""
+        self._require_data()
+        out = []
+        for name, spec in self._buffer_specs.items():
+            buf = getattr(self, name)
+            axis = spec.axis if spec.axis >= 0 else buf.ndim + spec.axis
+            out.append(
+                lax.slice_in_dim(buf, 0, self._num_samples, axis=axis)
+            )
+        return tuple(out)
+
+    def _require_data(self) -> None:
+        if self._num_samples == 0:
+            raise RuntimeError(
+                f"{type(self).__name__} has no data: call update() before "
+                "compute()."
+            )
+
+    # ------------------------------------------------------------------- merge
+
+    def merge_state(self, metrics) -> "BufferedExamplesMetric":
+        """Append every peer's valid samples into our buffers
+        (reference merge_state concat, e.g. auroc.py:142-148); any
+        non-buffer states merge by their declared kinds as usual."""
+        names = list(self._buffer_specs)
+        skip = set(names) | {"_num_samples"}
+        for other in metrics:
+            if other._num_samples > 0:
+                values = other._valid()
+                self._append(
+                    **{n: self._place_state(v) for n, v in zip(names, values)}
+                )
+            for name, kind in self._state_name_to_merge_kind.items():
+                if name in skip:
+                    continue
+                mine = getattr(self, name)
+                theirs = self._place_state(getattr(other, name))
+                setattr(self, name, self._merge_one(name, kind, mine, theirs))
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        # buffers are already single contiguous arrays; nothing to compact
+        pass
+
+    def _merge_custom_state(self, name, mine, theirs):
+        # unreachable for buffer states (merge_state is overridden), but keep
+        # sane semantics for direct calls
+        return mine
+
+    # ----------------------------------------------------------------- masking
+
+    def _valid_mask(self, capacity: int) -> jax.Array:
+        """(capacity,) bool mask of valid slots — pass to masked kernels."""
+        return jnp.arange(capacity) < self._num_samples

@@ -11,16 +11,16 @@ from typing import Iterable, Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._buffer import BufferedExamplesMetric
 from torcheval_tpu.metrics.functional.aggregation.auc import (
-    _auc_compute,
+    _auc_compute_masked_jit,
     _auc_update_input_check,
 )
-from torcheval_tpu.metrics.metric import MergeKind, Metric
 
 TAUC = TypeVar("TAUC", bound="AUC")
 
 
-class AUC(Metric[jax.Array]):
+class AUC(BufferedExamplesMetric):
     """Trapezoidal AUC of arbitrary (x, y) curves, buffered across updates.
 
     Args:
@@ -47,26 +47,22 @@ class AUC(Metric[jax.Array]):
         super().__init__(device=device)
         self.reorder = reorder
         self.n_tasks = n_tasks
-        self._add_state("x", [], merge=MergeKind.EXTEND)
-        self._add_state("y", [], merge=MergeKind.EXTEND)
+        # fixed-shape growable (n_tasks, capacity) buffers (_buffer.py);
+        # pad fill is irrelevant: the masked kernel clamps pads to the last
+        # valid point (zero-width trapezoids)
+        self._add_buffer("x", fill=0.0, axis=-1)
+        self._add_buffer("y", fill=0.0, axis=-1)
 
     def update(self: TAUC, x, y) -> TAUC:
         x, y = self._input(x), self._input(y)
         _auc_update_input_check(x, y, self.n_tasks)
-        self.x.append(jnp.atleast_2d(x))
-        self.y.append(jnp.atleast_2d(y))
+        BufferedExamplesMetric._append(
+            self, x=jnp.atleast_2d(x), y=jnp.atleast_2d(y)
+        )
         return self
 
     def compute(self) -> jax.Array:
-        if not self.x:
+        if self.num_samples == 0:
             return jnp.zeros((0,))
-        return _auc_compute(
-            jnp.concatenate(self.x, axis=1),
-            jnp.concatenate(self.y, axis=1),
-            self.reorder,
-        )
-
-    def _prepare_for_merge_state(self) -> None:
-        if self.x:
-            self.x = [jnp.concatenate(self.x, axis=1)]
-            self.y = [jnp.concatenate(self.y, axis=1)]
+        x, y = self._padded()
+        return _auc_compute_masked_jit(x, y, self.num_samples, self.reorder)

@@ -2,6 +2,9 @@
 
 Parity: reference torcheval/metrics/aggregation/cat.py:19-97 (note: ``dim``
 is registered as an int state; merge compacts buffers into one array).
+TPU-first: inputs accumulate into a fixed-shape power-of-2 device buffer
+along ``dim`` (see ``torcheval_tpu.metrics._buffer``) instead of the
+reference's list-append, so updates compile O(log n) times.
 """
 
 from __future__ import annotations
@@ -11,12 +14,13 @@ from typing import TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics._buffer import BufferedExamplesMetric
+from torcheval_tpu.metrics.metric import MergeKind
 
 TCat = TypeVar("TCat", bound="Cat")
 
 
-class Cat(Metric[jax.Array]):
+class Cat(BufferedExamplesMetric):
     """Concatenate all updated inputs along ``dim``.
 
     Examples::
@@ -31,20 +35,16 @@ class Cat(Metric[jax.Array]):
     def __init__(self, *, dim: int = 0, device=None) -> None:
         super().__init__(device=device)
         self._add_state("dim", dim, merge=MergeKind.CUSTOM)
-        self._add_state("inputs", [], merge=MergeKind.EXTEND)
+        self._add_buffer("inputs", fill=0.0, axis=dim)
 
     def update(self: TCat, input) -> TCat:
-        self.inputs.append(self._input(input))
+        BufferedExamplesMetric._append(self, inputs=self._input(input))
         return self
 
     def compute(self) -> jax.Array:
-        if not self.inputs:
+        if self.num_samples == 0:
             return jnp.zeros((0,))
-        return jnp.concatenate(self.inputs, axis=self.dim)
+        return self._valid()[0]
 
     def _merge_custom_state(self, name, mine, theirs):
         return mine  # `dim` is configuration carried as state; keep ours
-
-    def _prepare_for_merge_state(self) -> None:
-        if self.inputs:
-            self.inputs = [jnp.concatenate(self.inputs, axis=self.dim)]

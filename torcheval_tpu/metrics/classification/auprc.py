@@ -21,40 +21,39 @@ from torcheval_tpu.metrics.functional.classification.auprc import (
     _multilabel_auprc_param_check,
     _multilabel_auprc_update_input_check,
 )
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics._buffer import BufferedExamplesMetric
 
 T = TypeVar("T")
 
 
-class _BufferedPairMetric(Metric[jax.Array]):
-    """Shared buffered (inputs, targets) plumbing for curve metrics."""
+class _BufferedPairMetric(BufferedExamplesMetric):
+    """Shared buffered (inputs, targets) plumbing for curve metrics.
 
-    _concat_axis = 0
+    Fixed-shape power-of-2 device buffers + valid count (see
+    ``torcheval_tpu.metrics._buffer``), replacing the reference's Python
+    list-append states (reference classification/auprc.py:87-89-style).
+    Score padding is ``-inf`` (sorts after every real score); target padding
+    is ``-1`` (matches no class / no positive label), so curve kernels can
+    consume the full padded buffer and compile only O(log n) times.
+    """
+
+    _concat_axis = 0   # sample axis of update batches
+    _target_fill = -1.0
 
     def __init__(self, *, device=None) -> None:
         super().__init__(device=device)
-        self._add_state("inputs", [], merge=MergeKind.EXTEND)
-        self._add_state("targets", [], merge=MergeKind.EXTEND)
-
-    def _append(self, input: jax.Array, target: jax.Array) -> None:
-        self.inputs.append(input)
-        self.targets.append(target)
-
-    def _concat(self):
-        if not self.inputs:
-            raise RuntimeError(
-                f"{type(self).__name__} has no data: call update() before "
-                "compute()."
-            )
-        return (
-            jnp.concatenate(self.inputs, axis=self._concat_axis),
-            jnp.concatenate(self.targets, axis=self._concat_axis),
+        self._add_buffer("inputs", fill=-jnp.inf, axis=self._concat_axis)
+        self._add_buffer(
+            "targets", fill=self._target_fill, axis=self._concat_axis
         )
 
-    def _prepare_for_merge_state(self) -> None:
-        if self.inputs:
-            self.inputs = [jnp.concatenate(self.inputs, axis=self._concat_axis)]
-            self.targets = [jnp.concatenate(self.targets, axis=self._concat_axis)]
+    def _append(self, input: jax.Array, target: jax.Array) -> None:
+        BufferedExamplesMetric._append(self, inputs=input, targets=target)
+
+    def _concat(self):
+        """Exact-size (count-length) views for kernels that are not
+        pad-neutral; pad-neutral kernels should use ``_padded()``."""
+        return self._valid()
 
 
 class BinaryAUPRC(_BufferedPairMetric):
@@ -88,7 +87,9 @@ class BinaryAUPRC(_BufferedPairMetric):
         return self
 
     def compute(self) -> jax.Array:
-        inputs, targets = self._concat()
+        # pad-neutral kernel: padded entries (score -inf, target -1) add no
+        # true positives and only trailing zero-width Riemann segments
+        inputs, targets = self._padded()
         return _binary_auprc_kernel(inputs, targets)
 
 
@@ -114,7 +115,7 @@ class MulticlassAUPRC(_BufferedPairMetric):
         return self
 
     def compute(self) -> jax.Array:
-        inputs, targets = self._concat()
+        inputs, targets = self._padded()
         auprcs = _multiclass_auprc_kernel(inputs, targets)
         if self.average == "macro":
             return jnp.mean(auprcs)
@@ -143,7 +144,7 @@ class MultilabelAUPRC(_BufferedPairMetric):
         return self
 
     def compute(self) -> jax.Array:
-        inputs, targets = self._concat()
+        inputs, targets = self._padded()
         auprcs = _multilabel_auprc_kernel(inputs, targets)
         if self.average == "macro":
             return jnp.mean(auprcs)

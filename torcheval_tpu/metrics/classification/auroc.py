@@ -14,6 +14,7 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._buffer import BufferedExamplesMetric
 from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_compute,
     _binary_auroc_update_input_check,
@@ -26,7 +27,7 @@ from torcheval_tpu.metrics.metric import MergeKind, Metric
 TBinaryAUROC = TypeVar("TBinaryAUROC", bound="BinaryAUROC")
 
 
-class BinaryAUROC(Metric[jax.Array]):
+class BinaryAUROC(BufferedExamplesMetric):
     """AUROC for binary classification (optionally multi-task, weighted).
 
     Args:
@@ -56,9 +57,12 @@ class BinaryAUROC(Metric[jax.Array]):
             raise ValueError(f"`num_tasks` value should be greater than and equal to 1, but received {num_tasks}. ")
         self.num_tasks = num_tasks
         self.use_fused = use_fused if use_fbgemm is None else use_fbgemm
-        self._add_state("inputs", [], merge=MergeKind.EXTEND)
-        self._add_state("targets", [], merge=MergeKind.EXTEND)
-        self._add_state("weights", [], merge=MergeKind.EXTEND)
+        # fixed-shape growable buffers (see metrics/_buffer.py): pad scores
+        # sort last (-inf) and pad weights are 0, so the exact jitted kernel
+        # consumes the full buffer and compiles O(log n) times.
+        self._add_buffer("inputs", fill=-jnp.inf, axis=-1)
+        self._add_buffer("targets", fill=0.0, axis=-1)
+        self._add_buffer("weights", fill=0.0, axis=-1)
 
     def update(
         self: TBinaryAUROC, input, target, *, weight=None
@@ -66,36 +70,27 @@ class BinaryAUROC(Metric[jax.Array]):
         input, target = self._input(input), self._input(target)
         weight = self._input(weight) if weight is not None else None
         _binary_auroc_update_input_check(input, target, self.num_tasks, weight)
-        self.inputs.append(input)
-        self.targets.append(target)
-        self.weights.append(
-            weight if weight is not None else jnp.ones_like(input, dtype=jnp.float32)
+        if weight is None:
+            weight = jnp.ones_like(input, dtype=jnp.float32)
+        BufferedExamplesMetric._append(
+            self, inputs=input, targets=target, weights=weight
         )
         return self
 
     def compute(self) -> jax.Array:
-        if not self.inputs:
-            raise RuntimeError(
-                "BinaryAUROC has no data: call update() before compute()."
-            )
-        return _binary_auroc_compute(
-            jnp.concatenate(self.inputs, axis=-1),
-            jnp.concatenate(self.targets, axis=-1),
-            jnp.concatenate(self.weights, axis=-1),
-            self.use_fused,
-        )
-
-    def _prepare_for_merge_state(self) -> None:
-        if self.inputs:
-            self.inputs = [jnp.concatenate(self.inputs, axis=-1)]
-            self.targets = [jnp.concatenate(self.targets, axis=-1)]
-            self.weights = [jnp.concatenate(self.weights, axis=-1)]
+        if self.use_fused:
+            # the fused histogram kernel min/max-normalizes scores per call,
+            # so it must see the exact valid slice, not -inf padding
+            inputs, targets, weights = self._valid()
+        else:
+            inputs, targets, weights = self._padded()
+        return _binary_auroc_compute(inputs, targets, weights, self.use_fused)
 
 
 TMulticlassAUROC = TypeVar("TMulticlassAUROC", bound="MulticlassAUROC")
 
 
-class MulticlassAUROC(Metric[jax.Array]):
+class MulticlassAUROC(BufferedExamplesMetric):
     """One-vs-rest AUROC for multiclass classification.
 
     Examples::
@@ -115,30 +110,22 @@ class MulticlassAUROC(Metric[jax.Array]):
         _multiclass_auroc_param_check(num_classes, average)
         self.num_classes = num_classes
         self.average = average
-        self._add_state("inputs", [], merge=MergeKind.EXTEND)
-        self._add_state("targets", [], merge=MergeKind.EXTEND)
+        # pad rows: score -inf (sorts last per class), target -1 (matches no
+        # class); compute masks pads out via per-example validity weights
+        self._add_buffer("inputs", fill=-jnp.inf, axis=0)
+        self._add_buffer("targets", fill=-1.0, axis=0)
 
     def update(self: TMulticlassAUROC, input, target) -> TMulticlassAUROC:
         input, target = self._input(input), self._input(target)
         _multiclass_auroc_update_input_check(input, target, self.num_classes)
-        self.inputs.append(input)
-        self.targets.append(target)
+        BufferedExamplesMetric._append(self, inputs=input, targets=target)
         return self
 
     def compute(self) -> jax.Array:
-        if not self.inputs:
-            raise RuntimeError(
-                "MulticlassAUROC has no data: call update() before compute()."
-            )
+        inputs, targets = self._padded()
         aurocs = _multiclass_auroc_compute_jit(
-            jnp.concatenate(self.inputs, axis=0),
-            jnp.concatenate(self.targets, axis=0),
+            inputs, targets, self._valid_mask(inputs.shape[0])
         )
         if self.average == "macro":
             return jnp.mean(aurocs)
         return aurocs
-
-    def _prepare_for_merge_state(self) -> None:
-        if self.inputs:
-            self.inputs = [jnp.concatenate(self.inputs, axis=0)]
-            self.targets = [jnp.concatenate(self.targets, axis=0)]

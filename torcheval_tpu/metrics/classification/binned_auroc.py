@@ -63,7 +63,8 @@ class BinaryBinnedAUROC(_BufferedPairMetric):
         return self
 
     def compute(self) -> Tuple[jax.Array, jax.Array]:
-        inputs, targets = self._concat()
+        # pad-neutral: padded scores are -inf, below every finite threshold
+        inputs, targets = self._padded()
         return (
             _binary_binned_auroc_compute_jit(inputs, targets, self.threshold),
             self.threshold,
@@ -101,7 +102,7 @@ class MulticlassBinnedAUROC(_BufferedPairMetric):
         return self
 
     def compute(self) -> Tuple[jax.Array, jax.Array]:
-        inputs, targets = self._concat()
+        inputs, targets = self._padded()
         auroc = _multiclass_binned_auroc_compute_jit(
             inputs, targets, self.threshold
         )

@@ -14,10 +14,10 @@ from torcheval_tpu.metrics.classification.auprc import _BufferedPairMetric
 from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
     _binary_precision_recall_curve_compute,
     _binary_precision_recall_curve_update_input_check,
+    _multiclass_precision_recall_curve_compute,
     _multiclass_precision_recall_curve_update_input_check,
+    _multilabel_precision_recall_curve_compute,
     _multilabel_precision_recall_curve_update_input_check,
-    multiclass_precision_recall_curve,
-    multilabel_precision_recall_curve,
 )
 
 T = TypeVar("T")
@@ -48,8 +48,10 @@ class BinaryPrecisionRecallCurve(_BufferedPairMetric):
         return self
 
     def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
-        inputs, targets = self._concat()
-        return _binary_precision_recall_curve_compute(inputs, targets)
+        inputs, targets = self._padded()
+        return _binary_precision_recall_curve_compute(
+            inputs, targets, valid_count=self.num_samples
+        )
 
 
 class MulticlassPrecisionRecallCurve(_BufferedPairMetric):
@@ -70,9 +72,13 @@ class MulticlassPrecisionRecallCurve(_BufferedPairMetric):
     def compute(
         self,
     ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
-        inputs, targets = self._concat()
-        return multiclass_precision_recall_curve(
-            inputs, targets, num_classes=self.num_classes
+        inputs, targets = self._padded()
+        num_classes = (
+            self.num_classes if self.num_classes is not None
+            else inputs.shape[1]
+        )
+        return _multiclass_precision_recall_curve_compute(
+            inputs, targets, num_classes, valid_count=self.num_samples
         )
 
 
@@ -94,7 +100,11 @@ class MultilabelPrecisionRecallCurve(_BufferedPairMetric):
     def compute(
         self,
     ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
-        inputs, targets = self._concat()
-        return multilabel_precision_recall_curve(
-            inputs, targets, num_labels=self.num_labels
+        inputs, targets = self._padded()
+        num_labels = (
+            self.num_labels if self.num_labels is not None
+            else inputs.shape[1]
+        )
+        return _multilabel_precision_recall_curve_compute(
+            inputs, targets, num_labels, valid_count=self.num_samples
         )

@@ -51,7 +51,9 @@ class BinaryRecallAtFixedPrecision(_BufferedPairMetric):
         return self
 
     def compute(self) -> Tuple[jax.Array, jax.Array]:
-        inputs, targets = self._concat()
+        # pad-neutral: padded slots (score -inf, target -1) only lower the
+        # precision of trailing duplicate-recall points, never the result
+        inputs, targets = self._padded()
         return _binary_rafp_kernel(inputs, targets, float(self.min_precision))
 
 
@@ -80,7 +82,7 @@ class MultilabelRecallAtFixedPrecision(_BufferedPairMetric):
         return self
 
     def compute(self) -> Tuple[List[jax.Array], List[jax.Array]]:
-        inputs, targets = self._concat()
+        inputs, targets = self._padded()
         recalls, thresholds = _multilabel_rafp_kernel(
             inputs, targets, float(self.min_precision)
         )

@@ -26,6 +26,27 @@ def _auc_compute_jit(x: jax.Array, y: jax.Array, reorder: bool) -> jax.Array:
     return trapezoid(y, x, axis=1)
 
 
+@partial(jax.jit, static_argnames=("reorder",))
+def _auc_compute_masked_jit(
+    x: jax.Array, y: jax.Array, count, reorder: bool
+) -> jax.Array:
+    """AUC over a padded (n_tasks, capacity) buffer with ``count`` valid
+    leading points (metrics/_buffer.py): pad slots are clamped to the last
+    valid point, so they form zero-width trapezoids wherever the stable sort
+    places them. Compiles once per capacity, not per count."""
+    n = x.shape[1]
+    idx = jnp.broadcast_to(
+        jnp.minimum(jnp.arange(n), count - 1)[None, :], x.shape
+    )
+    x = jnp.take_along_axis(x, idx, axis=1)
+    y = jnp.take_along_axis(y, idx, axis=1)
+    if reorder:
+        order = jnp.argsort(x, axis=1, stable=True)
+        x = jnp.take_along_axis(x, order, axis=1)
+        y = jnp.take_along_axis(y, order, axis=1)
+    return trapezoid(y, x, axis=1)
+
+
 def _auc_compute(x: jax.Array, y: jax.Array, reorder: bool = False) -> jax.Array:
     if x.size == 0 or y.size == 0:
         return jnp.zeros((0,))

@@ -114,15 +114,28 @@ def binary_auroc(
 
 
 @jax.jit
-def _multiclass_auroc_compute_jit(input: jax.Array, target: jax.Array) -> jax.Array:
+def _multiclass_auroc_compute_jit(
+    input: jax.Array,
+    target: jax.Array,
+    valid: Optional[jax.Array] = None,
+) -> jax.Array:
     # one-vs-rest: per-class descending sort of the transposed scores
-    # (reference auroc.py:206-235), vmapped over classes.
+    # (reference auroc.py:206-235), vmapped over classes. ``valid`` is an
+    # optional (N,) mask used by the fixed-shape buffered class metric:
+    # padded rows get weight 0 so they contribute to no class's curve.
     num_classes = input.shape[1]
     scores = input.T  # (C, N)
     targets = (target[None, :] == jnp.arange(num_classes)[:, None]).astype(
         jnp.float32
     )
-    _, cum_tp, cum_fp, _ = roc_cumulators(scores, targets, None)
+    weight = (
+        None
+        if valid is None
+        else jnp.broadcast_to(
+            valid.astype(jnp.float32)[None, :], scores.shape
+        )
+    )
+    _, cum_tp, cum_fp, _ = roc_cumulators(scores, targets, weight)
     return auroc_from_cumulators(cum_tp, cum_fp)
 
 

@@ -43,11 +43,22 @@ def _compact(
 
 
 def _binary_precision_recall_curve_compute(
-    input: jax.Array, target: jax.Array
+    input: jax.Array,
+    target: jax.Array,
+    valid_count: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """``valid_count``: when the arrays come from a fixed-shape padded buffer
+    (metrics/_buffer.py), the kernel runs on the full capacity (compiling
+    O(log n) times) and the pad slots — ascending-first after the flip — are
+    dropped host-side before compaction."""
     precision, recall, threshold, is_end = (
         np.asarray(x) for x in _prc_arrays_jit(input, target)
     )
+    if valid_count is not None:
+        pad = precision.shape[-1] - valid_count
+        precision, recall, threshold, is_end = (
+            a[..., pad:] for a in (precision, recall, threshold, is_end)
+        )
     return _compact(precision, recall, threshold, is_end)
 
 
@@ -137,9 +148,23 @@ def multiclass_precision_recall_curve(
     if num_classes is None and input.ndim == 2:
         num_classes = input.shape[1]
     _multiclass_precision_recall_curve_update_input_check(input, target, num_classes)
+    return _multiclass_precision_recall_curve_compute(input, target, num_classes)
+
+
+def _multiclass_precision_recall_curve_compute(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    valid_count: Optional[int] = None,
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
     p_full, r_full, t_full, end_full = (
         np.asarray(x) for x in _multiclass_prc_full_jit(input, target)
     )
+    if valid_count is not None:
+        pad = p_full.shape[-1] - valid_count
+        p_full, r_full, t_full, end_full = (
+            a[..., pad:] for a in (p_full, r_full, t_full, end_full)
+        )
     precisions, recalls, thresholds = [], [], []
     for c in range(num_classes):
         p, r, t = _compact(p_full[c], r_full[c], t_full[c], end_full[c])
@@ -188,9 +213,23 @@ def multilabel_precision_recall_curve(
     if num_labels is None and input.ndim == 2:
         num_labels = input.shape[1]
     _multilabel_precision_recall_curve_update_input_check(input, target, num_labels)
+    return _multilabel_precision_recall_curve_compute(input, target, num_labels)
+
+
+def _multilabel_precision_recall_curve_compute(
+    input: jax.Array,
+    target: jax.Array,
+    num_labels: int,
+    valid_count: Optional[int] = None,
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
     p_full, r_full, t_full, end_full = (
         np.asarray(x) for x in _multilabel_prc_full_jit(input, target)
     )
+    if valid_count is not None:
+        pad = p_full.shape[-1] - valid_count
+        p_full, r_full, t_full, end_full = (
+            a[..., pad:] for a in (p_full, r_full, t_full, end_full)
+        )
     precisions, recalls, thresholds = [], [], []
     for l in range(num_labels):
         p, r, t = _compact(p_full[l], r_full[l], t_full[l], end_full[l])

@@ -65,8 +65,12 @@ class FIDInceptionV3:
 
     def __call__(self, images: jax.Array) -> jax.Array:
         x = jnp.transpose(images, (0, 2, 3, 1))  # NCHW -> NHWC for TPU convs
+        # antialias=False matches the reference's F.interpolate(...,
+        # mode='bilinear', align_corners=False), which does not antialias
+        # when downscaling (jax.image.resize antialiases by default).
         x = jax.image.resize(
-            x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear"
+            x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear",
+            antialias=False,
         )
         return self._apply(self.variables, x)
 

@@ -12,12 +12,12 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics.functional.ranking.hit_rate import hit_rate
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics._buffer import BufferedExamplesMetric
 
 THitRate = TypeVar("THitRate", bound="HitRate")
 
 
-class HitRate(Metric[jax.Array]):
+class HitRate(BufferedExamplesMetric):
     """Concatenated per-example hit-rate scores.
 
     Examples::
@@ -35,21 +35,19 @@ class HitRate(Metric[jax.Array]):
     ) -> None:
         super().__init__(device=device)
         self.k = k
-        self._add_state("scores", [], merge=MergeKind.EXTEND)
+        # fixed-shape growable buffer of per-example scores (_buffer.py)
+        self._add_buffer("scores", fill=0.0, axis=0)
 
     def update(self: THitRate, input, target) -> THitRate:
         """Score one batch of predictions against targets."""
-        self.scores.append(
-            hit_rate(self._input(input), self._input(target), k=self.k)
+        BufferedExamplesMetric._append(
+            self,
+            scores=hit_rate(self._input(input), self._input(target), k=self.k),
         )
         return self
 
     def compute(self) -> jax.Array:
         """All per-example scores; empty array before any update."""
-        if not self.scores:
+        if self.num_samples == 0:
             return jnp.zeros(0)
-        return jnp.concatenate(self.scores, axis=0)
-
-    def _prepare_for_merge_state(self) -> None:
-        if self.scores:
-            self.scores = [jnp.concatenate(self.scores, axis=0)]
+        return self._valid()[0]

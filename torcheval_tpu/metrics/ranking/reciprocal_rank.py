@@ -12,12 +12,12 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics.functional.ranking.reciprocal_rank import reciprocal_rank
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics._buffer import BufferedExamplesMetric
 
 TReciprocalRank = TypeVar("TReciprocalRank", bound="ReciprocalRank")
 
 
-class ReciprocalRank(Metric[jax.Array]):
+class ReciprocalRank(BufferedExamplesMetric):
     """Concatenated per-example reciprocal ranks.
 
     Examples::
@@ -35,21 +35,19 @@ class ReciprocalRank(Metric[jax.Array]):
     ) -> None:
         super().__init__(device=device)
         self.k = k
-        self._add_state("scores", [], merge=MergeKind.EXTEND)
+        # fixed-shape growable buffer of per-example scores (_buffer.py)
+        self._add_buffer("scores", fill=0.0, axis=0)
 
     def update(self: TReciprocalRank, input, target) -> TReciprocalRank:
         """Score one batch of predictions against targets."""
-        self.scores.append(
-            reciprocal_rank(self._input(input), self._input(target), k=self.k)
+        BufferedExamplesMetric._append(
+            self,
+            scores=reciprocal_rank(self._input(input), self._input(target), k=self.k),
         )
         return self
 
     def compute(self) -> jax.Array:
         """All per-example scores; empty array before any update."""
-        if not self.scores:
+        if self.num_samples == 0:
             return jnp.zeros(0)
-        return jnp.concatenate(self.scores, axis=0)
-
-    def _prepare_for_merge_state(self) -> None:
-        if self.scores:
-            self.scores = [jnp.concatenate(self.scores, axis=0)]
+        return self._valid()[0]

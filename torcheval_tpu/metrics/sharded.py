@@ -52,8 +52,12 @@ def sync_states_in_jit(
     - ``SUM`` counters -> ``lax.psum`` (one fused all-reduce over ICI),
     - ``MAX``/``MIN`` -> ``lax.pmax``/``pmin``,
     - ``EXTEND`` buffers -> ``lax.all_gather`` + flatten along the example
-      axis (static shapes: callers keep per-replica buffers equal-sized,
-      which the fixed-shape update path guarantees).
+      axis. Static-shape precondition: per-replica buffers must be
+      equal-sized. The fixed-shape buffer layer
+      (``torcheval_tpu.metrics._buffer``) guarantees this under SPMD — every
+      replica performs the same update sequence, so capacities match — and
+      its pad-neutral fills mean the padding interleaved in the flattened
+      gather is harmless to the padded-buffer compute kernels.
 
     ``specs`` defaults to SUM for every state. Unknown/CUSTOM kinds raise:
     bespoke merges cannot be lowered generically — sync those eagerly via

@@ -136,7 +136,19 @@ class WindowedTaskCounterMetric(RingCursorSerializationMixin, Metric):
         (reference window/normalized_entropy.py:232-296). ``max_num_updates``
         itself is unchanged, matching the reference: the merged metric's
         *window* keeps its own size while the merged buffer holds every
-        replica's live columns."""
+        replica's live columns.
+
+        Post-merge ``_record`` semantics (deliberate, reference parity):
+        the cursor is reduced ``idx % max_num_updates`` exactly as the
+        reference does (normalized_entropy.py:294-295), so a post-merge
+        update overwrites a column of the *enlarged* buffer at that reduced
+        index — NOT necessarily the oldest entry. The window contents after
+        merge-then-update therefore drift from a strict
+        oldest-first-eviction reading, but match the reference bit-for-bit;
+        ``tests/metrics/window/test_window_merge_semantics.py`` pins this
+        against the reference implementation. Every consumer is a
+        column-sum, so no correctness invariant depends on eviction order.
+        """
         metrics = list(metrics)
         merged_cols = self.max_num_updates + sum(m.max_num_updates for m in metrics)
         cur_size = min(self.total_updates, self.max_num_updates)

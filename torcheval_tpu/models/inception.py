@@ -146,11 +146,25 @@ class InceptionE(nn.Module):
 class InceptionV3(nn.Module):
     """InceptionV3 trunk producing 2048-d pooled features (fc removed).
 
-    Input: NHWC float images already resized to 299x299.
+    Input: NHWC float images already resized to 299x299, in [0, 1].
+
+    ``transform_input`` replicates torchvision's ``inception_v3`` default
+    for pretrained weights (``transform_input=True``): a channelwise affine
+    remap from the [0, 1] scale the weights were NOT trained on to the
+    ImageNet-normalized scale they were (torchvision
+    models/inception.py ``_transform_input``) — without it, FID features
+    from imported weights systematically diverge from the reference.
     """
+
+    transform_input: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        if self.transform_input:
+            ch0 = x[..., 0:1] * (0.229 / 0.5) + (0.485 - 0.5) / 0.5
+            ch1 = x[..., 1:2] * (0.224 / 0.5) + (0.456 - 0.5) / 0.5
+            ch2 = x[..., 2:3] * (0.225 / 0.5) + (0.406 - 0.5) / 0.5
+            x = jnp.concatenate([ch0, ch1, ch2], axis=-1)
         x = BasicConv2d(32, (3, 3), strides=(2, 2), name="Conv2d_1a_3x3")(x)
         x = BasicConv2d(32, (3, 3), name="Conv2d_2a_3x3")(x)
         x = BasicConv2d(64, (3, 3), padding=1, name="Conv2d_2b_3x3")(x)

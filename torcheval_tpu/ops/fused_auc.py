@@ -218,16 +218,34 @@ def fused_auc_histogram(
     *,
     num_bins: int = DEFAULT_NUM_BINS,
     backend: str = "auto",
+    bounds: Optional[Tuple[float, float]] = None,
 ) -> jax.Array:
     """(num_tasks, 2, num_bins) positive/negative weight histograms of the
-    scores — the sufficient statistic of the fused AUC.
+    scores.
+
+    ``bounds``: when ``None`` (default) scores are min/max-normalized **per
+    call, per task** — the resulting histogram is only a valid AUC statistic
+    for this call's data and MUST NOT be accumulated or merged across
+    batches (different calls get different bin edges, and one outlier
+    rescales every bin). To stream/merge histograms across batches, pass a
+    fixed ``(lo, hi)`` range — e.g. ``(0.0, 1.0)`` for probabilities — which
+    fixes the bin edges globally; out-of-range scores clamp into the edge
+    bins.
 
     ``backend``: ``auto`` | ``pallas`` | ``native`` | ``xla``.
     """
     scores, labels, weights, _ = _as_2d(
         jnp.asarray(input), jnp.asarray(target), weight
     )
-    scores = _normalize_scores(scores)
+    if bounds is None:
+        scores = _normalize_scores(scores)
+    else:
+        lo, hi = bounds
+        if not hi > lo:
+            raise ValueError(
+                f"bounds must satisfy hi > lo, got ({lo}, {hi})."
+            )
+        scores = jnp.clip((scores - lo) / (hi - lo), 0.0, 1.0)
     if backend == "auto":
         platform = (
             scores.devices().pop().platform
@@ -264,9 +282,11 @@ def fused_auc(
     *,
     num_bins: int = DEFAULT_NUM_BINS,
     backend: str = "auto",
+    bounds: Optional[Tuple[float, float]] = None,
 ) -> jax.Array:
     """Sort-free approximate AUROC (scores of any range; binned after a
-    per-task min/max rescale).
+    per-task min/max rescale, or fixed ``bounds`` — see
+    ``fused_auc_histogram``).
 
     The analogue of ``fbgemm_gpu.metrics.auc`` in the reference's opt-in
     path (reference auroc.py:161-173): one fused streaming pass, exact up
@@ -277,7 +297,8 @@ def fused_auc(
     """
     squeeze = jnp.asarray(input).ndim == 1
     hist = fused_auc_histogram(
-        input, target, weight, num_bins=num_bins, backend=backend
+        input, target, weight, num_bins=num_bins, backend=backend,
+        bounds=bounds,
     )
     auc = _auc_from_hist(hist)
     return auc[0] if squeeze else auc
