@@ -30,9 +30,11 @@ except Exception:
     HAVE_TORCH = False
 
 try:
-    import torchvision  # noqa: F401
+    from torchvision import models as _tv_models
 
-    HAVE_TORCHVISION = True
+    # ref_oracle.py stubs torchvision into sys.modules for reference-oracle
+    # imports; require the real API, not the stub
+    HAVE_TORCHVISION = hasattr(_tv_models, "inception_v3")
 except Exception:
     HAVE_TORCHVISION = False
 

@@ -178,8 +178,11 @@ class BufferedExamplesMetric(Metric[jax.Array]):
         for other in metrics:
             if other._num_samples > 0:
                 values = other._valid()
-                self._append(
-                    **{n: self._place_state(v) for n, v in zip(names, values)}
+                # call the base append by buffer name: subclasses may override
+                # _append with a user-facing (input, target) signature
+                BufferedExamplesMetric._append(
+                    self,
+                    **{n: self._place_state(v) for n, v in zip(names, values)},
                 )
             for name, kind in self._state_name_to_merge_kind.items():
                 if name in skip:

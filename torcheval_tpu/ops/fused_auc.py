@@ -246,12 +246,11 @@ def fused_auc_histogram(
                 f"bounds must satisfy hi > lo, got ({lo}, {hi})."
             )
         scores = jnp.clip((scores - lo) / (hi - lo), 0.0, 1.0)
+    try:
+        platform = scores.devices().pop().platform
+    except Exception:  # tracer inside jit: fall back to the default backend
+        platform = jax.default_backend()
     if backend == "auto":
-        platform = (
-            scores.devices().pop().platform
-            if hasattr(scores, "devices")
-            else jax.default_backend()
-        )
         if platform == "tpu":
             backend = "pallas"
         elif platform == "cpu":
@@ -259,7 +258,9 @@ def fused_auc_histogram(
         else:
             backend = "xla"
     if backend == "pallas":
-        interpret = jax.default_backend() != "tpu"
+        # compiled Pallas needs a real TPU under the data; anywhere else
+        # (including CPU-committed arrays with a live TPU plugin) interpret
+        interpret = platform != "tpu"
         return _histogram_pallas(
             scores, labels, weights, num_bins, interpret=interpret
         )

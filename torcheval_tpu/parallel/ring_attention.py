@@ -80,10 +80,14 @@ def ring_attention(
 
     q_offset = my_index * block
 
-    # running online-softmax state
-    acc = jnp.zeros((batch, heads, nq, dim), jnp.float32)
-    denom = jnp.zeros((batch, heads, nq), jnp.float32)
-    running_max = jnp.full((batch, heads, nq), NEG_INF, jnp.float32)
+    # running online-softmax state; marked device-varying over the ring axis
+    # so the scan carry type matches the (varying) per-step outputs
+    def _varying(x):
+        return lax.pcast(x, (axis_name,), to="varying")
+
+    acc = _varying(jnp.zeros((batch, heads, nq, dim), jnp.float32))
+    denom = _varying(jnp.zeros((batch, heads, nq), jnp.float32))
+    running_max = _varying(jnp.full((batch, heads, nq), NEG_INF, jnp.float32))
 
     def step(carry, _):
         acc, denom, running_max, k_blk, v_blk, kv_index = carry

@@ -35,11 +35,44 @@ def _checkpointer():
 
 
 def _to_plain(tree):
-    """DefaultStateDict (our auto-zero dict) -> plain dict for Orbax."""
+    """DefaultStateDict (our auto-zero dict) -> plain dict for Orbax.
+
+    Device arrays are written as host numpy: metric state is tiny (sufficient
+    statistics / bounded buffers), and numpy payloads restore on any topology
+    without per-array sharding metadata (restore then routes through
+    ``load_state_dict``, which re-places state on the metric's device).
+    """
+    import numpy as np
+
     if isinstance(tree, dict):
         return {k: _to_plain(v) for k, v in tree.items()}
     if isinstance(tree, list):
         return [_to_plain(v) for v in tree]
+    if isinstance(tree, jax.Array):
+        tree = np.asarray(tree)
+    if isinstance(tree, np.ndarray) and tree.size == 0:
+        # Orbax refuses zero-size arrays (a fresh buffered metric's lazy
+        # sentinel is shape (0,)); encode shape+dtype, rebuild on restore.
+        return {
+            "__empty_shape__": np.asarray(tree.shape, np.int64),
+            "__empty_proto__": np.zeros((1,), tree.dtype),
+        }
+    return tree
+
+
+def _from_plain(tree):
+    """Inverse of :func:`_to_plain`'s empty-array encoding."""
+    import numpy as np
+
+    if isinstance(tree, dict):
+        if set(tree) == {"__empty_shape__", "__empty_proto__"}:
+            return np.zeros(
+                tuple(int(d) for d in tree["__empty_shape__"]),
+                tree["__empty_proto__"].dtype,
+            )
+        return {k: _from_plain(v) for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [_from_plain(v) for v in tree]
     return tree
 
 
@@ -74,7 +107,7 @@ def load_metric_state(
     from torcheval_tpu.metrics.toolkit import _restore_state_types
 
     path = os.fspath(path)
-    tree = _checkpointer().restore(path)
+    tree = _from_plain(_checkpointer().restore(path))
     if isinstance(metric, Metric):
         if "__single__" not in tree:
             raise RuntimeError(
