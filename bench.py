@@ -102,7 +102,10 @@ def run_auroc_compute():
     def body():
         jax.block_until_ready((auroc.compute(), auprc.compute()))
 
-    cps = _timed_loop(body, min_time=3.0, max_iters=50)
+    # on an accelerator each compute is ~100us: allow enough iterations for
+    # the min_time window to dominate the measurement
+    cap = 50 if jax.default_backend() == "cpu" else 20000
+    cps = _timed_loop(body, min_time=3.0, max_iters=cap)
     return {
         "metric": f"BinaryAUROC+AUPRC deferred compute ({n_total} samples)",
         "value": round(cps, 2),
@@ -113,9 +116,18 @@ def run_auroc_compute():
 def run_sync_overhead():
     """Config 3: in-jit psum metric sync overhead as % of step time.
 
-    Runs an 8-device data-parallel eval step (matmul model) twice — with and
-    without the in-step metric state sync — on a Mesh, and reports the wall
-    clock overhead percentage. North star (BASELINE.md): <1%.
+    Three arms of the same 8-device data-parallel eval step (matmul model)
+    on a Mesh:
+
+      1. no metric at all,
+      2. local metric update folded into the step (no cross-replica sync),
+      3. update + in-jit ``lax.psum`` state sync every step.
+
+    Headline value = (3 vs 2): the wall-clock cost of the sync collective
+    alone — the BASELINE.md north-star quantity (<1% of step time). The
+    (3 vs 1) total is also reported; that is the definition the reference
+    baseline measures (its gloo ``sync_and_compute`` necessarily includes
+    the update).
     """
     from functools import partial
 
@@ -132,10 +144,6 @@ def run_sync_overhead():
 
     devs = jax.devices()
     n = len(devs) if len(devs) >= 2 else 1
-    if n == 1:
-        # Single real chip: a 1-device mesh still exercises the code path;
-        # the collective is a no-op but the program structure is identical.
-        pass
     mesh = Mesh(np.array(devs[:n]), ("dp",))
 
     batch, d, classes = 64 * n, 512, 512
@@ -157,6 +165,31 @@ def run_sync_overhead():
     @jax.jit
     @partial(
         shard_map, mesh=mesh,
+        in_specs=(P("dp", None), P(), P()),
+        out_specs=P(),
+    )
+    def step_nometric(x, w1, w2):
+        logits = model(x, w1, w2)
+        return jax.lax.psum(jnp.sum(logits), "dp")
+
+    @jax.jit
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("dp", None), P("dp"), P(), P(), P("dp")),
+        out_specs=(P(), P("dp")),
+    )
+    def step_update(x, y, w1, w2, state):
+        # state: per-replica (1,) rows of an (n,) P("dp") carry — the metric
+        # accumulates locally, no cross-replica collective
+        logits = model(x, w1, w2)
+        nc, nt = _multiclass_accuracy_update(logits, y, "micro", None, 1)
+        local = {"nc": state["nc"] + nc, "nt": state["nt"] + nt}
+        s = jax.lax.psum(jnp.sum(logits), "dp")
+        return s, local
+
+    @jax.jit
+    @partial(
+        shard_map, mesh=mesh,
         in_specs=(P("dp", None), P("dp"), P(), P(), P()),
         out_specs=(P(), P()),
     )
@@ -168,34 +201,46 @@ def run_sync_overhead():
         s = jax.lax.psum(jnp.sum(logits), "dp")
         return s, synced
 
-    @jax.jit
-    @partial(
-        shard_map, mesh=mesh,
-        in_specs=(P("dp", None), P(), P()),
-        out_specs=P(),
-    )
-    def step_plain(x, w1, w2):
-        logits = model(x, w1, w2)
-        s = jnp.sum(logits)
-        return jax.lax.psum(s, "dp") / jax.lax.psum(1, "dp")
-
     state = {"nc": jnp.zeros(()), "nt": jnp.zeros(())}
+    # per-replica carried state for the no-sync arm: (n, 1) rows, P("dp")
+    state_sharded = {
+        "nc": jax.device_put(jnp.zeros((n,)), NamedSharding(mesh, P("dp"))),
+        "nt": jax.device_put(jnp.zeros((n,)), NamedSharding(mesh, P("dp"))),
+    }
+
+    def body_nometric():
+        jax.block_until_ready(step_nometric(x, w1, w2))
+
+    def body_update():
+        jax.block_until_ready(step_update(x, y, w1, w2, state_sharded))
 
     def body_sync():
         jax.block_until_ready(step_sync(x, y, w1, w2, state))
 
-    def body_plain():
-        jax.block_until_ready(step_plain(x, w1, w2))
-
-    plain_ips = _timed_loop(body_plain, min_time=2.0)
-    sync_ips = _timed_loop(body_sync, min_time=2.0)
-    overhead_pct = max(0.0, (1.0 / sync_ips - 1.0 / plain_ips) * plain_ips * 100.0)
+    # interleaved best-of-3: the arms differ by <10%, so a transient load
+    # spike during any single pass would swamp the quantity being measured
+    bodies = (body_nometric, body_update, body_sync)
+    best = [0.0, 0.0, 0.0]
+    for _ in range(3):
+        for i, body in enumerate(bodies):
+            # high iteration cap: the time window must dominate, or the
+            # two near-equal rates being differenced are pure noise
+            best[i] = max(
+                best[i], _timed_loop(body, min_time=1.0, max_iters=100000)
+            )
+    nometric_ips, update_ips, sync_ips = best
+    sync_pct = max(0.0, (1.0 / sync_ips - 1.0 / update_ips) * update_ips * 100.0)
+    total_pct = max(
+        0.0, (1.0 / sync_ips - 1.0 / nometric_ips) * nometric_ips * 100.0
+    )
     return {
         "metric": f"in-jit psum metric sync overhead ({n}-device dp mesh)",
-        "value": round(overhead_pct, 3),
+        "value": round(sync_pct, 3),
         "unit": "% of step time",
         "lower_is_better": True,
-        "step_per_s_plain": round(plain_ips, 1),
+        "update_plus_sync_overhead_pct": round(total_pct, 3),
+        "step_per_s_no_metric": round(nometric_ips, 1),
+        "step_per_s_local_update": round(update_ips, 1),
         "step_per_s_with_metric_sync": round(sync_ips, 1),
     }
 
@@ -273,7 +318,8 @@ def run_fid():
         fid.update(jimgs, is_real=True)
         jax.block_until_ready(fid.state_dict())
 
-    ups = _timed_loop(body, min_time=3.0, max_iters=50)
+    cap = 50 if jax.default_backend() == "cpu" else 5000
+    ups = _timed_loop(body, min_time=3.0, max_iters=cap)
     return {
         "metric": f"FID update throughput (InceptionV3 fwd, batch={batch})",
         "value": round(ups * batch, 1),
@@ -363,32 +409,77 @@ def ref_sync_overhead():
     all_gather_object over gloo) on this host, as % overhead of the same
     matmul eval step.
     """
+    import tempfile
+
     import torch  # noqa: F401  (import check before spawning workers)
 
     # gloo busy-waits; on a small-core host more workers just thrash.
     nproc = 2
-    code_overhead = _REF_SYNC_WORKER
-    out = subprocess.run(
-        [sys.executable, "-c", code_overhead, str(nproc)],
-        capture_output=True, text=True, timeout=240,
-    )
+    # the worker must live in a real file: multiprocessing's spawn context
+    # re-imports __main__, which does not exist for `python -c` scripts
+    # (children die unpickling the target and q.get() blocks forever)
+    with tempfile.NamedTemporaryFile(
+        "w", suffix="_ref_sync_worker.py", delete=False
+    ) as f:
+        f.write(_REF_SYNC_WORKER)
+        worker_path = f.name
+    try:
+        out = subprocess.run(
+            [sys.executable, worker_path, str(nproc)],
+            capture_output=True, text=True, timeout=400,
+        )
+    finally:
+        os.unlink(worker_path)
     if out.returncode != 0:
         raise RuntimeError(f"ref sync worker failed: {out.stderr[-800:]}")
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 _REF_SYNC_WORKER = r"""
-import json, os, sys, time
+import importlib.machinery, json, os, sys, time, types
 sys.path.insert(0, "/root/reference")
 import torch
 import torch.distributed as dist
 import torch.multiprocessing as mp
+
+def _stub_torchvision():
+    # torcheval.metrics imports FID at package level, which hard-requires
+    # torchvision; stub it (spawned workers get a fresh interpreter)
+    if "torchvision" in sys.modules:
+        return
+    tv = types.ModuleType("torchvision")
+    tv.__spec__ = importlib.machinery.ModuleSpec("torchvision", None)
+    tv.models = types.ModuleType("torchvision.models")
+    tv.models.__spec__ = importlib.machinery.ModuleSpec(
+        "torchvision.models", None)
+    sys.modules["torchvision"] = tv
+    sys.modules["torchvision.models"] = tv.models
+    # torchtnt is absent from this image; the reference toolkit only uses
+    # PGWrapper(pg).get_world_size() (toolkit.py:242,298)
+    if "torchtnt" not in sys.modules:
+        tnt = types.ModuleType("torchtnt")
+        tnt.__spec__ = importlib.machinery.ModuleSpec("torchtnt", None)
+        tnt_utils = types.ModuleType("torchtnt.utils")
+        tnt_utils.__spec__ = importlib.machinery.ModuleSpec(
+            "torchtnt.utils", None)
+        class PGWrapper:
+            def __init__(self, pg=None):
+                self.pg = pg
+            def get_world_size(self):
+                return dist.get_world_size(self.pg)
+            def get_rank(self):
+                return dist.get_rank(self.pg)
+        tnt_utils.PGWrapper = PGWrapper
+        tnt.utils = tnt_utils
+        sys.modules["torchtnt"] = tnt
+        sys.modules["torchtnt.utils"] = tnt_utils
 
 def work(rank, nproc, port, q):
     os.environ["MASTER_ADDR"] = "127.0.0.1"
     os.environ["MASTER_PORT"] = str(port)
     torch.set_num_threads(2)
     dist.init_process_group("gloo", rank=rank, world_size=nproc)
+    _stub_torchvision()
     from torcheval.metrics import MulticlassAccuracy
     from torcheval.metrics.toolkit import sync_and_compute
     torch.manual_seed(rank)
@@ -429,11 +520,20 @@ if __name__ == "__main__":
     s = socket.socket(); s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]; s.close()
     ctx = mp.get_context("spawn")
-    q = ctx.SimpleQueue()
+    q = ctx.Queue()
     procs = [ctx.Process(target=work, args=(r, nproc, port, q))
              for r in range(nproc)]
     for p in procs: p.start()
-    res = q.get()
+    import queue as _queue
+    res = None
+    while res is None:
+        try:
+            res = q.get(timeout=5)
+        except _queue.Empty:
+            dead = [p for p in procs if not p.is_alive() and p.exitcode != 0]
+            if dead:
+                for p in procs: p.terminate()
+                sys.exit(f"worker died with exitcode {dead[0].exitcode}")
     for p in procs: p.join(60)
     print(json.dumps(res))
 """
@@ -578,9 +678,14 @@ def main():
             try:
                 ref = _run_ref_child(refname, timeout=420)
                 if entry.get("lower_is_better"):
+                    # compare like with like: the reference's sync number
+                    # necessarily includes the metric update, so ratio
+                    # against our update+sync total when we report one
+                    mine = entry.get(
+                        "update_plus_sync_overhead_pct", entry["value"]
+                    )
                     entry["vs_baseline"] = (
-                        round(ref["value"] / entry["value"], 2)
-                        if entry["value"] > 0 else None
+                        round(ref["value"] / mine, 2) if mine > 0 else None
                     )
                     entry["baseline_value"] = round(ref["value"], 3)
                 else:

@@ -1,0 +1,541 @@
+"""Edge-case parity sweep vs the reference oracle.
+
+Extends the main sweep (test_functional_parity.py) along the axes the
+reference's own unit tests stress hardest (reference
+tests/metrics/functional/**): tied scores, degenerate single-class targets,
+weighted variants, every ``average`` branch, top-k variants, multi-task
+shapes, threshold grids given as int/list/tensor, and text/ranking
+parameter corners.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.ref_oracle import load_reference_metrics
+from torcheval_tpu.metrics import functional as F
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    assert_result_close,
+)
+
+REF_M, REF_F = load_reference_metrics()
+RNG = np.random.default_rng(131)
+
+N = 48
+C = 4
+L = 3
+
+
+def _t(x):
+    return torch.tensor(np.asarray(x))
+
+
+CASES = {}
+
+
+def case(name):
+    def deco(fn):
+        CASES[name] = fn
+        return fn
+    return deco
+
+
+# ------------------------------------------------------------------- ties
+
+def tied_binary():
+    """Scores drawn from only 5 distinct values -> heavy ties."""
+    x = RNG.choice(np.linspace(0.1, 0.9, 5), size=N).astype(np.float32)
+    t = RNG.integers(0, 2, N).astype(np.float32)
+    return x, t
+
+
+@case("auroc_ties")
+def _():
+    x, t = tied_binary()
+    return F.binary_auroc(x, t), REF_F.binary_auroc(_t(x), _t(t))
+
+
+@case("auprc_ties")
+def _():
+    x, t = tied_binary()
+    return F.binary_auprc(x, t), REF_F.binary_auprc(_t(x), _t(t))
+
+
+@case("prc_ties")
+def _():
+    x, t = tied_binary()
+    return (
+        F.binary_precision_recall_curve(x, t),
+        REF_F.binary_precision_recall_curve(_t(x), _t(t)),
+    )
+
+
+@case("auroc_all_identical_scores")
+def _():
+    x = np.full(N, 0.5, np.float32)
+    t = RNG.integers(0, 2, N).astype(np.float32)
+    return F.binary_auroc(x, t), REF_F.binary_auroc(_t(x), _t(t))
+
+
+# -------------------------------------------------------------- degenerate
+
+@case("auroc_all_positive")
+def _():
+    x = RNG.random(N).astype(np.float32)
+    t = np.ones(N, np.float32)
+    return F.binary_auroc(x, t), REF_F.binary_auroc(_t(x), _t(t))
+
+
+@case("auroc_all_negative")
+def _():
+    x = RNG.random(N).astype(np.float32)
+    t = np.zeros(N, np.float32)
+    return F.binary_auroc(x, t), REF_F.binary_auroc(_t(x), _t(t))
+
+
+@case("auroc_single_sample")
+def _():
+    return (
+        F.binary_auroc(np.float32([0.7]), np.float32([1.0])),
+        REF_F.binary_auroc(_t(np.float32([0.7])), _t(np.float32([1.0]))),
+    )
+
+
+@case("multiclass_accuracy_absent_class_macro")
+def _():
+    # class C-1 never appears in targets: macro masks zero-count classes
+    x = RNG.random((N, C)).astype(np.float32)
+    t = RNG.integers(0, C - 1, N)
+    return (
+        F.multiclass_accuracy(x, t, average="macro", num_classes=C),
+        REF_F.multiclass_accuracy(_t(x), _t(t), average="macro", num_classes=C),
+    )
+
+
+@case("f1_absent_class")
+def _():
+    x = RNG.random((N, C)).astype(np.float32)
+    t = RNG.integers(0, C - 1, N)
+    ours = [
+        F.multiclass_f1_score(x, t, num_classes=C, average=a)
+        for a in ("macro", "weighted", None)
+    ]
+    ref = [
+        REF_F.multiclass_f1_score(_t(x), _t(t), num_classes=C, average=a)
+        for a in ("macro", "weighted", None)
+    ]
+    return ours, ref
+
+
+@case("mse_zero_variance_r2")
+def _():
+    # constant target: R2 degenerate branch
+    x = RNG.random(N).astype(np.float32)
+    t = np.full(N, 0.5, np.float32)
+    return F.mean_squared_error(x, t), REF_F.mean_squared_error(_t(x), _t(t))
+
+
+# ----------------------------------------------------------------- weights
+
+@case("auroc_weighted_1d")
+def _():
+    x, t = tied_binary()
+    w = RNG.random(N).astype(np.float32)
+    return (
+        F.binary_auroc(x, t, weight=w),
+        REF_F.binary_auroc(_t(x), _t(t), weight=_t(w)),
+    )
+
+
+@case("mean_sum_weight_variants")
+def _():
+    x = RNG.random(N).astype(np.float32)
+    w = RNG.random(N).astype(np.float32)
+    ours = [F.mean(x), F.mean(x, 2.5), F.mean(x, w), F.sum(x), F.sum(x, 3), F.sum(x, w)]
+    ref = [
+        REF_F.mean(_t(x)),
+        REF_F.mean(_t(x), 2.5),
+        REF_F.mean(_t(x), _t(w)),
+        REF_F.sum(_t(x)),
+        REF_F.sum(_t(x), 3),
+        REF_F.sum(_t(x), _t(w)),
+    ]
+    return ours, ref
+
+
+@case("mse_sample_weight")
+def _():
+    x = RNG.random((N, 3)).astype(np.float32)
+    t = RNG.random((N, 3)).astype(np.float32)
+    w = RNG.random(N).astype(np.float32)
+    ours = [
+        F.mean_squared_error(x, t, sample_weight=w),
+        F.mean_squared_error(x, t, sample_weight=w, multioutput="raw_values"),
+    ]
+    ref = [
+        REF_F.mean_squared_error(_t(x), _t(t), sample_weight=_t(w)),
+        REF_F.mean_squared_error(
+            _t(x), _t(t), sample_weight=_t(w), multioutput="raw_values"
+        ),
+    ]
+    return ours, ref
+
+
+@case("r2_variants")
+def _():
+    x = RNG.random((N, 3)).astype(np.float32)
+    t = (x + RNG.normal(0, 0.1, (N, 3))).astype(np.float32)
+    ours = [
+        F.r2_score(x, t, multioutput="raw_values"),
+        F.r2_score(x, t, multioutput="variance_weighted"),
+        F.r2_score(x[:, 0], t[:, 0], num_regressors=2),
+    ]
+    ref = [
+        REF_F.r2_score(_t(x), _t(t), multioutput="raw_values"),
+        REF_F.r2_score(_t(x), _t(t), multioutput="variance_weighted"),
+        REF_F.r2_score(_t(x[:, 0]), _t(t[:, 0]), num_regressors=2),
+    ]
+    return ours, ref
+
+
+@case("normalized_entropy_weighted_multitask")
+def _():
+    x = np.clip(RNG.random((2, N)), 0.05, 0.95).astype(np.float64)
+    t = RNG.integers(0, 2, (2, N)).astype(np.float64)
+    w = RNG.random((2, N)).astype(np.float64)
+    return (
+        F.binary_normalized_entropy(x, t, weight=w, num_tasks=2),
+        REF_F.binary_normalized_entropy(_t(x), _t(t), weight=_t(w), num_tasks=2),
+    )
+
+
+@case("ctr_weighted_multitask")
+def _():
+    k = RNG.integers(0, 2, (2, N)).astype(np.float32)
+    w = RNG.random((2, N)).astype(np.float32)
+    return (
+        F.click_through_rate(k, w, num_tasks=2),
+        REF_F.click_through_rate(_t(k), _t(w), num_tasks=2),
+    )
+
+
+@case("weighted_calibration_multitask")
+def _():
+    x = RNG.random((2, N)).astype(np.float32)
+    t = RNG.integers(0, 2, (2, N)).astype(np.float32)
+    w = RNG.random((2, N)).astype(np.float32)
+    return (
+        F.weighted_calibration(x, t, w, num_tasks=2),
+        REF_F.weighted_calibration(_t(x), _t(t), _t(w), num_tasks=2),
+    )
+
+
+# ------------------------------------------------------- average branches
+
+@case("precision_all_averages")
+def _():
+    x = RNG.random((N, C)).astype(np.float32)
+    t = RNG.integers(0, C, N)
+    ours = [
+        F.multiclass_precision(x, t, num_classes=C, average=a)
+        for a in ("micro", "macro", "weighted", None)
+    ]
+    ref = [
+        REF_F.multiclass_precision(_t(x), _t(t), num_classes=C, average=a)
+        for a in ("micro", "macro", "weighted", None)
+    ]
+    return ours, ref
+
+
+@case("recall_all_averages")
+def _():
+    x = RNG.random((N, C)).astype(np.float32)
+    t = RNG.integers(0, C, N)
+    ours = [
+        F.multiclass_recall(x, t, num_classes=C, average=a)
+        for a in ("micro", "macro", "weighted", None)
+    ]
+    ref = [
+        REF_F.multiclass_recall(_t(x), _t(t), num_classes=C, average=a)
+        for a in ("micro", "macro", "weighted", None)
+    ]
+    return ours, ref
+
+
+@case("auroc_multiclass_average_none")
+def _():
+    x = RNG.random((N, C)).astype(np.float32)
+    t = RNG.integers(0, C, N)
+    return (
+        F.multiclass_auroc(x, t, num_classes=C, average=None),
+        REF_F.multiclass_auroc(_t(x), _t(t), num_classes=C, average=None),
+    )
+
+
+@case("auprc_average_none_multilabel")
+def _():
+    x = RNG.random((N, L)).astype(np.float32)
+    t = RNG.integers(0, 2, (N, L)).astype(np.float32)
+    return (
+        F.multilabel_auprc(x, t, num_labels=L, average=None),
+        REF_F.multilabel_auprc(_t(x), _t(t), num_labels=L, average=None),
+    )
+
+
+@case("binned_auprc_average_none")
+def _():
+    x = RNG.random((N, C)).astype(np.float32)
+    t = RNG.integers(0, C, N)
+    return (
+        F.multiclass_binned_auprc(x, t, num_classes=C, threshold=15, average=None),
+        REF_F.multiclass_binned_auprc(
+            _t(x), _t(t), num_classes=C, threshold=15, average=None
+        ),
+    )
+
+
+# ---------------------------------------------------------- top-k variants
+
+@case("accuracy_k_sweep")
+def _():
+    x = RNG.random((N, C)).astype(np.float32)
+    t = RNG.integers(0, C, N)
+    ours = [
+        F.multiclass_accuracy(x, t, num_classes=C, k=k, average=a)
+        for k in (1, 2, 3)
+        for a in ("micro", "macro")
+    ]
+    ref = [
+        REF_F.multiclass_accuracy(_t(x), _t(t), num_classes=C, k=k, average=a)
+        for k in (1, 2, 3)
+        for a in ("micro", "macro")
+    ]
+    return ours, ref
+
+
+@case("topk_multilabel_all_criteria")
+def _():
+    # Deliberate divergence from the reference: its update hardcodes
+    # ``input.topk(k=2, ...)`` regardless of the ``k`` argument (reference
+    # functional/classification/accuracy.py:406-408), so for k != 2 it
+    # silently computes top-2. We honor k; pin against a numpy oracle that
+    # implements the documented semantics with the true k.
+    k = 3
+    x = RNG.random((N, 5)).astype(np.float32)
+    t = RNG.integers(0, 2, (N, 5)).astype(np.float32)
+    topk_idx = np.argsort(-x, axis=1)[:, :k]
+    lab = np.zeros_like(x)
+    np.put_along_axis(lab, topk_idx, 1.0, axis=1)
+
+    def oracle(criteria):
+        if criteria == "exact_match":
+            return np.all(lab == t, axis=1).mean()
+        if criteria == "hamming":
+            return (lab == t).mean()
+        if criteria == "overlap":
+            row_hit = np.logical_and(lab == t, lab == 1).max(axis=1)
+            both_zero = np.all((lab == 0) & (t == 0), axis=1)
+            return (row_hit + both_zero).mean()
+        if criteria == "contain":
+            return np.all(lab - t >= 0, axis=1).mean()
+        return np.all(lab - t <= 0, axis=1).mean()  # belong
+
+    crits = ("exact_match", "hamming", "overlap", "contain", "belong")
+    ours = [F.topk_multilabel_accuracy(x, t, criteria=c, k=k) for c in crits]
+    ref = [np.float32(oracle(c)) for c in crits]
+    return ours, ref
+
+
+@case("hit_rate_k_sweep")
+def _():
+    scores = RNG.random((16, 10)).astype(np.float32)
+    cls = RNG.integers(0, 10, 16)
+    ours = [F.hit_rate(scores, cls, k=k) for k in (1, 5, 10)]
+    ref = [REF_F.hit_rate(_t(scores), _t(cls), k=k) for k in (1, 5, 10)]
+    return ours, ref
+
+
+# ----------------------------------------------------- threshold variants
+
+@case("binned_threshold_forms")
+def _():
+    x, t = tied_binary()
+    th_list = [0.0, 0.25, 0.5, 0.75, 1.0]
+    ours = [
+        F.binary_binned_auroc(x, t, threshold=th_list),
+        F.binary_binned_auroc(x, t, threshold=jnp.asarray(th_list)),
+        F.binary_binned_auprc(x, t, threshold=th_list),
+        F.binary_binned_precision_recall_curve(
+            x, t, threshold=jnp.asarray(th_list)
+        ),
+    ]
+    ref = [
+        REF_F.binary_binned_auroc(_t(x), _t(t), threshold=th_list),
+        REF_F.binary_binned_auroc(_t(x), _t(t), threshold=_t(np.float32(th_list))),
+        REF_F.binary_binned_auprc(_t(x), _t(t), threshold=th_list),
+        REF_F.binary_binned_precision_recall_curve(
+            _t(x), _t(t), threshold=_t(np.float32(th_list))
+        ),
+    ]
+    return ours, ref
+
+
+@case("confusion_matrix_binary_threshold")
+def _():
+    x, t = tied_binary()
+    ti = t.astype(np.int64)
+    ours = [
+        F.binary_confusion_matrix(x, ti, threshold=th) for th in (0.25, 0.5, 0.75)
+    ]
+    ref = [
+        REF_F.binary_confusion_matrix(_t(x), _t(ti), threshold=th)
+        for th in (0.25, 0.5, 0.75)
+    ]
+    return ours, ref
+
+
+@case("binary_accuracy_extreme_thresholds")
+def _():
+    x, t = tied_binary()
+    ours = [F.binary_accuracy(x, t, threshold=th) for th in (0.0, 1.0)]
+    ref = [REF_F.binary_accuracy(_t(x), _t(t), threshold=th) for th in (0.0, 1.0)]
+    return ours, ref
+
+
+# ----------------------------------------------------------- multi-task
+
+@case("auroc_many_tasks")
+def _():
+    x = RNG.random((5, N)).astype(np.float32)
+    t = RNG.integers(0, 2, (5, N)).astype(np.float32)
+    return (
+        F.binary_auroc(x, t, num_tasks=5),
+        REF_F.binary_auroc(_t(x), _t(t), num_tasks=5),
+    )
+
+
+@case("auc_multitask_unsorted")
+def _():
+    # 2D (tasks, n) curves, unsorted x with reorder
+    x = RNG.random((3, 20)).astype(np.float32)
+    y = RNG.random((3, 20)).astype(np.float32)
+    return (
+        F.auc(x, y, reorder=True),
+        REF_F.auc(_t(x), _t(y), reorder=True),
+    )
+
+
+# ------------------------------------------------------------------ text
+
+@case("perplexity_ignore_index")
+def _():
+    logits = RNG.normal(size=(3, 10, 7)).astype(np.float32)
+    toks = RNG.integers(0, 7, (3, 10))
+    toks[0, :5] = -100
+    return (
+        F.perplexity(logits, toks, ignore_index=-100),
+        REF_F.perplexity(_t(logits), _t(toks), ignore_index=-100),
+    )
+
+
+@case("bleu_ngram_weights")
+def _():
+    preds = ["the quick brown fox jumps over the lazy dog tonight"]
+    tgts = [["the quick brown fox jumped over a lazy dog last night"]]
+    ours = [
+        F.bleu_score(preds, tgts, n_gram=n) for n in (1, 2, 3, 4)
+    ] + [F.bleu_score(preds, tgts, n_gram=4, weights=jnp.asarray([0.1, 0.2, 0.3, 0.4]))]
+    ref = [
+        REF_F.bleu_score(preds, tgts, n_gram=n) for n in (1, 2, 3, 4)
+    ] + [REF_F.bleu_score(preds, tgts, n_gram=4, weights=_t(np.float64([0.1, 0.2, 0.3, 0.4])))]
+    return ours, ref
+
+
+@case("bleu_multiple_references")
+def _():
+    preds = ["the cat sat on the mat", "a dog ran far"]
+    tgts = [
+        ["the cat sat on a mat", "a cat sat on the mat"],
+        ["the dog ran far away", "a dog ran quite far"],
+    ]
+    return (
+        F.bleu_score(preds, tgts, n_gram=3),
+        REF_F.bleu_score(preds, tgts, n_gram=3),
+    )
+
+
+@case("wer_single_string")
+def _():
+    ours = [
+        F.word_error_rate("hello world", "hello there world"),
+        F.word_error_rate("identical words here", "identical words here"),
+    ]
+    ref = [
+        REF_F.word_error_rate("hello world", "hello there world"),
+        REF_F.word_error_rate("identical words here", "identical words here"),
+    ]
+    return ours, ref
+
+
+# --------------------------------------------------------------- ranking
+
+@case("retrieval_precision_limit_k")
+def _():
+    x = RNG.random(6).astype(np.float32)
+    t = np.float32([1, 0, 1, 0, 0, 1])
+    ours = [
+        F.retrieval_precision(x, t, k=10, limit_k_to_size=True),
+        F.retrieval_precision(x, t, k=2),
+    ]
+    ref = [
+        REF_F.retrieval_precision(_t(x), _t(t), k=10, limit_k_to_size=True),
+        REF_F.retrieval_precision(_t(x), _t(t), k=2),
+    ]
+    return ours, ref
+
+
+@case("frequency_collisions_edges")
+def _():
+    ids = np.concatenate([np.arange(10), np.arange(5)])  # guaranteed collisions
+    freq = np.float32([0.0, 0.5, 0.5, 1.0])
+    ours = [
+        F.num_collisions(ids),
+        F.frequency_at_k(freq, k=0.5),
+        F.frequency_at_k(freq, k=0.0),
+    ]
+    ref = [
+        REF_F.num_collisions(_t(ids)),
+        REF_F.frequency_at_k(_t(freq), k=0.5),
+        REF_F.frequency_at_k(_t(freq), k=0.0),
+    ]
+    return ours, ref
+
+
+# ------------------------------------------------------------------ image
+
+@case("psnr_observed_range")
+def _():
+    # data_range=None: PSNR uses the observed target max-min
+    x = (RNG.random((4, 8)) * 3 + 1).astype(np.float32)
+    t = (RNG.random((4, 8)) * 3 + 1).astype(np.float32)
+    return (
+        F.peak_signal_noise_ratio(x, t),
+        REF_F.peak_signal_noise_ratio(_t(x), _t(t)),
+    )
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_functional_parity_edge(name):
+    ours, ref = CASES[name]()
+
+    def to_np(x):
+        if isinstance(x, torch.Tensor):
+            return x.detach().cpu().numpy()
+        if isinstance(x, (list, tuple)):
+            return type(x)(to_np(v) for v in x)
+        if x is None:
+            return None
+        return np.asarray(x)
+
+    assert_result_close(to_np(ours), to_np(ref), atol=1e-4, rtol=1e-4)

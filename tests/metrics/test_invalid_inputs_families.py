@@ -1,0 +1,230 @@
+"""Invalid-input sweeps for the non-classification families.
+
+Companion to tests/metrics/classification/test_invalid_inputs.py: mirrors the
+reference's per-metric ``assertRaisesRegex`` batteries for aggregation,
+ranking, regression, text, and image functional ops, plus class-constructor
+and update-time parameter checks (reference tests/metrics/aggregation/**,
+ranking/**, regression/**, text/**, window/**).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import torcheval_tpu.metrics.functional as F
+from torcheval_tpu.metrics import (
+    AUC,
+    FrechetInceptionDistance,
+    RetrievalPrecision,
+    Throughput,
+    WindowedBinaryAUROC,
+    WindowedBinaryNormalizedEntropy,
+    WindowedClickThroughRate,
+    WindowedMeanSquaredError,
+)
+
+A = jnp.asarray
+
+
+def _t(*shape):
+    return jnp.zeros(shape)
+
+
+def _ti(*shape):
+    return jnp.zeros(shape, dtype=jnp.int32)
+
+
+# (fn, args, kwargs, exc, message-regex)
+FUNCTIONAL_CASES = [
+    # -------------------------------------------------------- aggregation
+    (F.mean, (_t(4),), {"weight": _t(3)},
+     ValueError, r"Weight must be either a float value or a tensor"),
+    (F.sum, (_t(4),), {"weight": _t(3)},
+     ValueError, r"Weight must be either a float value or an int value"),
+    (F.auc, (_t(0), _t(0)), {},
+     ValueError, r"atleast 1 element"),
+    (F.auc, (_t(4), _t(3)), {},
+     ValueError, r"same shape"),
+    (F.throughput, (-1, 1.0), {},
+     ValueError, r"num_processed to be a non-negative number"),
+    (F.throughput, (10, 0.0), {},
+     ValueError, r"elapsed_time_sec to be a positive number"),
+    (F.throughput, (10, -2.0), {},
+     ValueError, r"elapsed_time_sec to be a positive number"),
+    # ------------------------------------------------------------ ranking
+    (F.retrieval_precision, (_t(4), _t(4)), {"k": 0},
+     ValueError, r"k must be a positive integer"),
+    (F.retrieval_precision, (_t(4), _t(4)),
+     {"k": None, "limit_k_to_size": True},
+     ValueError, r"limit_k_to_size is True"),
+    (F.retrieval_precision, (_t(4), _t(3)), {},
+     ValueError, r"same shape"),
+    (F.retrieval_precision, (_t(4, 2), _t(4, 2)), {},
+     ValueError, r"one dimensional tensors"),
+    (F.weighted_calibration, (_t(4), _t(4)), {"weight": _t(3)},
+     ValueError, r"Weight must be either a float value or a tensor"),
+    (F.weighted_calibration, (_t(4), _t(3)), {},
+     ValueError, r"different from `target` shape"),
+    (F.weighted_calibration, (_t(2, 4), _t(2, 4)), {},
+     ValueError, r"`num_tasks = 1`"),
+    (F.weighted_calibration, (_t(3, 4), _t(3, 4)), {"num_tasks": 2},
+     ValueError, r"`num_tasks = 2`"),
+    (F.num_collisions, (_t(4, 2).astype(jnp.int32),), {},
+     ValueError, r"one-dimensional tensor"),
+    (F.num_collisions, (_t(4),), {},
+     ValueError, r"integer tensor"),
+    (F.hit_rate, (_t(4, 3), _ti(4, 2)), {},
+     ValueError, r"target should be a one-dimensional tensor"),
+    (F.hit_rate, (_t(4), _ti(4)), {},
+     ValueError, r"input should be a two-dimensional tensor"),
+    (F.hit_rate, (_t(3, 3), _ti(4)), {},
+     ValueError, r"same minibatch dimension"),
+    (F.hit_rate, (_t(4, 3), _ti(4)), {"k": -1},
+     ValueError, r"k should be None or positive"),
+    (F.click_through_rate, (_t(4, 2, 2),), {},
+     ValueError, r"one or two dimensional tensor"),
+    (F.click_through_rate, (_t(4),), {"weights": _t(3)},
+     ValueError, r"same shape as tensor `input`"),
+    (F.click_through_rate, (_t(2, 4),), {},
+     ValueError, r"`num_tasks = 1`"),
+    (F.click_through_rate, (_t(3, 4),), {"num_tasks": 2},
+     ValueError, r"`num_tasks = 2`"),
+    (F.frequency_at_k, (_t(4, 2),), {"k": 0.5},
+     ValueError, r"one-dimensional tensor"),
+    (F.frequency_at_k, (_t(4),), {"k": -0.5},
+     ValueError, r"k should not be negative"),
+    (F.reciprocal_rank, (_t(4, 3), _ti(4, 2)), {},
+     ValueError, r"target should be a one-dimensional tensor"),
+    (F.reciprocal_rank, (_t(4), _ti(4)), {},
+     ValueError, r"input should be a two-dimensional tensor"),
+    (F.reciprocal_rank, (_t(3, 3), _ti(4)), {},
+     ValueError, r"same minibatch dimension"),
+    # --------------------------------------------------------- regression
+    (F.mean_squared_error, (_t(4, 2, 2), _t(4, 2, 2)), {},
+     ValueError, r"should be 1D or 2D"),
+    (F.mean_squared_error, (_t(4), _t(3)), {},
+     ValueError, r"should have the same size"),
+    (F.mean_squared_error, (_t(4, 2), _t(4, 2)), {"sample_weight": _t(3)},
+     ValueError, r"`sample_weight`"),
+    (F.mean_squared_error, (_t(4), _t(4)), {"multioutput": "avg"},
+     ValueError, r"must be either `raw_values` or `uniform_average`"),
+    (F.r2_score, (_t(1), _t(1)), {},
+     ValueError, r"at least two\s+samples"),
+    (F.r2_score, (_t(4), _t(4)), {"num_regressors": 3},
+     ValueError, r"must be smaller than n_samples - 1"),
+    (F.r2_score, (_t(4), _t(4)), {"multioutput": "mean"},
+     ValueError, r"`raw_values` or\s+`uniform_average` or `variance_weighted`"),
+    (F.r2_score, (_t(4), _t(4)), {"num_regressors": -1},
+     ValueError, r"integer larger or equal to zero"),
+    (F.r2_score, (_t(4, 2, 2), _t(4, 2, 2)), {},
+     ValueError, r"should be 1D or 2D"),
+    (F.r2_score, (_t(4), _t(3)), {},
+     ValueError, r"should have the same size"),
+    # --------------------------------------------------------------- text
+    (F.word_error_rate, ("a b", ["a", "b"]), {},
+     ValueError, r"same type"),
+    (F.word_error_rate, (["a", "b"], ["a"]), {},
+     ValueError, r"same length"),
+    (F.word_information_lost, ("a b", ["a", "b"]), {},
+     ValueError, r"same type"),
+    (F.word_information_preserved, (["a", "b"], ["a"]), {},
+     ValueError, r"same length"),
+    (F.bleu_score, (["hi there"], [["hi there"]]), {"n_gram": 5},
+     ValueError, r"n_gram should be 1, 2, 3, or 4"),
+    (F.bleu_score, (["a b", "c d"], [["a b"]]), {},
+     ValueError, r"same sizes"),
+    (F.bleu_score, (["one"], [["one two three"]]), {"n_gram": 4},
+     ValueError, r"too short"),
+    (F.bleu_score, (["a b c d e"], [["a b c d e"]]),
+     {"n_gram": 4, "weights": A(np.float32([0.5, 0.5]))},
+     ValueError, r"length of weights should equal n_gram"),
+    (F.perplexity, (_t(2, 5, 7), _ti(2, 5, 1)), {},
+     ValueError, r"target should be a two-dimensional tensor"),
+    (F.perplexity, (_t(2, 5), _ti(2, 5)), {},
+     ValueError, r"input should be a three-dimensional tensor"),
+    (F.perplexity, (_t(3, 5, 7), _ti(2, 5)), {},
+     ValueError, r"same first dimension"),
+    (F.perplexity, (_t(2, 4, 7), _ti(2, 5)), {},
+     ValueError, r"same second dimension"),
+    # -------------------------------------------------------------- image
+    (F.peak_signal_noise_ratio, (_t(4), _t(4)), {"data_range": "x"},
+     ValueError, r"either `None` or `float`"),
+    (F.peak_signal_noise_ratio, (_t(4), _t(4)), {"data_range": -1.0},
+     ValueError, r"needs to be positive"),
+    (F.peak_signal_noise_ratio, (_t(4), _t(3)), {},
+     ValueError, r"must have the same shape"),
+]
+
+
+@pytest.mark.parametrize(
+    "case", FUNCTIONAL_CASES,
+    ids=[f"{c[0].__name__}-{i}" for i, c in enumerate(FUNCTIONAL_CASES)],
+)
+def test_functional_invalid(case):
+    fn, args, kwargs, exc, msg = case
+    with pytest.raises(exc, match=msg):
+        fn(*args, **kwargs)
+
+
+# ----------------------------------------------------- class-level checks
+
+CLASS_CASES = [
+    (lambda: Throughput().update(-1, 1.0),
+     ValueError, r"num_processed to be a non-negative number"),
+    (lambda: Throughput().update(1, 0.0),
+     ValueError, r"elapsed_time_sec to be a positive number"),
+    (lambda: WindowedBinaryAUROC(num_tasks=0),
+     ValueError, r"`num_tasks` value should be greater"),
+    (lambda: WindowedBinaryAUROC(max_num_samples=0),
+     ValueError, r"`max_num_samples` value should be greater"),
+    (lambda: WindowedBinaryNormalizedEntropy(num_tasks=0),
+     ValueError, r"`num_tasks` value should be greater"),
+    (lambda: WindowedBinaryNormalizedEntropy(max_num_updates=0),
+     ValueError, r"`max_num_updates` value should be greater"),
+    (lambda: WindowedClickThroughRate(max_num_updates=0),
+     ValueError, r"`max_num_updates` value should be greater"),
+    (lambda: WindowedMeanSquaredError(max_num_updates=0),
+     ValueError, r"`max_num_updates` value should be greater"),
+    (lambda: RetrievalPrecision(empty_target_action="drop"),
+     ValueError, r"empty_target_action must be one of"),
+    (lambda: RetrievalPrecision(avg="mean"),
+     ValueError, r"avg must be"),
+    (lambda: RetrievalPrecision(k=0),
+     ValueError, r"k must be a positive integer"),
+    (lambda: FrechetInceptionDistance(feature_dim=0),
+     RuntimeError, r"feature_dim has to be a positive integer"),
+    (lambda: FrechetInceptionDistance(
+        model=lambda x: x, feature_dim=0),
+     RuntimeError, r"feature_dim has to be a positive integer"),
+]
+
+
+@pytest.mark.parametrize(
+    "case", CLASS_CASES, ids=[f"class-{i}" for i in range(len(CLASS_CASES))]
+)
+def test_class_invalid(case):
+    build, exc, msg = case
+    with pytest.raises(exc, match=msg):
+        build()
+
+
+def test_fid_update_input_checks():
+    # custom tiny extractor: the default model needs torchvision weights
+    fid = FrechetInceptionDistance(
+        model=lambda imgs: jnp.zeros((imgs.shape[0], 16)), feature_dim=16
+    )
+    with pytest.raises(ValueError, match=r"Expected 4D tensor"):
+        fid.update(_t(3, 8, 8), is_real=True)
+    with pytest.raises(ValueError, match=r"Expected 3 channels"):
+        fid.update(_t(2, 1, 8, 8), is_real=True)
+    with pytest.raises(ValueError, match=r"to be of type bool"):
+        fid.update(_t(2, 3, 8, 8), is_real=1)
+
+
+def test_retrieval_precision_empty_target_err():
+    m = RetrievalPrecision(empty_target_action="err", k=2)
+    m.update(A(np.float32([0.3, 0.9, 0.1])), A(np.float32([0.0, 0.0, 0.0])))
+    with pytest.raises(ValueError, match=r"no positive value found"):
+        m.compute()

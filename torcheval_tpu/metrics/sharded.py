@@ -62,16 +62,27 @@ def sync_states_in_jit(
     ``specs`` defaults to SUM for every state. Unknown/CUSTOM kinds raise:
     bespoke merges cannot be lowered generically — sync those eagerly via
     the toolkit.
+
+    All same-kind, same-dtype states are fused into ONE collective
+    (flatten-concat -> psum/pmax/pmin -> split): a whole metric collection
+    syncs in a handful of collectives regardless of state count — the in-jit
+    analogue of the reference's single batched ``all_gather_object`` for
+    collections (reference toolkit.py:263-334).
     """
     synced: Dict[str, Any] = {}
+    reduce_groups: Dict[Any, list] = {}  # (kind, dtype) -> [(name, value)]
+    reducers = {
+        MergeKind.SUM: lax.psum,
+        MergeKind.MAX: lax.pmax,
+        MergeKind.MIN: lax.pmin,
+    }
     for name, value in states.items():
         kind = (specs or {}).get(name, MergeKind.SUM)
-        if kind is MergeKind.SUM:
-            synced[name] = lax.psum(value, axis_name)
-        elif kind is MergeKind.MAX:
-            synced[name] = lax.pmax(value, axis_name)
-        elif kind is MergeKind.MIN:
-            synced[name] = lax.pmin(value, axis_name)
+        if kind in reducers:
+            value = jnp.asarray(value)
+            reduce_groups.setdefault((kind, value.dtype), []).append(
+                (name, value)
+            )
         elif kind is MergeKind.EXTEND:
             # Gather-as-psum: scatter the local shard into a zero [world, ...]
             # buffer at this replica's index, then all-reduce. Semantically an
@@ -90,6 +101,21 @@ def sync_states_in_jit(
                 f"State {name!r} has merge kind {kind}; custom merges must "
                 "use the eager toolkit sync."
             )
+
+    for (kind, _dtype), group in reduce_groups.items():
+        reducer = reducers[kind]
+        if len(group) == 1:
+            name, value = group[0]
+            synced[name] = reducer(value, axis_name)
+            continue
+        flat = jnp.concatenate([v.ravel() for _, v in group])
+        merged = reducer(flat, axis_name)
+        offset = 0
+        for name, value in group:
+            synced[name] = merged[offset:offset + value.size].reshape(
+                value.shape
+            )
+            offset += value.size
     return synced
 
 
