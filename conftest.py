@@ -1,9 +1,18 @@
 """Repo-root pytest config.
 
-Must run before JAX initializes its backends: forces an 8-device virtual CPU
-platform so multi-device sharding/sync tests run without TPU hardware
-(the JAX analogue of the reference's multi-process gloo-on-localhost test
-strategy, reference utils/test_utils/metric_class_tester.py:292-341).
+Tests run on a CPU-only JAX with an 8-device virtual platform, so
+multi-device sharding/sync tests need no TPU hardware (the JAX analogue of
+the reference's multi-process gloo-on-localhost strategy, reference
+utils/test_utils/metric_class_tester.py:292-341).
+
+This must happen BEFORE the first backend init: the image's TPU plugin
+registers at interpreter start (site hook on ``PALLAS_AXON_POOL_IPS``) and
+programmatically forces ``jax_platforms=axon``; when the TPU relay is
+unreachable, initializing that backend hangs every ``jax.devices()`` call.
+The env var ``JAX_PLATFORMS=cpu`` does NOT override the hook's programmatic
+setting — ``jax.config.update`` after import does. XLA_FLAGS is read at
+backend init, which has not happened yet at conftest time, so setting it
+here is still early enough.
 """
 
 import os
@@ -14,8 +23,7 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
+import jax  # noqa: E402  (already imported by the site hook anyway)
 
-# Some images expose an experimental TPU plugin that wins default-backend even
-# when tests want CPU; pin default placement to the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_device", jax.devices("cpu")[0])

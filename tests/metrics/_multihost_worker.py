@@ -18,13 +18,18 @@ import sys
 
 
 def main() -> None:
-    coord, nproc, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
-
     import jax
 
-    jax.distributed.initialize(
-        coordinator_address=coord, num_processes=nproc, process_id=rank
-    )
+    if len(sys.argv) > 3:  # explicit argv mode (test_multihost.py spawner)
+        coord, nproc, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=nproc, process_id=rank
+        )
+    else:  # launcher mode: python -m torcheval_tpu.launcher <this file>
+        from torcheval_tpu.launcher import init_from_env
+
+        init_from_env()
+        nproc, rank = jax.process_count(), jax.process_index()
 
     import jax.numpy as jnp
     import numpy as np

@@ -204,6 +204,29 @@ def test_cat_and_auc_growth():
     np.testing.assert_allclose(np.asarray(auc2.compute()), [1.0], atol=1e-5)
 
 
+def test_snapshot_survives_donated_appends():
+    """state_dict snapshots must stay valid across later updates: the append
+    kernel donates the live buffer, so snapshots must be real copies."""
+    m = BinaryAUROC()
+    x = RNG.random(40).astype(np.float32)
+    t = (RNG.random(40) < 0.5).astype(np.float32)
+    m.update(jnp.asarray(x), jnp.asarray(t))
+    snap = m.state_dict()
+    before = float(m.compute())
+    # several more appends into the same capacity-64 buffer (donated writes)
+    for _ in range(3):
+        m.update(jnp.asarray(x[:8]), jnp.asarray(t[:8]))
+    # the snapshot's arrays are still alive and unchanged
+    fresh = BinaryAUROC()
+    fresh.load_state_dict(snap)
+    np.testing.assert_allclose(float(fresh.compute()), before, atol=1e-7)
+    # and a load_state_dict'ed metric does not invalidate the caller's dict
+    fresh.update(jnp.asarray(x[:8]), jnp.asarray(t[:8]))
+    np.testing.assert_array_equal(
+        np.asarray(snap["inputs"]).shape[-1], 64
+    )
+
+
 def test_compute_before_update_raises():
     with pytest.raises(RuntimeError, match="has no data"):
         BinaryAUROC().compute()

@@ -12,9 +12,6 @@ from __future__ import annotations
 
 import json
 import os
-import socket
-import subprocess
-import sys
 
 import numpy as np
 import pytest
@@ -23,51 +20,25 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 WORKER = os.path.join(REPO, "tests", "metrics", "_multihost_worker.py")
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _spawn_workers(nproc: int, timeout: float = 300.0):
-    """Run the worker on nproc processes; return per-rank RESULT dicts."""
-    coord = f"127.0.0.1:{_free_port()}"
-    env = dict(os.environ)
-    # Workers must get a plain CPU-only JAX: scrub the TPU plugin
-    # registration and the parent's virtual-device flag (each worker is one
-    # "host" with its own device, like one process per pod host).
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, WORKER, coord, str(nproc), str(rank)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True, cwd=REPO,
-        )
-        for rank in range(nproc)
-    ]
-    outputs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=timeout)
-            outputs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+def parse_result_lines(outputs):
+    """Per-rank 'RESULT {json}' payloads from worker outputs (rank order)."""
     results = []
-    for rank, (p, out) in enumerate(zip(procs, outputs)):
-        assert p.returncode == 0, (
-            f"rank {rank} failed (rc={p.returncode}):\n{out[-2000:]}"
-        )
+    for rank, out in enumerate(outputs):
         lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
         assert lines, f"rank {rank} printed no RESULT line:\n{out[-2000:]}"
         results.append(json.loads(lines[-1][len("RESULT "):]))
     return results
+
+
+def _spawn_workers(nproc: int, timeout: float = 300.0):
+    """Run the worker on nproc processes via the launcher (the library's own
+    multi-process path); return per-rank RESULT dicts."""
+    from torcheval_tpu.launcher import launch
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    outputs = launch(WORKER, nproc=nproc, timeout=timeout, env=env)
+    return parse_result_lines(outputs)
 
 
 @pytest.mark.parametrize("nproc", [2, 4])

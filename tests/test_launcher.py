@@ -1,0 +1,74 @@
+"""Launcher tests: the JAX analogue of the reference's torchelastic launch
+path (reference examples/distributed_example.py:163-174).
+
+Launches the real multihost worker through ``torcheval_tpu.launcher`` and
+checks the ranks form one ``jax.distributed`` job and agree on synced values.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "metrics", "_multihost_worker.py")
+
+
+from tests.metrics.test_multihost import parse_result_lines as _parse_results
+
+
+def test_launch_python_api():
+    from torcheval_tpu.launcher import launch
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    outputs = launch(WORKER, nproc=2, timeout=300.0, env=env)
+    results = _parse_results(outputs)
+    assert results[0] == results[1]
+    assert results[0]["sum"] == 3.0  # (0+1) + (1+1)
+    assert results[0]["allgather_array"] == [[0, 1], [1, 2]]
+
+
+def test_launch_cli():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torcheval_tpu.launcher",
+            "--nproc", "2", WORKER,
+        ],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    # every worker line is rank-prefixed and both ranks reported results
+    assert "[rank 0] RESULT " in proc.stdout
+    assert "[rank 1] RESULT " in proc.stdout
+
+
+def test_worker_failure_is_reported(tmp_path):
+    import textwrap
+    from torcheval_tpu.launcher import launch
+
+    bad = tmp_path / "bad_worker.py"
+    bad.write_text(textwrap.dedent("""
+        import sys
+        from torcheval_tpu.launcher import init_from_env
+        rank = init_from_env()
+        if rank == 1:
+            sys.exit(7)
+        print("ok")
+    """))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    with pytest.raises(RuntimeError, match=r"rank 1 exited with 7"):
+        launch(str(bad), nproc=2, timeout=300.0, env=env)
+
+
+def test_init_from_env_noop_without_env():
+    from torcheval_tpu.launcher import ENV_COORDINATOR, init_from_env
+
+    assert ENV_COORDINATOR not in os.environ
+    assert init_from_env() == 0

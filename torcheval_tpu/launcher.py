@@ -1,0 +1,182 @@
+"""Multi-process launcher for multi-host-style JAX jobs.
+
+The reference spawns its distributed workers with ``torch.distributed.
+launcher`` (torchelastic ``pet.elastic_launch`` with a c10d rendezvous —
+reference examples/distributed_example.py:163-174, utils/test_utils/
+metric_class_tester.py:299-312). The JAX analogue launched here: N OS
+processes that join one ``jax.distributed`` job over a localhost (or given)
+coordinator, each becoming one "host" of the job. On a real TPU pod the
+runtime launches one process per host for you and none of this is needed —
+this launcher exists for single-machine multi-process runs: tests,
+examples, and CPU rehearsals of pod topology.
+
+Two surfaces:
+
+- CLI, mirroring the reference's ``torchrun``-style UX::
+
+    python -m torcheval_tpu.launcher --nproc 4 my_eval.py --my-flag
+
+  Each worker re-runs ``my_eval.py`` with ``TE_TPU_{COORDINATOR,NPROC,RANK}``
+  exported; the script opts in by calling :func:`init_from_env`.
+
+- Python API: :func:`launch` with a script path and argv.
+
+Workers get ``JAX_PLATFORMS=cpu`` by default (each process is one virtual
+"host"; accelerator plugins claiming the same chip N times would deadlock) —
+pass ``platform=None`` to inherit the parent's backends on a real pod.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import List, Optional, Sequence
+
+ENV_COORDINATOR = "TE_TPU_COORDINATOR"
+ENV_NPROC = "TE_TPU_NPROC"
+ENV_RANK = "TE_TPU_RANK"
+
+
+def init_from_env() -> int:
+    """Join the ``jax.distributed`` job described by the launcher's env vars.
+
+    Returns this worker's process index. A no-op (returning 0) when the env
+    vars are absent, so the same script runs unchanged single-process —
+    the reference scripts' ``init_process_group`` guard pattern
+    (reference examples/distributed_example.py:77-80).
+    """
+    import jax
+
+    coord = os.environ.get(ENV_COORDINATOR)
+    if coord is None:
+        return 0
+    nproc = int(os.environ[ENV_NPROC])
+    rank = int(os.environ[ENV_RANK])
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nproc, process_id=rank
+    )
+    return rank
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch(
+    script: str,
+    script_args: Sequence[str] = (),
+    *,
+    nproc: int = 2,
+    coordinator: Optional[str] = None,
+    platform: Optional[str] = "cpu",
+    timeout: float = 600.0,
+    env: Optional[dict] = None,
+) -> List[str]:
+    """Run ``script`` on ``nproc`` cooperating processes; returns each
+    worker's captured stdout+stderr (rank order). Raises ``RuntimeError``
+    with the failing rank's tail if any worker exits non-zero.
+    """
+    import tempfile
+    import time
+
+    coordinator = coordinator or f"127.0.0.1:{free_port()}"
+    base_env = dict(os.environ if env is None else env)
+    if platform is not None:
+        # one virtual host per process: strip single-chip plugin claims
+        base_env.pop("PALLAS_AXON_POOL_IPS", None)
+        base_env.pop("XLA_FLAGS", None)
+        base_env["JAX_PLATFORMS"] = platform
+    base_env[ENV_COORDINATOR] = coordinator
+    base_env[ENV_NPROC] = str(nproc)
+
+    # worker output goes to temp FILES, not pipes: a rank that fills a pipe
+    # buffer mid-collective would block, deadlocking the whole job while the
+    # parent drains some other rank
+    procs, logs = [], []
+    for rank in range(nproc):
+        worker_env = dict(base_env)
+        worker_env[ENV_RANK] = str(rank)
+        log = tempfile.TemporaryFile("w+", errors="replace")
+        logs.append(log)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, script, *script_args],
+                env=worker_env,
+                stdout=log,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+
+    def read_log(rank: int) -> str:
+        logs[rank].seek(0)
+        return logs[rank].read()
+
+    deadline = time.monotonic() + timeout  # shared: total, not per-rank
+    try:
+        for rank, p in enumerate(procs):
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                raise RuntimeError(
+                    f"worker rank {rank} timed out after {timeout:.0f}s:\n"
+                    f"{read_log(rank)[-2000:]}"
+                ) from None
+        outputs = [read_log(r) for r in range(nproc)]
+        for rank, p in enumerate(procs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"worker rank {rank} exited with {p.returncode}:\n"
+                    f"{outputs[rank][-2000:]}"
+                )
+        return outputs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m torcheval_tpu.launcher",
+        description="Launch a script on N cooperating jax.distributed "
+        "processes (workers call torcheval_tpu.launcher.init_from_env()).",
+    )
+    ap.add_argument("--nproc", type=int, default=2)
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port (default: localhost, free port)")
+    ap.add_argument("--platform", default="cpu",
+                    help="JAX_PLATFORMS for workers; 'inherit' keeps the "
+                    "parent's backends (real pod)")
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    platform = None if args.platform == "inherit" else args.platform
+    outputs = launch(
+        args.script,
+        args.script_args,
+        nproc=args.nproc,
+        coordinator=args.coordinator,
+        platform=platform,
+    )
+    for rank, out in enumerate(outputs):
+        for line in out.rstrip().splitlines():
+            print(f"[rank {rank}] {line}")
+
+
+if __name__ == "__main__":
+    main()

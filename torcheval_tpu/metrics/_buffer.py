@@ -50,8 +50,13 @@ def next_capacity(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
-@partial(jax.jit, static_argnames=("axis",))
+@partial(jax.jit, static_argnames=("axis",), donate_argnums=(0,))
 def _write_at(buf: jax.Array, batch: jax.Array, count, *, axis: int) -> jax.Array:
+    # buf is DONATED: XLA aliases input and output (on CPU too — the input
+    # buffer is deleted after the call), so the append is a true in-place
+    # O(batch) write instead of an O(capacity) copy per update. Ownership
+    # consequence: the buffer array object must never escape the metric —
+    # state_dict/load_state_dict below hand out/take in copies.
     start = tuple(
         count if d == axis else 0 for d in range(buf.ndim)
     )
@@ -166,6 +171,27 @@ class BufferedExamplesMetric(Metric[jax.Array]):
                 f"{type(self).__name__} has no data: call update() before "
                 "compute()."
             )
+
+    # ------------------------------------------------- snapshot ownership
+
+    def state_dict(self):
+        """Snapshots must not alias the live buffers: the donated append
+        kernel (``_write_at``) consumes the buffer array on the next
+        ``update``, which would invalidate a shared snapshot."""
+        sd = super().state_dict()
+        for name in self._buffer_specs:
+            if isinstance(sd.get(name), jax.Array):
+                sd[name] = jnp.copy(sd[name])
+        return sd
+
+    def load_state_dict(self, state_dict, strict: bool = True) -> None:
+        super().load_state_dict(state_dict, strict)
+        # take ownership: the caller's arrays must survive our future
+        # donated appends
+        for name in self._buffer_specs:
+            buf = getattr(self, name, None)
+            if isinstance(buf, jax.Array):
+                setattr(self, name, jnp.copy(buf))
 
     # ------------------------------------------------------------------- merge
 
