@@ -14,22 +14,15 @@ parent compares across ranks and against expected values.
 from __future__ import annotations
 
 import json
-import sys
 
 
 def main() -> None:
     import jax
 
-    if len(sys.argv) > 3:  # explicit argv mode (test_multihost.py spawner)
-        coord, nproc, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
-        jax.distributed.initialize(
-            coordinator_address=coord, num_processes=nproc, process_id=rank
-        )
-    else:  # launcher mode: python -m torcheval_tpu.launcher <this file>
-        from torcheval_tpu.launcher import init_from_env
+    from torcheval_tpu.launcher import init_from_env
 
-        init_from_env()
-        nproc, rank = jax.process_count(), jax.process_index()
+    init_from_env()
+    nproc, rank = jax.process_count(), jax.process_index()
 
     import jax.numpy as jnp
     import numpy as np
@@ -101,6 +94,20 @@ def main() -> None:
     # --- synced state dict (checkpoint payload) -----------------------------
     sd = get_synced_state_dict(m_sum, group)
     results["synced_state_dict_sum"] = float(sd["sum"])
+
+    # --- buffered metric, ragged sample counts across ranks ------------------
+    # rank r holds 60*r+5 samples: rank 0 stays at the 64-slot minimum
+    # capacity while later ranks cross power-of-2 doublings (128, 256), so
+    # the gathered buffer state_dicts genuinely differ in shape across ranks
+    from torcheval_tpu.metrics import BinaryAUROC
+
+    n_r = 60 * rank + 5
+    rngb = np.random.default_rng(100 + rank)
+    xb = rngb.random(n_r).astype(np.float32)
+    tb = (rngb.random(n_r) < 0.5).astype(np.float32)
+    auroc = BinaryAUROC()
+    auroc.update(jnp.asarray(xb), jnp.asarray(tb))
+    results["auroc"] = float(sync_and_compute(auroc, group))
 
     print("RESULT " + json.dumps(results), flush=True)
 

@@ -86,3 +86,15 @@ def test_multihost_sync(nproc):
     assert res["coll_sum"] == float(sum(range(nproc)))
 
     assert res["synced_state_dict_sum"] == res["sum"]
+
+    # buffered AUROC with ragged per-rank sample counts == pooled oracle
+    import sklearn.metrics as skm
+
+    xs, ts = [], []
+    for r in range(nproc):
+        rngb = np.random.default_rng(100 + r)
+        n_r = 60 * r + 5
+        xs.append(rngb.random(n_r).astype(np.float32))
+        ts.append((rngb.random(n_r) < 0.5).astype(np.float32))
+    expected = skm.roc_auc_score(np.concatenate(ts), np.concatenate(xs))
+    assert res["auroc"] == pytest.approx(expected, abs=1e-5)

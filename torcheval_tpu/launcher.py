@@ -118,31 +118,43 @@ def launch(
         logs[rank].seek(0)
         return logs[rank].read()
 
-    deadline = time.monotonic() + timeout  # shared: total, not per-rank
-    try:
-        for rank, p in enumerate(procs):
-            try:
-                p.wait(timeout=max(0.0, deadline - time.monotonic()))
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    if q.poll() is None:
-                        q.kill()
-                raise RuntimeError(
-                    f"worker rank {rank} timed out after {timeout:.0f}s:\n"
-                    f"{read_log(rank)[-2000:]}"
-                ) from None
-        outputs = [read_log(r) for r in range(nproc)]
-        for rank, p in enumerate(procs):
-            if p.returncode != 0:
-                raise RuntimeError(
-                    f"worker rank {rank} exited with {p.returncode}:\n"
-                    f"{outputs[rank][-2000:]}"
-                )
-        return outputs
-    finally:
+    def kill_all():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        for p in procs:  # reap: no zombies, logs quiesce before reading
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+    # fail-fast poll: one crashed rank leaves its peers blocked in
+    # jax.distributed collectives — report the crash, not the peers' hang
+    deadline = time.monotonic() + timeout  # shared: total, not per-rank
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            bad = next(
+                (r for r, c in enumerate(codes) if c not in (None, 0)), None
+            )
+            if bad is not None:
+                kill_all()
+                raise RuntimeError(
+                    f"worker rank {bad} exited with {codes[bad]}:\n"
+                    f"{read_log(bad)[-2000:]}"
+                )
+            if all(c == 0 for c in codes):
+                return [read_log(r) for r in range(nproc)]
+            if time.monotonic() > deadline:
+                hung = [r for r, c in enumerate(codes) if c is None]
+                kill_all()
+                raise RuntimeError(
+                    f"worker rank(s) {hung} timed out after {timeout:.0f}s:\n"
+                    f"{read_log(hung[0])[-2000:]}"
+                )
+            time.sleep(0.05)
+    finally:
+        kill_all()
         for log in logs:
             log.close()
 
