@@ -1,0 +1,79 @@
+"""fused_accumulate contract: one cached executable per (kernel, config),
+correct accumulation, arity mismatch raises."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torcheval_tpu.metrics._fuse import _CACHE, fused_accumulate
+
+
+def _pair_kernel(x, scale):
+    return jnp.sum(x) * scale, jnp.float32(x.shape[0])
+
+
+def _single_kernel(x):
+    return jnp.sum(x)
+
+
+def test_accumulates_and_caches():
+    before = len(_CACHE)
+    s = (jnp.zeros(()), jnp.zeros(()))
+    x = jnp.arange(4, dtype=jnp.float32)
+    s = fused_accumulate(_pair_kernel, s, (x,), (2.0,))
+    s = fused_accumulate(_pair_kernel, s, (x,), (2.0,))
+    np.testing.assert_allclose(float(s[0]), 2 * 2 * 6.0)
+    np.testing.assert_allclose(float(s[1]), 8.0)
+    assert len(_CACHE) == before + 1  # second call reused the entry
+
+    # different config -> different cache entry, independent result
+    s2 = fused_accumulate(_pair_kernel, (jnp.zeros(()), jnp.zeros(())), (x,), (3.0,))
+    np.testing.assert_allclose(float(s2[0]), 18.0)
+    assert len(_CACHE) == before + 2
+
+
+def test_single_delta_kernel():
+    (total,) = fused_accumulate(
+        _single_kernel, (jnp.float32(1.0),), (jnp.ones(3),)
+    )
+    np.testing.assert_allclose(float(total), 4.0)
+
+
+def test_arity_mismatch_raises():
+    with pytest.raises(ValueError, match="returned 1 deltas for 2 states"):
+        fused_accumulate(
+            _single_kernel, (jnp.zeros(()), jnp.zeros(())), (jnp.ones(3),)
+        )
+
+
+def test_counter_update_is_one_fused_program():
+    """The whole point: a counter-metric update routes through ONE cached
+    fused executable (kernel + state adds), compiled once for the input
+    signature — no separate eager-add programs and no per-update retrace."""
+    from torcheval_tpu.metrics import MulticlassF1Score
+    from torcheval_tpu.metrics.functional.classification.f1_score import (
+        _f1_score_update_jit,
+    )
+
+    m = MulticlassF1Score()
+    x = jnp.asarray(np.random.default_rng(0).integers(0, 4, 16))
+    t = jnp.asarray(np.random.default_rng(1).integers(0, 4, 16))
+
+    # drop any entries earlier tests created so the count below is exact
+    for k in [k for k in _CACHE if k[0] is _f1_score_update_jit]:
+        del _CACHE[k]
+
+    for _ in range(5):
+        m.update(x, t)
+
+    # exactly one fused entry appeared for this metric's (kernel, config)
+    new_keys = [k for k in _CACHE if k[0] is _f1_score_update_jit]
+    assert len(new_keys) == 1
+    fused_fn = _CACHE[new_keys[0]]
+    # 5 updates, one trace: the fused program is reused, not rebuilt
+    # (_cache_size is jax-private; skip the stronger assert if it goes away)
+    if hasattr(fused_fn, "_cache_size"):
+        assert fused_fn._cache_size() == 1

@@ -10,7 +10,12 @@ from typing import TypeVar, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.functional.aggregation.mean import _mean_update
+from torcheval_tpu.metrics._fuse import fused_accumulate
+from torcheval_tpu.metrics.functional.aggregation.mean import (
+    _scalar_weight_pair,
+    _weighted_sum_pair,
+)
+from torcheval_tpu.utils.convert import resolve_weight
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
 TMean = TypeVar("TMean", bound="Mean")
@@ -32,9 +37,14 @@ class Mean(Metric[jax.Array]):
         self._add_state("weights", jnp.zeros(()), merge=MergeKind.SUM)
 
     def update(self: TMean, input, *, weight: Union[float, int, jax.Array] = 1.0) -> TMean:
-        weighted_sum, weights = _mean_update(self._input(input), weight)
-        self.weighted_sum = self.weighted_sum + weighted_sum
-        self.weights = self.weights + weights
+        input = self._input_float(input)
+        is_scalar, weight_arr = resolve_weight(weight, input)
+        # one fused dispatch: weighted-sum kernel + the two counter adds
+        self.weighted_sum, self.weights = fused_accumulate(
+            _scalar_weight_pair if is_scalar else _weighted_sum_pair,
+            (self.weighted_sum, self.weights),
+            (input, weight_arr),
+        )
         return self
 
     def compute(self) -> jax.Array:

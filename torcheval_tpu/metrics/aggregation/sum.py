@@ -10,7 +10,9 @@ from typing import TypeVar, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.functional.aggregation.sum import _sum_update
+from torcheval_tpu.metrics._fuse import fused_accumulate
+from torcheval_tpu.metrics.functional.aggregation.sum import _weighted_total
+from torcheval_tpu.utils.convert import resolve_weight
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
 TSum = TypeVar("TSum", bound="Sum")
@@ -31,7 +33,12 @@ class Sum(Metric[jax.Array]):
         self._add_state("weighted_sum", jnp.zeros(()), merge=MergeKind.SUM)
 
     def update(self: TSum, input, *, weight: Union[float, int, jax.Array] = 1.0) -> TSum:
-        self.weighted_sum = self.weighted_sum + _sum_update(self._input(input), weight)
+        input = self._input_float(input)
+        _, weight_arr = resolve_weight(weight, input, int_clause=True)
+        # one fused dispatch: weighted-total kernel + the counter add
+        (self.weighted_sum,) = fused_accumulate(
+            _weighted_total, (self.weighted_sum,), (input, weight_arr)
+        )
         return self
 
     def compute(self) -> jax.Array:

@@ -13,6 +13,7 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.accuracy import (
     _accuracy_compute,
     _accuracy_param_check,
@@ -77,11 +78,13 @@ class MulticlassAccuracy(Metric[jax.Array]):
     def update(self: TAccuracy, input, target) -> TAccuracy:
         input, target = self._input(input), self._input(target)
         _accuracy_update_input_check(input, target, self.num_classes, self.k)
-        num_correct, num_total = _multiclass_accuracy_update(
-            input, target, self.average, self.num_classes, self.k
+        # one fused dispatch: kernel + counter accumulation in one program
+        self.num_correct, self.num_total = fused_accumulate(
+            _multiclass_accuracy_update,
+            (self.num_correct, self.num_total),
+            (input, target),
+            (self.average, self.num_classes, self.k),
         )
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
         return self
 
     def compute(self) -> jax.Array:
@@ -107,11 +110,12 @@ class BinaryAccuracy(MulticlassAccuracy):
     def update(self, input, target) -> "BinaryAccuracy":
         input, target = self._input(input), self._input(target)
         _binary_accuracy_update_input_check(input, target)
-        num_correct, num_total = _binary_accuracy_update(
-            input, target, float(self.threshold)
+        self.num_correct, self.num_total = fused_accumulate(
+            _binary_accuracy_update,
+            (self.num_correct, self.num_total),
+            (input, target),
+            (float(self.threshold),),
         )
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
         return self
 
 
@@ -143,11 +147,12 @@ class MultilabelAccuracy(MulticlassAccuracy):
     def update(self, input, target) -> "MultilabelAccuracy":
         input, target = self._input(input), self._input(target)
         _multilabel_accuracy_update_input_check(input, target)
-        num_correct, num_total = _multilabel_accuracy_update(
-            input, target, float(self.threshold), self.criteria
+        self.num_correct, self.num_total = fused_accumulate(
+            _multilabel_accuracy_update,
+            (self.num_correct, self.num_total),
+            (input, target),
+            (float(self.threshold), self.criteria),
         )
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
         return self
 
 
@@ -169,9 +174,10 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
     def update(self, input, target) -> "TopKMultilabelAccuracy":
         input, target = self._input(input), self._input(target)
         _topk_multilabel_accuracy_update_input_check(input, target, self.k)
-        num_correct, num_total = _topk_multilabel_accuracy_update(
-            input, target, self.criteria, self.k
+        self.num_correct, self.num_total = fused_accumulate(
+            _topk_multilabel_accuracy_update,
+            (self.num_correct, self.num_total),
+            (input, target),
+            (self.criteria, self.k),
         )
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
         return self

@@ -12,9 +12,11 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     _baseline_update,
-    _binary_normalized_entropy_update,
+    _ne_deltas,
+    _ne_input_check,
 )
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
@@ -64,14 +66,16 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
     ) -> TNormalizedEntropy:
         input, target = self._input(input), self._input(target)
         weight = self._input(weight) if weight is not None else None
-        cross_entropy, num_positive, num_examples = (
-            _binary_normalized_entropy_update(
-                input, target, self.from_logits, self.num_tasks, weight
+        _ne_input_check(input, target, self.from_logits, self.num_tasks, weight)
+        # one fused dispatch: CE kernel + the three counter adds
+        self.total_entropy, self.num_positive, self.num_examples = (
+            fused_accumulate(
+                _ne_deltas,
+                (self.total_entropy, self.num_positive, self.num_examples),
+                (input, target, weight),
+                (self.from_logits,),
             )
         )
-        self.total_entropy = self.total_entropy + jnp.atleast_1d(cross_entropy)
-        self.num_positive = self.num_positive + jnp.atleast_1d(num_positive)
-        self.num_examples = self.num_examples + jnp.atleast_1d(num_examples)
         return self
 
     def compute(self) -> jax.Array:

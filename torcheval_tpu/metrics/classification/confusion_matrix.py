@@ -11,11 +11,14 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
-    _binary_confusion_matrix_update,
+    _binary_confusion_matrix_update_input_check,
+    _binary_confusion_matrix_update_jit,
     _confusion_matrix_compute,
     _confusion_matrix_param_check,
-    _confusion_matrix_update,
+    _confusion_matrix_update_input_check,
+    _confusion_matrix_update_jit,
 )
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
@@ -56,8 +59,13 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
         self: TMulticlassConfusionMatrix, input, target
     ) -> TMulticlassConfusionMatrix:
         input, target = self._input(input), self._input(target)
-        self.confusion_matrix = self.confusion_matrix + _confusion_matrix_update(
-            input, target, self.num_classes
+        _confusion_matrix_update_input_check(input, target, self.num_classes)
+        # one fused dispatch: scatter kernel + matrix add
+        (self.confusion_matrix,) = fused_accumulate(
+            _confusion_matrix_update_jit,
+            (self.confusion_matrix,),
+            (input, target),
+            (self.num_classes,),
         )
         return self
 
@@ -87,7 +95,11 @@ class BinaryConfusionMatrix(MulticlassConfusionMatrix):
 
     def update(self, input, target) -> "BinaryConfusionMatrix":
         input, target = self._input(input), self._input(target)
-        self.confusion_matrix = self.confusion_matrix + _binary_confusion_matrix_update(
-            input, target, self.threshold
+        _binary_confusion_matrix_update_input_check(input, target)
+        (self.confusion_matrix,) = fused_accumulate(
+            _binary_confusion_matrix_update_jit,
+            (self.confusion_matrix,),
+            (input, target),
+            (float(self.threshold),),
         )
         return self

@@ -11,11 +11,14 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.f1_score import (
-    _binary_f1_score_update,
+    _binary_f1_score_update_input_check,
+    _binary_f1_score_update_jit,
     _f1_score_compute,
     _f1_score_param_check,
-    _f1_score_update,
+    _f1_score_update_input_check,
+    _f1_score_update_jit,
 )
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
@@ -52,12 +55,14 @@ class MulticlassF1Score(Metric[jax.Array]):
 
     def update(self: TF1Score, input, target) -> TF1Score:
         input, target = self._input(input), self._input(target)
-        num_tp, num_label, num_prediction = _f1_score_update(
-            input, target, self.num_classes, self.average
+        _f1_score_update_input_check(input, target, self.num_classes)
+        # one fused dispatch: kernel + the three counter adds
+        self.num_tp, self.num_label, self.num_prediction = fused_accumulate(
+            _f1_score_update_jit,
+            (self.num_tp, self.num_label, self.num_prediction),
+            (input, target),
+            (self.num_classes, self.average),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_label = self.num_label + num_label
-        self.num_prediction = self.num_prediction + num_prediction
         return self
 
     def compute(self) -> jax.Array:
@@ -75,10 +80,11 @@ class BinaryF1Score(MulticlassF1Score):
 
     def update(self, input, target) -> "BinaryF1Score":
         input, target = self._input(input), self._input(target)
-        num_tp, num_label, num_prediction = _binary_f1_score_update(
-            input, target, self.threshold
+        _binary_f1_score_update_input_check(input, target)
+        self.num_tp, self.num_label, self.num_prediction = fused_accumulate(
+            _binary_f1_score_update_jit,
+            (self.num_tp, self.num_label, self.num_prediction),
+            (input, target),
+            (float(self.threshold),),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_label = self.num_label + num_label
-        self.num_prediction = self.num_prediction + num_prediction
         return self

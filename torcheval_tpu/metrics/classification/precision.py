@@ -11,11 +11,14 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.precision import (
-    _binary_precision_update,
+    _binary_precision_update_input_check,
+    _binary_precision_update_jit,
     _precision_compute,
     _precision_param_check,
-    _precision_update,
+    _precision_update_input_check,
+    _precision_update_jit,
 )
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
@@ -56,12 +59,14 @@ class MulticlassPrecision(Metric[jax.Array]):
 
     def update(self: TPrecision, input, target) -> TPrecision:
         input, target = self._input(input), self._input(target)
-        num_tp, num_fp, num_label = _precision_update(
-            input, target, self.num_classes, self.average
+        _precision_update_input_check(input, target, self.num_classes)
+        # one fused dispatch: kernel + the three counter adds
+        self.num_tp, self.num_fp, self.num_label = fused_accumulate(
+            _precision_update_jit,
+            (self.num_tp, self.num_fp, self.num_label),
+            (input, target),
+            (self.num_classes, self.average),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_fp = self.num_fp + num_fp
-        self.num_label = self.num_label + num_label
         return self
 
     def compute(self) -> jax.Array:
@@ -79,10 +84,11 @@ class BinaryPrecision(MulticlassPrecision):
 
     def update(self, input, target) -> "BinaryPrecision":
         input, target = self._input(input), self._input(target)
-        num_tp, num_fp, num_label = _binary_precision_update(
-            input, target, self.threshold
+        _binary_precision_update_input_check(input, target)
+        self.num_tp, self.num_fp, self.num_label = fused_accumulate(
+            _binary_precision_update_jit,
+            (self.num_tp, self.num_fp, self.num_label),
+            (input, target),
+            (float(self.threshold),),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_fp = self.num_fp + num_fp
-        self.num_label = self.num_label + num_label
         return self

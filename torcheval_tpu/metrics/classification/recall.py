@@ -11,11 +11,14 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.recall import (
-    _binary_recall_update,
+    _binary_recall_update_input_check,
+    _binary_recall_update_jit,
     _recall_compute,
     _recall_param_check,
-    _recall_update,
+    _recall_update_input_check,
+    _recall_update_jit,
 )
 from torcheval_tpu.metrics.functional.tensor_utils import nan_safe_divide
 from torcheval_tpu.metrics.metric import MergeKind, Metric
@@ -53,12 +56,14 @@ class MulticlassRecall(Metric[jax.Array]):
 
     def update(self: TRecall, input, target) -> TRecall:
         input, target = self._input(input), self._input(target)
-        num_tp, num_labels, num_predictions = _recall_update(
-            input, target, self.num_classes, self.average
+        _recall_update_input_check(input, target, self.num_classes)
+        # one fused dispatch: kernel + the three counter adds
+        self.num_tp, self.num_labels, self.num_predictions = fused_accumulate(
+            _recall_update_jit,
+            (self.num_tp, self.num_labels, self.num_predictions),
+            (input, target),
+            (self.num_classes, self.average),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_labels = self.num_labels + num_labels
-        self.num_predictions = self.num_predictions + num_predictions
         return self
 
     def compute(self) -> jax.Array:
@@ -87,11 +92,13 @@ class BinaryRecall(Metric[jax.Array]):
 
     def update(self, input, target) -> "BinaryRecall":
         input, target = self._input(input), self._input(target)
-        num_tp, num_true_labels = _binary_recall_update(
-            input, target, self.threshold
+        _binary_recall_update_input_check(input, target)
+        self.num_tp, self.num_true_labels = fused_accumulate(
+            _binary_recall_update_jit,
+            (self.num_tp, self.num_true_labels),
+            (input, target),
+            (float(self.threshold),),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_true_labels = self.num_true_labels + num_true_labels
         return self
 
     def compute(self) -> jax.Array:

@@ -11,7 +11,7 @@ from typing import Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.utils.convert import is_torch_tensor, to_jax_float
+from torcheval_tpu.utils.convert import resolve_weight, to_jax_float
 
 
 @jax.jit
@@ -26,15 +26,10 @@ def _scalar_weight_pair(input: jax.Array, weight: jax.Array) -> Tuple[jax.Array,
 
 def _mean_update(input, weight: Union[float, int, jax.Array]) -> Tuple[jax.Array, jax.Array]:
     input = to_jax_float(input)
-    if isinstance(weight, (float, int)) and not is_torch_tensor(weight):
-        return _scalar_weight_pair(input, jnp.float32(weight))
-    weight_arr = to_jax_float(weight)
-    if weight_arr.shape == input.shape:
-        return _weighted_sum_pair(input, weight_arr)
-    raise ValueError(
-        "Weight must be either a float value or a tensor that matches the "
-        f"input tensor size. Got {weight} instead."
-    )
+    is_scalar, weight_arr = resolve_weight(weight, input)
+    if is_scalar:
+        return _scalar_weight_pair(input, weight_arr)
+    return _weighted_sum_pair(input, weight_arr)
 
 
 def mean(input, weight: Union[float, int, jax.Array] = 1.0) -> jax.Array:

@@ -12,7 +12,7 @@ from typing import Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.utils.convert import is_torch_tensor, to_jax_float
+from torcheval_tpu.utils.convert import resolve_weight, to_jax_float
 
 
 @jax.jit
@@ -22,15 +22,8 @@ def _weighted_total(input: jax.Array, weight: jax.Array) -> jax.Array:
 
 def _sum_update(input, weight: Union[float, int, jax.Array]) -> jax.Array:
     input = to_jax_float(input)
-    if isinstance(weight, (float, int)) and not is_torch_tensor(weight):
-        return _weighted_total(input, jnp.float32(weight))
-    weight_arr = to_jax_float(weight)
-    if weight_arr.shape == input.shape:
-        return _weighted_total(input, weight_arr)
-    raise ValueError(
-        "Weight must be either a float value or an int value or a tensor "
-        f"that matches the input tensor size. Got {weight} instead."
-    )
+    _, weight_arr = resolve_weight(weight, input, int_clause=True)
+    return _weighted_total(input, weight_arr)
 
 
 def sum(input, weight: Union[float, int, jax.Array] = 1.0) -> jax.Array:

@@ -93,6 +93,17 @@ def _ne_input_check(
             )
 
 
+def _ne_deltas(
+    input: jax.Array,
+    target: jax.Array,
+    weight: Optional[jax.Array],
+    from_logits: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-task (1d) state deltas; pure — safe inside a fused jit."""
+    ce, npos, nex = _ne_update_jit(input, target, weight, from_logits)
+    return jnp.atleast_1d(ce), jnp.atleast_1d(npos), jnp.atleast_1d(nex)
+
+
 def _binary_normalized_entropy_update(
     input: jax.Array,
     target: jax.Array,

@@ -141,12 +141,20 @@ def _binary_confusion_matrix_update_input_check(
         )
 
 
+@partial(jax.jit, static_argnames=("threshold",))
+def _binary_confusion_matrix_update_jit(
+    input: jax.Array, target: jax.Array, threshold: float
+) -> jax.Array:
+    return _confusion_matrix_update_jit(
+        jnp.where(input < threshold, 0, 1), target, 2
+    )
+
+
 def _binary_confusion_matrix_update(
     input: jax.Array, target: jax.Array, threshold: float = 0.5
 ) -> jax.Array:
     _binary_confusion_matrix_update_input_check(input, target)
-    input = jnp.where(input < threshold, 0, 1)
-    return _confusion_matrix_update_jit(input, target, 2)
+    return _binary_confusion_matrix_update_jit(input, target, threshold)
 
 
 def binary_confusion_matrix(

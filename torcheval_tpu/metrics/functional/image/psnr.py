@@ -26,6 +26,29 @@ def _psnr_update_jit(
     return sum_squared_error, num_observations
 
 
+@jax.jit
+def _psnr_accumulate(
+    sum_squared_error: jax.Array,
+    num_observations: jax.Array,
+    min_target: jax.Array,
+    max_target: jax.Array,
+    input: jax.Array,
+    target: jax.Array,
+):
+    """All auto-range PSNR states (and the derived data_range) advanced in
+    ONE compiled program."""
+    d_sse, d_n = _psnr_update_jit(input, target)
+    new_min = jnp.minimum(min_target, jnp.min(target))
+    new_max = jnp.maximum(max_target, jnp.max(target))
+    return (
+        sum_squared_error + d_sse,
+        num_observations + d_n,
+        new_min,
+        new_max,
+        new_max - new_min,
+    )
+
+
 def _psnr_update(input, target) -> Tuple[jax.Array, jax.Array]:
     input = to_jax_float(input)
     target = to_jax_float(target)

@@ -12,7 +12,7 @@ from typing import Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.utils.convert import to_jax, to_jax_float
+from torcheval_tpu.utils.convert import resolve_weight, to_jax, to_jax_float
 
 
 @jax.jit
@@ -38,15 +38,10 @@ def _weighted_calibration_update(
 ) -> Tuple[jax.Array, jax.Array]:
     input, target = to_jax_float(input), to_jax_float(target)
     _weighted_calibration_input_check(input, target, weight, num_tasks)
-    if isinstance(weight, (float, int)):
-        return _wc_update_scalar(input, target, jnp.float32(weight))
-    weight = to_jax_float(weight)
-    if weight.shape == input.shape:
-        return _wc_update_tensor(input, target, weight)
-    raise ValueError(
-        "Weight must be either a float value or a tensor that matches the "
-        f"input tensor size. Got {weight} instead."
-    )
+    is_scalar, weight_arr = resolve_weight(weight, input)
+    if is_scalar:
+        return _wc_update_scalar(input, target, weight_arr)
+    return _wc_update_tensor(input, target, weight_arr)
 
 
 def _weighted_calibration_input_check(

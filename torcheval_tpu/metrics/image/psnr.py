@@ -13,10 +13,13 @@ from typing import Iterable, Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.image.psnr import (
+    _psnr_accumulate,
     _psnr_compute,
+    _psnr_input_check,
     _psnr_param_check,
-    _psnr_update,
+    _psnr_update_jit,
 )
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
@@ -78,13 +81,29 @@ class PeakSignalNoiseRatio(Metric[jax.Array]):
         """Accumulate one batch of image pairs, shape (N, C, H, W)."""
         input = self._input_float(input)
         target = self._input_float(target)
-        sum_squared_error, num_observations = _psnr_update(input, target)
-        self.sum_squared_error = self.sum_squared_error + sum_squared_error
-        self.num_observations = self.num_observations + num_observations
+        _psnr_input_check(input, target)
         if self.auto_range:
-            self.min_target = jnp.minimum(jnp.min(target), self.min_target)
-            self.max_target = jnp.maximum(jnp.max(target), self.max_target)
-            self.data_range = self.max_target - self.min_target
+            # all five states (incl. derived data_range) in one fused dispatch
+            (
+                self.sum_squared_error,
+                self.num_observations,
+                self.min_target,
+                self.max_target,
+                self.data_range,
+            ) = _psnr_accumulate(
+                self.sum_squared_error,
+                self.num_observations,
+                self.min_target,
+                self.max_target,
+                input,
+                target,
+            )
+        else:
+            self.sum_squared_error, self.num_observations = fused_accumulate(
+                _psnr_update_jit,
+                (self.sum_squared_error, self.num_observations),
+                (input, target),
+            )
         return self
 
     def merge_state(

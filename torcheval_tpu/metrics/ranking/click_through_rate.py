@@ -13,9 +13,12 @@ from typing import Optional, TypeVar, Union
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.ranking.click_through_rate import (
     _click_through_rate_compute,
-    _click_through_rate_update,
+    _click_through_rate_input_check,
+    _ctr_update_scalar,
+    _ctr_update_weighted,
 )
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
@@ -57,13 +60,23 @@ class ClickThroughRate(Metric[jax.Array]):
         weights: Union[jax.Array, float, int] = 1.0,
     ) -> TClickThroughRate:
         """Accumulate click events (and optional per-event weights)."""
-        if not isinstance(weights, (float, int)):
-            weights = self._input_float(weights)
-        click_total, weight_total = _click_through_rate_update(
-            self._input(input), weights, num_tasks=self.num_tasks
+        input = self._input(input)
+        is_scalar = isinstance(weights, (float, int))
+        weights_arr = None if is_scalar else self._input_float(weights)
+        _click_through_rate_input_check(
+            input, weights_arr, is_scalar, num_tasks=self.num_tasks
         )
-        self.click_total = self.click_total + click_total
-        self.weight_total = self.weight_total + weight_total
+        states = (self.click_total, self.weight_total)
+        # one fused dispatch: CTR kernel + the two counter adds
+        if is_scalar:
+            states = fused_accumulate(
+                _ctr_update_scalar, states, (input, jnp.float32(weights))
+            )
+        else:
+            states = fused_accumulate(
+                _ctr_update_weighted, states, (input, weights_arr)
+            )
+        self.click_total, self.weight_total = states
         return self
 
     def compute(self) -> jax.Array:

@@ -12,10 +12,14 @@ from typing import Optional, TypeVar, Union
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.ranking.weighted_calibration import (
-    _weighted_calibration_update,
+    _wc_update_scalar,
+    _wc_update_tensor,
+    _weighted_calibration_input_check,
 )
 from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.utils.convert import resolve_weight
 
 TWeightedCalibration = TypeVar("TWeightedCalibration", bound="WeightedCalibration")
 
@@ -57,13 +61,18 @@ class WeightedCalibration(Metric[jax.Array]):
         weight: Union[float, int, jax.Array] = 1.0,
     ) -> TWeightedCalibration:
         """Accumulate one batch of predictions / binary targets / weights."""
+        input = self._input_float(input)
+        target = self._input_float(target)
         if not isinstance(weight, (float, int)):
             weight = self._input_float(weight)
-        weighted_input_sum, weighted_target_sum = _weighted_calibration_update(
-            self._input(input), self._input(target), weight, num_tasks=self.num_tasks
+        _weighted_calibration_input_check(input, target, weight, self.num_tasks)
+        is_scalar, weight_arr = resolve_weight(weight, input)
+        # one fused dispatch: kernel + the two counter adds
+        self.weighted_input_sum, self.weighted_target_sum = fused_accumulate(
+            _wc_update_scalar if is_scalar else _wc_update_tensor,
+            (self.weighted_input_sum, self.weighted_target_sum),
+            (input, target, weight_arr),
         )
-        self.weighted_input_sum = self.weighted_input_sum + weighted_input_sum
-        self.weighted_target_sum = self.weighted_target_sum + weighted_target_sum
         return self
 
     def compute(self) -> jax.Array:

@@ -13,11 +13,15 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.regression.mean_squared_error import (
     _mean_squared_error_compute,
     _mean_squared_error_param_check,
-    _mean_squared_error_update,
+    _mean_squared_error_update_input_check,
+    _update_unweighted,
+    _update_weighted,
 )
+from torcheval_tpu.utils.convert import to_jax_float
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
 TMeanSquaredError = TypeVar("TMeanSquaredError", bound="MeanSquaredError")
@@ -67,11 +71,19 @@ class MeanSquaredError(Metric[jax.Array]):
             target: ground truth, same shape.
             sample_weight: optional (n_sample,) weights.
         """
-        sum_squared_error, sum_weight = _mean_squared_error_update(
-            self._input_float(input), self._input_float(target), sample_weight
-        )
-        self.sum_squared_error = self.sum_squared_error + sum_squared_error
-        self.sum_weight = self.sum_weight + sum_weight
+        input = self._input_float(input)
+        target = self._input_float(target)
+        _mean_squared_error_update_input_check(input, target, sample_weight)
+        states = (self.sum_squared_error, self.sum_weight)
+        # one fused dispatch: squared-error kernel + the two counter adds
+        if sample_weight is None:
+            states = fused_accumulate(_update_unweighted, states, (input, target))
+        else:
+            states = fused_accumulate(
+                _update_weighted, states,
+                (input, target, to_jax_float(sample_weight)),
+            )
+        self.sum_squared_error, self.sum_weight = states
         return self
 
     def compute(self) -> jax.Array:

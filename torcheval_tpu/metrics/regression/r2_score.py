@@ -13,10 +13,12 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.regression.r2_score import (
     _r2_score_compute,
     _r2_score_param_check,
-    _r2_score_update,
+    _r2_score_update_input_check,
+    _update as _r2_update_kernel,
 )
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
@@ -62,13 +64,25 @@ class R2Score(Metric[jax.Array]):
 
     def update(self: TR2Score, input, target) -> TR2Score:
         """Accumulate one batch of predictions and ground truth."""
-        sum_squared_obs, sum_obs, sum_squared_residual, num_obs = _r2_score_update(
-            self._input_float(input), self._input_float(target)
+        input = self._input_float(input)
+        target = self._input_float(target)
+        _r2_score_update_input_check(input, target)
+        # one fused dispatch: sums kernel + the four counter adds
+        (
+            self.sum_squared_obs,
+            self.sum_obs,
+            self.sum_squared_residual,
+            self.num_obs,
+        ) = fused_accumulate(
+            _r2_update_kernel,
+            (
+                self.sum_squared_obs,
+                self.sum_obs,
+                self.sum_squared_residual,
+                self.num_obs,
+            ),
+            (input, target),
         )
-        self.sum_squared_obs = self.sum_squared_obs + sum_squared_obs
-        self.sum_obs = self.sum_obs + sum_obs
-        self.sum_squared_residual = self.sum_squared_residual + sum_squared_residual
-        self.num_obs = self.num_obs + num_obs
         return self
 
     def compute(self) -> jax.Array:

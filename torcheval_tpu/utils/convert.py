@@ -75,6 +75,30 @@ def to_jax_float(
     return arr
 
 
+def resolve_weight(
+    weight: Any, input: jax.Array, *, int_clause: bool = False
+) -> tuple:
+    """Split a ``weight`` kwarg into the scalar / matching-tensor case.
+
+    Returns ``(is_scalar, weight_arr)`` where ``weight_arr`` is a float32
+    scalar when ``is_scalar`` else a float array with ``input``'s shape.
+    This is the single home of the weight validation shared by the
+    functional `_xxx_update` wrappers and the fused class ``update()``
+    paths (Mean/Sum/WeightedCalibration), so accepted inputs and the error
+    message cannot drift between the two layers.
+    """
+    if isinstance(weight, (float, int)) and not is_torch_tensor(weight):
+        return True, jnp.float32(weight)
+    weight_arr = to_jax_float(weight)
+    if weight_arr.shape == input.shape:
+        return False, weight_arr
+    raise ValueError(
+        "Weight must be either a float value or "
+        + ("an int value or " if int_clause else "")
+        + f"a tensor that matches the input tensor size. Got {weight} instead."
+    )
+
+
 def canonicalize_device(
     device: Union[jax.Device, str, None],
 ) -> jax.Device:
