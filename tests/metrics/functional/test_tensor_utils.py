@@ -1,0 +1,63 @@
+"""argmax_last contract: exact jnp.argmax/np.argmax semantics (first index
+on ties, NaN wins, -0.0 == +0.0, +/-inf) for every dtype branch."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.functional.tensor_utils import argmax_last
+
+
+def test_argmax_last_matches_numpy_torture():
+    rng = np.random.default_rng(7)
+    for trial in range(300):
+        C = int(rng.integers(1, 17))
+        a = rng.integers(-3, 4, size=(5, C)).astype(np.float32)
+        if trial % 3 == 0:
+            a[rng.uniform(size=a.shape) < 0.2] = np.inf
+        if trial % 4 == 0:
+            a[rng.uniform(size=a.shape) < 0.2] = -np.inf
+        if trial % 5 == 0:
+            a[rng.uniform(size=a.shape) < 0.2] = -0.0
+        if trial % 7 == 0:
+            a[rng.uniform(size=a.shape) < 0.2] = np.nan
+        if trial % 11 == 0:  # negative NaN (e.g. inf + -inf) must also win
+            a[rng.uniform(size=a.shape) < 0.2] = np.float32(
+                np.copysign(np.nan, -1.0)
+            )
+        got = np.asarray(jax.jit(argmax_last)(jnp.asarray(a)))
+        np.testing.assert_array_equal(got, np.argmax(a, -1), err_msg=str(a))
+
+
+def test_argmax_last_dtype_branches():
+    rng = np.random.default_rng(0)
+    a = rng.uniform(size=(64, 33)).astype(np.float32)
+    # bfloat16 path (ties appear from rounding; compare against bf16 argmax)
+    ab = jnp.asarray(a).astype(jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(argmax_last(ab)), np.asarray(jnp.argmax(ab, -1))
+    )
+    # integer path
+    ai = rng.integers(-100, 100, size=(64, 33)).astype(np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(argmax_last(jnp.asarray(ai))), np.argmax(ai, -1)
+    )
+    # fallback path: uint32 values above int32 range must not be reordered
+    au = np.array([[3_000_000_000, 1], [1, 2]], dtype=np.uint32)
+    np.testing.assert_array_equal(
+        np.asarray(argmax_last(jnp.asarray(au))), np.argmax(au, -1)
+    )
+
+
+def test_argmax_last_batched_and_1class():
+    rng = np.random.default_rng(1)
+    a = rng.uniform(size=(3, 4, 9)).astype(np.float32)  # leading batch dims
+    np.testing.assert_array_equal(
+        np.asarray(argmax_last(jnp.asarray(a))), np.argmax(a, -1)
+    )
+    one = rng.uniform(size=(6, 1)).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(argmax_last(jnp.asarray(one))), np.zeros(6, np.int32)
+    )

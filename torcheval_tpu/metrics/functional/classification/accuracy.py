@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
+from torcheval_tpu.metrics.functional.tensor_utils import argmax_last
 from torcheval_tpu.utils.convert import to_jax
 
 
@@ -52,7 +53,7 @@ def _multiclass_accuracy_update(
     k: int,
 ) -> Tuple[jax.Array, jax.Array]:
     if k == 1:
-        pred = jnp.argmax(input, axis=1) if input.ndim == 2 else input
+        pred = argmax_last(input) if input.ndim == 2 else input
         mask = (pred == target).astype(jnp.float32)
     else:
         target_score = jnp.take_along_axis(input, target[:, None], axis=-1)

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
+from torcheval_tpu.metrics.functional.tensor_utils import argmax_last
 from torcheval_tpu.utils.convert import to_jax
 
 
@@ -24,7 +25,7 @@ def _confusion_matrix_update_jit(
     input: jax.Array, target: jax.Array, num_classes: int
 ) -> jax.Array:
     if input.ndim == 2:
-        input = jnp.argmax(input, axis=1)
+        input = argmax_last(input)
     flat = target.astype(jnp.int32) * num_classes + input.astype(jnp.int32)
     counts = jax.ops.segment_sum(
         jnp.ones_like(flat, dtype=jnp.int32), flat,

@@ -17,7 +17,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.functional.tensor_utils import nan_safe_divide
+from torcheval_tpu.metrics.functional.tensor_utils import argmax_last, nan_safe_divide
 from torcheval_tpu.utils.convert import to_jax
 
 _logger: logging.Logger = logging.getLogger(__name__)
@@ -31,7 +31,7 @@ def _f1_score_update_jit(
     average: Optional[str],
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     if input.ndim == 2:
-        input = jnp.argmax(input, axis=1)
+        input = argmax_last(input)
     if average == "micro":
         num_tp = jnp.sum(input == target).astype(jnp.float32)
         num_label = jnp.float32(target.shape[0])

@@ -22,6 +22,37 @@ def nan_safe_divide(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.where(b == 0, jnp.nan, a / jnp.where(b == 0, 1.0, b))
 
 
+def argmax_last(x: jax.Array) -> jax.Array:
+    """``jnp.argmax(x, axis=-1)`` with identical semantics (first index on
+    ties, NaN wins, -0.0 == +0.0), several times faster on XLA:CPU.
+
+    XLA:CPU lowers float variadic reduces (argmax/max over the minor axis)
+    to scalar loops, while integer reduces vectorize. So: bitcast to an
+    order-preserving int32 key, then integer max + first-matching-index via
+    integer min. On TPU both forms compile to fused VPU reductions. Used by
+    every score->label conversion in the classification hot loops.
+    """
+    C = x.shape[-1]
+    if x.dtype in (jnp.dtype(jnp.int32), jnp.dtype(jnp.int16),
+                   jnp.dtype(jnp.int8), jnp.dtype(jnp.bool_)):
+        key = x.astype(jnp.int32)
+    elif x.dtype in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
+                     jnp.dtype(jnp.float16)):
+        xf = x.astype(jnp.float32)
+        xi = jax.lax.bitcast_convert_type(xf, jnp.int32)
+        # sign-flip transform: negative floats (descending bit patterns) map
+        # below positives, order preserved
+        key = jnp.where(xi < 0, jnp.asarray(-0x80000000, jnp.int32) - 1 - xi, xi)
+        key = jnp.where(key == -1, jnp.int32(0), key)  # -0.0 ties with +0.0
+        # any NaN (either sign) ranks maximal, matching np/jnp argmax
+        key = jnp.where(xf != xf, jnp.asarray(0x7FFFFFFF, jnp.int32), key)
+    else:  # int64/uint/f64 etc.: an int32 key would reorder — use the stock op
+        return jnp.argmax(x, axis=-1)
+    mx = jnp.max(key, axis=-1, keepdims=True)
+    idx = jnp.arange(C, dtype=jnp.int32)
+    return jnp.min(jnp.where(key == mx, idx, jnp.int32(C)), axis=-1)
+
+
 def riemann_integral(x: jax.Array, y: jax.Array) -> jax.Array:
     """Left-Riemann integral of y(x): ``-sum((x[1:]-x[:-1]) * y[:-1])``
     (reference tensor_utils.py:12-16; the sign matches the reference's
