@@ -1,0 +1,116 @@
+"""bfloat16 input-path tests.
+
+On TPU, eval-loop activations (logits, scores) typically arrive as bfloat16.
+bf16 has an 8-bit mantissa: a bf16 *accumulator* silently plateaus after a
+few hundred unit increments (256 + 1 == 256 in bf16). These tests pin the
+framework guarantee that metric state accumulates at f32-or-wider precision
+regardless of input dtype, so long eval runs don't drift — a TPU-specific
+obligation with no reference analogue (torch metrics see f32 inputs; the
+reference never handles reduced-precision inputs specially).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    Mean,
+    MeanSquaredError,
+    MulticlassAccuracy,
+    Perplexity,
+    Sum,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def test_counter_states_are_not_bf16():
+    """Every registered accumulator must be wider than the bf16 input."""
+    x = jnp.asarray(RNG.normal(size=(32, 8)), dtype=jnp.bfloat16)
+    t = jnp.asarray(RNG.integers(0, 8, 32))
+    metrics = {
+        "acc": (MulticlassAccuracy(), (x, t)),
+        "mean": (Mean(), (x.reshape(-1),)),
+        "sum": (Sum(), (x.reshape(-1),)),
+        "mse": (MeanSquaredError(), (x.reshape(-1), x.reshape(-1))),
+        "ppl": (
+            Perplexity(),
+            (
+                jnp.asarray(RNG.normal(size=(2, 8, 16)), dtype=jnp.bfloat16),
+                jnp.asarray(RNG.integers(0, 16, (2, 8))),
+            ),
+        ),
+    }
+    for name, (metric, args) in metrics.items():
+        metric.update(*args)
+        for sname in metric._state_name_to_default:
+            val = getattr(metric, sname)
+            leaves = val if isinstance(val, list) else [val]
+            for leaf in leaves:
+                if hasattr(leaf, "dtype") and jnp.issubdtype(
+                    leaf.dtype, jnp.floating
+                ):
+                    assert jnp.finfo(leaf.dtype).bits >= 32, (
+                        f"{name}.{sname} accumulates at "
+                        f"{leaf.dtype} (< 32-bit)"
+                    )
+
+
+def test_sum_no_bf16_plateau():
+    """4096 unit increments: a bf16 accumulator would stall at 256."""
+    s = Sum()
+    one = jnp.ones((1,), dtype=jnp.bfloat16)
+    for _ in range(4096):
+        s.update(one)
+    assert float(s.compute()) == 4096.0
+
+
+def test_mean_long_run_precision():
+    """Mean of a constant over many updates stays at the bf16-rounded input
+    value (accumulation adds no drift beyond the input rounding itself)."""
+    m = Mean()
+    v = jnp.full((64,), 1.01, dtype=jnp.bfloat16)
+    exact = float(jnp.asarray(1.01, dtype=jnp.bfloat16))  # 1.0078125
+    for _ in range(512):
+        m.update(v)
+    assert float(m.compute()) == pytest.approx(exact, rel=1e-6)
+
+
+def test_accuracy_bf16_logits_match_f32():
+    """Argmax-based metrics are dtype-insensitive modulo input rounding:
+    feeding the f32 upcast of the same bf16 logits must give identical
+    counts."""
+    x16 = jnp.asarray(RNG.normal(size=(256, 10)), dtype=jnp.bfloat16)
+    t = jnp.asarray(RNG.integers(0, 10, 256))
+    m16, m32 = MulticlassAccuracy(), MulticlassAccuracy()
+    m16.update(x16, t)
+    m32.update(x16.astype(jnp.float32), t)
+    assert float(m16.compute()) == float(m32.compute())
+
+
+def test_auroc_bf16_scores_match_oracle_on_rounded_values():
+    """bf16 scores collapse into ~256 distinct values in [0,1) → heavy ties.
+    The tie-handling path must agree with sklearn run on the same rounded
+    values."""
+    skm = pytest.importorskip("sklearn.metrics")
+    scores = RNG.uniform(size=1024).astype(np.float32)
+    targets = RNG.integers(0, 2, 1024).astype(np.float32)
+    rounded = np.asarray(jnp.asarray(scores, dtype=jnp.bfloat16)).astype(
+        np.float32
+    )
+    m = BinaryAUROC()
+    m.update(jnp.asarray(scores, dtype=jnp.bfloat16), jnp.asarray(targets))
+    expected = skm.roc_auc_score(targets, rounded)
+    assert float(m.compute()) == pytest.approx(expected, abs=1e-6)
+
+
+def test_mixed_dtype_updates():
+    """bf16 and f32 updates interleave without error or precision loss in
+    the accumulator."""
+    s = Sum()
+    s.update(jnp.asarray([1.0, 2.0], dtype=jnp.bfloat16))
+    s.update(jnp.asarray([3.0, 4.0], dtype=jnp.float32))
+    assert float(s.compute()) == 10.0
