@@ -24,13 +24,11 @@ from torcheval_tpu.metrics import (
     Sum,
 )
 
-RNG = np.random.default_rng(7)
-
-
 def test_counter_states_are_not_bf16():
     """Every registered accumulator must be wider than the bf16 input."""
-    x = jnp.asarray(RNG.normal(size=(32, 8)), dtype=jnp.bfloat16)
-    t = jnp.asarray(RNG.integers(0, 8, 32))
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(32, 8)), dtype=jnp.bfloat16)
+    t = jnp.asarray(rng.integers(0, 8, 32))
     metrics = {
         "acc": (MulticlassAccuracy(), (x, t)),
         "mean": (Mean(), (x.reshape(-1),)),
@@ -39,8 +37,8 @@ def test_counter_states_are_not_bf16():
         "ppl": (
             Perplexity(),
             (
-                jnp.asarray(RNG.normal(size=(2, 8, 16)), dtype=jnp.bfloat16),
-                jnp.asarray(RNG.integers(0, 16, (2, 8))),
+                jnp.asarray(rng.normal(size=(2, 8, 16)), dtype=jnp.bfloat16),
+                jnp.asarray(rng.integers(0, 16, (2, 8))),
             ),
         ),
     }
@@ -83,8 +81,9 @@ def test_accuracy_bf16_logits_match_f32():
     """Argmax-based metrics are dtype-insensitive modulo input rounding:
     feeding the f32 upcast of the same bf16 logits must give identical
     counts."""
-    x16 = jnp.asarray(RNG.normal(size=(256, 10)), dtype=jnp.bfloat16)
-    t = jnp.asarray(RNG.integers(0, 10, 256))
+    rng = np.random.default_rng(8)
+    x16 = jnp.asarray(rng.normal(size=(256, 10)), dtype=jnp.bfloat16)
+    t = jnp.asarray(rng.integers(0, 10, 256))
     m16, m32 = MulticlassAccuracy(), MulticlassAccuracy()
     m16.update(x16, t)
     m32.update(x16.astype(jnp.float32), t)
@@ -95,9 +94,10 @@ def test_auroc_bf16_scores_match_oracle_on_rounded_values():
     """bf16 scores collapse into ~256 distinct values in [0,1) → heavy ties.
     The tie-handling path must agree with sklearn run on the same rounded
     values."""
+    rng = np.random.default_rng(9)
     skm = pytest.importorskip("sklearn.metrics")
-    scores = RNG.uniform(size=1024).astype(np.float32)
-    targets = RNG.integers(0, 2, 1024).astype(np.float32)
+    scores = rng.uniform(size=1024).astype(np.float32)
+    targets = rng.integers(0, 2, 1024).astype(np.float32)
     rounded = np.asarray(jnp.asarray(scores, dtype=jnp.bfloat16)).astype(
         np.float32
     )
