@@ -1,0 +1,76 @@
+"""Macro averaging when a class is absent from both target and predictions.
+
+The reference's ``multiclass_recall`` crashes here: ``_recall_compute``
+masks ``num_tp`` to the seen classes but divides by the *unmasked*
+``num_labels`` (reference functional/classification/recall.py:190-194 —
+shape mismatch whenever any class has zero labels AND zero predictions).
+Its precision and F1 handle the same case fine, so this is a reference
+bug, not a semantic choice. We deliberately diverge: macro recall averages
+over the seen classes only, matching sklearn and the reference's own
+precision/F1 masking convention.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torcheval_tpu.metrics.functional as F
+from torcheval_tpu.metrics import MulticlassPrecision, MulticlassRecall
+
+# class 2 never appears in targets or argmax predictions
+X = jnp.asarray(
+    np.array(
+        [[0.9, 0.1, 0.0], [0.8, 0.2, 0.0], [0.1, 0.9, 0.0]], np.float32
+    )
+)
+T = jnp.asarray(np.array([0, 1, 1]))
+
+
+def test_macro_recall_ignores_absent_class():
+    skm = pytest.importorskip("sklearn.metrics")
+    expected = skm.recall_score([0, 1, 1], [0, 0, 1], average="macro", labels=[0, 1])
+    got = float(F.multiclass_recall(X, T, average="macro", num_classes=3))
+    assert got == pytest.approx(expected)  # 0.75; the reference raises here
+
+
+def test_macro_precision_f1_match_reference_convention():
+    # precision and F1 mask consistently in the reference; stay in lockstep
+    assert float(
+        F.multiclass_precision(X, T, average="macro", num_classes=3)
+    ) == pytest.approx(0.75)
+    assert float(
+        F.multiclass_f1_score(X, T, average="macro", num_classes=3)
+    ) == pytest.approx(2 / 3)
+
+
+def test_class_metrics_absent_class_macro():
+    r = MulticlassRecall(average="macro", num_classes=3)
+    p = MulticlassPrecision(average="macro", num_classes=3)
+    r.update(X, T)
+    p.update(X, T)
+    assert float(r.compute()) == pytest.approx(0.75)
+    assert float(p.compute()) == pytest.approx(0.75)
+
+
+def test_macro_recall_single_seen_class():
+    """With exactly ONE seen class the reference's masked size-1 ``num_tp``
+    broadcasts against the full ``num_labels`` and yields ``inf`` instead of
+    crashing (same masking bug, reference recall.py:190-194). Found by
+    differential fuzzing. We return the sklearn value."""
+    skm = pytest.importorskip("sklearn.metrics")
+    x = jnp.asarray(np.full((2, 2), 0.3, np.float32))  # argmax -> class 0
+    t = jnp.asarray(np.array([0, 0]))
+    expected = skm.recall_score([0, 0], [0, 0], average="macro")
+    got = float(F.multiclass_recall(x, t, average="macro", num_classes=2))
+    assert got == pytest.approx(expected)  # 1.0; the reference returns inf
+
+
+def test_weighted_recall_absent_class():
+    """weighted averaging weights by label counts, so the absent class
+    contributes zero weight — no crash, same as sklearn."""
+    skm = pytest.importorskip("sklearn.metrics")
+    expected = skm.recall_score([0, 1, 1], [0, 0, 1], average="weighted")
+    got = float(F.multiclass_recall(X, T, average="weighted", num_classes=3))
+    assert got == pytest.approx(expected)

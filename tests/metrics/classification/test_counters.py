@@ -229,6 +229,31 @@ class TestNormalizedEntropy(MetricClassTester):
         )
         assert_result_close(ours, np.asarray(ref), atol=1e-4)
 
+    @pytest.mark.parametrize(
+        "case",
+        [
+            # degenerate positive-rate tails: the reference's float64-eps
+            # clamp (reference binary_normalized_entropy.py:107-117) makes
+            # the baseline tiny and NE huge; our float32 kernel must land
+            # within float32 precision of the same huge value
+            ([0.2], [1.0]),
+            ([0.7, 0.3], [0.0, 0.0]),
+            # input exactly 0/1: torch BCE clamps each log term at -100
+            ([0.0, 0.5], [1.0, 0.0]),
+            ([1.0, 0.5], [0.0, 1.0]),
+        ],
+        ids=["all-pos", "all-neg", "input-zero", "input-one"],
+    )
+    def test_ne_degenerate_tails(self, case):
+        x, t = (np.asarray(v, np.float32) for v in case)
+        ours = float(
+            F.binary_normalized_entropy(jnp.asarray(x), jnp.asarray(t))
+        )
+        ref = float(
+            REF_F.binary_normalized_entropy(torch.tensor(x), torch.tensor(t))
+        )
+        assert ours == pytest.approx(ref, rel=1e-4)
+
     def test_prob_range_check_gated_by_debug_validation(self):
         from torcheval_tpu.config import debug_validation
 

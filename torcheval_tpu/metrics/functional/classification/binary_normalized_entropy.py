@@ -3,9 +3,11 @@
 Parity: reference torcheval/metrics/functional/classification/
 binary_normalized_entropy.py (:16-130; `_baseline_update` eps clamping
 :107-117). The reference accumulates in float64; TPUs prefer float32, so the
-kernel computes in float32 and the eps clamp uses the float32 epsilon —
-results agree to ~1e-5 at realistic scales (tests assert this against the
-reference oracle). Enable ``jax_enable_x64`` for bit-level float64 parity.
+kernel computes in float32 but reproduces the reference's float64-eps
+clamping semantics exactly (see ``_baseline_update``): results agree to
+~1e-5 at realistic scales and stay finite-and-matching on the degenerate
+all-positive / all-negative tails. Enable ``jax_enable_x64`` for bit-level
+float64 parity.
 """
 
 from __future__ import annotations
@@ -38,9 +40,12 @@ def _ne_update_jit(
             + jnp.log1p(jnp.exp(-jnp.abs(input)))
         )
     else:
-        eps = 1e-12
-        clamped = jnp.clip(input, eps, 1.0 - eps)
-        ce = -(target * jnp.log(clamped) + (1.0 - target) * jnp.log(1.0 - clamped))
+        # torch.nn.functional.binary_cross_entropy clamps each log term at
+        # -100 (so input exactly 0 or 1 yields CE 100, not inf); log1p keeps
+        # precision near input == 1
+        logx = jnp.maximum(jnp.log(input), -100.0)
+        log1mx = jnp.maximum(jnp.log1p(-input), -100.0)
+        ce = -(target * logx + (1.0 - target) * log1mx)
     w = jnp.ones_like(target) if weight is None else weight.astype(jnp.float32)
     cross_entropy = jnp.sum(w * ce, axis=-1)
     num_examples = jnp.sum(w, axis=-1)
@@ -50,9 +55,16 @@ def _ne_update_jit(
 
 @jax.jit
 def _baseline_update(num_positive: jax.Array, num_examples: jax.Array) -> jax.Array:
-    eps = jnp.finfo(jnp.float32).eps
-    rate = jnp.clip(num_positive / num_examples, eps, 1.0 - eps)
-    return -rate * jnp.log(rate) - (1.0 - rate) * jnp.log(1.0 - rate)
+    # The reference clamps the positive rate by the FLOAT64 eps (reference
+    # binary_normalized_entropy.py:107-117). 1 - eps64 is not representable
+    # in float32, but H(r) is symmetric in r <-> 1-r, so clamping the
+    # distance-to-boundary d = min(r, 1-r) and evaluating with log1p
+    # reproduces the float64-eps semantics for BOTH degenerate tails
+    # (r -> 0 and r -> 1) while staying in float32.
+    eps = 2.220446049250313e-16  # float64 eps
+    rate = num_positive / num_examples
+    d = jnp.clip(jnp.minimum(rate, 1.0 - rate), eps, 0.5)
+    return -d * jnp.log(d) - (1.0 - d) * jnp.log1p(-d)
 
 
 def _ne_input_check(

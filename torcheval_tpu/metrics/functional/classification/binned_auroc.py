@@ -86,6 +86,13 @@ def binary_binned_auroc(
 
     Class version: ``torcheval_tpu.metrics.BinaryBinnedAUROC``.
 
+    For ``num_tasks=1`` the auroc is a scalar, as the reference's docstring
+    promises (``tensor(0.5)``, reference binned_auroc.py:46-48); the
+    reference *implementation* actually returns shape ``(1,)`` there (an
+    internal-broadcast quirk of its compute, reference binned_auroc.py:116)
+    — we deliberately match its documented shape, and its own tests compare
+    via broadcast so both agree.
+
     Examples::
 
         >>> from torcheval_tpu.metrics.functional import binary_binned_auroc
