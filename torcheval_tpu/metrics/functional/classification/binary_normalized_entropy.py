@@ -42,7 +42,11 @@ def _ne_update_jit(
     else:
         # torch.nn.functional.binary_cross_entropy clamps each log term at
         # -100 (so input exactly 0 or 1 yields CE 100, not inf); log1p keeps
-        # precision near input == 1
+        # precision near input == 1. The [0, 1] clip keeps a float-ulp
+        # excursion (e.g. p = 1.0000001 from upstream normalization) from
+        # turning log of a negative into state-poisoning NaN — the range
+        # check that would reject it is debug-only.
+        input = jnp.clip(input, 0.0, 1.0)
         logx = jnp.maximum(jnp.log(input), -100.0)
         log1mx = jnp.maximum(jnp.log1p(-input), -100.0)
         ce = -(target * logx + (1.0 - target) * log1mx)
