@@ -109,6 +109,25 @@ def main() -> None:
     auroc.update(jnp.asarray(xb), jnp.asarray(tb))
     results["auroc"] = float(sync_and_compute(auroc, group))
 
+    # --- windowed metric (ring buffer + CUSTOM window-concat merge) ----------
+    # rank r performs 2r+3 updates against a window of 4: rank 0 stays
+    # partially filled, rank 1+ wraps (evicting oldest entries), so the
+    # merged windows genuinely differ from lifetime history; merge must
+    # concatenate per-rank windows (reference
+    # window/normalized_entropy.py:232-296 semantics)
+    from torcheval_tpu.metrics import WindowedMeanSquaredError
+
+    wmse = WindowedMeanSquaredError(max_num_updates=4, enable_lifetime=True)
+    for i in range(2 * rank + 3):
+        v = (rank + 1) * 0.1 * (i + 1)
+        wmse.update(
+            jnp.full((8,), v, dtype=jnp.float32),
+            jnp.zeros((8,), dtype=jnp.float32),
+        )
+    life, win = sync_and_compute(wmse, group)
+    results["wmse_lifetime"] = float(life)
+    results["wmse_windowed"] = float(win)
+
     print("RESULT " + json.dumps(results), flush=True)
 
 

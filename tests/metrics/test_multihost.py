@@ -98,3 +98,25 @@ def test_multihost_sync(nproc):
         ts.append((rngb.random(n_r) < 0.5).astype(np.float32))
     expected = skm.roc_auc_score(np.concatenate(ts), np.concatenate(xs))
     assert res["auroc"] == pytest.approx(expected, abs=1e-5)
+
+    # windowed MSE merge semantics == the reference's window-concat merge
+    # (reference window/mean_squared_error.py via merge_state), replayed on
+    # the reference metrics themselves
+    import torch
+    from tests.ref_oracle import load_reference_metrics
+
+    REF_M, _ = load_reference_metrics()
+    replicas = []
+    for r in range(nproc):
+        m = REF_M.WindowedMeanSquaredError(
+            max_num_updates=4, enable_lifetime=True
+        )
+        for i in range(2 * r + 3):
+            v = (r + 1) * 0.1 * (i + 1)
+            m.update(torch.full((8,), v), torch.zeros(8))
+        replicas.append(m)
+    merged = replicas[0]
+    merged.merge_state(replicas[1:])
+    exp_life, exp_win = merged.compute()
+    assert res["wmse_lifetime"] == pytest.approx(float(exp_life), rel=1e-5)
+    assert res["wmse_windowed"] == pytest.approx(float(exp_win), rel=1e-5)
