@@ -232,11 +232,27 @@ def run_sync_overhead():
     total_pct = max(
         0.0, (1.0 / sync_ips - 1.0 / nometric_ips) * nometric_ips * 100.0
     )
+
+    # structural north-star evidence (tests/metrics/test_sync_collective_
+    # structure.py): XLA's all-reduce combiner merges the metric-state psum
+    # into the step's own reduction, so full metric sync adds ZERO
+    # collectives — on real ICI the wall-clock %, which on this emulated
+    # mesh is thread-rendezvous noise, collapses to payload bytes.
+    from torcheval_tpu.utils.hlo import collective_count
+
+    coll_plain = collective_count(step_nometric.lower(x, w1, w2).compile())
+    coll_sync = collective_count(
+        step_sync.lower(x, y, w1, w2, state).compile()
+    )
+
     return {
         "metric": f"in-jit psum metric sync overhead ({n}-device dp mesh)",
         "value": round(sync_pct, 3),
         "unit": "% of step time",
         "lower_is_better": True,
+        "collectives_no_metric": coll_plain,
+        "collectives_with_metric_sync": coll_sync,
+        "collectives_added_by_sync": coll_sync - coll_plain,
         # the reference's own distributed_example syncs every 4 batches
         # (reference examples/distributed_example.py:123); at that cadence the
         # per-sync cost amortizes over 4 local-update steps

@@ -33,19 +33,7 @@ from torcheval_tpu.metrics.functional.classification.accuracy import (
     _multiclass_accuracy_update,
 )
 from torcheval_tpu.metrics.sharded import sync_states_in_jit
-
-# count both the synchronous opcode and its async -start form (TPU/GPU
-# lowerings emit start/done pairs; counting -done too would double-count)
-COLLECTIVE_OPS = ("all-reduce", "all-gather", "collective-permute",
-                  "all-to-all", "reduce-scatter")
-
-
-def _collective_count(compiled) -> int:
-    hlo = compiled.as_text()
-    return sum(
-        hlo.count(f"{op}(") + hlo.count(f"{op}-start(")
-        for op in COLLECTIVE_OPS
-    )
+from torcheval_tpu.utils.hlo import collective_count as _collective_count
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,28 @@
+"""Optimized-HLO inspection helpers.
+
+Used by the sync-structure regression test and ``bench.py`` to prove the
+north-star property (BASELINE.md): in-jit metric sync adds ZERO collectives
+to a step, because XLA's all-reduce combiner merges the metric-state psum
+into the step's existing reduction.
+"""
+
+from __future__ import annotations
+
+# Synchronous opcodes and their async -start forms (TPU/GPU lowerings emit
+# start/done pairs; counting -done too would double-count an op).
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "collective-permute",
+    "all-to-all",
+    "reduce-scatter",
+)
+
+
+def collective_count(compiled) -> int:
+    """Number of collective ops in a ``jax.stages.Compiled``'s optimized HLO."""
+    hlo = compiled.as_text()
+    return sum(
+        hlo.count(f"{op}(") + hlo.count(f"{op}-start(")
+        for op in COLLECTIVE_OPS
+    )
