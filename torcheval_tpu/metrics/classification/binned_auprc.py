@@ -57,7 +57,9 @@ class BinaryBinnedAUPRC(Metric[jax.Array]):
         device=None,
     ) -> None:
         super().__init__(device=device)
-        threshold = jax.device_put(create_threshold_tensor(threshold), self.device)
+        threshold = jax.device_put(
+            create_threshold_tensor(threshold, span=True), self.device
+        )
         _binary_binned_auprc_param_check(num_tasks, threshold)
         self.num_tasks = num_tasks
         self.threshold = threshold
@@ -106,7 +108,9 @@ class MulticlassBinnedAUPRC(Metric[jax.Array]):
         device=None,
     ) -> None:
         super().__init__(device=device)
-        threshold = jax.device_put(create_threshold_tensor(threshold), self.device)
+        threshold = jax.device_put(
+            create_threshold_tensor(threshold, span=True), self.device
+        )
         _multiclass_binned_auprc_param_check(num_classes, threshold, average)
         _optimization_param_check(optimization)
         self.num_classes = num_classes
@@ -153,7 +157,9 @@ class MultilabelBinnedAUPRC(Metric[jax.Array]):
         device=None,
     ) -> None:
         super().__init__(device=device)
-        threshold = jax.device_put(create_threshold_tensor(threshold), self.device)
+        threshold = jax.device_put(
+            create_threshold_tensor(threshold, span=True), self.device
+        )
         _multilabel_binned_auprc_param_check(num_labels, threshold, average)
         _optimization_param_check(optimization)
         self.num_labels = num_labels

@@ -16,7 +16,6 @@ from torcheval_tpu.metrics.functional.classification.binned_precision_recall_cur
     DEFAULT_NUM_THRESHOLD,
     _binary_binned_compute_jit,
     _binary_binned_precision_recall_curve_update,
-    _binned_precision_recall_curve_param_check,
     _multiclass_binned_precision_recall_curve_compute,
     _multiclass_binned_precision_recall_curve_update,
     _multilabel_binned_precision_recall_curve_update,
@@ -50,7 +49,6 @@ class BinaryBinnedPrecisionRecallCurve(
     ) -> None:
         super().__init__(device=device)
         threshold = jax.device_put(create_threshold_tensor(threshold), self.device)
-        _binned_precision_recall_curve_param_check(threshold)
         self.threshold = threshold
         num_t = threshold.shape[0]
         self._add_state("num_tp", jnp.zeros(num_t), merge=MergeKind.SUM)
@@ -92,7 +90,6 @@ class MulticlassBinnedPrecisionRecallCurve(
     ) -> None:
         super().__init__(device=device)
         threshold = jax.device_put(create_threshold_tensor(threshold), self.device)
-        _binned_precision_recall_curve_param_check(threshold)
         _optimization_param_check(optimization)
         self.num_classes = num_classes
         self.threshold = threshold
@@ -136,7 +133,6 @@ class MultilabelBinnedPrecisionRecallCurve(
     ) -> None:
         super().__init__(device=device)
         threshold = jax.device_put(create_threshold_tensor(threshold), self.device)
-        _binned_precision_recall_curve_param_check(threshold)
         _optimization_param_check(optimization)
         self.num_labels = num_labels
         self.threshold = threshold

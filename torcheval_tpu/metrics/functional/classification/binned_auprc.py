@@ -12,7 +12,6 @@ from typing import List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from torcheval_tpu.metrics.functional.classification.auprc import (
     _binary_auprc_update_input_check,
@@ -21,7 +20,6 @@ from torcheval_tpu.metrics.functional.classification.auprc import (
 )
 from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
     _binary_binned_update_jit,
-    _binned_precision_recall_curve_param_check,
     _binary_binned_compute_jit,
     _multiclass_binned_precision_recall_curve_update,
     _multilabel_binned_precision_recall_curve_update,
@@ -49,24 +47,12 @@ def _binned_auprc_from_counts(
     return jnp.nan_to_num(integral, nan=0.0)
 
 
-def _binned_auprc_threshold_bounds_check(threshold: jax.Array) -> None:
-    """AUPRC grids must span [0, 1] or the Riemann integral silently
-    truncates (reference binned_auprc.py:133-137 enforces this)."""
-    t = np.asarray(threshold)
-    if t[0] != 0.0:
-        raise ValueError("First value in `threshold` should be 0.")
-    if t[-1] != 1.0:
-        raise ValueError("Last value in `threshold` should be 1.")
-
-
 def _binary_binned_auprc_param_check(num_tasks: int, threshold: jax.Array) -> None:
     if num_tasks < 1:
         raise ValueError(
             "`num_tasks` value should be greater than and equal to 1, but "
             f"received {num_tasks}. "
         )
-    _binned_precision_recall_curve_param_check(threshold)
-    _binned_auprc_threshold_bounds_check(threshold)
 
 
 def _binary_binned_auprc_compute(
@@ -99,7 +85,7 @@ def binary_binned_auprc(
         ...                     jnp.array([1, 0, 1, 1]), threshold=5)
     """
     input, target = to_jax(input), to_jax(target)
-    threshold = create_threshold_tensor(threshold)
+    threshold = create_threshold_tensor(threshold, span=True)
     _binary_binned_auprc_param_check(num_tasks, threshold)
     _binary_auprc_update_input_check(input, target, num_tasks)
     return _binary_binned_auprc_compute(input, target, num_tasks, threshold), threshold
@@ -116,8 +102,6 @@ def _multiclass_binned_auprc_param_check(
         )
     if num_classes < 2:
         raise ValueError("`num_classes` has to be at least 2.")
-    _binned_precision_recall_curve_param_check(threshold)
-    _binned_auprc_threshold_bounds_check(threshold)
 
 
 def multiclass_binned_auprc(
@@ -134,7 +118,7 @@ def multiclass_binned_auprc(
     Class version: ``torcheval_tpu.metrics.MulticlassBinnedAUPRC``.
     """
     input, target = to_jax(input), to_jax(target)
-    threshold = create_threshold_tensor(threshold)
+    threshold = create_threshold_tensor(threshold, span=True)
     if num_classes is None and input.ndim == 2:
         num_classes = input.shape[1]
     _multiclass_binned_auprc_param_check(num_classes, threshold, average)
@@ -159,8 +143,6 @@ def _multilabel_binned_auprc_param_check(
         )
     if num_labels < 2:
         raise ValueError("`num_labels` has to be at least 2.")
-    _binned_precision_recall_curve_param_check(threshold)
-    _binned_auprc_threshold_bounds_check(threshold)
 
 
 def multilabel_binned_auprc(
@@ -177,7 +159,7 @@ def multilabel_binned_auprc(
     Class version: ``torcheval_tpu.metrics.MultilabelBinnedAUPRC``.
     """
     input, target = to_jax(input), to_jax(target)
-    threshold = create_threshold_tensor(threshold)
+    threshold = create_threshold_tensor(threshold, span=True)
     if num_labels is None and input.ndim == 2:
         num_labels = input.shape[1]
     _multilabel_binned_auprc_param_check(num_labels, threshold, average)

@@ -15,9 +15,6 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_update_input_check,
     _multiclass_auroc_update_input_check,
 )
-from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
-    _binned_precision_recall_curve_param_check,
-)
 from torcheval_tpu.metrics.functional.tensor_utils import (
     create_threshold_tensor,
     trapezoid,
@@ -33,7 +30,6 @@ def _binary_binned_auroc_param_check(num_tasks: int, threshold: jax.Array) -> No
             "`num_tasks` value should be greater than and equal to 1, but "
             f"received {num_tasks}. "
         )
-    _binned_precision_recall_curve_param_check(threshold)
 
 
 @jax.jit
@@ -117,7 +113,6 @@ def _multiclass_binned_auroc_param_check(
         )
     if num_classes < 2:
         raise ValueError(f"`num_classes` has to be at least 2, got {num_classes}.")
-    _binned_precision_recall_curve_param_check(threshold)
 
 
 @jax.jit

@@ -19,7 +19,6 @@ from typing import List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
     _binary_precision_recall_curve_update_input_check,
@@ -33,21 +32,6 @@ from torcheval_tpu.metrics.functional.tensor_utils import (
 from torcheval_tpu.utils.convert import to_jax
 
 DEFAULT_NUM_THRESHOLD = 100
-
-
-def _binned_precision_recall_curve_param_check(threshold: jax.Array) -> None:
-    if threshold.ndim != 1:
-        raise ValueError(
-            f"The `threshold` should be a one-dimensional tensor, got shape "
-            f"{threshold.shape}."
-        )
-    t = np.asarray(threshold)
-    if (np.diff(t) < 0.0).any():
-        raise ValueError("The `threshold` should be a sorted tensor.")
-    if (t < 0.0).any() or (t > 1.0).any():
-        raise ValueError(
-            "The values in `threshold` should be in the range of [0, 1]."
-        )
 
 
 def _optimization_param_check(optimization: str) -> None:
@@ -118,7 +102,6 @@ def binary_binned_precision_recall_curve(
     """
     input, target = to_jax(input), to_jax(target)
     threshold = create_threshold_tensor(threshold)
-    _binned_precision_recall_curve_param_check(threshold)
     num_tp, num_fp, num_fn = _binary_binned_precision_recall_curve_update(
         input, target, threshold
     )
@@ -213,7 +196,6 @@ def multiclass_binned_precision_recall_curve(
     """
     input, target = to_jax(input), to_jax(target)
     threshold = create_threshold_tensor(threshold)
-    _binned_precision_recall_curve_param_check(threshold)
     if num_classes is None and input.ndim == 2:
         num_classes = input.shape[1]
     num_tp, num_fp, num_fn = _multiclass_binned_precision_recall_curve_update(
@@ -292,7 +274,6 @@ def multilabel_binned_precision_recall_curve(
     """
     input, target = to_jax(input), to_jax(target)
     threshold = create_threshold_tensor(threshold)
-    _binned_precision_recall_curve_param_check(threshold)
     if num_labels is None and input.ndim == 2:
         num_labels = input.shape[1]
     num_tp, num_fp, num_fn = _multilabel_binned_precision_recall_curve_update(

@@ -10,6 +10,7 @@ from typing import List, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def nan_safe_divide(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -70,9 +71,42 @@ def trapezoid(y: jax.Array, x: jax.Array, axis: int = -1) -> jax.Array:
 
 def create_threshold_tensor(
     threshold: Union[int, List[float], jax.Array],
+    *,
+    span: bool = False,
 ) -> jax.Array:
-    """int n -> linspace(0, 1, n); list/array -> as-is
-    (reference tensor_utils.py:19-33)."""
+    """int n -> linspace(0, 1, n); list/array -> float32 tensor
+    (reference tensor_utils.py:19-33).
+
+    Validation (1-D, sorted, values in [0, 1]; ``span=True`` additionally
+    requires endpoints exactly 0 and 1, the AUPRC-family constraint —
+    reference binned_auprc.py:133-137) happens HERE, on the host, before
+    device placement: value-checking an already-placed device tensor reads
+    it back on every call, a hidden device->host sync that dominated the
+    binned functional paths on remote TPUs. Int grids are valid by
+    construction and skip validation entirely.
+    """
     if isinstance(threshold, int):
+        if span and threshold < 2:
+            # linspace(0, 1, n<2) cannot end at 1; the AUPRC family
+            # rejected such grids before (single-point grids integrate to a
+            # silent 0)
+            raise ValueError("Last value in `threshold` should be 1.")
         return jnp.linspace(0.0, 1.0, threshold)
-    return jnp.asarray(threshold, dtype=jnp.float32)
+    t = np.asarray(threshold, dtype=np.float32)
+    if t.ndim != 1:
+        raise ValueError(
+            f"The `threshold` should be a one-dimensional tensor, got shape "
+            f"{t.shape}."
+        )
+    if (np.diff(t) < 0.0).any():
+        raise ValueError("The `threshold` should be a sorted tensor.")
+    if (t < 0.0).any() or (t > 1.0).any():
+        raise ValueError(
+            "The values in `threshold` should be in the range of [0, 1]."
+        )
+    if span:
+        if t[0] != 0.0:
+            raise ValueError("First value in `threshold` should be 0.")
+        if t[-1] != 1.0:
+            raise ValueError("Last value in `threshold` should be 1.")
+    return jnp.asarray(t)
