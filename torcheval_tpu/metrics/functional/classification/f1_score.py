@@ -17,6 +17,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.config import debug_validation_enabled
+
 from torcheval_tpu.metrics.functional.tensor_utils import argmax_last, nan_safe_divide
 from torcheval_tpu.utils.convert import to_jax
 
@@ -118,7 +120,7 @@ def _f1_score_compute(
     num_prediction: jax.Array,
     average: Optional[str],
 ) -> jax.Array:
-    if average != "micro" and bool(jnp.any(num_label == 0)):
+    if average != "micro" and debug_validation_enabled() and bool(jnp.any(num_label == 0)):
         _logger.warning(
             "Warning: Some classes do not exist in the target. F1 scores for "
             "these classes will be cast to zeros."

@@ -13,6 +13,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.config import debug_validation_enabled
+
 from torcheval_tpu.metrics.functional.tensor_utils import argmax_last, nan_safe_divide
 from torcheval_tpu.utils.convert import to_jax
 
@@ -118,7 +120,7 @@ def _precision_compute(
 ) -> jax.Array:
     if average in (None, "None"):
         denom = num_tp + num_fp
-        if bool(jnp.any((denom == 0) & (num_label == 0))):
+        if debug_validation_enabled() and bool(jnp.any((denom == 0) & (num_label == 0))):
             _logger.warning(
                 "One or more classes have zero instances in both the "
                 "predictions and the ground truth labels. Precision is "

@@ -51,8 +51,10 @@ def _binary_precision_recall_curve_compute(
     (metrics/_buffer.py), the kernel runs on the full capacity (compiling
     O(log n) times) and the pad slots — ascending-first after the flip — are
     dropped host-side before compaction."""
-    precision, recall, threshold, is_end = (
-        np.asarray(x) for x in _prc_arrays_jit(input, target)
+    # one batched device->host readback (4 separate np.asarray pulls cost
+    # 4 synchronous round trips on remote TPUs)
+    precision, recall, threshold, is_end = jax.device_get(
+        _prc_arrays_jit(input, target)
     )
     if valid_count is not None:
         pad = precision.shape[-1] - valid_count
@@ -158,7 +160,7 @@ def _multiclass_precision_recall_curve_compute(
     valid_count: Optional[int] = None,
 ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
     p_full, r_full, t_full, end_full = (
-        np.asarray(x) for x in _multiclass_prc_full_jit(input, target)
+        jax.device_get(_multiclass_prc_full_jit(input, target))
     )
     if valid_count is not None:
         pad = p_full.shape[-1] - valid_count
@@ -223,7 +225,7 @@ def _multilabel_precision_recall_curve_compute(
     valid_count: Optional[int] = None,
 ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
     p_full, r_full, t_full, end_full = (
-        np.asarray(x) for x in _multilabel_prc_full_jit(input, target)
+        jax.device_get(_multilabel_prc_full_jit(input, target))
     )
     if valid_count is not None:
         pad = p_full.shape[-1] - valid_count

@@ -13,6 +13,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.config import debug_validation_enabled
+
 from torcheval_tpu.metrics.functional.tensor_utils import argmax_last, nan_safe_divide
 from torcheval_tpu.utils.convert import to_jax
 
@@ -113,7 +115,7 @@ def _recall_compute(
     num_predictions: jax.Array,
     average: Optional[str],
 ) -> jax.Array:
-    if average in (None, "None") and bool(jnp.any(num_labels == 0)):
+    if average in (None, "None") and debug_validation_enabled() and bool(jnp.any(num_labels == 0)):
         _logger.warning(
             "One or more classes have zero instances in the ground truth "
             "labels. Recall is still logged as zero."

@@ -9,6 +9,7 @@ data-range path keeps running min/max on device.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -106,9 +107,20 @@ def peak_signal_noise_ratio(
     _psnr_param_check(data_range)
     input = to_jax_float(input)
     target = to_jax_float(target)
+    _psnr_input_check(input, target)
+    # one fused program; data_range is a static scalar (a Python-float
+    # upload per call would cost a host->device round trip)
+    return _psnr_oneshot_jit(input, target, data_range)
+
+
+@partial(jax.jit, static_argnames=("data_range",))
+def _psnr_oneshot_jit(
+    input: jax.Array, target: jax.Array, data_range: Optional[float]
+) -> jax.Array:
+    sse = jnp.sum(jnp.square(input - target))
+    n = jnp.float32(target.size)
     if data_range is None:
-        data_range_arr = jnp.max(target) - jnp.min(target)
+        dr = jnp.max(target) - jnp.min(target)
     else:
-        data_range_arr = jnp.float32(data_range)
-    sum_squared_error, num_observations = _psnr_update(input, target)
-    return _psnr_compute(sum_squared_error, num_observations, data_range_arr)
+        dr = jnp.float32(data_range)
+    return 10 * jnp.log10(jnp.square(dr) / (sse / n))

@@ -9,7 +9,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.utils.convert import to_jax
+from torcheval_tpu.utils.convert import cached_scalar, to_jax
 
 
 def _frequency_input_check(input: jax.Array, k: float) -> None:
@@ -32,4 +32,11 @@ def frequency_at_k(input, k: float) -> jax.Array:
     """
     input = to_jax(input)
     _frequency_input_check(input, k)
+    # k rides as a traced cached device scalar: static-arg jitting would
+    # recompile per distinct k, an eager compare would upload k per call
+    return _frequency_at_k_jit(input, cached_scalar(float(k)))
+
+
+@jax.jit
+def _frequency_at_k_jit(input: jax.Array, k: jax.Array) -> jax.Array:
     return (input < k).astype(jnp.float32)

@@ -79,12 +79,15 @@ def _compute_total_number_items_retrieved(
     return k
 
 
+@partial(jax.jit, static_argnames=("k", "limit_k_to_size"))
 def _retrieval_precision_compute(
     input: jax.Array,
     target: jax.Array,
     k: Optional[int] = None,
     limit_k_to_size: bool = False,
 ) -> jax.Array:
+    # fully fused: the eager form dispatched 3 ops and uploaded the
+    # divisor constant per call
     nb_relevant = _compute_nb_relevant_items_retrieved(input, k, target)
     nb_retrieved = _compute_total_number_items_retrieved(input, k, limit_k_to_size)
     return nb_relevant / nb_retrieved

@@ -67,8 +67,12 @@ def _r2_score_compute(
     num_obs: jax.Array,
     multioutput: str,
     num_regressors: int,
+    n_host: float = None,
 ) -> jax.Array:
-    n = float(num_obs)
+    # the sample-count guards need the count on the host; the functional
+    # path knows it statically from the input shape (no device readback),
+    # the class compute() reads its accumulated counter back once
+    n = float(num_obs) if n_host is None else float(n_host)
     if n < 2:
         raise ValueError(
             "There is no enough data for computing. Needs at least two "
@@ -135,7 +139,10 @@ def r2_score(
         Array(0.6, dtype=float32)
     """
     _r2_score_param_check(multioutput, num_regressors)
+    input = to_jax_float(input)
+    target = to_jax_float(target)
     sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(input, target)
     return _r2_score_compute(
-        sum_squared_obs, sum_obs, rss, num_obs, multioutput, num_regressors
+        sum_squared_obs, sum_obs, rss, num_obs, multioutput, num_regressors,
+        n_host=target.shape[0],
     )

@@ -10,6 +10,7 @@ semantics at the reference's API boundary.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Any, Optional, Sequence, Union
 
 import jax
@@ -75,6 +76,19 @@ def to_jax_float(
     return arr
 
 
+@lru_cache(maxsize=512)
+def cached_scalar(value: float, dtype=jnp.float32) -> jax.Array:
+    """A device-resident scalar, cached per (value, dtype).
+
+    Building ``jnp.float32(x)`` from a Python number is a host->device
+    transfer; doing it per metric call puts a round trip on every update
+    (tunnel-amplified on remote TPUs). Real workloads use a handful of
+    distinct scalar weights/params, so a small cache removes the transfer
+    entirely after first use.
+    """
+    return jnp.asarray(value, dtype=dtype)
+
+
 def resolve_weight(
     weight: Any, input: jax.Array, *, int_clause: bool = False
 ) -> tuple:
@@ -88,7 +102,7 @@ def resolve_weight(
     message cannot drift between the two layers.
     """
     if isinstance(weight, (float, int)) and not is_torch_tensor(weight):
-        return True, jnp.float32(weight)
+        return True, cached_scalar(float(weight))
     weight_arr = to_jax_float(weight)
     if weight_arr.shape == input.shape:
         return False, weight_arr
