@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.utils.convert import cached_scalar
 
 MIN_CAPACITY = 64
 
@@ -121,6 +122,8 @@ class BufferedExamplesMetric(Metric[jax.Array]):
                 )
             buf = self._ensure_capacity(buf, spec, batch, needed)
             axis = spec.axis if spec.axis >= 0 else buf.ndim + spec.axis
+            # count is strictly increasing, so a cached device scalar would
+            # never hit; the plain int upload is the cheapest option here
             buf = _write_at(buf, batch, count, axis=axis)
             setattr(self, name, buf)
         self._num_samples = needed
@@ -133,14 +136,18 @@ class BufferedExamplesMetric(Metric[jax.Array]):
             # lazy init: row shape/dtype from the first batch
             shape = list(batch.shape)
             shape[axis] = next_capacity(needed)
-            return jnp.full(shape, spec.fill, dtype=batch.dtype)
+            return jnp.full(
+                shape, cached_scalar(spec.fill, batch.dtype), dtype=batch.dtype
+            )
         cap = buf.shape[axis]
         if needed <= cap:
             return buf
         new_cap = next_capacity(needed)
         pad = [(0, 0)] * buf.ndim
         pad[axis] = (0, new_cap - cap)
-        return jnp.pad(buf, pad, constant_values=spec.fill)
+        return jnp.pad(
+            buf, pad, constant_values=cached_scalar(spec.fill, buf.dtype)
+        )
 
     # ------------------------------------------------------------------ access
 

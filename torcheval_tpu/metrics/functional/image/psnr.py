@@ -15,7 +15,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.utils.convert import to_jax_float
+from torcheval_tpu.utils.convert import cached_scalar, to_jax_float
 
 
 @jax.jit
@@ -57,6 +57,7 @@ def _psnr_update(input, target) -> Tuple[jax.Array, jax.Array]:
     return _psnr_update_jit(input, target)
 
 
+@jax.jit
 def _psnr_compute(
     sum_squared_error: jax.Array,
     num_observations: jax.Array,
@@ -108,19 +109,20 @@ def peak_signal_noise_ratio(
     input = to_jax_float(input)
     target = to_jax_float(target)
     _psnr_input_check(input, target)
-    # one fused program; data_range is a static scalar (a Python-float
-    # upload per call would cost a host->device round trip)
-    return _psnr_oneshot_jit(input, target, data_range)
+    # one fused program; a fixed data_range rides as a traced cached device
+    # scalar (static-arg jitting would recompile per distinct value, an
+    # eager upload would cost a round trip per call)
+    auto_range = data_range is None
+    dr = cached_scalar(0.0 if auto_range else float(data_range))
+    return _psnr_oneshot_jit(input, target, dr, auto_range)
 
 
-@partial(jax.jit, static_argnames=("data_range",))
+@partial(jax.jit, static_argnames=("auto_range",))
 def _psnr_oneshot_jit(
-    input: jax.Array, target: jax.Array, data_range: Optional[float]
+    input: jax.Array, target: jax.Array, dr: jax.Array, auto_range: bool
 ) -> jax.Array:
     sse = jnp.sum(jnp.square(input - target))
     n = jnp.float32(target.size)
-    if data_range is None:
+    if auto_range:
         dr = jnp.max(target) - jnp.min(target)
-    else:
-        dr = jnp.float32(data_range)
     return 10 * jnp.log10(jnp.square(dr) / (sse / n))

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -49,6 +51,7 @@ def _mean_squared_error_update(
     return _update_weighted(input, target, to_jax_float(sample_weight))
 
 
+@partial(jax.jit, static_argnames=("multioutput",))
 def _mean_squared_error_compute(
     sum_squared_error: jax.Array,
     multioutput: str,

@@ -25,9 +25,17 @@ from typing import Iterable, List, Optional, Sequence, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.utils.convert import cached_scalar
+
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
 TWindowed = TypeVar("TWindowed", bound="WindowedTaskCounterMetric")
+
+
+
+@jax.jit
+def _ring_write(buf: jax.Array, col: jax.Array, value: jax.Array) -> jax.Array:
+    return buf.at[:, col].set(value)
 
 
 class RingCursorSerializationMixin:
@@ -115,10 +123,14 @@ class WindowedTaskCounterMetric(RingCursorSerializationMixin, Metric):
                 # `+` broadcasts the reference's scalar->vector state
                 # promotion (reference window/mean_squared_error.py:141-145)
                 setattr(self, name, getattr(self, name) + value)
+        # traced column index (cached device scalar): baking the Python int
+        # into the eager .at[].set would compile one program per ring slot
+        # and upload constants per call; the cursor itself stays a host int
         col = self.next_inserted
+        col_dev = cached_scalar(col, jnp.int32)
         for name, value in zip(self._counter_names, counter_values):
             buf = getattr(self, f"windowed_{name}")
-            setattr(self, f"windowed_{name}", buf.at[:, col].set(value))
+            setattr(self, f"windowed_{name}", _ring_write(buf, col_dev, value))
         self.next_inserted = (col + 1) % self.max_num_updates
         self.total_updates += 1
 

@@ -16,6 +16,8 @@ from typing import Iterable, Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.utils.convert import cached_scalar
+
 from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_compute,
     _binary_auroc_update_input_check,
@@ -24,6 +26,12 @@ from torcheval_tpu.metrics.metric import MergeKind, Metric
 from torcheval_tpu.metrics.window._base import RingCursorSerializationMixin
 
 TWindowedBinaryAUROC = TypeVar("TWindowedBinaryAUROC", bound="WindowedBinaryAUROC")
+
+
+
+@jax.jit
+def _ring_write_cols(buf: jax.Array, col: jax.Array, value: jax.Array) -> jax.Array:
+    return jax.lax.dynamic_update_slice(buf, value.astype(buf.dtype), (jnp.int32(0), col))
 
 
 class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
@@ -70,8 +78,12 @@ class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
         self._add_state("weights", zeros, merge=MergeKind.CUSTOM)
 
     def _write(self, name: str, col: int, value: jax.Array) -> None:
+        # traced start column (cached device scalar): an eager .at slice-set
+        # would compile per ring offset and upload constants per call
         buf = getattr(self, name)
-        setattr(self, name, buf.at[:, col : col + value.shape[1]].set(value))
+        setattr(
+            self, name, _ring_write_cols(buf, cached_scalar(col, jnp.int32), value)
+        )
 
     def update(
         self: TWindowedBinaryAUROC,
@@ -82,7 +94,7 @@ class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
         """Insert a batch of samples into the ring buffers."""
         input, target = self._input(input), self._input(target)
         if weight is None:
-            weight = jnp.ones_like(input, dtype=jnp.float32)
+            weight = jnp.broadcast_to(cached_scalar(1.0), input.shape)
         else:
             weight = self._input_float(weight)
         _binary_auroc_update_input_check(input, target, self.num_tasks, weight)
