@@ -14,7 +14,7 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.utils.convert import cached_scalar
+from torcheval_tpu.utils.convert import default_ones
 
 from torcheval_tpu.metrics._buffer import BufferedExamplesMetric
 from torcheval_tpu.metrics.functional.classification.auroc import (
@@ -73,7 +73,7 @@ class BinaryAUROC(BufferedExamplesMetric):
         weight = self._input(weight) if weight is not None else None
         _binary_auroc_update_input_check(input, target, self.num_tasks, weight)
         if weight is None:
-            weight = jnp.broadcast_to(cached_scalar(1.0), input.shape)
+            weight = default_ones(input.shape)
         BufferedExamplesMetric._append(
             self, inputs=input, targets=target, weights=weight
         )

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
-from torcheval_tpu.utils.convert import cached_scalar, to_jax
+from torcheval_tpu.utils.convert import default_ones, to_jax
 
 
 def _debug_check_target_range(input: jax.Array, target: jax.Array) -> None:
@@ -80,5 +80,5 @@ def hit_rate(input, target, *, k: Optional[int] = None) -> jax.Array:
     _hit_rate_input_check(input, target, k)
     _debug_check_target_range(input, target)
     if k is None or k >= input.shape[-1]:
-        return jnp.broadcast_to(cached_scalar(1.0), target.shape)
+        return default_ones(target.shape)
     return _hit_rate_jit(input, target, k)

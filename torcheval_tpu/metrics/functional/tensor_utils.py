@@ -6,6 +6,7 @@ Parity targets: reference torcheval/metrics/functional/tensor_utils.py
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import List, Union
 
 import jax
@@ -69,6 +70,13 @@ def trapezoid(y: jax.Array, x: jax.Array, axis: int = -1) -> jax.Array:
     return jnp.sum(dx * (y[..., 1:] + y[..., :-1]) / 2.0, axis=-1)
 
 
+@lru_cache(maxsize=64)
+def _cached_linspace_grid(n: int) -> jax.Array:
+    # rebuilding the grid eagerly per functional call uploads its constants
+    # every time; grids are reused heavily, so cache per bin count
+    return jnp.linspace(0.0, 1.0, n)
+
+
 def create_threshold_tensor(
     threshold: Union[int, List[float], jax.Array],
     *,
@@ -91,7 +99,7 @@ def create_threshold_tensor(
             # rejected such grids before (single-point grids integrate to a
             # silent 0)
             raise ValueError("Last value in `threshold` should be 1.")
-        return jnp.linspace(0.0, 1.0, threshold)
+        return _cached_linspace_grid(threshold)
     t = np.asarray(threshold, dtype=np.float32)
     if t.ndim != 1:
         raise ValueError(

@@ -25,7 +25,7 @@ from typing import Iterable, List, Optional, Sequence, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.utils.convert import cached_scalar
+from torcheval_tpu.utils.convert import cached_index
 
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
@@ -127,7 +127,7 @@ class WindowedTaskCounterMetric(RingCursorSerializationMixin, Metric):
         # into the eager .at[].set would compile one program per ring slot
         # and upload constants per call; the cursor itself stays a host int
         col = self.next_inserted
-        col_dev = cached_scalar(col, jnp.int32)
+        col_dev = cached_index(col)
         for name, value in zip(self._counter_names, counter_values):
             buf = getattr(self, f"windowed_{name}")
             setattr(self, f"windowed_{name}", _ring_write(buf, col_dev, value))

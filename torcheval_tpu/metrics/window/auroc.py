@@ -16,7 +16,7 @@ from typing import Iterable, Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.utils.convert import cached_scalar
+from torcheval_tpu.utils.convert import cached_index, default_ones
 
 from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_compute,
@@ -82,7 +82,7 @@ class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
         # would compile per ring offset and upload constants per call
         buf = getattr(self, name)
         setattr(
-            self, name, _ring_write_cols(buf, cached_scalar(col, jnp.int32), value)
+            self, name, _ring_write_cols(buf, cached_index(col), value)
         )
 
     def update(
@@ -94,7 +94,7 @@ class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
         """Insert a batch of samples into the ring buffers."""
         input, target = self._input(input), self._input(target)
         if weight is None:
-            weight = jnp.broadcast_to(cached_scalar(1.0), input.shape)
+            weight = default_ones(input.shape)
         else:
             weight = self._input_float(weight)
         _binary_auroc_update_input_check(input, target, self.num_tasks, weight)

@@ -89,6 +89,25 @@ def cached_scalar(value: float, dtype=jnp.float32) -> jax.Array:
     return jnp.asarray(value, dtype=dtype)
 
 
+@lru_cache(maxsize=1024)
+def cached_index(i: int) -> jax.Array:
+    """A device-resident int32 index, cached in its OWN pool.
+
+    Ring-buffer cursors cycle through up to window-size distinct values;
+    routing them through ``cached_scalar`` would evict genuinely hot
+    scalars (the 1.0 default weight) from the shared pool. Windows larger
+    than this cache simply pay one small int upload per update — the same
+    documented cost as the growable-buffer append offset.
+    """
+    return jnp.asarray(i, dtype=jnp.int32)
+
+
+def default_ones(shape: tuple) -> jax.Array:
+    """All-ones float32 default weights without a per-call constant upload
+    (``jnp.ones_like`` uploads its fill scalar every call)."""
+    return jnp.broadcast_to(cached_scalar(1.0), shape)
+
+
 def resolve_weight(
     weight: Any, input: jax.Array, *, int_clause: bool = False
 ) -> tuple:
