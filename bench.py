@@ -650,6 +650,12 @@ def main():
     ap.add_argument("--child", help="run one config in-process (ours)")
     ap.add_argument("--ref", help="run one reference baseline in-process")
     ap.add_argument("--only", help="comma-separated config subset (parent)")
+    ap.add_argument(
+        "--budget-s", type=float, default=1500.0,
+        help="soft wall-clock budget: once half is spent, remaining configs "
+        "skip their TPU attempt (a mid-run relay stall costs a 420 s child "
+        "timeout per config; the budget bounds the worst case)",
+    )
     args = ap.parse_args()
 
     if args.child:
@@ -667,7 +673,9 @@ def main():
     platform = "cpu"
     for attempt in range(2):  # probe TPU, retry once
         try:
-            res = _run_child("probe", "tpu", timeout=180)
+            # first TPU compile is ~20-40s; 120s covers it while keeping the
+            # dead time bounded when the relay is hung
+            res = _run_child("probe", "tpu", timeout=120)
             platform = "tpu" if res.get("backend") not in (None, "cpu") else "cpu"
             break
         except Exception as e:  # noqa: BLE001
@@ -676,11 +684,20 @@ def main():
     print(f"# platform: {platform}", file=sys.stderr)
 
     configs_out = {}
+    budget_hit = False
     for name in names:
         _, refname = CONFIGS[name]
         # sync_overhead needs a multi-device mesh: with one real TPU chip the
         # virtual 8-device CPU platform is the honest measurement.
         plat = "cpu" if name == "sync_overhead" else platform
+        if plat != "cpu" and time.monotonic() - t0 > args.budget_s / 2:
+            if not budget_hit:
+                print(
+                    f"# budget ({args.budget_s:.0f}s) half-spent: remaining "
+                    "configs run on cpu", file=sys.stderr,
+                )
+                budget_hit = True
+            plat = "cpu"
         entry = None
         for p in dict.fromkeys([plat, "cpu"]):  # fall back to cpu once
             try:
