@@ -1,0 +1,112 @@
+"""Pick a usable JAX backend BEFORE the first ``import jax``.
+
+When the TPU plugin's relay is unreachable, backend init hangs inside
+``make_pjrt_c_api_client`` — and setting ``JAX_PLATFORMS=cpu`` does not help
+because the plugin registers itself programmatically. The reliable recipe
+(same as ``bench.py``): probe the accelerator in a SUBPROCESS with a
+timeout; on failure scrub the plugin-registration env var and force CPU for
+this process. Examples call ``ensure_backend()`` first so they run anywhere
+— TPU when it's claimable, CPU otherwise — instead of hanging.
+
+Siblings of this recipe (mechanically different, keep in sync on the env
+var name): ``bench.py:_cpu_env`` builds a scrubbed env for CHILD processes,
+``__graft_entry__.py`` re-execs into one, ``conftest.py`` applies the
+in-process config force for pytest. They cannot share code: the bench
+parent must never import jax (or torcheval_tpu, which imports jax).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_PLUGIN_ENV = "PALLAS_AXON_POOL_IPS"
+
+
+def ensure_backend(timeout: float = 90.0) -> str:
+    """Probe the default accelerator; fall back to CPU if it is unusable.
+
+    Must run before the first backend *initialization* (any jax.devices()/
+    computation). The site hook imports jax at interpreter start, so "jax
+    already imported" is the normal state here — ``jax.config.update`` still
+    wins as long as no backend has initialized yet (same trick as the repo
+    conftest). Returns ``"default"`` or ``"cpu"``.
+    """
+    if _PLUGIN_ENV not in os.environ:
+        return "default"  # no plugin registered; plain jax picks cpu/gpu
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # user already chose CPU: honor it without paying the probe (the
+        # env var alone cannot override the plugin's programmatic setting,
+        # so the config-level force below is still required)
+        return force_cpu()
+    probe = (
+        "import jax, jax.numpy as jnp; "
+        "jax.block_until_ready(jnp.ones(()) + 1)"
+    )
+    try:
+        ok = (
+            subprocess.run(
+                [sys.executable, "-c", probe],
+                timeout=timeout,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            ).returncode
+            == 0
+        )
+    except subprocess.TimeoutExpired:
+        ok = False
+    if ok:
+        return "default"
+    print(
+        "# accelerator unreachable: falling back to CPU "
+        "(set JAX_PLATFORMS=cpu to skip this probe)",
+        file=sys.stderr,
+    )
+    return force_cpu()
+
+
+def rehearsal_cpu() -> str:
+    """CPU platform for pod-REHEARSAL workers; a no-op on a real pod.
+
+    Local rehearsals (this dev image's exclusive-claim relay plugin, or
+    workers spawned by ``torcheval_tpu.launcher``) must not race N
+    processes onto one chip — force CPU, one virtual device per worker
+    (the launcher's one-virtual-host-per-process contract,
+    launcher.py docstring). On a real pod neither marker is present and
+    the TPU runtime owns device assignment: change nothing.
+    """
+    under_launcher = bool(os.environ.get("TE_TPU_NPROC"))
+    if _PLUGIN_ENV in os.environ or under_launcher:
+        return force_cpu(n_virtual_devices=1 if under_launcher else 8)
+    return "default"
+
+
+def force_cpu(n_virtual_devices: int = 8) -> str:
+    """Force THIS process onto an ``n_virtual_devices``-device CPU platform.
+
+    The plugin registration armed at interpreter startup (site hook) and
+    programmatically forces the platform, so env vars alone cannot override
+    it — but the jax config can, as long as no backend initialized yet
+    (same recipe as the repo conftest). The virtual device count keeps
+    multi-device examples meaningful without hardware. Also the right call
+    for pod-rehearsal workers (``multihost_example``): N processes cannot
+    share one exclusive-claim chip, and per-rank accelerator probes would
+    race it.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count"
+            f"={n_virtual_devices}"
+        ).strip()
+    os.environ.pop(_PLUGIN_ENV, None)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # NOTE: deliberately no jax.devices() call here — with the platform
+    # forced it is redundant, and touching devices would initialize the
+    # backend, which must not happen before jax.distributed.initialize()
+    # in launcher-spawned workers.
+    return "cpu"

@@ -11,6 +11,10 @@ path is also shown for per-device replica metrics.
 import os, sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from examples._backend import ensure_backend
+
+ensure_backend()  # fall back to CPU if the accelerator relay is unreachable
+
 import jax
 import jax.numpy as jnp
 import numpy as np

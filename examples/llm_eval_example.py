@@ -16,6 +16,10 @@ text family is designed around:
 import os, sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from examples._backend import ensure_backend
+
+ensure_backend()  # fall back to CPU if the accelerator relay is unreachable
+
 import time
 
 import jax

@@ -26,6 +26,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from examples._backend import rehearsal_cpu
+
+# local rehearsals run workers on the CPU platform (N processes cannot
+# share one exclusive-claim chip, and per-rank accelerator probes would
+# race it); on a real pod this is a no-op and the TPU runtime owns
+# process/device assignment
+rehearsal_cpu()
+
 from torcheval_tpu.launcher import init_from_env
 
 init_from_env()  # joins the job when run under the launcher; no-op otherwise
