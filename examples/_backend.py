@@ -67,19 +67,21 @@ def ensure_backend(timeout: float = 90.0) -> str:
 
 
 def rehearsal_cpu() -> str:
-    """CPU platform for pod-REHEARSAL workers; a no-op on a real pod.
+    """CPU platform for pod-REHEARSAL workers; a no-op everywhere else.
 
-    Local rehearsals (this dev image's exclusive-claim relay plugin, or
-    workers spawned by ``torcheval_tpu.launcher``) must not race N
-    processes onto one chip — force CPU, one virtual device per worker
-    (the launcher's one-virtual-host-per-process contract,
-    launcher.py docstring). On a real pod neither marker is present and
-    the TPU runtime owns device assignment: change nothing.
+    Fires only when the exclusive-claim relay plugin env is present — N
+    processes cannot share one chip, and per-rank probes would race it.
+    Workers spawned by ``torcheval_tpu.launcher`` with the default
+    ``platform="cpu"`` arrive with that env already scrubbed (no-op here);
+    ``launch(..., platform=None)`` on a real pod has no plugin env either,
+    so the TPU runtime keeps device assignment. When forcing, launcher
+    workers get ONE virtual device (the one-virtual-host-per-process
+    contract, launcher.py docstring), standalone runs get 8.
     """
-    under_launcher = bool(os.environ.get("TE_TPU_NPROC"))
-    if _PLUGIN_ENV in os.environ or under_launcher:
-        return force_cpu(n_virtual_devices=1 if under_launcher else 8)
-    return "default"
+    if _PLUGIN_ENV not in os.environ:
+        return "default"
+    n = 1 if os.environ.get("TE_TPU_NPROC") else 8
+    return force_cpu(n_virtual_devices=n)
 
 
 def force_cpu(n_virtual_devices: int = 8) -> str:
@@ -94,12 +96,18 @@ def force_cpu(n_virtual_devices: int = 8) -> str:
     share one exclusive-claim chip, and per-rank accelerator probes would
     race it.
     """
+    import re
+
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count"
-            f"={n_virtual_devices}"
-        ).strip()
+    want = f"--xla_force_host_platform_device_count={n_virtual_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        # replace a stale count rather than silently keeping it
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", want, flags
+        )
+    else:
+        flags = f"{flags} {want}".strip()
+    os.environ["XLA_FLAGS"] = flags
     os.environ.pop(_PLUGIN_ENV, None)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax

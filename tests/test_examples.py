@@ -1,0 +1,45 @@
+"""Example-script smoke tests.
+
+Each example is a documented user flow; run it as a real subprocess on the
+forced-CPU path (``JAX_PLATFORMS=cpu`` short-circuits the accelerator probe
+in ``examples/_backend.py``) and assert it completes with its expected
+output marker. The multihost example runs in its single-process regime
+here; its multi-process regime rides the launcher machinery that
+test_launcher.py exercises with a dedicated worker.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name: str, timeout: float = 240.0) -> str:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name)],
+        env=env, cwd=REPO, timeout=timeout,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    assert proc.returncode == 0, f"{name} rc={proc.returncode}:\n{proc.stdout[-2000:]}"
+    return proc.stdout
+
+
+@pytest.mark.parametrize(
+    "name,marker",
+    [
+        ("simple_example.py", "epoch 1:"),
+        ("distributed_example.py", "devices"),
+        ("llm_eval_example.py", "perplexity="),
+        ("multihost_example.py", "done"),
+    ],
+)
+def test_example_runs(name, marker):
+    out = _run_example(name)
+    assert marker in out, f"{name} output missing {marker!r}:\n{out[-1500:]}"
