@@ -1,0 +1,166 @@
+"""Broad invalid-input sweep over functional/classification ValueError
+branches (the reference's per-metric assertRaisesRegex batteries, e.g.
+reference tests/metrics/functional/classification/test_accuracy.py) —
+one case per distinct message family, asserting the message prefix.
+Value-dependent checks (target-range) run under debug_validation.
+Param-type errors (TypeError) and a few shared-message variants are
+covered by the per-family test files.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torcheval_tpu.metrics.functional as F
+from torcheval_tpu.config import debug_validation
+
+
+def _t(*shape):
+    return jnp.zeros(shape, dtype=jnp.float32)
+
+
+def _ti(*shape):
+    return jnp.zeros(shape, dtype=jnp.int32)
+
+
+# (callable, message-regex) pairs
+CASES = [
+    # ------------------------------------------------------------ accuracy
+    (lambda: F.multiclass_accuracy(_t(4, 3), _ti(3)),
+     r"The `input` and `target` should have the same"),
+    (lambda: F.multiclass_accuracy(_t(4, 3, 2), _ti(4)),
+     r"input should have shape of \(num_sample,\) or \(num_sample, num_classes\)"),
+    (lambda: F.multiclass_accuracy(_t(4, 2), _ti(4), k=3, num_classes=2),
+     r"k \(3\) should not be greater than the number of classes"),
+    (lambda: F.binary_accuracy(_t(4), _t(3)),
+     r"The `input` and `target` should have the same"),
+    (lambda: F.multilabel_accuracy(_t(4, 3), _t(3, 3)),
+     r"The `input` and `target` should have the same"),
+    (lambda: F.topk_multilabel_accuracy(_t(4, 3), _t(3, 3), k=2),
+     r"The `input` and `target` should have the same"),
+    # --------------------------------------------------------------- auroc
+    (lambda: F.binary_auroc(_t(4), _t(3)),
+     r"The `input` and `target` should have the same shape"),
+    (lambda: F.binary_auroc(_t(4), _t(4), weight=_t(3)),
+     r"The `weight` and `target` should have the same shape"),
+    (lambda: F.binary_auroc(_t(2, 4), _t(2, 4)),
+     r"`num_tasks` = 1, `input` is expected to be one-dimensional tensor|"
+     r"`num_tasks = 1`, `input` is expected to be one-dimensional"),
+    (lambda: F.multiclass_auroc(_t(4, 3), _ti(3), num_classes=3),
+     r"The `input` and `target` should have the same first dimension"),
+    (lambda: F.multiclass_auroc(_t(4, 2), _ti(4), num_classes=3),
+     r"input should have shape of \(num_sample, num_classes\)"),
+    # --------------------------------------------------------------- auprc
+    (lambda: F.binary_auprc(_t(4), _t(3)),
+     r"The `input` and `target` should have the same shape"),
+    (lambda: F.binary_auprc(_t(2, 2, 2), _t(2, 2, 2)),
+     r"input should be at most two-dimensional"),
+    (lambda: F.binary_auprc(_t(2, 4), _t(2, 4), num_tasks=1),
+     r"`num_tasks = 1`, `input` and `target` are expected to be"),
+    (lambda: F.multiclass_auprc(_t(4, 3), _ti(3), num_classes=3),
+     r"The `input` and `target` should have the same first dimension"),
+    (lambda: F.multiclass_auprc(_t(4, 2), _ti(4), num_classes=3),
+     r"input should have shape of \(num_sample, num_classes\)"),
+    (lambda: F.multilabel_auprc(_t(4, 3), _t(3, 3), num_labels=3),
+     r"Expected both input.shape and target.shape"),
+    (lambda: F.multilabel_auprc(_t(4, 2), _t(4, 2), num_labels=3),
+     r"input should have shape of \(num_sample, num_labels\)"),
+    # ------------------------------------------------- precision / recall / f1
+    (lambda: F.multiclass_precision(_t(4, 3), _ti(3), num_classes=3),
+     r"The `input` and `target` should have the same"),
+    (lambda: F.multiclass_precision(_t(4, 3, 2), _ti(4), num_classes=3),
+     r"input should have shape of \(num_sample,\)"),
+    (lambda: F.binary_precision(_t(4), _t(3)),
+     r"The `input` and `target` should have the same"),
+    (lambda: F.multiclass_recall(_t(4, 3), _ti(3), num_classes=3),
+     r"The `input` and `target` should have the same"),
+    (lambda: F.multiclass_recall(_t(4, 3, 2), _ti(4), num_classes=3),
+     r"input should have shape of \(num_sample,\)"),
+    (lambda: F.binary_recall(_t(4), _t(3)),
+     r"The `input` and `target` should have the same"),
+    (lambda: F.multiclass_f1_score(_t(4, 3), _ti(3), num_classes=3),
+     r"The `input` and `target` should have the same"),
+    (lambda: F.multiclass_f1_score(_t(4, 3, 2), _ti(4), num_classes=3),
+     r"input should have shape of \(num_sample,\)"),
+    (lambda: F.binary_f1_score(_t(4), _t(3)),
+     r"The `input` and `target` should have the same"),
+    # ---------------------------------------------------- confusion matrix
+    (lambda: F.multiclass_confusion_matrix(_t(4, 3), _ti(4), num_classes=1),
+     r"Must be at least two classes"),
+    (lambda: F.multiclass_confusion_matrix(
+        _t(4, 3), _ti(4), num_classes=3, normalize="bogus"),
+     r"normalize must be one of"),
+    (lambda: F.multiclass_confusion_matrix(_t(4, 3), _ti(3), num_classes=3),
+     r"The `input` and `target` should have the same"),
+    (lambda: F.multiclass_confusion_matrix(_t(4, 3, 2), _ti(4), num_classes=3),
+     r"input should have shape of \(num_sample,\)"),
+    (lambda: F.binary_confusion_matrix(_t(4), _t(3)),
+     r"The `input` and `target` should have the same"),
+    # --------------------------------------------------------------- curves
+    (lambda: F.binary_precision_recall_curve(_t(4), _t(3)),
+     r"The `input` and `target` should have the same shape"),
+    (lambda: F.multiclass_precision_recall_curve(
+        _t(4, 3), _ti(3), num_classes=3),
+     r"The `input` and `target` should have the same first dimension"),
+    (lambda: F.multiclass_precision_recall_curve(
+        _t(4, 2), _ti(4), num_classes=3),
+     r"input should have shape of \(num_sample, num_classes\)"),
+    (lambda: F.multilabel_precision_recall_curve(_t(4, 3), _t(3, 3)),
+     r"Expected both input.shape and target.shape"),
+    (lambda: F.multilabel_precision_recall_curve(
+        _t(4, 2), _t(4, 2), num_labels=3),
+     r"input should have shape of \(num_sample, num_labels\)"),
+    # ------------------------------------------- recall at fixed precision
+    (lambda: F.binary_recall_at_fixed_precision(_t(4), _t(4), min_precision=1.5),
+     r"Expected min_precision to be a float in the \[0, 1\] range"),
+    (lambda: F.multilabel_recall_at_fixed_precision(
+        _t(4, 3), _t(4, 3), num_labels=3, min_precision=-0.1),
+     r"Expected min_precision to be a float in the \[0, 1\] range"),
+    # ---------------------------------------------------------- binned PRC
+    (lambda: F.multiclass_binned_precision_recall_curve(
+        _t(4, 3), _ti(4), num_classes=3, optimization="fastest"),
+     r"Unknown memory approach"),
+    # --------------------------------------------------- normalized entropy
+    (lambda: F.binary_normalized_entropy(_t(4), _t(3)),
+     r"`input` shape"),
+    (lambda: F.binary_normalized_entropy(_t(4), _t(4), weight=_t(3)),
+     r"`weight` shape"),
+    (lambda: F.binary_normalized_entropy(_t(2, 4), _t(2, 4)),
+     r"`num_tasks = 1`, `input` is expected to be one-dimensional"),
+]
+
+
+@pytest.mark.parametrize("idx", range(len(CASES)))
+def test_invalid_input_raises(idx):
+    fn, pattern = CASES[idx]
+    with pytest.raises(ValueError, match=pattern):
+        fn()
+
+
+# -------- value-dependent branches (device readback): debug-mode only ----
+
+
+def test_accuracy_target_range_debug():
+    with debug_validation():
+        with pytest.raises(ValueError, match=r"target values must be in"):
+            F.multiclass_accuracy(
+                _t(4, 3), jnp.asarray([0, 1, 2, 5]), num_classes=3
+            )
+
+
+def test_confusion_matrix_target_range_debug():
+    with debug_validation():
+        with pytest.raises(ValueError, match=r"target values must be in"):
+            F.multiclass_confusion_matrix(
+                _t(4, 3), jnp.asarray([0, 1, 2, 5]), num_classes=3
+            )
+
+
+def test_ne_probability_range_debug():
+    with debug_validation():
+        with pytest.raises(ValueError, match=r"probability"):
+            F.binary_normalized_entropy(
+                jnp.asarray([1.5, 0.2]), jnp.asarray([1.0, 0.0])
+            )

@@ -123,6 +123,14 @@ def _accuracy_update_input_check(
             "input should have shape (num_sample, num_classes) for k > 1, "
             f"got shape {input.shape}."
         )
+    if k > 1 and k > input.shape[1]:  # ndim==2 guaranteed by the check above
+        # the reference dies inside torch.topk here ("selected index k out
+        # of range"); our rank-count top-k has no such guard built in, so
+        # validate explicitly instead of silently returning accuracy 1.0
+        raise ValueError(
+            f"k ({k}) should not be greater than the number of classes "
+            f"({input.shape[1]})."
+        )
     if not input.ndim == 1 and not (
         input.ndim == 2 and (num_classes is None or input.shape[1] == num_classes)
     ):
