@@ -755,6 +755,11 @@ def main():
         "wall_s": round(time.monotonic() - t0, 1),
         "configs": configs_out,
     }
+    if platform == "cpu":
+        out["note"] = (
+            "TPU not available for this run; previously captured "
+            "single-chip TPU numbers are committed in docs/benchmarks.md"
+        )
     print(json.dumps(out))
 
 
