@@ -1,30 +1,121 @@
 """BLEU score.
 
 Parity: reference torcheval/metrics/functional/text/bleu.py (`bleu_score`
-:13-62, `_bleu_score_update` :65-111, `_bleu_score_compute` :114-137,
-brevity penalty :140-146, `_get_ngrams` :149-162). N-gram counting is
-host-side string processing (as in the reference); the per-update result is
-a small fixed-size vector of counters that accumulates on device.
+:13-62, update/compute/brevity-penalty semantics :65-146). The counting here
+is re-derived as array code rather than per-sentence ``Counter`` work: the
+whole batch is flattened into one token stream, tokens are integer-encoded
+with a single ``np.unique``, and clipped n-gram overlaps are computed per
+order with sliding-window row dedup + grouped bincounts (the same
+"vectorize the host text kernel" approach as ``helper.py``'s edit
+distance). The per-update result is a small fixed-size vector of counters
+that accumulates on device.
 """
 
 from __future__ import annotations
 
-from collections import Counter
-from typing import Optional, Sequence, Tuple, Union
+from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
-def _get_ngrams(sentence: Sequence[str], n_gram: int) -> Counter:
-    if n_gram not in (1, 2, 3, 4):
-        raise ValueError(f"n_gram should be 1, 2, 3, or 4, got {n_gram}.")
-    ngram_counts: Counter = Counter()
-    for n_val in range(1, n_gram + 1):
-        for i in range(0, len(sentence) - n_val + 1):
-            ngram_counts[tuple(sentence[i : i + n_val])] += 1
-    return ngram_counts
+def _encode_corpus(
+    candidates: Sequence[Sequence[str]],
+    references: Sequence[Sequence[Sequence[str]]],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
+    """Flatten a tokenized batch into one integer-coded token stream.
+
+    Returns ``(ids, sent_serial, pair_idx, ref_local, max_refs)`` where each
+    array has one entry per token: ``ids`` the token's integer code (dense,
+    from one global ``np.unique``), ``sent_serial`` a distinct serial per
+    sentence (so n-gram windows never straddle sentences), ``pair_idx`` the
+    candidate/reference-pair index, and ``ref_local`` the reference's index
+    within its pair (-1 for candidate tokens).
+    """
+    flat: List[str] = []
+    serial: List[int] = []
+    pair: List[int] = []
+    ref_local: List[int] = []
+    sent = 0
+    max_refs = 0
+    for i, (cand, refs) in enumerate(zip(candidates, references)):
+        flat.extend(cand)
+        serial.extend([sent] * len(cand))
+        pair.extend([i] * len(cand))
+        ref_local.extend([-1] * len(cand))
+        sent += 1
+        max_refs = max(max_refs, len(refs))
+        for r, ref in enumerate(refs):
+            flat.extend(ref)
+            serial.extend([sent] * len(ref))
+            pair.extend([i] * len(ref))
+            ref_local.extend([r] * len(ref))
+            sent += 1
+    if not flat:
+        ids = np.zeros(0, dtype=np.int64)
+    else:
+        _, ids = np.unique(np.asarray(flat), return_inverse=True)
+        ids = ids.astype(np.int64, copy=False)
+    return (
+        ids,
+        np.asarray(serial, dtype=np.int64),
+        np.asarray(pair, dtype=np.int64),
+        np.asarray(ref_local, dtype=np.int64),
+        max_refs,
+    )
+
+
+def _clipped_matches_per_order(
+    ids: np.ndarray,
+    sent_serial: np.ndarray,
+    pair_idx: np.ndarray,
+    ref_local: np.ndarray,
+    max_refs: int,
+    n_gram: int,
+) -> np.ndarray:
+    """Clipped n-gram match totals for orders ``1..n_gram``.
+
+    For order ``n``, every length-``n`` window that stays inside one
+    sentence becomes a row ``[pair, tok_0..tok_{n-1}]``; ``np.unique`` over
+    rows assigns each distinct (pair, n-gram) a group id, and the clipped
+    match count is ``sum_g min(cand_count[g], max_ref ref_count[g, ref])``.
+    """
+    matches = np.zeros(n_gram, dtype=np.float64)
+    total = ids.shape[0]
+    for n in range(1, n_gram + 1):
+        n_windows = total - n + 1
+        if n_windows <= 0:
+            continue
+        starts = np.arange(n_windows)
+        inside = sent_serial[starts] == sent_serial[starts + n - 1]
+        starts = starts[inside]
+        if starts.size == 0:
+            continue
+        rows = np.empty((starts.size, n + 1), dtype=np.int64)
+        rows[:, 0] = pair_idx[starts]
+        for k in range(n):
+            rows[:, k + 1] = ids[starts + k]
+        _, group = np.unique(rows, axis=0, return_inverse=True)
+        group = group.reshape(-1)
+        n_groups = int(group.max()) + 1
+
+        from_cand = ref_local[starts] < 0
+        cand_counts = np.bincount(group[from_cand], minlength=n_groups)
+
+        ref_groups = group[~from_cand]
+        ref_ids = ref_local[starts][~from_cand]
+        # Per-(group, reference) counts (sparse — only populated pairs),
+        # then the per-group max across references: the multi-reference
+        # clip ceiling.
+        pair_keys, pair_counts = np.unique(
+            ref_groups * max_refs + ref_ids, return_counts=True
+        )
+        ref_ceiling = np.zeros(n_groups, dtype=np.int64)
+        np.maximum.at(ref_ceiling, pair_keys // max_refs, pair_counts)
+
+        matches[n - 1] = np.minimum(cand_counts, ref_ceiling).sum()
+    return matches
 
 
 def _bleu_score_update(
@@ -46,38 +137,31 @@ def _bleu_score_update(
             f"corpus size = {len(input_)}, target corpus size = {len(target_)} "
         )
 
-    input_len = 0.0
-    target_len = 0.0
-    matches_by_order = np.zeros(n_gram, dtype=np.float64)
-    possible_matches_by_order = np.zeros(n_gram, dtype=np.float64)
+    cand_tok = [c.split() for c in input_]
+    ref_tok = [[r.split() for r in refs] for refs in target_]
 
-    for candidate, references in zip(input_, target_):
-        candidate_tokenized = candidate.split()
-        references_tokenized = [ref.split() for ref in references]
+    cand_lens = np.asarray([len(t) for t in cand_tok], dtype=np.int64)
+    ref_min_lens = np.asarray(
+        [min(len(r) for r in refs) for refs in ref_tok], dtype=np.int64
+    )
+    input_len = float(cand_lens.sum())
+    target_len = float(ref_min_lens.sum())
 
-        len_candidate = len(candidate_tokenized)
-        len_reference = min(len(ref) for ref in references_tokenized)
-        input_len += len_candidate
-        target_len += len_reference
-
-        candidate_ngram_counter = _get_ngrams(candidate_tokenized, n_gram)
-        reference_ngram_counter: Counter = Counter()
-        for ref in references_tokenized:
-            reference_ngram_counter |= _get_ngrams(ref, n_gram)
-        overlap = candidate_ngram_counter & reference_ngram_counter
-
-        for ngram in overlap:
-            matches_by_order[len(ngram) - 1] += overlap[ngram]
-
-        for i in range(n_gram):
-            if len_candidate - i > 0:
-                possible_matches_by_order[i] += len_candidate - i
-
-    if np.min(possible_matches_by_order) == 0:
+    orders = np.arange(n_gram, dtype=np.int64)
+    possible_matches_by_order = (
+        np.maximum(cand_lens[:, None] - orders[None, :], 0)
+        .sum(axis=0)
+        .astype(np.float64)
+    )
+    if possible_matches_by_order.size == 0 or possible_matches_by_order.min() == 0:
         raise ValueError(
             "the input is too short to find all n-gram matches with "
             f"n_gram={n_gram}"
         )
+
+    matches_by_order = _clipped_matches_per_order(
+        *_encode_corpus(cand_tok, ref_tok), n_gram
+    )
 
     return input_len, target_len, matches_by_order, possible_matches_by_order
 
