@@ -645,6 +645,61 @@ def _run_ref_child(refname, timeout):
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+class RelayProber:
+    """Fights for the TPU with a bounded, auditable retry schedule.
+
+    VERDICT r2: a single up-front probe let one relay blip push the whole
+    round to CPU. This prober (a) retries with backoff at run start, (b)
+    re-probes between configs so a mid-run relay revival is caught, and
+    (c) records every attempt (timestamp, timeout, outcome) in the output
+    JSON so a CPU fallback is auditable rather than asserted.
+    """
+
+    def __init__(self, budget_s: float, t0: float):
+        self.budget_s = budget_s
+        self.t0 = t0
+        self.spent = 0.0
+        self.attempts = []
+        self.platform = "cpu"
+
+    def _one_probe(self, timeout: float) -> bool:
+        start = time.monotonic()
+        rec = {
+            "t_s": round(start - self.t0, 1),
+            "timeout_s": timeout,
+            "ok": False,
+        }
+        try:
+            res = _run_child("probe", "tpu", timeout=timeout)
+            rec["ok"] = res.get("backend") not in (None, "cpu")
+            rec["backend"] = res.get("backend")
+        except Exception as e:  # noqa: BLE001
+            rec["error"] = str(e)[-200:]
+        self.spent += time.monotonic() - start
+        self.attempts.append(rec)
+        print(f"# tpu probe: {rec}", file=sys.stderr)
+        return rec["ok"]
+
+    def initial(self) -> str:
+        # first TPU compile is ~20-40s; 120s covers it while keeping the
+        # dead time bounded when the relay is hung
+        for timeout in (120.0, 60.0):
+            if self.spent >= self.budget_s:
+                break
+            if self._one_probe(min(timeout, self.budget_s - self.spent)):
+                self.platform = "tpu"
+                break
+        return self.platform
+
+    def recheck(self) -> str:
+        """Between configs: one more bounded attempt while budget remains."""
+        if self.platform == "tpu" or self.spent >= self.budget_s:
+            return self.platform
+        if self._one_probe(min(45.0, self.budget_s - self.spent)):
+            self.platform = "tpu"
+        return self.platform
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", help="run one config in-process (ours)")
@@ -655,6 +710,11 @@ def main():
         help="soft wall-clock budget: once half is spent, remaining configs "
         "skip their TPU attempt (a mid-run relay stall costs a 420 s child "
         "timeout per config; the budget bounds the worst case)",
+    )
+    ap.add_argument(
+        "--probe-budget-s", type=float, default=150.0,
+        help="total wall-clock allowed for TPU relay probes (initial "
+        "backoff + between-config rechecks)",
     )
     args = ap.parse_args()
 
@@ -670,23 +730,15 @@ def main():
     t0 = time.monotonic()
     names = list(CONFIGS) if not args.only else args.only.split(",")
 
-    platform = "cpu"
-    for attempt in range(2):  # probe TPU, retry once
-        try:
-            # first TPU compile is ~20-40s; 120s covers it while keeping the
-            # dead time bounded when the relay is hung
-            res = _run_child("probe", "tpu", timeout=120)
-            platform = "tpu" if res.get("backend") not in (None, "cpu") else "cpu"
-            break
-        except Exception as e:  # noqa: BLE001
-            print(f"# tpu probe attempt {attempt + 1} failed: {e}",
-                  file=sys.stderr)
+    prober = RelayProber(args.probe_budget_s, t0)
+    platform = prober.initial()
     print(f"# platform: {platform}", file=sys.stderr)
 
     configs_out = {}
     budget_hit = False
     for name in names:
         _, refname = CONFIGS[name]
+        platform = prober.recheck()
         # sync_overhead needs a multi-device mesh: with one real TPU chip the
         # virtual 8-device CPU platform is the honest measurement.
         plat = "cpu" if name == "sync_overhead" else platform
@@ -744,6 +796,10 @@ def main():
     head = configs_out.get("accuracy_update") or next(
         (v for v in configs_out.values() if "value" in v), {}
     )
+    # the headline platform is the platform the HEADLINE NUMBER ran on —
+    # a mid-run relay revival must not relabel configs that already fell
+    # back to CPU (each configs_out entry carries its own platform)
+    platform = head.get("platform", prober.platform)
     out = {
         "metric": head.get(
             "metric", "MulticlassAccuracy jitted update throughput"
@@ -753,12 +809,19 @@ def main():
         "vs_baseline": head.get("vs_baseline"),
         "platform": platform,
         "wall_s": round(time.monotonic() - t0, 1),
+        "relay_attempts": prober.attempts,
+        "relay_probe_spent_s": round(prober.spent, 1),
         "configs": configs_out,
     }
-    if platform == "cpu":
+    fell_back = [
+        n for n, e in configs_out.items()
+        if e.get("platform") == "cpu" and n != "sync_overhead"
+    ]
+    if fell_back:
         out["note"] = (
-            "TPU not available for this run; previously captured "
-            "single-chip TPU numbers are committed in docs/benchmarks.md"
+            f"configs {fell_back} ran on cpu (relay probe schedule in "
+            "relay_attempts); previously captured single-chip TPU numbers "
+            "are committed in docs/benchmarks.md"
         )
     print(json.dumps(out))
 

@@ -109,6 +109,62 @@ def main() -> None:
     auroc.update(jnp.asarray(xb), jnp.asarray(tb))
     results["auroc"] = float(sync_and_compute(auroc, group))
 
+    # --- MAX / MIN scalar states --------------------------------------------
+    from torcheval_tpu.metrics import Max, Min
+
+    m_max, m_min = Max(), Min()
+    # values chosen so neither extremum lives on rank 0
+    m_max.update(jnp.asarray(float((rank * 7) % (nproc + 2))))
+    m_min.update(jnp.asarray(float(-((rank * 7) % (nproc + 2)))))
+    results["max"] = float(sync_and_compute(m_max, group))
+    results["min"] = float(sync_and_compute(m_min, group))
+
+    # --- binned counter states (fixed-bin SUM vectors) ----------------------
+    from torcheval_tpu.metrics import BinaryBinnedAUPRC
+
+    rng_bin = np.random.default_rng(200 + rank)
+    n_bin = 40 + 10 * rank
+    binned = BinaryBinnedAUPRC(threshold=7)
+    binned.update(
+        jnp.asarray(rng_bin.random(n_bin).astype(np.float32)),
+        jnp.asarray((rng_bin.random(n_bin) < 0.4).astype(np.float32)),
+    )
+    results["binned_auprc"] = float(sync_and_compute(binned, group))
+
+    # --- multi-query CUSTOM list-of-lists (RetrievalPrecision) --------------
+    # rank r contributes to queries r%3 and (r+1)%3 only, so per-query lists
+    # are ragged across ranks and some queries are missing on some ranks
+    from torcheval_tpu.metrics import RetrievalPrecision
+
+    rp = RetrievalPrecision(k=2, num_queries=3, empty_target_action="neg")
+    rng_rp = np.random.default_rng(300 + rank)
+    n_rp = 6 + 2 * rank
+    scores = rng_rp.random(n_rp).astype(np.float32)
+    labels = (rng_rp.random(n_rp) < 0.5).astype(np.float32)
+    indexes = np.where(
+        np.arange(n_rp) % 2 == 0, rank % 3, (rank + 1) % 3
+    )
+    rp.update(jnp.asarray(scores), jnp.asarray(labels), indexes=indexes)
+    results["retrieval_precision"] = [
+        float(v) for v in sync_and_compute(rp, group)
+    ]
+
+    # --- per-task vector SUM states (NormalizedEntropy, num_tasks=2) --------
+    from torcheval_tpu.metrics import BinaryNormalizedEntropy
+
+    ne = BinaryNormalizedEntropy(num_tasks=2)
+    rng_ne = np.random.default_rng(400 + rank)
+    n_ne = 16 + 8 * rank
+    ne.update(
+        jnp.asarray(
+            rng_ne.uniform(0.01, 0.99, size=(2, n_ne)).astype(np.float32)
+        ),
+        jnp.asarray((rng_ne.random((2, n_ne)) < 0.5).astype(np.float32)),
+    )
+    results["normalized_entropy"] = [
+        float(v) for v in sync_and_compute(ne, group)
+    ]
+
     # --- windowed metric (ring buffer + CUSTOM window-concat merge) ----------
     # rank r performs 2r+3 updates against a window of 4: rank 0 stays
     # partially filled, rank 1+ wraps (evicting oldest entries), so the

@@ -6,6 +6,12 @@ path — ``multihost_utils.process_allgather`` over the collective backend —
 with asymmetric per-rank states. This is the JAX analogue of the reference's
 spawned-gloo-worker strategy (reference
 utils/test_utils/metric_class_tester.py:292-341, tests/metrics/test_synclib.py).
+
+``test_merge_archetype`` is the VERDICT-r2 matrix: every state/merge
+archetype the library uses crosses a real process boundary (wire protocol:
+pickle framing, padded ragged gathers, dtype preservation, key ordering),
+named per archetype × nproc ∈ {2, 4}. One spawn per nproc is shared by the
+whole matrix — each worker computes all legs in one distributed job.
 """
 
 from __future__ import annotations
@@ -30,64 +36,56 @@ def parse_result_lines(outputs):
     return results
 
 
-def _spawn_workers(nproc: int, timeout: float = 300.0):
-    """Run the worker on nproc processes via the launcher (the library's own
-    multi-process path); return per-rank RESULT dicts."""
-    from torcheval_tpu.launcher import launch
-
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    outputs = launch(WORKER, nproc=nproc, timeout=timeout, env=env)
-    return parse_result_lines(outputs)
+_CACHE = {}
 
 
-@pytest.mark.parametrize("nproc", [2, 4])
-def test_multihost_sync(nproc):
-    results = _spawn_workers(nproc)
+def _results_for(nproc: int):
+    """Spawn the worker matrix once per nproc; every test shares the run."""
+    if nproc not in _CACHE:
+        from torcheval_tpu.launcher import launch
 
-    # every rank must agree bit-for-bit on the synced values
-    for r in range(1, nproc):
-        assert results[r] == results[0], (
-            f"rank {r} disagrees with rank 0:\n{results[r]}\nvs\n{results[0]}"
-        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        outputs = launch(WORKER, nproc=nproc, timeout=300.0, env=env)
+        _CACHE[nproc] = parse_result_lines(outputs)
+    return _CACHE[nproc]
 
-    res = results[0]
 
-    assert res["allgather_array"] == [[r, r + 1] for r in range(nproc)]
-    assert res["allgather_object_ok"]
+# --------------------------------------------------------------------------
+# archetype oracles: replay every rank's updates into ONE in-process metric;
+# the spawned result must match (update/merge order is immaterial for every
+# archetype here)
+# --------------------------------------------------------------------------
 
-    # tensor state: sum over ranks of (rank+1)
-    assert res["sum"] == sum(r + 1 for r in range(nproc))
 
-    # list state with rank-0 empty: sum over ranks of sum(1..rank)
-    assert res["list_sum"] == sum(
-        i + 1 for r in range(nproc) for i in range(r)
-    )
+def _oracle_sum(nproc):
+    return float(sum(r + 1 for r in range(nproc)))
 
-    # dict state: disjoint per-rank keys + one shared summed key
-    expected_dict = {f"k{r}": 1.0 for r in range(nproc)}
-    expected_dict["shared"] = float(sum(range(nproc)))
-    assert res["dict"] == expected_dict
 
-    # float states, slowest-rank merge: sum(10*(r+1)) / max(r+1)
-    assert res["throughput"] == pytest.approx(
-        sum(10 * (r + 1) for r in range(nproc)) / nproc
-    )
+def _oracle_list_extend(nproc):
+    return float(sum(i + 1 for r in range(nproc) for i in range(r)))
 
-    # collection exchange: accuracy over the concatenation of all ranks' data
-    correct = total = 0
-    for r in range(nproc):
-        rng = np.random.default_rng(r)
-        x = rng.uniform(size=(32, 5)).astype(np.float32)
-        t = rng.integers(0, 5, size=(32,))
-        correct += int(np.sum(np.argmax(x, axis=1) == t))
-        total += 32
-    assert res["coll_acc"] == pytest.approx(correct / total)
-    assert res["coll_sum"] == float(sum(range(nproc)))
 
-    assert res["synced_state_dict_sum"] == res["sum"]
+def _oracle_dict_disjoint(nproc):
+    d = {f"k{r}": 1.0 for r in range(nproc)}
+    d["shared"] = float(sum(range(nproc)))
+    return d
 
-    # buffered AUROC with ragged per-rank sample counts == pooled oracle
+
+def _oracle_max(nproc):
+    return float(max((r * 7) % (nproc + 2) for r in range(nproc)))
+
+
+def _oracle_min(nproc):
+    return float(min(-((r * 7) % (nproc + 2)) for r in range(nproc)))
+
+
+def _oracle_throughput_float_max(nproc):
+    # SUM(processed) / MAX(elapsed): the slowest rank bounds the pod
+    return sum(10 * (r + 1) for r in range(nproc)) / nproc
+
+
+def _oracle_buffered_auroc_extend(nproc):
     import sklearn.metrics as skm
 
     xs, ts = [], []
@@ -96,13 +94,60 @@ def test_multihost_sync(nproc):
         n_r = 60 * r + 5
         xs.append(rngb.random(n_r).astype(np.float32))
         ts.append((rngb.random(n_r) < 0.5).astype(np.float32))
-    expected = skm.roc_auc_score(np.concatenate(ts), np.concatenate(xs))
-    assert res["auroc"] == pytest.approx(expected, abs=1e-5)
+    return float(skm.roc_auc_score(np.concatenate(ts), np.concatenate(xs)))
 
-    # windowed MSE merge semantics == the reference's window-concat merge
-    # (reference window/mean_squared_error.py via merge_state), replayed on
-    # the reference metrics themselves
+
+def _oracle_binned_counters(nproc):
+    import jax.numpy as jnp
+
+    from torcheval_tpu.metrics import BinaryBinnedAUPRC
+
+    m = BinaryBinnedAUPRC(threshold=7)
+    for r in range(nproc):
+        rng = np.random.default_rng(200 + r)
+        n = 40 + 10 * r
+        m.update(
+            jnp.asarray(rng.random(n).astype(np.float32)),
+            jnp.asarray((rng.random(n) < 0.4).astype(np.float32)),
+        )
+    return float(m.compute())
+
+
+def _oracle_retrieval_multiquery(nproc):
+    import jax.numpy as jnp
+
+    from torcheval_tpu.metrics import RetrievalPrecision
+
+    m = RetrievalPrecision(k=2, num_queries=3, empty_target_action="neg")
+    for r in range(nproc):
+        rng = np.random.default_rng(300 + r)
+        n = 6 + 2 * r
+        scores = rng.random(n).astype(np.float32)
+        labels = (rng.random(n) < 0.5).astype(np.float32)
+        indexes = np.where(np.arange(n) % 2 == 0, r % 3, (r + 1) % 3)
+        m.update(jnp.asarray(scores), jnp.asarray(labels), indexes=indexes)
+    return [float(v) for v in m.compute()]
+
+
+def _oracle_ne_per_task(nproc):
+    import jax.numpy as jnp
+
+    from torcheval_tpu.metrics import BinaryNormalizedEntropy
+
+    m = BinaryNormalizedEntropy(num_tasks=2)
+    for r in range(nproc):
+        rng = np.random.default_rng(400 + r)
+        n = 16 + 8 * r
+        m.update(
+            jnp.asarray(rng.uniform(0.01, 0.99, size=(2, n)).astype(np.float32)),
+            jnp.asarray((rng.random((2, n)) < 0.5).astype(np.float32)),
+        )
+    return [float(v) for v in m.compute()]
+
+
+def _oracle_window_custom(nproc):
     import torch
+
     from tests.ref_oracle import load_reference_metrics
 
     REF_M, _ = load_reference_metrics()
@@ -117,6 +162,78 @@ def test_multihost_sync(nproc):
         replicas.append(m)
     merged = replicas[0]
     merged.merge_state(replicas[1:])
-    exp_life, exp_win = merged.compute()
-    assert res["wmse_lifetime"] == pytest.approx(float(exp_life), rel=1e-5)
-    assert res["wmse_windowed"] == pytest.approx(float(exp_win), rel=1e-5)
+    life, win = merged.compute()
+    return [float(life), float(win)]
+
+
+# archetype -> (worker result key(s), oracle)
+ARCHETYPES = {
+    "scalar_sum": (("sum",), _oracle_sum),
+    "scalar_max": (("max",), _oracle_max),
+    "scalar_min": (("min",), _oracle_min),
+    "list_extend_with_empty_rank": (("list_sum",), _oracle_list_extend),
+    "dict_disjoint_keys": (("dict",), _oracle_dict_disjoint),
+    "throughput_float_max": (("throughput",), _oracle_throughput_float_max),
+    "buffered_extend_ragged": (("auroc",), _oracle_buffered_auroc_extend),
+    "binned_sum_counters": (("binned_auprc",), _oracle_binned_counters),
+    "retrieval_multiquery_custom": (
+        ("retrieval_precision",), _oracle_retrieval_multiquery
+    ),
+    "per_task_vector_sum": (("normalized_entropy",), _oracle_ne_per_task),
+    "window_ring_custom": (
+        ("wmse_lifetime", "wmse_windowed"), _oracle_window_custom
+    ),
+}
+
+
+@pytest.mark.parametrize("nproc", [2, 4])
+@pytest.mark.parametrize("archetype", sorted(ARCHETYPES))
+def test_merge_archetype(archetype, nproc):
+    """Every merge archetype must survive the real spawned-process wire."""
+    results = _results_for(nproc)
+    keys, oracle = ARCHETYPES[archetype]
+    expected = oracle(nproc)
+    got = [results[0][k] for k in keys]
+    if len(keys) == 1:
+        got = got[0]
+    else:
+        got = [g for g in got]
+        expected = list(expected)
+    # every rank must agree bit-for-bit before comparing to the oracle
+    for r in range(1, nproc):
+        for k in keys:
+            assert results[r][k] == results[0][k], (
+                f"rank {r} disagrees on {archetype}/{k}"
+            )
+    if isinstance(expected, dict):
+        assert got == expected
+    else:
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_multihost_sync(nproc):
+    """Raw collective legs + batched collection exchange + synced dicts."""
+    results = _results_for(nproc)
+
+    # every rank must agree bit-for-bit on every synced value
+    for r in range(1, nproc):
+        assert results[r] == results[0], (
+            f"rank {r} disagrees with rank 0:\n{results[r]}\nvs\n{results[0]}"
+        )
+
+    res = results[0]
+    assert res["allgather_array"] == [[r, r + 1] for r in range(nproc)]
+    assert res["allgather_object_ok"]
+
+    # collection exchange: accuracy over the concatenation of all ranks' data
+    correct = total = 0
+    for r in range(nproc):
+        rng = np.random.default_rng(r)
+        x = rng.uniform(size=(32, 5)).astype(np.float32)
+        t = rng.integers(0, 5, size=(32,))
+        correct += int(np.sum(np.argmax(x, axis=1) == t))
+        total += 32
+    assert res["coll_acc"] == pytest.approx(correct / total)
+    assert res["coll_sum"] == float(sum(range(nproc)))
+    assert res["synced_state_dict_sum"] == res["sum"]

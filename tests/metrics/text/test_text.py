@@ -274,3 +274,71 @@ def test_edit_distance_matches_reference_dp():
         a = [vocab[i] for i in RNG.integers(0, len(vocab), RNG.integers(0, 12))]
         b = [vocab[i] for i in RNG.integers(0, len(vocab), RNG.integers(0, 12))]
         assert _edit_distance(a, b) == ref_dp(a, b), (a, b)
+
+
+class TestPerplexityNativeKernel:
+    """The CPU-native fused NLL kernel must be bit-compatible in semantics
+    with the pure-XLA kernel: same clip gather, same non-finite results.
+
+    The fast-math build drops NaNs from its vectorized max/clamp blends, so
+    these cases guard the kernel's explicit integer-domain RowScan; if a
+    compiler change ever folds it away, this fails loudly.
+    """
+
+    def _paths(self):
+        from torcheval_tpu.metrics.functional.text.perplexity import (
+            _perplexity_update,
+            _perplexity_update_jit,
+        )
+        from torcheval_tpu.ops import native
+
+        if not native.ensure_registered():
+            pytest.skip("native toolchain unavailable")
+        return _perplexity_update, _perplexity_update_jit
+
+    def _assert_same(self, L, T, ignore_index=None):
+        native_fn, xla_fn = self._paths()
+        a = native_fn(L, T, ignore_index)
+        b = xla_fn(jnp.asarray(L), jnp.asarray(T), ignore_index)
+        nll_a, nll_b = float(a[0]), float(b[0])
+        assert int(a[1]) == int(b[1])
+        if np.isnan(nll_b) or np.isinf(nll_b):
+            assert str(nll_a) == str(nll_b), (nll_a, nll_b)
+        else:
+            np.testing.assert_allclose(nll_a, nll_b, rtol=1e-5)
+
+    def _data(self):
+        rng = np.random.default_rng(29)
+        L = jnp.asarray(rng.normal(size=(3, 17, 257)).astype(np.float32))
+        T = jnp.asarray(rng.integers(0, 257, size=(3, 17)))
+        return L, T
+
+    def test_in_range_and_ignore(self):
+        L, T = self._data()
+        self._assert_same(L, T)
+        self._assert_same(L, T, ignore_index=int(T[0, 0]))
+
+    def test_out_of_range_targets_clip_like_xla(self):
+        L, T = self._data()
+        for bad in (9999, -5, -99999):
+            self._assert_same(L, T.at[1, 3].set(bad))
+
+    def test_non_finite_logits_match_xla(self):
+        L, T = self._data()
+        self._assert_same(L.at[0, 0, 0].set(jnp.nan), T)
+        self._assert_same(L.at[0, 0, 0].set(jnp.inf), T)
+        self._assert_same(L.at[0, 0, :].set(-jnp.inf), T)
+        self._assert_same(L.at[0, 0, 0].set(-jnp.inf), T)
+        self._assert_same(L.at[0, 0, int(T[0, 0])].set(-jnp.inf), T)
+        # NaN in an ignored row must NOT poison the total
+        self._assert_same(
+            L.at[0, 0, 0].set(jnp.nan),
+            T.at[0, 0].set(42),
+            ignore_index=42,
+        )
+
+    def test_large_batch_value(self):
+        rng = np.random.default_rng(5)
+        L = jnp.asarray(rng.normal(size=(4, 64, 2048)).astype(np.float32))
+        T = jnp.asarray(rng.integers(0, 2048, size=(4, 64)))
+        self._assert_same(L, T)

@@ -31,8 +31,12 @@ def _perplexity_update_jit(
 ) -> Tuple[jax.Array, jax.Array]:
     log_probs = jax.nn.log_softmax(input.reshape(-1, input.shape[-1]), axis=-1)
     flat_target = target.reshape(-1)
+    # mode="clip" pins out-of-range behavior (invalid targets are caught by
+    # debug_validation; with it off, every backend — XLA TPU/CPU and the
+    # native CPU kernel — must agree rather than inherit gather's
+    # platform-defined default)
     token_log_probs = jnp.take_along_axis(
-        log_probs, flat_target[:, None], axis=-1
+        log_probs, flat_target[:, None], axis=-1, mode="clip"
     ).squeeze(-1)
     if ignore_index is not None:
         keep = flat_target != ignore_index
@@ -41,6 +45,40 @@ def _perplexity_update_jit(
     else:
         num_total = jnp.int32(flat_target.shape[0])
     return -jnp.sum(token_log_probs), num_total
+
+
+@partial(jax.jit, static_argnames=("ignore_index",))
+def _perplexity_update_native_jit(
+    input: jax.Array,
+    target: jax.Array,
+    ignore_index: Optional[int],
+) -> Tuple[jax.Array, jax.Array]:
+    call = jax.ffi.ffi_call(
+        "torcheval_ce_nll",
+        (
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        ),
+    )
+    nll, count = call(
+        input.reshape(-1, input.shape[-1]),
+        target.reshape(-1).astype(jnp.int32),
+        ignore_index=int(ignore_index if ignore_index is not None else 0),
+        has_ignore=int(ignore_index is not None),
+    )
+    return nll, count
+
+
+def _use_native_ce(input: jax.Array) -> bool:
+    try:
+        platform = input.devices().pop().platform
+    except Exception:  # tracer inside jit: use the pure-XLA kernel
+        return False
+    if platform != "cpu":
+        return False
+    from torcheval_tpu.ops import native
+
+    return native.ensure_registered()
 
 
 def _perplexity_update(
@@ -52,6 +90,8 @@ def _perplexity_update(
     input = to_jax_float(input)
     target = to_jax(target)
     _perplexity_input_check(input, target, ignore_index)
+    if input.dtype == jnp.float32 and _use_native_ce(input):
+        return _perplexity_update_native_jit(input, target, ignore_index)
     return _perplexity_update_jit(input, target, ignore_index)
 
 

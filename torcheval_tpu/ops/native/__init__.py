@@ -1,16 +1,26 @@
 """Native (C++) op library: build-on-first-use loader.
 
-Compiles ``fused_auc.cc`` against the XLA FFI headers shipped with jaxlib
-(``jax.ffi.include_dir()``) into a shared library cached next to the source,
-and registers the handlers with XLA's CPU backend. The loader degrades
-gracefully: if no C++ toolchain is available, callers fall back to the pure
-XLA implementation (mirroring the reference's optional fbgemm_gpu import
-guard, reference functional/classification/auroc.py:12-21).
+Compiles every ``.cc`` in this directory against the XLA FFI headers shipped
+with jaxlib (``jax.ffi.include_dir()``) into one shared library cached next
+to the sources, and registers the handlers with XLA's CPU backend. The
+loader degrades gracefully: if no C++ toolchain is available, callers fall
+back to the pure XLA implementations (mirroring the reference's optional
+fbgemm_gpu import guard, reference functional/classification/auroc.py:12-21).
+
+The cached library is only trusted when a sidecar fingerprint matches: the
+build uses ``-march=native``, so a library built on one microarchitecture
+(e.g. baked into a container image on an AVX-512 host) must be rebuilt
+rather than loaded on a different CPU, and a library from an older package
+version missing a newer handler symbol must be rebuilt rather than
+disabling every native target.
 """
 
 from __future__ import annotations
 
 import ctypes
+import glob
+import hashlib
+import json
 import logging
 import os
 import subprocess
@@ -19,39 +29,108 @@ from typing import Optional
 
 _logger = logging.getLogger(__name__)
 
-_SRC = os.path.join(os.path.dirname(__file__), "fused_auc.cc")
-_LIB = os.path.join(os.path.dirname(__file__), "libtorcheval_tpu_native.so")
+_DIR = os.path.dirname(__file__)
+_LIB = os.path.join(_DIR, "libtorcheval_tpu_native.so")
+_SIDECAR = _LIB + ".buildinfo"
+
+# exported symbol -> XLA FFI target name; every handler registers on CPU
+_TARGETS = {
+    "FusedAucHistogram": "torcheval_fused_auc_histogram",
+    "CrossEntropyNll": "torcheval_ce_nll",
+}
+
+# per-file extra compile flags; ``cross_entropy.cc``'s reductions only
+# reach SIMD width when the compiler may reassociate float sums
+# (-fno-finite-math-only instead blocks the max reduction). NaN/Inf logits
+# still propagate to a NaN result at runtime — NaN survives the exp
+# polynomial and poisons the sum — matching the pure-XLA path; pinned by
+# tests/metrics/text's non-finite parity test against a fast-math compiler
+# ever folding it away.
+_EXTRA_FLAGS = {
+    "cross_entropy.cc": ["-ffast-math", "-march=native"],
+}
 
 _lock = threading.Lock()
 _registered: Optional[bool] = None
 
 
+def _sources():
+    return sorted(glob.glob(os.path.join(_DIR, "*.cc")))
+
+
+def _cpu_fingerprint() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
+def _expected_buildinfo() -> dict:
+    return {
+        "cpu": _cpu_fingerprint(),
+        "symbols": sorted(_TARGETS),
+        "sources": {
+            os.path.basename(s): hashlib.sha256(
+                open(s, "rb").read()
+            ).hexdigest()[:16]
+            for s in _sources()
+        },
+        "flags": _EXTRA_FLAGS,
+    }
+
+
+def _cache_valid() -> bool:
+    if not os.path.exists(_LIB):
+        return False
+    try:
+        with open(_SIDECAR) as f:
+            return json.load(f) == _expected_buildinfo()
+    except (OSError, ValueError):
+        return False
+
+
 def _build() -> bool:
     import jax.ffi
 
-    cmd = [
-        "g++",
-        "-O3",
-        "-shared",
-        "-fPIC",
-        "-std=c++17",
-        f"-I{jax.ffi.include_dir()}",
-        _SRC,
-        "-o",
-        _LIB,
-    ]
+    include = f"-I{jax.ffi.include_dir()}"
+    objs = []
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        for src in _sources():
+            obj = src[:-3] + ".o"
+            cmd = [
+                "g++", "-O3", "-c", "-fPIC", "-std=c++17", include,
+                *_EXTRA_FLAGS.get(os.path.basename(src), []),
+                src, "-o", obj,
+            ]
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+            objs.append(obj)
+        subprocess.run(
+            ["g++", "-shared", *objs, "-o", _LIB],
+            check=True, capture_output=True, timeout=300,
+        )
+        with open(_SIDECAR, "w") as f:
+            json.dump(_expected_buildinfo(), f)
         return True
     except Exception as e:  # missing toolchain / headers: degrade
-        _logger.info("native fused_auc build skipped: %s", e)
+        _logger.info("native op build skipped: %s", e)
         return False
+    finally:
+        for obj in objs:
+            try:
+                os.unlink(obj)
+            except OSError:
+                pass
 
 
 def ensure_registered() -> bool:
     """Build (if needed) and register the native handlers with XLA CPU.
-    Returns True when the ``torcheval_fused_auc_histogram`` FFI target is
-    usable."""
+    Returns True when the FFI targets are usable."""
     global _registered
     with _lock:
         if _registered is not None:
@@ -59,20 +138,18 @@ def ensure_registered() -> bool:
         try:
             import jax.ffi
 
-            if not os.path.exists(_LIB) or os.path.getmtime(
-                _LIB
-            ) < os.path.getmtime(_SRC):
-                if not _build():
-                    _registered = False
-                    return False
+            if not _cache_valid() and not _build():
+                _registered = False
+                return False
             lib = ctypes.cdll.LoadLibrary(_LIB)
-            jax.ffi.register_ffi_target(
-                "torcheval_fused_auc_histogram",
-                jax.ffi.pycapsule(lib.FusedAucHistogram),
-                platform="cpu",
-            )
+            for symbol, target in _TARGETS.items():
+                jax.ffi.register_ffi_target(
+                    target,
+                    jax.ffi.pycapsule(getattr(lib, symbol)),
+                    platform="cpu",
+                )
             _registered = True
         except Exception as e:
-            _logger.info("native fused_auc registration skipped: %s", e)
+            _logger.info("native op registration skipped: %s", e)
             _registered = False
         return _registered

@@ -10,6 +10,7 @@ semantics at the reference's API boundary.
 
 from __future__ import annotations
 
+import math
 from functools import lru_cache
 from typing import Any, Optional, Sequence, Union
 
@@ -77,6 +78,10 @@ def to_jax_float(
 
 
 @lru_cache(maxsize=512)
+def _cached_scalar_impl(value: float, dtype) -> jax.Array:
+    return jnp.asarray(value, dtype=dtype)
+
+
 def cached_scalar(value: float, dtype=jnp.float32) -> jax.Array:
     """A device-resident scalar, cached per (value, dtype).
 
@@ -85,8 +90,14 @@ def cached_scalar(value: float, dtype=jnp.float32) -> jax.Array:
     (tunnel-amplified on remote TPUs). Real workloads use a handful of
     distinct scalar weights/params, so a small cache removes the transfer
     entirely after first use.
+
+    NaN fills normalize to one canonical NaN before keying the cache:
+    ``NaN != NaN``, so every lookup would otherwise miss, grow a new entry,
+    and eventually evict genuinely hot scalars like the 1.0 default weight.
     """
-    return jnp.asarray(value, dtype=dtype)
+    if isinstance(value, float) and math.isnan(value):
+        value = math.nan
+    return _cached_scalar_impl(value, dtype)
 
 
 @lru_cache(maxsize=1024)

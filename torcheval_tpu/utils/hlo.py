@@ -8,21 +8,36 @@ into the step's existing reduction.
 
 from __future__ import annotations
 
-# Synchronous opcodes and their async -start forms (TPU/GPU lowerings emit
-# start/done pairs; counting -done too would double-count an op).
+import re
+
+# Cross-replica collective opcodes. Async lowerings emit -start/-done pairs;
+# only the -start form is counted so a pair counts once.
 COLLECTIVE_OPS = (
     "all-reduce",
     "all-gather",
+    "collective-broadcast",
     "collective-permute",
     "all-to-all",
+    "ragged-all-to-all",
     "reduce-scatter",
+)
+
+# Matches the HLO instruction form `%name = <shape> <op>(`, where <shape>
+# may be a bare array shape or a parenthesized tuple (async collectives).
+# Tuple element layouts may themselves contain parens — TPU tiled layouts
+# print as e.g. `(f32[8,128]{1,0:T(8,128)}, ...)` — so the tuple branch
+# allows one level of nesting. Anchoring on the `= shape op(` structure
+# keeps the count robust to the opcode appearing in metadata, comments, or
+# operand names, and the leading whitespace requirement stops `all-to-all`
+# from also counting every `ragged-all-to-all`.
+_INSTR = re.compile(
+    r"=\s+(?:\((?:[^()]|\([^()]*\))*\)|\S+)\s+({ops})(?:-start)?\(".format(
+        ops="|".join(re.escape(op) for op in COLLECTIVE_OPS)
+    )
 )
 
 
 def collective_count(compiled) -> int:
     """Number of collective ops in a ``jax.stages.Compiled``'s optimized HLO."""
     hlo = compiled.as_text()
-    return sum(
-        hlo.count(f"{op}(") + hlo.count(f"{op}-start(")
-        for op in COLLECTIVE_OPS
-    )
+    return sum(1 for _ in _INSTR.finditer(hlo))
