@@ -1,0 +1,104 @@
+"""Native radix argsort vs XLA: bit-exact order parity.
+
+The curve metrics' CPU lowering swaps ``jnp.argsort(-x, stable=True)`` for
+the FFI radix sort (``ops/native/sort_desc.cc``); these tests pin the exact
+comparator semantics — stability under ties, NaN-last, and XLA CPU's
+flush-to-zero tie class for subnormals/±0.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+    _sort_desc_xla,
+    sort_desc,
+)
+
+
+@pytest.fixture(autouse=True)
+def _require_native():
+    from torcheval_tpu.ops import native
+
+    if not native.ensure_registered():
+        pytest.skip("native toolchain unavailable")
+
+
+def _assert_matches_xla(x):
+    jx = jnp.asarray(x)
+    s_n, o_n = jax.jit(sort_desc)(jx)
+    s_x, o_x = _sort_desc_xla(jx)
+    np.testing.assert_array_equal(np.asarray(o_n), np.asarray(o_x))
+    np.testing.assert_array_equal(
+        np.asarray(s_n), np.asarray(s_x), strict=True
+    )
+
+
+def test_ties_stable():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(size=501).astype(np.float32)
+    x[::3] = x[0]
+    x[1::7] = x[1]
+    _assert_matches_xla(x)
+
+
+def test_special_values_order():
+    _assert_matches_xla(
+        np.array(
+            [0.5, np.nan, -np.inf, np.inf, 0.5, -np.nan, 0.0, 1e-38,
+             -1e-38, -0.0, -1.5, 3e38, -3e38],
+            dtype=np.float32,
+        )
+    )
+
+
+def test_batched_and_vmap():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(5, 64)).astype(np.float32)
+    _assert_matches_xla(x)
+    jx = jnp.asarray(x)
+    o_v = jax.jit(jax.vmap(lambda r: sort_desc(r)[1]))(jx)
+    o_e = jax.vmap(lambda r: _sort_desc_xla(r)[1])(jx)
+    np.testing.assert_array_equal(np.asarray(o_v), np.asarray(o_e))
+
+
+def test_wide_range_fuzz():
+    rng = np.random.default_rng(2)
+    for trial in range(10):
+        n = int(rng.integers(1, 4097))
+        x = (rng.normal(size=n) * float(10.0 ** rng.integers(-6, 7))).astype(
+            np.float32
+        )
+        x[rng.random(n) < 0.25] = np.float32(rng.choice(x))
+        _assert_matches_xla(x)
+
+
+def test_non_f32_falls_back_to_xla():
+    # bfloat16 input must not reach the f32-only kernel
+    x = jnp.asarray(np.random.default_rng(3).normal(size=33), jnp.bfloat16)
+    s, o = jax.jit(sort_desc)(x)
+    s_x, o_x = _sort_desc_xla(x)
+    np.testing.assert_array_equal(np.asarray(o), np.asarray(o_x))
+    np.testing.assert_array_equal(
+        np.asarray(s.astype(jnp.float32)), np.asarray(s_x.astype(jnp.float32))
+    )
+
+
+def test_empty_input():
+    for shape in [(0,), (3, 0), (0, 5)]:
+        s, o = jax.jit(sort_desc)(jnp.zeros(shape, jnp.float32))
+        assert s.shape == shape and o.shape == shape
+
+
+def test_x64_mode_curve_metric():
+    # jax_enable_x64 flips argsort's dtype to int64; the dispatch must
+    # still produce equal branch types (reproduces a trace-time crash)
+    with jax.enable_x64(True):
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.uniform(size=64).astype(np.float32))
+        s, o = jax.jit(sort_desc)(x)
+        s_x, o_x = _sort_desc_xla(x)
+        np.testing.assert_array_equal(
+            np.asarray(o), np.asarray(o_x).astype(np.int32)
+        )

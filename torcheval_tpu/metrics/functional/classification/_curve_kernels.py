@@ -29,6 +29,56 @@ import jax
 import jax.numpy as jnp
 
 
+def _sort_desc_xla(input: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    order = jnp.argsort(-input, axis=-1, stable=True)
+    return jnp.take_along_axis(input, order, axis=-1), order
+
+
+def _sort_desc_native(input: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    n = input.shape[-1]
+    x2 = input.reshape(-1, n)
+    call = jax.ffi.ffi_call(
+        "torcheval_sort_desc",
+        (
+            jax.ShapeDtypeStruct(x2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(x2.shape, jnp.int32),
+        ),
+        vmap_method="sequential",
+    )
+    sorted_scores, order = call(x2)
+    return sorted_scores.reshape(input.shape), order.reshape(input.shape)
+
+
+def sort_desc(input: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Stable descending sort along axis -1: ``(sorted_scores, order)``.
+
+    Semantics of ``jnp.argsort(-x, stable=True)`` (ties keep ascending
+    original index, NaNs of either sign sort last) on every backend. The
+    sort is the whole cost of the curve metrics on CPU — XLA's
+    single-threaded comparison sort takes ~100 ms for 262k floats where
+    the native radix argsort (``ops/native/sort_desc.cc``) takes ~6 ms —
+    so the CPU lowering swaps in the FFI kernel via
+    ``lax.platform_dependent``; TPU keeps the pure-XLA sort (its sort unit
+    is not the bottleneck there).
+    """
+    if input.dtype != jnp.float32 or input.size == 0:
+        return _sort_desc_xla(input)
+    from torcheval_tpu.ops import native
+
+    if not native.ensure_registered():
+        return _sort_desc_xla(input)
+
+    def _xla_i32(x):
+        # platform_dependent needs identical branch output types; under
+        # jax_enable_x64 argsort returns int64 while the kernel pins int32
+        s, o = _sort_desc_xla(x)
+        return s, o.astype(jnp.int32)
+
+    return jax.lax.platform_dependent(
+        input, cpu=_sort_desc_native, default=_xla_i32
+    )
+
+
 def _run_end_mask(sorted_scores: jax.Array) -> jax.Array:
     """True at the last element of each equal-score run (axis -1)."""
     neq = sorted_scores[..., 1:] != sorted_scores[..., :-1]
@@ -59,8 +109,7 @@ def roc_cumulators(
     Returns (threshold_sorted, cum_tp, cum_fp, is_run_end), each shaped like
     ``input`` with axis -1 in descending-score order.
     """
-    order = jnp.argsort(-input, axis=-1, stable=True)
-    threshold = jnp.take_along_axis(input, order, axis=-1)
+    threshold, order = sort_desc(input)
     starget = jnp.take_along_axis(target, order, axis=-1).astype(jnp.float32)
     if weight is None:
         sweight = jnp.ones_like(starget)
@@ -98,8 +147,7 @@ def prc_arrays(
     integrators append it themselves. Recall is NaN-corrected to 1.0 when the
     target has no positive examples.
     """
-    order = jnp.argsort(-input, axis=-1, stable=True)
-    threshold = jnp.take_along_axis(input, order, axis=-1)
+    threshold, order = sort_desc(input)
     hit = (jnp.take_along_axis(target, order, axis=-1) == pos_label).astype(
         jnp.float32
     )

@@ -37,6 +37,7 @@ _SIDECAR = _LIB + ".buildinfo"
 _TARGETS = {
     "FusedAucHistogram": "torcheval_fused_auc_histogram",
     "CrossEntropyNll": "torcheval_ce_nll",
+    "SortDesc": "torcheval_sort_desc",
 }
 
 # per-file extra compile flags; ``cross_entropy.cc``'s reductions only

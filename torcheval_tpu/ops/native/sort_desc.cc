@@ -1,0 +1,108 @@
+// Stable descending argsort — C++ XLA custom-call (CPU host kernel).
+//
+// The curve metrics (AUROC / AUPRC / PR-curve) are sort-bound on CPU: XLA
+// lowers jnp.argsort to a single-threaded comparison sort (~100 ms for
+// 262k floats) while this LSD radix sort over the IEEE-754 total-order key
+// runs in ~5-10 ms. Registered for the CPU backend only; TPU lowers the
+// pure-XLA sort onto its own sort unit. Parity role: torch.sort's radix
+// path that the reference's TorchScript curve kernels lean on (reference
+// functional/classification/auroc.py:115-152).
+//
+// Inputs:  scores (T, N) f32.
+// Outputs: sorted (T, N) f32 descending, order (T, N) s32 — stable: ties
+//          keep ascending original index, exactly like
+//          jnp.argsort(-x, stable=True); NaNs (either sign) sort last,
+//          also matching it.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+// Ascending order of the returned key == stable descending score order.
+// F(b) is the standard IEEE total-order map (ascending F == ascending x);
+// the complement flips it to descending. Two remaps pin bit-exact parity
+// with XLA CPU's comparator: positive NaNs would otherwise sort first, so
+// they move past -Inf's key (negative NaNs already land there, matching
+// NaN-last argsort(-x)); and XLA CPU compares with flush-to-zero, so ±0
+// and every subnormal collapse into one stable tie class keyed as +0.
+inline uint32_t DescKey(float x) {
+  uint32_t b;
+  std::memcpy(&b, &x, sizeof(b));
+  const uint32_t mag = b & 0x7FFFFFFFu;
+  const uint32_t f = (b & 0x80000000u) ? ~b : (b | 0x80000000u);
+  uint32_t k = ~f;
+  if (mag > 0x7F800000u) k = 0xFFFFFFFFu;  // NaN (either sign): last
+  if (mag < 0x00800000u) k = 0x7FFFFFFFu;  // zero/subnormal: +0's key
+  return k;
+}
+
+void RadixArgsortDesc(const float* x, int64_t n, float* sorted_out,
+                      int32_t* order_out, uint32_t* k0, int32_t* i0,
+                      uint32_t* k1, int32_t* i1) {
+  for (int64_t i = 0; i < n; ++i) {
+    k0[i] = DescKey(x[i]);
+    i0[i] = static_cast<int32_t>(i);
+  }
+  uint32_t* ks = k0;
+  int32_t* is = i0;
+  uint32_t* kd = k1;
+  int32_t* id = i1;
+  for (int shift = 0; shift < 32; shift += 8) {
+    int64_t count[256] = {0};
+    for (int64_t i = 0; i < n; ++i) ++count[(ks[i] >> shift) & 0xFFu];
+    if (count[(ks[0] >> shift) & 0xFFu] == n) continue;  // constant byte
+    int64_t pos[256];
+    int64_t acc = 0;
+    for (int b = 0; b < 256; ++b) {
+      pos[b] = acc;
+      acc += count[b];
+    }
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t p = pos[(ks[i] >> shift) & 0xFFu]++;
+      kd[p] = ks[i];
+      id[p] = is[i];
+    }
+    std::swap(ks, kd);
+    std::swap(is, id);
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    order_out[i] = is[i];
+    sorted_out[i] = x[is[i]];
+  }
+}
+
+}  // namespace
+
+static ffi::Error SortDescImpl(ffi::Buffer<ffi::F32> scores,
+                               ffi::ResultBuffer<ffi::F32> sorted,
+                               ffi::ResultBuffer<ffi::S32> order) {
+  const auto dims = scores.dimensions();
+  if (dims.size() != 2) {
+    return ffi::Error::InvalidArgument("scores must be rank 2 (tasks, n)");
+  }
+  const int64_t tasks = dims[0];
+  const int64_t n = dims[1];
+  const float* x = scores.typed_data();
+  float* s = sorted->typed_data();
+  int32_t* o = order->typed_data();
+
+  std::vector<uint32_t> k0(n), k1(n);
+  std::vector<int32_t> i0(n), i1(n);
+  for (int64_t t = 0; t < tasks; ++t) {
+    RadixArgsortDesc(x + t * n, n, s + t * n, o + t * n, k0.data(), i0.data(),
+                     k1.data(), i1.data());
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(SortDesc, SortDescImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>());
