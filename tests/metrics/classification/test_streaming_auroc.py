@@ -1,0 +1,170 @@
+"""StreamingBinaryAUROC: mergeable histogram-state AUROC.
+
+Covers the MetricClassTester harness legs (update/merge/pickle/state_dict),
+accuracy vs the exact sort-based AUROC, weighted/multi-task forms, and the
+in-jit one-psum sync property the O(bins) SUM state exists for.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from torcheval_tpu.metrics import BinaryAUROC, StreamingBinaryAUROC
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(23)
+N_UP, BATCH = 8, 64
+
+
+class TestStreamingBinaryAUROC(MetricClassTester):
+    def test_class_harness(self):
+        inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [
+            RNG.integers(0, 2, BATCH).astype(np.float32) for _ in range(N_UP)
+        ]
+        expected = skm.roc_auc_score(
+            np.concatenate(targets), np.concatenate(inputs)
+        )
+        self.run_class_implementation_tests(
+            metric=StreamingBinaryAUROC(num_bins=4096),
+            state_names={"hist"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=np.float32(expected),
+            atol=1e-3,  # bin-resolution error bound
+            rtol=1e-3,
+        )
+
+    def test_matches_exact_auroc_within_bin_error(self):
+        x = RNG.uniform(size=5000).astype(np.float32)
+        t = (RNG.random(5000) < 0.3).astype(np.float32)
+        exact = BinaryAUROC()
+        exact.update(jnp.asarray(x), jnp.asarray(t))
+        stream = StreamingBinaryAUROC(num_bins=8192)
+        stream.update(jnp.asarray(x), jnp.asarray(t))
+        np.testing.assert_allclose(
+            float(stream.compute()), float(exact.compute()), atol=2e-3
+        )
+
+    def test_grid_aligned_scores_are_exact(self):
+        # scores on bin centers -> zero binning error
+        x = (RNG.integers(0, 16, size=400).astype(np.float32) + 0.5) / 16.0
+        t = (RNG.random(400) < 0.5).astype(np.float32)
+        stream = StreamingBinaryAUROC(num_bins=16)
+        stream.update(jnp.asarray(x), jnp.asarray(t))
+        np.testing.assert_allclose(
+            float(stream.compute()),
+            skm.roc_auc_score(t, x),
+            rtol=1e-6,
+            atol=1e-6,
+        )
+
+    def test_weighted_and_multitask(self):
+        x = RNG.uniform(size=(3, 512)).astype(np.float32)
+        t = (RNG.random((3, 512)) < 0.5).astype(np.float32)
+        w = RNG.uniform(0.5, 2.0, size=(3, 512)).astype(np.float32)
+        m = StreamingBinaryAUROC(num_tasks=3, num_bins=8192)
+        m.update(jnp.asarray(x), jnp.asarray(t), jnp.asarray(w))
+        got = np.asarray(m.compute())
+        assert got.shape == (3,)
+        for i in range(3):
+            np.testing.assert_allclose(
+                got[i],
+                skm.roc_auc_score(t[i], x[i], sample_weight=w[i]),
+                atol=2e-3,
+            )
+
+    def test_merge_equals_pooled(self):
+        xs = [RNG.uniform(size=200).astype(np.float32) for _ in range(3)]
+        ts = [(RNG.random(200) < 0.4).astype(np.float32) for _ in range(3)]
+        parts = []
+        for x, t in zip(xs, ts):
+            m = StreamingBinaryAUROC(num_bins=1024)
+            m.update(jnp.asarray(x), jnp.asarray(t))
+            parts.append(m)
+        parts[0].merge_state(parts[1:])
+        pooled = StreamingBinaryAUROC(num_bins=1024)
+        pooled.update(
+            jnp.asarray(np.concatenate(xs)), jnp.asarray(np.concatenate(ts))
+        )
+        np.testing.assert_allclose(
+            float(parts[0].compute()), float(pooled.compute()), rtol=1e-6
+        )
+
+    def test_custom_bounds_clamp(self):
+        # logit-range scores with fixed bounds; out-of-range clamps to edges
+        x = np.array([-10.0, -1.0, 0.5, 1.0, 10.0], np.float32)
+        t = np.array([0.0, 0.0, 1.0, 1.0, 1.0], np.float32)
+        m = StreamingBinaryAUROC(num_bins=64, bounds=(-2.0, 2.0))
+        m.update(jnp.asarray(x), jnp.asarray(t))
+        assert float(m.compute()) == pytest.approx(1.0)
+
+    def test_merge_rejects_mismatched_bounds(self):
+        a = StreamingBinaryAUROC(num_bins=64, bounds=(0.0, 1.0))
+        b = StreamingBinaryAUROC(num_bins=64, bounds=(-2.0, 2.0))
+        with pytest.raises(ValueError, match="different.*bounds"):
+            a.merge_state([b])
+
+    def test_invalid_params(self):
+        with pytest.raises(ValueError, match="num_tasks"):
+            StreamingBinaryAUROC(num_tasks=0)
+        with pytest.raises(ValueError, match="num_bins"):
+            StreamingBinaryAUROC(num_bins=1)
+        with pytest.raises(ValueError, match="bounds"):
+            StreamingBinaryAUROC(bounds=(1.0, 1.0))
+        with pytest.raises(ValueError, match="same shape"):
+            StreamingBinaryAUROC().update(
+                jnp.zeros(4), jnp.zeros(5)
+            )
+
+
+def test_in_jit_sync_is_one_fused_psum():
+    """The histogram state syncs inside jit via a single psum that XLA
+    merges with the step's own reduction — zero added collectives."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from torcheval_tpu.metrics.sharded import sync_states_in_jit
+    from torcheval_tpu.ops.fused_auc import _auc_from_hist, fused_auc_histogram
+    from torcheval_tpu.utils.hlo import collective_count
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs a multi-device mesh")
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("dp",))
+
+    x = jnp.asarray(RNG.uniform(size=(n * 32,)).astype(np.float32))
+    t = jnp.asarray((RNG.random(n * 32) < 0.5).astype(np.float32))
+
+    @jax.jit
+    @partial(
+        shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=(P(), P())
+    )
+    def step(x, t):
+        hist = fused_auc_histogram(
+            x[None, :], t[None, :], num_bins=128, bounds=(0.0, 1.0)
+        )
+        synced = sync_states_in_jit({"hist": hist}, "dp")
+        loss = jax.lax.psum(jnp.sum(x), "dp")
+        return loss, _auc_from_hist(synced["hist"])[0]
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp"),), out_specs=P())
+    def step_plain(x):
+        return jax.lax.psum(jnp.sum(x), "dp")
+
+    n_plain = collective_count(step_plain.lower(x).compile())
+    n_sync = collective_count(step.lower(x, t).compile())
+    assert n_plain == 1
+    assert n_sync == n_plain, "hist sync must fuse into the existing psum"
+
+    _, auc = step(x, t)
+    pooled = StreamingBinaryAUROC(num_bins=128)
+    pooled.update(x, t)
+    np.testing.assert_allclose(float(auc), float(pooled.compute()), rtol=1e-5)

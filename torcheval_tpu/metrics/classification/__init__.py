@@ -52,6 +52,9 @@ from torcheval_tpu.metrics.classification.recall_at_fixed_precision import (
     BinaryRecallAtFixedPrecision,
     MultilabelRecallAtFixedPrecision,
 )
+from torcheval_tpu.metrics.classification.streaming_auroc import (
+    StreamingBinaryAUROC,
+)
 
 __all__ = [
     "BinaryAccuracy",
@@ -84,5 +87,6 @@ __all__ = [
     "MultilabelBinnedPrecisionRecallCurve",
     "MultilabelPrecisionRecallCurve",
     "MultilabelRecallAtFixedPrecision",
+    "StreamingBinaryAUROC",
     "TopKMultilabelAccuracy",
 ]

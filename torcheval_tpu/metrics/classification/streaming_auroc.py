@@ -1,0 +1,146 @@
+"""Streaming (histogram-state) binary AUROC.
+
+Beyond-parity extension of the reference's opt-in fbgemm fused-AUC path
+(reference torcheval/metrics/functional/classification/auroc.py:161-173):
+where the reference's approximate kernel is per-call only and its exact
+metric must buffer raw scores and gather ALL of them to sync
+(O(total samples) state, ragged all-gather), this metric's whole state is
+a fixed (num_tasks, 2, num_bins) weight histogram over globally-fixed bin
+edges — O(bins) memory regardless of stream length, SUM-mergeable, so a
+distributed sync is ONE ``psum`` that XLA folds into the step's existing
+all-reduce (zero added collectives, see
+tests/metrics/test_sync_collective_structure.py).
+
+The update dispatches to the fastest histogram backend per platform
+(Pallas MXU kernel on TPU, C++ custom-call on CPU, pure-XLA scatter
+otherwise — ``torcheval_tpu/ops/fused_auc.py``). AUC is exact up to bin
+resolution: ties within one bin integrate trapezoidally, identical to the
+fused kernel's semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    _binary_auroc_update_input_check,
+)
+from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.ops.fused_auc import (
+    DEFAULT_NUM_BINS,
+    _auc_from_hist,
+    fused_auc_histogram,
+)
+
+TStreamingBinaryAUROC = TypeVar(
+    "TStreamingBinaryAUROC", bound="StreamingBinaryAUROC"
+)
+
+
+class StreamingBinaryAUROC(Metric[jax.Array]):
+    """Approximate binary AUROC with O(num_bins) mergeable state.
+
+    Use instead of ``BinaryAUROC`` when streams are long or the metric
+    must sync often: state size and sync cost are independent of how many
+    samples were seen. Scores are binned over fixed ``bounds`` (defaults
+    to [0, 1] for probabilities); out-of-range scores clamp into the edge
+    bins.
+
+    Args:
+        num_tasks: number of independent tasks.
+        num_bins: histogram resolution; AUC error is O(1/num_bins).
+        bounds: global (lo, hi) score range defining the bin edges. Fixed
+            at construction so states from any worker/batch are mergeable.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import StreamingBinaryAUROC
+        >>> metric = StreamingBinaryAUROC()
+        >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]),
+        ...               jnp.array([0, 0, 1, 1]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        num_bins: int = DEFAULT_NUM_BINS,
+        bounds: Tuple[float, float] = (0.0, 1.0),
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        if num_bins < 2:
+            raise ValueError(f"num_bins must be >= 2, got {num_bins}.")
+        lo, hi = float(bounds[0]), float(bounds[1])
+        if not hi > lo:
+            raise ValueError(f"bounds must satisfy hi > lo, got ({lo}, {hi}).")
+        self.num_tasks = num_tasks
+        self.num_bins = num_bins
+        self.bounds = (lo, hi)
+        self._add_state(
+            "hist",
+            jnp.zeros((num_tasks, 2, num_bins), dtype=jnp.float32),
+            merge=MergeKind.SUM,
+        )
+
+    def merge_state(
+        self: TStreamingBinaryAUROC,
+        metrics,
+    ) -> TStreamingBinaryAUROC:
+        """SUM-merge histograms; peers must share the bin geometry.
+
+        A ``num_bins`` mismatch fails on shape, but a ``bounds`` mismatch
+        would silently add histograms with different bin edges — check it
+        loudly here. (Distributed groups already require identically
+        constructed metrics on every rank, as in the reference.)
+        """
+        metrics = list(metrics)
+        for other in metrics:
+            if getattr(other, "bounds", None) != self.bounds:
+                raise ValueError(
+                    "cannot merge StreamingBinaryAUROC with different "
+                    f"bounds: {self.bounds} vs {getattr(other, 'bounds', None)}"
+                )
+        return super().merge_state(metrics)
+
+    def update(
+        self: TStreamingBinaryAUROC,
+        input,
+        target,
+        weight=None,
+    ) -> TStreamingBinaryAUROC:
+        """Bin one batch of scores into the histogram state.
+
+        Args:
+            input: scores, shape (n,) or (num_tasks, n).
+            target: binary labels, same shape.
+            weight: optional per-sample weights, same shape.
+        """
+        input, target = self._input_float(input), self._input(target)
+        if weight is not None:
+            weight = self._input_float(weight)
+        _binary_auroc_update_input_check(input, target, self.num_tasks, weight)
+        batch_hist = fused_auc_histogram(
+            input,
+            target,
+            weight,
+            num_bins=self.num_bins,
+            bounds=self.bounds,
+        )
+        self.hist = self.hist + batch_hist
+        return self
+
+    def compute(self) -> jax.Array:
+        """AUROC from the histogram; scalar for ``num_tasks == 1``."""
+        auc = _auc_from_hist(self.hist)
+        return auc[0] if self.num_tasks == 1 else auc

@@ -198,23 +198,41 @@ def init_inception_params(
     return InceptionV3().init(rng, dummy)
 
 
-def load_torchvision_inception_params() -> Dict[str, Any]:
+def load_torchvision_inception_params(
+    state_dict: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
     """Import torchvision's pretrained InceptionV3 weights into the Flax
-    pytree (requires torchvision + downloaded weights).
+    pytree.
 
     Name mapping: torchvision ``Mixed_5b.branch1x1.conv.weight`` (OIHW) ->
     flax ``params/Mixed_5b/branch1x1/conv/kernel`` (HWIO); batchnorm
     weight/bias -> scale/bias, running_mean/var -> batch_stats.
+
+    Args:
+        state_dict: a torchvision-format ``inception_v3`` state dict
+            (name -> numpy array). When ``None``, torchvision's pretrained
+            weights are fetched (requires torchvision + downloaded
+            weights). The injectable form lets the mapping itself be
+            tested without torchvision (tests/metrics/image).
+
+    Raises if any torch entry fails to land (unknown name / shape
+    mismatch) or any Flax parameter is left untouched — a silently
+    partial import would produce plausible-but-wrong FID features.
     """
     import flax
-    from torchvision import models  # noqa: deferred optional dep
 
-    torch_model = models.inception_v3(weights="DEFAULT")
-    state = {k: v.detach().numpy() for k, v in torch_model.state_dict().items()}
+    if state_dict is None:
+        from torchvision import models  # noqa: deferred optional dep
+
+        torch_model = models.inception_v3(weights="DEFAULT")
+        state_dict = {
+            k: v.detach().numpy() for k, v in torch_model.state_dict().items()
+        }
 
     variables = flax.core.unfreeze(init_inception_params())
     flat_params = flax.traverse_util.flatten_dict(variables["params"])
     flat_stats = flax.traverse_util.flatten_dict(variables["batch_stats"])
+    unassigned = set(flat_params) | set(flat_stats)
 
     def assign(flat: Dict[Tuple[str, ...], Any], path: Tuple[str, ...], value):
         if path not in flat:
@@ -226,16 +244,17 @@ def load_torchvision_inception_params() -> Dict[str, Any]:
                 f"{expected}"
             )
         flat[path] = jnp.asarray(value)
+        unassigned.discard(path)
 
-    for name, value in state.items():
+    for name, value in state_dict.items():
         parts = tuple(name.split("."))
         if parts[0] in ("fc", "AuxLogits") or parts[-1] == "num_batches_tracked":
             continue  # fc removed (reference fid.py:43); aux head unused
         *module_path, leaf = parts
         module_path = tuple(module_path)
-        if module_path[-1] == "conv" and leaf == "weight":
+        if module_path and module_path[-1] == "conv" and leaf == "weight":
             assign(flat_params, module_path + ("kernel",), value.transpose(2, 3, 1, 0))
-        elif module_path[-1] == "bn":
+        elif module_path and module_path[-1] == "bn":
             if leaf == "weight":
                 assign(flat_params, module_path + ("scale",), value)
             elif leaf == "bias":
@@ -244,6 +263,19 @@ def load_torchvision_inception_params() -> Dict[str, Any]:
                 assign(flat_stats, module_path + ("mean",), value)
             elif leaf == "running_var":
                 assign(flat_stats, module_path + ("var",), value)
+            else:
+                raise KeyError(f"unrecognized batchnorm leaf in '{name}'")
+        else:
+            raise KeyError(
+                f"unrecognized torchvision inception parameter '{name}'"
+            )
+
+    if unassigned:
+        missing = sorted("/".join(p) for p in unassigned)
+        raise ValueError(
+            f"{len(missing)} Flax parameters were not covered by the "
+            f"state dict, e.g. {missing[:5]}"
+        )
 
     return {
         "params": flax.traverse_util.unflatten_dict(flat_params),
