@@ -43,3 +43,42 @@ def _run_example(name: str, timeout: float = 240.0) -> str:
 def test_example_runs(name, marker):
     out = _run_example(name)
     assert marker in out, f"{name} output missing {marker!r}:\n{out[-1500:]}"
+
+
+def test_intro_notebook_cells_execute():
+    """The walkthrough notebook's code cells must run top-to-bottom, and
+    the checked-in .ipynb must be the generator's current output."""
+    import json
+
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    try:
+        import build_intro_notebook
+    finally:
+        sys.path.pop(0)
+
+    with open(
+        os.path.join(REPO, "examples", "Introducing_TorchEval_TPU.ipynb")
+    ) as f:
+        committed = json.load(f)
+    assert committed == build_intro_notebook.build(), (
+        "notebook out of date: run python examples/build_intro_notebook.py"
+    )
+
+    runner = (
+        "import sys; sys.path.insert(0, 'examples')\n"
+        "from build_intro_notebook import code_cells\n"
+        "ns = {}\n"
+        "for i, src in enumerate(code_cells()):\n"
+        "    exec(compile(src, f'<cell {i}>', 'exec'), ns)\n"
+        "print('NOTEBOOK_OK')\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", runner],
+        env=env, cwd=REPO, timeout=300,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    assert proc.returncode == 0 and "NOTEBOOK_OK" in proc.stdout, (
+        proc.stdout[-2000:]
+    )
