@@ -1,0 +1,107 @@
+"""Native argmax_last / correct_mask kernels vs XLA: bit-exact parity.
+
+Every native kernel gets an adversarial parity pin; these cover the
+one-pass accuracy kernels (`ops/native/argmax_last.cc`) against the XLA
+key formulation and stock jnp.argmax on ties, NaN rows, signed zeros,
+subnormals, out-of-range and float targets.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torcheval_tpu.metrics.functional.tensor_utils import (
+    _argmax_last_xla,
+    _correct_mask_xla,
+    argmax_last,
+    correct_mask,
+)
+
+SPECIALS = np.array(
+    [0.0, -0.0, np.nan, -np.nan, np.inf, -np.inf, 1e-40, -1e-40, 1.0, -1.0],
+    np.float32,
+)
+
+
+@pytest.fixture(autouse=True)
+def _require_native():
+    from torcheval_tpu.ops import native
+
+    if not native.ensure_registered():
+        pytest.skip("native toolchain unavailable")
+
+
+def _adversarial(rng, shape):
+    x = rng.normal(size=shape).astype(np.float32)
+    flat = x.reshape(-1)
+    n_sp = max(1, flat.size // 6)
+    ii = rng.integers(0, flat.size, size=n_sp)
+    flat[ii] = rng.choice(SPECIALS, size=n_sp)
+    return flat.reshape(shape)
+
+
+def test_argmax_parity_fuzz():
+    rng = np.random.default_rng(0)
+    for trial in range(25):
+        shape = (
+            (int(rng.integers(1, 200)), int(rng.integers(1, 130)))
+            if trial % 2
+            else (int(rng.integers(1, 400)),)
+        )
+        x = jnp.asarray(_adversarial(rng, shape))
+        a = np.asarray(jax.jit(argmax_last)(x))
+        assert np.array_equal(a, np.asarray(_argmax_last_xla(x))), trial
+        assert np.array_equal(a, np.asarray(jnp.argmax(x, axis=-1))), trial
+
+
+def test_argmax_all_tied_row():
+    x = jnp.full((3, 7), 2.5, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(jax.jit(argmax_last)(x)), [0, 0, 0])
+
+
+def test_correct_mask_parity_fuzz():
+    rng = np.random.default_rng(1)
+    for trial in range(25):
+        R, C = int(rng.integers(1, 200)), int(rng.integers(1, 130))
+        x = jnp.asarray(_adversarial(rng, (R, C)))
+        t = jnp.asarray(rng.integers(-3, C + 3, size=R))  # incl out-of-range
+        a = np.asarray(jax.jit(correct_mask)(x, t))
+        assert np.array_equal(a, np.asarray(_correct_mask_xla(x, t))), trial
+
+
+def test_correct_mask_tie_rule_first_index_wins():
+    # ties: target matches argmax only when it is the FIRST max position
+    x = jnp.asarray([[1.0, 5.0, 5.0], [5.0, 5.0, 1.0]], jnp.float32)
+    got = np.asarray(correct_mask(x, jnp.asarray([2, 0])))
+    np.testing.assert_array_equal(got, [0.0, 1.0])
+    got = np.asarray(correct_mask(x, jnp.asarray([1, 1])))
+    np.testing.assert_array_equal(got, [1.0, 0.0])
+
+
+def test_correct_mask_nan_wins():
+    x = jnp.asarray([[1.0, jnp.nan, 9.0]], jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(correct_mask(x, jnp.asarray([1]))), [1.0]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(correct_mask(x, jnp.asarray([2]))), [0.0]
+    )
+
+
+def test_correct_mask_float_targets_fall_back():
+    # non-integral float target can never equal an int argmax; the native
+    # kernel must not be reached (it would truncate 2.5 -> 2)
+    x = jnp.asarray([[0.0, 1.0, 9.0, 2.0]], jnp.float32)
+    got = np.asarray(jax.jit(correct_mask)(x, jnp.asarray([2.5])))
+    np.testing.assert_array_equal(got, [0.0])
+    got = np.asarray(jax.jit(correct_mask)(x, jnp.asarray([2.0])))
+    np.testing.assert_array_equal(got, [1.0])
+
+
+def test_grad_is_zero_like_xla():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(6, 4)).astype(np.float32))
+    t = jnp.asarray(rng.integers(0, 4, size=6))
+    g = jax.grad(lambda x: jnp.sum(correct_mask(x, t)))(x)
+    assert float(jnp.sum(jnp.abs(g))) == 0.0

@@ -76,7 +76,7 @@ def test_intro_notebook_cells_execute():
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, "-c", runner],
-        env=env, cwd=REPO, timeout=300,
+        env=env, cwd=REPO, timeout=600,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     assert proc.returncode == 0 and "NOTEBOOK_OK" in proc.stdout, (

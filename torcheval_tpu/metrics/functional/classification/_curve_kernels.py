@@ -34,7 +34,10 @@ def _sort_desc_xla(input: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return jnp.take_along_axis(input, order, axis=-1), order
 
 
+@jax.custom_jvp
 def _sort_desc_native(input: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    from torcheval_tpu.metrics.functional.tensor_utils import _match_vma
+
     n = input.shape[-1]
     x2 = input.reshape(-1, n)
     call = jax.ffi.ffi_call(
@@ -46,7 +49,23 @@ def _sort_desc_native(input: jax.Array) -> Tuple[jax.Array, jax.Array]:
         vmap_method="sequential",
     )
     sorted_scores, order = call(x2)
-    return sorted_scores.reshape(input.shape), order.reshape(input.shape)
+    return (
+        _match_vma(sorted_scores.reshape(input.shape), input),
+        _match_vma(order.reshape(input.shape), input),
+    )
+
+
+@_sort_desc_native.defjvp
+def _sort_desc_native_jvp(primals, tangents):
+    # same JVP XLA's sort has: the tangent rides the permutation; the
+    # integer order output has no tangent (float0)
+    import numpy as np
+
+    (x,), (tx,) = primals, tangents
+    sorted_scores, order = _sort_desc_native(x)
+    t_sorted = jnp.take_along_axis(tx, order, axis=-1)
+    t_order = np.zeros(order.shape, dtype=jax.dtypes.float0)
+    return (sorted_scores, order), (t_sorted, t_order)
 
 
 def sort_desc(input: jax.Array) -> Tuple[jax.Array, jax.Array]:

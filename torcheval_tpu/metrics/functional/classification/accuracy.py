@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
-from torcheval_tpu.metrics.functional.tensor_utils import argmax_last
+from torcheval_tpu.metrics.functional.tensor_utils import correct_mask
 from torcheval_tpu.utils.convert import to_jax
 
 
@@ -53,8 +53,10 @@ def _multiclass_accuracy_update(
     k: int,
 ) -> Tuple[jax.Array, jax.Array]:
     if k == 1:
-        pred = argmax_last(input) if input.ndim == 2 else input
-        mask = (pred == target).astype(jnp.float32)
+        if input.ndim == 2:
+            mask = correct_mask(input, target)
+        else:
+            mask = (input == target).astype(jnp.float32)
     else:
         target_score = jnp.take_along_axis(input, target[:, None], axis=-1)
         rank = jnp.sum(input > target_score, axis=-1)

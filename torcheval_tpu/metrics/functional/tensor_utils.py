@@ -24,6 +24,82 @@ def nan_safe_divide(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.where(b == 0, jnp.nan, a / jnp.where(b == 0, 1.0, b))
 
 
+def _match_vma(out: jax.Array, ref: jax.Array) -> jax.Array:
+    """Propagate ``ref``'s varying-manual-axes onto ``out``.
+
+    Inside ``shard_map``, values carry a set of mesh axes they vary over;
+    XLA ops propagate it but ffi_call outputs come back unmarked, which
+    makes ``platform_dependent`` branches disagree ("varying manual axes
+    do not match"). No-op outside shard_map.
+    """
+    try:
+        missing = tuple(sorted(jax.typeof(ref).vma - jax.typeof(out).vma))
+    except Exception:
+        return out
+    return jax.lax.pvary(out, missing) if missing else out
+
+
+def _correct_mask_native(x: jax.Array, target: jax.Array) -> jax.Array:
+    call = jax.ffi.ffi_call(
+        "torcheval_correct_mask",
+        jax.ShapeDtypeStruct((x.shape[0],), jnp.float32),
+        vmap_method="sequential",
+    )
+    return _match_vma(call(x, target.astype(jnp.int32)), x)
+
+
+def correct_mask(x: jax.Array, target: jax.Array) -> jax.Array:
+    """Per-row ``(argmax_last(x) == target)`` as float32, in one pass.
+
+    The hot inner statement of every top-1 accuracy update. Full argmax
+    needs per-row index bookkeeping that drowns short rows in reduction
+    overhead; the correctness mask only needs a count of positions beating
+    the target (strictly greater key, or equal key at a smaller index), a
+    single branchless reduction — the CPU lowering runs it as a native
+    custom call when available. Semantics identical to
+    ``argmax_last(x) == target`` including ties / NaN / out-of-range
+    targets (which can never equal an argmax).
+    """
+    if (
+        x.ndim == 2
+        and x.dtype == jnp.float32
+        and x.size > 0
+        and jnp.issubdtype(target.dtype, jnp.integer)
+    ):
+        from torcheval_tpu.ops import native
+
+        if native.ensure_registered():
+            # the mask is piecewise-constant in the scores: its true
+            # gradient is zero everywhere it exists, which is exactly what
+            # the XLA branch yields (int argmax -> bool eq -> cast). The
+            # FFI call refuses JVP outright, so cut tangents up front —
+            # identical autodiff semantics on every backend.
+            x = jax.lax.stop_gradient(x)
+            target = jax.lax.stop_gradient(target)
+            return jax.lax.platform_dependent(
+                x,
+                target,
+                cpu=_correct_mask_native,
+                default=_correct_mask_xla,
+            )
+    return _correct_mask_xla(x, target)
+
+
+def _correct_mask_xla(x: jax.Array, target: jax.Array) -> jax.Array:
+    return (argmax_last(x) == target).astype(jnp.float32)
+
+
+def _argmax_last_native(x: jax.Array) -> jax.Array:
+    c = x.shape[-1]
+    x2 = x.reshape(-1, c)
+    call = jax.ffi.ffi_call(
+        "torcheval_argmax_last",
+        jax.ShapeDtypeStruct((x2.shape[0],), jnp.int32),
+        vmap_method="sequential",
+    )
+    return _match_vma(call(x2).reshape(x.shape[:-1]), x)
+
+
 def argmax_last(x: jax.Array) -> jax.Array:
     """``jnp.argmax(x, axis=-1)`` with identical semantics (first index on
     ties, NaN wins, -0.0 == +0.0), several times faster on XLA:CPU.
@@ -31,9 +107,29 @@ def argmax_last(x: jax.Array) -> jax.Array:
     XLA:CPU lowers float variadic reduces (argmax/max over the minor axis)
     to scalar loops, while integer reduces vectorize. So: bitcast to an
     order-preserving int32 key, then integer max + first-matching-index via
-    integer min. On TPU both forms compile to fused VPU reductions. Used by
-    every score->label conversion in the classification hot loops.
+    integer min. On the CPU lowering, when the native library is present,
+    the whole thing collapses further into a one-pass C++ custom call
+    (``ops/native/argmax_last.cc``). On TPU both jnp forms compile to
+    fused VPU reductions. Used by every score->label conversion in the
+    classification hot loops.
     """
+    C = x.shape[-1]
+    if x.dtype == jnp.float32 and x.size > 0:
+        from torcheval_tpu.ops import native
+
+        if native.ensure_registered():
+            # integer output: tangents are symbolically zero on the XLA
+            # branch; cut them so the FFI branch never sees a JVP trace
+            x = jax.lax.stop_gradient(x)
+            return jax.lax.platform_dependent(
+                x,
+                cpu=_argmax_last_native,
+                default=_argmax_last_xla,
+            )
+    return _argmax_last_xla(x)
+
+
+def _argmax_last_xla(x: jax.Array) -> jax.Array:
     C = x.shape[-1]
     if x.dtype in (jnp.dtype(jnp.int32), jnp.dtype(jnp.int16),
                    jnp.dtype(jnp.int8), jnp.dtype(jnp.bool_)):

@@ -35,6 +35,8 @@ _SIDECAR = _LIB + ".buildinfo"
 
 # exported symbol -> XLA FFI target name; every handler registers on CPU
 _TARGETS = {
+    "ArgmaxLast": "torcheval_argmax_last",
+    "CorrectMask": "torcheval_correct_mask",
     "FusedAucHistogram": "torcheval_fused_auc_histogram",
     "CrossEntropyNll": "torcheval_ce_nll",
     "SortDesc": "torcheval_sort_desc",
@@ -48,6 +50,7 @@ _TARGETS = {
 # tests/metrics/text's non-finite parity test against a fast-math compiler
 # ever folding it away.
 _EXTRA_FLAGS = {
+    "argmax_last.cc": ["-march=native"],
     "cross_entropy.cc": ["-ffast-math", "-march=native"],
 }
 

@@ -1,0 +1,153 @@
+// Row-wise argmax — C++ XLA custom-call (CPU host kernel).
+//
+// One fused pass replacing the CPU lowering of `argmax_last`
+// (torcheval_tpu/metrics/functional/tensor_utils.py): the XLA formulation
+// must materialize an order-preserving integer key array plus two reduces
+// (max, then first-matching-index), ~3 passes over the batch; this kernel
+// streams each row once tracking (best_key, first_index). Feeds every
+// score->label conversion in the classification hot loops (accuracy,
+// precision, recall, F1, confusion matrix).
+//
+// Semantics pinned to jnp.argmax(axis=-1): FIRST index on ties, NaN of
+// either sign ranks maximal, -0.0 ties with +0.0. Subnormals keep their
+// exact IEEE order (the bitcast key preserves them; only the sort kernel
+// needed XLA's flush-to-zero tie class).
+//
+// Inputs:  scores (R, C) f32.
+// Outputs: index (R,) s32.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+// Ascending unsigned key == ascending float order (IEEE total-order map),
+// with +-0 collapsed and NaN forced maximal. Branchless so the max
+// reduction below vectorizes to integer-max blends.
+inline uint32_t AscKey(float x) {
+  uint32_t b;
+  std::memcpy(&b, &x, sizeof(b));
+  const uint32_t mag = b & 0x7FFFFFFFu;
+  // all-ones masks instead of ?: — ternaries lower to branches that stop
+  // the caller's reduction loop from vectorizing
+  const uint32_t sign = static_cast<uint32_t>(static_cast<int32_t>(b) >> 31);
+  uint32_t k = (b ^ sign) | (~sign & 0x80000000u);
+  const uint32_t zero = static_cast<uint32_t>(
+      -static_cast<int32_t>(mag == 0u));  // -0.0 ties with +0.0
+  k = (k & ~zero) | (0x80000000u & zero);
+  const uint32_t nan = static_cast<uint32_t>(
+      -static_cast<int32_t>(mag > 0x7F800000u));  // NaN ranks maximal
+  return k | nan;
+}
+
+// A loop-carried argmax (value + index together) defeats the
+// autovectorizer, so split into three vectorizable passes over the row
+// (which lives in L1): keys into scratch, unsigned-max reduce, then a
+// min-reduce over matching indices (first max = smallest match).
+__attribute__((noinline)) void RowKeys(const float* row, int64_t c,
+                                       uint32_t* keys) {
+  for (int64_t i = 0; i < c; ++i) keys[i] = AscKey(row[i]);
+}
+
+__attribute__((noinline)) uint32_t MaxKey(const uint32_t* keys, int64_t c) {
+  uint32_t m = 0;
+  for (int64_t i = 0; i < c; ++i) m = keys[i] > m ? keys[i] : m;
+  return m;
+}
+
+__attribute__((noinline)) int32_t FirstMatch(const uint32_t* keys, int64_t c,
+                                             uint32_t m) {
+  int32_t mn = INT32_MAX;
+  for (int64_t i = 0; i < c; ++i) {
+    const int32_t v = keys[i] == m ? static_cast<int32_t>(i) : INT32_MAX;
+    mn = v < mn ? v : mn;
+  }
+  return mn;
+}
+
+int32_t RowArgmax(const float* row, int64_t c, uint32_t* scratch) {
+  RowKeys(row, c, scratch);
+  return FirstMatch(scratch, c, MaxKey(scratch, c));
+}
+
+// Count of positions beating the target under argmax's tie rule: any
+// strictly-greater key, or an equal key at a smaller index. Zero
+// violations == argmax(row) == t. One branchless vectorizable pass —
+// unlike full argmax there is no per-row index bookkeeping, so short rows
+// (C ~ 100) don't drown in reduction prologues.
+__attribute__((noinline)) int64_t RowViolations(const float* row, int64_t c,
+                                                uint32_t kt, int64_t t) {
+  int64_t n = 0;
+  for (int64_t j = 0; j < c; ++j) {
+    const uint32_t k = AscKey(row[j]);
+    n += static_cast<int64_t>((k > kt) | ((k == kt) & (j < t)));
+  }
+  return n;
+}
+
+}  // namespace
+
+static ffi::Error CorrectMaskImpl(ffi::Buffer<ffi::F32> scores,
+                                  ffi::Buffer<ffi::S32> targets,
+                                  ffi::ResultBuffer<ffi::F32> mask) {
+  const auto dims = scores.dimensions();
+  if (dims.size() != 2) {
+    return ffi::Error::InvalidArgument("scores must be rank 2 (rows, c)");
+  }
+  const int64_t rows = dims[0];
+  const int64_t c = dims[1];
+  const auto tdims = targets.dimensions();
+  if (tdims.size() != 1 || tdims[0] != rows) {
+    return ffi::Error::InvalidArgument("targets must be (rows,)");
+  }
+  const float* x = scores.typed_data();
+  const int32_t* tg = targets.typed_data();
+  float* out = mask->typed_data();
+  for (int64_t r = 0; r < rows; ++r) {
+    const int64_t t = tg[r];
+    if (t < 0 || t >= c) {  // out-of-range target can never match argmax
+      out[r] = 0.0f;
+      continue;
+    }
+    const float* row = x + r * c;
+    out[r] =
+        RowViolations(row, c, AscKey(row[t]), t) == 0 ? 1.0f : 0.0f;
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(CorrectMask, CorrectMaskImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+static ffi::Error ArgmaxLastImpl(ffi::Buffer<ffi::F32> scores,
+                                 ffi::ResultBuffer<ffi::S32> index) {
+  const auto dims = scores.dimensions();
+  if (dims.size() != 2) {
+    return ffi::Error::InvalidArgument("scores must be rank 2 (rows, c)");
+  }
+  const int64_t rows = dims[0];
+  const int64_t c = dims[1];
+  if (c == 0) {
+    return ffi::Error::InvalidArgument("argmax over an empty axis");
+  }
+  const float* x = scores.typed_data();
+  int32_t* out = index->typed_data();
+  std::vector<uint32_t> scratch(c);
+  for (int64_t r = 0; r < rows; ++r) {
+    out[r] = RowArgmax(x + r * c, c, scratch.data());
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(ArgmaxLast, ArgmaxLastImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>());
