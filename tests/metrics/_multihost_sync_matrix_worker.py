@@ -1,0 +1,42 @@
+"""Worker: sync EVERY metric class over the real multi-process wire.
+
+Spawned by ``test_multihost.py::test_every_metric_class_syncs``. Each rank
+builds every metric in the shared case registry (``_sync_matrix.py``),
+applies its rank's deterministic updates, and runs ``sync_and_compute``
+over the live ``MultiHostGroup``; one JSON result line carries every
+metric's synced value back for comparison against the in-process
+``merge_state`` oracle.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def main() -> None:
+    import jax
+
+    from torcheval_tpu.launcher import init_from_env
+
+    init_from_env()
+    rank = jax.process_index()
+
+    from tests.metrics._sync_matrix import build_cases, run_case, to_jsonable
+    from torcheval_tpu.distributed import default_process_group
+    from torcheval_tpu.metrics.toolkit import sync_and_compute
+
+    group = default_process_group()
+
+    results = {}
+    for name, (factory, gen) in sorted(build_cases().items()):
+        metric = run_case(factory(), gen, rank)
+        try:
+            results[name] = to_jsonable(sync_and_compute(metric, group))
+        except Exception as e:  # noqa: BLE001 — report, don't kill the job
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+
+    print("RESULT " + json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

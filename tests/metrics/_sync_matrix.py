@@ -1,0 +1,511 @@
+"""Shared case registry: EVERY metric class crosses the spawned-process
+sync wire (reference bar: the class tester spawns 4 gloo workers per
+metric, reference utils/test_utils/metric_class_tester.py:292-341).
+
+Used from two places with identical data:
+- ``_multihost_sync_matrix_worker.py`` (spawned ranks): each rank builds
+  every metric, applies its rank's updates, runs ``sync_and_compute`` over
+  the real ``MultiHostGroup`` wire;
+- ``test_multihost.py::test_every_metric_class_syncs`` (parent): builds
+  per-rank replicas in-process, merges with ``merge_state``, and compares.
+
+Data is deterministic per (metric name, rank); each rank applies two
+updates (three for windowed metrics so ring buffers wrap) with
+rank-asymmetric sizes where the update contract allows it.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+Case = Tuple[Callable[[], Any], Callable[[int], List[Tuple[tuple, dict]]]]
+
+WORDS = ["the", "cat", "sat", "on", "a", "mat", "dog", "ran", "red", "fox"]
+
+
+def _rng(name: str, rank: int) -> np.random.Generator:
+    # zlib.crc32, not hash(): str hashing is salted per process, and these
+    # seeds must agree between spawned ranks and the in-process oracle
+    return np.random.default_rng(zlib.crc32(f"{name}/{rank}".encode()))
+
+
+def _bin_pair(name, n_updates=2):
+    """(scores, binary labels) updates; ragged n across ranks."""
+
+    def gen(rank):
+        rng = _rng(name, rank)
+        out = []
+        for _ in range(n_updates):
+            n = 8 + 4 * rank
+            out.append(
+                (
+                    (
+                        rng.uniform(size=n).astype(np.float32),
+                        (rng.random(n) < 0.5).astype(np.float32),
+                    ),
+                    {},
+                )
+            )
+        return out
+
+    return gen
+
+
+def _mc_pair(name, classes, n_updates=2):
+    def gen(rank):
+        rng = _rng(name, rank)
+        out = []
+        for _ in range(n_updates):
+            n = 8 + 4 * rank
+            out.append(
+                (
+                    (
+                        rng.uniform(size=(n, classes)).astype(np.float32),
+                        rng.integers(0, classes, size=n),
+                    ),
+                    {},
+                )
+            )
+        return out
+
+    return gen
+
+
+def _ml_pair(name, labels, n_updates=2):
+    def gen(rank):
+        rng = _rng(name, rank)
+        out = []
+        for _ in range(n_updates):
+            n = 8 + 4 * rank
+            out.append(
+                (
+                    (
+                        rng.uniform(size=(n, labels)).astype(np.float32),
+                        (rng.random((n, labels)) < 0.5).astype(np.float32),
+                    ),
+                    {},
+                )
+            )
+        return out
+
+    return gen
+
+
+def _reg_pair(name, n_updates=2):
+    def gen(rank):
+        rng = _rng(name, rank)
+        out = []
+        for _ in range(n_updates):
+            n = 8 + 4 * rank
+            out.append(
+                (
+                    (
+                        rng.normal(size=n).astype(np.float32),
+                        rng.normal(size=n).astype(np.float32),
+                    ),
+                    {},
+                )
+            )
+        return out
+
+    return gen
+
+
+def _text_pair(name, n_updates=2):
+    def gen(rank):
+        rng = _rng(name, rank)
+        out = []
+        for _ in range(n_updates):
+            n = 2 + rank
+            cands = [
+                " ".join(rng.choice(WORDS, size=6 + rank)) for _ in range(n)
+            ]
+            refs = [
+                " ".join(rng.choice(WORDS, size=6 + rank)) for _ in range(n)
+            ]
+            out.append(((cands, refs), {}))
+        return out
+
+    return gen
+
+
+def _tiny_fid_model():
+    """Deterministic feature extractor: images -> 8-dim pooled features."""
+    import jax.numpy as jnp
+
+    def model(images):  # (N, 3, H, W)
+        x = jnp.asarray(images, jnp.float32)
+        pooled = jnp.stack(
+            [
+                x.mean(axis=(1, 2, 3)),
+                x.std(axis=(1, 2, 3)) + 0.1,
+                x[:, 0].mean(axis=(1, 2)),
+                x[:, 1].mean(axis=(1, 2)),
+                x[:, 2].mean(axis=(1, 2)),
+                x[:, :, ::2].mean(axis=(1, 2, 3)),
+                x[:, :, :, ::2].mean(axis=(1, 2, 3)),
+                x.max(axis=(1, 2, 3)),
+            ],
+            axis=-1,
+        )
+        return pooled
+
+    return model
+
+
+def build_cases() -> Dict[str, Case]:
+    """name -> (metric factory, per-rank update generator)."""
+    import jax.numpy as jnp  # noqa: F401  (factories build device metrics)
+
+    import torcheval_tpu.metrics as M
+
+    cases: Dict[str, Case] = {}
+
+    def bleu_gen(rank):
+        rng = _rng("BLEUScore", rank)
+        out = []
+        for _ in range(2):
+            n = 2 + rank
+            cands = [" ".join(rng.choice(WORDS, size=8)) for _ in range(n)]
+            refs = [
+                [" ".join(rng.choice(WORDS, size=8))] for _ in range(n)
+            ]
+            out.append(((cands, refs), {}))
+        return out
+
+    def ppl_gen(rank):
+        rng = _rng("Perplexity", rank)
+        return [
+            (
+                (
+                    rng.normal(size=(1 + rank, 6, 17)).astype(np.float32),
+                    rng.integers(0, 17, size=(1 + rank, 6)),
+                ),
+                {},
+            )
+            for _ in range(2)
+        ]
+
+    def fid_gen(rank):
+        rng = _rng("FrechetInceptionDistance", rank)
+        out = []
+        for is_real in (True, False):
+            imgs = rng.uniform(size=(6 + rank, 3, 8, 8)).astype(np.float32)
+            out.append(((imgs,), {"is_real": is_real}))
+        return out
+
+    def throughput_gen(rank):
+        return [(tuple(), {"num_processed": 10 * (rank + 1),
+                           "elapsed_time_sec": float(rank + 1)})]
+
+    def ctr_gen(rank):
+        rng = _rng("ClickThroughRate", rank)
+        n = 8 + 4 * rank
+        return [
+            (((rng.random(n) < 0.4).astype(np.float32),),
+             {"weights": rng.uniform(0.5, 2.0, size=n).astype(np.float32)})
+            for _ in range(2)
+        ]
+
+    def weighted_cal_gen(rank):
+        rng = _rng("WeightedCalibration", rank)
+        n = 8 + 4 * rank
+        return [
+            ((rng.uniform(size=n).astype(np.float32),
+              (rng.random(n) < 0.5).astype(np.float32),
+              rng.uniform(0.5, 2.0, size=n).astype(np.float32)), {})
+            for _ in range(2)
+        ]
+
+    def retrieval_gen(rank):
+        rng = _rng("RetrievalPrecision", rank)
+        n = 6 + 2 * rank
+        idx = np.where(np.arange(n) % 2 == 0, rank % 3, (rank + 1) % 3)
+        return [
+            ((rng.random(n).astype(np.float32),
+              (rng.random(n) < 0.5).astype(np.float32)),
+             {"indexes": idx})
+        ]
+
+    def topk_ranking_gen(name):
+        def gen(rank):
+            rng = _rng(name, rank)
+            return [
+                ((rng.uniform(size=(4 + rank, 6)).astype(np.float32),
+                  rng.integers(0, 6, size=4 + rank)), {})
+                for _ in range(2)
+            ]
+
+        return gen
+
+    def scalar_gen(name):
+        def gen(rank):
+            rng = _rng(name, rank)
+            return [
+                ((rng.normal(size=8 + 4 * rank).astype(np.float32),), {})
+                for _ in range(2)
+            ]
+
+        return gen
+
+    def psnr_gen(rank):
+        rng = _rng("PeakSignalNoiseRatio", rank)
+        return [
+            ((rng.uniform(size=(2, 4, 4)).astype(np.float32),
+              rng.uniform(size=(2, 4, 4)).astype(np.float32)), {})
+            for _ in range(2)
+        ]
+
+    def windowed_ctr_gen(rank):
+        rng = _rng("WindowedClickThroughRate", rank)
+        return [
+            (((rng.random(8) < 0.4).astype(np.float32),), {})
+            for _ in range(6)
+        ]
+
+    def windowed_mse_gen(rank):
+        rng = _rng("WindowedMeanSquaredError", rank)
+        return [
+            ((rng.normal(size=8).astype(np.float32) * (u + 1),
+              np.zeros(8, np.float32)), {})
+            for u in range(6)
+        ]
+
+    def windowed_wcal_gen(rank):
+        rng = _rng("WindowedWeightedCalibration", rank)
+        return [
+            ((rng.uniform(size=8).astype(np.float32),
+              (rng.random(8) < 0.5).astype(np.float32)), {})
+            for _ in range(6)
+        ]
+
+    def auc_gen(rank):
+        rng = _rng("AUC", rank)
+        n = 6 + 2 * rank
+        return [
+            ((np.sort(rng.uniform(size=n).astype(np.float32)),
+              rng.uniform(size=n).astype(np.float32)), {})
+            for _ in range(2)
+        ]
+
+    cases.update({
+        # aggregation
+        "AUC": (lambda: M.AUC(), auc_gen),
+        "Cat": (lambda: M.Cat(), scalar_gen("Cat")),
+        "Max": (lambda: M.Max(), scalar_gen("Max")),
+        "Mean": (lambda: M.Mean(), scalar_gen("Mean")),
+        "Min": (lambda: M.Min(), scalar_gen("Min")),
+        "Sum": (lambda: M.Sum(), scalar_gen("Sum")),
+        "Throughput": (lambda: M.Throughput(), throughput_gen),
+        # classification: binary family
+        "BinaryAccuracy": (lambda: M.BinaryAccuracy(), _bin_pair("BinaryAccuracy")),
+        "BinaryAUPRC": (lambda: M.BinaryAUPRC(), _bin_pair("BinaryAUPRC")),
+        "BinaryAUROC": (lambda: M.BinaryAUROC(), _bin_pair("BinaryAUROC")),
+        "BinaryBinnedAUPRC": (
+            lambda: M.BinaryBinnedAUPRC(threshold=7), _bin_pair("BinaryBinnedAUPRC")
+        ),
+        "BinaryBinnedAUROC": (
+            lambda: M.BinaryBinnedAUROC(threshold=7), _bin_pair("BinaryBinnedAUROC")
+        ),
+        "BinaryBinnedPrecisionRecallCurve": (
+            lambda: M.BinaryBinnedPrecisionRecallCurve(threshold=5),
+            _bin_pair("BinaryBinnedPrecisionRecallCurve"),
+        ),
+        "BinaryConfusionMatrix": (
+            lambda: M.BinaryConfusionMatrix(), _bin_pair("BinaryConfusionMatrix")
+        ),
+        "BinaryF1Score": (lambda: M.BinaryF1Score(), _bin_pair("BinaryF1Score")),
+        "BinaryNormalizedEntropy": (
+            lambda: M.BinaryNormalizedEntropy(),
+            _bin_pair("BinaryNormalizedEntropy"),
+        ),
+        "BinaryPrecision": (lambda: M.BinaryPrecision(), _bin_pair("BinaryPrecision")),
+        "BinaryPrecisionRecallCurve": (
+            lambda: M.BinaryPrecisionRecallCurve(),
+            _bin_pair("BinaryPrecisionRecallCurve"),
+        ),
+        "BinaryRecall": (lambda: M.BinaryRecall(), _bin_pair("BinaryRecall")),
+        "BinaryRecallAtFixedPrecision": (
+            lambda: M.BinaryRecallAtFixedPrecision(min_precision=0.4),
+            _bin_pair("BinaryRecallAtFixedPrecision"),
+        ),
+        "StreamingBinaryAUROC": (
+            lambda: M.StreamingBinaryAUROC(num_bins=128),
+            _bin_pair("StreamingBinaryAUROC"),
+        ),
+        # classification: multiclass family
+        "MulticlassAccuracy": (
+            lambda: M.MulticlassAccuracy(average="macro", num_classes=5),
+            _mc_pair("MulticlassAccuracy", 5),
+        ),
+        "MulticlassAUPRC": (
+            lambda: M.MulticlassAUPRC(num_classes=5), _mc_pair("MulticlassAUPRC", 5)
+        ),
+        "MulticlassAUROC": (
+            lambda: M.MulticlassAUROC(num_classes=5), _mc_pair("MulticlassAUROC", 5)
+        ),
+        "MulticlassBinnedAUPRC": (
+            lambda: M.MulticlassBinnedAUPRC(num_classes=5, threshold=7),
+            _mc_pair("MulticlassBinnedAUPRC", 5),
+        ),
+        "MulticlassBinnedAUROC": (
+            lambda: M.MulticlassBinnedAUROC(num_classes=5, threshold=7),
+            _mc_pair("MulticlassBinnedAUROC", 5),
+        ),
+        "MulticlassBinnedPrecisionRecallCurve": (
+            lambda: M.MulticlassBinnedPrecisionRecallCurve(
+                num_classes=5, threshold=5
+            ),
+            _mc_pair("MulticlassBinnedPrecisionRecallCurve", 5),
+        ),
+        "MulticlassConfusionMatrix": (
+            lambda: M.MulticlassConfusionMatrix(num_classes=5),
+            _mc_pair("MulticlassConfusionMatrix", 5),
+        ),
+        "MulticlassF1Score": (
+            lambda: M.MulticlassF1Score(average="macro", num_classes=5),
+            _mc_pair("MulticlassF1Score", 5),
+        ),
+        "MulticlassPrecision": (
+            lambda: M.MulticlassPrecision(average="macro", num_classes=5),
+            _mc_pair("MulticlassPrecision", 5),
+        ),
+        "MulticlassPrecisionRecallCurve": (
+            lambda: M.MulticlassPrecisionRecallCurve(num_classes=5),
+            _mc_pair("MulticlassPrecisionRecallCurve", 5),
+        ),
+        "MulticlassRecall": (
+            lambda: M.MulticlassRecall(average="macro", num_classes=5),
+            _mc_pair("MulticlassRecall", 5),
+        ),
+        # classification: multilabel family
+        "MultilabelAccuracy": (
+            lambda: M.MultilabelAccuracy(), _ml_pair("MultilabelAccuracy", 4)
+        ),
+        "MultilabelAUPRC": (
+            lambda: M.MultilabelAUPRC(num_labels=4), _ml_pair("MultilabelAUPRC", 4)
+        ),
+        "MultilabelBinnedAUPRC": (
+            lambda: M.MultilabelBinnedAUPRC(num_labels=4, threshold=7),
+            _ml_pair("MultilabelBinnedAUPRC", 4),
+        ),
+        "MultilabelBinnedPrecisionRecallCurve": (
+            lambda: M.MultilabelBinnedPrecisionRecallCurve(
+                num_labels=4, threshold=5
+            ),
+            _ml_pair("MultilabelBinnedPrecisionRecallCurve", 4),
+        ),
+        "MultilabelPrecisionRecallCurve": (
+            lambda: M.MultilabelPrecisionRecallCurve(num_labels=4),
+            _ml_pair("MultilabelPrecisionRecallCurve", 4),
+        ),
+        "MultilabelRecallAtFixedPrecision": (
+            lambda: M.MultilabelRecallAtFixedPrecision(
+                num_labels=4, min_precision=0.4
+            ),
+            _ml_pair("MultilabelRecallAtFixedPrecision", 4),
+        ),
+        "TopKMultilabelAccuracy": (
+            lambda: M.TopKMultilabelAccuracy(criteria="hamming", k=2),
+            _ml_pair("TopKMultilabelAccuracy", 4),
+        ),
+        # ranking
+        "ClickThroughRate": (lambda: M.ClickThroughRate(), ctr_gen),
+        "HitRate": (lambda: M.HitRate(k=3), topk_ranking_gen("HitRate")),
+        "ReciprocalRank": (
+            lambda: M.ReciprocalRank(k=3), topk_ranking_gen("ReciprocalRank")
+        ),
+        "RetrievalPrecision": (
+            lambda: M.RetrievalPrecision(
+                k=2, num_queries=3, empty_target_action="neg"
+            ),
+            retrieval_gen,
+        ),
+        "WeightedCalibration": (lambda: M.WeightedCalibration(), weighted_cal_gen),
+        # regression
+        "MeanSquaredError": (
+            lambda: M.MeanSquaredError(), _reg_pair("MeanSquaredError")
+        ),
+        "R2Score": (lambda: M.R2Score(), _reg_pair("R2Score")),
+        # image
+        "PeakSignalNoiseRatio": (
+            lambda: M.PeakSignalNoiseRatio(data_range=1.0), psnr_gen
+        ),
+        "FrechetInceptionDistance": (
+            lambda: M.FrechetInceptionDistance(
+                model=_tiny_fid_model(), feature_dim=8
+            ),
+            fid_gen,
+        ),
+        # text
+        "BLEUScore": (lambda: M.BLEUScore(n_gram=2), bleu_gen),
+        "Perplexity": (lambda: M.Perplexity(), ppl_gen),
+        "WordErrorRate": (lambda: M.WordErrorRate(), _text_pair("WordErrorRate")),
+        "WordInformationLost": (
+            lambda: M.WordInformationLost(), _text_pair("WordInformationLost")
+        ),
+        "WordInformationPreserved": (
+            lambda: M.WordInformationPreserved(),
+            _text_pair("WordInformationPreserved"),
+        ),
+        # window family: 6 updates into size-4 windows so ring buffers WRAP
+        # (wrap happens on update 5); one shared rng per rank keeps every
+        # update's data distinct, so a merge that picks wrong slots fails
+        "WindowedBinaryAUROC": (
+            lambda: M.WindowedBinaryAUROC(max_num_samples=16),
+            _bin_pair("WindowedBinaryAUROC", n_updates=6),
+        ),
+        "WindowedBinaryNormalizedEntropy": (
+            lambda: M.WindowedBinaryNormalizedEntropy(
+                max_num_updates=4, enable_lifetime=True
+            ),
+            _bin_pair("WindowedBinaryNormalizedEntropy", n_updates=6),
+        ),
+        "WindowedClickThroughRate": (
+            lambda: M.WindowedClickThroughRate(
+                max_num_updates=4, enable_lifetime=True
+            ),
+            windowed_ctr_gen,
+        ),
+        "WindowedMeanSquaredError": (
+            lambda: M.WindowedMeanSquaredError(
+                max_num_updates=4, enable_lifetime=True
+            ),
+            windowed_mse_gen,
+        ),
+        "WindowedWeightedCalibration": (
+            lambda: M.WindowedWeightedCalibration(
+                max_num_updates=4, enable_lifetime=True
+            ),
+            windowed_wcal_gen,
+        ),
+    })
+    return cases
+
+
+def run_case(metric, gen, rank: int):
+    """Apply rank's updates to a fresh metric instance."""
+    import jax.numpy as jnp
+
+    for args, kwargs in gen(rank):
+        conv_args = tuple(
+            jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args
+        )
+        conv_kwargs = {
+            k: jnp.asarray(v) if isinstance(v, np.ndarray) else v
+            for k, v in kwargs.items()
+        }
+        metric.update(*conv_args, **conv_kwargs)
+    return metric
+
+
+def to_jsonable(result):
+    """Normalize a compute() result (array / tuple / list-of-arrays) into
+    nested float lists for cross-process comparison."""
+    if isinstance(result, (tuple, list)):
+        return [to_jsonable(r) for r in result]
+    arr = np.asarray(result)
+    return arr.astype(np.float64).tolist() if arr.ndim else float(arr)

@@ -237,3 +237,61 @@ def test_multihost_sync(nproc):
     assert res["coll_acc"] == pytest.approx(correct / total)
     assert res["coll_sum"] == float(sum(range(nproc)))
     assert res["synced_state_dict_sum"] == res["sum"]
+
+
+MATRIX_WORKER = os.path.join(
+    REPO, "tests", "metrics", "_multihost_sync_matrix_worker.py"
+)
+
+
+def _matrix_results():
+    if "matrix" not in _CACHE:
+        from torcheval_tpu.launcher import launch
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        try:
+            outputs = launch(MATRIX_WORKER, nproc=2, timeout=900.0, env=env)
+            _CACHE["matrix"] = parse_result_lines(outputs)
+        except Exception as e:  # cache the failure: don't respawn 58 times
+            _CACHE["matrix"] = e
+    if isinstance(_CACHE["matrix"], Exception):
+        raise _CACHE["matrix"]
+    return _CACHE["matrix"]
+
+
+def _metric_case_names():
+    from tests.metrics._sync_matrix import build_cases
+
+    return sorted(build_cases())
+
+
+@pytest.mark.parametrize("name", _metric_case_names())
+def test_every_metric_class_syncs(name):
+    """Reference bar: every metric class crosses a real process boundary
+    (reference metric_class_tester.py:292-341 spawns gloo workers per
+    metric). One spawned 2-rank job carries all ~58 classes; each synced
+    result must equal the in-process merge_state oracle on the same data.
+    """
+    from tests.metrics._sync_matrix import build_cases, run_case, to_jsonable
+
+    results = _matrix_results()
+    got = results[0][name]
+    assert results[1][name] == got, f"ranks disagree on {name}"
+    assert not (isinstance(got, dict) and "error" in got), got
+
+    factory, gen = build_cases()[name]
+    replicas = [run_case(factory(), gen, r) for r in range(2)]
+    replicas[0].merge_state(replicas[1:])
+    expected = to_jsonable(replicas[0].compute())
+
+    def close(a, b):
+        if isinstance(a, list) and isinstance(b, list):
+            return len(a) == len(b) and all(close(x, y) for x, y in zip(a, b))
+        if isinstance(a, float) and isinstance(b, float):
+            if np.isnan(a) and np.isnan(b):
+                return True
+            return bool(np.isclose(a, b, rtol=1e-4, atol=1e-5))
+        return a == b
+
+    assert close(got, expected), f"{name}: synced {got} != merged {expected}"

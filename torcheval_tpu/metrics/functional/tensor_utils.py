@@ -45,7 +45,12 @@ def _correct_mask_native(x: jax.Array, target: jax.Array) -> jax.Array:
         jax.ShapeDtypeStruct((x.shape[0],), jnp.float32),
         vmap_method="sequential",
     )
-    return _match_vma(call(x, target.astype(jnp.int32)), x)
+    # funnel out-of-range targets (incl. int64 values past 2^31, which a
+    # bare int32 cast would wrap into range) to -1 — never an argmax
+    t32 = jnp.where(
+        (target >= 0) & (target < x.shape[1]), target, -1
+    ).astype(jnp.int32)
+    return _match_vma(call(x, t32), x)
 
 
 def correct_mask(x: jax.Array, target: jax.Array) -> jax.Array:
@@ -65,6 +70,7 @@ def correct_mask(x: jax.Array, target: jax.Array) -> jax.Array:
         and x.dtype == jnp.float32
         and x.size > 0
         and jnp.issubdtype(target.dtype, jnp.integer)
+        and x.shape[1] < 2**31
     ):
         from torcheval_tpu.ops import native
 
@@ -113,7 +119,6 @@ def argmax_last(x: jax.Array) -> jax.Array:
     fused VPU reductions. Used by every score->label conversion in the
     classification hot loops.
     """
-    C = x.shape[-1]
     if x.dtype == jnp.float32 and x.size > 0:
         from torcheval_tpu.ops import native
 

@@ -222,7 +222,10 @@ class FrechetInceptionDistance(Metric[jax.Array]):
         # the reference, whose torch.linalg.eigvals is a host LAPACK call
         # on CPU tensors, fid.py:221).
         try:
-            cpu = jax.devices("cpu")[0]
+            # local_devices, not devices: in a multi-process job the global
+            # first CPU device belongs to rank 0 and is non-addressable
+            # from every other rank
+            cpu = jax.local_devices(backend="cpu")[0]
         except RuntimeError:  # JAX_PLATFORMS excludes cpu
             cpu = self._device
         return _frechet_distance(
