@@ -74,6 +74,11 @@ def test_intro_notebook_cells_execute():
     )
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # the TPU plugin registers itself programmatically when this var is
+    # set and then ignores JAX_PLATFORMS; unlike examples/_backend.py's
+    # probe, the notebook cells import jax directly — scrub it so the
+    # runner cannot hang on a dead relay
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.run(
         [sys.executable, "-c", runner],
         env=env, cwd=REPO, timeout=600,

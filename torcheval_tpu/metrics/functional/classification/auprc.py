@@ -16,16 +16,14 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics.functional.classification._curve_kernels import (
-    auprc_from_prc,
-    prc_arrays,
+    binary_auprc_area,
 )
 from torcheval_tpu.utils.convert import to_jax
 
 
 @jax.jit
 def _binary_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
-    p, r, _, _ = prc_arrays(input, target, 1)
-    return auprc_from_prc(p, r)
+    return binary_auprc_area(input, target)
 
 
 def _binary_auprc_update_input_check(
@@ -107,8 +105,7 @@ def _multiclass_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
     pos = jnp.arange(num_classes)
 
     def per_class(s, c):
-        p, r, _, _ = prc_arrays(s, (target == c).astype(jnp.int32), 1)
-        return auprc_from_prc(p, r)
+        return binary_auprc_area(s, (target == c).astype(jnp.int32))
 
     return jax.vmap(per_class)(scores, pos)
 
@@ -164,8 +161,7 @@ def _multilabel_auprc_update_input_check(
 @jax.jit
 def _multilabel_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
     def per_label(s, t):
-        p, r, _, _ = prc_arrays(s, t, 1)
-        return auprc_from_prc(p, r)
+        return binary_auprc_area(s, t)
 
     return jax.vmap(per_label)(input.T, target.T)
 

@@ -22,8 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics.functional.classification._curve_kernels import (
-    auroc_from_cumulators,
-    roc_cumulators,
+    binary_auroc_area,
 )
 from torcheval_tpu.utils.convert import to_jax
 
@@ -32,8 +31,7 @@ from torcheval_tpu.utils.convert import to_jax
 def _binary_auroc_compute_jit(
     input: jax.Array, target: jax.Array, weight: Optional[jax.Array]
 ) -> jax.Array:
-    _, cum_tp, cum_fp, _ = roc_cumulators(input, target, weight)
-    return auroc_from_cumulators(cum_tp, cum_fp)
+    return binary_auroc_area(input, target, weight)
 
 
 def _binary_auroc_compute(
@@ -135,8 +133,7 @@ def _multiclass_auroc_compute_jit(
             valid.astype(jnp.float32)[None, :], scores.shape
         )
     )
-    _, cum_tp, cum_fp, _ = roc_cumulators(scores, targets, weight)
-    return auroc_from_cumulators(cum_tp, cum_fp)
+    return binary_auroc_area(scores, targets, weight)
 
 
 def _multiclass_auroc_param_check(num_classes: int, average: Optional[str]) -> None:

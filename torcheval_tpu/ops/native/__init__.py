@@ -36,6 +36,8 @@ _SIDECAR = _LIB + ".buildinfo"
 # exported symbol -> XLA FFI target name; every handler registers on CPU
 _TARGETS = {
     "ArgmaxLast": "torcheval_argmax_last",
+    "BinaryAuprc": "torcheval_binary_auprc",
+    "BinaryAuroc": "torcheval_binary_auroc",
     "CorrectMask": "torcheval_correct_mask",
     "FusedAucHistogram": "torcheval_fused_auc_histogram",
     "CrossEntropyNll": "torcheval_ce_nll",

@@ -79,6 +79,121 @@ void RadixArgsortDesc(const float* x, int64_t n, float* sorted_out,
 
 }  // namespace
 
+namespace {
+
+// One-pass trapezoidal AUROC over the descending-sorted (FP, TP) curve
+// with tie-run compaction — the fused equivalent of roc_cumulators +
+// auroc_from_cumulators (_curve_kernels.py): area accrues only at run
+// ends, origin (0,0) implied, degenerate single-class input -> 0.5.
+// ``w == nullptr`` means unweighted (all-ones).
+double AurocFromSorted(const float* s, const float* l, const float* w,
+                       const int32_t* order, int64_t n) {
+  double tp = 0.0, fp = 0.0, prev_tp = 0.0, prev_fp = 0.0, area = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t o = order[i];
+    const double wi = w ? w[o] : 1.0;
+    const double li = l[o];
+    tp += wi * li;
+    fp += wi * (1.0 - li);
+    if (i == n - 1 || s[i] != s[i + 1]) {  // tie-run end
+      area += (fp - prev_fp) * (tp + prev_tp) * 0.5;
+      prev_tp = tp;
+      prev_fp = fp;
+    }
+  }
+  const double denom = tp * fp;
+  // == 0 (not > 0): NaN or negative weights must flow through the division
+  // exactly like the XLA branch's where(factor == 0, 0.5, area / factor)
+  return denom == 0.0 ? 0.5 : area / denom;
+}
+
+// One-pass left-Riemann AUPRC (unweighted counts, reference convention):
+// sum over tie-runs of (delta tp) * precision(run end) / total positives,
+// terminal (p=1, r=0) point implied; no positives -> 0.
+double AuprcFromSorted(const float* s, const float* l, const int32_t* order,
+                       int64_t n) {
+  double tp = 0.0, count = 0.0, prev_tp = 0.0, area = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    tp += l[order[i]];
+    count += 1.0;
+    if (i == n - 1 || s[i] != s[i + 1]) {
+      area += (tp - prev_tp) * (tp / count);
+      prev_tp = tp;
+    }
+  }
+  return tp == 0.0 ? 0.0 : area / tp;  // NaN labels propagate, as in XLA
+}
+
+}  // namespace
+
+namespace {
+
+// Shared driver: validate (tasks, n) layout, argsort each task row, apply
+// ``fn(sorted, order, task)`` for the per-task area.
+template <typename Fn>
+ffi::Error ForEachTaskSorted(const ffi::Buffer<ffi::F32>& scores,
+                             float* out, Fn&& fn) {
+  const auto dims = scores.dimensions();
+  if (dims.size() != 2) {
+    return ffi::Error::InvalidArgument("scores must be rank 2 (tasks, n)");
+  }
+  const int64_t tasks = dims[0];
+  const int64_t n = dims[1];
+  const float* x = scores.typed_data();
+  std::vector<uint32_t> k0(n), k1(n);
+  std::vector<int32_t> i0(n), i1(n);
+  std::vector<float> sorted(n);
+  std::vector<int32_t> order(n);
+  for (int64_t t = 0; t < tasks; ++t) {
+    RadixArgsortDesc(x + t * n, n, sorted.data(), order.data(), k0.data(),
+                     i0.data(), k1.data(), i1.data());
+    out[t] = static_cast<float>(fn(sorted.data(), order.data(), t, n));
+  }
+  return ffi::Error::Success();
+}
+
+}  // namespace
+
+static ffi::Error BinaryAurocImpl(ffi::Buffer<ffi::F32> scores,
+                                  ffi::Buffer<ffi::F32> labels,
+                                  ffi::Buffer<ffi::F32> weights,
+                                  int64_t has_weight,
+                                  ffi::ResultBuffer<ffi::F32> auroc) {
+  const float* l = labels.typed_data();
+  const float* w = has_weight ? weights.typed_data() : nullptr;
+  return ForEachTaskSorted(
+      scores, auroc->typed_data(),
+      [&](const float* sorted, const int32_t* order, int64_t t, int64_t n) {
+        return AurocFromSorted(sorted, l + t * n,
+                               w ? w + t * n : nullptr, order, n);
+      });
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(BinaryAuroc, BinaryAurocImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Attr<int64_t>("has_weight")
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+static ffi::Error BinaryAuprcImpl(ffi::Buffer<ffi::F32> scores,
+                                  ffi::Buffer<ffi::F32> labels,
+                                  ffi::ResultBuffer<ffi::F32> auprc) {
+  const float* l = labels.typed_data();
+  return ForEachTaskSorted(
+      scores, auprc->typed_data(),
+      [&](const float* sorted, const int32_t* order, int64_t t, int64_t n) {
+        return AuprcFromSorted(sorted, l + t * n, order, n);
+      });
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(BinaryAuprc, BinaryAuprcImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
 static ffi::Error SortDescImpl(ffi::Buffer<ffi::F32> scores,
                                ffi::ResultBuffer<ffi::F32> sorted,
                                ffi::ResultBuffer<ffi::S32> order) {
