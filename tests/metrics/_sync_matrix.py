@@ -483,6 +483,21 @@ def build_cases() -> Dict[str, Case]:
             windowed_wcal_gen,
         ),
     })
+
+    # self-enforcing completeness: a metric class added to the library
+    # without a case here must fail loudly, not silently skip the wire
+    from torcheval_tpu.metrics.metric import Metric
+
+    all_classes = {
+        n for n in M.__all__
+        if isinstance(getattr(M, n, None), type)
+        and issubclass(getattr(M, n), Metric)
+        and n != "Metric"
+    }
+    missing = all_classes - set(cases)
+    assert not missing, (
+        f"metric classes without a sync-matrix case: {sorted(missing)}"
+    )
     return cases
 
 

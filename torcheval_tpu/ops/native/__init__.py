@@ -102,36 +102,56 @@ def _cache_valid() -> bool:
 
 
 def _build() -> bool:
+    """Compile + link into a private temp dir, then atomically rename.
+
+    Concurrent processes (spawned test ranks, pytest workers) may all hit
+    a cold cache at once: each builds its own artifacts and the
+    os.replace() publications are atomic, so a reader never sees a
+    half-written library — worst case two identical builds race and the
+    last rename wins. The sidecar lands after the library; the harmless
+    in-between state (new .so, stale sidecar) just re-triggers a build.
+    The four translation units compile concurrently.
+    """
+    import tempfile
+
     import jax.ffi
 
     include = f"-I{jax.ffi.include_dir()}"
-    objs = []
     try:
-        for src in _sources():
-            obj = src[:-3] + ".o"
-            cmd = [
-                "g++", "-O3", "-c", "-fPIC", "-std=c++17", include,
-                *_EXTRA_FLAGS.get(os.path.basename(src), []),
-                src, "-o", obj,
-            ]
-            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
-            objs.append(obj)
-        subprocess.run(
-            ["g++", "-shared", *objs, "-o", _LIB],
-            check=True, capture_output=True, timeout=300,
-        )
-        with open(_SIDECAR, "w") as f:
-            json.dump(_expected_buildinfo(), f)
+        with tempfile.TemporaryDirectory(dir=_DIR) as tmp:
+            procs = []
+            objs = []
+            for src in _sources():
+                obj = os.path.join(tmp, os.path.basename(src)[:-3] + ".o")
+                cmd = [
+                    "g++", "-O3", "-c", "-fPIC", "-std=c++17", include,
+                    *_EXTRA_FLAGS.get(os.path.basename(src), []),
+                    src, "-o", obj,
+                ]
+                procs.append(
+                    subprocess.Popen(
+                        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE
+                    )
+                )
+                objs.append(obj)
+            for p in procs:
+                _, err = p.communicate(timeout=300)
+                if p.returncode != 0:
+                    raise RuntimeError(err.decode()[-500:])
+            tmp_lib = os.path.join(tmp, "lib.so")
+            subprocess.run(
+                ["g++", "-shared", *objs, "-o", tmp_lib],
+                check=True, capture_output=True, timeout=300,
+            )
+            tmp_sidecar = os.path.join(tmp, "lib.buildinfo")
+            with open(tmp_sidecar, "w") as f:
+                json.dump(_expected_buildinfo(), f)
+            os.replace(tmp_lib, _LIB)
+            os.replace(tmp_sidecar, _SIDECAR)
         return True
     except Exception as e:  # missing toolchain / headers: degrade
         _logger.info("native op build skipped: %s", e)
         return False
-    finally:
-        for obj in objs:
-            try:
-                os.unlink(obj)
-            except OSError:
-                pass
 
 
 def ensure_registered() -> bool:
