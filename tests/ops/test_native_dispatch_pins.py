@@ -1,0 +1,135 @@
+"""CPU lowerings must actually contain the native custom-calls.
+
+The dispatchers fall back to pure XLA silently when registration fails —
+correct but 10-20x slower on CPU. These pins turn a silent perf
+regression (loader bug, registration rename, dispatch-guard typo) into a
+test failure by asserting the FFI target names appear in the compiled
+HLO of each hot entry point.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _require_native():
+    from torcheval_tpu.ops import native
+
+    if not native.ensure_registered():
+        pytest.skip("native toolchain unavailable")
+
+
+def _compiled_text(fn, *args):
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def test_auroc_lowering_uses_fused_kernel():
+    from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+        binary_auroc_area,
+    )
+
+    x = jnp.zeros(64, jnp.float32)
+    t = jnp.zeros(64, jnp.float32)
+    assert "torcheval_binary_auroc" in _compiled_text(
+        lambda x, t: binary_auroc_area(x, t), x, t
+    )
+
+
+def test_auprc_lowering_uses_fused_kernel():
+    from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+        binary_auprc_area,
+    )
+
+    x = jnp.zeros(64, jnp.float32)
+    t = jnp.zeros(64, jnp.float32)
+    assert "torcheval_binary_auprc" in _compiled_text(binary_auprc_area, x, t)
+
+
+def test_sort_lowering_uses_radix_kernel():
+    from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+        sort_desc,
+    )
+
+    x = jnp.zeros(64, jnp.float32)
+    assert "torcheval_sort_desc" in _compiled_text(sort_desc, x)
+
+
+def test_accuracy_lowering_uses_correct_mask():
+    from torcheval_tpu.metrics.functional.tensor_utils import correct_mask
+
+    x = jnp.zeros((8, 5), jnp.float32)
+    t = jnp.zeros(8, jnp.int32)
+    assert "torcheval_correct_mask" in _compiled_text(correct_mask, x, t)
+
+
+def test_argmax_lowering_uses_native_kernel():
+    from torcheval_tpu.metrics.functional.tensor_utils import argmax_last
+
+    x = jnp.zeros((8, 5), jnp.float32)
+    assert "torcheval_argmax_last" in _compiled_text(argmax_last, x)
+
+
+def test_perplexity_update_uses_native_ce():
+    # eager dispatch (device-based, not platform_dependent): run once and
+    # verify the jitted native wrapper is what executes
+    from torcheval_tpu.metrics.functional.text.perplexity import (
+        _perplexity_update_native_jit,
+        _use_native_ce,
+    )
+
+    L = jnp.zeros((1, 4, 16), jnp.float32)
+    assert _use_native_ce(L)
+    assert "torcheval_ce_nll" in (
+        jax.jit(lambda L, T: _perplexity_update_native_jit(L, T, None))
+        .lower(L, jnp.zeros((1, 4), jnp.int32))
+        .compile()
+        .as_text()
+    )
+
+
+def test_fallbacks_keep_working_without_native():
+    """With the native registry forced off, every dispatcher must still
+    produce correct results through pure XLA."""
+    import torcheval_tpu.ops.native as native
+
+    from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+        binary_auprc_area,
+        binary_auroc_area,
+        sort_desc,
+    )
+    from torcheval_tpu.metrics.functional.tensor_utils import (
+        argmax_last,
+        correct_mask,
+    )
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(size=128).astype(np.float32))
+    t = jnp.asarray((rng.random(128) < 0.5).astype(np.float32))
+    x2 = jnp.asarray(rng.normal(size=(16, 7)).astype(np.float32))
+    t2 = jnp.asarray(rng.integers(0, 7, size=16))
+
+    with_native = (
+        float(binary_auroc_area(x, t)),
+        float(binary_auprc_area(x, t)),
+        np.asarray(sort_desc(x)[1]),
+        np.asarray(argmax_last(x2)),
+        np.asarray(correct_mask(x2, t2)),
+    )
+    saved = native._registered
+    native._registered = False
+    try:
+        without = (
+            float(binary_auroc_area(x, t)),
+            float(binary_auprc_area(x, t)),
+            np.asarray(sort_desc(x)[1]),
+            np.asarray(argmax_last(x2)),
+            np.asarray(correct_mask(x2, t2)),
+        )
+    finally:
+        native._registered = saved
+    np.testing.assert_allclose(with_native[0], without[0], rtol=1e-5)
+    np.testing.assert_allclose(with_native[1], without[1], rtol=1e-5)
+    for a, b in zip(with_native[2:], without[2:]):
+        np.testing.assert_array_equal(a, b)
