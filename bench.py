@@ -154,7 +154,7 @@ def run_sync_overhead():
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     from torcheval_tpu.metrics.functional.classification.accuracy import (
         _multiclass_accuracy_update,

@@ -116,7 +116,7 @@ Below: way 2 on whatever devices this notebook sees (1 is fine; with the
 from functools import partial
 
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from torcheval_tpu.metrics.functional.classification.accuracy import (
     _multiclass_accuracy_update,

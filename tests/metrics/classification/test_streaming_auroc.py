@@ -127,7 +127,7 @@ def test_in_jit_sync_is_one_fused_psum():
     """The histogram state syncs inside jit via a single psum that XLA
     merges with the step's own reduction — zero added collectives."""
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     from torcheval_tpu.metrics.sharded import sync_states_in_jit
     from torcheval_tpu.ops.fused_auc import _auc_from_hist, fused_auc_histogram

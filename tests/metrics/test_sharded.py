@@ -11,7 +11,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 try:
     from jax import shard_map
 except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
 from torcheval_tpu.metrics import MulticlassAccuracy, Max, Min
 from torcheval_tpu.metrics.functional.classification.accuracy import (

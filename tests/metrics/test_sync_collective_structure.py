@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 try:
     from jax import shard_map
 except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
 from torcheval_tpu.metrics.functional.classification.accuracy import (
     _multiclass_accuracy_update,
