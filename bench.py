@@ -624,6 +624,18 @@ REF_FNS = {
 }
 
 
+def _cache_env(env):
+    # Persistent XLA compile cache shared by every child: each config runs
+    # in a fresh interpreter, so without this each pays its own ~20-60 s
+    # (re)compile. The dir survives across bench runs, so a warm repo cuts
+    # total wall time roughly in half (measured: auroc child 79 s -> 36 s).
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+    )
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    return env
+
+
 def _cpu_env():
     env = dict(os.environ)
     # The TPU PJRT plugin registers from sitecustomize only when this is
@@ -639,7 +651,7 @@ def _cpu_env():
 
 
 def _run_child(config, platform, timeout):
-    env = _cpu_env() if platform == "cpu" else dict(os.environ)
+    env = _cache_env(_cpu_env() if platform == "cpu" else dict(os.environ))
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--child", config],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,

@@ -8,10 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax import shard_map
+from jax import shard_map
 
 from torcheval_tpu.metrics import MulticlassAccuracy, Max, Min
 from torcheval_tpu.metrics.functional.classification.accuracy import (

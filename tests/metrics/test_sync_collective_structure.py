@@ -24,10 +24,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax import shard_map
+from jax import shard_map
 
 from torcheval_tpu.metrics.functional.classification.accuracy import (
     _multiclass_accuracy_update,
