@@ -36,7 +36,7 @@ def _match_vma(out: jax.Array, ref: jax.Array) -> jax.Array:
         missing = tuple(sorted(jax.typeof(ref).vma - jax.typeof(out).vma))
     except Exception:
         return out
-    return jax.lax.pvary(out, missing) if missing else out
+    return jax.lax.pcast(out, missing, to="varying") if missing else out
 
 
 def _correct_mask_native(x: jax.Array, target: jax.Array) -> jax.Array:

@@ -1,6 +1,18 @@
+from torcheval_tpu.parallel.moe import moe_apply, moe_reference
+from torcheval_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_reference,
+)
 from torcheval_tpu.parallel.ring_attention import (
     dense_reference_attention,
     ring_attention,
 )
 
-__all__ = ["dense_reference_attention", "ring_attention"]
+__all__ = [
+    "dense_reference_attention",
+    "moe_apply",
+    "moe_reference",
+    "pipeline_apply",
+    "pipeline_reference",
+    "ring_attention",
+]

@@ -1,0 +1,124 @@
+"""Expert parallelism: top-1 (Switch-style) MoE dispatch over a mesh axis.
+
+Experts are sharded one-per-device along an ``ep`` mesh axis; tokens are
+sharded over the same axis. Each device routes its local tokens with a
+softmax gate, packs them into a fixed-capacity ``(E, C, d)`` dispatch
+buffer (static shapes — the TPU-idiomatic capacity formulation: tokens past
+an expert's capacity are dropped, their output is zero), exchanges buffers
+with one ``lax.all_to_all`` over ICI, applies its resident expert FFN — a
+single large MXU matmul over all received tokens — and returns results with
+a second ``all_to_all``. Gate-probability weighting happens at the source
+device, so the combine is a gather, not a collective.
+
+The reference has no expert parallelism (it is a metrics library;
+SURVEY.md section 5.7) — this primitive exists so the *evaluation* stack
+(flagship model forward + metric updates, see ``__graft_entry__``) covers
+MoE model families the way the surrounding TPU training stack does. The
+capacity/dispatch formulation follows the public Switch Transformer recipe
+(Fedus et al., 2021, arXiv:2101.03961).
+
+Use inside ``shard_map`` over a mesh with an expert axis::
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("ep"), P(), P("ep"), P("ep")), out_specs=P("ep"))
+    def run(x, wg, w1, w2):
+        return moe_apply(x, wg, w1[0], w2[0], axis_name="ep", capacity=C)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _route(
+    x: jax.Array, wg: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 gating: per-token expert index, gate probability, and the
+    token's arrival position within its expert's queue (source order)."""
+    probs = jax.nn.softmax(x @ wg, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    onehot = jax.nn.one_hot(expert, wg.shape[-1], dtype=jnp.int32)
+    position = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)
+    return expert, gate, position
+
+
+def moe_apply(
+    x: jax.Array,
+    wg: jax.Array,
+    w1: jax.Array,
+    w2: jax.Array,
+    *,
+    axis_name: str,
+    capacity: int,
+) -> jax.Array:
+    """Dispatch local tokens through the device-sharded experts.
+
+    Args:
+        x: ``(n, d)`` this device's token shard.
+        wg: ``(d, E)`` gate weights, replicated.
+        w1: ``(d, h)`` this device's expert up-projection.
+        w2: ``(h, d)`` this device's expert down-projection.
+        axis_name: the expert mesh axis (E = its size).
+        capacity: max tokens each (source device, expert) pair may send;
+            overflow tokens get zero output.
+
+    Returns the ``(n, d)`` combined output: ``gate * expert(x)`` per kept
+    token, zero for dropped tokens.
+    """
+    num_experts = lax.psum(1, axis_name)
+    n, d = x.shape
+    expert, gate, position = _route(x, wg)
+    keep = position < capacity
+
+    # pack into (E, C+1, d); slot C is the spill row every dropped token
+    # writes to (and is then cut off), so kept tokens never collide
+    slot = jnp.where(keep, position, capacity)
+    dispatch = jnp.zeros((num_experts, capacity + 1, d), x.dtype)
+    dispatch = dispatch.at[expert, slot].set(x)[:, :capacity]
+
+    # exchange: leading axis goes from "destination expert" to "source
+    # device" — each device now holds every shard's tokens for ITS expert
+    received = lax.all_to_all(dispatch, axis_name, 0, 0, tiled=True)
+
+    hidden = jax.nn.relu(received.reshape(-1, d) @ w1)
+    processed = (hidden @ w2).reshape(num_experts, capacity, d)
+
+    # send results back and gather each token's row from its expert buffer
+    returned = lax.all_to_all(processed, axis_name, 0, 0, tiled=True)
+    padded = jnp.concatenate(
+        [returned, jnp.zeros((num_experts, 1, d), returned.dtype)], axis=1
+    )
+    return padded[expert, slot] * gate[:, None]
+
+
+def moe_reference(
+    x: jax.Array,
+    wg: jax.Array,
+    w1: jax.Array,
+    w2: jax.Array,
+    *,
+    num_shards: int,
+    capacity: int,
+) -> jax.Array:
+    """Unsharded oracle with identical routing/drop semantics.
+
+    ``x`` is the full ``(N, d)`` batch laid out as ``num_shards``
+    contiguous shards; ``w1``/``w2`` carry the expert axis in front
+    (``(E, d, h)`` / ``(E, h, d)``).
+    """
+    outs = []
+    for shard in jnp.split(x, num_shards, axis=0):
+        expert, gate, position = _route(shard, wg)
+        keep = position < capacity
+        y = jnp.einsum(
+            "nh,nhd->nd",
+            jax.nn.relu(jnp.einsum("nd,ndh->nh", shard, w1[expert])),
+            w2[expert],
+        )
+        outs.append(jnp.where(keep[:, None], y * gate[:, None], 0.0))
+    return jnp.concatenate(outs, axis=0)

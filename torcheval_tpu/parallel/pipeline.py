@@ -1,0 +1,117 @@
+"""Pipeline parallelism: GPipe-style microbatched staging over a mesh axis.
+
+Layers are partitioned into S stages, one per device along a ``pp`` mesh
+axis; a batch is split into M microbatches that stream through the stages.
+Each tick every stage applies its layer block to the microbatch it holds,
+then passes the activation one hop down the ring with ``lax.ppermute`` —
+the classic (M + S - 1)-tick GPipe schedule, expressed as a ``lax.scan`` so
+XLA sees one static program with no data-dependent control flow. The bubble
+fraction is (S-1)/(M+S-1); communication is nearest-neighbour over ICI.
+
+The reference has no pipeline parallelism (it is a metrics library;
+SURVEY.md section 5.7) — this primitive exists so the *evaluation* stack
+(flagship model forward + metric updates, see ``__graft_entry__``) can run
+models too deep for one chip, the way the surrounding TPU training stack
+does.
+
+Use inside ``shard_map`` over a mesh with a pipeline axis, stage parameters
+stacked on a leading axis sharded over it::
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("pp"), P()), out_specs=P())
+    def run(stage_params, x_microbatches):
+        local = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        return pipeline_apply(stage_fn, local, x_microbatches,
+                              axis_name="pp")
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    axis_name: str,
+) -> jax.Array:
+    """Stream microbatches through the pipeline stages on ``axis_name``.
+
+    Args:
+        stage_fn: ``(params, activation) -> activation`` for ONE stage;
+            activation shape is preserved.
+        stage_params: this device's stage parameters (already indexed out of
+            the stacked pytree by the caller).
+        x: ``(M, mb, ...)`` microbatched input, replicated across the axis.
+        axis_name: the pipeline mesh axis.
+
+    Returns the ``(M, mb, ...)`` pipeline output, replicated (every device
+    returns the full result; the last stage's outputs are psum-broadcast).
+    """
+    num_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    num_micro = x.shape[0]
+    is_last = stage == num_stages - 1
+
+    def _varying(v):
+        return lax.pcast(v, (axis_name,), to="varying")
+
+    # ring neighbours: stage s hands its activation to s+1 (the wrap edge
+    # S-1 -> 0 carries retired activations; they are never read)
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def tick(carry, t):
+        arriving, outputs = carry
+        # stage 0 injects microbatch t (clamped: past M it re-reads the
+        # last microbatch, whose result never lands in `outputs`)
+        fresh = _varying(
+            lax.dynamic_index_in_dim(
+                x, jnp.clip(t, 0, num_micro - 1), axis=0, keepdims=False
+            )
+        )
+        inp = jnp.where(stage == 0, fresh, arriving)
+        out = stage_fn(stage_params, inp)
+        # the last stage finished microbatch t-(S-1) this tick
+        done_idx = t - (num_stages - 1)
+        write = is_last & (done_idx >= 0)
+        cand = lax.dynamic_update_index_in_dim(
+            outputs, out, jnp.clip(done_idx, 0, num_micro - 1), axis=0
+        )
+        outputs = jnp.where(write, cand, outputs)
+        arriving = lax.ppermute(out, axis_name, perm)
+        return (arriving, outputs), None
+
+    init = (
+        _varying(jnp.zeros_like(x[0])),
+        _varying(jnp.zeros_like(x)),
+    )
+    (_, outputs), _ = lax.scan(
+        tick, init, jnp.arange(num_micro + num_stages - 1)
+    )
+    # only the last stage holds real outputs; broadcast to every stage so
+    # the caller can use out_specs=P() (replicated)
+    return lax.psum(jnp.where(is_last, outputs, 0), axis_name)
+
+
+def pipeline_reference(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+) -> jax.Array:
+    """Unsharded oracle: apply all S stages sequentially to each microbatch.
+
+    ``stacked_params`` leaves carry the stage axis in front (shape
+    ``(S, ...)``); ``x`` is ``(M, mb, ...)`` as in :func:`pipeline_apply`.
+    """
+    num_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    out = x
+    for s in range(num_stages):
+        params_s = jax.tree_util.tree_map(lambda a: a[s], stacked_params)
+        out = jax.vmap(lambda mb: stage_fn(params_s, mb))(out)
+    return out
