@@ -38,6 +38,7 @@ def _run_example(name: str, timeout: float = 240.0) -> str:
         ("distributed_example.py", "devices"),
         ("llm_eval_example.py", "perplexity="),
         ("multihost_example.py", "done"),
+        ("scaleout_example.py", "scaleout done"),
     ],
 )
 def test_example_runs(name, marker):
