@@ -111,6 +111,35 @@ def test_small_weights_not_shrunk():
     assert float(v) == 1.0
 
 
+def test_accumulate_requires_bounds():
+    """bounds=None would sum histograms with per-batch bin edges — the
+    accumulate entry must refuse it loudly."""
+    from torcheval_tpu.ops.fused_auc import fused_auc_histogram_accumulate
+
+    h = jnp.zeros((1, 2, 64), jnp.float32)
+    with pytest.raises(ValueError, match="requires fixed bounds"):
+        fused_auc_histogram_accumulate(
+            h, jnp.ones(4), jnp.ones(4), num_bins=64, bounds=None
+        )
+
+
+def test_accumulate_matches_oneshot_multitask():
+    """Streaming accumulation over batches == one-shot histogram of the
+    concatenation, for tasks > 1 (the real-TPU Pallas tiling regression:
+    blocks over a (T>1, n) array must keep every block dim equal to its
+    array dim)."""
+    from torcheval_tpu.ops.fused_auc import fused_auc_histogram_accumulate
+
+    s, t = _informative(3000, tasks=2)
+    h = jnp.zeros((2, 2, 256), jnp.float32)
+    for lo, hi in ((0, 1000), (1000, 3000)):
+        h = fused_auc_histogram_accumulate(
+            h, s[:, lo:hi], t[:, lo:hi], num_bins=256, bounds=(0.0, 1.0)
+        )
+    oneshot = fused_auc_histogram(s, t, num_bins=256, bounds=(0.0, 1.0))
+    np.testing.assert_allclose(np.asarray(h), np.asarray(oneshot), atol=1e-3)
+
+
 def test_unbounded_scores_logits():
     """Regression: scores outside [0, 1] (logits) are rank-normalized, not
     clamped into the edge bins."""

@@ -31,8 +31,8 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 from torcheval_tpu.ops.fused_auc import (
     DEFAULT_NUM_BINS,
-    _auc_from_hist,
-    fused_auc_histogram,
+    _auc_from_hist_fused,
+    fused_auc_histogram_accumulate,
 )
 
 TStreamingBinaryAUROC = TypeVar(
@@ -130,17 +130,17 @@ class StreamingBinaryAUROC(Metric[jax.Array]):
         if weight is not None:
             weight = self._input_float(weight)
         _binary_auroc_update_input_check(input, target, self.num_tasks, weight)
-        batch_hist = fused_auc_histogram(
+        # one fused dispatch: prep + clip + histogram backend + accumulate
+        self.hist = fused_auc_histogram_accumulate(
+            self.hist,
             input,
             target,
             weight,
             num_bins=self.num_bins,
             bounds=self.bounds,
         )
-        self.hist = self.hist + batch_hist
         return self
 
     def compute(self) -> jax.Array:
         """AUROC from the histogram; scalar for ``num_tasks == 1``."""
-        auc = _auc_from_hist(self.hist)
-        return auc[0] if self.num_tasks == 1 else auc
+        return _auc_from_hist_fused(self.hist, squeeze=self.num_tasks == 1)

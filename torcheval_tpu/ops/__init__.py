@@ -1,6 +1,11 @@
 from torcheval_tpu.ops.fused_auc import (
     fused_auc,
     fused_auc_histogram,
+    fused_auc_histogram_accumulate,
 )
 
-__all__ = ["fused_auc", "fused_auc_histogram"]
+__all__ = [
+    "fused_auc",
+    "fused_auc_histogram",
+    "fused_auc_histogram_accumulate",
+]

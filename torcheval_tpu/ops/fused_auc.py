@@ -41,7 +41,7 @@ _LANE = 128
 
 
 def _auc_from_hist(hist: jax.Array) -> jax.Array:
-    """(T, 2, B) weight histograms -> (T,) AUC."""
+    """(T, 2, B) weight histograms -> (T,) AUC. Jit-traceable."""
     wpos = hist[:, 0, :]
     wneg = hist[:, 1, :]
     total_pos = jnp.sum(wpos, axis=-1, keepdims=True)
@@ -50,6 +50,15 @@ def _auc_from_hist(hist: jax.Array) -> jax.Array:
     denom = total_pos[:, 0] * jnp.sum(wneg, axis=-1)
     # degenerate single-class tasks -> 0.5 (reference auroc.py:115-152)
     return jnp.where(denom > 0, num / jnp.where(denom > 0, denom, 1.0), 0.5)
+
+
+@functools.partial(jax.jit, static_argnames=("squeeze",))
+def _auc_from_hist_fused(hist: jax.Array, *, squeeze: bool) -> jax.Array:
+    """One-dispatch eager entry for the histogram->AUC reduction (the raw
+    helper issues ~8 eager ops per call — each a tunnel round-trip on a
+    remote TPU)."""
+    auc = _auc_from_hist(hist)
+    return auc[0] if squeeze else auc
 
 
 def _as_2d(
@@ -73,7 +82,6 @@ def _as_2d(
 
 # --------------------------------------------------------------------- xla
 
-@jax.jit
 def _normalize_scores(scores: jax.Array) -> jax.Array:
     """Per-task min/max rescale to [0, 1] — AUC is a rank statistic,
     invariant under monotone transforms, so this makes the binned kernel
@@ -172,8 +180,13 @@ def _histogram_pallas(
     bin_tile = min(_BIN_TILE, num_bins)
     bins_padded = -(-num_bins // bin_tile) * bin_tile  # top pad bins stay 0
 
-    grid = (num_tasks, bins_padded // bin_tile, n_padded // _CHUNK)
-    hist = pl.pallas_call(
+    # One pallas_call per task, unrolled into the same XLA program: Mosaic's
+    # tiling rule demands the block's second-to-last dim divide 8 OR equal
+    # the array dim — a (1, CHUNK) block over a (T>1, n) array satisfies
+    # neither (interpret mode never checks this, only a real TPU does).
+    # Task-dim-1 slices keep every block dim equal to its array dim.
+    grid = (1, bins_padded // bin_tile, n_padded // _CHUNK)
+    call = pl.pallas_call(
         functools.partial(_hist_kernel, num_bins),
         grid=grid,
         in_specs=[
@@ -182,11 +195,14 @@ def _histogram_pallas(
             pl.BlockSpec((1, _CHUNK), lambda t, b, k: (t, k)),
         ],
         out_specs=pl.BlockSpec((1, 2, bin_tile), lambda t, b, k: (t, 0, b)),
-        out_shape=jax.ShapeDtypeStruct(
-            (num_tasks, 2, bins_padded), jnp.float32
-        ),
+        out_shape=jax.ShapeDtypeStruct((1, 2, bins_padded), jnp.float32),
         interpret=interpret,
-    )(scores, wpos, wneg)
+    )
+    rows = [
+        call(scores[t : t + 1], wpos[t : t + 1], wneg[t : t + 1])
+        for t in range(num_tasks)
+    ]
+    hist = rows[0] if num_tasks == 1 else jnp.concatenate(rows, axis=0)
     return hist[:, :, :num_bins]
 
 
@@ -197,11 +213,8 @@ def _histogram_native(
     labels: jax.Array,
     weights: jax.Array,
     num_bins: int,
-) -> Optional[jax.Array]:
-    from torcheval_tpu.ops import native
-
-    if not native.ensure_registered():
-        return None
+) -> jax.Array:
+    """Caller must have confirmed native.ensure_registered() eagerly."""
     call = jax.ffi.ffi_call(
         "torcheval_fused_auc_histogram",
         jax.ShapeDtypeStruct((scores.shape[0], 2, num_bins), jnp.float32),
@@ -210,6 +223,91 @@ def _histogram_native(
 
 
 # ---------------------------------------------------------------- dispatch
+
+def _platform_of(x: jax.Array) -> str:
+    try:
+        return x.devices().pop().platform
+    except Exception:  # tracer inside jit: fall back to the default backend
+        return jax.default_backend()
+
+
+def _resolve_backend(backend: str, platform: str) -> Tuple[str, bool]:
+    """-> (backend, pallas_interpret). Must run eagerly (touches the native
+    registry); the result feeds the jitted kernels as static args."""
+    if backend == "auto":
+        if platform == "tpu":
+            backend = "pallas"
+        elif platform == "cpu":
+            # C++ custom-call registered for cpu only
+            from torcheval_tpu.ops import native
+
+            backend = "native" if native.ensure_registered() else "xla"
+        else:
+            backend = "xla"
+    elif backend == "native":
+        from torcheval_tpu.ops import native
+
+        if not native.ensure_registered():
+            backend = "xla"
+    elif backend not in ("pallas", "xla"):
+        raise ValueError(
+            f"backend must be auto|pallas|native|xla, got {backend!r}."
+        )
+    # compiled Pallas needs a real TPU under the data; anywhere else
+    # (including CPU-committed arrays with a live TPU plugin) interpret
+    return backend, backend == "pallas" and platform != "tpu"
+
+
+def _histogram_impl(scores, labels, weights, num_bins, bounds, backend,
+                    interpret):
+    """Traceable body shared by the one-shot and accumulate entry points."""
+    scores, labels, weights, _ = _as_2d(scores, labels, weights)
+    if bounds is None:
+        scores = _normalize_scores(scores)
+    else:
+        lo, hi = bounds
+        scores = jnp.clip((scores - lo) / (hi - lo), 0.0, 1.0)
+    if backend == "pallas":
+        return _histogram_pallas(
+            scores, labels, weights, num_bins, interpret=interpret
+        )
+    if backend == "native":
+        return _histogram_native(scores, labels, weights, num_bins)
+    return _histogram_xla(scores, labels, weights, num_bins)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins", "bounds", "backend", "interpret"),
+)
+def _histogram_fused(scores, labels, weights, *, num_bins, bounds, backend,
+                     interpret):
+    return _histogram_impl(
+        scores, labels, weights, num_bins, bounds, backend, interpret
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins", "bounds", "backend", "interpret"),
+)
+def _histogram_accumulate(hist, scores, labels, weights, *, num_bins,
+                          bounds, backend, interpret):
+    return hist + _histogram_impl(
+        scores, labels, weights, num_bins, bounds, backend, interpret
+    )
+
+
+def _check_bounds(
+    bounds: Optional[Tuple[float, float]],
+) -> Optional[Tuple[float, float]]:
+    if bounds is None:
+        return None
+    lo, hi = float(bounds[0]), float(bounds[1])
+    if not hi > lo:
+        raise ValueError(f"bounds must satisfy hi > lo, got ({lo}, {hi}).")
+    return lo, hi
+
 
 def fused_auc_histogram(
     input,
@@ -221,7 +319,7 @@ def fused_auc_histogram(
     bounds: Optional[Tuple[float, float]] = None,
 ) -> jax.Array:
     """(num_tasks, 2, num_bins) positive/negative weight histograms of the
-    scores.
+    scores, produced by ONE fused dispatch (prep + normalize + binning).
 
     ``bounds``: when ``None`` (default) scores are min/max-normalized **per
     call, per task** — the resulting histogram is only a valid AUC statistic
@@ -234,45 +332,39 @@ def fused_auc_histogram(
 
     ``backend``: ``auto`` | ``pallas`` | ``native`` | ``xla``.
     """
-    scores, labels, weights, _ = _as_2d(
-        jnp.asarray(input), jnp.asarray(target), weight
+    scores = jnp.asarray(input)
+    backend, interpret = _resolve_backend(backend, _platform_of(scores))
+    return _histogram_fused(
+        scores, jnp.asarray(target), weight, num_bins=num_bins,
+        bounds=_check_bounds(bounds), backend=backend, interpret=interpret,
     )
+
+
+def fused_auc_histogram_accumulate(
+    hist: jax.Array,
+    input,
+    target,
+    weight=None,
+    *,
+    num_bins: int = DEFAULT_NUM_BINS,
+    backend: str = "auto",
+    bounds: Tuple[float, float] = (0.0, 1.0),
+) -> jax.Array:
+    """``hist + histogram(batch)`` in ONE dispatch — the streaming-metric
+    hot path (``StreamingBinaryAUROC.update``). ``bounds`` is required
+    (fixed bin edges are what make accumulation meaningful; see
+    ``fused_auc_histogram``)."""
     if bounds is None:
-        scores = _normalize_scores(scores)
-    else:
-        lo, hi = bounds
-        if not hi > lo:
-            raise ValueError(
-                f"bounds must satisfy hi > lo, got ({lo}, {hi})."
-            )
-        scores = jnp.clip((scores - lo) / (hi - lo), 0.0, 1.0)
-    try:
-        platform = scores.devices().pop().platform
-    except Exception:  # tracer inside jit: fall back to the default backend
-        platform = jax.default_backend()
-    if backend == "auto":
-        if platform == "tpu":
-            backend = "pallas"
-        elif platform == "cpu":
-            backend = "native"  # C++ custom-call registered for cpu only
-        else:
-            backend = "xla"
-    if backend == "pallas":
-        # compiled Pallas needs a real TPU under the data; anywhere else
-        # (including CPU-committed arrays with a live TPU plugin) interpret
-        interpret = platform != "tpu"
-        return _histogram_pallas(
-            scores, labels, weights, num_bins, interpret=interpret
+        raise ValueError(
+            "fused_auc_histogram_accumulate requires fixed bounds: with "
+            "bounds=None each batch would be min/max-normalized to its own "
+            "bin edges, and summing such histograms is meaningless."
         )
-    if backend == "native":
-        hist = _histogram_native(scores, labels, weights, num_bins)
-        if hist is not None:
-            return hist
-        backend = "xla"
-    if backend == "xla":
-        return _histogram_xla(scores, labels, weights, num_bins)
-    raise ValueError(
-        f"backend must be auto|pallas|native|xla, got {backend!r}."
+    scores = jnp.asarray(input)
+    backend, interpret = _resolve_backend(backend, _platform_of(hist))
+    return _histogram_accumulate(
+        hist, scores, jnp.asarray(target), weight, num_bins=num_bins,
+        bounds=_check_bounds(bounds), backend=backend, interpret=interpret,
     )
 
 
@@ -301,5 +393,4 @@ def fused_auc(
         input, target, weight, num_bins=num_bins, backend=backend,
         bounds=bounds,
     )
-    auc = _auc_from_hist(hist)
-    return auc[0] if squeeze else auc
+    return _auc_from_hist_fused(hist, squeeze=squeeze)
