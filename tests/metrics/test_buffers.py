@@ -22,7 +22,7 @@ from torcheval_tpu.metrics import (
     Cat,
     MulticlassAUROC,
 )
-from torcheval_tpu.metrics._buffer import MIN_CAPACITY, _write_at, next_capacity
+from torcheval_tpu.metrics._buffer import MIN_CAPACITY, _write_all, next_capacity
 from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_compute_jit,
 )
@@ -44,7 +44,7 @@ def test_next_capacity():
 def test_update_compiles_o_log_n():
     """100 growing updates must stay within the O(log n) compile budget."""
     batch = 37
-    writes_before = _write_at._cache_size()
+    writes_before = _write_all._cache_size()
     computes_before = _binary_auroc_compute_jit._cache_size()
 
     m = BinaryAUROC()
@@ -57,8 +57,8 @@ def test_update_compiles_o_log_n():
 
     assert m.num_samples == 100 * batch
     # distinct capacities touched: 64..4096 -> 7; one write program per
-    # (capacity, batch-shape) pair
-    assert _write_at._cache_size() - writes_before <= 8
+    # (capacity, batch-shape) pair, covering ALL buffers of the metric
+    assert _write_all._cache_size() - writes_before <= 8
     # compute kernel compiles once per capacity, NOT per count
     assert _binary_auroc_compute_jit._cache_size() - computes_before <= 8
 

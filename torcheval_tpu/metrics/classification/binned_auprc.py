@@ -25,14 +25,41 @@ from torcheval_tpu.metrics.functional.classification.binned_auprc import (
     _multiclass_binned_auprc_param_check,
     _multilabel_binned_auprc_param_check,
 )
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
     _binary_binned_update_jit,
-    _multiclass_binned_precision_recall_curve_update,
-    _multilabel_binned_precision_recall_curve_update,
+    _multiclass_binned_update_memory_jit,
+    _multiclass_binned_update_vectorized_jit,
+    _multilabel_binned_update_memory_jit,
+    _multilabel_binned_update_vectorized_jit,
     _optimization_param_check,
 )
 from torcheval_tpu.metrics.functional.tensor_utils import create_threshold_tensor
 from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+
+def _binary_binned_update_flat(input, target, threshold):
+    """num_tasks=1: accept the reference's permitted (1, N) form without
+    letting it broadcast the (T,) counter states to (1, T)."""
+    return _binary_binned_update_jit(
+        input.reshape(-1), target.reshape(-1), threshold
+    )
+
+
+def _binary_binned_update_per_task(input, target, threshold):
+    return jax.vmap(_binary_binned_update_jit, in_axes=(0, 0, None))(
+        input, target, threshold
+    )
+
+
+_MULTICLASS_KERNELS = {
+    "vectorized": _multiclass_binned_update_vectorized_jit,
+    "memory": _multiclass_binned_update_memory_jit,
+}
+_MULTILABEL_KERNELS = {
+    "vectorized": _multilabel_binned_update_vectorized_jit,
+    "memory": _multilabel_binned_update_memory_jit,
+}
 
 
 class BinaryBinnedAUPRC(Metric[jax.Array]):
@@ -72,19 +99,17 @@ class BinaryBinnedAUPRC(Metric[jax.Array]):
     def update(self, input, target) -> "BinaryBinnedAUPRC":
         input, target = self._input(input), self._input(target)
         _binary_auprc_update_input_check(input, target, self.num_tasks)
-        if self.num_tasks == 1:
-            # accept the reference's permitted (1, N) form without letting it
-            # broadcast the (T,) counter states to (1, T)
-            tp, fp, fn = _binary_binned_update_jit(
-                input.reshape(-1), target.reshape(-1), self.threshold
-            )
-        else:
-            tp, fp, fn = jax.vmap(
-                lambda x, t: _binary_binned_update_jit(x, t, self.threshold)
-            )(input, target)
-        self.num_tp = self.num_tp + tp
-        self.num_fp = self.num_fp + fp
-        self.num_fn = self.num_fn + fn
+        kernel = (
+            _binary_binned_update_flat
+            if self.num_tasks == 1
+            else _binary_binned_update_per_task
+        )
+        # one fused dispatch: binning kernel + the three counter adds
+        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+            kernel,
+            (self.num_tp, self.num_fp, self.num_fn),
+            (input, target, self.threshold),
+        )
         return self
 
     def compute(self) -> jax.Array:
@@ -125,12 +150,12 @@ class MulticlassBinnedAUPRC(Metric[jax.Array]):
     def update(self, input, target) -> "MulticlassBinnedAUPRC":
         input, target = self._input(input), self._input(target)
         _multiclass_auprc_update_input_check(input, target, self.num_classes)
-        tp, fp, fn = _multiclass_binned_precision_recall_curve_update(
-            input, target, self.num_classes, self.threshold, self.optimization
+        # one fused dispatch: binning kernel + the three counter adds
+        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+            _MULTICLASS_KERNELS[self.optimization],
+            (self.num_tp, self.num_fp, self.num_fn),
+            (input, target, self.threshold),
         )
-        self.num_tp = self.num_tp + tp
-        self.num_fp = self.num_fp + fp
-        self.num_fn = self.num_fn + fn
         return self
 
     def compute(self) -> jax.Array:
@@ -174,12 +199,12 @@ class MultilabelBinnedAUPRC(Metric[jax.Array]):
     def update(self, input, target) -> "MultilabelBinnedAUPRC":
         input, target = self._input(input), self._input(target)
         _multilabel_auprc_update_input_check(input, target, self.num_labels)
-        tp, fp, fn = _multilabel_binned_precision_recall_curve_update(
-            input, target, self.num_labels, self.threshold, self.optimization
+        # one fused dispatch: binning kernel + the three counter adds
+        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+            _MULTILABEL_KERNELS[self.optimization],
+            (self.num_tp, self.num_fp, self.num_fn),
+            (input, target, self.threshold),
         )
-        self.num_tp = self.num_tp + tp
-        self.num_fp = self.num_fp + fp
-        self.num_fn = self.num_fn + fn
         return self
 
     def compute(self) -> jax.Array:

@@ -12,14 +12,22 @@ from typing import List, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
     DEFAULT_NUM_THRESHOLD,
     _binary_binned_compute_jit,
-    _binary_binned_precision_recall_curve_update,
+    _binary_binned_update_jit,
     _multiclass_binned_precision_recall_curve_compute,
-    _multiclass_binned_precision_recall_curve_update,
-    _multilabel_binned_precision_recall_curve_update,
+    _multiclass_binned_update_memory_jit,
+    _multiclass_binned_update_vectorized_jit,
+    _multilabel_binned_update_memory_jit,
+    _multilabel_binned_update_vectorized_jit,
     _optimization_param_check,
+)
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_update_input_check,
+    _multiclass_precision_recall_curve_update_input_check,
+    _multilabel_precision_recall_curve_update_input_check,
 )
 from torcheval_tpu.metrics.functional.tensor_utils import create_threshold_tensor
 from torcheval_tpu.metrics.metric import MergeKind, Metric
@@ -57,12 +65,13 @@ class BinaryBinnedPrecisionRecallCurve(
 
     def update(self, input, target) -> "BinaryBinnedPrecisionRecallCurve":
         input, target = self._input(input), self._input(target)
-        tp, fp, fn = _binary_binned_precision_recall_curve_update(
-            input, target, self.threshold
+        _binary_precision_recall_curve_update_input_check(input, target)
+        # one fused dispatch: binning kernel + the three counter adds
+        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+            _binary_binned_update_jit,
+            (self.num_tp, self.num_fp, self.num_fn),
+            (input, target, self.threshold),
         )
-        self.num_tp = self.num_tp + tp
-        self.num_fp = self.num_fp + fp
-        self.num_fn = self.num_fn + fn
         return self
 
     def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -101,12 +110,20 @@ class MulticlassBinnedPrecisionRecallCurve(
 
     def update(self, input, target) -> "MulticlassBinnedPrecisionRecallCurve":
         input, target = self._input(input), self._input(target)
-        tp, fp, fn = _multiclass_binned_precision_recall_curve_update(
-            input, target, self.num_classes, self.threshold, self.optimization
+        _multiclass_precision_recall_curve_update_input_check(
+            input, target, self.num_classes
         )
-        self.num_tp = self.num_tp + tp
-        self.num_fp = self.num_fp + fp
-        self.num_fn = self.num_fn + fn
+        kernel = (
+            _multiclass_binned_update_vectorized_jit
+            if self.optimization == "vectorized"
+            else _multiclass_binned_update_memory_jit
+        )
+        # one fused dispatch: binning kernel + the three counter adds
+        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+            kernel,
+            (self.num_tp, self.num_fp, self.num_fn),
+            (input, target, self.threshold),
+        )
         return self
 
     def compute(self) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
@@ -144,12 +161,20 @@ class MultilabelBinnedPrecisionRecallCurve(
 
     def update(self, input, target) -> "MultilabelBinnedPrecisionRecallCurve":
         input, target = self._input(input), self._input(target)
-        tp, fp, fn = _multilabel_binned_precision_recall_curve_update(
-            input, target, self.num_labels, self.threshold, self.optimization
+        _multilabel_precision_recall_curve_update_input_check(
+            input, target, self.num_labels
         )
-        self.num_tp = self.num_tp + tp
-        self.num_fp = self.num_fp + fp
-        self.num_fn = self.num_fn + fn
+        kernel = (
+            _multilabel_binned_update_vectorized_jit
+            if self.optimization == "vectorized"
+            else _multilabel_binned_update_memory_jit
+        )
+        # one fused dispatch: binning kernel + the three counter adds
+        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+            kernel,
+            (self.num_tp, self.num_fp, self.num_fn),
+            (input, target, self.threshold),
+        )
         return self
 
     def compute(self) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:

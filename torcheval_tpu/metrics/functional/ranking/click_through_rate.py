@@ -13,7 +13,7 @@ from typing import Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.utils.convert import to_jax, to_jax_float
+from torcheval_tpu.utils.convert import cached_scalar, to_jax, to_jax_float
 
 
 @jax.jit
@@ -33,16 +33,38 @@ def _ctr_update_scalar(
     return click_total, weight_total
 
 
+def resolve_ctr_weights(
+    input: jax.Array,
+    weights: Union[jax.Array, float, int],
+    *,
+    num_tasks: int,
+    convert=to_jax_float,
+) -> Tuple:
+    """Split CTR ``weights`` into the scalar/tensor kernel and its args —
+    the single home of the weight validation and scalar coercion shared by
+    the functional wrapper and both class update paths (the CTR analogue
+    of ``convert.resolve_weight``), so accepted inputs and error messages
+    cannot drift between them. Returns ``(kernel, kernel_args)``; scalar
+    weights become a cached device scalar (``jnp.float32(w)`` would upload
+    per call), tensor weights go through ``convert`` (the metric-device
+    placement hook for class callers)."""
+    is_scalar = isinstance(weights, (float, int))
+    weights_arr = None if is_scalar else convert(weights)
+    _click_through_rate_input_check(
+        input, weights_arr, is_scalar, num_tasks=num_tasks
+    )
+    if is_scalar:
+        return _ctr_update_scalar, (input, cached_scalar(float(weights)))
+    return _ctr_update_weighted, (input, weights_arr)
+
+
 def _click_through_rate_update(
     input, weights: Union[jax.Array, float, int] = 1.0, *, num_tasks: int
 ) -> Tuple[jax.Array, jax.Array]:
-    input = to_jax(input)
-    is_scalar = isinstance(weights, (float, int))
-    weights_arr = None if is_scalar else to_jax_float(weights)
-    _click_through_rate_input_check(input, weights_arr, is_scalar, num_tasks=num_tasks)
-    if is_scalar:
-        return _ctr_update_scalar(input, jnp.float32(weights))
-    return _ctr_update_weighted(input, weights_arr)
+    kernel, args = resolve_ctr_weights(
+        to_jax(input), weights, num_tasks=num_tasks
+    )
+    return kernel(*args)
 
 
 @jax.jit

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
 TFrechetInceptionDistance = TypeVar(
@@ -192,15 +193,23 @@ class FrechetInceptionDistance(Metric[jax.Array]):
         self._FID_update_input_check(images=images, is_real=is_real)
         images = images.astype(jnp.float32)
         activations = self.model(images)
-        act_sum, act_cov, batch = _fid_accumulate(activations)
+        # one fused dispatch: sum/cov/count kernel + the three counter adds
         if is_real:
-            self.num_real_images = self.num_real_images + batch
-            self.real_sum = self.real_sum + act_sum
-            self.real_cov_sum = self.real_cov_sum + act_cov
+            self.real_sum, self.real_cov_sum, self.num_real_images = (
+                fused_accumulate(
+                    _fid_accumulate,
+                    (self.real_sum, self.real_cov_sum, self.num_real_images),
+                    (activations,),
+                )
+            )
         else:
-            self.num_fake_images = self.num_fake_images + batch
-            self.fake_sum = self.fake_sum + act_sum
-            self.fake_cov_sum = self.fake_cov_sum + act_cov
+            self.fake_sum, self.fake_cov_sum, self.num_fake_images = (
+                fused_accumulate(
+                    _fid_accumulate,
+                    (self.fake_sum, self.fake_cov_sum, self.num_fake_images),
+                    (activations,),
+                )
+            )
         return self
 
     def compute(self) -> jax.Array:

@@ -16,9 +16,7 @@ import jax.numpy as jnp
 from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.ranking.click_through_rate import (
     _click_through_rate_compute,
-    _click_through_rate_input_check,
-    _ctr_update_scalar,
-    _ctr_update_weighted,
+    resolve_ctr_weights,
 )
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
@@ -60,23 +58,16 @@ class ClickThroughRate(Metric[jax.Array]):
         weights: Union[jax.Array, float, int] = 1.0,
     ) -> TClickThroughRate:
         """Accumulate click events (and optional per-event weights)."""
-        input = self._input(input)
-        is_scalar = isinstance(weights, (float, int))
-        weights_arr = None if is_scalar else self._input_float(weights)
-        _click_through_rate_input_check(
-            input, weights_arr, is_scalar, num_tasks=self.num_tasks
+        kernel, args = resolve_ctr_weights(
+            self._input(input),
+            weights,
+            num_tasks=self.num_tasks,
+            convert=self._input_float,
         )
-        states = (self.click_total, self.weight_total)
         # one fused dispatch: CTR kernel + the two counter adds
-        if is_scalar:
-            states = fused_accumulate(
-                _ctr_update_scalar, states, (input, jnp.float32(weights))
-            )
-        else:
-            states = fused_accumulate(
-                _ctr_update_weighted, states, (input, weights_arr)
-            )
-        self.click_total, self.weight_total = states
+        self.click_total, self.weight_total = fused_accumulate(
+            kernel, (self.click_total, self.weight_total), args
+        )
         return self
 
     def compute(self) -> jax.Array:

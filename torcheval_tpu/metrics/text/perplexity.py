@@ -12,9 +12,13 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.text.perplexity import (
     _perplexity_compute,
-    _perplexity_update,
+    _perplexity_input_check,
+    _perplexity_update_jit,
+    _perplexity_update_native_jit,
+    _use_native_ce,
 )
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
@@ -65,11 +69,21 @@ class Perplexity(Metric[jax.Array]):
             input: logits, shape (n_samples, seq_len, vocab_size).
             target: vocab indices, shape (n_samples, seq_len).
         """
-        sum_log_probs, num_total = _perplexity_update(
-            self._input_float(input), self._input(target), self.ignore_index
+        input = self._input_float(input)
+        target = self._input(target)
+        _perplexity_input_check(input, target, self.ignore_index)
+        kernel = (
+            _perplexity_update_native_jit
+            if input.dtype == jnp.float32 and _use_native_ce(input)
+            else _perplexity_update_jit
         )
-        self.sum_log_probs = self.sum_log_probs + sum_log_probs
-        self.num_total = self.num_total + num_total
+        # one fused dispatch: NLL kernel + both counter adds
+        self.sum_log_probs, self.num_total = fused_accumulate(
+            kernel,
+            (self.sum_log_probs, self.num_total),
+            (input, target),
+            config=(self.ignore_index,),
+        )
         return self
 
     def compute(self) -> jax.Array:

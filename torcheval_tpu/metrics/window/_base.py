@@ -31,11 +31,14 @@ from torcheval_tpu.metrics.metric import MergeKind, Metric
 
 TWindowed = TypeVar("TWindowed", bound="WindowedTaskCounterMetric")
 
+# (kernel, config, counter names, lifetime flag) -> jitted fused update
+_RECORD_VIA_CACHE: dict = {}
 
 
-@jax.jit
-def _ring_write(buf: jax.Array, col: jax.Array, value: jax.Array) -> jax.Array:
-    return buf.at[:, col].set(value)
+
+def _identity_kernel(*values):
+    """Pre-computed counter values pass straight through ``_record_via``."""
+    return values
 
 
 class RingCursorSerializationMixin:
@@ -117,20 +120,66 @@ class WindowedTaskCounterMetric(RingCursorSerializationMixin, Metric):
     # ------------------------------------------------------------- accumulate
 
     def _record(self, counter_values: Sequence[jax.Array]) -> None:
-        """Write one update's counters into the ring (and lifetime) states."""
-        if self.enable_lifetime:
-            for name, value in zip(self._counter_names, counter_values):
-                # `+` broadcasts the reference's scalar->vector state
-                # promotion (reference window/mean_squared_error.py:141-145)
-                setattr(self, name, getattr(self, name) + value)
-        # traced column index (cached device scalar): baking the Python int
-        # into the eager .at[].set would compile one program per ring slot
-        # and upload constants per call; the cursor itself stays a host int
+        """Write one update's pre-computed counters into the ring (and
+        lifetime) states. Prefer :meth:`_record_via` where the producing
+        kernel is jittable — it fuses the kernel into the same dispatch."""
+        self._record_via(_identity_kernel, tuple(counter_values))
+
+    def _record_via(
+        self, kernel, dynamic: tuple, config: tuple = ()
+    ) -> None:
+        """``kernel(*dynamic, *config) -> counter values``, fused with the
+        lifetime accumulates and ring-column writes into ONE dispatch (the
+        separate kernel + record calls each cost a device round-trip on a
+        remote TPU). ``kernel`` and ``config`` entries must be hashable —
+        they key the trace cache; input validation stays with the caller.
+
+        `+` broadcasts the reference's scalar->vector state promotion
+        (reference window/mean_squared_error.py:141-145). The traced column
+        index is a cached device scalar: baking the Python int into an
+        eager ``.at[].set`` would compile one program per ring slot and
+        upload constants per call; the cursor itself stays a host int.
+        """
+        names = self._counter_names
+        key = (kernel, config, names, self.enable_lifetime)
+        fn = _RECORD_VIA_CACHE.get(key)
+        if fn is None:
+
+            def fused(lifetime, rings, col, *dyn):
+                deltas = kernel(*dyn, *config)
+                if len(deltas) != len(names):
+                    raise ValueError(
+                        f"kernel {kernel.__name__} returned {len(deltas)} "
+                        f"counter values for {len(names)} counters {names}"
+                    )
+                values = dict(zip(names, deltas))
+                new_lifetime = {
+                    k: lifetime[k] + values[k] for k in lifetime
+                }
+                new_rings = {
+                    k: rings[k].at[:, col].set(values[k]) for k in rings
+                }
+                return new_lifetime, new_rings
+
+            fn = jax.jit(fused)
+            _RECORD_VIA_CACHE[key] = fn
+
+        lifetime = (
+            {name: getattr(self, name) for name in names}
+            if self.enable_lifetime
+            else {}
+        )
+        rings = {
+            name: getattr(self, f"windowed_{name}") for name in names
+        }
         col = self.next_inserted
-        col_dev = cached_index(col)
-        for name, value in zip(self._counter_names, counter_values):
-            buf = getattr(self, f"windowed_{name}")
-            setattr(self, f"windowed_{name}", _ring_write(buf, col_dev, value))
+        new_lifetime, new_rings = fn(
+            lifetime, rings, cached_index(col), *dynamic
+        )
+        for name, value in new_lifetime.items():
+            setattr(self, name, value)
+        for name, value in new_rings.items():
+            setattr(self, f"windowed_{name}", value)
         self.next_inserted = (col + 1) % self.max_num_updates
         self.total_updates += 1
 

@@ -16,7 +16,7 @@ from typing import Iterable, Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.utils.convert import cached_index, default_ones
+from torcheval_tpu.utils.convert import cached_index
 
 from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_compute,
@@ -29,9 +29,41 @@ TWindowedBinaryAUROC = TypeVar("TWindowedBinaryAUROC", bound="WindowedBinaryAURO
 
 
 
+def _stack_batch(input, target, weight):
+    """2-D (tasks, n) views; weight=None becomes all-ones inside the trace
+    (no separate eager default_ones dispatch)."""
+    i2, t2 = jnp.atleast_2d(input), jnp.atleast_2d(target)
+    w2 = jnp.ones_like(i2) if weight is None else jnp.atleast_2d(weight)
+    return i2, t2, w2
+
+
 @jax.jit
-def _ring_write_cols(buf: jax.Array, col: jax.Array, value: jax.Array) -> jax.Array:
-    return jax.lax.dynamic_update_slice(buf, value.astype(buf.dtype), (jnp.int32(0), col))
+def _ring_insert(bufs, col, input, target, weight):
+    """Insert a batch of n < capacity samples at traced column ``col``,
+    wrapping modularly — ONE dispatch covers both the reference's
+    fits-in-rest and wraps cases (reference window/auroc.py:109-154):
+    position ``(col + j) % capacity`` receives sample ``j``, which lands
+    ``batch[:rest]`` on the tail and ``batch[rest:]`` at the front exactly
+    as the two-write formulation did. n < capacity keeps the scatter
+    indices distinct."""
+    cap = bufs[0].shape[1]
+    vals = _stack_batch(input, target, weight)
+    idx = (col + jnp.arange(vals[0].shape[1])) % cap
+    return tuple(
+        b.at[:, idx].set(v.astype(b.dtype)) for b, v in zip(bufs, vals)
+    )
+
+
+@jax.jit
+def _ring_overwrite(bufs, input, target, weight):
+    """Oversized batch (n >= capacity): the window becomes the batch's last
+    ``capacity`` samples (reference window/auroc.py:109-120), cursor 0."""
+    cap = bufs[0].shape[1]
+    vals = _stack_batch(input, target, weight)
+    return tuple(
+        jax.lax.dynamic_update_slice(b, v[:, -cap:].astype(b.dtype), (0, 0))
+        for b, v in zip(bufs, vals)
+    )
 
 
 class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
@@ -77,57 +109,32 @@ class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
         self._add_state("targets", zeros, merge=MergeKind.CUSTOM)
         self._add_state("weights", zeros, merge=MergeKind.CUSTOM)
 
-    def _write(self, name: str, col: int, value: jax.Array) -> None:
-        # traced start column (cached device scalar): an eager .at slice-set
-        # would compile per ring offset and upload constants per call
-        buf = getattr(self, name)
-        setattr(
-            self, name, _ring_write_cols(buf, cached_index(col), value)
-        )
-
     def update(
         self: TWindowedBinaryAUROC,
         input,
         target,
         weight: Optional[jax.Array] = None,
     ) -> TWindowedBinaryAUROC:
-        """Insert a batch of samples into the ring buffers."""
+        """Insert a batch of samples into the ring buffers — one fused
+        dispatch (reshape + wrap-aware write of all three buffers)."""
         input, target = self._input(input), self._input(target)
-        if weight is None:
-            weight = default_ones(input.shape)
-        else:
+        if weight is not None:
             weight = self._input_float(weight)
         _binary_auroc_update_input_check(input, target, self.num_tasks, weight)
-        if input.ndim == 1:
-            input = input.reshape(1, -1)
-            target = target.reshape(1, -1)
-            weight = weight.reshape(1, -1)
-        target = target.astype(jnp.float32)
-        n = input.shape[1]
+        bufs = (self.inputs, self.targets, self.weights)
+        n = input.shape[-1]
         if n >= self.max_num_samples:
             # oversized batch: keep only its last max_num_samples samples
-            self._write("inputs", 0, input[:, -self.max_num_samples :])
-            self._write("targets", 0, target[:, -self.max_num_samples :])
-            self._write("weights", 0, weight[:, -self.max_num_samples :])
+            out = _ring_overwrite(bufs, input, target, weight)
             self.next_inserted = 0
         else:
-            rest = self.max_num_samples - self.next_inserted
-            if n <= rest:
-                self._write("inputs", self.next_inserted, input)
-                self._write("targets", self.next_inserted, target)
-                self._write("weights", self.next_inserted, weight)
-                self.next_inserted += n
-            else:
-                # wrap: first part fills the tail, remainder goes to the front
-                self._write("inputs", self.next_inserted, input[:, :rest])
-                self._write("targets", self.next_inserted, target[:, :rest])
-                self._write("weights", self.next_inserted, weight[:, :rest])
-                remainder = n - rest
-                self._write("inputs", 0, input[:, -remainder:])
-                self._write("targets", 0, target[:, -remainder:])
-                self._write("weights", 0, weight[:, -remainder:])
-                self.next_inserted = remainder
-        self.next_inserted %= self.max_num_samples
+            out = _ring_insert(
+                bufs, cached_index(self.next_inserted), input, target, weight
+            )
+            self.next_inserted = (
+                self.next_inserted + n
+            ) % self.max_num_samples
+        self.inputs, self.targets, self.weights = out
         self.total_samples += n
         return self
 

@@ -11,7 +11,7 @@ import jax
 
 from torcheval_tpu.metrics.functional.ranking.click_through_rate import (
     _click_through_rate_compute,
-    _click_through_rate_update,
+    resolve_ctr_weights,
 )
 from torcheval_tpu.metrics.window._base import WindowedTaskCounterMetric
 
@@ -60,13 +60,15 @@ class WindowedClickThroughRate(
         input,
         weights: Union[jax.Array, float, int] = 1.0,
     ) -> TWindowedClickThroughRate:
-        """Accumulate one update's click events into the window."""
-        if not isinstance(weights, (float, int)):
-            weights = self._input_float(weights)
-        click_total, weight_total = _click_through_rate_update(
-            self._input(input), weights, num_tasks=self.num_tasks
+        """Accumulate one update's click events into the window — one fused
+        dispatch (CTR kernel + lifetime + ring write)."""
+        kernel, args = resolve_ctr_weights(
+            self._input(input),
+            weights,
+            num_tasks=self.num_tasks,
+            convert=self._input_float,
         )
-        self._record((click_total, weight_total))
+        self._record_via(kernel, args)
         return self
 
     def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:

@@ -15,8 +15,11 @@ import jax.numpy as jnp
 from torcheval_tpu.metrics.functional.regression.mean_squared_error import (
     _mean_squared_error_compute,
     _mean_squared_error_param_check,
-    _mean_squared_error_update,
+    _mean_squared_error_update_input_check,
+    _update_unweighted,
+    _update_weighted,
 )
+from torcheval_tpu.utils.convert import to_jax_float
 from torcheval_tpu.metrics.window._base import WindowedTaskCounterMetric
 
 TWindowedMeanSquaredError = TypeVar(
@@ -80,13 +83,18 @@ class WindowedMeanSquaredError(WindowedTaskCounterMetric):
         *,
         sample_weight: Optional[jax.Array] = None,
     ) -> TWindowedMeanSquaredError:
-        """Accumulate one batch's squared-error sums into the window."""
+        """Accumulate one batch's squared-error sums into the window — one
+        fused dispatch (MSE kernel + lifetime + ring write)."""
         input, target = self._input_float(input), self._input_float(target)
-        sum_squared_error, sum_weight = _mean_squared_error_update(
-            input, target, sample_weight
-        )
+        _mean_squared_error_update_input_check(input, target, sample_weight)
         self._window_input_check(input)
-        self._record((sum_squared_error, sum_weight))
+        if sample_weight is None:
+            self._record_via(_update_unweighted, (input, target))
+        else:
+            self._record_via(
+                _update_weighted,
+                (input, target, to_jax_float(sample_weight)),
+            )
         return self
 
     def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:

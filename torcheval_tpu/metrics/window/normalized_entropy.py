@@ -14,13 +14,23 @@ import jax
 
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     _baseline_update,
-    _binary_normalized_entropy_update,
+    _ne_input_check,
+    _ne_update_jit,
 )
 from torcheval_tpu.metrics.window._base import WindowedTaskCounterMetric
 
 TWindowedNormalizedEntropy = TypeVar(
     "TWindowedNormalizedEntropy", bound="WindowedBinaryNormalizedEntropy"
 )
+
+
+def _ne_window_kernel(input, target, weight, from_logits):
+    """NE kernel reordered to this class's counter declaration order
+    (total_entropy, num_examples, num_positive)."""
+    ce, num_positive, num_examples = _ne_update_jit(
+        input, target, weight, from_logits
+    )
+    return ce, num_examples, num_positive
 
 
 class WindowedBinaryNormalizedEntropy(WindowedTaskCounterMetric):
@@ -62,13 +72,16 @@ class WindowedBinaryNormalizedEntropy(WindowedTaskCounterMetric):
         *,
         weight: Optional[jax.Array] = None,
     ) -> TWindowedNormalizedEntropy:
-        """Accumulate one batch's entropy counters into the window."""
+        """Accumulate one batch's entropy counters into the window — one
+        fused dispatch (NE kernel + lifetime + ring write)."""
         input, target = self._input(input), self._input(target)
         weight = self._input(weight) if weight is not None else None
-        cross_entropy, num_positive, num_examples = _binary_normalized_entropy_update(
-            input, target, self.from_logits, self.num_tasks, weight
+        _ne_input_check(input, target, self.from_logits, self.num_tasks, weight)
+        self._record_via(
+            _ne_window_kernel,
+            (input, target, weight),
+            config=(self.from_logits,),
         )
-        self._record((cross_entropy, num_examples, num_positive))
         return self
 
     def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:

@@ -13,9 +13,12 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics.functional.ranking.weighted_calibration import (
-    _weighted_calibration_update,
+    _wc_update_scalar,
+    _wc_update_tensor,
+    _weighted_calibration_input_check,
 )
 from torcheval_tpu.metrics.window._base import WindowedTaskCounterMetric
+from torcheval_tpu.utils.convert import resolve_weight
 
 TWindowedWeightedCalibration = TypeVar(
     "TWindowedWeightedCalibration", bound="WindowedWeightedCalibration"
@@ -59,13 +62,18 @@ class WindowedWeightedCalibration(WindowedTaskCounterMetric):
         target,
         weight: Union[float, int, jax.Array] = 1.0,
     ) -> TWindowedWeightedCalibration:
-        """Accumulate one batch into the window."""
+        """Accumulate one batch into the window — one fused dispatch
+        (calibration kernel + lifetime + ring write)."""
+        input = self._input_float(input)
+        target = self._input_float(target)
         if not isinstance(weight, (float, int)):
             weight = self._input_float(weight)
-        sums = _weighted_calibration_update(
-            self._input(input), self._input(target), weight, num_tasks=self.num_tasks
+        _weighted_calibration_input_check(
+            input, target, weight, self.num_tasks
         )
-        self._record(sums)
+        is_scalar, weight_arr = resolve_weight(weight, input)
+        kernel = _wc_update_scalar if is_scalar else _wc_update_tensor
+        self._record_via(kernel, (input, target, weight_arr))
         return self
 
     def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
