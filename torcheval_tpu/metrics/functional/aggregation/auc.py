@@ -13,14 +13,29 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+    sort_desc,
+)
 from torcheval_tpu.metrics.functional.tensor_utils import trapezoid
 from torcheval_tpu.utils.convert import to_jax
+
+
+def _ascending_order(x: jax.Array) -> jax.Array:
+    """Stable ascending argsort along the last axis through the shared
+    curve-sort machinery (native radix on CPU, where XLA's comparison sort
+    is ~15x slower): the stable descending order of ``-x`` is the stable
+    ascending order of ``x`` with identical tie order."""
+    if x.dtype == jnp.bool_:
+        # bool has no negation; jnp.argsort accepted it (so does torch)
+        x = x.astype(jnp.int32)
+    _, order = sort_desc(-x)
+    return order
 
 
 @partial(jax.jit, static_argnames=("reorder",))
 def _auc_compute_jit(x: jax.Array, y: jax.Array, reorder: bool) -> jax.Array:
     if reorder:
-        order = jnp.argsort(x, axis=1, stable=True)
+        order = _ascending_order(x)
         x = jnp.take_along_axis(x, order, axis=1)
         y = jnp.take_along_axis(y, order, axis=1)
     return trapezoid(y, x, axis=1)
@@ -41,7 +56,7 @@ def _auc_compute_masked_jit(
     x = jnp.take_along_axis(x, idx, axis=1)
     y = jnp.take_along_axis(y, idx, axis=1)
     if reorder:
-        order = jnp.argsort(x, axis=1, stable=True)
+        order = _ascending_order(x)
         x = jnp.take_along_axis(x, order, axis=1)
         y = jnp.take_along_axis(y, order, axis=1)
     return trapezoid(y, x, axis=1)
