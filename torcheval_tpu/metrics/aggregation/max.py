@@ -15,6 +15,12 @@ from torcheval_tpu.metrics.metric import MergeKind, Metric
 TMax = TypeVar("TMax", bound="Max")
 
 
+@jax.jit
+def _max_update_jit(state: jax.Array, input: jax.Array) -> jax.Array:
+    # one fused dispatch: reduce + running-max accumulate
+    return jnp.maximum(state, jnp.max(input))
+
+
 class Max(Metric[jax.Array]):
     """Running maximum over all elements of all updates.
 
@@ -30,7 +36,7 @@ class Max(Metric[jax.Array]):
         self._add_state("max", jnp.float32(-jnp.inf), merge=MergeKind.MAX)
 
     def update(self: TMax, input) -> TMax:
-        self.max = jnp.maximum(self.max, jnp.max(self._input_float(input)))
+        self.max = _max_update_jit(self.max, self._input_float(input))
         return self
 
     def compute(self) -> jax.Array:

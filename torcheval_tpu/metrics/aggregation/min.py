@@ -15,6 +15,12 @@ from torcheval_tpu.metrics.metric import MergeKind, Metric
 TMin = TypeVar("TMin", bound="Min")
 
 
+@jax.jit
+def _min_update_jit(state: jax.Array, input: jax.Array) -> jax.Array:
+    # one fused dispatch: reduce + running-min accumulate
+    return jnp.minimum(state, jnp.min(input))
+
+
 class Min(Metric[jax.Array]):
     """Running minimum over all elements of all updates.
 
@@ -30,7 +36,7 @@ class Min(Metric[jax.Array]):
         self._add_state("min", jnp.float32(jnp.inf), merge=MergeKind.MIN)
 
     def update(self: TMin, input) -> TMin:
-        self.min = jnp.minimum(self.min, jnp.min(self._input_float(input)))
+        self.min = _min_update_jit(self.min, self._input_float(input))
         return self
 
     def compute(self) -> jax.Array:

@@ -95,6 +95,7 @@ def _perplexity_update(
     return _perplexity_update_jit(input, target, ignore_index)
 
 
+@jax.jit
 def _perplexity_compute(
     sum_log_probs: jax.Array, num_total: jax.Array
 ) -> jax.Array:

@@ -113,10 +113,29 @@ def cached_index(i: int) -> jax.Array:
     return jnp.asarray(i, dtype=jnp.int32)
 
 
-def default_ones(shape: tuple) -> jax.Array:
-    """All-ones float32 default weights without a per-call constant upload
-    (``jnp.ones_like`` uploads its fill scalar every call)."""
+_ONES_CACHE_MAX_ELEMENTS = 4096
+
+
+@lru_cache(maxsize=128)
+def _cached_ones(shape: tuple) -> jax.Array:
     return jnp.broadcast_to(cached_scalar(1.0), shape)
+
+
+def default_ones(shape: tuple) -> jax.Array:
+    """All-ones float32 default weights, cached per shape for small batches:
+    the eager ``broadcast_to`` is itself one dispatch per call, a measurable
+    tunnel round-trip on a remote TPU (``jnp.ones_like`` additionally
+    uploads its fill scalar every call). Safe to share — the array is
+    immutable and no consumer donates its batch arguments. Shapes over
+    ``_ONES_CACHE_MAX_ELEMENTS`` stay uncached (bounding resident cache
+    memory to ~2 MB worst case; one extra dispatch is negligible against
+    processing a batch that large)."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    if n > _ONES_CACHE_MAX_ELEMENTS:
+        return jnp.broadcast_to(cached_scalar(1.0), shape)
+    return _cached_ones(shape)
 
 
 def resolve_weight(
