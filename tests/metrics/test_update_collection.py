@@ -1,0 +1,138 @@
+"""toolkit.update_collection: K metric updates in one fused dispatch.
+
+Beyond-parity feature built on ``Metric._update_plan`` — correctness is
+pinned against per-metric ``update()`` (identical states afterward), the
+fallback path against non-fusable metrics, and the dispatch structure via
+the compile-count trick from ``test_dispatch_counts``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torcheval_tpu.metrics as M
+from torcheval_tpu.metrics.toolkit import update_collection
+from tests.metrics.test_dispatch_counts import programs_for
+
+RNG = np.random.default_rng(23)
+
+N, C = 128, 8
+XC = jnp.asarray(RNG.uniform(size=(N, C)).astype(np.float32))
+TC = jnp.asarray(RNG.integers(0, C, size=N))
+
+
+def _classification_collection():
+    return {
+        "acc": M.MulticlassAccuracy(),
+        "acc_macro": M.MulticlassAccuracy(average="macro", num_classes=C),
+        "f1": M.MulticlassF1Score(),
+        "precision": M.MulticlassPrecision(num_classes=C, average="macro"),
+        "recall": M.MulticlassRecall(num_classes=C, average="macro"),
+        "cm": M.MulticlassConfusionMatrix(C),
+        "binned_auprc": M.MulticlassBinnedAUPRC(num_classes=C, threshold=16),
+    }
+
+
+def test_matches_individual_updates():
+    grouped = _classification_collection()
+    individual = _classification_collection()
+
+    for lo, hi in ((0, 64), (64, 128)):  # two batches
+        update_collection(grouped, XC[lo:hi], TC[lo:hi])
+        for m in individual.values():
+            m.update(XC[lo:hi], TC[lo:hi])
+
+    for name in grouped:
+        got = jax.tree_util.tree_map(np.asarray, grouped[name].state_dict())
+        want = jax.tree_util.tree_map(
+            np.asarray, individual[name].state_dict()
+        )
+        assert got.keys() == want.keys()
+        for k in got:
+            np.testing.assert_allclose(
+                got[k], want[k], atol=1e-5, err_msg=f"{name}.{k}"
+            )
+
+
+def test_single_dispatch_for_fusable_group():
+    metrics = _classification_collection()
+    update_collection(metrics, XC, TC)  # trace/compile
+    progs = programs_for(lambda: update_collection(metrics, XC, TC))
+    assert len(progs) <= 1, progs
+
+
+def test_fallback_for_non_fusable():
+    """Buffered metrics have no plan; they update normally in the call."""
+    x1 = jnp.asarray(RNG.uniform(size=N).astype(np.float32))
+    t1 = jnp.asarray((RNG.random(N) < 0.5).astype(np.float32))
+    metrics = {
+        "auroc": M.BinaryAUROC(),  # buffered: no plan
+        "acc": M.BinaryAccuracy(),  # fusable
+        "ne": M.BinaryNormalizedEntropy(),  # fusable
+    }
+    update_collection(metrics, x1, t1)
+    assert metrics["auroc"].num_samples == N
+    solo = M.BinaryAccuracy().update(x1, t1)
+    np.testing.assert_allclose(
+        float(metrics["acc"].compute()), float(solo.compute()), atol=1e-6
+    )
+    import sklearn.metrics as skm
+
+    np.testing.assert_allclose(
+        float(metrics["auroc"].compute()),
+        skm.roc_auc_score(np.asarray(t1), np.asarray(x1)),
+        atol=1e-5,
+    )
+
+
+def test_list_input_and_return_identity():
+    ms = [M.Sum(), M.Mean()]
+    out = update_collection(ms, jnp.asarray([1.0, 2.0, 3.0]))
+    assert out is ms
+    assert float(ms[0].compute()) == 6.0
+    np.testing.assert_allclose(float(ms[1].compute()), 2.0)
+
+
+def test_kwargs_flow_through():
+    metrics = {"mse": M.MeanSquaredError(), "r2": M.R2Score()}
+    x = jnp.asarray(RNG.uniform(size=N).astype(np.float32))
+    t = jnp.asarray(RNG.uniform(size=N).astype(np.float32))
+    w = jnp.asarray(RNG.uniform(size=N).astype(np.float32))
+    # mse accepts sample_weight kwarg; r2 does not — so group only the
+    # metrics sharing a signature, as a user would
+    update_collection({"mse": metrics["mse"]}, x, t, sample_weight=w)
+    solo = M.MeanSquaredError().update(x, t, sample_weight=w)
+    np.testing.assert_allclose(
+        float(metrics["mse"].compute()), float(solo.compute()), rtol=1e-6
+    )
+
+
+def test_invalid_input_raises_before_any_state_change():
+    """A bad batch must not partially update the collection: plans run
+    their checks eagerly before the group program executes."""
+    metrics = _classification_collection()
+    with pytest.raises(ValueError):
+        update_collection(metrics, XC, TC[: N // 2])  # shape mismatch
+    for name, m in metrics.items():
+        for k, v in m.state_dict().items():
+            if isinstance(v, jax.Array):
+                assert float(jnp.sum(jnp.abs(v))) == 0.0, (name, k)
+
+
+def test_mixed_collection_no_partial_update_on_bad_batch():
+    """Plan validation runs for EVERY fusable metric before any fallback
+    metric mutates: a batch that fails a fusable metric's check must leave
+    non-fusable (buffered) peers untouched too."""
+    x1 = jnp.asarray(RNG.uniform(size=N).astype(np.float32))
+    t1 = jnp.asarray((RNG.random(N) < 0.5).astype(np.float32))
+    metrics = {
+        "auroc": M.BinaryAUROC(),  # fallback (buffered, no plan)
+        "ne": M.BinaryNormalizedEntropy(num_tasks=2),  # plan rejects 1-D
+    }
+    with pytest.raises(ValueError):
+        update_collection(metrics, x1, t1)
+    assert metrics["auroc"].num_samples == 0  # buffer never touched

@@ -19,6 +19,20 @@ import jax
 _CACHE: Dict[Any, Callable] = {}
 
 
+def _apply_kernel(kernel, config, states, dyn):
+    """Traceable shared body: ``tuple(s + d)`` for the kernel's deltas,
+    with the arity check both the per-metric and group paths rely on."""
+    deltas = kernel(*dyn, *config)
+    if not isinstance(deltas, tuple):
+        deltas = (deltas,)
+    if len(deltas) != len(states):
+        raise ValueError(
+            f"kernel {kernel.__name__} returned {len(deltas)} deltas "
+            f"for {len(states)} states"
+        )
+    return tuple(s + d for s, d in zip(states, deltas))
+
+
 def fused_accumulate(
     kernel: Callable,
     states: Tuple[jax.Array, ...],
@@ -37,16 +51,46 @@ def fused_accumulate(
     if fn is None:
 
         def fused(states, *dyn):
-            deltas = kernel(*dyn, *config)
-            if not isinstance(deltas, tuple):
-                deltas = (deltas,)
-            if len(deltas) != len(states):
-                raise ValueError(
-                    f"kernel {kernel.__name__} returned {len(deltas)} deltas "
-                    f"for {len(states)} states"
-                )
-            return tuple(s + d for s, d in zip(states, deltas))
+            return _apply_kernel(kernel, config, states, dyn)
 
         fn = jax.jit(fused)
         _CACHE[key] = fn
     return fn(states, *dynamic)
+
+
+_GROUP_CACHE: Dict[Any, Callable] = {}
+
+
+def fused_accumulate_group(plans):
+    """Run MANY fusable update plans as ONE jitted dispatch.
+
+    ``plans`` is a sequence of ``(kernel, states, dynamic, config)`` tuples
+    (the per-metric shape ``fused_accumulate`` takes). Returns the new
+    states, one tuple per plan, computed by a single XLA program — the
+    collection analogue of the per-metric fusion: an eval loop updating K
+    counter metrics on one batch pays one device round-trip instead of K.
+
+    XLA additionally CSEs work shared between kernels traced into the same
+    program (e.g. several classification metrics re-deriving argmax of the
+    same logits compute it once).
+    """
+    kernels = tuple(p[0] for p in plans)
+    configs = tuple(p[3] for p in plans)
+    arity = tuple((len(p[1]), len(p[2])) for p in plans)
+    key = (kernels, configs, arity)
+    fn = _GROUP_CACHE.get(key)
+    if fn is None:
+
+        def fused(states_group, dynamic_group):
+            return tuple(
+                _apply_kernel(kernel, config, states, dyn)
+                for kernel, config, states, dyn in zip(
+                    kernels, configs, states_group, dynamic_group
+                )
+            )
+
+        fn = jax.jit(fused)
+        _GROUP_CACHE[key] = fn
+    return fn(
+        tuple(p[1] for p in plans), tuple(p[2] for p in plans)
+    )

@@ -10,7 +10,6 @@ from typing import TypeVar, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.aggregation.mean import (
     _scalar_weight_pair,
     _weighted_sum_pair,
@@ -36,16 +35,18 @@ class Mean(Metric[jax.Array]):
         self._add_state("weighted_sum", jnp.zeros(()), merge=MergeKind.SUM)
         self._add_state("weights", jnp.zeros(()), merge=MergeKind.SUM)
 
-    def update(self: TMean, input, *, weight: Union[float, int, jax.Array] = 1.0) -> TMean:
+    def _update_plan(self: TMean, input, *, weight: Union[float, int, jax.Array] = 1.0):
         input = self._input_float(input)
         is_scalar, weight_arr = resolve_weight(weight, input)
         # one fused dispatch: weighted-sum kernel + the two counter adds
-        self.weighted_sum, self.weights = fused_accumulate(
+        return (
             _scalar_weight_pair if is_scalar else _weighted_sum_pair,
-            (self.weighted_sum, self.weights),
+            ("weighted_sum", "weights"),
             (input, weight_arr),
         )
-        return self
+
+    def update(self: TMean, input, *, weight: Union[float, int, jax.Array] = 1.0) -> TMean:
+        return self._apply_update_plan(self._update_plan(input, weight=weight))
 
     def compute(self) -> jax.Array:
         return self.weighted_sum / self.weights

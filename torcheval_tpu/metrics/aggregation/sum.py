@@ -10,7 +10,6 @@ from typing import TypeVar, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.aggregation.sum import _weighted_total
 from torcheval_tpu.utils.convert import resolve_weight
 from torcheval_tpu.metrics.metric import MergeKind, Metric
@@ -32,14 +31,16 @@ class Sum(Metric[jax.Array]):
         super().__init__(device=device)
         self._add_state("weighted_sum", jnp.zeros(()), merge=MergeKind.SUM)
 
-    def update(self: TSum, input, *, weight: Union[float, int, jax.Array] = 1.0) -> TSum:
+    def _update_plan(self, input, *, weight=1.0):
         input = self._input_float(input)
         _, weight_arr = resolve_weight(weight, input, int_clause=True)
-        # one fused dispatch: weighted-total kernel + the counter add
-        (self.weighted_sum,) = fused_accumulate(
-            _weighted_total, (self.weighted_sum,), (input, weight_arr)
+        return (
+            _weighted_total, ("weighted_sum",), (input, weight_arr), ()
         )
-        return self
+
+    def update(self: TSum, input, *, weight: Union[float, int, jax.Array] = 1.0) -> TSum:
+        # one fused dispatch: weighted-total kernel + the counter add
+        return self._apply_update_plan(self._update_plan(input, weight=weight))
 
     def compute(self) -> jax.Array:
         return self.weighted_sum

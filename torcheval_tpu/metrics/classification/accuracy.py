@@ -13,7 +13,6 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.accuracy import (
     _accuracy_compute,
     _accuracy_param_check,
@@ -75,17 +74,19 @@ class MulticlassAccuracy(Metric[jax.Array]):
                 "num_total", jnp.zeros(num_classes), merge=MergeKind.SUM
             )
 
-    def update(self: TAccuracy, input, target) -> TAccuracy:
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _accuracy_update_input_check(input, target, self.num_classes, self.k)
-        # one fused dispatch: kernel + counter accumulation in one program
-        self.num_correct, self.num_total = fused_accumulate(
+        return (
             _multiclass_accuracy_update,
-            (self.num_correct, self.num_total),
+            ("num_correct", "num_total"),
             (input, target),
             (self.average, self.num_classes, self.k),
         )
-        return self
+
+    def update(self: TAccuracy, input, target) -> TAccuracy:
+        # one fused dispatch: kernel + counter accumulation in one program
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> jax.Array:
         return _accuracy_compute(self.num_correct, self.num_total, self.average)
@@ -107,16 +108,18 @@ class BinaryAccuracy(MulticlassAccuracy):
         super().__init__(device=device)
         self.threshold = threshold
 
-    def update(self, input, target) -> "BinaryAccuracy":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_accuracy_update_input_check(input, target)
-        self.num_correct, self.num_total = fused_accumulate(
+        return (
             _binary_accuracy_update,
-            (self.num_correct, self.num_total),
+            ("num_correct", "num_total"),
             (input, target),
             (float(self.threshold),),
         )
-        return self
+
+    def update(self, input, target) -> "BinaryAccuracy":
+        return self._apply_update_plan(self._update_plan(input, target))
 
 
 class MultilabelAccuracy(MulticlassAccuracy):
@@ -144,16 +147,18 @@ class MultilabelAccuracy(MulticlassAccuracy):
         self.threshold = threshold
         self.criteria = criteria
 
-    def update(self, input, target) -> "MultilabelAccuracy":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _multilabel_accuracy_update_input_check(input, target)
-        self.num_correct, self.num_total = fused_accumulate(
+        return (
             _multilabel_accuracy_update,
-            (self.num_correct, self.num_total),
+            ("num_correct", "num_total"),
             (input, target),
             (float(self.threshold), self.criteria),
         )
-        return self
+
+    def update(self, input, target) -> "MultilabelAccuracy":
+        return self._apply_update_plan(self._update_plan(input, target))
 
 
 class TopKMultilabelAccuracy(MulticlassAccuracy):
@@ -171,13 +176,15 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
         self.criteria = criteria
         self.k = k
 
-    def update(self, input, target) -> "TopKMultilabelAccuracy":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _topk_multilabel_accuracy_update_input_check(input, target, self.k)
-        self.num_correct, self.num_total = fused_accumulate(
+        return (
             _topk_multilabel_accuracy_update,
-            (self.num_correct, self.num_total),
+            ("num_correct", "num_total"),
             (input, target),
             (self.criteria, self.k),
         )
-        return self
+
+    def update(self, input, target) -> "TopKMultilabelAccuracy":
+        return self._apply_update_plan(self._update_plan(input, target))

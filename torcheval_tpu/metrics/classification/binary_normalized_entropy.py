@@ -12,7 +12,6 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     _baseline_update,
     _ne_deltas,
@@ -61,22 +60,24 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
             "num_positive", jnp.zeros(num_tasks), merge=MergeKind.SUM
         )
 
-    def update(
-        self: TNormalizedEntropy, input, target, *, weight=None
-    ) -> TNormalizedEntropy:
+    def _update_plan(self, input, target, *, weight=None):
         input, target = self._input(input), self._input(target)
         weight = self._input(weight) if weight is not None else None
         _ne_input_check(input, target, self.from_logits, self.num_tasks, weight)
-        # one fused dispatch: CE kernel + the three counter adds
-        self.total_entropy, self.num_positive, self.num_examples = (
-            fused_accumulate(
-                _ne_deltas,
-                (self.total_entropy, self.num_positive, self.num_examples),
-                (input, target, weight),
-                (self.from_logits,),
-            )
+        return (
+            _ne_deltas,
+            ("total_entropy", "num_positive", "num_examples"),
+            (input, target, weight),
+            (self.from_logits,),
         )
-        return self
+
+    def update(
+        self: TNormalizedEntropy, input, target, *, weight=None
+    ) -> TNormalizedEntropy:
+        # one fused dispatch: CE kernel + the three counter adds
+        return self._apply_update_plan(
+            self._update_plan(input, target, weight=weight)
+        )
 
     def compute(self) -> jax.Array:
         baseline = _baseline_update(self.num_positive, self.num_examples)

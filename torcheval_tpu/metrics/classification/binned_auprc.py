@@ -25,7 +25,6 @@ from torcheval_tpu.metrics.functional.classification.binned_auprc import (
     _multiclass_binned_auprc_param_check,
     _multilabel_binned_auprc_param_check,
 )
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
     _binary_binned_update_jit,
     _multiclass_binned_update_memory_jit,
@@ -96,7 +95,7 @@ class BinaryBinnedAUPRC(Metric[jax.Array]):
         self._add_state("num_fp", jnp.zeros(shape), merge=MergeKind.SUM)
         self._add_state("num_fn", jnp.zeros(shape), merge=MergeKind.SUM)
 
-    def update(self, input, target) -> "BinaryBinnedAUPRC":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_auprc_update_input_check(input, target, self.num_tasks)
         kernel = (
@@ -105,12 +104,14 @@ class BinaryBinnedAUPRC(Metric[jax.Array]):
             else _binary_binned_update_per_task
         )
         # one fused dispatch: binning kernel + the three counter adds
-        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+        return (
             kernel,
-            (self.num_tp, self.num_fp, self.num_fn),
+            ("num_tp", "num_fp", "num_fn"),
             (input, target, self.threshold),
         )
-        return self
+
+    def update(self, input, target) -> "BinaryBinnedAUPRC":
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> jax.Array:
         # the reference's binned AUPRC classes return only the AUPRC value
@@ -147,16 +148,18 @@ class MulticlassBinnedAUPRC(Metric[jax.Array]):
         self._add_state("num_fp", jnp.zeros((num_t, num_classes)), merge=MergeKind.SUM)
         self._add_state("num_fn", jnp.zeros((num_t, num_classes)), merge=MergeKind.SUM)
 
-    def update(self, input, target) -> "MulticlassBinnedAUPRC":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _multiclass_auprc_update_input_check(input, target, self.num_classes)
         # one fused dispatch: binning kernel + the three counter adds
-        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+        return (
             _MULTICLASS_KERNELS[self.optimization],
-            (self.num_tp, self.num_fp, self.num_fn),
+            ("num_tp", "num_fp", "num_fn"),
             (input, target, self.threshold),
         )
-        return self
+
+    def update(self, input, target) -> "MulticlassBinnedAUPRC":
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> jax.Array:
         auprc = _binned_auprc_from_counts(
@@ -196,16 +199,18 @@ class MultilabelBinnedAUPRC(Metric[jax.Array]):
         self._add_state("num_fp", jnp.zeros((num_t, num_labels)), merge=MergeKind.SUM)
         self._add_state("num_fn", jnp.zeros((num_t, num_labels)), merge=MergeKind.SUM)
 
-    def update(self, input, target) -> "MultilabelBinnedAUPRC":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _multilabel_auprc_update_input_check(input, target, self.num_labels)
         # one fused dispatch: binning kernel + the three counter adds
-        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+        return (
             _MULTILABEL_KERNELS[self.optimization],
-            (self.num_tp, self.num_fp, self.num_fn),
+            ("num_tp", "num_fp", "num_fn"),
             (input, target, self.threshold),
         )
-        return self
+
+    def update(self, input, target) -> "MultilabelBinnedAUPRC":
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> jax.Array:
         auprc = _binned_auprc_from_counts(

@@ -12,7 +12,6 @@ from typing import List, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
     DEFAULT_NUM_THRESHOLD,
     _binary_binned_compute_jit,
@@ -63,16 +62,18 @@ class BinaryBinnedPrecisionRecallCurve(
         self._add_state("num_fp", jnp.zeros(num_t), merge=MergeKind.SUM)
         self._add_state("num_fn", jnp.zeros(num_t), merge=MergeKind.SUM)
 
-    def update(self, input, target) -> "BinaryBinnedPrecisionRecallCurve":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_precision_recall_curve_update_input_check(input, target)
         # one fused dispatch: binning kernel + the three counter adds
-        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+        return (
             _binary_binned_update_jit,
-            (self.num_tp, self.num_fp, self.num_fn),
+            ("num_tp", "num_fp", "num_fn"),
             (input, target, self.threshold),
         )
-        return self
+
+    def update(self, input, target) -> "BinaryBinnedPrecisionRecallCurve":
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
         precision, recall = _binary_binned_compute_jit(
@@ -108,7 +109,7 @@ class MulticlassBinnedPrecisionRecallCurve(
         self._add_state("num_fp", jnp.zeros((num_t, num_classes)), merge=MergeKind.SUM)
         self._add_state("num_fn", jnp.zeros((num_t, num_classes)), merge=MergeKind.SUM)
 
-    def update(self, input, target) -> "MulticlassBinnedPrecisionRecallCurve":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _multiclass_precision_recall_curve_update_input_check(
             input, target, self.num_classes
@@ -119,12 +120,14 @@ class MulticlassBinnedPrecisionRecallCurve(
             else _multiclass_binned_update_memory_jit
         )
         # one fused dispatch: binning kernel + the three counter adds
-        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+        return (
             kernel,
-            (self.num_tp, self.num_fp, self.num_fn),
+            ("num_tp", "num_fp", "num_fn"),
             (input, target, self.threshold),
         )
-        return self
+
+    def update(self, input, target) -> "MulticlassBinnedPrecisionRecallCurve":
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
         return _multiclass_binned_precision_recall_curve_compute(
@@ -159,7 +162,7 @@ class MultilabelBinnedPrecisionRecallCurve(
         self._add_state("num_fp", jnp.zeros((num_t, num_labels)), merge=MergeKind.SUM)
         self._add_state("num_fn", jnp.zeros((num_t, num_labels)), merge=MergeKind.SUM)
 
-    def update(self, input, target) -> "MultilabelBinnedPrecisionRecallCurve":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _multilabel_precision_recall_curve_update_input_check(
             input, target, self.num_labels
@@ -170,12 +173,14 @@ class MultilabelBinnedPrecisionRecallCurve(
             else _multilabel_binned_update_memory_jit
         )
         # one fused dispatch: binning kernel + the three counter adds
-        self.num_tp, self.num_fp, self.num_fn = fused_accumulate(
+        return (
             kernel,
-            (self.num_tp, self.num_fp, self.num_fn),
+            ("num_tp", "num_fp", "num_fn"),
             (input, target, self.threshold),
         )
-        return self
+
+    def update(self, input, target) -> "MultilabelBinnedPrecisionRecallCurve":
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
         precision, recall = _binary_binned_compute_jit(

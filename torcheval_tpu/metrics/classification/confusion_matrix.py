@@ -11,7 +11,6 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
     _binary_confusion_matrix_update_input_check,
     _binary_confusion_matrix_update_jit,
@@ -55,19 +54,21 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
             merge=MergeKind.SUM,
         )
 
-    def update(
-        self: TMulticlassConfusionMatrix, input, target
-    ) -> TMulticlassConfusionMatrix:
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _confusion_matrix_update_input_check(input, target, self.num_classes)
-        # one fused dispatch: scatter kernel + matrix add
-        (self.confusion_matrix,) = fused_accumulate(
+        return (
             _confusion_matrix_update_jit,
-            (self.confusion_matrix,),
+            ("confusion_matrix",),
             (input, target),
             (self.num_classes,),
         )
-        return self
+
+    def update(
+        self: TMulticlassConfusionMatrix, input, target
+    ) -> TMulticlassConfusionMatrix:
+        # one fused dispatch: scatter kernel + matrix add
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> jax.Array:
         return _confusion_matrix_compute(self.confusion_matrix, self.normalize)
@@ -93,13 +94,15 @@ class BinaryConfusionMatrix(MulticlassConfusionMatrix):
         super().__init__(num_classes=2, normalize=normalize, device=device)
         self.threshold = threshold
 
-    def update(self, input, target) -> "BinaryConfusionMatrix":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_confusion_matrix_update_input_check(input, target)
-        (self.confusion_matrix,) = fused_accumulate(
+        return (
             _binary_confusion_matrix_update_jit,
-            (self.confusion_matrix,),
+            ("confusion_matrix",),
             (input, target),
             (float(self.threshold),),
         )
-        return self
+
+    def update(self, input, target) -> "BinaryConfusionMatrix":
+        return self._apply_update_plan(self._update_plan(input, target))

@@ -11,7 +11,6 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.f1_score import (
     _binary_f1_score_update_input_check,
     _binary_f1_score_update_jit,
@@ -53,17 +52,19 @@ class MulticlassF1Score(Metric[jax.Array]):
         self._add_state("num_label", jnp.zeros(shape), merge=MergeKind.SUM)
         self._add_state("num_prediction", jnp.zeros(shape), merge=MergeKind.SUM)
 
-    def update(self: TF1Score, input, target) -> TF1Score:
+    def _update_plan(self: TF1Score, input, target):
         input, target = self._input(input), self._input(target)
         _f1_score_update_input_check(input, target, self.num_classes)
         # one fused dispatch: kernel + the three counter adds
-        self.num_tp, self.num_label, self.num_prediction = fused_accumulate(
+        return (
             _f1_score_update_jit,
-            (self.num_tp, self.num_label, self.num_prediction),
+            ("num_tp", "num_label", "num_prediction"),
             (input, target),
             (self.num_classes, self.average),
         )
-        return self
+
+    def update(self: TF1Score, input, target) -> TF1Score:
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> jax.Array:
         return _f1_score_compute(
@@ -78,13 +79,15 @@ class BinaryF1Score(MulticlassF1Score):
         super().__init__(device=device)
         self.threshold = threshold
 
-    def update(self, input, target) -> "BinaryF1Score":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_f1_score_update_input_check(input, target)
-        self.num_tp, self.num_label, self.num_prediction = fused_accumulate(
+        return (
             _binary_f1_score_update_jit,
-            (self.num_tp, self.num_label, self.num_prediction),
+            ("num_tp", "num_label", "num_prediction"),
             (input, target),
             (float(self.threshold),),
         )
-        return self
+
+    def update(self, input, target) -> "BinaryF1Score":
+        return self._apply_update_plan(self._update_plan(input, target))

@@ -11,7 +11,6 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.precision import (
     _binary_precision_update_input_check,
     _binary_precision_update_jit,
@@ -57,17 +56,19 @@ class MulticlassPrecision(Metric[jax.Array]):
             merge=MergeKind.SUM,
         )
 
-    def update(self: TPrecision, input, target) -> TPrecision:
+    def _update_plan(self: TPrecision, input, target):
         input, target = self._input(input), self._input(target)
         _precision_update_input_check(input, target, self.num_classes)
         # one fused dispatch: kernel + the three counter adds
-        self.num_tp, self.num_fp, self.num_label = fused_accumulate(
+        return (
             _precision_update_jit,
-            (self.num_tp, self.num_fp, self.num_label),
+            ("num_tp", "num_fp", "num_label"),
             (input, target),
             (self.num_classes, self.average),
         )
-        return self
+
+    def update(self: TPrecision, input, target) -> TPrecision:
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> jax.Array:
         return _precision_compute(
@@ -82,13 +83,15 @@ class BinaryPrecision(MulticlassPrecision):
         super().__init__(device=device)
         self.threshold = threshold
 
-    def update(self, input, target) -> "BinaryPrecision":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_precision_update_input_check(input, target)
-        self.num_tp, self.num_fp, self.num_label = fused_accumulate(
+        return (
             _binary_precision_update_jit,
-            (self.num_tp, self.num_fp, self.num_label),
+            ("num_tp", "num_fp", "num_label"),
             (input, target),
             (float(self.threshold),),
         )
-        return self
+
+    def update(self, input, target) -> "BinaryPrecision":
+        return self._apply_update_plan(self._update_plan(input, target))

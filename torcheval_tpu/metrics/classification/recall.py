@@ -11,7 +11,6 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.classification.recall import (
     _binary_recall_update_input_check,
     _binary_recall_update_jit,
@@ -54,17 +53,19 @@ class MulticlassRecall(Metric[jax.Array]):
         self._add_state("num_labels", jnp.zeros(shape), merge=MergeKind.SUM)
         self._add_state("num_predictions", jnp.zeros(shape), merge=MergeKind.SUM)
 
-    def update(self: TRecall, input, target) -> TRecall:
+    def _update_plan(self: TRecall, input, target):
         input, target = self._input(input), self._input(target)
         _recall_update_input_check(input, target, self.num_classes)
         # one fused dispatch: kernel + the three counter adds
-        self.num_tp, self.num_labels, self.num_predictions = fused_accumulate(
+        return (
             _recall_update_jit,
-            (self.num_tp, self.num_labels, self.num_predictions),
+            ("num_tp", "num_labels", "num_predictions"),
             (input, target),
             (self.num_classes, self.average),
         )
-        return self
+
+    def update(self: TRecall, input, target) -> TRecall:
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> jax.Array:
         return _recall_compute(
@@ -90,16 +91,18 @@ class BinaryRecall(Metric[jax.Array]):
         self._add_state("num_tp", jnp.zeros(()), merge=MergeKind.SUM)
         self._add_state("num_true_labels", jnp.zeros(()), merge=MergeKind.SUM)
 
-    def update(self, input, target) -> "BinaryRecall":
+    def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_recall_update_input_check(input, target)
-        self.num_tp, self.num_true_labels = fused_accumulate(
+        return (
             _binary_recall_update_jit,
-            (self.num_tp, self.num_true_labels),
+            ("num_tp", "num_true_labels"),
             (input, target),
             (float(self.threshold),),
         )
-        return self
+
+    def update(self, input, target) -> "BinaryRecall":
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> jax.Array:
         return jnp.nan_to_num(

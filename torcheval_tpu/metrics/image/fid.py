@@ -31,7 +31,6 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 
 TFrechetInceptionDistance = TypeVar(
@@ -192,25 +191,17 @@ class FrechetInceptionDistance(Metric[jax.Array]):
         images = self._input(images)
         self._FID_update_input_check(images=images, is_real=is_real)
         images = images.astype(jnp.float32)
+        # one fused dispatch for the stats: sum/cov/count kernel + the
+        # three counter adds (the model forward stays its own program)
         activations = self.model(images)
-        # one fused dispatch: sum/cov/count kernel + the three counter adds
-        if is_real:
-            self.real_sum, self.real_cov_sum, self.num_real_images = (
-                fused_accumulate(
-                    _fid_accumulate,
-                    (self.real_sum, self.real_cov_sum, self.num_real_images),
-                    (activations,),
-                )
-            )
-        else:
-            self.fake_sum, self.fake_cov_sum, self.num_fake_images = (
-                fused_accumulate(
-                    _fid_accumulate,
-                    (self.fake_sum, self.fake_cov_sum, self.num_fake_images),
-                    (activations,),
-                )
-            )
-        return self
+        names = (
+            ("real_sum", "real_cov_sum", "num_real_images")
+            if is_real
+            else ("fake_sum", "fake_cov_sum", "num_fake_images")
+        )
+        return self._apply_update_plan(
+            (_fid_accumulate, names, (activations,), ())
+        )
 
     def compute(self) -> jax.Array:
         """FID on the accumulated statistics; 0.0 (with a warning) until at

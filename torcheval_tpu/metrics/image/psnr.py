@@ -13,7 +13,6 @@ from typing import Iterable, Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.image.psnr import (
     _psnr_accumulate,
     _psnr_compute,
@@ -79,32 +78,43 @@ class PeakSignalNoiseRatio(Metric[jax.Array]):
         self: TPeakSignalNoiseRatio, input, target
     ) -> TPeakSignalNoiseRatio:
         """Accumulate one batch of image pairs, shape (N, C, H, W)."""
+        if not self.auto_range:
+            return self._apply_update_plan(self._update_plan(input, target))
         input = self._input_float(input)
         target = self._input_float(target)
         _psnr_input_check(input, target)
-        if self.auto_range:
-            # all five states (incl. derived data_range) in one fused dispatch
-            (
-                self.sum_squared_error,
-                self.num_observations,
-                self.min_target,
-                self.max_target,
-                self.data_range,
-            ) = _psnr_accumulate(
-                self.sum_squared_error,
-                self.num_observations,
-                self.min_target,
-                self.max_target,
-                input,
-                target,
-            )
-        else:
-            self.sum_squared_error, self.num_observations = fused_accumulate(
-                _psnr_update_jit,
-                (self.sum_squared_error, self.num_observations),
-                (input, target),
-            )
+        # all five states (incl. derived data_range) in one fused dispatch
+        (
+            self.sum_squared_error,
+            self.num_observations,
+            self.min_target,
+            self.max_target,
+            self.data_range,
+        ) = _psnr_accumulate(
+            self.sum_squared_error,
+            self.num_observations,
+            self.min_target,
+            self.max_target,
+            input,
+            target,
+        )
         return self
+
+    def _update_plan(self, input, target):
+        if self.auto_range:
+            # the min/max/data-range states are not additive: this update
+            # cannot be expressed as states += kernel(...), so it is not
+            # group-fusable (update() runs the dedicated 5-state program)
+            return None
+        input = self._input_float(input)
+        target = self._input_float(target)
+        _psnr_input_check(input, target)
+        return (
+            _psnr_update_jit,
+            ("sum_squared_error", "num_observations"),
+            (input, target),
+            (),
+        )
 
     def merge_state(
         self: TPeakSignalNoiseRatio,

@@ -42,6 +42,7 @@ from typing import (
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.utils.convert import (
     canonicalize_device,
     device_descriptor,
@@ -207,6 +208,34 @@ class Metric(Generic[TComputeReturn], ABC):
     @abstractmethod
     def update(self: TSelf, *_: Any, **__: Any) -> TSelf:
         """Accumulate a batch into metric state. Async, no host sync."""
+
+    # --------------------------------------------------------- fusable update
+
+    def _update_plan(self, *args: Any, **kwargs: Any):
+        """The fusable factorization of ``update(*args, **kwargs)``:
+        ``(kernel, state_names, dynamic, config)`` such that the update is
+        exactly ``states += kernel(*dynamic, *config)`` — or ``None`` when
+        this metric's update cannot be expressed that way (buffered
+        appends, ring writes, host-side text processing).
+
+        Implementations run their input validation eagerly here, so a plan
+        that is returned is safe to execute. ``toolkit.update_collection``
+        executes many metrics' plans as ONE jitted dispatch; a metric's own
+        ``update`` runs its plan through :meth:`_apply_update_plan`.
+        """
+        return None
+
+    def _apply_update_plan(self: TSelf, plan) -> TSelf:
+        """Execute one fusable update plan against this metric's states.
+        The trailing ``config`` element may be omitted (defaults to ``()``).
+        """
+        kernel, state_names, dynamic, *rest = plan
+        config = rest[0] if rest else ()
+        states = tuple(getattr(self, name) for name in state_names)
+        new_states = fused_accumulate(kernel, states, dynamic, config)
+        for name, value in zip(state_names, new_states):
+            setattr(self, name, value)
+        return self
 
     @abstractmethod
     def compute(self) -> TComputeReturn:

@@ -13,7 +13,6 @@ from typing import Optional, TypeVar, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.ranking.click_through_rate import (
     _click_through_rate_compute,
     resolve_ctr_weights,
@@ -58,17 +57,17 @@ class ClickThroughRate(Metric[jax.Array]):
         weights: Union[jax.Array, float, int] = 1.0,
     ) -> TClickThroughRate:
         """Accumulate click events (and optional per-event weights)."""
+        # one fused dispatch: CTR kernel + the two counter adds
+        return self._apply_update_plan(self._update_plan(input, weights))
+
+    def _update_plan(self, input, weights=1.0):
         kernel, args = resolve_ctr_weights(
             self._input(input),
             weights,
             num_tasks=self.num_tasks,
             convert=self._input_float,
         )
-        # one fused dispatch: CTR kernel + the two counter adds
-        self.click_total, self.weight_total = fused_accumulate(
-            kernel, (self.click_total, self.weight_total), args
-        )
-        return self
+        return (kernel, ("click_total", "weight_total"), args, ())
 
     def compute(self) -> jax.Array:
         """CTR per task; 0.0 for tasks with no updates."""

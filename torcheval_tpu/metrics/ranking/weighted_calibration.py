@@ -12,7 +12,6 @@ from typing import Optional, TypeVar, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.ranking.weighted_calibration import (
     _wc_update_scalar,
     _wc_update_tensor,
@@ -54,13 +53,12 @@ class WeightedCalibration(Metric[jax.Array]):
             "weighted_target_sum", jnp.zeros(num_tasks), merge=MergeKind.SUM
         )
 
-    def update(
+    def _update_plan(
         self: TWeightedCalibration,
         input,
         target,
         weight: Union[float, int, jax.Array] = 1.0,
-    ) -> TWeightedCalibration:
-        """Accumulate one batch of predictions / binary targets / weights."""
+    ):
         input = self._input_float(input)
         target = self._input_float(target)
         if not isinstance(weight, (float, int)):
@@ -68,12 +66,20 @@ class WeightedCalibration(Metric[jax.Array]):
         _weighted_calibration_input_check(input, target, weight, self.num_tasks)
         is_scalar, weight_arr = resolve_weight(weight, input)
         # one fused dispatch: kernel + the two counter adds
-        self.weighted_input_sum, self.weighted_target_sum = fused_accumulate(
+        return (
             _wc_update_scalar if is_scalar else _wc_update_tensor,
-            (self.weighted_input_sum, self.weighted_target_sum),
+            ("weighted_input_sum", "weighted_target_sum"),
             (input, target, weight_arr),
         )
-        return self
+
+    def update(
+        self: TWeightedCalibration,
+        input,
+        target,
+        weight: Union[float, int, jax.Array] = 1.0,
+    ) -> TWeightedCalibration:
+        """Accumulate one batch of predictions / binary targets / weights."""
+        return self._apply_update_plan(self._update_plan(input, target, weight))
 
     def compute(self) -> jax.Array:
         """Calibration per task; empty array if any task has zero target sum

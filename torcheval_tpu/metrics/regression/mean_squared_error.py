@@ -13,7 +13,6 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.regression.mean_squared_error import (
     _mean_squared_error_compute,
     _mean_squared_error_param_check,
@@ -71,20 +70,22 @@ class MeanSquaredError(Metric[jax.Array]):
             target: ground truth, same shape.
             sample_weight: optional (n_sample,) weights.
         """
+        return self._apply_update_plan(
+            self._update_plan(input, target, sample_weight=sample_weight)
+        )
+
+    def _update_plan(self, input, target, *, sample_weight=None):
         input = self._input_float(input)
         target = self._input_float(target)
         _mean_squared_error_update_input_check(input, target, sample_weight)
-        states = (self.sum_squared_error, self.sum_weight)
+        names = ("sum_squared_error", "sum_weight")
         # one fused dispatch: squared-error kernel + the two counter adds
         if sample_weight is None:
-            states = fused_accumulate(_update_unweighted, states, (input, target))
-        else:
-            states = fused_accumulate(
-                _update_weighted, states,
-                (input, target, to_jax_float(sample_weight)),
-            )
-        self.sum_squared_error, self.sum_weight = states
-        return self
+            return (_update_unweighted, names, (input, target), ())
+        return (
+            _update_weighted, names,
+            (input, target, to_jax_float(sample_weight)), (),
+        )
 
     def compute(self) -> jax.Array:
         """MSE; NaN if no updates have happened."""

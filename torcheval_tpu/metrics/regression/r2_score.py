@@ -13,7 +13,6 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.regression.r2_score import (
     _r2_score_compute,
     _r2_score_param_check,
@@ -62,28 +61,21 @@ class R2Score(Metric[jax.Array]):
         self._add_state("sum_squared_residual", jnp.zeros(()), merge=MergeKind.SUM)
         self._add_state("num_obs", jnp.zeros(()), merge=MergeKind.SUM)
 
-    def update(self: TR2Score, input, target) -> TR2Score:
-        """Accumulate one batch of predictions and ground truth."""
+    def _update_plan(self, input, target):
         input = self._input_float(input)
         target = self._input_float(target)
         _r2_score_update_input_check(input, target)
-        # one fused dispatch: sums kernel + the four counter adds
-        (
-            self.sum_squared_obs,
-            self.sum_obs,
-            self.sum_squared_residual,
-            self.num_obs,
-        ) = fused_accumulate(
+        return (
             _r2_update_kernel,
-            (
-                self.sum_squared_obs,
-                self.sum_obs,
-                self.sum_squared_residual,
-                self.num_obs,
-            ),
+            ("sum_squared_obs", "sum_obs", "sum_squared_residual", "num_obs"),
             (input, target),
+            (),
         )
-        return self
+
+    def update(self: TR2Score, input, target) -> TR2Score:
+        """Accumulate one batch of predictions and ground truth."""
+        # one fused dispatch: sums kernel + the four counter adds
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> jax.Array:
         """R2 score; raises if fewer than two samples were observed."""

@@ -12,7 +12,6 @@ from typing import Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.metrics.functional.text.perplexity import (
     _perplexity_compute,
     _perplexity_input_check,
@@ -69,6 +68,10 @@ class Perplexity(Metric[jax.Array]):
             input: logits, shape (n_samples, seq_len, vocab_size).
             target: vocab indices, shape (n_samples, seq_len).
         """
+        # one fused dispatch: NLL kernel + both counter adds
+        return self._apply_update_plan(self._update_plan(input, target))
+
+    def _update_plan(self, input, target):
         input = self._input_float(input)
         target = self._input(target)
         _perplexity_input_check(input, target, self.ignore_index)
@@ -77,14 +80,12 @@ class Perplexity(Metric[jax.Array]):
             if input.dtype == jnp.float32 and _use_native_ce(input)
             else _perplexity_update_jit
         )
-        # one fused dispatch: NLL kernel + both counter adds
-        self.sum_log_probs, self.num_total = fused_accumulate(
+        return (
             kernel,
-            (self.sum_log_probs, self.num_total),
+            ("sum_log_probs", "num_total"),
             (input, target),
-            config=(self.ignore_index,),
+            (self.ignore_index,),
         )
-        return self
 
     def compute(self) -> jax.Array:
         """Running perplexity."""
