@@ -123,6 +123,105 @@ def test_invalid_input_raises_before_any_state_change():
                 assert float(jnp.sum(jnp.abs(v))) == 0.0, (name, k)
 
 
+def _recsys_collection():
+    return {
+        "ctr": M.WindowedClickThroughRate(max_num_updates=3),
+        "ne": M.WindowedBinaryNormalizedEntropy(max_num_updates=3),
+        "wc": M.WindowedWeightedCalibration(max_num_updates=3),
+        "ctr_life": M.ClickThroughRate(),
+        "ne_life": M.BinaryNormalizedEntropy(),
+    }
+
+
+def test_windowed_metrics_fuse_and_match_individual():
+    """Windowed (ring-buffer) metrics join the group dispatch via
+    transform plans; states + cursors must match per-metric updates,
+    including ring wraparound (5 updates into 3-slot windows)."""
+    grouped, individual = _recsys_collection(), _recsys_collection()
+    for i in range(5):
+        x = jnp.asarray(RNG.uniform(size=32).astype(np.float32))
+        t = jnp.asarray((RNG.random(32) < 0.5).astype(np.float32))
+        update_collection(grouped, x, t)
+        for m in individual.values():
+            m.update(x, t)
+    for name in grouped:
+        got = grouped[name].state_dict()
+        want = individual[name].state_dict()
+        assert got.keys() == want.keys(), name
+        for k in got:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]), atol=1e-5,
+                err_msg=f"{name}.{k}",
+            )
+        out_g = jax.tree_util.tree_map(np.asarray, grouped[name].compute())
+        out_i = jax.tree_util.tree_map(np.asarray, individual[name].compute())
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+            out_g, out_i,
+        )
+
+
+def test_windowed_plus_counter_single_dispatch():
+    metrics = _recsys_collection()
+    x = jnp.asarray(RNG.uniform(size=32).astype(np.float32))
+    t = jnp.asarray((RNG.random(32) < 0.5).astype(np.float32))
+    update_collection(metrics, x, t)  # compile at this cursor position
+    # pin the dispatch count at a DIFFERENT cursor than the warm call, so
+    # the traced-column design (no per-slot programs) is also exercised
+    progs = programs_for(lambda: update_collection(metrics, x, t))
+    assert len(progs) <= 1, progs
+
+
+def test_windowed_auroc_fuses_in_collection():
+    metrics = {
+        "wauroc": M.WindowedBinaryAUROC(max_num_samples=64),
+        "acc": M.BinaryAccuracy(),
+    }
+    solo = M.WindowedBinaryAUROC(max_num_samples=64)
+    for i in range(4):  # wraps the 64-slot ring with 32-sample batches
+        x = jnp.asarray(RNG.uniform(size=32).astype(np.float32))
+        t = jnp.asarray((RNG.random(32) < 0.5).astype(np.float32))
+        update_collection(metrics, x, t)
+        solo.update(x, t)
+    assert metrics["wauroc"].next_inserted == solo.next_inserted
+    np.testing.assert_allclose(
+        np.asarray(metrics["wauroc"].compute()),
+        np.asarray(solo.compute()),
+        atol=1e-6,
+    )
+
+
+def test_record_extension_point_counts_once():
+    """The documented subclass path (pre-computed counters through
+    ``_record``) must advance ``total_updates`` exactly once per call —
+    regression for a double increment when ``_record_via`` gained a
+    finalize-bearing plan."""
+    from torcheval_tpu.metrics.window._base import WindowedTaskCounterMetric
+
+    class MiniWindowed(WindowedTaskCounterMetric):
+        def __init__(self):
+            super().__init__()
+            self._init_window_states(
+                ("total",), num_tasks=1, max_num_updates=3,
+                enable_lifetime=True,
+            )
+
+        def update(self, value):
+            self._record((jnp.asarray([float(value)]),))
+            return self
+
+        def compute(self):
+            return self._windowed_counter_sums()[0]
+
+    m = MiniWindowed()
+    for v in (1.0, 2.0, 3.0, 4.0):  # wraps the 3-slot ring once
+        m.update(v)
+    assert m.total_updates == 4
+    assert m.next_inserted == 1
+    np.testing.assert_allclose(float(m.compute()[0]), 2.0 + 3.0 + 4.0)
+    np.testing.assert_allclose(np.asarray(m.total).squeeze(), 10.0)
+
+
 def test_mixed_collection_no_partial_update_on_bad_batch():
     """Plan validation runs for EVERY fusable metric before any fallback
     metric mutates: a batch that fails a fusable metric's check must leave

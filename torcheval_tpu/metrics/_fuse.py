@@ -58,17 +58,38 @@ def fused_accumulate(
     return fn(states, *dynamic)
 
 
+_TRANSFORM_CACHE: Dict[Any, Callable] = {}
+
+
+def fused_transform(kernel, states, dynamic, config=()):
+    """``kernel(states, *dynamic, *config)`` -> new states, as one jitted
+    dispatch — the non-additive sibling of ``fused_accumulate`` (ring
+    column writes, running extrema). Cached per (kernel, config, arity)."""
+    key = (kernel, config, len(states), len(dynamic))
+    fn = _TRANSFORM_CACHE.get(key)
+    if fn is None:
+
+        def fused(states, *dyn):
+            return kernel(states, *dyn, *config)
+
+        fn = jax.jit(fused)
+        _TRANSFORM_CACHE[key] = fn
+    return fn(states, *dynamic)
+
+
 _GROUP_CACHE: Dict[Any, Callable] = {}
 
 
 def fused_accumulate_group(plans):
     """Run MANY fusable update plans as ONE jitted dispatch.
 
-    ``plans`` is a sequence of ``(kernel, states, dynamic, config)`` tuples
-    (the per-metric shape ``fused_accumulate`` takes). Returns the new
+    ``plans`` is a sequence of ``(kernel, states, dynamic, config)`` or
+    ``(kernel, states, dynamic, config, transform)`` tuples. Accumulate
+    plans apply ``states += kernel(*dynamic, *config)``; transform plans
+    apply ``states = kernel(states, *dynamic, *config)``. Returns the new
     states, one tuple per plan, computed by a single XLA program — the
     collection analogue of the per-metric fusion: an eval loop updating K
-    counter metrics on one batch pays one device round-trip instead of K.
+    metrics on one batch pays one device round-trip instead of K.
 
     XLA additionally CSEs work shared between kernels traced into the same
     program (e.g. several classification metrics re-deriving argmax of the
@@ -76,18 +97,22 @@ def fused_accumulate_group(plans):
     """
     kernels = tuple(p[0] for p in plans)
     configs = tuple(p[3] for p in plans)
+    kinds = tuple(bool(p[4]) if len(p) > 4 else False for p in plans)
     arity = tuple((len(p[1]), len(p[2])) for p in plans)
-    key = (kernels, configs, arity)
+    key = (kernels, configs, kinds, arity)
     fn = _GROUP_CACHE.get(key)
     if fn is None:
 
         def fused(states_group, dynamic_group):
-            return tuple(
-                _apply_kernel(kernel, config, states, dyn)
-                for kernel, config, states, dyn in zip(
-                    kernels, configs, states_group, dynamic_group
-                )
-            )
+            out = []
+            for kernel, config, transform, states, dyn in zip(
+                kernels, configs, kinds, states_group, dynamic_group
+            ):
+                if transform:
+                    out.append(tuple(kernel(states, *dyn, *config)))
+                else:
+                    out.append(_apply_kernel(kernel, config, states, dyn))
+            return tuple(out)
 
         fn = jax.jit(fused)
         _GROUP_CACHE[key] = fn

@@ -34,6 +34,7 @@ from typing import (
     Generic,
     Iterable,
     List,
+    NamedTuple,
     Optional,
     TypeVar,
     Union,
@@ -54,6 +55,24 @@ from torcheval_tpu.utils.convert import (
 TState = Union[jax.Array, List[jax.Array], Dict[Any, jax.Array], int, float]
 TComputeReturn = TypeVar("TComputeReturn")
 TSelf = TypeVar("TSelf", bound="Metric")
+
+
+class UpdatePlan(NamedTuple):
+    """A fusable metric update (see :meth:`Metric._update_plan`).
+
+    ``transform=False``: ``states += kernel(*dynamic, *config)``.
+    ``transform=True``: ``states = kernel(states, *dynamic, *config)``.
+    ``kernel`` and ``config`` must be hashable (they key the jit caches);
+    ``finalize`` (host-side, optional) runs after the device step and is
+    never part of a cache key.
+    """
+
+    kernel: Any
+    state_names: tuple
+    dynamic: tuple
+    config: tuple = ()
+    transform: bool = False
+    finalize: Any = None
 
 
 class MergeKind(enum.Enum):
@@ -212,11 +231,19 @@ class Metric(Generic[TComputeReturn], ABC):
     # --------------------------------------------------------- fusable update
 
     def _update_plan(self, *args: Any, **kwargs: Any):
-        """The fusable factorization of ``update(*args, **kwargs)``:
-        ``(kernel, state_names, dynamic, config)`` such that the update is
-        exactly ``states += kernel(*dynamic, *config)`` — or ``None`` when
-        this metric's update cannot be expressed that way (buffered
-        appends, ring writes, host-side text processing).
+        """The fusable factorization of ``update(*args, **kwargs)`` — or
+        ``None`` when this metric's update cannot be expressed as one
+        (buffered appends with donation, host-side text processing).
+
+        Two forms:
+
+        - a plain tuple ``(kernel, state_names, dynamic[, config])``:
+          the update is exactly ``states += kernel(*dynamic, *config)``;
+        - an :class:`UpdatePlan` with ``transform=True``: the update is
+          ``states = kernel(states, *dynamic, *config)`` (ring-buffer
+          column writes, running min/max — anything non-additive), with an
+          optional host-side ``finalize`` callback run after the device
+          step (cursor advances, host counters).
 
         Implementations run their input validation eagerly here, so a plan
         that is returned is safe to execute. ``toolkit.update_collection``
@@ -229,6 +256,23 @@ class Metric(Generic[TComputeReturn], ABC):
         """Execute one fusable update plan against this metric's states.
         The trailing ``config`` element may be omitted (defaults to ``()``).
         """
+        from torcheval_tpu.metrics._fuse import fused_transform
+
+        if isinstance(plan, UpdatePlan):
+            states = tuple(getattr(self, n) for n in plan.state_names)
+            if plan.transform:
+                new_states = fused_transform(
+                    plan.kernel, states, plan.dynamic, plan.config
+                )
+            else:
+                new_states = fused_accumulate(
+                    plan.kernel, states, plan.dynamic, plan.config
+                )
+            for name, value in zip(plan.state_names, new_states):
+                setattr(self, name, value)
+            if plan.finalize is not None:
+                plan.finalize()
+            return self
         kernel, state_names, dynamic, *rest = plan
         config = rest[0] if rest else ()
         states = tuple(getattr(self, name) for name in state_names)

@@ -245,9 +245,14 @@ def update_collection(
     into a single XLA program, so an eval step tracking K counter metrics
     (accuracy + F1 + recall + confusion matrix + ...) pays one device
     round-trip instead of K — and XLA CSEs work the kernels share (e.g.
-    argmax of the same logits). Metrics without a fusable plan (buffered
-    curves, windowed rings, host-side text) fall back to their plain
-    ``update`` within the same call.
+    argmax of the same logits). Windowed ring-buffer metrics fuse too
+    (via transform plans). Metrics without a fusable plan (buffered
+    curves with donated appends, host-side text) fall back to their
+    plain ``update`` within the same call; note fallbacks validate their
+    inputs inside their own ``update``, so a batch rejected by a
+    fallback (rather than by a fusable plan) can leave earlier fallbacks
+    already updated — the all-or-nothing guarantee covers the fusable
+    group.
 
     Args:
         metrics: ``{name: Metric}`` dict or iterable of metrics.
@@ -263,33 +268,46 @@ def update_collection(
         >>> toolkit.update_collection(metrics, logits, labels)  # ONE dispatch
     """
     from torcheval_tpu.metrics._fuse import fused_accumulate_group
+    from torcheval_tpu.metrics.metric import UpdatePlan
 
     items = list(metrics.values() if isinstance(metrics, dict) else metrics)
     # pass 1: build every fusable plan FIRST — each plan runs its metric's
-    # input validation eagerly, so a bad batch raises before ANY metric
-    # (fusable or fallback) has mutated state; no partial updates
+    # input validation eagerly, so a batch any PLAN rejects raises before
+    # any metric has mutated state (fallback metrics can only validate
+    # inside their own update, in pass 2)
     fallback: List[Metric] = []
-    fusable: List[tuple] = []  # (metric, state_names)
+    fusable: List[tuple] = []  # (metric, state_names, finalize)
     plans: List[tuple] = []
     for metric in items:
         plan = metric._update_plan(*args, **kwargs)
         if plan is None:
             fallback.append(metric)
             continue
-        kernel, names, dynamic, *rest = plan
-        config = rest[0] if rest else ()
+        if isinstance(plan, UpdatePlan):
+            kernel, names, dynamic, config = (
+                plan.kernel, plan.state_names, plan.dynamic, plan.config
+            )
+            transform, finalize = plan.transform, plan.finalize
+        else:
+            kernel, names, dynamic, *rest = plan
+            config = rest[0] if rest else ()
+            transform, finalize = False, None
         states = tuple(getattr(metric, n) for n in names)
-        fusable.append((metric, names))
-        plans.append((kernel, states, dynamic, config))
+        fusable.append((metric, names, finalize))
+        plans.append((kernel, states, dynamic, config, transform))
     # pass 2: execute — fallbacks still validate themselves, but only after
     # every collected plan has passed validation
     for metric in fallback:
         metric.update(*args, **kwargs)
     if plans:
         new_states_group = fused_accumulate_group(plans)
-        for (metric, names), new_states in zip(fusable, new_states_group):
+        for (metric, names, finalize), new_states in zip(
+            fusable, new_states_group
+        ):
             for name, value in zip(names, new_states):
                 setattr(metric, name, value)
+            if finalize is not None:
+                finalize()
     return metrics
 
 

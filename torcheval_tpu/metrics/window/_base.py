@@ -27,12 +27,46 @@ import jax.numpy as jnp
 
 from torcheval_tpu.utils.convert import cached_index
 
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TWindowed = TypeVar("TWindowed", bound="WindowedTaskCounterMetric")
 
-# (kernel, config, counter names, lifetime flag) -> jitted fused update
-_RECORD_VIA_CACHE: dict = {}
+# (kernel, n_counters, lifetime flag, config) -> traceable transform body
+_WINDOW_TRANSFORM_CACHE: dict = {}
+
+
+def _window_transform(kernel, n_counters: int, lifetime: bool, config):
+    """A stable (cacheable) transform closure: counter kernel + lifetime
+    accumulates + ring-column writes over a names-ordered flat state tuple
+    ``(lifetime..., rings...)``. Used both by single-metric updates (via
+    ``fused_transform``) and by ``toolkit.update_collection`` group
+    programs — the SAME function object per key, so the jit caches hit."""
+    key = (kernel, n_counters, lifetime, config)
+    fn = _WINDOW_TRANSFORM_CACHE.get(key)
+    if fn is None:
+
+        def transform(states, col, *dyn):
+            deltas = kernel(*dyn, *config)
+            if not isinstance(deltas, tuple):
+                deltas = (deltas,)
+            if len(deltas) != n_counters:
+                raise ValueError(
+                    f"kernel {kernel.__name__} returned {len(deltas)} "
+                    f"counter values for {n_counters} counters"
+                )
+            if lifetime:
+                lt, rings = states[:n_counters], states[n_counters:]
+                new_lt = tuple(v + d for v, d in zip(lt, deltas))
+            else:
+                rings, new_lt = states, ()
+            new_rings = tuple(
+                r.at[:, col].set(d) for r, d in zip(rings, deltas)
+            )
+            return new_lt + new_rings
+
+        _WINDOW_TRANSFORM_CACHE[key] = transform
+        fn = transform
+    return fn
 
 
 
@@ -125,10 +159,9 @@ class WindowedTaskCounterMetric(RingCursorSerializationMixin, Metric):
         kernel is jittable — it fuses the kernel into the same dispatch."""
         self._record_via(_identity_kernel, tuple(counter_values))
 
-    def _record_via(
-        self, kernel, dynamic: tuple, config: tuple = ()
-    ) -> None:
-        """``kernel(*dynamic, *config) -> counter values``, fused with the
+    def _window_plan(self, kernel, dynamic: tuple, config: tuple = ()):
+        """Build the transform :class:`UpdatePlan` for one windowed update:
+        ``kernel(*dynamic, *config) -> counter values``, fused with the
         lifetime accumulates and ring-column writes into ONE dispatch (the
         separate kernel + record calls each cost a device round-trip on a
         remote TPU). ``kernel`` and ``config`` entries must be hashable —
@@ -138,50 +171,37 @@ class WindowedTaskCounterMetric(RingCursorSerializationMixin, Metric):
         (reference window/mean_squared_error.py:141-145). The traced column
         index is a cached device scalar: baking the Python int into an
         eager ``.at[].set`` would compile one program per ring slot and
-        upload constants per call; the cursor itself stays a host int.
+        upload constants per call; the cursor itself stays a host int,
+        advanced by the plan's ``finalize`` after the device step.
         """
-        names = self._counter_names
-        key = (kernel, config, names, self.enable_lifetime)
-        fn = _RECORD_VIA_CACHE.get(key)
-        if fn is None:
-
-            def fused(lifetime, rings, col, *dyn):
-                deltas = kernel(*dyn, *config)
-                if len(deltas) != len(names):
-                    raise ValueError(
-                        f"kernel {kernel.__name__} returned {len(deltas)} "
-                        f"counter values for {len(names)} counters {names}"
-                    )
-                values = dict(zip(names, deltas))
-                new_lifetime = {
-                    k: lifetime[k] + values[k] for k in lifetime
-                }
-                new_rings = {
-                    k: rings[k].at[:, col].set(values[k]) for k in rings
-                }
-                return new_lifetime, new_rings
-
-            fn = jax.jit(fused)
-            _RECORD_VIA_CACHE[key] = fn
-
-        lifetime = (
-            {name: getattr(self, name) for name in names}
-            if self.enable_lifetime
-            else {}
-        )
-        rings = {
-            name: getattr(self, f"windowed_{name}") for name in names
-        }
+        counter_names = self._counter_names
+        names = (
+            tuple(counter_names) if self.enable_lifetime else ()
+        ) + tuple(f"windowed_{n}" for n in counter_names)
         col = self.next_inserted
-        new_lifetime, new_rings = fn(
-            lifetime, rings, cached_index(col), *dynamic
+
+        def finalize():
+            self.next_inserted = (col + 1) % self.max_num_updates
+            self.total_updates += 1
+
+        return UpdatePlan(
+            _window_transform(
+                kernel, len(counter_names), self.enable_lifetime, config
+            ),
+            names,
+            (cached_index(col),) + tuple(dynamic),
+            (),
+            transform=True,
+            finalize=finalize,
         )
-        for name, value in new_lifetime.items():
-            setattr(self, name, value)
-        for name, value in new_rings.items():
-            setattr(self, f"windowed_{name}", value)
-        self.next_inserted = (col + 1) % self.max_num_updates
-        self.total_updates += 1
+
+    def _record_via(
+        self, kernel, dynamic: tuple, config: tuple = ()
+    ) -> None:
+        """Run one windowed update through its fused plan (see
+        :meth:`_window_plan`; the plan's ``finalize`` advances the cursor
+        and update count)."""
+        self._apply_update_plan(self._window_plan(kernel, dynamic, config))
 
     def _windowed_counter_sums(self) -> List[jax.Array]:
         """Per-task sums over the window, shape (num_tasks,) each."""

@@ -117,26 +117,41 @@ class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
     ) -> TWindowedBinaryAUROC:
         """Insert a batch of samples into the ring buffers — one fused
         dispatch (reshape + wrap-aware write of all three buffers)."""
+        return self._apply_update_plan(
+            self._update_plan(input, target, weight)
+        )
+
+    def _update_plan(self, input, target, weight=None):
+        from torcheval_tpu.metrics.metric import UpdatePlan
+
         input, target = self._input(input), self._input(target)
         if weight is not None:
             weight = self._input_float(weight)
         _binary_auroc_update_input_check(input, target, self.num_tasks, weight)
-        bufs = (self.inputs, self.targets, self.weights)
+        names = ("inputs", "targets", "weights")
         n = input.shape[-1]
-        if n >= self.max_num_samples:
+        cap = self.max_num_samples
+        col = self.next_inserted
+        if n >= cap:
             # oversized batch: keep only its last max_num_samples samples
-            out = _ring_overwrite(bufs, input, target, weight)
-            self.next_inserted = 0
-        else:
-            out = _ring_insert(
-                bufs, cached_index(self.next_inserted), input, target, weight
+            def finalize():
+                self.next_inserted = 0
+                self.total_samples += n
+
+            return UpdatePlan(
+                _ring_overwrite, names, (input, target, weight), (),
+                transform=True, finalize=finalize,
             )
-            self.next_inserted = (
-                self.next_inserted + n
-            ) % self.max_num_samples
-        self.inputs, self.targets, self.weights = out
-        self.total_samples += n
-        return self
+
+        def finalize():
+            self.next_inserted = (col + n) % cap
+            self.total_samples += n
+
+        return UpdatePlan(
+            _ring_insert, names,
+            (cached_index(col), input, target, weight), (),
+            transform=True, finalize=finalize,
+        )
 
     def compute(self) -> jax.Array:
         """AUROC per task over the windowed samples; empty before updates."""

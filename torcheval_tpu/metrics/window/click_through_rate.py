@@ -62,14 +62,16 @@ class WindowedClickThroughRate(
     ) -> TWindowedClickThroughRate:
         """Accumulate one update's click events into the window — one fused
         dispatch (CTR kernel + lifetime + ring write)."""
+        return self._apply_update_plan(self._update_plan(input, weights))
+
+    def _update_plan(self, input, weights=1.0):
         kernel, args = resolve_ctr_weights(
             self._input(input),
             weights,
             num_tasks=self.num_tasks,
             convert=self._input_float,
         )
-        self._record_via(kernel, args)
-        return self
+        return self._window_plan(kernel, args)
 
     def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
         """Windowed (and lifetime) CTR per task; empty before any update."""

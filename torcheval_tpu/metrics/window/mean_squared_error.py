@@ -85,17 +85,20 @@ class WindowedMeanSquaredError(WindowedTaskCounterMetric):
     ) -> TWindowedMeanSquaredError:
         """Accumulate one batch's squared-error sums into the window — one
         fused dispatch (MSE kernel + lifetime + ring write)."""
+        return self._apply_update_plan(
+            self._update_plan(input, target, sample_weight=sample_weight)
+        )
+
+    def _update_plan(self, input, target, *, sample_weight=None):
         input, target = self._input_float(input), self._input_float(target)
         _mean_squared_error_update_input_check(input, target, sample_weight)
         self._window_input_check(input)
         if sample_weight is None:
-            self._record_via(_update_unweighted, (input, target))
-        else:
-            self._record_via(
-                _update_weighted,
-                (input, target, to_jax_float(sample_weight)),
-            )
-        return self
+            return self._window_plan(_update_unweighted, (input, target))
+        return self._window_plan(
+            _update_weighted,
+            (input, target, to_jax_float(sample_weight)),
+        )
 
     def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
         """Windowed (and lifetime) MSE; empty before any update."""

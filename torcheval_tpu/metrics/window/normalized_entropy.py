@@ -74,15 +74,19 @@ class WindowedBinaryNormalizedEntropy(WindowedTaskCounterMetric):
     ) -> TWindowedNormalizedEntropy:
         """Accumulate one batch's entropy counters into the window — one
         fused dispatch (NE kernel + lifetime + ring write)."""
+        return self._apply_update_plan(
+            self._update_plan(input, target, weight=weight)
+        )
+
+    def _update_plan(self, input, target, *, weight=None):
         input, target = self._input(input), self._input(target)
         weight = self._input(weight) if weight is not None else None
         _ne_input_check(input, target, self.from_logits, self.num_tasks, weight)
-        self._record_via(
+        return self._window_plan(
             _ne_window_kernel,
             (input, target, weight),
             config=(self.from_logits,),
         )
-        return self
 
     def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
         """Windowed (and lifetime) NE per task; empty before any update."""

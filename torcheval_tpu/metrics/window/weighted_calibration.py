@@ -64,6 +64,11 @@ class WindowedWeightedCalibration(WindowedTaskCounterMetric):
     ) -> TWindowedWeightedCalibration:
         """Accumulate one batch into the window — one fused dispatch
         (calibration kernel + lifetime + ring write)."""
+        return self._apply_update_plan(
+            self._update_plan(input, target, weight)
+        )
+
+    def _update_plan(self, input, target, weight=1.0):
         input = self._input_float(input)
         target = self._input_float(target)
         if not isinstance(weight, (float, int)):
@@ -73,8 +78,7 @@ class WindowedWeightedCalibration(WindowedTaskCounterMetric):
         )
         is_scalar, weight_arr = resolve_weight(weight, input)
         kernel = _wc_update_scalar if is_scalar else _wc_update_tensor
-        self._record_via(kernel, (input, target, weight_arr))
-        return self
+        return self._window_plan(kernel, (input, target, weight_arr))
 
     def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
         """Windowed (and lifetime) calibration; empty before any update."""
