@@ -191,6 +191,47 @@ def test_windowed_auroc_fuses_in_collection():
     )
 
 
+def test_aggregation_image_streaming_plans():
+    """Max/Min (transform), PSNR auto-range (5-state transform), and
+    StreamingBinaryAUROC (histogram accumulate) all fuse — one dispatch
+    for the whole panel, states identical to per-metric updates."""
+    def mk():
+        return {
+            "max": M.Max(),
+            "min": M.Min(),
+            "psnr": M.PeakSignalNoiseRatio(),  # auto_range default
+            "stream": M.StreamingBinaryAUROC(num_bins=64),
+        }
+
+    grouped, individual = mk(), mk()
+    for _ in range(3):
+        x = jnp.asarray(RNG.uniform(size=64).astype(np.float32))
+        t = jnp.asarray((RNG.random(64) < 0.5).astype(np.float32))
+        # psnr/stream take (input, target); max/min ignore the target via
+        # their single-arg plan — group them by signature as a user would
+        update_collection({"psnr": grouped["psnr"],
+                           "stream": grouped["stream"]}, x, t)
+        update_collection({"max": grouped["max"],
+                           "min": grouped["min"]}, x)
+        individual["psnr"].update(x, t)
+        individual["stream"].update(x, t)
+        individual["max"].update(x)
+        individual["min"].update(x)
+    for name in grouped:
+        got = grouped[name].state_dict()
+        want = individual[name].state_dict()
+        for k in got:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]), atol=1e-6,
+                err_msg=f"{name}.{k}",
+            )
+    x = jnp.asarray(RNG.uniform(size=64).astype(np.float32))
+    t = jnp.asarray((RNG.random(64) < 0.5).astype(np.float32))
+    pair = {"psnr": grouped["psnr"], "stream": grouped["stream"]}
+    progs = programs_for(lambda: update_collection(pair, x, t))
+    assert len(progs) <= 1, progs
+
+
 def test_record_extension_point_counts_once():
     """The documented subclass path (pre-computed counters through
     ``_record``) must advance ``total_updates`` exactly once per call —

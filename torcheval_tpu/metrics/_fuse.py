@@ -19,18 +19,30 @@ import jax
 _CACHE: Dict[Any, Callable] = {}
 
 
+def _check_arity(kernel, out, states):
+    """Normalize a kernel's output to a tuple and require one entry per
+    state — zip-assignment would otherwise silently truncate."""
+    if not isinstance(out, tuple):
+        out = (out,)
+    if len(out) != len(states):
+        raise ValueError(
+            f"kernel {kernel.__name__} returned {len(out)} values "
+            f"for {len(states)} states"
+        )
+    return out
+
+
 def _apply_kernel(kernel, config, states, dyn):
     """Traceable shared body: ``tuple(s + d)`` for the kernel's deltas,
     with the arity check both the per-metric and group paths rely on."""
-    deltas = kernel(*dyn, *config)
-    if not isinstance(deltas, tuple):
-        deltas = (deltas,)
-    if len(deltas) != len(states):
-        raise ValueError(
-            f"kernel {kernel.__name__} returned {len(deltas)} deltas "
-            f"for {len(states)} states"
-        )
+    deltas = _check_arity(kernel, kernel(*dyn, *config), states)
     return tuple(s + d for s, d in zip(states, deltas))
+
+
+def _apply_transform(kernel, config, states, dyn):
+    """Traceable shared body for transform plans:
+    ``states = kernel(states, *dyn, *config)``, arity-checked."""
+    return _check_arity(kernel, kernel(states, *dyn, *config), states)
 
 
 def fused_accumulate(
@@ -70,7 +82,7 @@ def fused_transform(kernel, states, dynamic, config=()):
     if fn is None:
 
         def fused(states, *dyn):
-            return kernel(states, *dyn, *config)
+            return _apply_transform(kernel, config, states, dyn)
 
         fn = jax.jit(fused)
         _TRANSFORM_CACHE[key] = fn
@@ -109,7 +121,9 @@ def fused_accumulate_group(plans):
                 kernels, configs, kinds, states_group, dynamic_group
             ):
                 if transform:
-                    out.append(tuple(kernel(states, *dyn, *config)))
+                    out.append(
+                        _apply_transform(kernel, config, states, dyn)
+                    )
                 else:
                     out.append(_apply_kernel(kernel, config, states, dyn))
             return tuple(out)

@@ -15,10 +15,10 @@ from torcheval_tpu.metrics.metric import MergeKind, Metric
 TMax = TypeVar("TMax", bound="Max")
 
 
-@jax.jit
-def _max_update_jit(state: jax.Array, input: jax.Array) -> jax.Array:
-    # one fused dispatch: reduce + running-max accumulate
-    return jnp.maximum(state, jnp.max(input))
+def _max_transform(states, input):
+    """Transform-plan kernel: reduce + running-max accumulate in one
+    fused dispatch (running max is not additive)."""
+    return (jnp.maximum(states[0], jnp.max(input)),)
 
 
 class Max(Metric[jax.Array]):
@@ -36,8 +36,15 @@ class Max(Metric[jax.Array]):
         self._add_state("max", jnp.float32(-jnp.inf), merge=MergeKind.MAX)
 
     def update(self: TMax, input) -> TMax:
-        self.max = _max_update_jit(self.max, self._input_float(input))
-        return self
+        return self._apply_update_plan(self._update_plan(input))
+
+    def _update_plan(self, input):
+        from torcheval_tpu.metrics.metric import UpdatePlan
+
+        return UpdatePlan(
+            _max_transform, ("max",), (self._input_float(input),),
+            transform=True,
+        )
 
     def compute(self) -> jax.Array:
         return self.max

@@ -15,10 +15,10 @@ from torcheval_tpu.metrics.metric import MergeKind, Metric
 TMin = TypeVar("TMin", bound="Min")
 
 
-@jax.jit
-def _min_update_jit(state: jax.Array, input: jax.Array) -> jax.Array:
-    # one fused dispatch: reduce + running-min accumulate
-    return jnp.minimum(state, jnp.min(input))
+def _min_transform(states, input):
+    """Transform-plan kernel: reduce + running-min accumulate in one
+    fused dispatch (running min is not additive)."""
+    return (jnp.minimum(states[0], jnp.min(input)),)
 
 
 class Min(Metric[jax.Array]):
@@ -36,8 +36,15 @@ class Min(Metric[jax.Array]):
         self._add_state("min", jnp.float32(jnp.inf), merge=MergeKind.MIN)
 
     def update(self: TMin, input) -> TMin:
-        self.min = _min_update_jit(self.min, self._input_float(input))
-        return self
+        return self._apply_update_plan(self._update_plan(input))
+
+    def _update_plan(self, input):
+        from torcheval_tpu.metrics.metric import UpdatePlan
+
+        return UpdatePlan(
+            _min_transform, ("min",), (self._input_float(input),),
+            transform=True,
+        )
 
     def compute(self) -> jax.Array:
         return self.min

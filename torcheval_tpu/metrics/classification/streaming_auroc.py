@@ -32,7 +32,9 @@ from torcheval_tpu.metrics.metric import MergeKind, Metric
 from torcheval_tpu.ops.fused_auc import (
     DEFAULT_NUM_BINS,
     _auc_from_hist_fused,
-    fused_auc_histogram_accumulate,
+    _platform_of,
+    _resolve_backend,
+    histogram_delta_kernel,
 )
 
 TStreamingBinaryAUROC = TypeVar(
@@ -126,20 +128,25 @@ class StreamingBinaryAUROC(Metric[jax.Array]):
             target: binary labels, same shape.
             weight: optional per-sample weights, same shape.
         """
+        # one fused dispatch: prep + clip + histogram backend + accumulate
+        return self._apply_update_plan(
+            self._update_plan(input, target, weight)
+        )
+
+    def _update_plan(self, input, target, weight=None):
+        """Accumulate plan (``hist += histogram(batch)``) so streaming
+        AUROC joins ``toolkit.update_collection``'s single dispatch."""
         input, target = self._input_float(input), self._input(target)
         if weight is not None:
             weight = self._input_float(weight)
         _binary_auroc_update_input_check(input, target, self.num_tasks, weight)
-        # one fused dispatch: prep + clip + histogram backend + accumulate
-        self.hist = fused_auc_histogram_accumulate(
-            self.hist,
-            input,
-            target,
-            weight,
-            num_bins=self.num_bins,
-            bounds=self.bounds,
+        backend, interpret = _resolve_backend("auto", _platform_of(self.hist))
+        return (
+            histogram_delta_kernel,
+            ("hist",),
+            (input, target, weight),
+            (self.num_bins, self.bounds, backend, interpret),
         )
-        return self
 
     def compute(self) -> jax.Array:
         """AUROC from the histogram; scalar for ``num_tasks == 1``."""

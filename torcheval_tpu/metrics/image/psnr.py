@@ -27,6 +27,15 @@ TPeakSignalNoiseRatio = TypeVar(
 )
 
 
+def _psnr_auto_transform(states, input, target):
+    """Transform-plan form of the auto-range update: the min/max/data-range
+    states are not additive. ``states`` order matches the plan's names
+    (sum_squared_error, num_observations, min_target, max_target,
+    data_range); ``_psnr_accumulate`` consumes the first four and derives
+    the fifth."""
+    return tuple(_psnr_accumulate(*states[:4], input, target))
+
+
 class PeakSignalNoiseRatio(Metric[jax.Array]):
     """PSNR between accumulated input and target images.
 
@@ -77,38 +86,31 @@ class PeakSignalNoiseRatio(Metric[jax.Array]):
     def update(
         self: TPeakSignalNoiseRatio, input, target
     ) -> TPeakSignalNoiseRatio:
-        """Accumulate one batch of image pairs, shape (N, C, H, W)."""
-        if not self.auto_range:
-            return self._apply_update_plan(self._update_plan(input, target))
-        input = self._input_float(input)
-        target = self._input_float(target)
-        _psnr_input_check(input, target)
-        # all five states (incl. derived data_range) in one fused dispatch
-        (
-            self.sum_squared_error,
-            self.num_observations,
-            self.min_target,
-            self.max_target,
-            self.data_range,
-        ) = _psnr_accumulate(
-            self.sum_squared_error,
-            self.num_observations,
-            self.min_target,
-            self.max_target,
-            input,
-            target,
-        )
-        return self
+        """Accumulate one batch of image pairs, shape (N, C, H, W) — one
+        fused dispatch either way (auto-range includes the derived
+        data_range in its 5-state transform)."""
+        return self._apply_update_plan(self._update_plan(input, target))
 
     def _update_plan(self, input, target):
-        if self.auto_range:
-            # the min/max/data-range states are not additive: this update
-            # cannot be expressed as states += kernel(...), so it is not
-            # group-fusable (update() runs the dedicated 5-state program)
-            return None
         input = self._input_float(input)
         target = self._input_float(target)
         _psnr_input_check(input, target)
+        if self.auto_range:
+            # min/max/data-range are not additive -> transform plan
+            from torcheval_tpu.metrics.metric import UpdatePlan
+
+            return UpdatePlan(
+                _psnr_auto_transform,
+                (
+                    "sum_squared_error",
+                    "num_observations",
+                    "min_target",
+                    "max_target",
+                    "data_range",
+                ),
+                (input, target),
+                transform=True,
+            )
         return (
             _psnr_update_jit,
             ("sum_squared_error", "num_observations"),

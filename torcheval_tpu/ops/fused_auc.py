@@ -340,6 +340,17 @@ def fused_auc_histogram(
     )
 
 
+def histogram_delta_kernel(scores, labels, weights, num_bins, bounds,
+                           backend, interpret):
+    """Traceable batch-histogram delta for accumulate-style update plans
+    (``hist += histogram(batch)``): the module-level, hashable form of
+    ``_histogram_impl`` that ``Metric._update_plan`` implementations pass
+    as their plan kernel with the eagerly-resolved backend in config."""
+    return _histogram_impl(
+        scores, labels, weights, num_bins, bounds, backend, interpret
+    )
+
+
 def fused_auc_histogram_accumulate(
     hist: jax.Array,
     input,
