@@ -16,7 +16,13 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from torcheval_tpu.metrics import MulticlassAccuracy, Mean, Throughput
+from torcheval_tpu.metrics import (
+    Mean,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    Throughput,
+)
+from torcheval_tpu.metrics.toolkit import update_collection
 from torcheval_tpu.models import TransformerLM, init_params
 
 import time
@@ -42,7 +48,12 @@ def main() -> None:
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss, logits
 
-    accuracy = MulticlassAccuracy()
+    # accuracy + F1 track the same (logits, labels) batch: update them with
+    # ONE fused dispatch per step via update_collection
+    cls_metrics = {
+        "acc": MulticlassAccuracy(),
+        "f1": MulticlassF1Score(num_classes=VOCAB, average="macro"),
+    }
     loss_mean = Mean()
     tput = Throughput()
 
@@ -56,16 +67,19 @@ def main() -> None:
             params, opt_state, loss, logits = train_step(
                 params, opt_state, tokens, targets
             )
-            accuracy.update(logits.reshape(-1, VOCAB), targets.reshape(-1))
+            update_collection(
+                cls_metrics, logits.reshape(-1, VOCAB), targets.reshape(-1)
+            )
             loss_mean.update(loss)
         tput.update(STEPS * BATCH * SEQ, time.perf_counter() - t0)
         print(
             f"epoch {epoch}: loss={float(loss_mean.compute()):.4f} "
-            f"acc={float(accuracy.compute()):.4f} "
+            f"acc={float(cls_metrics['acc'].compute()):.4f} "
+            f"f1={float(cls_metrics['f1'].compute()):.4f} "
             f"throughput={tput.compute():.0f} tok/s"
         )
-        accuracy.reset()
-        loss_mean.reset()
+        for metric in (*cls_metrics.values(), loss_mean):
+            metric.reset()
 
 
 if __name__ == "__main__":

@@ -230,6 +230,9 @@ def test_aggregation_image_streaming_plans():
     pair = {"psnr": grouped["psnr"], "stream": grouped["stream"]}
     progs = programs_for(lambda: update_collection(pair, x, t))
     assert len(progs) <= 1, progs
+    extrema = {"max": grouped["max"], "min": grouped["min"]}
+    progs = programs_for(lambda: update_collection(extrema, x))
+    assert len(progs) <= 1, progs
 
 
 def test_record_extension_point_counts_once():

@@ -10,7 +10,7 @@ from typing import TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TMax = TypeVar("TMax", bound="Max")
 
@@ -39,8 +39,6 @@ class Max(Metric[jax.Array]):
         return self._apply_update_plan(self._update_plan(input))
 
     def _update_plan(self, input):
-        from torcheval_tpu.metrics.metric import UpdatePlan
-
         return UpdatePlan(
             _max_transform, ("max",), (self._input_float(input),),
             transform=True,

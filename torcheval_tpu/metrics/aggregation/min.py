@@ -10,7 +10,7 @@ from typing import TypeVar
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TMin = TypeVar("TMin", bound="Min")
 
@@ -39,8 +39,6 @@ class Min(Metric[jax.Array]):
         return self._apply_update_plan(self._update_plan(input))
 
     def _update_plan(self, input):
-        from torcheval_tpu.metrics.metric import UpdatePlan
-
         return UpdatePlan(
             _min_transform, ("min",), (self._input_float(input),),
             transform=True,

@@ -20,7 +20,7 @@ from torcheval_tpu.metrics.functional.image.psnr import (
     _psnr_param_check,
     _psnr_update_jit,
 )
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TPeakSignalNoiseRatio = TypeVar(
     "TPeakSignalNoiseRatio", bound="PeakSignalNoiseRatio"
@@ -97,8 +97,6 @@ class PeakSignalNoiseRatio(Metric[jax.Array]):
         _psnr_input_check(input, target)
         if self.auto_range:
             # min/max/data-range are not additive -> transform plan
-            from torcheval_tpu.metrics.metric import UpdatePlan
-
             return UpdatePlan(
                 _psnr_auto_transform,
                 (

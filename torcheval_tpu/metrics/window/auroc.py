@@ -22,7 +22,7 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_compute,
     _binary_auroc_update_input_check,
 )
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 from torcheval_tpu.metrics.window._base import RingCursorSerializationMixin
 
 TWindowedBinaryAUROC = TypeVar("TWindowedBinaryAUROC", bound="WindowedBinaryAUROC")
@@ -122,8 +122,6 @@ class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
         )
 
     def _update_plan(self, input, target, weight=None):
-        from torcheval_tpu.metrics.metric import UpdatePlan
-
         input, target = self._input(input), self._input(target)
         if weight is not None:
             weight = self._input_float(weight)
