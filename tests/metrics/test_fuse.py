@@ -43,7 +43,7 @@ def test_single_delta_kernel():
 
 
 def test_arity_mismatch_raises():
-    with pytest.raises(ValueError, match="returned 1 deltas for 2 states"):
+    with pytest.raises(ValueError, match="returned 1 values for 2 states"):
         fused_accumulate(
             _single_kernel, (jnp.zeros(()), jnp.zeros(())), (jnp.ones(3),)
         )
