@@ -11,11 +11,15 @@ MulticlassAccuracy update throughput vs the reference torcheval on torch CPU
 (higher is better). The ``configs`` field carries all five BASELINE.md
 configs, each with its own value/unit/vs_baseline.
 
-Robustness contract (VERDICT round 1): the parent process NEVER imports JAX —
-every measurement runs in a subprocess, so a hung/unclaimable TPU backend
-cannot prevent the JSON line from being printed. The TPU is probed first
-(with one retry); on failure every config falls back to a CPU-only child
-with the TPU plugin registration scrubbed from the environment.
+Robustness contract (VERDICT rounds 1-3): the parent process NEVER imports
+JAX — every measurement runs in a subprocess, so a hung/unclaimable TPU
+backend cannot prevent the JSON line from being printed. A background
+daemon thread probes the TPU relay for the WHOLE run (not a front-loaded
+budget): configs start on whatever platform is claimable right then, fall
+back to a CPU-only child (TPU plugin registration scrubbed from the
+environment) when the relay is dead, and are RE-RUN on the TPU
+("re-promotion") if a later probe lands. Every probe attempt is recorded
+in the output JSON.
 """
 
 from __future__ import annotations
@@ -25,6 +29,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -377,6 +382,204 @@ def run_probe():
             "backend": jax.default_backend()}
 
 
+def _median_us(fn, iters=15, warm=2, budget_s=4.0):
+    """Median wall microseconds of fn() (blocked on its return value)."""
+    import jax
+
+    for _ in range(warm):
+        jax.block_until_ready(fn())
+    ts = []
+    start = time.perf_counter()
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append((time.perf_counter() - t0) * 1e6)
+        if time.perf_counter() - start > budget_s:
+            break
+    ts.sort()
+    return round(ts[len(ts) // 2], 1)
+
+
+def run_kernels():
+    """Per-backend kernel attestation (VERDICT r3 item 7).
+
+    Times each fused/native kernel against its pure-XLA twin on the backend
+    it claims to beat, so every per-kernel claim in docs/benchmarks.md is
+    individually auditable from the bench JSON:
+
+    - ``fused_auc``: the sort-free histogram AUC on the default backend —
+      Pallas vs pure-XLA on TPU, C++ custom-call vs pure-XLA on CPU.
+    - ``native_cpu``: the C++ CPU kernels (radix argsort, fused AUROC/AUPRC
+      area, fused cross-entropy) vs their XLA formulations, always measured
+      on the host CPU backend (arrays committed to a CPU device), even when
+      the child's default backend is TPU.
+    - ``bridge``: the BASELINE north-star bridge quantities — per-step
+      metric work of the config-3 panel in microseconds on this backend
+      (docs/benchmarks.md carries the <1%-of-step arithmetic).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    out = {
+        "metric": "per-backend kernel attestation",
+        "value": 1.0,
+        "unit": "see fused_auc/native_cpu/bridge",
+        "default_backend": jax.default_backend(),
+    }
+    rng = np.random.default_rng(0)
+
+    # ---- fused AUC on the default backend: pallas/native vs xla ----
+    from torcheval_tpu.ops import native
+    from torcheval_tpu.ops.fused_auc import fused_auc
+
+    n = 1 << 20
+    scores = jnp.asarray(rng.uniform(size=(n,)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 2, size=(n,)).astype(np.float32))
+    fa = {"n_samples": n, "num_bins": 8192}
+    backends = ["xla"]
+    if jax.default_backend() == "tpu":
+        backends.append("pallas")
+    elif jax.default_backend() == "cpu" and native.ensure_registered():
+        backends.append("native")
+    for b in backends:
+        fa[f"{b}_us"] = _median_us(
+            lambda b=b: fused_auc(scores, labels, num_bins=8192, backend=b)
+        )
+    out["fused_auc"] = fa
+
+    # ---- native C++ CPU kernels vs XLA, on the host CPU backend ----
+    nc = {"available": bool(native.ensure_registered())}
+    if nc["available"]:
+        from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+            _binary_auprc_area_xla,
+            _binary_auroc_area_xla,
+            _sort_desc_native,
+            _sort_desc_xla,
+            binary_auprc_area,
+            binary_auroc_area,
+        )
+        from torcheval_tpu.metrics.functional.text.perplexity import (
+            _perplexity_update_jit,
+            _perplexity_update_native_jit,
+        )
+
+        cpu0 = jax.devices("cpu")[0]
+        ns = 1 << 18
+        x = jax.device_put(
+            jnp.asarray(rng.uniform(size=(ns,)).astype(np.float32)), cpu0
+        )
+        t = jax.device_put(
+            jnp.asarray(rng.integers(0, 2, size=(ns,)).astype(np.float32)),
+            cpu0,
+        )
+        sort_native_j = jax.jit(_sort_desc_native)
+        sort_xla_j = jax.jit(_sort_desc_xla)
+        auroc_xla_j = jax.jit(
+            lambda x, t: _binary_auroc_area_xla(x, t, None)
+        )
+        auprc_xla_j = jax.jit(_binary_auprc_area_xla)
+        nc["sort_desc"] = {
+            "n_samples": ns,
+            "native_us": _median_us(lambda: sort_native_j(x), iters=10),
+            "xla_us": _median_us(
+                lambda: sort_xla_j(x), iters=6, budget_s=6.0
+            ),
+        }
+        nc["auroc_area"] = {
+            "n_samples": ns,
+            "native_us": _median_us(
+                lambda: binary_auroc_area(x, t), iters=10
+            ),
+            "xla_us": _median_us(
+                lambda: auroc_xla_j(x, t), iters=6, budget_s=6.0
+            ),
+        }
+        nc["auprc_area"] = {
+            "n_samples": ns,
+            "native_us": _median_us(
+                lambda: binary_auprc_area(x, t), iters=10
+            ),
+            "xla_us": _median_us(
+                lambda: auprc_xla_j(x, t), iters=6, budget_s=6.0
+            ),
+        }
+        b_, s_, v_ = 8, 128, 8192
+        logits = jax.device_put(
+            jnp.asarray(rng.normal(size=(b_, s_, v_)).astype(np.float32)),
+            cpu0,
+        )
+        targets = jax.device_put(
+            jnp.asarray(rng.integers(0, v_, size=(b_, s_)).astype(np.int32)),
+            cpu0,
+        )
+        nc["cross_entropy"] = {
+            "shape": [b_, s_, v_],
+            "native_us": _median_us(
+                lambda: _perplexity_update_native_jit(logits, targets, None),
+                iters=10,
+            ),
+            "xla_us": _median_us(
+                lambda: _perplexity_update_jit(logits, targets, None),
+                iters=6,
+                budget_s=6.0,
+            ),
+        }
+    out["native_cpu"] = nc
+
+    # ---- north-star bridge: per-step metric work in us on this backend ----
+    import torcheval_tpu.metrics as M
+    from torcheval_tpu.metrics.toolkit import update_collection
+
+    batch, classes = 1024, 100
+    xb = jnp.asarray(rng.uniform(size=(batch, classes)).astype(np.float32))
+    tb = jnp.asarray(rng.integers(0, classes, size=(batch,)))
+    acc = M.MulticlassAccuracy()
+
+    def acc_step():
+        acc.update(xb, tb)
+        return acc.num_total
+
+    sauroc = M.StreamingBinaryAUROC()
+    xs = jnp.asarray(rng.uniform(size=(16384,)).astype(np.float32))
+    ts_ = jnp.asarray(rng.integers(0, 2, size=(16384,)).astype(np.float32))
+
+    def sauroc_step():
+        sauroc.update(xs, ts_)
+        return sauroc.hist
+
+    panel = {
+        "acc": M.MulticlassAccuracy(),
+        "f1": M.MulticlassF1Score(),
+        "precision": M.MulticlassPrecision(
+            num_classes=classes, average="macro"
+        ),
+        "recall": M.MulticlassRecall(num_classes=classes, average="macro"),
+        "cm": M.MulticlassConfusionMatrix(classes),
+    }
+
+    def panel_step():
+        update_collection(panel, xb, tb)
+        return panel["acc"].num_total
+
+    out["bridge"] = {
+        "note": (
+            "per-step metric cost of the BASELINE config-3 workload "
+            "(MulticlassAccuracy + AUROC tracking) on this backend; the "
+            "in-jit sync adds zero collectives "
+            "(tests/metrics/test_sync_collective_structure.py), so "
+            "update cost IS the metric overhead — docs/benchmarks.md "
+            "derives the <1%-of-step bound from these"
+        ),
+        "accuracy_update_us": _median_us(acc_step, iters=30),
+        "streaming_auroc_update_us": _median_us(sauroc_step, iters=30),
+        "panel5_update_collection_us": _median_us(panel_step, iters=30),
+        "accuracy_sync_payload_bytes": 8,
+        "streaming_auroc_sync_payload_bytes": int(sauroc.hist.size) * 4,
+    }
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Reference baselines (torch CPU — the only backend the reference runs here)
 # ---------------------------------------------------------------------------
@@ -614,6 +817,12 @@ CONFIGS = {
     "sync_overhead": (run_sync_overhead, "ref_sync_overhead"),
     "text_eval": (run_text_eval, "ref_text_eval"),
     "fid": (run_fid, None),  # reference needs torchvision (absent here)
+    "kernels": (run_kernels, None),  # per-backend attestation, no ref number
+}
+
+_NO_REF_NOTES = {
+    "fid": "reference requires torchvision (not installed in this image)",
+    "kernels": "per-backend attestation — no single reference number",
 }
 
 REF_FNS = {
@@ -650,18 +859,30 @@ def _cpu_env():
     return env
 
 
-def _run_child(config, platform, timeout):
+def _run_child(config, platform, timeout, proc_slot=None):
+    """Run one config in a subprocess. ``proc_slot``: optional list the
+    live Popen is appended to, so a caller on another thread (the relay
+    prober) can kill an in-flight child instead of orphaning it — a probe
+    hung on a dead relay would otherwise outlive the parent process."""
     env = _cache_env(_cpu_env() if platform == "cpu" else dict(os.environ))
-    proc = subprocess.run(
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "bench.py"), "--child", config],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        timeout=timeout, cwd=REPO,
+        cwd=REPO,
     )
+    if proc_slot is not None:
+        proc_slot.append(proc)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise
     if proc.returncode != 0:
         raise RuntimeError(
-            f"{config}@{platform} rc={proc.returncode}: {proc.stderr[-500:]}"
+            f"{config}@{platform} rc={proc.returncode}: {stderr[-500:]}"
         )
-    return json.loads(proc.stdout.strip().splitlines()[-1])
+    return json.loads(stdout.strip().splitlines()[-1])
 
 
 def _run_ref_child(refname, timeout):
@@ -677,59 +898,197 @@ def _run_ref_child(refname, timeout):
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
-class RelayProber:
-    """Fights for the TPU with a bounded, auditable retry schedule.
+class _KillableProcSlot:
+    """Holds the prober's in-flight probe Popen; ``kill_all`` is sticky, so
+    a child whose Popen lands in the slot AFTER the kill (spawn racing
+    stop()) is killed on arrival instead of orphaned."""
 
-    VERDICT r2: a single up-front probe let one relay blip push the whole
-    round to CPU. This prober (a) retries with backoff at run start, (b)
-    re-probes between configs so a mid-run relay revival is caught, and
-    (c) records every attempt (timestamp, timeout, outcome) in the output
-    JSON so a CPU fallback is auditable rather than asserted.
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._procs = []
+        self._killed = False
+
+    def append(self, proc) -> None:  # duck-typed for _run_child's proc_slot
+        with self._lock:
+            self._procs.append(proc)
+            if self._killed and proc.poll() is None:
+                proc.kill()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._procs.clear()
+
+    def kill_all(self) -> None:
+        with self._lock:
+            self._killed = True
+            for proc in self._procs:
+                if proc.poll() is None:
+                    proc.kill()
+
+
+class RelayProber:
+    """Fights for the TPU with a background probe thread.
+
+    VERDICT r3: the round-3 prober front-loaded a 150 s probe budget, so a
+    relay that revived mid-run (as the builder's same-day capture proved it
+    does) was never caught. Now a daemon thread keeps probing for the WHOLE
+    run: foreground configs consult ``available()`` just-in-time, probes
+    cost no foreground wall time, and the parent re-runs (re-promotes)
+    fallen-back configs once a probe lands. Every attempt is recorded
+    (t_s, timeout, outcome) in the output JSON so a CPU fallback is
+    auditable rather than asserted.
     """
 
-    def __init__(self, budget_s: float, t0: float):
-        self.budget_s = budget_s
+    def __init__(self, t0: float, first_timeout=120.0, timeout=75.0,
+                 interval=15.0, interval_busy=60.0):
         self.t0 = t0
-        self.spent = 0.0
+        self.first_timeout = first_timeout
+        self.timeout = timeout
+        self.interval = interval
+        # while a foreground measurement child runs, probe less often: each
+        # probe costs a few CPU-seconds of JAX import that would otherwise
+        # perturb the number being measured
+        self.interval_busy = interval_busy
         self.attempts = []
-        self.platform = "cpu"
+        self.spent = 0.0
+        self._ok = threading.Event()
+        self._first_done = threading.Event()
+        self._stop = threading.Event()
+        self._busy = threading.Event()
+        self._proc_slot = _KillableProcSlot()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._stop.set()
+        # a probe child may be mid-flight, hung against a dead relay: kill
+        # it (otherwise it outlives this process) and join the thread; the
+        # sticky kill also covers a Popen landing in the slot after this.
+        # snapshot_attempts() additionally protects the final JSON from a
+        # still-running thread's in-place record update
+        self._proc_slot.kill_all()
+        self._thread.join(join_timeout)
+
+    def set_busy(self, busy: bool) -> None:
+        """Foreground measurement in flight: stretch the probe cadence."""
+        if busy:
+            self._busy.set()
+        else:
+            self._busy.clear()
+
+    def snapshot_attempts(self):
+        """Race-free copy for serialization: ``dict(rec)`` is atomic under
+        the GIL, so a probe resolving mid-dump cannot mutate what
+        ``json.dumps`` iterates."""
+        return [dict(rec) for rec in list(self.attempts)]
+
+    def available(self) -> bool:
+        return self._ok.is_set()
+
+    def invalidate(self) -> None:
+        """A TPU child just failed: drop the claim, resume probing."""
+        self._ok.clear()
+
+    def wait_first_attempt(self, timeout: float) -> None:
+        """Block until the first probe resolves (or timeout) so a healthy
+        relay gets config 1 on the chip without a re-promotion round trip."""
+        self._first_done.wait(timeout)
 
     def _one_probe(self, timeout: float) -> bool:
         start = time.monotonic()
+        # recorded BEFORE the child runs: if the parent finishes while this
+        # probe is still in flight, the audit trail shows the pending
+        # attempt rather than pretending no probe happened
         rec = {
             "t_s": round(start - self.t0, 1),
-            "timeout_s": timeout,
-            "ok": False,
+            "timeout_s": round(timeout, 1),
+            "ok": None,
+            "pending": True,
         }
+        self.attempts.append(rec)
         try:
-            res = _run_child("probe", "tpu", timeout=timeout)
+            self._proc_slot.clear()
+            res = _run_child(
+                "probe", "tpu", timeout=timeout, proc_slot=self._proc_slot
+            )
             rec["ok"] = res.get("backend") not in (None, "cpu")
             rec["backend"] = res.get("backend")
         except Exception as e:  # noqa: BLE001
+            rec["ok"] = False
             rec["error"] = str(e)[-200:]
+        del rec["pending"]
         self.spent += time.monotonic() - start
-        self.attempts.append(rec)
         print(f"# tpu probe: {rec}", file=sys.stderr)
         return rec["ok"]
 
-    def initial(self) -> str:
-        # first TPU compile is ~20-40s; 120s covers it while keeping the
-        # dead time bounded when the relay is hung
-        for timeout in (120.0, 60.0):
-            if self.spent >= self.budget_s:
-                break
-            if self._one_probe(min(timeout, self.budget_s - self.spent)):
-                self.platform = "tpu"
-                break
-        return self.platform
+    def _loop(self) -> None:
+        timeout = self.first_timeout
+        while not self._stop.is_set():
+            if self._ok.is_set():
+                self._stop.wait(1.0)
+                continue
+            ok = self._one_probe(timeout)
+            self._first_done.set()
+            timeout = self.timeout
+            if ok:
+                self._ok.set()
+            else:
+                self._stop.wait(
+                    self.interval_busy
+                    if self._busy.is_set()
+                    else self.interval
+                )
 
-    def recheck(self) -> str:
-        """Between configs: one more bounded attempt while budget remains."""
-        if self.platform == "tpu" or self.spent >= self.budget_s:
-            return self.platform
-        if self._one_probe(min(45.0, self.budget_s - self.spent)):
-            self.platform = "tpu"
-        return self.platform
+
+def _attach_ref(entry, name, refname, ref_cache):
+    """Compute vs_baseline against the (cached) reference measurement."""
+    if refname is None:
+        entry["vs_baseline"] = None
+        entry["vs_baseline_note"] = _NO_REF_NOTES.get(name, "no reference")
+        return
+    try:
+        if refname not in ref_cache:
+            ref_cache[refname] = _run_ref_child(refname, timeout=420)
+        ref = ref_cache[refname]
+        if entry.get("lower_is_better"):
+            # compare like with like: the reference's sync number
+            # necessarily includes the metric update, so ratio
+            # against our update+sync total when we report one
+            mine = entry.get(
+                "update_plus_sync_overhead_pct", entry["value"]
+            )
+            if not mine or mine <= 0:
+                # the update+sync total can clamp to 0 when the synced arm
+                # measures faster than the plain arm (noise floor); fall
+                # back to the sync-only number rather than dropping the
+                # ratio entirely — flagged, because the denominators are
+                # then unlike quantities (baseline includes the update)
+                mine = entry["value"]
+                entry["vs_baseline_note"] = (
+                    "update+sync total clamped to 0 (noise floor); ratio "
+                    "uses the sync-only overhead as denominator, which "
+                    "overstates the win vs the update-inclusive baseline"
+                )
+            if mine and mine > 0:
+                entry["vs_baseline"] = round(ref["value"] / mine, 2)
+            else:
+                entry["vs_baseline"] = None
+                entry["vs_baseline_note"] = (
+                    "our overhead measured 0% (noise floor); the baseline "
+                    "overhead is in baseline_value"
+                )
+            entry["baseline_value"] = round(ref["value"], 3)
+        else:
+            entry["vs_baseline"] = round(entry["value"] / ref["value"], 2)
+            entry["baseline_value"] = round(ref["value"], 2)
+        for k in ("step_per_s_plain", "step_per_s_with_metric_sync"):
+            if k in ref:
+                entry[f"baseline_{k}"] = round(ref[k], 1)
+    except Exception as e:  # noqa: BLE001
+        entry["vs_baseline"] = None
+        entry["vs_baseline_error"] = str(e)[-300:]
 
 
 def main():
@@ -739,14 +1098,32 @@ def main():
     ap.add_argument("--only", help="comma-separated config subset (parent)")
     ap.add_argument(
         "--budget-s", type=float, default=1500.0,
-        help="soft wall-clock budget: once half is spent, remaining configs "
-        "skip their TPU attempt (a mid-run relay stall costs a 420 s child "
-        "timeout per config; the budget bounds the worst case)",
+        help="TPU-attempt/linger budget: no TPU attempt (initial or "
+        "re-promotion) starts unless it could finish (420 s child timeout) "
+        "inside it, and lingering for a late relay revival ends at 60%% of "
+        "it. CPU and reference children are bounded per-child (420 s "
+        "each), not by this budget",
     )
     ap.add_argument(
-        "--probe-budget-s", type=float, default=150.0,
-        help="total wall-clock allowed for TPU relay probes (initial "
-        "backoff + between-config rechecks)",
+        "--first-wait-s", type=float, default=130.0,
+        help="how long config 1 waits for the FIRST background probe to "
+        "resolve (a healthy relay answers inside this; a hung one costs "
+        "one probe timeout, after which work proceeds on cpu while probes "
+        "continue in the background)",
+    )
+    ap.add_argument(
+        "--linger-s", type=float, default=420.0,
+        help="after the cpu pass, keep waiting this long for a late relay "
+        "revival before giving up on re-promoting fallen-back configs",
+    )
+    ap.add_argument(
+        "--probe-timeout-s", type=float, default=75.0,
+        help="per-probe child timeout after the first (first gets 120 s "
+        "to cover the initial TPU compile)",
+    )
+    ap.add_argument(
+        "--probe-interval-s", type=float, default=15.0,
+        help="pause between failed background probes",
     )
     args = ap.parse_args()
 
@@ -762,26 +1139,22 @@ def main():
     t0 = time.monotonic()
     names = list(CONFIGS) if not args.only else args.only.split(",")
 
-    prober = RelayProber(args.probe_budget_s, t0)
-    platform = prober.initial()
-    print(f"# platform: {platform}", file=sys.stderr)
+    prober = RelayProber(
+        t0,
+        first_timeout=max(120.0, args.probe_timeout_s),
+        timeout=args.probe_timeout_s,
+        interval=args.probe_interval_s,
+    )
+    prober.start()
+    prober.wait_first_attempt(args.first_wait_s)
+    print(f"# tpu available: {prober.available()}", file=sys.stderr)
 
-    configs_out = {}
-    budget_hit = False
-    for name in names:
-        _, refname = CONFIGS[name]
-        platform = prober.recheck()
-        # sync_overhead needs a multi-device mesh: with one real TPU chip the
-        # virtual 8-device CPU platform is the honest measurement.
-        plat = "cpu" if name == "sync_overhead" else platform
-        if plat != "cpu" and time.monotonic() - t0 > args.budget_s / 2:
-            if not budget_hit:
-                print(
-                    f"# budget ({args.budget_s:.0f}s) half-spent: remaining "
-                    "configs run on cpu", file=sys.stderr,
-                )
-                budget_hit = True
-            plat = "cpu"
+    def tpu_time_ok():
+        # room for the TPU child (420 s) plus a cpu fallback re-run
+        return time.monotonic() - t0 < args.budget_s - 450
+
+    def measure(name, plat):
+        """Run one config child; returns the entry or None."""
         entry = None
         for p in dict.fromkeys([plat, "cpu"]):  # fall back to cpu once
             try:
@@ -790,48 +1163,91 @@ def main():
                 break
             except Exception as e:  # noqa: BLE001
                 print(f"# {name}@{p} failed: {e}", file=sys.stderr)
+                if p != "cpu":
+                    prober.invalidate()
+        return entry
+
+    ref_cache = {}
+    configs_out = {}
+    # the whole first pass is timing-sensitive (our children AND the torch
+    # reference children): stretch the probe cadence for its duration
+    prober.set_busy(True)
+    for name in names:
+        _, refname = CONFIGS[name]
+        # sync_overhead needs a multi-device mesh: with one real TPU chip the
+        # virtual 8-device CPU platform is the honest measurement.
+        want_tpu = (
+            name != "sync_overhead" and prober.available() and tpu_time_ok()
+        )
+        entry = measure(name, "tpu" if want_tpu else "cpu")
         if entry is None:
             configs_out[name] = {"error": "all platforms failed"}
             continue
-
-        if refname is not None:
-            try:
-                ref = _run_ref_child(refname, timeout=420)
-                if entry.get("lower_is_better"):
-                    # compare like with like: the reference's sync number
-                    # necessarily includes the metric update, so ratio
-                    # against our update+sync total when we report one
-                    mine = entry.get(
-                        "update_plus_sync_overhead_pct", entry["value"]
-                    )
-                    entry["vs_baseline"] = (
-                        round(ref["value"] / mine, 2) if mine > 0 else None
-                    )
-                    entry["baseline_value"] = round(ref["value"], 3)
-                else:
-                    entry["vs_baseline"] = round(entry["value"] / ref["value"], 2)
-                    entry["baseline_value"] = round(ref["value"], 2)
-                for k in ("step_per_s_plain", "step_per_s_with_metric_sync"):
-                    if k in ref:
-                        entry[f"baseline_{k}"] = round(ref[k], 1)
-            except Exception as e:  # noqa: BLE001
-                entry["vs_baseline"] = None
-                entry["vs_baseline_error"] = str(e)[-300:]
-        else:
-            entry["vs_baseline"] = None
-            entry["vs_baseline_note"] = (
-                "reference requires torchvision (not installed in this image)"
-            )
+        _attach_ref(entry, name, refname, ref_cache)
         configs_out[name] = entry
         print(f"# {name}: {json.dumps(entry)}", file=sys.stderr)
+    prober.set_busy(False)
+
+    # ---- re-promotion: fight for the chip until the budget says stop ----
+    # (VERDICT r3 item 1: a late relay revival must convert already-fallen
+    # configs to TPU entries, not just be noted in the audit trail)
+    def fallen():
+        return [
+            n for n, e in configs_out.items()
+            if e.get("platform") == "cpu" and n != "sync_overhead"
+            and "error" not in e
+        ]
+
+    linger_deadline = min(
+        t0 + args.budget_s * 0.6, time.monotonic() + args.linger_s
+    )
+    repromoted = []
+    failed_repromotions = {}  # config -> attempt count (2 strikes and out)
+    while tpu_time_ok():
+        candidates = [
+            n for n in fallen() if failed_repromotions.get(n, 0) < 2
+        ]
+        if not candidates:
+            break
+        if not prober.available():
+            if time.monotonic() >= linger_deadline:
+                break
+            time.sleep(3.0)
+            continue
+        # least-failed first: one config whose TPU child keeps dying for a
+        # config-specific reason must not starve the others
+        name = min(candidates, key=lambda n: failed_repromotions.get(n, 0))
+        print(f"# re-promoting {name} to tpu", file=sys.stderr)
+        prober.set_busy(True)
+        try:
+            try:
+                entry = _run_child(name, "tpu", timeout=420)
+                entry["platform"] = "tpu"
+            except Exception as e:  # noqa: BLE001
+                print(
+                    f"# re-promotion {name}@tpu failed: {e}", file=sys.stderr
+                )
+                failed_repromotions[name] = (
+                    failed_repromotions.get(name, 0) + 1
+                )
+                prober.invalidate()
+                continue
+            old = configs_out[name]
+            entry["cpu_fallback_value"] = old.get("value")
+            entry["repromoted_at_s"] = round(time.monotonic() - t0, 1)
+            _attach_ref(entry, name, CONFIGS[name][1], ref_cache)
+        finally:
+            prober.set_busy(False)
+        configs_out[name] = entry
+        repromoted.append(name)
+        print(f"# {name}: {json.dumps(entry)}", file=sys.stderr)
+    prober.stop()
 
     head = configs_out.get("accuracy_update") or next(
         (v for v in configs_out.values() if "value" in v), {}
     )
-    # the headline platform is the platform the HEADLINE NUMBER ran on —
-    # a mid-run relay revival must not relabel configs that already fell
-    # back to CPU (each configs_out entry carries its own platform)
-    platform = head.get("platform", prober.platform)
+    # the headline platform is the platform the HEADLINE NUMBER ran on
+    platform = head.get("platform", "cpu")
     out = {
         "metric": head.get(
             "metric", "MulticlassAccuracy jitted update throughput"
@@ -841,17 +1257,27 @@ def main():
         "vs_baseline": head.get("vs_baseline"),
         "platform": platform,
         "wall_s": round(time.monotonic() - t0, 1),
-        "relay_attempts": prober.attempts,
+        "relay_attempts": prober.snapshot_attempts(),
         "relay_probe_spent_s": round(prober.spent, 1),
         "configs": configs_out,
     }
-    fell_back = [
-        n for n, e in configs_out.items()
-        if e.get("platform") == "cpu" and n != "sync_overhead"
-    ]
+    if repromoted:
+        out["repromoted"] = repromoted
+    fell_back = fallen()
     if fell_back:
+        reached = any(
+            rec.get("ok") for rec in out["relay_attempts"]
+        ) or any(
+            e.get("platform") == "tpu" for e in configs_out.values()
+        )
+        why = (
+            "TPU children failed or the relay was lost mid-run"
+            if reached
+            else "the background prober never reached the TPU relay "
+            "during this run"
+        )
         out["note"] = (
-            f"configs {fell_back} ran on cpu (relay probe schedule in "
+            f"configs {fell_back} ran on cpu — {why} (audit trail in "
             "relay_attempts); previously captured single-chip TPU numbers "
             "are committed in docs/benchmarks.md"
         )
