@@ -1,0 +1,113 @@
+"""Generate docs/metrics.md — the rendered per-metric API reference.
+
+The reference ships Sphinx autodoc pages for every symbol
+(reference docs/source/torcheval.metrics.rst); here the docstrings are the
+single source and this generator renders them to one markdown file, with
+every class's Examples block shown as a code fence. Regenerate with::
+
+    PYTHONPATH=. python docs/gen_metrics_reference.py
+
+``tests/test_metrics_reference_doc.py`` regenerates in-memory and fails if
+the committed file drifts from the docstrings, and
+``tests/test_docstring_examples.py`` executes every example shown here —
+so the rendered docs cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+
+CATEGORY_OF_MODULE = (
+    ("aggregation", "Aggregation"),
+    ("classification", "Classification"),
+    ("image", "Image"),
+    ("ranking", "Ranking"),
+    ("regression", "Regression"),
+    ("text", "Text"),
+    ("window", "Windowed"),
+)
+
+
+def _category(obj) -> str:
+    module = getattr(obj, "__module__", "")
+    for needle, title in CATEGORY_OF_MODULE:
+        if f".{needle}." in module:
+            return title
+    return "Core"
+
+
+def _render_docstring(doc: str) -> str:
+    """Docstring -> markdown: `Examples::`/`Args:` sections become fences
+    and literal blocks; prose passes through."""
+    out = []
+    lines = doc.split("\n")
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        if line.strip() in ("Examples::", "Example::"):
+            out.append("```python")
+            i += 1
+            while i < len(lines) and (
+                not lines[i].strip() or lines[i].startswith("    ")
+            ):
+                stripped = lines[i][4:] if lines[i].startswith("    ") else ""
+                out.append(stripped)
+                i += 1
+            while out and not out[-1].strip():
+                out.pop()
+            out.append("```")
+            continue
+        out.append(line)
+        i += 1
+    return "\n".join(out).strip()
+
+
+def render() -> str:
+    import torcheval_tpu.metrics as M
+    import torcheval_tpu.metrics.functional as F
+
+    sections: dict = {title: [] for _, title in CATEGORY_OF_MODULE}
+    sections["Core"] = []
+
+    for name in sorted(n for n in M.__all__ if n[0].isupper()):
+        obj = getattr(M, name)
+        doc = inspect.getdoc(obj) or ""
+        try:
+            sig = str(inspect.signature(obj.__init__)).replace("self, ", "")
+        except (TypeError, ValueError):
+            sig = "(...)"
+        entry = [f"### `{name}{sig}`", "", _render_docstring(doc), ""]
+        sections[_category(obj)].append("\n".join(entry))
+
+    parts = [
+        "# Metrics reference",
+        "",
+        "Generated from class docstrings by `docs/gen_metrics_reference.py`"
+        " — do not edit by hand (`tests/test_metrics_reference_doc.py`"
+        " guards drift, and every example below is executed by"
+        " `tests/test_docstring_examples.py`).",
+        "",
+        "Functional (stateless) siblings live in"
+        " `torcheval_tpu.metrics.functional` — same math, eager, one call;"
+        " see [api.md](api.md) for the one-line index of all"
+        f" {len(F.__all__)} functions.",
+        "",
+    ]
+    for _, title in (("core", "Core"),) + CATEGORY_OF_MODULE:
+        if sections[title]:
+            parts.append(f"## {title}")
+            parts.append("")
+            parts.extend(sections[title])
+    return "\n".join(parts).rstrip() + "\n"
+
+
+def main() -> None:
+    path = os.path.join(os.path.dirname(__file__), "metrics.md")
+    with open(path, "w") as f:
+        f.write(render())
+    print(f"wrote {path} ({os.path.getsize(path)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

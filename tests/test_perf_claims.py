@@ -1,0 +1,165 @@
+"""Published performance claims must match their committed captures.
+
+VERDICT r3 item 5: README.md and docs/benchmarks.md published different
+numbers for the same config (different same-day runs). This guard makes
+the committed capture JSONs (`docs/captures/`) the single source of
+truth: every ratio and headline value published in either file is parsed
+out of the markdown and compared against the capture it cites. A doc
+edit that drifts from the captures — or a capture swap that silently
+invalidates the docs — fails here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    with open(os.path.join(REPO, "docs", "captures", name)) as f:
+        return json.load(f)["configs"]
+
+
+TPU = _load("bench_r3_tpu_20260731.json")
+CPU = _load("bench_r3_cpu_deadrelay_20260731.json")
+
+
+def _read(path):
+    with open(os.path.join(REPO, path)) as f:
+        return f.read()
+
+
+def _fmt_ratio(x, decimals=1):
+    """Render a capture ratio the way the docs publish it: thousands
+    separator, one decimal below 100, none above."""
+    if x >= 100:
+        return f"{round(x):,}"
+    return f"{round(x, decimals):g}"
+
+
+# (published-row regex, capture entry, lower_is_better) per config; the
+# regex captures the ratio cell so a rewrite of surrounding prose cannot
+# silently detach the number from the check
+README_ROWS = [
+    (r"MulticlassAccuracy update throughput \| \*\*([\d.,]+)×\*\* \| \*\*([\d.,]+)×\*\*",
+     ("accuracy_update", "accuracy_update")),
+    (r"BinaryAUROC\+AUPRC deferred compute \(262k samples\) \| \*\*([\d.,]+)×\*\* \| \*\*([\d.,]+)×\*\*",
+     ("auroc_compute", "auroc_compute")),
+    (r"Metric sync overhead, % of step time \(8-way DP\) \| \*\*([\d.,]+)×\*\* lower \| \*\*([\d.,]+)×\*\* lower",
+     ("sync_overhead", "sync_overhead")),
+    (r"Perplexity\+BLEU eval loop \| \*\*([\d.,]+)×\*\* \| \*\*([\d.,]+)×\*\*",
+     ("text_eval", "text_eval")),
+]
+
+
+def test_readme_table_matches_captures():
+    text = _read("README.md")
+    for pattern, (tpu_key, cpu_key) in README_ROWS:
+        m = re.search(pattern, text)
+        assert m, f"README row not found for {tpu_key}: /{pattern}/"
+        want_tpu = _fmt_ratio(TPU[tpu_key]["vs_baseline"])
+        want_cpu = _fmt_ratio(CPU[cpu_key]["vs_baseline"])
+        assert m.group(1) == want_tpu, (
+            f"README TPU ratio for {tpu_key} is {m.group(1)}x; capture "
+            f"says {want_tpu}x"
+        )
+        assert m.group(2) == want_cpu, (
+            f"README CPU ratio for {cpu_key} is {m.group(2)}x; capture "
+            f"says {want_cpu}x"
+        )
+
+
+def test_readme_fid_value_matches_capture():
+    m = re.search(r"FID update throughput \| ([\d.,]+) img/s", _read("README.md"))
+    assert m, "README FID row not found"
+    want = f"{round(TPU['fid']['value']):,}"
+    assert m.group(1) == want, (
+        f"README FID throughput {m.group(1)} img/s; capture says {want}"
+    )
+
+
+BENCHMARKS_TPU_ROWS = [
+    (r"1\. MulticlassAccuracy class update[^|]*\| ([\d,]+) updates/s \(TPU\) \| ([\d,]+) updates/s \| \*\*([\d.,]+)×\*\*",
+     "accuracy_update"),
+    (r"2\. BinaryAUROC\+AUPRC deferred compute[^|]*\| ([\d,]+) computes/s \(TPU\) \| ([\d.]+) computes/s \| \*\*([\d.,]+)×\*\*",
+     "auroc_compute"),
+    (r"4\. Perplexity\+BLEU eval loop[^|]*\| (\d+) updates/s \(TPU\) \| ([\d.]+) updates/s \| \*\*([\d.,]+)×\*\*",
+     "text_eval"),
+]
+
+
+def test_benchmarks_tpu_table_matches_capture():
+    text = _read("docs/benchmarks.md")
+    for pattern, key in BENCHMARKS_TPU_ROWS:
+        m = re.search(pattern, text)
+        assert m, f"benchmarks.md TPU row not found for {key}"
+        entry = TPU[key]
+        got_value = float(m.group(1).replace(",", ""))
+        assert got_value == pytest.approx(entry["value"], rel=0.01), (
+            f"{key}: published value {got_value} vs capture {entry['value']}"
+        )
+        got_base = float(m.group(2).replace(",", ""))
+        assert got_base == pytest.approx(entry["baseline_value"], rel=0.01)
+        assert m.group(3) == _fmt_ratio(entry["vs_baseline"])
+
+
+BENCHMARKS_CPU_ROWS = [
+    (r"1\. MulticlassAccuracy update \| ([\d,]+) updates/s \| ([\d,]+) updates/s \| \*\*([\d.]+)×\*\*",
+     "accuracy_update"),
+    (r"2\. BinaryAUROC\+AUPRC deferred compute \| ([\d.]+) computes/s \| ([\d.]+) computes/s \| \*\*([\d.]+)×\*\*",
+     "auroc_compute"),
+    (r"3\. sync overhead \(8-dev virtual mesh, update\+sync total\) \| ([\d.]+)% of step \| ([\d.]+)% of step \| \*\*([\d.]+)×\*\* lower",
+     "sync_overhead"),
+    (r"4\. Perplexity\+BLEU eval loop \| (\d+) updates/s \| ([\d.]+) updates/s \| \*\*([\d.]+)×\*\*",
+     "text_eval"),
+]
+
+
+def test_benchmarks_cpu_table_matches_capture():
+    text = _read("docs/benchmarks.md")
+    for pattern, key in BENCHMARKS_CPU_ROWS:
+        m = re.search(pattern, text)
+        assert m, f"benchmarks.md CPU row not found for {key}"
+        entry = CPU[key]
+        mine = (
+            entry["update_plus_sync_overhead_pct"]
+            if key == "sync_overhead"
+            else entry["value"]
+        )
+        got_value = float(m.group(1).replace(",", ""))
+        assert got_value == pytest.approx(mine, rel=0.01), (
+            f"{key}: published value {got_value} vs capture {mine}"
+        )
+        got_base = float(m.group(2).replace(",", ""))
+        assert got_base == pytest.approx(entry["baseline_value"], rel=0.01)
+        assert m.group(3) == _fmt_ratio(entry["vs_baseline"])
+
+
+def test_bridge_numerator_terms_match_dispatch_table():
+    """The <1% bridge's measured terms must equal the dispatch-fusion
+    table's published numbers (both from the same chip capture)."""
+    text = _read("docs/benchmarks.md")
+    dispatch = re.search(
+        r"`StreamingBinaryAUROC.update` \| \d+ us \| \*\*(\d+) us\*\*", text
+    )
+    bridge = re.search(
+        r"`StreamingBinaryAUROC.update` \(one fused dispatch\) \| (\d+) µs/step",
+        text,
+    )
+    assert dispatch and bridge
+    assert dispatch.group(1) == bridge.group(1)
+    acc = re.search(
+        r"`MulticlassAccuracy.update` \(one fused dispatch\) \| (\d+) µs/step",
+        text,
+    )
+    floor = re.search(
+        r"`MulticlassAccuracy.update` \(already fused; the dispatch floor\) \| (\d+) us \| (\d+) us",
+        text,
+    )
+    assert acc and floor
+    assert acc.group(1) == floor.group(2)

@@ -162,7 +162,16 @@ class MultilabelAccuracy(MulticlassAccuracy):
 
 
 class TopKMultilabelAccuracy(MulticlassAccuracy):
-    """Multilabel accuracy with top-k binarization of scores."""
+    """Multilabel accuracy with top-k binarization of scores.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import TopKMultilabelAccuracy
+        >>> metric = TopKMultilabelAccuracy(criteria="hamming", k=2)
+        >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
 
     def __init__(
         self,

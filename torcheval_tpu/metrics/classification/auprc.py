@@ -94,7 +94,17 @@ class BinaryAUPRC(_BufferedPairMetric):
 
 
 class MulticlassAUPRC(_BufferedPairMetric):
-    """One-vs-rest AUPRC for multiclass classification."""
+    """One-vs-rest AUPRC for multiclass classification.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import MulticlassAUPRC
+        >>> metric = MulticlassAUPRC(num_classes=3)
+        >>> metric.update(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
+        ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     def __init__(
         self,
@@ -123,7 +133,16 @@ class MulticlassAUPRC(_BufferedPairMetric):
 
 
 class MultilabelAUPRC(_BufferedPairMetric):
-    """Per-label AUPRC for multilabel classification."""
+    """Per-label AUPRC for multilabel classification.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import MultilabelAUPRC
+        >>> metric = MultilabelAUPRC(num_labels=3)
+        >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     def __init__(
         self,

@@ -32,7 +32,7 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
         >>> metric = BinaryNormalizedEntropy()
         >>> metric.update(jnp.array([0.2, 0.3]), jnp.array([1.0, 0.0]))
         >>> metric.compute()
-        Array([1.046], dtype=float32)
+        Array([1.4182507], dtype=float32)
     """
 
     def __init__(

@@ -120,7 +120,17 @@ class BinaryBinnedAUPRC(Metric[jax.Array]):
 
 
 class MulticlassBinnedAUPRC(Metric[jax.Array]):
-    """Binned one-vs-rest AUPRC for multiclass classification."""
+    """Binned one-vs-rest AUPRC for multiclass classification.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import MulticlassBinnedAUPRC
+        >>> metric = MulticlassBinnedAUPRC(num_classes=3, threshold=5)
+        >>> metric.update(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
+        ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     _extra_device_attrs = ("threshold",)
 
@@ -171,7 +181,16 @@ class MulticlassBinnedAUPRC(Metric[jax.Array]):
 
 
 class MultilabelBinnedAUPRC(Metric[jax.Array]):
-    """Binned per-label AUPRC for multilabel classification."""
+    """Binned per-label AUPRC for multilabel classification.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import MultilabelBinnedAUPRC
+        >>> metric = MultilabelBinnedAUPRC(num_labels=3, threshold=5)
+        >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))
+        >>> metric.compute()
+        Array(0.77777785, dtype=float32)
+    """
 
     _extra_device_attrs = ("threshold",)
 

@@ -76,6 +76,15 @@ class MulticlassBinnedAUROC(_BufferedPairMetric):
 
     See the functional docstring for the documented divergence from the
     reference's (buggy) class-axis reduction.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import MulticlassBinnedAUROC
+        >>> metric = MulticlassBinnedAUROC(num_classes=3, threshold=5)
+        >>> metric.update(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
+        ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        (Array(1., dtype=float32), Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
     """
 
     _extra_device_attrs = ("threshold",)

@@ -86,7 +86,17 @@ class MulticlassBinnedPrecisionRecallCurve(
     Metric[Tuple[List[jax.Array], List[jax.Array], jax.Array]]
 ):
     """Binned per-class precision-recall curves for multiclass
-    classification, with selectable update kernel (``optimization``)."""
+    classification, with selectable update kernel (``optimization``).
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import MulticlassBinnedPrecisionRecallCurve
+        >>> metric = MulticlassBinnedPrecisionRecallCurve(num_classes=3, threshold=3)
+        >>> metric.update(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
+        ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        ([Array([0.25, 1.  , 1.  , 1.  ], dtype=float32), Array([0.5, 1. , 1. , 1. ], dtype=float32), Array([0.25, 1.  , 1.  , 1.  ], dtype=float32)], [Array([1., 1., 0., 0.], dtype=float32), Array([1., 1., 0., 0.], dtype=float32), Array([1., 1., 0., 0.], dtype=float32)], Array([0. , 0.5, 1. ], dtype=float32))
+    """
 
     _extra_device_attrs = ("threshold",)
 
@@ -139,7 +149,16 @@ class MultilabelBinnedPrecisionRecallCurve(
     Metric[Tuple[List[jax.Array], List[jax.Array], jax.Array]]
 ):
     """Binned per-label precision-recall curves for multilabel
-    classification."""
+    classification.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import MultilabelBinnedPrecisionRecallCurve
+        >>> metric = MultilabelBinnedPrecisionRecallCurve(num_labels=3, threshold=3)
+        >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))
+        >>> metric.compute()
+        ([Array([0.6666667, 1.       , 1.       , 1.       ], dtype=float32), Array([0.33333334, 0.5       , 1.        , 1.        ], dtype=float32), Array([0.6666667, 1.       , 1.       , 1.       ], dtype=float32)], [Array([1., 1., 0., 0.], dtype=float32), Array([1., 1., 0., 0.], dtype=float32), Array([1. , 0.5, 0. , 0. ], dtype=float32)], Array([0. , 0.5, 1. ], dtype=float32))
+    """
 
     _extra_device_attrs = ("threshold",)
 

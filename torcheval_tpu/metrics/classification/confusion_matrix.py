@@ -82,7 +82,17 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
 
 class BinaryConfusionMatrix(MulticlassConfusionMatrix):
     """2x2 confusion matrix for binary classification with thresholded
-    score inputs."""
+    score inputs.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import BinaryConfusionMatrix
+        >>> metric = BinaryConfusionMatrix()
+        >>> metric.update(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))
+        >>> metric.compute()
+        Array([[2, 0],
+               [0, 2]], dtype=int32)
+    """
 
     def __init__(
         self,

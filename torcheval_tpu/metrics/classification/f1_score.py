@@ -73,7 +73,16 @@ class MulticlassF1Score(Metric[jax.Array]):
 
 
 class BinaryF1Score(MulticlassF1Score):
-    """Binary F1 score with thresholded score inputs."""
+    """Binary F1 score with thresholded score inputs.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import BinaryF1Score
+        >>> metric = BinaryF1Score()
+        >>> metric.update(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     def __init__(self, *, threshold: float = 0.5, device=None) -> None:
         super().__init__(device=device)

@@ -77,7 +77,16 @@ class MulticlassPrecision(Metric[jax.Array]):
 
 
 class BinaryPrecision(MulticlassPrecision):
-    """Binary precision with thresholded score inputs."""
+    """Binary precision with thresholded score inputs.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import BinaryPrecision
+        >>> metric = BinaryPrecision()
+        >>> metric.update(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     def __init__(self, *, threshold: float = 0.5, device=None) -> None:
         super().__init__(device=device)

@@ -55,7 +55,17 @@ class BinaryPrecisionRecallCurve(_BufferedPairMetric):
 
 
 class MulticlassPrecisionRecallCurve(_BufferedPairMetric):
-    """Per-class precision-recall curves for multiclass classification."""
+    """Per-class precision-recall curves for multiclass classification.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import MulticlassPrecisionRecallCurve
+        >>> metric = MulticlassPrecisionRecallCurve(num_classes=3)
+        >>> metric.update(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
+        ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        ([Array([0.25      , 0.33333334, 0.5       , 1.        , 1.        ],      dtype=float32), Array([0.5      , 0.6666667, 1.       , 1.       , 1.       ], dtype=float32), Array([0.25, 0.5 , 1.  , 1.  ], dtype=float32)], [Array([1., 1., 1., 1., 0.], dtype=float32), Array([1. , 1. , 1. , 0.5, 0. ], dtype=float32), Array([1., 1., 1., 0.], dtype=float32)], [Array([0.1, 0.2, 0.3, 0.8], dtype=float32), Array([0.1, 0.2, 0.5, 0.7], dtype=float32), Array([0.1, 0.2, 0.7], dtype=float32)])
+    """
 
     def __init__(self, *, num_classes: Optional[int] = None, device=None) -> None:
         super().__init__(device=device)
@@ -83,7 +93,16 @@ class MulticlassPrecisionRecallCurve(_BufferedPairMetric):
 
 
 class MultilabelPrecisionRecallCurve(_BufferedPairMetric):
-    """Per-label precision-recall curves for multilabel classification."""
+    """Per-label precision-recall curves for multilabel classification.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import MultilabelPrecisionRecallCurve
+        >>> metric = MultilabelPrecisionRecallCurve(num_labels=3)
+        >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))
+        >>> metric.compute()
+        ([Array([0.6666667, 1.       , 1.       , 1.       ], dtype=float32), Array([0.33333334, 0.5       , 1.        , 1.        ], dtype=float32), Array([0.6666667, 1.       , 1.       , 1.       ], dtype=float32)], [Array([1. , 1. , 0.5, 0. ], dtype=float32), Array([1., 1., 1., 0.], dtype=float32), Array([1. , 1. , 0.5, 0. ], dtype=float32)], [Array([0.1, 0.6, 0.9], dtype=float32), Array([0.2, 0.5, 0.7], dtype=float32), Array([0.3, 0.4, 0.8], dtype=float32)])
+    """
 
     def __init__(self, *, num_labels: Optional[int] = None, device=None) -> None:
         super().__init__(device=device)

@@ -59,7 +59,16 @@ class BinaryRecallAtFixedPrecision(_BufferedPairMetric):
 
 class MultilabelRecallAtFixedPrecision(_BufferedPairMetric):
     """Per-label max recall at fixed precision; returns
-    ``(recalls, thresholds)`` lists."""
+    ``(recalls, thresholds)`` lists.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics import MultilabelRecallAtFixedPrecision
+        >>> metric = MultilabelRecallAtFixedPrecision(num_labels=3, min_precision=0.5)
+        >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))
+        >>> metric.compute()
+        ([Array(1., dtype=float32), Array(1., dtype=float32), Array(1., dtype=float32)], [Array(0.6, dtype=float32), Array(0.7, dtype=float32), Array(0.4, dtype=float32)])
+    """
 
     def __init__(
         self, *, num_labels: int, min_precision: float, device=None

@@ -109,7 +109,7 @@ def auc(x, y, reorder: bool = False) -> jax.Array:
 
         >>> from torcheval_tpu.metrics.functional import auc
         >>> auc(jnp.array([0., .1, .5, 1.]), jnp.array([1., 1., .5, 0.]))
-        Array([0.575], dtype=float32)
+        Array([0.525], dtype=float32)
     """
     x, y = to_jax(x), to_jax(y)
     _auc_update_input_check(x, y, n_tasks=1 if x.ndim == 1 else x.shape[0])

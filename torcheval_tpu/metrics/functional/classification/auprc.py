@@ -120,6 +120,13 @@ def multiclass_auprc(
     """Compute one-vs-rest AUPRC for multiclass classification.
 
     Class version: ``torcheval_tpu.metrics.MulticlassAUPRC``.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics.functional import multiclass_auprc
+        >>> multiclass_auprc(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
+        ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]), num_classes=3)
+        Array(1., dtype=float32)
     """
     input, target = to_jax(input), to_jax(target)
     if num_classes is None and input.ndim == 2:
@@ -176,6 +183,12 @@ def multilabel_auprc(
     """Compute per-label AUPRC for multilabel classification.
 
     Class version: ``torcheval_tpu.metrics.MultilabelAUPRC``.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics.functional import multilabel_auprc
+        >>> multilabel_auprc(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), num_labels=3)
+        Array(1., dtype=float32)
     """
     input, target = to_jax(input), to_jax(target)
     if input.ndim != 2:

@@ -149,7 +149,7 @@ def binary_normalized_entropy(
         >>> from torcheval_tpu.metrics.functional import binary_normalized_entropy
         >>> binary_normalized_entropy(
         ...     jnp.array([0.2, 0.3]), jnp.array([1.0, 0.0]))
-        Array(1.046, dtype=float32)
+        Array(1.4182507, dtype=float32)
     """
     input, target = to_jax(input), to_jax(target)
     weight = to_jax(weight) if weight is not None else None

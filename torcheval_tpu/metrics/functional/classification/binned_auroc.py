@@ -94,6 +94,7 @@ def binary_binned_auroc(
         >>> from torcheval_tpu.metrics.functional import binary_binned_auroc
         >>> binary_binned_auroc(jnp.array([0.1, 0.5, 0.7, 0.8]),
         ...                     jnp.array([0, 0, 1, 1]), threshold=5)
+        (Array(0.875, dtype=float32), Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
     """
     input, target = to_jax(input), to_jax(target)
     threshold = create_threshold_tensor(threshold)
@@ -146,6 +147,13 @@ def multiclass_binned_auroc(
     num_classes=3). This implementation computes the intended per-class
     one-vs-rest AUROC; with a dense threshold grid it converges to
     ``multiclass_auroc`` exactly.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics.functional import multiclass_binned_auroc
+        >>> multiclass_binned_auroc(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
+        ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]), num_classes=3, threshold=5)
+        (Array(1., dtype=float32), Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
     """
     input, target = to_jax(input), to_jax(target)
     threshold = create_threshold_tensor(threshold)

@@ -115,6 +115,9 @@ def multiclass_confusion_matrix(
         >>> from torcheval_tpu.metrics.functional import multiclass_confusion_matrix
         >>> multiclass_confusion_matrix(
         ...     jnp.array([0, 2, 1, 1]), jnp.array([0, 1, 2, 1]), num_classes=3)
+        Array([[1, 0, 0],
+               [0, 1, 1],
+               [0, 1, 0]], dtype=int32)
     """
     input, target = to_jax(input), to_jax(target)
     _confusion_matrix_param_check(num_classes, normalize)
@@ -168,6 +171,13 @@ def binary_confusion_matrix(
     """Compute the 2x2 confusion matrix for binary classification.
 
     Class version: ``torcheval_tpu.metrics.BinaryConfusionMatrix``.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics.functional import binary_confusion_matrix
+        >>> binary_confusion_matrix(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))
+        Array([[2, 0],
+               [0, 2]], dtype=int32)
     """
     input, target = to_jax(input), to_jax(target)
     _confusion_matrix_param_check(2, normalize)

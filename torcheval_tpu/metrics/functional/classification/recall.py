@@ -177,6 +177,12 @@ def binary_recall(input, target, *, threshold: float = 0.5) -> jax.Array:
     """Compute recall for binary classification.
 
     Class version: ``torcheval_tpu.metrics.BinaryRecall``.
+    
+    Examples::
+    
+        >>> from torcheval_tpu.metrics.functional import binary_recall
+        >>> binary_recall(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))
+        Array(1., dtype=float32)
     """
     input, target = to_jax(input), to_jax(target)
     num_tp, num_true_labels = _binary_recall_update(input, target, threshold)

@@ -26,7 +26,9 @@ def _reciprocal_rank_jit(
 ) -> jax.Array:
     y_score = jnp.take_along_axis(input, target[:, None], axis=-1)
     rank = jnp.sum(input > y_score, axis=-1)
-    score = 1.0 / (rank + 1.0)
+    # strong-typed f32: python-scalar arithmetic would leak weak_type into
+    # the public return (visible in reprs and dtype promotion downstream)
+    score = jnp.reciprocal((rank + 1).astype(jnp.float32))
     if k is not None:
         score = jnp.where(rank >= k, 0.0, score)
     return score

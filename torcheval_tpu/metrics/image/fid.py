@@ -136,11 +136,16 @@ class FrechetInceptionDistance(Metric[jax.Array]):
     Examples::
 
         >>> from torcheval_tpu.metrics import FrechetInceptionDistance
-        >>> metric = FrechetInceptionDistance(model=my_extractor,
-        ...                                   feature_dim=64)
-        >>> metric.update(real_images, is_real=True)
-        >>> metric.update(generated_images, is_real=False)
+        >>> def extractor(images):  # (N, 3, H, W) -> (N, 4)
+        ...     pooled = images.mean(axis=(2, 3))
+        ...     spread = images.var(axis=(1, 2, 3))[:, None]
+        ...     return jnp.concatenate([pooled, spread], axis=1)
+        >>> metric = FrechetInceptionDistance(model=extractor, feature_dim=4)
+        >>> real = jnp.stack([jnp.full((3, 4, 4), 0.1 * i) for i in range(1, 9)])
+        >>> metric.update(real, is_real=True)
+        >>> metric.update(real * 0.8, is_real=False)
         >>> metric.compute()
+        Array(0.03144199, dtype=float32)
     """
 
     def __init__(

@@ -36,7 +36,7 @@ class WindowedClickThroughRate(
         >>> metric.update(jnp.array([0., 1., 0., 1.]))
         >>> metric.update(jnp.array([0., 0., 0., 1.]))
         >>> metric.compute()
-        (Array([0.5833...], dtype=float32), Array([0.375], dtype=float32))
+        (Array([0.5], dtype=float32), Array([0.375], dtype=float32))
     """
 
     def __init__(
