@@ -17,6 +17,9 @@ from __future__ import annotations
 
 import numpy as np
 import pytest
+
+# slow tier: randomized oracle sweeps
+pytestmark = pytest.mark.slow
 import torch
 import jax.numpy as jnp
 

@@ -58,6 +58,7 @@ def test_resize_matches_reference_interpolate(hw):
     np.testing.assert_allclose(ours, ref, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_transform_input_affine():
     """InceptionV3.transform_input applies torchvision's channelwise remap
     of [0,1] pixels to the ImageNet scale the pretrained weights expect."""

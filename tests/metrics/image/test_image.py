@@ -172,6 +172,7 @@ class TestFrechetInceptionDistance(MetricClassTester):
             metric.update(np.zeros((2, 3, 6, 6), dtype=np.float32), is_real=1)
 
 
+@pytest.mark.slow
 def test_inception_v3_architecture_shapes():
     """The Flax InceptionV3 port produces 2048-d features and its parameter
     tree matches torchvision's layer structure (spot-checked shapes)."""

@@ -23,6 +23,9 @@ import os
 import numpy as np
 import pytest
 
+# slow tier: full InceptionV3 forward in torch and flax (~50 s)
+pytestmark = pytest.mark.slow
+
 import jax.numpy as jnp
 
 from torcheval_tpu.models.inception import (

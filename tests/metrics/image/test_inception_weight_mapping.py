@@ -186,6 +186,7 @@ def test_block_forward_matches_torch():
     )
 
 
+@pytest.mark.slow
 def test_mapping_rejects_bad_state_dicts():
     state = _synth_state_dict()
 

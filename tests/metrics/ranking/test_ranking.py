@@ -176,6 +176,7 @@ class TestRetrievalPrecision(MetricClassTester):
             compute_result=np.asarray(ref.compute()),
         )
 
+    @pytest.mark.slow
     def test_retrieval_precision_multi_query(self):
         inputs = [RNG.uniform(size=(10,)).astype(np.float32) for _ in range(4)]
         targets = [RNG.integers(0, 2, size=(10,)).astype(np.float32) for _ in range(4)]

@@ -57,6 +57,7 @@ def programs_for(fn) -> list[str]:
             if m.startswith("Compiling ")]
 
 
+@pytest.mark.slow
 def test_counter_sees_every_program():
     """Counter self-check: a deliberately unfused 4-op eager chain (abs,
     cumsum, tanh, multiply) must count 4 — guards against a JAX logger

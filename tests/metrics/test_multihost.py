@@ -22,6 +22,11 @@ import os
 import numpy as np
 import pytest
 
+# slow tier: spawned-process sync matrix (~2-5 min); the per-class coverage
+# enforcement in _sync_matrix.build_cases still fires at collection time
+# in the fast tier
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 WORKER = os.path.join(REPO, "tests", "metrics", "_multihost_worker.py")
 

@@ -30,7 +30,10 @@ from torcheval_tpu.metrics.functional.classification.accuracy import (
     _multiclass_accuracy_update,
 )
 from torcheval_tpu.metrics.sharded import sync_states_in_jit
-from torcheval_tpu.utils.hlo import collective_count as _collective_count
+from torcheval_tpu.utils.hlo import (
+    collective_count as _collective_count,
+    compile_fully_optimized as _compile_opt,
+)
 
 
 @pytest.fixture(scope="module")
@@ -82,8 +85,8 @@ def test_metric_sync_adds_no_collectives(mesh):
         synced = sync_states_in_jit(local, "dp")
         return jax.lax.psum(jnp.sum(logits), "dp"), synced
 
-    plain = step_nometric.lower(x, w1, w2).compile()
-    synced = step_with_sync.lower(x, y, w1, w2, state).compile()
+    plain = _compile_opt(step_nometric.lower(x, w1, w2))
+    synced = _compile_opt(step_with_sync.lower(x, y, w1, w2, state))
 
     n_plain = _collective_count(plain)
     n_synced = _collective_count(synced)
@@ -112,7 +115,7 @@ def test_collection_sync_is_one_collective_per_dtype(mesh):
     def sync_many(states):
         return sync_states_in_jit(states, "dp")
 
-    compiled = sync_many.lower(states).compile()
+    compiled = _compile_opt(sync_many.lower(states))
     count = _collective_count(compiled)
     assert count == 1, f"12 same-dtype states should fuse into 1 psum, got {count}"
 

@@ -41,6 +41,7 @@ def _adversarial(rng, shape):
     return flat.reshape(shape)
 
 
+@pytest.mark.slow
 def test_argmax_parity_fuzz():
     rng = np.random.default_rng(0)
     for trial in range(25):
@@ -60,6 +61,7 @@ def test_argmax_all_tied_row():
     np.testing.assert_array_equal(np.asarray(jax.jit(argmax_last)(x)), [0, 0, 0])
 
 
+@pytest.mark.slow
 def test_correct_mask_parity_fuzz():
     rng = np.random.default_rng(1)
     for trial in range(25):

@@ -49,6 +49,7 @@ def _check(x, t, w=None, rtol=1e-5):
         )
 
 
+@pytest.mark.slow
 def test_fuzz_with_ties_and_weights():
     rng = np.random.default_rng(0)
     for trial in range(15):
@@ -89,6 +90,7 @@ def test_task_batch_and_vmap():
     np.testing.assert_allclose(np.asarray(got), np.asarray(exp), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_grad_matches_xla_tangents():
     rng = np.random.default_rng(4)
     x = jnp.asarray(rng.uniform(size=48).astype(np.float32))

@@ -90,6 +90,7 @@ def test_moe_capacity_drops_overflow():
 
 
 @pytest.mark.parametrize("capacity_frac", [1.0, 0.25])
+@pytest.mark.slow
 def test_moe_grads_flow(capacity_frac):
     """capacity_frac=0.25 exercises the backward through the spill-slot
     scatter (all dropped tokens collide at slot C) and the zero-row gather:

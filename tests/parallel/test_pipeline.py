@@ -60,6 +60,7 @@ def test_pipeline_matches_sequential(n_stages, n_micro):
     )
 
 
+@pytest.mark.slow
 def test_pipeline_grads_flow():
     """Differentiable through the schedule (training-step compatibility)."""
     n_stages, n_micro = 4, 6

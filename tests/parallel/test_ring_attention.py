@@ -52,6 +52,7 @@ def test_ring_matches_dense(n_shards, causal):
     )
 
 
+@pytest.mark.slow
 def test_ring_attention_grads_flow():
     """The primitive is differentiable (needed if reused in training evals)."""
     q, k, v = _qkv()

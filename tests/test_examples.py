@@ -16,6 +16,9 @@ import sys
 
 import pytest
 
+# slow tier: full example-script smokes (~15 s each)
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -13,6 +13,9 @@ import sys
 
 import pytest
 
+# slow tier: spawns real worker processes (~40 s)
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "metrics", "_multihost_worker.py")
 

@@ -41,3 +41,16 @@ def collective_count(compiled) -> int:
     """Number of collective ops in a ``jax.stages.Compiled``'s optimized HLO."""
     hlo = compiled.as_text()
     return sum(1 for _ in _INSTR.finditer(hlo))
+
+
+def compile_fully_optimized(lowered):
+    """Compile a ``jax.stages.Lowered`` at full backend optimization
+    regardless of process-wide XLA_FLAGS.
+
+    The structural claims (all-reduce combiner merging the metric psum
+    into the step's reduction) are statements about XLA's OPTIMIZED
+    output; the test conftest lowers the backend optimization level for
+    compile speed, so structure tests must pin the level explicitly."""
+    return lowered.compile(
+        compiler_options={"xla_backend_optimization_level": "3"}
+    )
