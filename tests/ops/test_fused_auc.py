@@ -151,3 +151,33 @@ def test_unbounded_scores_logits():
     np.testing.assert_allclose(
         float(fused_auc(wide, t)), float(fused_auc(s, t)), atol=2e-3
     )
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_empty_input_all_backends(backend):
+    """Regression: n == 0 must yield a zero histogram, not an OOB read of
+    scores[0] (the native kernel's per-task min/max pass segfaulted on
+    empty input before the guard)."""
+    h = np.asarray(
+        fused_auc_histogram(
+            jnp.zeros((1, 0)), jnp.zeros((1, 0)), backend=backend, num_bins=64
+        )
+    )
+    assert h.shape == (1, 2, 64)
+    assert h.sum() == 0.0
+    assert float(fused_auc(jnp.zeros(0), jnp.zeros(0), backend=backend)) == 0.5
+
+
+def test_nan_scores_native_deterministic():
+    """NaN scores land in bin 0 on the native kernel (sanitized before the
+    float->int cast, which is UB on NaN)."""
+    s = jnp.array([float("nan"), 0.5, float("nan"), 0.9])
+    t = jnp.array([1.0, 0.0, 0.0, 1.0])
+    h = np.asarray(
+        fused_auc_histogram(
+            s, t, backend="native", num_bins=8, bounds=(0.0, 1.0)
+        )
+    )
+    # the two NaN samples (one pos, one neg) sit in bin 0
+    assert h[0, 0, 0] == 1.0 and h[0, 1, 0] == 1.0
+    np.testing.assert_allclose(h.sum(), 4.0)

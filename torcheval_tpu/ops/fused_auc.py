@@ -62,17 +62,23 @@ def _auc_from_hist_fused(hist: jax.Array, *, squeeze: bool) -> jax.Array:
 
 
 def _as_2d(
-    input: jax.Array, target: jax.Array, weight: Optional[jax.Array]
-) -> Tuple[jax.Array, jax.Array, jax.Array, bool]:
+    input: jax.Array,
+    target: jax.Array,
+    weight: Optional[jax.Array],
+    materialize_unit_weights: bool = True,
+) -> Tuple[jax.Array, jax.Array, Optional[jax.Array], bool]:
+    """Shape contract for every backend: broadcast labels/weights to the
+    full (tasks, n) — the native C++ kernel indexes [t*n + i] and must
+    never see a smaller buffer. ``materialize_unit_weights=False`` returns
+    ``None`` for an absent weight instead of a dense ones array (the
+    native kernel applies unit weights implicitly)."""
     squeeze = input.ndim == 1
     scores = jnp.atleast_2d(input).astype(jnp.float32)
-    # broadcast labels/weights to the full (tasks, n) shape: the native C++
-    # kernel indexes [t*n + i] and must never see a smaller buffer
     labels = jnp.broadcast_to(
         jnp.atleast_2d(target).astype(jnp.float32), scores.shape
     )
     if weight is None:
-        weights = jnp.ones_like(scores)
+        weights = jnp.ones_like(scores) if materialize_unit_weights else None
     else:
         weights = jnp.broadcast_to(
             jnp.atleast_2d(weight).astype(jnp.float32), scores.shape
@@ -210,16 +216,40 @@ def _histogram_pallas(
 
 def _histogram_native(
     scores: jax.Array,
-    labels: jax.Array,
-    weights: jax.Array,
+    target: jax.Array,
+    weight: Optional[jax.Array],
     num_bins: int,
+    bounds: Optional[Tuple[float, float]],
 ) -> jax.Array:
-    """Caller must have confirmed native.ensure_registered() eagerly."""
+    """Whole-op custom call: normalization (per-task min/max or fixed
+    bounds) and implicit unit weights happen INSIDE the kernel, so no
+    normalized score copy or ones-weights array is materialized — those
+    two prep passes dominate the XLA-side cost at large n.
+
+    Caller must have confirmed native.ensure_registered() eagerly."""
+    scores2, labels2, weights2, _ = _as_2d(
+        scores, target, weight, materialize_unit_weights=False
+    )
+    if weights2 is None:
+        # (T, 1) dummy the kernel never reads (has_weight=0)
+        weights2 = jnp.zeros((scores2.shape[0], 1), jnp.float32)
+        has_weight = 0
+    else:
+        has_weight = 1
+    lo, hi = bounds if bounds is not None else (0.0, 0.0)
     call = jax.ffi.ffi_call(
         "torcheval_fused_auc_histogram",
-        jax.ShapeDtypeStruct((scores.shape[0], 2, num_bins), jnp.float32),
+        jax.ShapeDtypeStruct((scores2.shape[0], 2, num_bins), jnp.float32),
     )
-    return call(scores, labels, weights)
+    return call(
+        scores2,
+        labels2,
+        weights2,
+        has_weight=has_weight,
+        use_bounds=int(bounds is not None),
+        lo=float(lo),
+        hi=float(hi),
+    )
 
 
 # ---------------------------------------------------------------- dispatch
@@ -261,6 +291,15 @@ def _resolve_backend(backend: str, platform: str) -> Tuple[str, bool]:
 def _histogram_impl(scores, labels, weights, num_bins, bounds, backend,
                     interpret):
     """Traceable body shared by the one-shot and accumulate entry points."""
+    if scores.shape[-1] == 0:
+        # zero samples -> zero histograms on every backend (the normalize
+        # min/max has no identity, and the native kernel must not read
+        # scores[0]); downstream AUC of an all-zero histogram is 0.5
+        num_tasks = 1 if scores.ndim == 1 else scores.shape[0]
+        return jnp.zeros((num_tasks, 2, num_bins), jnp.float32)
+    if backend == "native":
+        # the custom call owns prep too (normalize + implicit weights)
+        return _histogram_native(scores, labels, weights, num_bins, bounds)
     scores, labels, weights, _ = _as_2d(scores, labels, weights)
     if bounds is None:
         scores = _normalize_scores(scores)
@@ -271,8 +310,6 @@ def _histogram_impl(scores, labels, weights, num_bins, bounds, backend,
         return _histogram_pallas(
             scores, labels, weights, num_bins, interpret=interpret
         )
-    if backend == "native":
-        return _histogram_native(scores, labels, weights, num_bins)
     return _histogram_xla(scores, labels, weights, num_bins)
 
 

@@ -7,12 +7,24 @@
 // fused CUDA AUC kernel in the reference
 // (torcheval/metrics/functional/classification/auroc.py:161-173).
 //
-// Inputs:  scores (T, N) f32 in [0, 1] (clamped), labels (T, N) f32 {0, 1},
-//          weights (T, N) f32.
+// The WHOLE fused-AUC prep is inside the call — per-task min/max score
+// normalization (use_bounds=0) or fixed-range scaling (use_bounds=1), and
+// implicit unit weights (has_weight=0) — so the XLA side feeds raw scores
+// and never materializes a normalized copy or a ones-weights array (those
+// two prep passes cost more than the binning loop itself at 1M samples).
+//
+// Inputs:  scores (T, N) f32 (any range), labels (T, N) f32 {0, 1},
+//          weights (T, N) f32 — or (T, 1) dummy when has_weight=0.
+// Attrs:   has_weight, use_bounds (int64), lo, hi (double).
 // Outputs: hist (T, 2, B) f32 — per task, row 0 = positive-weight histogram,
 //          row 1 = negative-weight histogram over B equal score bins.
 //
-// Build: g++ -O3 -march=native -shared -fPIC (see native/build.py).
+// NaN scores land in bin 0 deterministically (sanitized BEFORE the
+// float->int cast: converting NaN to int64 is undefined behavior, and the
+// previous kernel relied on it merely "usually" producing a clampable
+// value).
+//
+// Build: g++ -O3 -march=native -shared -fPIC (see native/__init__.py).
 
 #include <algorithm>
 #include <cstdint>
@@ -24,7 +36,9 @@ namespace ffi = xla::ffi;
 static ffi::Error FusedAucHistogramImpl(ffi::Buffer<ffi::F32> scores,
                                         ffi::Buffer<ffi::F32> labels,
                                         ffi::Buffer<ffi::F32> weights,
-                                        ffi::ResultBuffer<ffi::F32> hist) {
+                                        ffi::ResultBuffer<ffi::F32> hist,
+                                        int64_t has_weight, int64_t use_bounds,
+                                        double lo_attr, double hi_attr) {
   const auto dims = scores.dimensions();
   if (dims.size() != 2) {
     return ffi::Error::InvalidArgument("scores must be rank 2 (tasks, n)");
@@ -33,10 +47,15 @@ static ffi::Error FusedAucHistogramImpl(ffi::Buffer<ffi::F32> scores,
   const int64_t n = dims[1];
   const auto ldims = labels.dimensions();
   const auto wdims = weights.dimensions();
-  if (ldims.size() != 2 || ldims[0] != num_tasks || ldims[1] != n ||
-      wdims.size() != 2 || wdims[0] != num_tasks || wdims[1] != n) {
+  if (ldims.size() != 2 || ldims[0] != num_tasks || ldims[1] != n) {
     return ffi::Error::InvalidArgument(
-        "labels/weights must match scores shape (tasks, n)");
+        "labels must match scores shape (tasks, n)");
+  }
+  if (wdims.size() != 2 || wdims[0] != num_tasks ||
+      (has_weight && wdims[1] != n)) {
+    return ffi::Error::InvalidArgument(
+        "weights must be (tasks, n), or a (tasks, 1) dummy when "
+        "has_weight=0");
   }
   const auto hist_dims = hist->dimensions();
   if (hist_dims.size() != 3 || hist_dims[0] != num_tasks ||
@@ -51,16 +70,44 @@ static ffi::Error FusedAucHistogramImpl(ffi::Buffer<ffi::F32> scores,
   float* h = hist->typed_data();
   std::fill(h, h + num_tasks * 2 * bins, 0.0f);
 
+  if (n == 0) {
+    return ffi::Error::Success();  // zero histograms; no score to read
+  }
+  const float fbins = static_cast<float>(bins);
   for (int64_t t = 0; t < num_tasks; ++t) {
     float* pos = h + t * 2 * bins;
     float* neg = pos + bins;
     const int64_t base = t * n;
+
+    float lo, span;
+    if (use_bounds) {
+      lo = static_cast<float>(lo_attr);
+      span = static_cast<float>(hi_attr) - lo;
+    } else {
+      // per-task min/max rescale: AUC is rank-invariant, so this makes
+      // the binning correct for arbitrary score ranges (logits included)
+      float smin = s[base], smax = s[base];
+      for (int64_t i = 1; i < n; ++i) {
+        const float sc = s[base + i];
+        smin = sc < smin ? sc : smin;
+        smax = sc > smax ? sc : smax;
+      }
+      lo = smin;
+      span = smax - smin;
+    }
+    // DIVISION, not multiply-by-reciprocal: the XLA paths normalize with
+    // (s - lo) / span, and the backends-agree-exactly contract needs
+    // bit-identical bin edges. Degenerate span maps every score to 0.5,
+    // matching the XLA normalize; NaN scores fall through the clamps
+    // into bin 0.
     for (int64_t i = 0; i < n; ++i) {
-      float sc = s[base + i];
-      sc = sc < 0.0f ? 0.0f : (sc > 1.0f ? 1.0f : sc);
-      int64_t b = static_cast<int64_t>(sc * static_cast<float>(bins));
-      if (b >= bins) b = bins - 1;
-      const float wi = w[base + i];
+      float x = span > 0.0f ? (s[base + i] - lo) / span : 0.5f;
+      x = x < 0.0f ? 0.0f : (x > 1.0f ? 1.0f : x);
+      x = x == x ? x : 0.0f;  // NaN -> bin 0 BEFORE the cast (fp->int
+                              // conversion of NaN is UB, not just junk)
+      int64_t b = static_cast<int64_t>(x * fbins);
+      b = b >= bins ? bins - 1 : b;
+      const float wi = has_weight ? w[base + i] : 1.0f;
       const float li = l[base + i];
       pos[b] += wi * li;
       neg[b] += wi * (1.0f - li);
@@ -74,4 +121,8 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(FusedAucHistogram, FusedAucHistogramImpl,
                                   .Arg<ffi::Buffer<ffi::F32>>()
                                   .Arg<ffi::Buffer<ffi::F32>>()
                                   .Arg<ffi::Buffer<ffi::F32>>()
-                                  .Ret<ffi::Buffer<ffi::F32>>());
+                                  .Ret<ffi::Buffer<ffi::F32>>()
+                                  .Attr<int64_t>("has_weight")
+                                  .Attr<int64_t>("use_bounds")
+                                  .Attr<double>("lo")
+                                  .Attr<double>("hi"));
