@@ -464,6 +464,15 @@ def run_kernels():
             _perplexity_update_native_jit,
         )
 
+        def ab(native_fn, xla_fn, **extra):
+            """A/B one kernel: median us of the native call and its XLA
+            twin (fewer XLA iterations — it is the slow arm)."""
+            return {
+                **extra,
+                "native_us": _median_us(native_fn, iters=10),
+                "xla_us": _median_us(xla_fn, iters=6, budget_s=6.0),
+            }
+
         cpu0 = jax.devices("cpu")[0]
         ns = 1 << 18
         x = jax.device_put(
@@ -479,31 +488,19 @@ def run_kernels():
             lambda x, t: _binary_auroc_area_xla(x, t, None)
         )
         auprc_xla_j = jax.jit(_binary_auprc_area_xla)
-        nc["sort_desc"] = {
-            "n_samples": ns,
-            "native_us": _median_us(lambda: sort_native_j(x), iters=10),
-            "xla_us": _median_us(
-                lambda: sort_xla_j(x), iters=6, budget_s=6.0
-            ),
-        }
-        nc["auroc_area"] = {
-            "n_samples": ns,
-            "native_us": _median_us(
-                lambda: binary_auroc_area(x, t), iters=10
-            ),
-            "xla_us": _median_us(
-                lambda: auroc_xla_j(x, t), iters=6, budget_s=6.0
-            ),
-        }
-        nc["auprc_area"] = {
-            "n_samples": ns,
-            "native_us": _median_us(
-                lambda: binary_auprc_area(x, t), iters=10
-            ),
-            "xla_us": _median_us(
-                lambda: auprc_xla_j(x, t), iters=6, budget_s=6.0
-            ),
-        }
+        nc["sort_desc"] = ab(
+            lambda: sort_native_j(x), lambda: sort_xla_j(x), n_samples=ns
+        )
+        nc["auroc_area"] = ab(
+            lambda: binary_auroc_area(x, t),
+            lambda: auroc_xla_j(x, t),
+            n_samples=ns,
+        )
+        nc["auprc_area"] = ab(
+            lambda: binary_auprc_area(x, t),
+            lambda: auprc_xla_j(x, t),
+            n_samples=ns,
+        )
         b_, s_, v_ = 8, 128, 8192
         logits = jax.device_put(
             jnp.asarray(rng.normal(size=(b_, s_, v_)).astype(np.float32)),
@@ -513,18 +510,11 @@ def run_kernels():
             jnp.asarray(rng.integers(0, v_, size=(b_, s_)).astype(np.int32)),
             cpu0,
         )
-        nc["cross_entropy"] = {
-            "shape": [b_, s_, v_],
-            "native_us": _median_us(
-                lambda: _perplexity_update_native_jit(logits, targets, None),
-                iters=10,
-            ),
-            "xla_us": _median_us(
-                lambda: _perplexity_update_jit(logits, targets, None),
-                iters=6,
-                budget_s=6.0,
-            ),
-        }
+        nc["cross_entropy"] = ab(
+            lambda: _perplexity_update_native_jit(logits, targets, None),
+            lambda: _perplexity_update_jit(logits, targets, None),
+            shape=[b_, s_, v_],
+        )
     out["native_cpu"] = nc
 
     # ---- north-star bridge: per-step metric work in us on this backend ----

@@ -94,7 +94,7 @@ def render() -> str:
         f" {len(F.__all__)} functions.",
         "",
     ]
-    for _, title in (("core", "Core"),) + CATEGORY_OF_MODULE:
+    for title in ["Core"] + [t for _, t in CATEGORY_OF_MODULE]:
         if sections[title]:
             parts.append(f"## {title}")
             parts.append("")
