@@ -42,31 +42,47 @@ inline uint32_t DescKey(float x) {
   return k;
 }
 
-void RadixArgsortDesc(const float* x, int64_t n, float* sorted_out,
-                      int32_t* order_out, uint32_t* k0, int32_t* i0,
-                      uint32_t* k1, int32_t* i1) {
+// LSD radix argsort, parameterized on the digit plan. All histograms are
+// built in the SAME pass that builds the keys (one read of the data
+// instead of one per radix pass); digits whose histogram is a single
+// bucket skip their scatter entirely (common for real data: the sign /
+// top-exponent digit is near-constant). Stability is the LSD invariant
+// and is digit-width independent.
+template <int kPasses, int kBits>
+void RadixImpl(const float* x, int64_t n, float* sorted_out,
+               int32_t* order_out, uint32_t* k0, int32_t* i0, uint32_t* k1,
+               int32_t* i1) {
+  constexpr int kBuckets = 1 << kBits;
+  constexpr uint32_t kMask = kBuckets - 1;
+  int64_t hist[kPasses][kBuckets] = {};
   for (int64_t i = 0; i < n; ++i) {
-    k0[i] = DescKey(x[i]);
+    const uint32_t k = DescKey(x[i]);
+    k0[i] = k;
     i0[i] = static_cast<int32_t>(i);
+    for (int p = 0; p < kPasses; ++p) {
+      // the final digit has fewer than kBits significant bits; the shift
+      // alone zeroes the excess, so one mask serves every pass
+      ++hist[p][(k >> (p * kBits)) & kMask];
+    }
   }
   uint32_t* ks = k0;
   int32_t* is = i0;
   uint32_t* kd = k1;
   int32_t* id = i1;
-  for (int shift = 0; shift < 32; shift += 8) {
-    int64_t count[256] = {0};
-    for (int64_t i = 0; i < n; ++i) ++count[(ks[i] >> shift) & 0xFFu];
-    if (count[(ks[0] >> shift) & 0xFFu] == n) continue;  // constant byte
-    int64_t pos[256];
+  for (int p = 0; p < kPasses; ++p) {
+    const int shift = p * kBits;
+    const int64_t* h = hist[p];
+    if (h[(ks[0] >> shift) & kMask] == n) continue;  // constant digit
+    int64_t pos[kBuckets];
     int64_t acc = 0;
-    for (int b = 0; b < 256; ++b) {
+    for (int b = 0; b < kBuckets; ++b) {
       pos[b] = acc;
-      acc += count[b];
+      acc += h[b];
     }
     for (int64_t i = 0; i < n; ++i) {
-      const int64_t p = pos[(ks[i] >> shift) & 0xFFu]++;
-      kd[p] = ks[i];
-      id[p] = is[i];
+      const int64_t dest = pos[(ks[i] >> shift) & kMask]++;
+      kd[dest] = ks[i];
+      id[dest] = is[i];
     }
     std::swap(ks, kd);
     std::swap(is, id);
@@ -74,6 +90,25 @@ void RadixArgsortDesc(const float* x, int64_t n, float* sorted_out,
   for (int64_t i = 0; i < n; ++i) {
     order_out[i] = is[i];
     sorted_out[i] = x[is[i]];
+  }
+}
+
+void RadixArgsortDesc(const float* x, int64_t n, float* sorted_out,
+                      int32_t* order_out, uint32_t* k0, int32_t* i0,
+                      uint32_t* k1, int32_t* i1) {
+  if (n == 0) {
+    return;  // ks[0] (a size-0 vector's data()) must never be read; the
+             // Python dispatchers route empty inputs to XLA, this guards
+             // direct FFI callers
+  }
+  if (n >= 4096) {
+    // 11+11+10 bits: three data sweeps instead of four; the 2^11-entry
+    // tables (~64 KiB of stack across hist+pos) stay cache-resident
+    RadixImpl<3, 11>(x, n, sorted_out, order_out, k0, i0, k1, i1);
+  } else {
+    // small rows (vmapped per-class curves): 8-bit tables cost less to
+    // zero and prefix-sum than the row costs to sort
+    RadixImpl<4, 8>(x, n, sorted_out, order_out, k0, i0, k1, i1);
   }
 }
 
