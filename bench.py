@@ -1102,9 +1102,11 @@ def main():
         "continue in the background)",
     )
     ap.add_argument(
-        "--linger-s", type=float, default=420.0,
+        "--linger-s", type=float, default=300.0,
         help="after the cpu pass, keep waiting this long for a late relay "
-        "revival before giving up on re-promoting fallen-back configs",
+        "revival before giving up on re-promoting fallen-back configs "
+        "(the whole config pass already probes in the background, so this "
+        "only covers a revival arriving after the last config finished)",
     )
     ap.add_argument(
         "--probe-timeout-s", type=float, default=75.0,
