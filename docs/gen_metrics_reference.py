@@ -80,6 +80,17 @@ def render() -> str:
         entry = [f"### `{name}{sig}`", "", _render_docstring(doc), ""]
         sections[_category(obj)].append("\n".join(entry))
 
+    sections["Functional"] = []
+    for name in sorted(F.__all__):
+        obj = getattr(F, name)
+        doc = inspect.getdoc(obj) or ""
+        try:
+            sig = str(inspect.signature(obj))
+        except (TypeError, ValueError):
+            sig = "(...)"
+        entry = [f"### `{name}{sig}`", "", _render_docstring(doc), ""]
+        sections["Functional"].append("\n".join(entry))
+
     parts = [
         "# Metrics reference",
         "",
@@ -88,13 +99,12 @@ def render() -> str:
         " guards drift, and every example below is executed by"
         " `tests/test_docstring_examples.py`).",
         "",
-        "Functional (stateless) siblings live in"
-        " `torcheval_tpu.metrics.functional` — same math, eager, one call;"
-        " see [api.md](api.md) for the one-line index of all"
-        f" {len(F.__all__)} functions.",
+        "Classes first (stateful, `update`/`compute`/`merge_state`), then"
+        f" the {len(F.__all__)} stateless functional siblings — same math,"
+        " eager, one call. [api.md](api.md) carries the one-line index.",
         "",
     ]
-    for title in ["Core"] + [t for _, t in CATEGORY_OF_MODULE]:
+    for title in ["Core"] + [t for _, t in CATEGORY_OF_MODULE] + ["Functional"]:
         if sections[title]:
             parts.append(f"## {title}")
             parts.append("")

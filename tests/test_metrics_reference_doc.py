@@ -42,3 +42,12 @@ def test_metrics_md_covers_every_class():
         if name[0].isupper() and f"### `{name}(" not in text
     ]
     assert not missing, f"classes absent from docs/metrics.md: {missing}"
+
+
+def test_metrics_md_covers_every_functional():
+    import torcheval_tpu.metrics.functional as F
+
+    with open(os.path.join(REPO, "docs", "metrics.md")) as f:
+        text = f.read()
+    missing = [name for name in F.__all__ if f"### `{name}(" not in text]
+    assert not missing, f"functions absent from docs/metrics.md: {missing}"
