@@ -70,26 +70,24 @@ def render() -> str:
     sections: dict = {title: [] for _, title in CATEGORY_OF_MODULE}
     sections["Core"] = []
 
+    def entry_for(name, obj, sig_target):
+        doc = inspect.getdoc(obj) or ""
+        try:
+            sig = str(inspect.signature(sig_target)).replace("self, ", "")
+        except (TypeError, ValueError):
+            sig = "(...)"
+        return "\n".join(
+            [f"### `{name}{sig}`", "", _render_docstring(doc), ""]
+        )
+
     for name in sorted(n for n in M.__all__ if n[0].isupper()):
         obj = getattr(M, name)
-        doc = inspect.getdoc(obj) or ""
-        try:
-            sig = str(inspect.signature(obj.__init__)).replace("self, ", "")
-        except (TypeError, ValueError):
-            sig = "(...)"
-        entry = [f"### `{name}{sig}`", "", _render_docstring(doc), ""]
-        sections[_category(obj)].append("\n".join(entry))
+        sections[_category(obj)].append(entry_for(name, obj, obj.__init__))
 
-    sections["Functional"] = []
-    for name in sorted(F.__all__):
-        obj = getattr(F, name)
-        doc = inspect.getdoc(obj) or ""
-        try:
-            sig = str(inspect.signature(obj))
-        except (TypeError, ValueError):
-            sig = "(...)"
-        entry = [f"### `{name}{sig}`", "", _render_docstring(doc), ""]
-        sections["Functional"].append("\n".join(entry))
+    sections["Functional"] = [
+        entry_for(name, getattr(F, name), getattr(F, name))
+        for name in sorted(F.__all__)
+    ]
 
     parts = [
         "# Metrics reference",

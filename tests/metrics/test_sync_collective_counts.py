@@ -167,3 +167,60 @@ def test_multihost_xla_collectives_at_most_three(monkeypatch):
         assert len(counts) <= 3, (n_metrics, len(counts))
         assert len(synced) == jax.process_count()
         assert set(synced[0]) == set(coll)
+
+
+def test_synced_state_dict_collection_two_ranks():
+    """get_synced_state_dict(_collection): rank-consistent checkpoint
+    payloads from the batched sync (reference toolkit.py:110-179). With
+    the fake group's two identical ranks every SUM state doubles."""
+    from torcheval_tpu.metrics.toolkit import (
+        get_synced_state_dict,
+        get_synced_state_dict_collection,
+    )
+
+    coll = _collection(8)  # first 8 includes the SUM-state "sum" metric
+    _feed(coll)
+    local = {name: m.state_dict() for name, m in coll.items()}
+    synced = get_synced_state_dict_collection(
+        {k: copy.deepcopy(m) for k, m in coll.items()}, CountingGroup()
+    )
+    assert synced.keys() == local.keys()
+    np.testing.assert_allclose(
+        np.asarray(synced["sum"]["weighted_sum"]),
+        2.0 * np.asarray(local["sum"]["weighted_sum"]),
+        atol=1e-6,
+    )
+    single = get_synced_state_dict(copy.deepcopy(coll["sum"]), CountingGroup())
+    np.testing.assert_allclose(
+        np.asarray(single["weighted_sum"]),
+        np.asarray(synced["sum"]["weighted_sum"]),
+        atol=1e-6,
+    )
+
+
+def test_synced_state_dict_world_of_one_passthrough():
+    """World size 1: the local state dict comes back unchanged without any
+    collective (reference toolkit.py:337-350 fast path)."""
+    from torcheval_tpu.distributed import SingleProcessGroup
+    from torcheval_tpu.metrics.toolkit import (
+        get_synced_state_dict,
+        get_synced_state_dict_collection,
+    )
+
+    coll = _collection(2)
+    _feed(coll)
+    synced = get_synced_state_dict_collection(coll, SingleProcessGroup())
+    for name, m in coll.items():
+        want = m.state_dict()
+        assert synced[name].keys() == want.keys()
+        for key in want:
+            np.testing.assert_allclose(
+                np.asarray(synced[name][key]),
+                np.asarray(want[key]),
+                err_msg=f"{name}.{key} not passed through unchanged",
+            )
+    single = get_synced_state_dict(coll["acc"], SingleProcessGroup())
+    np.testing.assert_allclose(
+        np.asarray(single["num_total"]),
+        np.asarray(coll["acc"].state_dict()["num_total"]),
+    )

@@ -1,0 +1,94 @@
+"""Random-data generators (reference torcheval/utils/random_data.py:12-161).
+
+These feed examples and user test suites, so their shape/range/dtype
+contract is part of the public surface — pinned here (they had no direct
+tests; everything else exercised them only incidentally).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils import (
+    get_rand_data_binary,
+    get_rand_data_binned_binary,
+    get_rand_data_multiclass,
+    get_rand_data_multilabel,
+)
+
+
+def test_binary_shapes_ranges_and_task_squeeze():
+    x, t = get_rand_data_binary(3, 2, 8)
+    assert x.shape == t.shape == (3, 2, 8)
+    assert float(jnp.min(x)) >= 0.0 and float(jnp.max(x)) < 1.0
+    assert set(np.unique(np.asarray(t))) <= {0, 1}
+    # num_tasks == 1 squeezes the task axis (reference random_data.py:40-42)
+    x1, t1 = get_rand_data_binary(3, 1, 8)
+    assert x1.shape == t1.shape == (3, 8)
+
+
+def test_multiclass_shapes_and_label_range():
+    x, t = get_rand_data_multiclass(4, 5, 6)
+    assert x.shape == (4, 6, 5)
+    assert t.shape == (4, 6)
+    labels = np.unique(np.asarray(t))
+    assert labels.min() >= 0 and labels.max() < 5
+
+
+def test_multilabel_shapes_and_binary_targets():
+    x, t = get_rand_data_multilabel(2, 3, 4)
+    assert x.shape == t.shape == (2, 4, 3)
+    assert set(np.unique(np.asarray(t))) <= {0, 1}
+
+
+def test_binned_binary_returns_sorted_unit_thresholds():
+    x, t, thr = get_rand_data_binned_binary(2, 1, 8, 5)
+    assert x.shape == t.shape == (2, 8)
+    thr = np.asarray(thr)
+    assert thr.ndim == 1
+    assert (np.diff(thr) >= 0).all()
+    assert thr.min() >= 0.0 and thr.max() <= 1.0
+
+
+def test_deterministic_under_explicit_key_and_varied_without():
+    key = jax.random.PRNGKey(7)
+    a = get_rand_data_binary(2, 1, 4, key=key)
+    b = get_rand_data_binary(2, 1, 4, key=key)
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+    c = get_rand_data_binary(2, 1, 4, key=jax.random.PRNGKey(8))
+    assert not np.array_equal(np.asarray(a[0]), np.asarray(c[0]))
+
+
+def test_generated_data_feeds_the_metrics_it_names():
+    """The shapes the generators document must be the shapes the metric
+    families accept — one end-to-end pass per family."""
+    import torcheval_tpu.metrics as M
+
+    xb, tb = get_rand_data_binary(2, 1, 16)
+    auroc = M.BinaryAUROC()
+    for u in range(2):
+        auroc.update(xb[u], tb[u].astype(jnp.float32))
+    assert 0.0 <= float(auroc.compute()) <= 1.0
+
+    xm, tm = get_rand_data_multiclass(2, 4, 16)
+    acc = M.MulticlassAccuracy()
+    for u in range(2):
+        acc.update(xm[u], tm[u])
+    assert 0.0 <= float(acc.compute()) <= 1.0
+
+    xl, tl = get_rand_data_multilabel(2, 3, 16)
+    ml = M.MultilabelAccuracy(criteria="hamming")
+    for u in range(2):
+        ml.update(xl[u], tl[u])
+    assert 0.0 <= float(ml.compute()) <= 1.0
+
+    xbb, tbb, thr = get_rand_data_binned_binary(2, 1, 16, 5)
+    bb = M.BinaryBinnedAUROC(threshold=thr)
+    for u in range(2):
+        bb.update(xbb[u], tbb[u].astype(jnp.float32))
+    value, _ = bb.compute()
+    assert 0.0 <= float(value) <= 1.0
