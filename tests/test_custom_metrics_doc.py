@@ -1,0 +1,41 @@
+"""docs/custom-metrics.md must execute exactly as written.
+
+The guide's promise is that its code blocks run top-to-bottom; this test
+extracts every ```python fence and executes them in one shared namespace,
+so an API change that breaks the guide breaks the suite.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fences():
+    with open(os.path.join(REPO, "docs", "custom-metrics.md")) as f:
+        text = f.read()
+    return re.findall(r"```python\n(.*?)```", text, flags=re.S)
+
+
+def test_guide_code_blocks_execute_in_order():
+    import sys
+    import types
+
+    fences = _fences()
+    assert len(fences) >= 5, "guide lost its code blocks?"
+    # execute inside a registered module so the guide's classes are
+    # picklable (MetricClassTester pickles the metric) — the moral
+    # equivalent of the user defining them at module level
+    mod = types.ModuleType("_custom_metrics_guide")
+    sys.modules["_custom_metrics_guide"] = mod
+    namespace = mod.__dict__
+    for i, block in enumerate(fences):
+        try:
+            exec(compile(block, f"<custom-metrics.md block {i}>", "exec"),
+                 namespace)
+        except Exception as e:  # pragma: no cover - failure reporting
+            raise AssertionError(
+                f"custom-metrics.md block {i} failed: {e}\n---\n{block}"
+            ) from e

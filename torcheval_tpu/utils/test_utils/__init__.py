@@ -3,9 +3,13 @@ from torcheval_tpu.utils.test_utils.dummy_metric import (
     DummySumListStateMetric,
     DummySumMetric,
 )
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+)
 
 __all__ = [
     "DummySumMetric",
     "DummySumListStateMetric",
     "DummySumDictStateMetric",
+    "MetricClassTester",
 ]
