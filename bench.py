@@ -1042,11 +1042,22 @@ class RelayProber:
             if ok:
                 self._ok.set()
             else:
-                self._stop.wait(
-                    self.interval_busy
-                    if self._busy.is_set()
-                    else self.interval
-                )
+                # re-sample the busy flag every second: a long busy-cadence
+                # wait must cut back to the idle cadence the moment the
+                # foreground measurement finishes (otherwise a probe that
+                # failed mid-measurement sleeps 60 s into the linger
+                # window)
+                waited = 0.0
+                while not self._stop.is_set():
+                    limit = (
+                        self.interval_busy
+                        if self._busy.is_set()
+                        else self.interval
+                    )
+                    if waited >= limit:
+                        break
+                    self._stop.wait(1.0)
+                    waited += 1.0
 
 
 def _attach_ref(entry, name, refname, ref_cache):
@@ -1138,7 +1149,15 @@ def main():
 
     if args.child:
         fn = run_probe if args.child == "probe" else CONFIGS[args.child][0]
-        print(json.dumps(fn()))
+        res = fn()
+        if "backend" not in res:
+            # every child reports the backend it ACTUALLY ran on, so the
+            # parent can refuse to publish a silent in-child CPU fallback
+            # as a TPU number
+            import jax
+
+            res["backend"] = jax.default_backend()
+        print(json.dumps(res))
         return
     if args.ref:
         print(json.dumps(REF_FNS[args.ref]()))
@@ -1162,13 +1181,24 @@ def main():
         # room for the TPU child (420 s) plus a cpu fallback re-run
         return time.monotonic() - t0 < args.budget_s - 450
 
+    def run_on(name, p):
+        """One child on one platform; raises if a TPU request silently ran
+        on CPU (JAX initializes the CPU backend and proceeds when the
+        relay drops between probe and child)."""
+        entry = _run_child(name, p, timeout=420)
+        if p == "tpu" and entry.get("backend") in (None, "cpu"):
+            raise RuntimeError(
+                f"tpu child actually ran on {entry.get('backend')!r}"
+            )
+        entry["platform"] = p
+        return entry
+
     def measure(name, plat):
         """Run one config child; returns the entry or None."""
         entry = None
         for p in dict.fromkeys([plat, "cpu"]):  # fall back to cpu once
             try:
-                entry = _run_child(name, p, timeout=420)
-                entry["platform"] = p
+                entry = run_on(name, p)
                 break
             except Exception as e:  # noqa: BLE001
                 print(f"# {name}@{p} failed: {e}", file=sys.stderr)
@@ -1230,8 +1260,7 @@ def main():
         prober.set_busy(True)
         try:
             try:
-                entry = _run_child(name, "tpu", timeout=420)
-                entry["platform"] = "tpu"
+                entry = run_on(name, "tpu")
             except Exception as e:  # noqa: BLE001
                 print(
                     f"# re-promotion {name}@tpu failed: {e}", file=sys.stderr

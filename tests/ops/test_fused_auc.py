@@ -181,3 +181,19 @@ def test_nan_scores_native_deterministic():
     # the two NaN samples (one pos, one neg) sit in bin 0
     assert h[0, 0, 0] == 1.0 and h[0, 1, 0] == 1.0
     np.testing.assert_allclose(h.sum(), 4.0)
+
+
+def test_nan_scores_agree_across_backends_unbounded():
+    """bounds=None + NaN anywhere: every backend degenerates the whole
+    task to 0.5 (jnp.min/max propagate NaN through the normalize; the
+    native kernel's scan must poison the task the same way, regardless of
+    the NaN's position)."""
+    for pos in (0, 1, 3):
+        scores = np.array([0.2, 0.5, 0.9, 0.1], dtype=np.float32)
+        scores[pos] = np.nan
+        t = jnp.array([1.0, 0.0, 1.0, 0.0])
+        vals = {
+            b: float(fused_auc(jnp.asarray(scores), t, backend=b))
+            for b in BACKENDS
+        }
+        assert vals["native"] == vals["xla"] == 0.5, (pos, vals)

@@ -43,6 +43,7 @@ def _install_fakes(monkeypatch, probe_ok):
             "metric": config,
             "value": 100.0 if platform == "tpu" else 10.0,
             "unit": "u",
+            "backend": "axon" if platform == "tpu" else "cpu",
         }
 
     def fake_ref_child(refname, timeout):
@@ -141,6 +142,34 @@ def test_tpu_child_failure_invalidates_and_falls_back(monkeypatch, capsys):
     for name, entry in out["configs"].items():
         assert entry["platform"] == "cpu", name
         assert "error" not in entry
+    assert out["platform"] == "cpu"
+
+
+def test_silent_cpu_fallback_inside_tpu_child_is_not_published(
+    monkeypatch, capsys
+):
+    """A child that was ASKED for TPU but reports backend=cpu (JAX silently
+    initializing the CPU backend when the relay drops between probe and
+    child) must be re-labeled a CPU entry, never published as TPU."""
+
+    def fake_run_child(config, platform, timeout, proc_slot=None):
+        if config == "probe":
+            return {"metric": "probe", "value": 1, "backend": "axon"}
+        return {
+            "metric": config,
+            "value": 10.0,
+            "unit": "u",
+            "backend": "cpu",  # the lie: asked for tpu, ran on cpu
+        }
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(
+        bench, "_run_ref_child", lambda r, timeout: {"value": 5.0}
+    )
+    out = _run_main(monkeypatch, capsys)
+
+    for name, entry in out["configs"].items():
+        assert entry["platform"] == "cpu", name
     assert out["platform"] == "cpu"
 
 

@@ -22,8 +22,6 @@ import re
 import numpy as np
 import pytest
 
-import jax.numpy as jnp
-
 import torcheval_tpu.metrics as M
 import torcheval_tpu.metrics.functional as F
 
@@ -68,9 +66,9 @@ def _collect():
             if key in seen:
                 continue
             seen.add(key)
-            for test in finder.find(
-                obj, name=name, globs={"np": np, "jnp": jnp}
-            ):
+            # EMPTY globs: every example must import what it uses (a
+            # copied example has no ambient jnp)
+            for test in finder.find(obj, name=name, globs={}):
                 if test.examples:
                     tests.append(test)
     return tests

@@ -30,6 +30,7 @@ class AUC(BufferedExamplesMetric):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import AUC
         >>> metric = AUC()
         >>> metric.update(jnp.array([0., .5, 1.]), jnp.array([1., .5, 0.]))

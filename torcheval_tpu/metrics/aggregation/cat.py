@@ -25,6 +25,7 @@ class Cat(BufferedExamplesMetric):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import Cat
         >>> metric = Cat()
         >>> metric.update(jnp.array([1., 2.])).update(jnp.array([3.]))

@@ -26,6 +26,7 @@ class Max(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import Max
         >>> Max().update(jnp.array([1., 5., 2.])).compute()
         Array(5., dtype=float32)

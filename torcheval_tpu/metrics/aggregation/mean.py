@@ -25,6 +25,7 @@ class Mean(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import Mean
         >>> Mean().update(jnp.array([2., 3.])).compute()
         Array(2.5, dtype=float32)

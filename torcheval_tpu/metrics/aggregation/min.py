@@ -26,6 +26,7 @@ class Min(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import Min
         >>> Min().update(jnp.array([1., 5., 2.])).compute()
         Array(1., dtype=float32)

@@ -22,6 +22,7 @@ class Sum(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import Sum
         >>> Sum().update(jnp.array([2., 3.])).compute()
         Array(5., dtype=float32)

@@ -42,6 +42,7 @@ class MulticlassAccuracy(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import MulticlassAccuracy
         >>> metric = MulticlassAccuracy()
         >>> metric.update(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
@@ -97,6 +98,7 @@ class BinaryAccuracy(MulticlassAccuracy):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import BinaryAccuracy
         >>> metric = BinaryAccuracy()
         >>> metric.update(jnp.array([0.9, 0.2, 0.6, 0.1]), jnp.array([1, 0, 0, 1]))
@@ -127,6 +129,7 @@ class MultilabelAccuracy(MulticlassAccuracy):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import MultilabelAccuracy
         >>> metric = MultilabelAccuracy()
         >>> metric.update(jnp.array([[0.1, 0.9], [0.8, 0.9]]),
@@ -165,6 +168,8 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
     """Multilabel accuracy with top-k binarization of scores.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import TopKMultilabelAccuracy
         >>> metric = TopKMultilabelAccuracy(criteria="hamming", k=2)

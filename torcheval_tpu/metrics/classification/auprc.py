@@ -61,6 +61,7 @@ class BinaryAUPRC(_BufferedPairMetric):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import BinaryAUPRC
         >>> metric = BinaryAUPRC()
         >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]),
@@ -97,6 +98,8 @@ class MulticlassAUPRC(_BufferedPairMetric):
     """One-vs-rest AUPRC for multiclass classification.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import MulticlassAUPRC
         >>> metric = MulticlassAUPRC(num_classes=3)
@@ -136,6 +139,8 @@ class MultilabelAUPRC(_BufferedPairMetric):
     """Per-label AUPRC for multilabel classification.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import MultilabelAUPRC
         >>> metric = MultilabelAUPRC(num_labels=3)

@@ -39,6 +39,7 @@ class BinaryAUROC(BufferedExamplesMetric):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import BinaryAUROC
         >>> metric = BinaryAUROC()
         >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]), jnp.array([0, 0, 1, 1]))

@@ -28,6 +28,7 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import BinaryNormalizedEntropy
         >>> metric = BinaryNormalizedEntropy()
         >>> metric.update(jnp.array([0.2, 0.3]), jnp.array([1.0, 0.0]))

@@ -66,6 +66,7 @@ class BinaryBinnedAUPRC(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import BinaryBinnedAUPRC
         >>> metric = BinaryBinnedAUPRC(threshold=5)
         >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]),
@@ -123,6 +124,8 @@ class MulticlassBinnedAUPRC(Metric[jax.Array]):
     """Binned one-vs-rest AUPRC for multiclass classification.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import MulticlassBinnedAUPRC
         >>> metric = MulticlassBinnedAUPRC(num_classes=3, threshold=5)
@@ -184,6 +187,8 @@ class MultilabelBinnedAUPRC(Metric[jax.Array]):
     """Binned per-label AUPRC for multilabel classification.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import MultilabelBinnedAUPRC
         >>> metric = MultilabelBinnedAUPRC(num_labels=3, threshold=5)

@@ -32,6 +32,7 @@ class BinaryBinnedAUROC(_BufferedPairMetric):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import BinaryBinnedAUROC
         >>> metric = BinaryBinnedAUROC(threshold=5)
         >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]),
@@ -78,6 +79,8 @@ class MulticlassBinnedAUROC(_BufferedPairMetric):
     reference's (buggy) class-axis reduction.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import MulticlassBinnedAUROC
         >>> metric = MulticlassBinnedAUROC(num_classes=3, threshold=5)

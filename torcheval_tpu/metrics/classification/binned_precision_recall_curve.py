@@ -39,6 +39,7 @@ class BinaryBinnedPrecisionRecallCurve(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import BinaryBinnedPrecisionRecallCurve
         >>> metric = BinaryBinnedPrecisionRecallCurve(
         ...     threshold=jnp.array([0.0, 0.5, 1.0]))
@@ -89,6 +90,8 @@ class MulticlassBinnedPrecisionRecallCurve(
     classification, with selectable update kernel (``optimization``).
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import MulticlassBinnedPrecisionRecallCurve
         >>> metric = MulticlassBinnedPrecisionRecallCurve(num_classes=3, threshold=3)
@@ -152,6 +155,8 @@ class MultilabelBinnedPrecisionRecallCurve(
     classification.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import MultilabelBinnedPrecisionRecallCurve
         >>> metric = MultilabelBinnedPrecisionRecallCurve(num_labels=3, threshold=3)

@@ -32,6 +32,7 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import MulticlassConfusionMatrix
         >>> metric = MulticlassConfusionMatrix(4)
         >>> metric.update(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
@@ -85,6 +86,8 @@ class BinaryConfusionMatrix(MulticlassConfusionMatrix):
     score inputs.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import BinaryConfusionMatrix
         >>> metric = BinaryConfusionMatrix()

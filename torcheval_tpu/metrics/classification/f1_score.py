@@ -29,6 +29,7 @@ class MulticlassF1Score(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import MulticlassF1Score
         >>> metric = MulticlassF1Score()
         >>> metric.update(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
@@ -76,6 +77,8 @@ class BinaryF1Score(MulticlassF1Score):
     """Binary F1 score with thresholded score inputs.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import BinaryF1Score
         >>> metric = BinaryF1Score()

@@ -29,6 +29,7 @@ class MulticlassPrecision(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import MulticlassPrecision
         >>> metric = MulticlassPrecision()
         >>> metric.update(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
@@ -80,6 +81,8 @@ class BinaryPrecision(MulticlassPrecision):
     """Binary precision with thresholded score inputs.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import BinaryPrecision
         >>> metric = BinaryPrecision()

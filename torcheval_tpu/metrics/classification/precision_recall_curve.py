@@ -30,6 +30,7 @@ class BinaryPrecisionRecallCurve(_BufferedPairMetric):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import BinaryPrecisionRecallCurve
         >>> metric = BinaryPrecisionRecallCurve()
         >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]),
@@ -58,6 +59,8 @@ class MulticlassPrecisionRecallCurve(_BufferedPairMetric):
     """Per-class precision-recall curves for multiclass classification.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import MulticlassPrecisionRecallCurve
         >>> metric = MulticlassPrecisionRecallCurve(num_classes=3)
@@ -96,6 +99,8 @@ class MultilabelPrecisionRecallCurve(_BufferedPairMetric):
     """Per-label precision-recall curves for multilabel classification.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics import MultilabelPrecisionRecallCurve
         >>> metric = MultilabelPrecisionRecallCurve(num_labels=3)

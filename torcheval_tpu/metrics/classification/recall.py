@@ -30,6 +30,7 @@ class MulticlassRecall(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import MulticlassRecall
         >>> metric = MulticlassRecall()
         >>> metric.update(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
@@ -78,6 +79,7 @@ class BinaryRecall(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import BinaryRecall
         >>> metric = BinaryRecall()
         >>> metric.update(jnp.array([0.9, 0.2, 0.6, 0.1]), jnp.array([1, 0, 1, 1]))

@@ -59,6 +59,7 @@ class StreamingBinaryAUROC(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import StreamingBinaryAUROC
         >>> metric = StreamingBinaryAUROC()
         >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]),

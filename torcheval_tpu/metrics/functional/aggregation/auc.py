@@ -107,6 +107,7 @@ def auc(x, y, reorder: bool = False) -> jax.Array:
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import auc
         >>> auc(jnp.array([0., .1, .5, 1.]), jnp.array([1., 1., .5, 0.]))
         Array([0.525], dtype=float32)

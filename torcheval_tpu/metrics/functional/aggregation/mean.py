@@ -39,6 +39,7 @@ def mean(input, weight: Union[float, int, jax.Array] = 1.0) -> jax.Array:
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import mean
         >>> mean(jnp.array([2., 3.]))
         Array(2.5, dtype=float32)

@@ -33,6 +33,7 @@ def sum(input, weight: Union[float, int, jax.Array] = 1.0) -> jax.Array:
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import sum
         >>> sum(jnp.array([2., 3.]))
         Array(5., dtype=float32)

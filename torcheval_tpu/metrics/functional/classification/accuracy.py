@@ -167,6 +167,7 @@ def multiclass_accuracy(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import multiclass_accuracy
         >>> multiclass_accuracy(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
         Array(0.5, dtype=float32)
@@ -211,6 +212,7 @@ def binary_accuracy(input, target, *, threshold: float = 0.5) -> jax.Array:
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import binary_accuracy
         >>> binary_accuracy(jnp.array([0.9, 0.2, 0.6, 0.1]), jnp.array([1, 0, 0, 1]))
         Array(0.5, dtype=float32)
@@ -327,6 +329,7 @@ def multilabel_accuracy(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import multilabel_accuracy
         >>> multilabel_accuracy(
         ...     jnp.array([[0.1, 0.9], [0.8, 0.9]]), jnp.array([[0, 1], [1, 1]]))
@@ -353,6 +356,8 @@ def topk_multilabel_accuracy(
     Class version: ``torcheval_tpu.metrics.TopKMultilabelAccuracy``.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import topk_multilabel_accuracy
         >>> topk_multilabel_accuracy(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), criteria="hamming", k=2)

@@ -59,6 +59,7 @@ def binary_auprc(input, target, *, num_tasks: int = 1) -> jax.Array:
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import binary_auprc
         >>> binary_auprc(jnp.array([0.1, 0.5, 0.7, 0.8]), jnp.array([1, 0, 1, 1]))
         Array(0.9167, dtype=float32)
@@ -123,6 +124,8 @@ def multiclass_auprc(
     
     Examples::
     
+        >>> import jax.numpy as jnp
+    
         >>> from torcheval_tpu.metrics.functional import multiclass_auprc
         >>> multiclass_auprc(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
         ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]), num_classes=3)
@@ -185,6 +188,8 @@ def multilabel_auprc(
     Class version: ``torcheval_tpu.metrics.MultilabelAUPRC``.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import multilabel_auprc
         >>> multilabel_auprc(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), num_labels=3)

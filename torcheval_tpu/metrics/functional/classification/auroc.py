@@ -99,6 +99,7 @@ def binary_auroc(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import binary_auroc
         >>> binary_auroc(jnp.array([0.1, 0.5, 0.7, 0.8]), jnp.array([0, 0, 1, 1]))
         Array(1., dtype=float32)
@@ -181,6 +182,7 @@ def multiclass_auroc(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import multiclass_auroc
         >>> multiclass_auroc(
         ...     jnp.array([[0.1, 0.1], [0.5, 0.5]]), jnp.array([0, 1]),

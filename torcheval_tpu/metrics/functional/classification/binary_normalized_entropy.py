@@ -146,6 +146,7 @@ def binary_normalized_entropy(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import binary_normalized_entropy
         >>> binary_normalized_entropy(
         ...     jnp.array([0.2, 0.3]), jnp.array([1.0, 0.0]))

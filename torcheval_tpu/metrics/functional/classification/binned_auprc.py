@@ -80,6 +80,7 @@ def binary_binned_auprc(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import binary_binned_auprc
         >>> binary_binned_auprc(jnp.array([0.1, 0.5, 0.7, 0.8]),
         ...                     jnp.array([1, 0, 1, 1]), threshold=5)
@@ -119,6 +120,8 @@ def multiclass_binned_auprc(
     Class version: ``torcheval_tpu.metrics.MulticlassBinnedAUPRC``.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import multiclass_binned_auprc
         >>> multiclass_binned_auprc(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
@@ -167,6 +170,8 @@ def multilabel_binned_auprc(
     Class version: ``torcheval_tpu.metrics.MultilabelBinnedAUPRC``.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import multilabel_binned_auprc
         >>> multilabel_binned_auprc(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), num_labels=3, threshold=5)

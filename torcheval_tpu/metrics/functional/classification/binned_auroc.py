@@ -91,6 +91,7 @@ def binary_binned_auroc(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import binary_binned_auroc
         >>> binary_binned_auroc(jnp.array([0.1, 0.5, 0.7, 0.8]),
         ...                     jnp.array([0, 0, 1, 1]), threshold=5)
@@ -149,6 +150,8 @@ def multiclass_binned_auroc(
     ``multiclass_auroc`` exactly.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import multiclass_binned_auroc
         >>> multiclass_binned_auroc(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],

@@ -95,6 +95,7 @@ def binary_binned_precision_recall_curve(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import binary_binned_precision_recall_curve
         >>> p, r, t = binary_binned_precision_recall_curve(
         ...     jnp.array([0.2, 0.8]), jnp.array([0, 1]),
@@ -196,6 +197,8 @@ def multiclass_binned_precision_recall_curve(
     
     Examples::
     
+        >>> import jax.numpy as jnp
+    
         >>> from torcheval_tpu.metrics.functional import multiclass_binned_precision_recall_curve
         >>> multiclass_binned_precision_recall_curve(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
         ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]), num_classes=3, threshold=3)
@@ -280,6 +283,8 @@ def multilabel_binned_precision_recall_curve(
     ``torcheval_tpu.metrics.MultilabelBinnedPrecisionRecallCurve``.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import multilabel_binned_precision_recall_curve
         >>> multilabel_binned_precision_recall_curve(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), num_labels=3, threshold=3)

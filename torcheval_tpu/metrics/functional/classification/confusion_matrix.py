@@ -112,6 +112,7 @@ def multiclass_confusion_matrix(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import multiclass_confusion_matrix
         >>> multiclass_confusion_matrix(
         ...     jnp.array([0, 2, 1, 1]), jnp.array([0, 1, 2, 1]), num_classes=3)
@@ -173,6 +174,8 @@ def binary_confusion_matrix(
     Class version: ``torcheval_tpu.metrics.BinaryConfusionMatrix``.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import binary_confusion_matrix
         >>> binary_confusion_matrix(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))

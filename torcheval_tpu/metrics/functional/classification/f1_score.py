@@ -141,6 +141,7 @@ def multiclass_f1_score(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import multiclass_f1_score
         >>> multiclass_f1_score(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
         Array(0.5, dtype=float32)
@@ -195,6 +196,8 @@ def binary_f1_score(input, target, *, threshold: float = 0.5) -> jax.Array:
     Class version: ``torcheval_tpu.metrics.BinaryF1Score``.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import binary_f1_score
         >>> binary_f1_score(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))

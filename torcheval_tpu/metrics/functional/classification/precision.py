@@ -142,6 +142,7 @@ def multiclass_precision(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import multiclass_precision
         >>> multiclass_precision(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
         Array(0.5, dtype=float32)
@@ -187,6 +188,8 @@ def binary_precision(input, target, *, threshold: float = 0.5) -> jax.Array:
     Class version: ``torcheval_tpu.metrics.BinaryPrecision``.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import binary_precision
         >>> binary_precision(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))

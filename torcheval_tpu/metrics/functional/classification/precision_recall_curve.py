@@ -90,6 +90,7 @@ def binary_precision_recall_curve(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import binary_precision_recall_curve
         >>> p, r, t = binary_precision_recall_curve(
         ...     jnp.array([0.1, 0.5, 0.7, 0.8]), jnp.array([0, 0, 1, 1]))
@@ -147,6 +148,8 @@ def multiclass_precision_recall_curve(
     Returns lists of (precision, recall, thresholds), one entry per class.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import multiclass_precision_recall_curve
         >>> multiclass_precision_recall_curve(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
@@ -219,6 +222,8 @@ def multilabel_precision_recall_curve(
     Class version: ``torcheval_tpu.metrics.MultilabelPrecisionRecallCurve``.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import multilabel_precision_recall_curve
         >>> multilabel_precision_recall_curve(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), num_labels=3)

@@ -136,6 +136,7 @@ def multiclass_recall(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import multiclass_recall
         >>> multiclass_recall(jnp.array([0, 2, 1, 3]), jnp.array([0, 1, 2, 3]))
         Array(0.5, dtype=float32)
@@ -179,6 +180,8 @@ def binary_recall(input, target, *, threshold: float = 0.5) -> jax.Array:
     Class version: ``torcheval_tpu.metrics.BinaryRecall``.
     
     Examples::
+    
+        >>> import jax.numpy as jnp
     
         >>> from torcheval_tpu.metrics.functional import binary_recall
         >>> binary_recall(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))

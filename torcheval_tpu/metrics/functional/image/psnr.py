@@ -100,6 +100,7 @@ def peak_signal_noise_ratio(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import peak_signal_noise_ratio
         >>> input = jnp.array([[0.1, 0.2], [0.3, 0.4]])
         >>> peak_signal_noise_ratio(input, input * 0.9)

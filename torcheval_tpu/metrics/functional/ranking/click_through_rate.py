@@ -123,6 +123,7 @@ def click_through_rate(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import click_through_rate
         >>> click_through_rate(jnp.array([0, 1, 0, 1, 1, 0, 0, 1]))
         Array(0.5, dtype=float32)

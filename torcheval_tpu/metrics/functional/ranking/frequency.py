@@ -26,6 +26,7 @@ def frequency_at_k(input, k: float) -> jax.Array:
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import frequency_at_k
         >>> frequency_at_k(jnp.array([0.3, 0.1, 0.6]), k=0.5)
         Array([1., 1., 0.], dtype=float32)

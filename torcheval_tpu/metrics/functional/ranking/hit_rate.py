@@ -71,6 +71,7 @@ def hit_rate(input, target, *, k: Optional[int] = None) -> jax.Array:
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import hit_rate
         >>> hit_rate(jnp.array([[0.3, 0.1, 0.6], [0.5, 0.2, 0.3]]),
         ...          jnp.array([2, 1]), k=2)

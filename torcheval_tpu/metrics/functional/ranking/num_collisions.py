@@ -33,6 +33,7 @@ def num_collisions(input) -> jax.Array:
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import num_collisions
         >>> num_collisions(jnp.array([3, 4, 2, 3]))
         Array([1, 0, 0, 1], dtype=int32)

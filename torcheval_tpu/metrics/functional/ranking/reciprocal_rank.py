@@ -62,6 +62,7 @@ def reciprocal_rank(input, target, *, k: Optional[int] = None) -> jax.Array:
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import reciprocal_rank
         >>> reciprocal_rank(jnp.array([[0.3, 0.1, 0.6], [0.5, 0.2, 0.3]]),
         ...                 jnp.array([2, 1]))

@@ -114,6 +114,7 @@ def retrieval_precision(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import retrieval_precision
         >>> retrieval_precision(jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2]),
         ...                     jnp.array([0, 0, 1, 1, 1, 0, 1]), k=2)

@@ -81,6 +81,7 @@ def weighted_calibration(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import weighted_calibration
         >>> weighted_calibration(jnp.array([0.8, 0.4, 0.3, 0.8, 0.7, 0.6]),
         ...                      jnp.array([1, 1, 0, 0, 1, 0]))

@@ -118,6 +118,7 @@ def mean_squared_error(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import mean_squared_error
         >>> mean_squared_error(jnp.array([0.9, 0.5, 0.3, 0.5]),
         ...                    jnp.array([0.5, 0.8, 0.2, 0.8]))

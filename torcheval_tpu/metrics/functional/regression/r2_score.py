@@ -134,6 +134,7 @@ def r2_score(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import r2_score
         >>> r2_score(jnp.array([0., 2., 1., 3.]), jnp.array([0., 1., 2., 3.]))
         Array(0.6, dtype=float32)

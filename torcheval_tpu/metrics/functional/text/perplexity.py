@@ -162,6 +162,7 @@ def perplexity(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics.functional import perplexity
         >>> input = jnp.array([[[0.3659, 0.7025, 0.3104],
         ...                     [0.0097, 0.6577, 0.1947]]])

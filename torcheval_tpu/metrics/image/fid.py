@@ -135,6 +135,7 @@ class FrechetInceptionDistance(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import FrechetInceptionDistance
         >>> def extractor(images):  # (N, 3, H, W) -> (N, 4)
         ...     pooled = images.mean(axis=(2, 3))

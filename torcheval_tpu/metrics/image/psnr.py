@@ -48,6 +48,7 @@ class PeakSignalNoiseRatio(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import PeakSignalNoiseRatio
         >>> metric = PeakSignalNoiseRatio()
         >>> input = jnp.array([[0.1, 0.2], [0.3, 0.4]])

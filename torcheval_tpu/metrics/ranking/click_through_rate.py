@@ -27,6 +27,7 @@ class ClickThroughRate(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import ClickThroughRate
         >>> metric = ClickThroughRate()
         >>> metric.update(jnp.array([0, 1, 0, 1, 1, 0, 0, 1]))

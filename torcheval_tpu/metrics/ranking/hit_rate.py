@@ -22,6 +22,7 @@ class HitRate(BufferedExamplesMetric):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import HitRate
         >>> metric = HitRate(k=2)
         >>> metric.update(jnp.array([[0.3, 0.1, 0.6], [0.5, 0.2, 0.3]]),

@@ -22,6 +22,7 @@ class ReciprocalRank(BufferedExamplesMetric):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import ReciprocalRank
         >>> metric = ReciprocalRank()
         >>> metric.update(jnp.array([[0.3, 0.1, 0.6], [0.5, 0.2, 0.3]]),

@@ -43,6 +43,7 @@ class RetrievalPrecision(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import RetrievalPrecision
         >>> metric = RetrievalPrecision(k=2)
         >>> metric.update(jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2]),

@@ -28,6 +28,7 @@ class WeightedCalibration(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import WeightedCalibration
         >>> metric = WeightedCalibration()
         >>> metric.update(jnp.array([0.8, 0.4, 0.3, 0.8, 0.7, 0.6]),

@@ -36,6 +36,7 @@ class MeanSquaredError(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import MeanSquaredError
         >>> metric = MeanSquaredError()
         >>> metric.update(jnp.array([0.9, 0.5, 0.3, 0.5]),

@@ -37,6 +37,7 @@ class R2Score(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import R2Score
         >>> metric = R2Score()
         >>> metric.update(jnp.array([0., 2., 1., 3.]),

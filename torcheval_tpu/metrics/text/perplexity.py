@@ -35,6 +35,7 @@ class Perplexity(Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import Perplexity
         >>> metric = Perplexity()
         >>> input = jnp.array([[[0.3659, 0.7025, 0.3104],

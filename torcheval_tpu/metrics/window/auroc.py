@@ -71,6 +71,7 @@ class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import WindowedBinaryAUROC
         >>> metric = WindowedBinaryAUROC(max_num_samples=4)
         >>> metric.update(jnp.array([0.2, 0.5, 0.1, 0.5, 0.7, 0.8]),

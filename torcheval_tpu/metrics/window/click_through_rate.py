@@ -30,6 +30,7 @@ class WindowedClickThroughRate(
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import WindowedClickThroughRate
         >>> metric = WindowedClickThroughRate(max_num_updates=2)
         >>> metric.update(jnp.array([0., 1., 1., 1.]))

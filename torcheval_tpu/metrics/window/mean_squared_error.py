@@ -32,6 +32,7 @@ class WindowedMeanSquaredError(WindowedTaskCounterMetric):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import WindowedMeanSquaredError
         >>> metric = WindowedMeanSquaredError(max_num_updates=2)
         >>> metric.update(jnp.array([0.9, 0.5]), jnp.array([0.5, 0.8]))

@@ -38,6 +38,7 @@ class WindowedBinaryNormalizedEntropy(WindowedTaskCounterMetric):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import WindowedBinaryNormalizedEntropy
         >>> metric = WindowedBinaryNormalizedEntropy(max_num_updates=2)
         >>> metric.update(jnp.array([0.2, 0.3]), jnp.array([1.0, 0.0]))

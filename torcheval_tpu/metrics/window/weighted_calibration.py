@@ -32,6 +32,7 @@ class WindowedWeightedCalibration(WindowedTaskCounterMetric):
 
     Examples::
 
+        >>> import jax.numpy as jnp
         >>> from torcheval_tpu.metrics import WindowedWeightedCalibration
         >>> metric = WindowedWeightedCalibration(max_num_updates=2,
         ...                                      enable_lifetime=False)

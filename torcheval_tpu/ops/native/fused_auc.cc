@@ -19,10 +19,11 @@
 // Outputs: hist (T, 2, B) f32 — per task, row 0 = positive-weight histogram,
 //          row 1 = negative-weight histogram over B equal score bins.
 //
-// NaN scores land in bin 0 deterministically (sanitized BEFORE the
-// float->int cast: converting NaN to int64 is undefined behavior, and the
-// previous kernel relied on it merely "usually" producing a clampable
-// value).
+// NaN handling matches the XLA twin: with bounds=None a NaN poisons the
+// whole task (every score maps to the 0.5 bin, as jnp.min/max propagate
+// NaN through the normalize); with fixed bounds a NaN score lands in
+// bin 0, sanitized BEFORE the float->int cast (converting NaN to int64
+// is undefined behavior).
 //
 // Build: g++ -O3 -march=native -shared -fPIC (see native/__init__.py).
 
@@ -85,15 +86,20 @@ static ffi::Error FusedAucHistogramImpl(ffi::Buffer<ffi::F32> scores,
       span = static_cast<float>(hi_attr) - lo;
     } else {
       // per-task min/max rescale: AUC is rank-invariant, so this makes
-      // the binning correct for arbitrary score ranges (logits included)
+      // the binning correct for arbitrary score ranges (logits included).
+      // Any NaN poisons the whole task exactly like jnp.min/max propagate
+      // NaN in the XLA normalize (span NaN -> every score maps to 0.5);
+      // a position-dependent skip here would make backends disagree.
       float smin = s[base], smax = s[base];
-      for (int64_t i = 1; i < n; ++i) {
+      bool has_nan = false;
+      for (int64_t i = 0; i < n; ++i) {
         const float sc = s[base + i];
+        has_nan |= sc != sc;
         smin = sc < smin ? sc : smin;
         smax = sc > smax ? sc : smax;
       }
       lo = smin;
-      span = smax - smin;
+      span = has_nan ? -1.0f : smax - smin;
     }
     // DIVISION, not multiply-by-reciprocal: the XLA paths normalize with
     // (s - lo) / span, and the backends-agree-exactly contract needs
