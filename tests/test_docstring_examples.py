@@ -52,6 +52,19 @@ class _Checker(doctest.OutputChecker):
         )
 
 
+def _extra_example_objects():
+    """Example-bearing public callables outside the metrics namespaces."""
+    from torcheval_tpu.metrics import toolkit
+    from torcheval_tpu.ops import fused_auc
+    from torcheval_tpu.tools import count_flops
+
+    return [
+        ("fused_auc", fused_auc),
+        ("update_collection", toolkit.update_collection),
+        ("count_flops", count_flops),
+    ]
+
+
 def _collect():
     finder = doctest.DocTestFinder(recurse=True)
     seen = set()
@@ -71,6 +84,10 @@ def _collect():
             for test in finder.find(obj, name=name, globs={}):
                 if test.examples:
                     tests.append(test)
+    for name, obj in _extra_example_objects():
+        for test in finder.find(obj, name=name, globs={}):
+            if test.examples:
+                tests.append(test)
     return tests
 
 

@@ -262,10 +262,15 @@ def update_collection(
 
     Examples::
 
+        >>> import jax.numpy as jnp
+        >>> from torcheval_tpu.metrics import MulticlassAccuracy, MulticlassF1Score
         >>> from torcheval_tpu.metrics import toolkit
-        >>> metrics = {"acc": MulticlassAccuracy(num_classes=10),
-        ...            "f1": MulticlassF1Score(num_classes=10)}
-        >>> toolkit.update_collection(metrics, logits, labels)  # ONE dispatch
+        >>> metrics = {"acc": MulticlassAccuracy(), "f1": MulticlassF1Score()}
+        >>> logits = jnp.array([[0.9, 0.1], [0.2, 0.8]])
+        >>> labels = jnp.array([0, 1])
+        >>> _ = toolkit.update_collection(metrics, logits, labels)  # ONE dispatch
+        >>> metrics["acc"].compute()
+        Array(1., dtype=float32)
     """
     from torcheval_tpu.metrics._fuse import fused_accumulate_group
     from torcheval_tpu.metrics.metric import UpdatePlan

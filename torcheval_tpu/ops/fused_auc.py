@@ -433,6 +433,8 @@ def fused_auc(
     path (reference auroc.py:161-173): one fused streaming pass, exact up
     to bin resolution. Shape (n,) -> scalar; (num_tasks, n) -> (num_tasks,).
 
+    >>> import jax.numpy as jnp
+    >>> from torcheval_tpu.ops import fused_auc
     >>> fused_auc(jnp.array([0.1, 0.5, 0.7, 0.8]), jnp.array([0, 0, 1, 1]))
     Array(1., dtype=float32)
     """

@@ -38,6 +38,8 @@ def count_flops(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> float:
     Args may be arrays or ``jax.ShapeDtypeStruct`` avals — nothing is
     executed, only lowered and compiled.
 
+    >>> import jax, jax.numpy as jnp
+    >>> from torcheval_tpu.tools import count_flops
     >>> count_flops(lambda a, b: a @ b,
     ...             jax.ShapeDtypeStruct((128, 64), jnp.float32),
     ...             jax.ShapeDtypeStruct((64, 32), jnp.float32))
