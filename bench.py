@@ -1249,9 +1249,10 @@ def main():
         if not candidates:
             break
         if not prober.available():
-            if time.monotonic() >= linger_deadline:
+            remaining = linger_deadline - time.monotonic()
+            if remaining <= 0:
                 break
-            time.sleep(3.0)
+            time.sleep(min(3.0, max(0.1, remaining)))
             continue
         # least-failed first: one config whose TPU child keeps dying for a
         # config-specific reason must not starve the others

@@ -54,15 +54,15 @@ def _install_fakes(monkeypatch, probe_ok):
     return calls
 
 
-def _run_main(monkeypatch, capsys, linger="2"):
+def _run_main(monkeypatch, capsys, linger="1"):
     monkeypatch.setattr(
         sys,
         "argv",
         [
             "bench.py",
-            "--first-wait-s", "2",
+            "--first-wait-s", "1",
             "--linger-s", linger,
-            "--probe-interval-s", "0.1",
+            "--probe-interval-s", "0.05",
         ],
     )
     bench.main()
