@@ -9,7 +9,8 @@ The headline (metric/value/vs_baseline) is BASELINE config 1 — jitted
 MulticlassAccuracy update throughput vs the reference torcheval on torch CPU
 (the only backend the reference can use here); ``vs_baseline`` = ours / ref
 (higher is better). The ``configs`` field carries all five BASELINE.md
-configs, each with its own value/unit/vs_baseline.
+configs plus the per-backend kernel attestation (``kernels``), each with
+its own value/unit/vs_baseline and the backend its child actually ran on.
 
 Robustness contract (VERDICT rounds 1-3): the parent process NEVER imports
 JAX — every measurement runs in a subprocess, so a hung/unclaimable TPU
