@@ -180,3 +180,30 @@ def test_config_registry_shape(name):
     assert refname is None or refname in bench.REF_FNS
     if refname is None:
         assert name in bench._NO_REF_NOTES
+
+
+def test_killable_proc_slot_sticky_kill():
+    """A Popen landing in the slot AFTER kill_all (probe spawn racing
+    stop()) must be killed on arrival, not orphaned."""
+    import subprocess
+
+    slot = bench._KillableProcSlot()
+    before = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    slot.append(before)
+    slot.kill_all()
+    assert before.wait(timeout=10) != 0  # killed, not still sleeping
+
+    late = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    slot.append(late)  # arrives after the kill: must die on arrival
+    assert late.wait(timeout=10) != 0
+
+
+def test_killable_proc_slot_clear_resets_tracking():
+    import subprocess
+
+    slot = bench._KillableProcSlot()
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    slot.append(proc)
+    proc.wait(timeout=10)
+    slot.clear()
+    slot.kill_all()  # nothing tracked; must not raise on the reaped proc
