@@ -38,6 +38,7 @@ def _run_example(name: str, timeout: float = 240.0) -> str:
     "name,marker",
     [
         ("simple_example.py", "epoch 1:"),
+        ("eval_panel_example.py", "eval panel done"),
         ("distributed_example.py", "devices"),
         ("llm_eval_example.py", "perplexity="),
         ("multihost_example.py", "done"),
