@@ -27,6 +27,7 @@ MODULES = [
     ("torcheval_tpu.distributed", "distributed"),
     ("torcheval_tpu.tools", "tools"),
     ("torcheval_tpu.utils", "utils"),
+    ("torcheval_tpu.utils.test_utils", "test_utils"),
     ("torcheval_tpu.parallel", "parallel"),
     ("torcheval_tpu.ops.fused_auc", "ops.fused_auc"),
 ]
