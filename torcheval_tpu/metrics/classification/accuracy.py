@@ -166,11 +166,10 @@ class MultilabelAccuracy(MulticlassAccuracy):
 
 class TopKMultilabelAccuracy(MulticlassAccuracy):
     """Multilabel accuracy with top-k binarization of scores.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import TopKMultilabelAccuracy
         >>> metric = TopKMultilabelAccuracy(criteria="hamming", k=2)
         >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))

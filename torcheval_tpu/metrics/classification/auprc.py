@@ -96,11 +96,10 @@ class BinaryAUPRC(_BufferedPairMetric):
 
 class MulticlassAUPRC(_BufferedPairMetric):
     """One-vs-rest AUPRC for multiclass classification.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import MulticlassAUPRC
         >>> metric = MulticlassAUPRC(num_classes=3)
         >>> metric.update(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
@@ -137,11 +136,10 @@ class MulticlassAUPRC(_BufferedPairMetric):
 
 class MultilabelAUPRC(_BufferedPairMetric):
     """Per-label AUPRC for multilabel classification.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import MultilabelAUPRC
         >>> metric = MultilabelAUPRC(num_labels=3)
         >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))

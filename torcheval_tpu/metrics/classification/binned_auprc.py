@@ -122,11 +122,10 @@ class BinaryBinnedAUPRC(Metric[jax.Array]):
 
 class MulticlassBinnedAUPRC(Metric[jax.Array]):
     """Binned one-vs-rest AUPRC for multiclass classification.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import MulticlassBinnedAUPRC
         >>> metric = MulticlassBinnedAUPRC(num_classes=3, threshold=5)
         >>> metric.update(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
@@ -185,11 +184,10 @@ class MulticlassBinnedAUPRC(Metric[jax.Array]):
 
 class MultilabelBinnedAUPRC(Metric[jax.Array]):
     """Binned per-label AUPRC for multilabel classification.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import MultilabelBinnedAUPRC
         >>> metric = MultilabelBinnedAUPRC(num_labels=3, threshold=5)
         >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))

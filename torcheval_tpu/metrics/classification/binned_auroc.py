@@ -77,11 +77,10 @@ class MulticlassBinnedAUROC(_BufferedPairMetric):
 
     See the functional docstring for the documented divergence from the
     reference's (buggy) class-axis reduction.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import MulticlassBinnedAUROC
         >>> metric = MulticlassBinnedAUROC(num_classes=3, threshold=5)
         >>> metric.update(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],

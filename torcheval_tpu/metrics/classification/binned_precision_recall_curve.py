@@ -88,11 +88,10 @@ class MulticlassBinnedPrecisionRecallCurve(
 ):
     """Binned per-class precision-recall curves for multiclass
     classification, with selectable update kernel (``optimization``).
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import MulticlassBinnedPrecisionRecallCurve
         >>> metric = MulticlassBinnedPrecisionRecallCurve(num_classes=3, threshold=3)
         >>> metric.update(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
@@ -153,11 +152,10 @@ class MultilabelBinnedPrecisionRecallCurve(
 ):
     """Binned per-label precision-recall curves for multilabel
     classification.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import MultilabelBinnedPrecisionRecallCurve
         >>> metric = MultilabelBinnedPrecisionRecallCurve(num_labels=3, threshold=3)
         >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))

@@ -84,11 +84,10 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
 class BinaryConfusionMatrix(MulticlassConfusionMatrix):
     """2x2 confusion matrix for binary classification with thresholded
     score inputs.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import BinaryConfusionMatrix
         >>> metric = BinaryConfusionMatrix()
         >>> metric.update(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))

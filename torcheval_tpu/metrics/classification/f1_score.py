@@ -75,11 +75,10 @@ class MulticlassF1Score(Metric[jax.Array]):
 
 class BinaryF1Score(MulticlassF1Score):
     """Binary F1 score with thresholded score inputs.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import BinaryF1Score
         >>> metric = BinaryF1Score()
         >>> metric.update(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))

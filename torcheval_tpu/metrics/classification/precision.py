@@ -79,11 +79,10 @@ class MulticlassPrecision(Metric[jax.Array]):
 
 class BinaryPrecision(MulticlassPrecision):
     """Binary precision with thresholded score inputs.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import BinaryPrecision
         >>> metric = BinaryPrecision()
         >>> metric.update(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))

@@ -57,11 +57,10 @@ class BinaryPrecisionRecallCurve(_BufferedPairMetric):
 
 class MulticlassPrecisionRecallCurve(_BufferedPairMetric):
     """Per-class precision-recall curves for multiclass classification.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import MulticlassPrecisionRecallCurve
         >>> metric = MulticlassPrecisionRecallCurve(num_classes=3)
         >>> metric.update(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
@@ -97,11 +96,10 @@ class MulticlassPrecisionRecallCurve(_BufferedPairMetric):
 
 class MultilabelPrecisionRecallCurve(_BufferedPairMetric):
     """Per-label precision-recall curves for multilabel classification.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import MultilabelPrecisionRecallCurve
         >>> metric = MultilabelPrecisionRecallCurve(num_labels=3)
         >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))

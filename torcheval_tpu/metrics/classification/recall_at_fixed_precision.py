@@ -60,11 +60,10 @@ class BinaryRecallAtFixedPrecision(_BufferedPairMetric):
 class MultilabelRecallAtFixedPrecision(_BufferedPairMetric):
     """Per-label max recall at fixed precision; returns
     ``(recalls, thresholds)`` lists.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics import MultilabelRecallAtFixedPrecision
         >>> metric = MultilabelRecallAtFixedPrecision(num_labels=3, min_precision=0.5)
         >>> metric.update(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]))

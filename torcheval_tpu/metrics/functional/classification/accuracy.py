@@ -354,11 +354,10 @@ def topk_multilabel_accuracy(
     """Compute multilabel accuracy with top-k score binarization.
 
     Class version: ``torcheval_tpu.metrics.TopKMultilabelAccuracy``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import topk_multilabel_accuracy
         >>> topk_multilabel_accuracy(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), criteria="hamming", k=2)
         Array(0.6666667, dtype=float32)

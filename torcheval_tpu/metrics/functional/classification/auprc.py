@@ -121,11 +121,10 @@ def multiclass_auprc(
     """Compute one-vs-rest AUPRC for multiclass classification.
 
     Class version: ``torcheval_tpu.metrics.MulticlassAUPRC``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import multiclass_auprc
         >>> multiclass_auprc(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
         ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]), num_classes=3)
@@ -186,11 +185,10 @@ def multilabel_auprc(
     """Compute per-label AUPRC for multilabel classification.
 
     Class version: ``torcheval_tpu.metrics.MultilabelAUPRC``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import multilabel_auprc
         >>> multilabel_auprc(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), num_labels=3)
         Array(1., dtype=float32)

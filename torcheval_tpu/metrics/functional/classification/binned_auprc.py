@@ -118,11 +118,10 @@ def multiclass_binned_auprc(
     """Binned one-vs-rest AUPRC for multiclass classification.
 
     Class version: ``torcheval_tpu.metrics.MulticlassBinnedAUPRC``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import multiclass_binned_auprc
         >>> multiclass_binned_auprc(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
         ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]), num_classes=3, threshold=5)
@@ -168,11 +167,10 @@ def multilabel_binned_auprc(
     """Binned per-label AUPRC for multilabel classification.
 
     Class version: ``torcheval_tpu.metrics.MultilabelBinnedAUPRC``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import multilabel_binned_auprc
         >>> multilabel_binned_auprc(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), num_labels=3, threshold=5)
         (Array(0.77777785, dtype=float32), Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))

@@ -148,11 +148,10 @@ def multiclass_binned_auroc(
     num_classes=3). This implementation computes the intended per-class
     one-vs-rest AUROC; with a dense threshold grid it converges to
     ``multiclass_auroc`` exactly.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import multiclass_binned_auroc
         >>> multiclass_binned_auroc(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
         ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]), num_classes=3, threshold=5)

@@ -194,11 +194,10 @@ def multiclass_binned_precision_recall_curve(
 
     Class version:
     ``torcheval_tpu.metrics.MulticlassBinnedPrecisionRecallCurve``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import multiclass_binned_precision_recall_curve
         >>> multiclass_binned_precision_recall_curve(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
         ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]), num_classes=3, threshold=3)
@@ -281,11 +280,10 @@ def multilabel_binned_precision_recall_curve(
 
     Class version:
     ``torcheval_tpu.metrics.MultilabelBinnedPrecisionRecallCurve``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import multilabel_binned_precision_recall_curve
         >>> multilabel_binned_precision_recall_curve(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), num_labels=3, threshold=3)
         ([Array([0.6666667, 1.       , 1.       , 1.       ], dtype=float32), Array([0.33333334, 0.5       , 1.        , 1.        ], dtype=float32), Array([0.6666667, 1.       , 1.       , 1.       ], dtype=float32)], [Array([1., 1., 0., 0.], dtype=float32), Array([1., 1., 0., 0.], dtype=float32), Array([1. , 0.5, 0. , 0. ], dtype=float32)], Array([0. , 0.5, 1. ], dtype=float32))

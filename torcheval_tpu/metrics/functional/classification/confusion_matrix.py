@@ -172,11 +172,10 @@ def binary_confusion_matrix(
     """Compute the 2x2 confusion matrix for binary classification.
 
     Class version: ``torcheval_tpu.metrics.BinaryConfusionMatrix``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import binary_confusion_matrix
         >>> binary_confusion_matrix(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))
         Array([[2, 0],

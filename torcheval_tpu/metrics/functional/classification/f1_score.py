@@ -194,11 +194,10 @@ def binary_f1_score(input, target, *, threshold: float = 0.5) -> jax.Array:
     """Compute binary F1 score (harmonic mean of precision and recall).
 
     Class version: ``torcheval_tpu.metrics.BinaryF1Score``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import binary_f1_score
         >>> binary_f1_score(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))
         Array(1., dtype=float32)

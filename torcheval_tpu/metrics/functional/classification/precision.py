@@ -186,11 +186,10 @@ def binary_precision(input, target, *, threshold: float = 0.5) -> jax.Array:
     """Compute precision for binary classification.
 
     Class version: ``torcheval_tpu.metrics.BinaryPrecision``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import binary_precision
         >>> binary_precision(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))
         Array(1., dtype=float32)

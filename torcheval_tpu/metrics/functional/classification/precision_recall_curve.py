@@ -146,11 +146,10 @@ def multiclass_precision_recall_curve(
 
     Class version: ``torcheval_tpu.metrics.MulticlassPrecisionRecallCurve``.
     Returns lists of (precision, recall, thresholds), one entry per class.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import multiclass_precision_recall_curve
         >>> multiclass_precision_recall_curve(jnp.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
         ...                  [0.1, 0.2, 0.7], [0.3, 0.5, 0.2]]), jnp.array([0, 1, 2, 1]), num_classes=3)
@@ -220,11 +219,10 @@ def multilabel_precision_recall_curve(
     """Per-label precision-recall curves for multilabel classification.
 
     Class version: ``torcheval_tpu.metrics.MultilabelPrecisionRecallCurve``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import multilabel_precision_recall_curve
         >>> multilabel_precision_recall_curve(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), num_labels=3)
         ([Array([0.6666667, 1.       , 1.       , 1.       ], dtype=float32), Array([0.33333334, 0.5       , 1.        , 1.        ], dtype=float32), Array([0.6666667, 1.       , 1.       , 1.       ], dtype=float32)], [Array([1. , 1. , 0.5, 0. ], dtype=float32), Array([1., 1., 1., 0.], dtype=float32), Array([1. , 1. , 0.5, 0. ], dtype=float32)], [Array([0.1, 0.6, 0.9], dtype=float32), Array([0.2, 0.5, 0.7], dtype=float32), Array([0.3, 0.4, 0.8], dtype=float32)])

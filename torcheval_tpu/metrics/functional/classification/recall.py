@@ -178,11 +178,10 @@ def binary_recall(input, target, *, threshold: float = 0.5) -> jax.Array:
     """Compute recall for binary classification.
 
     Class version: ``torcheval_tpu.metrics.BinaryRecall``.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import binary_recall
         >>> binary_recall(jnp.array([0.2, 0.8, 0.6, 0.3]), jnp.array([0, 1, 1, 0]))
         Array(1., dtype=float32)

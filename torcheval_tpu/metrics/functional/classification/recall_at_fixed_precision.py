@@ -87,11 +87,10 @@ def multilabel_recall_at_fixed_precision(
 
     Class version: ``torcheval_tpu.metrics.MultilabelRecallAtFixedPrecision``.
     Returns (recalls, thresholds) as lists with one entry per label.
-    
+
     Examples::
-    
+
         >>> import jax.numpy as jnp
-    
         >>> from torcheval_tpu.metrics.functional import multilabel_recall_at_fixed_precision
         >>> multilabel_recall_at_fixed_precision(jnp.array([[0.9, 0.2, 0.8], [0.1, 0.7, 0.3], [0.6, 0.5, 0.4]]), jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 1]]), num_labels=3, min_precision=0.5)
         ([Array(1., dtype=float32), Array(1., dtype=float32), Array(1., dtype=float32)], [Array(0.6, dtype=float32), Array(0.7, dtype=float32), Array(0.4, dtype=float32)])

@@ -83,7 +83,11 @@ static ffi::Error FusedAucHistogramImpl(ffi::Buffer<ffi::F32> scores,
     float lo, span;
     if (use_bounds) {
       lo = static_cast<float>(lo_attr);
-      span = static_cast<float>(hi_attr) - lo;
+      // Subtract in double BEFORE narrowing: the XLA path bakes in
+      // f32(hi - lo) at trace time, and f32(hi) - f32(lo) can differ
+      // from it by 1 ULP (e.g. bounds (0.1, 0.3)), shifting edge
+      // scores into a neighbouring bin and breaking backend parity.
+      span = static_cast<float>(hi_attr - lo_attr);
     } else {
       // per-task min/max rescale: AUC is rank-invariant, so this makes
       // the binning correct for arbitrary score ranges (logits included).
