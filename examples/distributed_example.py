@@ -8,10 +8,8 @@ ICI; there is no host-side collective at all). The eager ``sync_and_compute``
 path is also shown for per-device replica metrics.
 """
 
-import os, sys
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from examples._backend import ensure_backend
+from _backend import ensure_backend
 
 ensure_backend()  # fall back to CPU if the accelerator relay is unreachable
 

@@ -12,10 +12,9 @@ The pattern most eval loops want (reference examples call each metric's
   an Orbax checkpoint, resuming accumulation exactly where it stopped.
 """
 
-import os, sys
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import os
 
-from examples._backend import ensure_backend
+from _backend import ensure_backend
 
 ensure_backend()  # fall back to CPU if the accelerator relay is unreachable
 

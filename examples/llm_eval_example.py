@@ -13,10 +13,8 @@ text family is designed around:
   zero-copy on TPU-VM hosts.
 """
 
-import os, sys
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from examples._backend import ensure_backend
+from _backend import ensure_backend
 
 ensure_backend()  # fall back to CPU if the accelerator relay is unreachable
 

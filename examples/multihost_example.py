@@ -21,12 +21,9 @@ TPU pod slice that path is faster; this one mirrors the reference's
 process-per-rank topology.
 """
 
-import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from examples._backend import rehearsal_cpu
+from _backend import rehearsal_cpu
 
 # local rehearsals run workers on the CPU platform (N processes cannot
 # share one exclusive-claim chip, and per-rank accelerator probes would

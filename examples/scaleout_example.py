@@ -9,10 +9,8 @@ count: a TPU slice, or the 8-device virtual CPU platform
 (``XLA_FLAGS=--xla_force_host_platform_device_count=8``).
 """
 
-import os, sys
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from examples._backend import ensure_backend
+from _backend import ensure_backend
 
 ensure_backend()
 

@@ -5,10 +5,8 @@ call ``metric.update`` per batch (async, no host sync), ``compute`` per epoch,
 ``reset`` between epochs.
 """
 
-import os, sys
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from examples._backend import ensure_backend
+from _backend import ensure_backend
 
 ensure_backend()  # fall back to CPU if the accelerator relay is unreachable
 

@@ -1,0 +1,89 @@
+"""Packaging contract: the repo must stay pip-installable.
+
+Parity target: the reference ships setup.py/pyproject.toml/LICENSE
+(/root/reference/setup.py:1-80); here the contract is pinned by tests so a
+refactor can't silently orphan the metadata. The actual install is
+exercised by CI (`pip install -e .[dev]`) and the wheel workflow.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+try:
+    import tomllib  # 3.11+
+except ImportError:  # pragma: no cover - 3.10
+    import tomli as tomllib
+
+import torcheval_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pyproject():
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        return tomllib.load(f)
+
+
+def test_version_single_sourced():
+    """pyproject declares version dynamic, sourced from version.py, and the
+    package exposes the same string."""
+    py = _pyproject()
+    assert "version" in py["project"]["dynamic"]
+    assert (
+        py["tool"]["setuptools"]["dynamic"]["version"]["attr"]
+        == "torcheval_tpu.version.__version__"
+    )
+    from torcheval_tpu.version import __version__
+
+    assert torcheval_tpu.__version__ == __version__
+    assert re.fullmatch(r"\d+\.\d+\.\d+", __version__)
+
+
+def test_native_kernel_sources_ship_in_wheel():
+    """The C++ kernels build on first use, so the wheel must carry .cc
+    sources (and must NOT carry a prebuilt .so, which would be stale on any
+    other toolchain)."""
+    py = _pyproject()
+    data = py["tool"]["setuptools"]["package-data"]["torcheval_tpu.ops.native"]
+    assert "*.cc" in data
+    native = os.path.join(REPO, "torcheval_tpu", "ops", "native")
+    cc = [f for f in os.listdir(native) if f.endswith(".cc")]
+    assert len(cc) >= 4, cc
+
+
+def test_license_present():
+    with open(os.path.join(REPO, "LICENSE")) as f:
+        assert "BSD 3-Clause" in f.read()
+    assert _pyproject()["project"]["license"] == {"file": "LICENSE"}
+
+
+def test_core_deps_are_jax_native():
+    """torch must never be a hard dependency — it is the optional front
+    door, not the compute path."""
+    py = _pyproject()
+    deps = " ".join(py["project"]["dependencies"])
+    assert "torch" not in deps
+    for want in ("jax", "flax", "numpy", "orbax-checkpoint"):
+        assert want in deps, want
+    extras = py["project"]["optional-dependencies"]
+    assert any("torch" in d for d in extras["torch"])
+
+
+def test_examples_import_the_installed_package():
+    """No example may re-add the repo root to sys.path — they must work in
+    any cwd against the pip-installed package."""
+    exdir = os.path.join(REPO, "examples")
+    for name in sorted(os.listdir(exdir)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(exdir, name)) as f:
+            src = f.read()
+        assert "sys.path.insert" not in src, name
+
+
+def test_ci_installs_via_pyproject():
+    with open(os.path.join(REPO, ".github", "workflows", "unit_test.yaml")) as f:
+        ci = f.read()
+    assert "pip install -e .[dev]" in ci
