@@ -198,6 +198,33 @@ def test_killable_proc_slot_sticky_kill():
     assert late.wait(timeout=10) != 0
 
 
+def test_killable_proc_slot_pause_kills_stragglers_then_lifts():
+    """set_paused(True) must kill the in-flight probe AND any probe whose
+    Popen lands afterwards (the probe thread can be between its busy
+    check and its spawn when the measurement pass begins); unlike
+    kill_all the pause lifts, so linger-window probes run again."""
+    import subprocess
+
+    slot = bench._KillableProcSlot()
+    inflight = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"]
+    )
+    slot.append(inflight)
+    slot.set_paused(True)
+    assert inflight.wait(timeout=10) != 0  # preempted
+
+    straggler = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"]
+    )
+    slot.append(straggler)  # spawned past the pause: dies on arrival
+    assert straggler.wait(timeout=10) != 0
+
+    slot.set_paused(False)
+    after = subprocess.Popen([sys.executable, "-c", "pass"])
+    slot.append(after)  # pause lifted: runs to completion
+    assert after.wait(timeout=10) == 0
+
+
 def test_killable_proc_slot_clear_resets_tracking():
     import subprocess
 
