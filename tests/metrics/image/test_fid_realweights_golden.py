@@ -1,0 +1,126 @@
+"""FID parity with the PUBLISHED torchvision InceptionV3 checkpoint.
+
+The composite attestation VERDICT r4 asked for: the Flax port + weight
+mapping must reproduce, under the REAL pretrained weights, the pooled
+features and final FID captured from the reference pipeline
+(``scripts/capture_fid_realweights_golden.py``). Both legs need
+torchvision (this image has neither it nor egress), so the module skips
+cleanly here and runs wherever the weights exist — the fid_golden CI
+workflow executes capture + this test on every push.
+
+The in-image mitigations stay in force regardless: wiring parity per
+Mixed block against an independent torch mirror
+(test_inception_golden.py) and value-checked weight placement
+(test_inception_weight_mapping.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+NPZ = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "golden_fid_realweights.npz")
+
+tv = pytest.importorskip(
+    "torchvision",
+    reason="real-weights golden needs torchvision (absent in this image; "
+    "runs in the fid_golden CI workflow)",
+)
+
+
+@pytest.fixture(scope="module")
+def golden():
+    if not os.path.exists(NPZ):
+        pytest.skip(
+            "golden_fid_realweights.npz not captured yet — run "
+            "scripts/capture_fid_realweights_golden.py on a machine with "
+            "torchvision"
+        )
+    with np.load(NPZ) as f:
+        return {k: f[k] for k in f.files}
+
+
+@pytest.fixture(scope="module")
+def variables(golden):
+    """Flax params imported from the same checkpoint the golden used."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from capture_fid_realweights_golden import state_dict_sha256
+    finally:
+        sys.path.pop(0)
+
+    from torchvision import models
+
+    from torcheval_tpu.models.inception import (
+        load_torchvision_inception_params,
+    )
+
+    sd = {
+        k: v.detach().numpy()
+        for k, v in models.inception_v3(weights="DEFAULT").state_dict().items()
+    }
+    sha = state_dict_sha256(sd)
+    want = bytes(golden["weight_sha256"]).decode()
+    assert sha == want, (
+        f"local torchvision checkpoint {sha[:16]}… differs from the "
+        f"captured one {want[:16]}… — re-run the capture script"
+    )
+    return load_torchvision_inception_params(sd)
+
+
+def _features(variables, u8):
+    import jax
+    import jax.numpy as jnp
+
+    from torcheval_tpu.models.inception import InceptionV3
+
+    x = jnp.asarray(u8.astype(np.float32) / 255.0)
+    x = jnp.transpose(x, (0, 2, 3, 1))
+    x = jax.image.resize(
+        x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear",
+        antialias=False,
+    )
+    return np.asarray(InceptionV3().apply(variables, x))
+
+
+def test_pooled_features_match_published_checkpoint(golden, variables):
+    for leg in ("real", "fake"):
+        ours = _features(variables, golden[f"{leg}_images"])
+        ref = golden[f"{leg}_features"]
+        # f32 conv stacks on different backends: compare with a feature-
+        # scale tolerance; any wiring/mapping error moves features by O(1)
+        np.testing.assert_allclose(ours, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_fid_matches_published_checkpoint(golden, variables):
+    from torcheval_tpu.metrics import FrechetInceptionDistance
+    from torcheval_tpu.models.inception import InceptionV3
+
+    import jax
+    import jax.numpy as jnp
+
+    module = InceptionV3()
+
+    def extractor(images):  # (N, 3, H, W) float in [0, 1]
+        x = jnp.transpose(images, (0, 2, 3, 1))
+        x = jax.image.resize(
+            x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear",
+            antialias=False,
+        )
+        return module.apply(variables, x)
+
+    m = FrechetInceptionDistance(model=extractor)
+    m.update(jnp.asarray(golden["real_images"].astype(np.float32) / 255.0),
+             is_real=True)
+    m.update(jnp.asarray(golden["fake_images"].astype(np.float32) / 255.0),
+             is_real=False)
+    got = float(m.compute())
+    want = float(golden["fid"])
+    assert got == pytest.approx(want, rel=0.02), (got, want)
