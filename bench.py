@@ -1432,6 +1432,7 @@ def main():
 
     ref_cache = {}
     configs_out = {}
+    _REF_HISTORY.clear()  # per-run tiebreak history (tests call main() repeatedly)
     # the whole first pass is timing-sensitive (our children AND the torch
     # reference children): pause probing until it completes — see
     # RelayProber.set_busy for why this is a net win for chip coverage
