@@ -1,0 +1,98 @@
+"""Parallelism axes must COMPOSE: ring attention on the sp axis inside a
+data-parallel step, with the metric counter psum'd over both axes in the
+same jitted program — the realistic long-context eval topology (BASELINE
+config 4: sequence-parallel eval with in-jit metrics). The single-axis
+oracles live in test_ring_attention.py; this pins the 2x4 (dp, sp)
+composition against the dense single-device computation.
+"""
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torcheval_tpu.parallel import dense_reference_attention, ring_attention
+
+RNG = np.random.default_rng(23)
+
+B, S, H, D = 4, 32, 4, 8  # global batch 4 -> 2 per dp replica; S/sp = 8
+
+
+def test_ring_attention_composes_with_dp_and_in_jit_metric():
+    devices = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("dp", "sp"))
+
+    q, k, v = (
+        jnp.asarray(RNG.normal(size=(B, S, H, D)), jnp.float32)
+        for _ in range(3)
+    )
+    spec = P("dp", "sp", None, None)
+
+    def step(q, k, v):
+        out = ring_attention(q, k, v, axis_name="sp", causal=True)
+        # an accuracy-style counter over the local block, synced over BOTH
+        # mesh axes inside the same program (zero extra dispatches)
+        local_pos = jnp.sum(out > 0.0).astype(jnp.float32)
+        local_n = jnp.float32(out.size)
+        num_pos = lax.psum(local_pos, ("dp", "sp"))
+        num_total = lax.psum(local_n, ("dp", "sp"))
+        return out, num_pos, num_total
+
+    composed = jax.jit(
+        shard_map(
+            step, mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=(spec, P(), P()),
+        )
+    )
+    out, num_pos, num_total = composed(
+        jax.device_put(q, NamedSharding(mesh, spec)),
+        jax.device_put(k, NamedSharding(mesh, spec)),
+        jax.device_put(v, NamedSharding(mesh, spec)),
+    )
+
+    expected = dense_reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5
+    )
+    assert float(num_total) == B * S * H * D
+    np.testing.assert_allclose(
+        float(num_pos), float(jnp.sum(expected > 0.0)), atol=1.0
+    )
+
+
+def test_composed_step_adds_no_collectives_beyond_ring_and_sync():
+    """The composed program's collective count is the ring's ppermutes plus
+    the two metric psums — data parallelism itself must not introduce any
+    extra collective (the dp axis only shards the batch)."""
+    from torcheval_tpu.utils.hlo import collective_count, compile_fully_optimized
+
+    devices = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("dp", "sp"))
+    spec = P("dp", "sp", None, None)
+
+    def ring_only(q, k, v):
+        return ring_attention(q, k, v, axis_name="sp", causal=True)
+
+    def with_metric(q, k, v):
+        out = ring_only(q, k, v)
+        return out, lax.psum(jnp.sum(out).astype(jnp.float32), ("dp", "sp"))
+
+    q = jnp.zeros((B, S, H, D), jnp.float32)
+    shape_args = (q, q, q)
+
+    def count(fn, out_specs):
+        jitted = jax.jit(
+            shard_map(fn, mesh=mesh, in_specs=(spec,) * 3, out_specs=out_specs)
+        )
+        return collective_count(
+            compile_fully_optimized(jitted.lower(*shape_args))
+        )
+
+    base = count(ring_only, spec)
+    metric = count(with_metric, (spec, P()))
+    assert metric - base <= 1, (base, metric)

@@ -39,6 +39,23 @@ from torcheval_tpu.metrics import synclib
 
 _logger: logging.Logger = logging.getLogger(__name__)
 
+# mirrors the reference toolkit's public surface (reference
+# torcheval/metrics/toolkit.py) plus the beyond-parity update_collection
+__all__ = [
+    "sync_and_compute",
+    "sync_and_compute_collection",
+    "get_synced_metric",
+    "get_synced_metric_collection",
+    "get_synced_state_dict",
+    "get_synced_state_dict_collection",
+    "clone_metric",
+    "clone_metrics",
+    "reset_metrics",
+    "to_device",
+    "update_collection",
+    "classwise_converter",
+]
+
 TMetric = TypeVar("TMetric", bound=Metric)
 # Under MultiHostGroup each process passes its own Metric; under
 # LocalReplicaGroup the controller passes the whole per-replica list.

@@ -80,10 +80,23 @@ def ring_attention(
 
     q_offset = my_index * block
 
-    # running online-softmax state; marked device-varying over the ring axis
-    # so the scan carry type matches the (varying) per-step outputs
+    # running online-softmax state; the scan carry's type must match the
+    # per-step outputs, which vary over EVERY manual axis ANY input varies
+    # over — not just the ring axis. Under a composed mesh (e.g. dp x sp)
+    # the inputs are also dp-varying (and k/v can vary over axes q does
+    # not, e.g. per-replica KV caches), so pcast the fresh zero carries
+    # over the union (pinned by tests/parallel/test_composed_mesh.py).
+    vary_axes = tuple(
+        dict.fromkeys(
+            tuple(jax.typeof(q).vma)
+            + tuple(jax.typeof(k).vma)
+            + tuple(jax.typeof(v).vma)
+            + (axis_name,)
+        )
+    )
+
     def _varying(x):
-        return lax.pcast(x, (axis_name,), to="varying")
+        return lax.pcast(x, vary_axes, to="varying")
 
     acc = _varying(jnp.zeros((batch, heads, nq, dim), jnp.float32))
     denom = _varying(jnp.zeros((batch, heads, nq), jnp.float32))
