@@ -65,10 +65,94 @@ def test_ring_attention_composes_with_dp_and_in_jit_metric():
     )
 
 
+def test_pipeline_composes_with_dp():
+    """GPipe on the pp axis inside a dp-sharded step: each dp replica
+    streams ITS batch shard through the same pipeline stages; outputs
+    must equal the sequential reference per replica."""
+    from torcheval_tpu.parallel import pipeline_apply, pipeline_reference
+
+    devices = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("dp", "pp"))
+
+    dim, micro, mb = 8, 4, 6  # per-replica: (4 microbatches, 3 rows) after dp split
+    stacked = {
+        "w": jnp.asarray(RNG.normal(size=(4, dim, dim)) * 0.5, jnp.float32),
+        "b": jnp.asarray(RNG.normal(size=(4, dim)) * 0.1, jnp.float32),
+    }
+    x = jnp.asarray(RNG.normal(size=(micro, mb, dim)), jnp.float32)
+
+    def stage_fn(params, a):
+        return jnp.tanh(a @ params["w"] + params["b"])
+
+    def step(stacked, x):
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked)
+        return pipeline_apply(stage_fn, local, x, axis_name="pp")
+
+    run = jax.jit(
+        shard_map(
+            step, mesh=mesh,
+            # params sharded over pp, batch rows over dp (x is dp-varying
+            # inside the body -> the composed-carry case the round-5 fix
+            # covers)
+            in_specs=(P("pp"), P(None, "dp")),
+            out_specs=P(None, "dp"),
+        )
+    )
+    out = run(stacked, x)
+    expected = pipeline_reference(stage_fn, stacked, x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_moe_composes_with_dp():
+    """Expert-parallel dispatch on the ep axis inside a dp-sharded step:
+    the all_to_all stays within each dp replica, so each replica's output
+    must equal the routing oracle run on its own token block."""
+    from torcheval_tpu.parallel import moe_apply, moe_reference
+
+    devices = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("dp", "ep"))
+
+    dim, hidden, cap = 8, 16, 16
+    n_experts, per_shard = 4, cap
+    wg = jnp.asarray(RNG.normal(size=(dim, n_experts)) * 0.5, jnp.float32)
+    w1 = jnp.asarray(RNG.normal(size=(n_experts, dim, hidden)) * 0.3, jnp.float32)
+    w2 = jnp.asarray(RNG.normal(size=(n_experts, hidden, dim)) * 0.3, jnp.float32)
+    x = jnp.asarray(
+        RNG.normal(size=(2 * n_experts * per_shard, dim)), jnp.float32
+    )
+
+    run = jax.jit(
+        shard_map(
+            lambda x, wg, w1, w2: moe_apply(
+                x, wg, w1[0], w2[0], axis_name="ep", capacity=cap
+            ),
+            mesh=mesh,
+            # tokens split over (dp, ep); experts over ep, shared by
+            # both dp replicas; gate replicated
+            in_specs=(P(("dp", "ep")), P(), P("ep"), P("ep")),
+            out_specs=P(("dp", "ep")),
+        )
+    )
+    out = np.asarray(run(x, wg, w1, w2))
+
+    half = n_experts * per_shard
+    for r in range(2):
+        expected = moe_reference(
+            x[r * half:(r + 1) * half], wg, w1, w2,
+            num_shards=n_experts, capacity=cap,
+        )
+        np.testing.assert_allclose(
+            out[r * half:(r + 1) * half], np.asarray(expected),
+            atol=1e-5, rtol=1e-5,
+        )
+
+
 def test_composed_step_adds_no_collectives_beyond_ring_and_sync():
     """The composed program's collective count is the ring's ppermutes plus
-    the two metric psums — data parallelism itself must not introduce any
-    extra collective (the dp axis only shards the batch)."""
+    the single metric psum — data parallelism itself must not introduce
+    any extra collective (the dp axis only shards the batch)."""
     from torcheval_tpu.utils.hlo import collective_count, compile_fully_optimized
 
     devices = np.array(jax.devices("cpu")[:8]).reshape(2, 4)

@@ -1,0 +1,34 @@
+"""Varying-manual-axes helpers shared by the scan-carrying primitives.
+
+A ``lax.scan`` carry inside ``shard_map`` must be typed varying over every
+manual axis the step outputs vary over — the union of the inputs' varying
+axes plus the primitive's own collective axis, not just the latter. Under
+a composed mesh (e.g. dp x sp) the inputs are also dp-varying, so a carry
+pcast only over the ring/pipeline axis trips a trace-time carry-type
+mismatch (pinned by tests/parallel/test_composed_mesh.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+from jax import lax
+
+
+def union_vary_axes(*values: Any, axis_name: str) -> Tuple[str, ...]:
+    """The union of every leaf's varying manual axes plus ``axis_name``,
+    in first-seen order."""
+    axes = []
+    for value in values:
+        for leaf in jax.tree_util.tree_leaves(value):
+            axes.extend(jax.typeof(leaf).vma)
+    axes.append(axis_name)
+    return tuple(dict.fromkeys(axes))
+
+
+def pcast_varying(x: jax.Array, vary_axes: Tuple[str, ...]) -> jax.Array:
+    """Mark ``x`` varying over the axes in ``vary_axes`` it does not
+    already vary over (``lax.pcast`` rejects re-marking a varying axis)."""
+    missing = tuple(a for a in vary_axes if a not in jax.typeof(x).vma)
+    return lax.pcast(x, missing, to="varying") if missing else x

@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from torcheval_tpu.parallel._vma import pcast_varying, union_vary_axes
+
 
 def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -59,8 +61,13 @@ def pipeline_apply(
     num_micro = x.shape[0]
     is_last = stage == num_stages - 1
 
+    # the scan carry must be varying over the union of the manual axes of
+    # x and the stage params, not just the pipeline axis — see
+    # parallel/_vma.py
+    vary_axes = union_vary_axes(x, stage_params, axis_name=axis_name)
+
     def _varying(v):
-        return lax.pcast(v, (axis_name,), to="varying")
+        return pcast_varying(v, vary_axes)
 
     # ring neighbours: stage s hands its activation to s+1 (the wrap edge
     # S-1 -> 0 carries retired activations; they are never read)

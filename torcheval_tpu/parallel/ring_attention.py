@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from torcheval_tpu.parallel._vma import pcast_varying, union_vary_axes
+
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/max() NaN-free
 
 
@@ -80,23 +82,13 @@ def ring_attention(
 
     q_offset = my_index * block
 
-    # running online-softmax state; the scan carry's type must match the
-    # per-step outputs, which vary over EVERY manual axis ANY input varies
-    # over — not just the ring axis. Under a composed mesh (e.g. dp x sp)
-    # the inputs are also dp-varying (and k/v can vary over axes q does
-    # not, e.g. per-replica KV caches), so pcast the fresh zero carries
-    # over the union (pinned by tests/parallel/test_composed_mesh.py).
-    vary_axes = tuple(
-        dict.fromkeys(
-            tuple(jax.typeof(q).vma)
-            + tuple(jax.typeof(k).vma)
-            + tuple(jax.typeof(v).vma)
-            + (axis_name,)
-        )
-    )
+    # running online-softmax state; the scan carry must be varying over
+    # the union of the inputs' manual axes (k/v can vary over axes q does
+    # not, e.g. per-replica KV caches) — see parallel/_vma.py
+    vary_axes = union_vary_axes(q, k, v, axis_name=axis_name)
 
     def _varying(x):
-        return lax.pcast(x, vary_axes, to="varying")
+        return pcast_varying(x, vary_axes)
 
     acc = _varying(jnp.zeros((batch, heads, nq, dim), jnp.float32))
     denom = _varying(jnp.zeros((batch, heads, nq), jnp.float32))
