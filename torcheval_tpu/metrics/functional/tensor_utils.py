@@ -32,11 +32,12 @@ def _match_vma(out: jax.Array, ref: jax.Array) -> jax.Array:
     makes ``platform_dependent`` branches disagree ("varying manual axes
     do not match"). No-op outside shard_map.
     """
+    from torcheval_tpu.utils.vma import pcast_varying
+
     try:
-        missing = tuple(sorted(jax.typeof(ref).vma - jax.typeof(out).vma))
+        return pcast_varying(out, tuple(jax.typeof(ref).vma))
     except Exception:
         return out
-    return jax.lax.pcast(out, missing, to="varying") if missing else out
 
 
 def _correct_mask_native(x: jax.Array, target: jax.Array) -> jax.Array:

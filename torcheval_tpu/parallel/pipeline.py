@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from torcheval_tpu.parallel._vma import pcast_varying, union_vary_axes
+from torcheval_tpu.utils.vma import pcast_varying, union_vary_axes
 
 
 def pipeline_apply(
@@ -63,7 +63,7 @@ def pipeline_apply(
 
     # the scan carry must be varying over the union of the manual axes of
     # x and the stage params, not just the pipeline axis — see
-    # parallel/_vma.py
+    # utils/vma.py
     vary_axes = union_vary_axes(x, stage_params, axis_name=axis_name)
 
     def _varying(v):

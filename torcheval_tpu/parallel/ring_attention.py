@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from torcheval_tpu.parallel._vma import pcast_varying, union_vary_axes
+from torcheval_tpu.utils.vma import pcast_varying, union_vary_axes
 
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/max() NaN-free
 
@@ -84,7 +84,7 @@ def ring_attention(
 
     # running online-softmax state; the scan carry must be varying over
     # the union of the inputs' manual axes (k/v can vary over axes q does
-    # not, e.g. per-replica KV caches) — see parallel/_vma.py
+    # not, e.g. per-replica KV caches) — see utils/vma.py
     vary_axes = union_vary_axes(q, k, v, axis_name=axis_name)
 
     def _varying(x):

@@ -1,11 +1,18 @@
-"""Varying-manual-axes helpers shared by the scan-carrying primitives.
+"""Varying-manual-axes helpers (shard_map vma bookkeeping).
 
-A ``lax.scan`` carry inside ``shard_map`` must be typed varying over every
-manual axis the step outputs vary over — the union of the inputs' varying
-axes plus the primitive's own collective axis, not just the latter. Under
-a composed mesh (e.g. dp x sp) the inputs are also dp-varying, so a carry
-pcast only over the ring/pipeline axis trips a trace-time carry-type
-mismatch (pinned by tests/parallel/test_composed_mesh.py).
+Two consumers:
+
+- scan-carrying parallel primitives (ring attention, GPipe): a
+  ``lax.scan`` carry inside ``shard_map`` must be typed varying over every
+  manual axis the step outputs vary over — the union of the inputs'
+  varying axes plus the primitive's own collective axis, not just the
+  latter. Under a composed mesh (e.g. dp x sp) the inputs are also
+  dp-varying, so a carry pcast only over the ring/pipeline axis trips a
+  trace-time carry-type mismatch
+  (pinned by tests/parallel/test_composed_mesh.py);
+- native-kernel outputs (``metrics/functional/tensor_utils._match_vma``):
+  ffi_call results come back unmarked and must re-acquire their
+  reference operand's vma.
 """
 
 from __future__ import annotations
