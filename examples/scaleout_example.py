@@ -139,6 +139,42 @@ def main() -> None:
     print(f"ep MoE reconstruction mse={float(mse.compute()):.3f} "
           f"({n} experts, all_to_all dispatch)")
 
+    # ---- composed dp x sp: ring attention inside a data-parallel step ---
+    # the realistic long-context eval topology: batch over dp, sequence
+    # over sp, metric counters psum'd over BOTH axes in the same program
+    if n >= 4 and n % 2 == 0:
+        dp, sp = 2, n // 2
+        dpsp_mesh = Mesh(devs.reshape(dp, sp), ("dp", "sp"))
+        seq_c = 8 * sp
+        qc, kc, vc = (
+            jnp.asarray(
+                rng.normal(size=(dp * 2, seq_c, heads, dim)),
+                jnp.float32,
+            )
+            for _ in range(3)
+        )
+        spec_c = P("dp", "sp", None, None)
+
+        @jax.jit
+        @partial(
+            shard_map, mesh=dpsp_mesh,
+            in_specs=(spec_c,) * 3, out_specs=(spec_c, P()),
+        )
+        def dpsp_eval(q, k, v):
+            attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+            pos_frac = jax.lax.psum(
+                jnp.sum(attn > 0).astype(jnp.float32), ("dp", "sp")
+            )
+            return attn, pos_frac
+
+        attn_c, pos = dpsp_eval(qc, kc, vc)
+        print(f"dpxsp composed ring attention ok "
+              f"(mesh {dp}x{sp}, seq {seq_c}, pos_frac="
+              f"{float(pos) / attn_c.size:.3f})")
+    else:
+        print(f"dpxsp composed leg skipped (needs an even device count "
+              f">= 4; have {n})")
+
     print("scaleout done")
 
 
