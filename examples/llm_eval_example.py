@@ -95,6 +95,51 @@ def main() -> None:
         f"throughput={float(tput.compute()):.0f} tok/s"
     )
 
+    # ---- long-context variant: the same eval, sequence-sharded ----------
+    # when the context is too long for one chip, the LM forward runs with
+    # ring attention over an sp mesh axis and the perplexity counters are
+    # psum'd inside the same program (models/long_context.py)
+    devices = jax.devices()
+    if len(devices) == 1 and jax.devices("cpu"):
+        devices = jax.devices("cpu")
+    if len(devices) >= 2:
+        from jax import lax, shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from torcheval_tpu.models import (
+            init_long_context_lm,
+            long_context_lm,
+            perplexity_counters,
+        )
+
+        sp = 2
+        long_seq = SEQ * sp
+        lc_params = init_long_context_lm(
+            jax.random.PRNGKey(0), vocab_size=VOCAB, d_model=64, n_heads=4,
+            n_layers=2, d_ff=128, max_len=long_seq,
+        )
+        mesh = Mesh(np.array(devices[:sp]), ("sp",))
+
+        def lc_step(params, tokens, targets):
+            logits = long_context_lm(params, tokens, axis_name="sp")
+            return jax.tree.map(
+                lambda c: lax.psum(c, "sp"), perplexity_counters(logits, targets, ignore_index=PAD)
+            )
+
+        step = jax.jit(
+            shard_map(
+                lc_step, mesh=mesh,
+                in_specs=(P(), P(None, "sp"), P(None, "sp")),
+                out_specs=P(),
+            )
+        )
+        toks = jnp.asarray(rng.integers(1, VOCAB, size=(2, long_seq)))
+        tgts = jnp.asarray(rng.integers(1, VOCAB, size=(2, long_seq)))
+        c = step(lc_params, toks, tgts)
+        lc_ppl = float(jnp.exp(c["sum_log_probs"] / c["num_total"]))
+        print(f"long-context perplexity={lc_ppl:.2f} "
+              f"({long_seq}-token sequences, ring attention x{sp})")
+
 
 if __name__ == "__main__":
     main()

@@ -1,7 +1,19 @@
+from torcheval_tpu.models.long_context import (
+    init_long_context_lm,
+    long_context_lm,
+    perplexity_counters,
+)
 from torcheval_tpu.models.transformer import (
     TransformerLM,
     init_params,
     param_specs,
 )
 
-__all__ = ["TransformerLM", "init_params", "param_specs"]
+__all__ = [
+    "TransformerLM",
+    "init_params",
+    "param_specs",
+    "init_long_context_lm",
+    "long_context_lm",
+    "perplexity_counters",
+]

@@ -1,0 +1,156 @@
+"""Long-context transformer LM: ring attention at the model level.
+
+``models/transformer.py`` is the dp/tp flagship; this is its sequence-
+parallel sibling for contexts too long for one chip's HBM. One functional
+implementation serves both execution modes:
+
+- ``axis_name=None``: dense causal attention over the full sequence — the
+  single-device oracle;
+- ``axis_name="sp"`` inside ``shard_map``: tokens arrive as this device's
+  contiguous sequence block, attention runs as the exact ring
+  (``parallel/ring_attention.py``, P-1 ``ppermute`` hops over ICI), and
+  positional embeddings index by GLOBAL position via ``lax.axis_index``.
+
+Everything else in the block (QKV/out projections, LayerNorm, MLP, head)
+is per-token, so the sharded forward is numerically the dense forward
+restricted to the local block — pinned by
+``tests/parallel/test_long_context.py``. The reference has no model
+runtime at all (it is a metrics library; SURVEY.md section 5.7) — this
+exists so metric evaluation composes with long-context scale the way the
+surrounding TPU stack expects.
+
+Plain-pytree parameters (not Flax): the sharded path runs inside
+``shard_map``, where an explicit dict of arrays keeps the partitioning
+story obvious — params are replicated over sp; only activations shard.
+The head count is carried STRUCTURALLY: ``wqkv`` has shape
+``(d_model, 3, n_heads, head_dim)``, so the forward derives it from a
+static weight shape instead of trusting a caller-supplied integer that
+could silently disagree with init.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from torcheval_tpu.metrics.functional.text.perplexity import (
+    _perplexity_update_jit,
+)
+from torcheval_tpu.parallel.ring_attention import (
+    dense_reference_attention,
+    ring_attention,
+)
+
+Params = Dict[str, Any]
+
+
+def init_long_context_lm(
+    rng: jax.Array,
+    *,
+    vocab_size: int,
+    d_model: int,
+    n_heads: int,
+    n_layers: int,
+    d_ff: int,
+    max_len: int,
+) -> Params:
+    """He/embedding-scaled plain-pytree parameters."""
+    if d_model % n_heads:
+        raise ValueError(f"d_model {d_model} not divisible by n_heads {n_heads}")
+    head_dim = d_model // n_heads
+    # exact key budget: any future consumer added without its key raises
+    # StopIteration instead of silently reusing slack
+    keys = iter(jax.random.split(rng, 3 + 4 * n_layers))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape) * (fan_in ** -0.5)).astype(
+            jnp.float32
+        )
+
+    params: Params = {
+        "tok_embed": dense(next(keys), (vocab_size, d_model), d_model ** 0.5),
+        "pos_embed": dense(next(keys), (max_len, d_model), d_model ** 0.5),
+        "head": dense(next(keys), (d_model, vocab_size), d_model),
+        "final_ln_scale": jnp.ones((d_model,), jnp.float32),
+        "layers": [],
+    }
+    for _ in range(n_layers):
+        params["layers"].append(
+            {
+                "ln1_scale": jnp.ones((d_model,), jnp.float32),
+                "wqkv": dense(
+                    next(keys), (d_model, 3, n_heads, head_dim), d_model
+                ),
+                "wo": dense(next(keys), (d_model, d_model), d_model),
+                "ln2_scale": jnp.ones((d_model,), jnp.float32),
+                "w_up": dense(next(keys), (d_model, d_ff), d_model),
+                "w_down": dense(next(keys), (d_ff, d_model), d_ff),
+            }
+        )
+    return params
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    return x * scale * jax.lax.rsqrt(
+        jnp.mean(jnp.square(x), axis=-1, keepdims=True) + 1e-6
+    )
+
+
+def long_context_lm(
+    params: Params,
+    tokens: jax.Array,
+    *,
+    axis_name: Optional[str] = None,
+) -> jax.Array:
+    """Causal LM forward: ``(B, L) int tokens -> (B, L, V) logits``.
+
+    With ``axis_name`` set (inside ``shard_map``), ``tokens`` is this
+    device's sequence block and attention runs as the exact ring over
+    that mesh axis; with ``axis_name=None`` it is the dense oracle.
+    """
+    _, local_len = tokens.shape
+    d_model = params["tok_embed"].shape[1]
+
+    # global positions: block i on the sp axis covers
+    # [i*local_len, (i+1)*local_len)
+    offset = (
+        lax.axis_index(axis_name) * local_len if axis_name is not None else 0
+    )
+    positions = offset + jnp.arange(local_len)
+    x = params["tok_embed"][tokens] + params["pos_embed"][positions]
+
+    for layer in params["layers"]:
+        h = _rms_norm(x, layer["ln1_scale"])
+        # (B, L, d) @ (d, 3, H, hd) -> (B, L, 3, H, hd); the head count is
+        # the weight's own (static) axis
+        qkv = jnp.einsum("bld,dcnh->blcnh", h, layer["wqkv"])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if axis_name is not None:
+            attn = ring_attention(q, k, v, axis_name=axis_name, causal=True)
+        else:
+            attn = dense_reference_attention(q, k, v, causal=True)
+        x = x + attn.reshape(*h.shape[:2], d_model) @ layer["wo"]
+        h = _rms_norm(x, layer["ln2_scale"])
+        x = x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
+
+    return _rms_norm(x, params["final_ln_scale"]) @ params["head"]
+
+
+def perplexity_counters(
+    logits: jax.Array,
+    targets: jax.Array,
+    *,
+    ignore_index: Optional[int] = None,
+) -> Dict[str, jax.Array]:
+    """Perplexity sufficient statistics for one (local) logits block —
+    SUM-mergeable, so a ``lax.psum`` over the mesh axes yields the global
+    counters in the same program. Delegates to the metric's own update
+    kernel (identical ignore_index and out-of-range-target semantics)."""
+    nll, count = _perplexity_update_jit(logits, targets, ignore_index)
+    return {
+        "sum_log_probs": nll,
+        "num_total": count.astype(jnp.float32),
+    }
