@@ -29,6 +29,7 @@ MODULES = [
     ("torcheval_tpu.utils", "utils"),
     ("torcheval_tpu.utils.test_utils", "test_utils"),
     ("torcheval_tpu.parallel", "parallel"),
+    ("torcheval_tpu.models", "models"),
     ("torcheval_tpu.ops.fused_auc", "ops.fused_auc"),
 ]
 
