@@ -26,7 +26,7 @@ def _load(name):
 
 
 TPU = _load("bench_r3_tpu_20260731.json")
-CPU = _load("bench_r5_cpu_deadrelay_20260731.json")
+CPU = _load("bench_r5_cpu_deadrelay_20260801.json")
 
 
 def _read(path):
