@@ -10,6 +10,8 @@ a value probe running one conv/bn block through real torch
 features, so a silently wrong mapping is a silently wrong metric).
 """
 
+import functools
+
 import flax
 import numpy as np
 import pytest
@@ -24,8 +26,12 @@ from torcheval_tpu.models.inception import (
 RNG = np.random.default_rng(17)
 
 
+@functools.lru_cache(maxsize=1)
 def _synth_state_dict():
     """A torchvision-format inception_v3 state dict with random values.
+
+    Cached: synthesis runs a full InceptionV3 ``init`` (~10 s of tracing),
+    and the four consumers below treat the dict as read-only.
 
     Derived by inverting the documented mapping over the Flax tree (plus
     the fc / AuxLogits / num_batches_tracked entries a real torchvision
