@@ -336,6 +336,10 @@ def build_cases() -> Dict[str, Case]:
             lambda: M.StreamingBinaryAUROC(num_bins=128),
             _bin_pair("StreamingBinaryAUROC"),
         ),
+        "StreamingBinaryAUPRC": (
+            lambda: M.StreamingBinaryAUPRC(num_bins=128),
+            _bin_pair("StreamingBinaryAUPRC"),
+        ),
         # classification: multiclass family
         "MulticlassAccuracy": (
             lambda: M.MulticlassAccuracy(average="macro", num_classes=5),

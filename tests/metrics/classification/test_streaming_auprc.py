@@ -1,0 +1,114 @@
+"""StreamingBinaryAUPRC: mergeable histogram-state average precision.
+
+The AUPRC sibling of StreamingBinaryAUROC — same state and update plan
+(those legs are exercised by its own MetricClassTester harness here),
+with the compute reduction checked against the exact sort-based
+BinaryAUPRC and sklearn, including the tie and degenerate-class edges
+the descending-Riemann formulation must reproduce.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from torcheval_tpu.metrics import BinaryAUPRC, StreamingBinaryAUPRC
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(29)
+N_UP, BATCH = 8, 64
+
+
+class TestStreamingBinaryAUPRC(MetricClassTester):
+    def test_class_harness(self):
+        inputs = [RNG.uniform(size=BATCH).astype(np.float32) for _ in range(N_UP)]
+        targets = [
+            RNG.integers(0, 2, BATCH).astype(np.float32) for _ in range(N_UP)
+        ]
+        exact = BinaryAUPRC()
+        exact.update(
+            jnp.asarray(np.concatenate(inputs)),
+            jnp.asarray(np.concatenate(targets)),
+        )
+        self.run_class_implementation_tests(
+            metric=StreamingBinaryAUPRC(num_bins=4096),
+            state_names={"hist"},
+            update_kwargs={"input": inputs, "target": targets},
+            compute_result=np.float32(float(exact.compute())),
+            atol=1e-3,  # bin-resolution error bound
+            rtol=1e-3,
+        )
+
+    def test_matches_exact_auprc_within_bin_error(self):
+        x = RNG.uniform(size=5000).astype(np.float32)
+        t = (RNG.random(5000) < 0.3).astype(np.float32)
+        exact = BinaryAUPRC()
+        exact.update(jnp.asarray(x), jnp.asarray(t))
+        stream = StreamingBinaryAUPRC(num_bins=8192)
+        stream.update(jnp.asarray(x), jnp.asarray(t))
+        np.testing.assert_allclose(
+            float(stream.compute()), float(exact.compute()), atol=2e-3
+        )
+
+    def test_grid_aligned_scores_are_exact(self):
+        # scores on bin centers -> zero binning error vs the exact kernel
+        x = (RNG.integers(0, 16, size=400).astype(np.float32) + 0.5) / 16.0
+        t = (RNG.random(400) < 0.5).astype(np.float32)
+        stream = StreamingBinaryAUPRC(num_bins=16)
+        stream.update(jnp.asarray(x), jnp.asarray(t))
+        exact = BinaryAUPRC()
+        exact.update(jnp.asarray(x), jnp.asarray(t))
+        np.testing.assert_allclose(
+            float(stream.compute()), float(exact.compute()), rtol=1e-5
+        )
+
+    def test_tie_and_degenerate_edges_match_exact_kernel(self):
+        # one tie group: precision at the group, like the exact compaction
+        m = StreamingBinaryAUPRC(num_bins=8)
+        m.update(jnp.asarray([0.5, 0.5, 0.5, 0.5]),
+                 jnp.asarray([1.0, 0.0, 1.0, 0.0]))
+        assert float(m.compute()) == pytest.approx(0.5)
+        # no positives -> 0; all positives -> 1 (exact-kernel semantics)
+        neg = StreamingBinaryAUPRC()
+        neg.update(jnp.asarray([0.2, 0.7]), jnp.asarray([0.0, 0.0]))
+        assert float(neg.compute()) == 0.0
+        pos = StreamingBinaryAUPRC()
+        pos.update(jnp.asarray([0.2, 0.7]), jnp.asarray([1.0, 1.0]))
+        assert float(pos.compute()) == pytest.approx(1.0)
+
+    def test_weighted_and_multitask(self):
+        x = RNG.uniform(size=(3, 512)).astype(np.float32)
+        t = (RNG.random((3, 512)) < 0.5).astype(np.float32)
+        w = RNG.uniform(0.5, 2.0, size=(3, 512)).astype(np.float32)
+        m = StreamingBinaryAUPRC(num_tasks=3, num_bins=8192)
+        m.update(jnp.asarray(x), jnp.asarray(t), jnp.asarray(w))
+        got = np.asarray(m.compute())
+        assert got.shape == (3,)
+        for i in range(3):
+            np.testing.assert_allclose(
+                got[i],
+                skm.average_precision_score(t[i], x[i], sample_weight=w[i]),
+                atol=3e-3,
+            )
+
+    def test_merge_equals_pooled_and_rejects_mismatched_bounds(self):
+        xs = [RNG.uniform(size=200).astype(np.float32) for _ in range(3)]
+        ts = [(RNG.random(200) < 0.4).astype(np.float32) for _ in range(3)]
+        parts = []
+        for x, t in zip(xs, ts):
+            m = StreamingBinaryAUPRC(num_bins=1024)
+            m.update(jnp.asarray(x), jnp.asarray(t))
+            parts.append(m)
+        parts[0].merge_state(parts[1:])
+        pooled = StreamingBinaryAUPRC(num_bins=1024)
+        pooled.update(
+            jnp.asarray(np.concatenate(xs)), jnp.asarray(np.concatenate(ts))
+        )
+        np.testing.assert_allclose(
+            float(parts[0].compute()), float(pooled.compute()), rtol=1e-6
+        )
+        other = StreamingBinaryAUPRC(num_bins=1024, bounds=(-1.0, 1.0))
+        with pytest.raises(ValueError, match="different.*bounds"):
+            parts[0].merge_state([other])

@@ -101,6 +101,12 @@ UPDATE_BUDGETS = [
         1,
     ),
     (
+        "StreamingBinaryAUPRC",
+        lambda: M.StreamingBinaryAUPRC(num_bins=128),
+        (X1, T1),
+        1,
+    ),
+    (
         "BinaryBinnedPrecisionRecallCurve",
         lambda: M.BinaryBinnedPrecisionRecallCurve(threshold=16),
         (X1, T1),
@@ -184,6 +190,12 @@ COMPUTE_BUDGETS = [
     (
         "StreamingBinaryAUROC",
         lambda: M.StreamingBinaryAUROC(num_bins=128),
+        (X1, T1),
+        1,
+    ),
+    (
+        "StreamingBinaryAUPRC",
+        lambda: M.StreamingBinaryAUPRC(num_bins=128),
         (X1, T1),
         1,
     ),

@@ -201,6 +201,7 @@ def test_aggregation_image_streaming_plans():
             "min": M.Min(),
             "psnr": M.PeakSignalNoiseRatio(),  # auto_range default
             "stream": M.StreamingBinaryAUROC(num_bins=64),
+            "stream_pr": M.StreamingBinaryAUPRC(num_bins=64),
         }
 
     grouped, individual = mk(), mk()
@@ -210,11 +211,13 @@ def test_aggregation_image_streaming_plans():
         # psnr/stream take (input, target); max/min ignore the target via
         # their single-arg plan — group them by signature as a user would
         update_collection({"psnr": grouped["psnr"],
-                           "stream": grouped["stream"]}, x, t)
+                           "stream": grouped["stream"],
+                           "stream_pr": grouped["stream_pr"]}, x, t)
         update_collection({"max": grouped["max"],
                            "min": grouped["min"]}, x)
         individual["psnr"].update(x, t)
         individual["stream"].update(x, t)
+        individual["stream_pr"].update(x, t)
         individual["max"].update(x)
         individual["min"].update(x)
     for name in grouped:

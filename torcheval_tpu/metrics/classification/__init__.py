@@ -53,6 +53,7 @@ from torcheval_tpu.metrics.classification.recall_at_fixed_precision import (
     MultilabelRecallAtFixedPrecision,
 )
 from torcheval_tpu.metrics.classification.streaming_auroc import (
+    StreamingBinaryAUPRC,
     StreamingBinaryAUROC,
 )
 
@@ -87,6 +88,7 @@ __all__ = [
     "MultilabelBinnedPrecisionRecallCurve",
     "MultilabelPrecisionRecallCurve",
     "MultilabelRecallAtFixedPrecision",
+    "StreamingBinaryAUPRC",
     "StreamingBinaryAUROC",
     "TopKMultilabelAccuracy",
 ]

@@ -32,6 +32,7 @@ from torcheval_tpu.metrics.metric import MergeKind, Metric
 from torcheval_tpu.ops.fused_auc import (
     DEFAULT_NUM_BINS,
     _auc_from_hist_fused,
+    _auprc_from_hist_fused,
     _platform_of,
     _resolve_backend,
     histogram_delta_kernel,
@@ -111,7 +112,7 @@ class StreamingBinaryAUROC(Metric[jax.Array]):
         for other in metrics:
             if getattr(other, "bounds", None) != self.bounds:
                 raise ValueError(
-                    "cannot merge StreamingBinaryAUROC with different "
+                    f"cannot merge {type(self).__name__} with different "
                     f"bounds: {self.bounds} vs {getattr(other, 'bounds', None)}"
                 )
         return super().merge_state(metrics)
@@ -152,3 +153,31 @@ class StreamingBinaryAUROC(Metric[jax.Array]):
     def compute(self) -> jax.Array:
         """AUROC from the histogram; scalar for ``num_tasks == 1``."""
         return _auc_from_hist_fused(self.hist, squeeze=self.num_tasks == 1)
+
+
+class StreamingBinaryAUPRC(StreamingBinaryAUROC):
+    """Approximate binary AUPRC with O(num_bins) mergeable state.
+
+    The AUPRC sibling of ``StreamingBinaryAUROC``: identical histogram
+    state (same fused per-platform update, same ONE-``psum`` sync, joins
+    ``toolkit.update_collection``'s single dispatch), different area
+    reduction — average precision by descending-threshold Riemann sum,
+    each bin one tie group. Error is O(1/num_bins); use instead of
+    ``BinaryAUPRC`` when streams are long or the metric must sync often.
+
+    Args: see ``StreamingBinaryAUROC``.
+
+    Examples::
+
+        >>> import jax.numpy as jnp
+        >>> from torcheval_tpu.metrics import StreamingBinaryAUPRC
+        >>> metric = StreamingBinaryAUPRC()
+        >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]),
+        ...               jnp.array([0, 0, 1, 1]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
+    def compute(self) -> jax.Array:
+        """AUPRC from the histogram; scalar for ``num_tasks == 1``."""
+        return _auprc_from_hist_fused(self.hist, squeeze=self.num_tasks == 1)

@@ -61,6 +61,33 @@ def _auc_from_hist_fused(hist: jax.Array, *, squeeze: bool) -> jax.Array:
     return auc[0] if squeeze else auc
 
 
+def _auprc_from_hist(hist: jax.Array) -> jax.Array:
+    """(T, 2, B) weight histograms -> (T,) AUPRC (average precision).
+
+    Riemann sum in descending-score order with each bin as one tie group:
+    precision measured AFTER absorbing the whole group, times the group's
+    recall increment — the same tie semantics the exact kernel's
+    reverse-cummin compaction produces, so this converges to
+    ``binary_auprc`` as bins grow. Degenerate edges match the exact
+    kernel: no positives -> 0, all positives -> 1.
+    """
+    wpos = hist[:, 0, ::-1]  # descending score order
+    wneg = hist[:, 1, ::-1]
+    tp = jnp.cumsum(wpos, axis=-1)
+    fp = jnp.cumsum(wneg, axis=-1)
+    total_pos = tp[:, -1:]
+    precision = tp / jnp.maximum(tp + fp, 1e-30)
+    delta_recall = wpos / jnp.maximum(total_pos, 1e-30)
+    return jnp.sum(precision * delta_recall, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("squeeze",))
+def _auprc_from_hist_fused(hist: jax.Array, *, squeeze: bool) -> jax.Array:
+    """One-dispatch eager entry for the histogram->AUPRC reduction."""
+    auprc = _auprc_from_hist(hist)
+    return auprc[0] if squeeze else auprc
+
+
 def _as_2d(
     input: jax.Array,
     target: jax.Array,
