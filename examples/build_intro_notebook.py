@@ -116,7 +116,10 @@ Below: way 2 on whatever devices this notebook sees (1 is fine; with the
 from functools import partial
 
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.38 jax keeps it under experimental
+    from jax.experimental.shard_map import shard_map
 
 from torcheval_tpu.metrics.functional.classification.accuracy import (
     _multiclass_accuracy_update,

@@ -14,6 +14,15 @@ The pattern most eval loops want (reference examples call each metric's
 
 import os
 
+import sys as _sys
+
+# file-relative fallback: `python -m examples.<name>` resolves imports from
+# the CWD, not this directory, so `_backend` needs the examples dir on
+# sys.path (direct `python examples/<name>.py` runs already have it)
+_here = os.path.dirname(os.path.abspath(__file__))
+_sys.path.append(_here)
+_sys.path.append(os.path.dirname(_here))  # repo root: uninstalled checkouts
+
 from _backend import ensure_backend
 
 ensure_backend()  # fall back to CPU if the accelerator relay is unreachable

@@ -10,6 +10,16 @@ count: a TPU slice, or the 8-device virtual CPU platform
 """
 
 
+import os as _os
+import sys as _sys
+
+# file-relative fallback: `python -m examples.<name>` resolves imports from
+# the CWD, not this directory, so `_backend` needs the examples dir on
+# sys.path (direct `python examples/<name>.py` runs already have it)
+_here = _os.path.dirname(_os.path.abspath(__file__))
+_sys.path.append(_here)
+_sys.path.append(_os.path.dirname(_here))  # repo root: uninstalled checkouts
+
 from _backend import ensure_backend
 
 ensure_backend()
@@ -19,7 +29,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.38 jax keeps it under experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from torcheval_tpu.metrics import MeanSquaredError, MulticlassAccuracy, Perplexity

@@ -6,6 +6,16 @@ call ``metric.update`` per batch (async, no host sync), ``compute`` per epoch,
 """
 
 
+import os as _os
+import sys as _sys
+
+# file-relative fallback: `python -m examples.<name>` resolves imports from
+# the CWD, not this directory, so `_backend` needs the examples dir on
+# sys.path (direct `python examples/<name>.py` runs already have it)
+_here = _os.path.dirname(_os.path.abspath(__file__))
+_sys.path.append(_here)
+_sys.path.append(_os.path.dirname(_here))  # repo root: uninstalled checkouts
+
 from _backend import ensure_backend
 
 ensure_backend()  # fall back to CPU if the accelerator relay is unreachable

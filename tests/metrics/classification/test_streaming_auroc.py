@@ -127,7 +127,10 @@ def test_in_jit_sync_is_one_fused_psum():
     """The histogram state syncs inside jit via a single psum that XLA
     merges with the step's own reduction — zero added collectives."""
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.4.38 jax keeps it under experimental
+        from jax.experimental.shard_map import shard_map
 
     from torcheval_tpu.metrics.sharded import sync_states_in_jit
     from torcheval_tpu.ops.fused_auc import _auc_from_hist, fused_auc_histogram
@@ -162,6 +165,16 @@ def test_in_jit_sync_is_one_fused_psum():
     n_plain = collective_count(step_plain.lower(x).compile())
     n_sync = collective_count(step.lower(x, t).compile())
     assert n_plain == 1
+    from torcheval_tpu.utils.hlo import all_reduce_combiner_active
+
+    if not all_reduce_combiner_active():
+        # sync still lowered to one batched psum of its own; merging it
+        # into the step's reduction needs the combiner (TPU toolchains)
+        assert n_sync <= n_plain + 1
+        pytest.skip(
+            "this XLA build does not run the all-reduce combiner; the "
+            "fused-psum pin needs a TPU toolchain"
+        )
     assert n_sync == n_plain, "hist sync must fuse into the existing psum"
 
     _, auc = step(x, t)

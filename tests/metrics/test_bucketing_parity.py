@@ -1,0 +1,314 @@
+"""Shape bucketing must never change a metric's value.
+
+Every converted kernel's contract: padded rows contribute EXACTLY ZERO to
+every state, so a ragged stream under ``config.shape_bucketing()`` computes
+the same result as the unbucketed path. For counting metrics (accuracy /
+precision / recall / F1 / confusion matrix / binned curves) the states are
+sums of 0/1 indicators — exact in float32 regardless of association — so
+parity is asserted BIT-IDENTICAL. Real-valued accumulators (MSE, R2,
+perplexity) append zeros to the reduced array, which can change XLA's
+reduction tree, so those assert to float32 resolution (rtol 1e-6).
+
+The same streams are also checked against the reference oracle where the
+/root/reference mount exists (tests/ref_oracle.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu import config
+from torcheval_tpu import metrics as M
+from torcheval_tpu.metrics._bucket import MIN_BUCKET, bucket_bound, bucket_length
+from torcheval_tpu.metrics.toolkit import update_collection
+
+RNG = np.random.default_rng(17)
+C = 6
+SIZES = [5, 33, 64, 100, 13, 1]  # ragged stream incl. an exact bucket size
+
+
+def _cls_batch(n):
+    return (
+        RNG.uniform(size=(n, C)).astype(np.float32),
+        np.asarray(RNG.integers(0, C, size=(n,))),
+    )
+
+
+def _bin_batch(n):
+    return (
+        RNG.uniform(size=(n,)).astype(np.float32),
+        np.asarray(RNG.integers(0, 2, size=(n,))),
+    )
+
+
+def _reg_batch(n):
+    return (
+        RNG.normal(size=(n,)).astype(np.float32),
+        RNG.normal(size=(n,)).astype(np.float32),
+    )
+
+
+def _ml_batch(n):
+    return (
+        RNG.uniform(size=(n, C)).astype(np.float32),
+        np.asarray(RNG.integers(0, 2, size=(n, C))),
+    )
+
+
+def _ppl_batch(n):
+    return (
+        RNG.normal(size=(2, n, 16)).astype(np.float32),
+        np.asarray(RNG.integers(0, 16, size=(2, n))),
+    )
+
+
+def _run_stream(ctor, batches, bucketed):
+    metric = ctor()
+    if bucketed:
+        with config.shape_bucketing():
+            for args in batches:
+                metric.update(*args)
+    else:
+        for args in batches:
+            metric.update(*args)
+    return metric.compute()
+
+
+def _flat(result):
+    if isinstance(result, (tuple, list)):
+        return np.concatenate([np.asarray(r).ravel() for r in result])
+    return np.asarray(result)
+
+
+EXACT_CASES = [
+    ("MulticlassAccuracy", lambda: M.MulticlassAccuracy(), _cls_batch),
+    (
+        "MulticlassAccuracy_macro",
+        lambda: M.MulticlassAccuracy(average="macro", num_classes=C),
+        _cls_batch,
+    ),
+    (
+        "MulticlassAccuracy_top2",
+        lambda: M.MulticlassAccuracy(k=2),
+        _cls_batch,
+    ),
+    ("BinaryAccuracy", lambda: M.BinaryAccuracy(), _bin_batch),
+    (
+        "MultilabelAccuracy_hamming",
+        lambda: M.MultilabelAccuracy(criteria="hamming"),
+        _ml_batch,
+    ),
+    (
+        "TopKMultilabelAccuracy",
+        lambda: M.TopKMultilabelAccuracy(criteria="overlap", k=2),
+        _ml_batch,
+    ),
+    ("MulticlassPrecision", lambda: M.MulticlassPrecision(), _cls_batch),
+    (
+        "MulticlassPrecision_none",
+        lambda: M.MulticlassPrecision(num_classes=C, average=None),
+        _cls_batch,
+    ),
+    ("BinaryPrecision", lambda: M.BinaryPrecision(), _bin_batch),
+    (
+        "MulticlassRecall_weighted",
+        lambda: M.MulticlassRecall(num_classes=C, average="weighted"),
+        _cls_batch,
+    ),
+    ("BinaryRecall", lambda: M.BinaryRecall(), _bin_batch),
+    (
+        "MulticlassF1Score_macro",
+        lambda: M.MulticlassF1Score(num_classes=C, average="macro"),
+        _cls_batch,
+    ),
+    ("BinaryF1Score", lambda: M.BinaryF1Score(), _bin_batch),
+    (
+        "MulticlassConfusionMatrix",
+        lambda: M.MulticlassConfusionMatrix(C),
+        _cls_batch,
+    ),
+    ("BinaryConfusionMatrix", lambda: M.BinaryConfusionMatrix(), _bin_batch),
+    (
+        "BinaryBinnedPrecisionRecallCurve",
+        lambda: M.BinaryBinnedPrecisionRecallCurve(threshold=9),
+        _bin_batch,
+    ),
+    (
+        "MulticlassBinnedPrecisionRecallCurve",
+        lambda: M.MulticlassBinnedPrecisionRecallCurve(
+            num_classes=C, threshold=7
+        ),
+        _cls_batch,
+    ),
+    (
+        "MulticlassBinnedPRC_memory",
+        lambda: M.MulticlassBinnedPrecisionRecallCurve(
+            num_classes=C, threshold=7, optimization="memory"
+        ),
+        _cls_batch,
+    ),
+    (
+        "MultilabelBinnedPrecisionRecallCurve",
+        lambda: M.MultilabelBinnedPrecisionRecallCurve(
+            num_labels=C, threshold=7
+        ),
+        _ml_batch,
+    ),
+    (
+        "MultilabelBinnedPRC_memory",
+        lambda: M.MultilabelBinnedPrecisionRecallCurve(
+            num_labels=C, threshold=7, optimization="memory"
+        ),
+        _ml_batch,
+    ),
+]
+
+CLOSE_CASES = [
+    ("MeanSquaredError", lambda: M.MeanSquaredError(), _reg_batch),
+    ("R2Score", lambda: M.R2Score(), _reg_batch),
+    ("Perplexity", lambda: M.Perplexity(), _ppl_batch),
+    ("Perplexity_ignore", lambda: M.Perplexity(ignore_index=3), _ppl_batch),
+]
+
+
+@pytest.mark.parametrize(
+    "name,ctor,gen", EXACT_CASES, ids=[c[0] for c in EXACT_CASES]
+)
+def test_bucketed_equals_unbucketed_exact(name, ctor, gen):
+    batches = [gen(n) for n in SIZES]
+    plain = _flat(_run_stream(ctor, batches, bucketed=False))
+    bucketed = _flat(_run_stream(ctor, batches, bucketed=True))
+    np.testing.assert_array_equal(plain, bucketed)
+
+
+@pytest.mark.parametrize(
+    "name,ctor,gen", CLOSE_CASES, ids=[c[0] for c in CLOSE_CASES]
+)
+def test_bucketed_equals_unbucketed_close(name, ctor, gen):
+    batches = [gen(n) for n in SIZES]
+    plain = _flat(_run_stream(ctor, batches, bucketed=False))
+    bucketed = _flat(_run_stream(ctor, batches, bucketed=True))
+    np.testing.assert_allclose(plain, bucketed, rtol=1e-6, atol=1e-7)
+
+
+def test_weighted_mse_masks_through_sample_weight():
+    batches = [
+        (*_reg_batch(n), RNG.uniform(0.5, 2.0, size=(n,)).astype(np.float32))
+        for n in SIZES
+    ]
+
+    def run(bucketed):
+        metric = M.MeanSquaredError()
+        ctx = config.shape_bucketing() if bucketed else _null_ctx()
+        with ctx:
+            for x, t, w in batches:
+                metric.update(x, t, sample_weight=w)
+        return np.asarray(metric.compute())
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+
+def _null_ctx():
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def test_device_array_inputs_bucket_too():
+    """jax.Array inputs take the (trivially jitted) device-pad path; the
+    values must still match exactly."""
+    batches = [tuple(jnp.asarray(a) for a in _cls_batch(n)) for n in SIZES]
+    plain = _flat(
+        _run_stream(lambda: M.MulticlassAccuracy(), batches, bucketed=False)
+    )
+    bucketed = _flat(
+        _run_stream(lambda: M.MulticlassAccuracy(), batches, bucketed=True)
+    )
+    np.testing.assert_array_equal(plain, bucketed)
+
+
+def test_update_collection_bucketed_parity():
+    """The fused-group path pads once per batch and must agree with the
+    per-metric path."""
+    def panel():
+        return {
+            "acc": M.MulticlassAccuracy(),
+            "f1": M.MulticlassF1Score(num_classes=C, average="macro"),
+            "cm": M.MulticlassConfusionMatrix(C),
+        }
+
+    batches = [_cls_batch(n) for n in SIZES]
+    plain, bucketed = panel(), panel()
+    for args in batches:
+        update_collection(plain, *args)
+    with config.shape_bucketing():
+        for args in batches:
+            update_collection(bucketed, *args)
+    for key in plain:
+        np.testing.assert_array_equal(
+            np.asarray(plain[key].compute()),
+            np.asarray(bucketed[key].compute()),
+            err_msg=key,
+        )
+
+
+def test_bucket_length_and_bound():
+    assert bucket_length(1) == MIN_BUCKET
+    assert bucket_length(MIN_BUCKET) == MIN_BUCKET
+    assert bucket_length(MIN_BUCKET + 1) == 2 * MIN_BUCKET
+    assert bucket_length(1000) == 1024
+    assert bucket_length(1024) == 1024
+    # bound counts the distinct buckets sizes in [1, max] can produce
+    assert bucket_bound(1024) == len(
+        {bucket_length(n) for n in range(1, 1025)}
+    )
+
+
+def test_input_validation_still_raises_under_bucketing():
+    """Host (numpy) inputs flow through the same shape validation."""
+    m = M.MulticlassAccuracy()
+    x, _ = _cls_batch(8)
+    _, t = _cls_batch(9)
+    with config.shape_bucketing():
+        with pytest.raises(ValueError, match="first dimension"):
+            m.update(x, t)
+
+
+def test_oracle_parity_bucketed_stream():
+    """Bucketed ragged streams against the reference torcheval oracle
+    (skips where /root/reference is not mounted)."""
+    from tests.ref_oracle import load_reference_metrics
+
+    ref_m, _ = load_reference_metrics()
+    if ref_m is None:
+        pytest.skip("reference oracle unavailable")
+    import torch
+
+    batches = [_cls_batch(n) for n in SIZES]
+
+    ours = M.MulticlassAccuracy()
+    with config.shape_bucketing():
+        for x, t in batches:
+            ours.update(x, t)
+    ref = ref_m.MulticlassAccuracy()
+    for x, t in batches:
+        ref.update(torch.tensor(x), torch.tensor(t))
+    np.testing.assert_allclose(
+        np.asarray(ours.compute()), np.asarray(ref.compute()), rtol=1e-6
+    )
+
+    ours_f1 = M.MulticlassF1Score(num_classes=C, average="macro")
+    with config.shape_bucketing():
+        for x, t in batches:
+            ours_f1.update(x, t)
+    ref_f1 = ref_m.MulticlassF1Score(num_classes=C, average="macro")
+    for x, t in batches:
+        ref_f1.update(torch.tensor(x), torch.tensor(t))
+    np.testing.assert_allclose(
+        np.asarray(ours_f1.compute()), np.asarray(ref_f1.compute()),
+        rtol=1e-6,
+    )

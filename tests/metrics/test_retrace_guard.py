@@ -1,0 +1,256 @@
+"""Retrace-proofing regression guard (tier-1, fast).
+
+The shape-bucketing layer (torcheval_tpu/metrics/_bucket.py) exists to make
+a variable-shape eval stream compile O(log max_batch) fused programs
+instead of one per distinct batch shape. A regression here is silent —
+results stay correct while every ragged batch pays a fresh trace+compile —
+so this guard runs a 20-step loop over 7 distinct batch sizes under the
+compile counter and fails loudly if the program count exceeds the bucket
+bound. A control without bucketing proves the counter would have seen the
+retraces, and a shard_map arm pins that the mask-aware kernels add ZERO
+collectives to an in-jit-synced step.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.38 jax keeps it under experimental
+    from jax.experimental.shard_map import shard_map
+
+from torcheval_tpu import config
+from torcheval_tpu.metrics import (
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from torcheval_tpu.metrics._bucket import bucket_bound, bucket_length
+from torcheval_tpu.metrics.functional.classification.accuracy import (
+    _multiclass_accuracy_update,
+    _multiclass_accuracy_update_masked,
+)
+from torcheval_tpu.metrics.sharded import sync_states_in_jit
+from torcheval_tpu.metrics.toolkit import update_collection
+from torcheval_tpu.utils import CompileCounter
+from torcheval_tpu.utils.hlo import (
+    collective_count,
+    compile_fully_optimized,
+)
+
+RNG = np.random.default_rng(3)
+
+MAX_BATCH, CLASSES = 64, 8
+# 20 steps cycling 7 distinct batch sizes — ragged tails and odd mid-stream
+# shapes; numpy inputs (the data-loader reality), so padding is pure host
+# work and the counter sees only fused update programs.
+SIZES = [64, 64, 37, 64, 19, 64, 50, 7, 64, 23, 64, 64, 37, 3, 64, 19,
+         64, 50, 64, 37]
+X = RNG.uniform(size=(MAX_BATCH, CLASSES)).astype(np.float32)
+T = np.asarray(RNG.integers(0, CLASSES, size=(MAX_BATCH,)))
+
+assert len(SIZES) == 20 and len(set(SIZES)) == 7
+
+
+def _expected_buckets():
+    return {bucket_length(n) for n in SIZES}
+
+
+def test_compile_counter_sees_fresh_compiles():
+    """Counter self-check: a never-before-compiled program must count 1 —
+    guards against a JAX monitoring-event rename making the bound below
+    vacuously true."""
+    salt = jnp.float32(RNG.uniform())  # unique constant -> unique program
+    with CompileCounter() as cc:
+        jax.block_until_ready(
+            jax.jit(lambda a: jnp.cumsum(a) * salt)(jnp.arange(17.0))
+        )
+    assert cc.programs >= 1
+
+
+def test_ragged_stream_compiles_within_bucket_bound():
+    metric = MulticlassAccuracy()
+    with config.shape_bucketing():
+        with CompileCounter() as cc:
+            for n in SIZES:
+                metric.update(X[:n], T[:n])
+            jax.block_until_ready(metric.num_total)
+
+    issue_bound = math.ceil(math.log2(MAX_BATCH)) + 1
+    assert cc.programs <= len(_expected_buckets()), (
+        f"{cc.programs} programs for buckets {_expected_buckets()}"
+    )
+    assert cc.programs <= issue_bound
+    assert cc.programs <= bucket_bound(MAX_BATCH)
+
+    # the stream really was ragged: without bucketing the same sizes
+    # compile one program each
+    control = MulticlassAccuracy()
+    with CompileCounter() as cc_ctrl:
+        for n in sorted(set(SIZES)):
+            control.update(X[:n], T[:n])
+        jax.block_until_ready(control.num_total)
+    assert cc_ctrl.programs >= len(set(SIZES))
+
+    # and the bucketed stream computed the same value
+    np.testing.assert_allclose(
+        np.asarray(metric.compute()),
+        np.asarray(
+            MulticlassAccuracy()
+            .update(
+                np.concatenate([X[:n] for n in SIZES]),
+                np.concatenate([T[:n] for n in SIZES]),
+            )
+            .compute()
+        ),
+        rtol=1e-6,
+    )
+
+
+def test_update_collection_compiles_one_group_program_per_bucket():
+    """The fused GROUP dispatch must bucket too: K metrics on a ragged
+    stream compile one group program per bucket, not K programs per
+    distinct shape."""
+    panel = {
+        "acc": MulticlassAccuracy(),
+        "f1": MulticlassF1Score(),
+        "precision": MulticlassPrecision(num_classes=CLASSES, average="macro"),
+        "recall": MulticlassRecall(num_classes=CLASSES, average="macro"),
+        "cm": MulticlassConfusionMatrix(CLASSES),
+    }
+    with config.shape_bucketing():
+        with CompileCounter() as cc:
+            for n in SIZES:
+                update_collection(panel, X[:n], T[:n])
+            jax.block_until_ready(panel["acc"].num_total)
+    # one GROUP program per bucket (not per metric, not per shape)
+    assert cc.programs <= len(_expected_buckets()), (
+        f"{cc.programs} group programs for buckets {_expected_buckets()}"
+    )
+
+
+def test_mixed_panel_keeps_bucketed_group_bound():
+    """A metric WITHOUT a mask-aware kernel in the panel (here: a
+    windowed ring-buffer metric, transform plan) must not drag the
+    bucketed metrics' group program into per-shape retraces — unbucketed
+    plans group separately, so their inherent per-shape compiles add to
+    the total but the bucketed group stays at one program per bucket."""
+    from torcheval_tpu.metrics import BinaryAccuracy, WindowedMeanSquaredError
+
+    panel = {
+        "acc": BinaryAccuracy(),
+        "wmse": WindowedMeanSquaredError(max_num_updates=4),
+    }
+    scores = RNG.uniform(size=(MAX_BATCH,)).astype(np.float32)
+    labels = (RNG.random(MAX_BATCH) < 0.5).astype(np.float32)
+    with config.shape_bucketing():
+        with CompileCounter() as cc:
+            for n in SIZES:
+                update_collection(panel, scores[:n], labels[:n])
+            jax.block_until_ready(panel["acc"].num_total)
+    # the windowed metric retraces once per distinct shape (no masked
+    # kernel — inherent); the bucketed group must still cost at most one
+    # program per bucket on top of that
+    budget = len(_expected_buckets()) + len(set(SIZES))
+    assert cc.programs <= budget, (
+        f"{cc.programs} programs; bucketed group must stay at "
+        f"{len(_expected_buckets())} on top of {len(set(SIZES))} "
+        "windowed-metric retraces"
+    )
+    # value parity for the bucketed member of the mixed panel
+    np.testing.assert_array_equal(
+        np.asarray(panel["acc"].compute()),
+        np.asarray(
+            BinaryAccuracy()
+            .update(
+                np.concatenate([scores[:n] for n in SIZES]),
+                np.concatenate([labels[:n] for n in SIZES]),
+            )
+            .compute()
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    cpus = jax.devices("cpu")
+    if len(cpus) < 8:
+        pytest.skip("needs the 8-device virtual CPU platform")
+    return Mesh(np.array(cpus[:8]), ("dp",))
+
+
+def test_masked_kernel_adds_no_collectives(mesh):
+    """Masking is a local concern: an in-jit-synced eval step using the
+    mask-aware accuracy kernel must lower to EXACTLY the collectives of
+    the unmasked step (sharded.py's unchanged-collective-count contract)."""
+    n = 8
+    batch, d = 8 * n, 16
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(d, CLASSES)).astype(np.float32))
+    x = jax.device_put(
+        jnp.asarray(rng.normal(size=(batch, d)).astype(np.float32)),
+        NamedSharding(mesh, P("dp", None)),
+    )
+    y = jax.device_put(
+        jnp.asarray(rng.integers(0, CLASSES, size=(batch,))),
+        NamedSharding(mesh, P("dp")),
+    )
+    state = {"nc": jnp.zeros(()), "nt": jnp.zeros(())}
+    valid_sizes = jnp.asarray([n - 3], dtype=jnp.int32)
+
+    @jax.jit
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("dp", None), P("dp"), P(), P()),
+        out_specs=(P(), P()),
+    )
+    def step_unmasked(x, y, w, state):
+        logits = jnp.tanh(x @ w)
+        nc, nt = _multiclass_accuracy_update(logits, y, "micro", None, 1)
+        local = {"nc": state["nc"] + nc, "nt": state["nt"] + nt}
+        return jax.lax.psum(jnp.sum(logits), "dp"), sync_states_in_jit(
+            local, "dp"
+        )
+
+    @jax.jit
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("dp", None), P("dp"), P(), P(), P()),
+        out_specs=(P(), P()),
+    )
+    def step_masked(x, y, w, valid_sizes, state):
+        logits = jnp.tanh(x @ w)
+        nc, nt = _multiclass_accuracy_update_masked(
+            logits, y, valid_sizes, "micro", None, 1
+        )
+        local = {"nc": state["nc"] + nc, "nt": state["nt"] + nt}
+        return jax.lax.psum(jnp.sum(logits), "dp"), sync_states_in_jit(
+            local, "dp"
+        )
+
+    plain = collective_count(
+        compile_fully_optimized(step_unmasked.lower(x, y, w, state))
+    )
+    masked = collective_count(
+        compile_fully_optimized(
+            step_masked.lower(x, y, w, valid_sizes, state)
+        )
+    )
+    assert masked == plain, (
+        f"masked step lowered to {masked} collectives vs {plain} unmasked"
+    )
+
+    # and the masked step's counters really exclude the padded rows
+    _, synced = step_masked(x, y, w, valid_sizes, state)
+    assert float(synced["nt"]) == 8 * (n - 3)

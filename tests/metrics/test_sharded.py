@@ -8,7 +8,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.38 jax keeps it under experimental
+    from jax.experimental.shard_map import shard_map
 
 from torcheval_tpu.metrics import MulticlassAccuracy, Max, Min
 from torcheval_tpu.metrics.functional.classification.accuracy import (

@@ -24,13 +24,17 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.38 jax keeps it under experimental
+    from jax.experimental.shard_map import shard_map
 
 from torcheval_tpu.metrics.functional.classification.accuracy import (
     _multiclass_accuracy_update,
 )
 from torcheval_tpu.metrics.sharded import sync_states_in_jit
 from torcheval_tpu.utils.hlo import (
+    all_reduce_combiner_active as _combiner_active,
     collective_count as _collective_count,
     compile_fully_optimized as _compile_opt,
 )
@@ -91,6 +95,14 @@ def test_metric_sync_adds_no_collectives(mesh):
     n_plain = _collective_count(plain)
     n_synced = _collective_count(synced)
     assert n_plain == 1, f"baseline step expected 1 all-reduce, got {n_plain}"
+    if not _combiner_active():
+        # the whole-metric sync still lowered to ONE batched collective —
+        # only the merge INTO the step's own reduction needs the combiner
+        assert n_synced <= n_plain + 1
+        pytest.skip(
+            "this XLA build does not run the all-reduce combiner; the "
+            "zero-added-collectives pin needs a TPU toolchain"
+        )
     assert n_synced == n_plain, (
         f"metric sync added collectives: {n_synced} vs {n_plain} — the "
         "psum-combiner fusion the sync design relies on has regressed"

@@ -12,7 +12,12 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.38 jax keeps it under experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torcheval_tpu.parallel import dense_reference_attention, ring_attention

@@ -27,6 +27,7 @@ def _load(name):
 
 TPU = _load("bench_r3_tpu_20260731.json")
 CPU = _load("bench_r5_cpu_deadrelay_20260801.json")
+VB = _load("bench_r6_variable_batch_cpu_20260803.json")
 
 
 def _read(path):
@@ -208,6 +209,41 @@ def test_measured_bridge_table_matches_capture():
     assert float(m.group(1)) == pytest.approx(
         bridge["measured_overhead_pct"], abs=0.0005
     )
+
+
+def test_variable_batch_table_matches_capture():
+    """The retrace-proofing table traces to its committed capture: compile
+    count, unbucketed control, ragged and fixed throughput."""
+    text = _read("docs/benchmarks.md")
+    vb = VB["variable_batch"]
+    m = re.search(
+        r"compiles for the whole ragged stream \| \*\*(\d+)\*\* programs",
+        text,
+    )
+    assert m, "variable_batch compile-count row not found"
+    assert int(m.group(1)) == vb["compiles_per_metric"]
+    assert vb["compiles_per_metric"] <= vb["compile_bound_log2"]
+    m = re.search(
+        r"unbucketed control, (\d+) distinct sizes \| (\d+) programs", text
+    )
+    assert m, "unbucketed control row not found"
+    assert int(m.group(1)) == vb["unbucketed_control"]["distinct_sizes"]
+    assert int(m.group(2)) == vb["unbucketed_control"]["programs"]
+    m = re.search(
+        r"ragged steady-state throughput \| ([\d,]+) updates/s "
+        r"\(([\d.]+)× the fixed loop[^|]*\| acceptance floor: "
+        r"≥ fixed-shape ([\d,]+) updates/s",
+        text,
+    )
+    assert m, "variable_batch throughput row not found"
+    assert float(m.group(1).replace(",", "")) == pytest.approx(
+        vb["value"], rel=0.01
+    )
+    assert float(m.group(2)) == pytest.approx(vb["ragged_vs_fixed"], abs=0.05)
+    assert float(m.group(3).replace(",", "")) == pytest.approx(
+        vb["fixed_shape_updates_per_s"], rel=0.01
+    )
+    assert vb["ragged_within_1p5x_of_fixed"]
 
 
 def test_bridge_numerator_terms_match_dispatch_table():

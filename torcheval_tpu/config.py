@@ -11,7 +11,15 @@ split validation into two tiers:
 - *value checks*: require device->host readback — gated behind
   ``debug_validation`` (env ``TORCHEVAL_TPU_DEBUG``), default off.
 
-There is deliberately no config-file/flag system beyond this: the reference
+The second knob is *shape bucketing* (env ``TORCHEVAL_TPU_SHAPE_BUCKETING``,
+default off): variable-batch eval loops retrace/recompile the fused update
+program once per distinct input shape. With bucketing on, batch axes are
+padded up to power-of-two buckets and a validity mask keeps padded rows out
+of every state, so a whole ragged stream compiles O(log max_batch) programs
+total (see ``torcheval_tpu/metrics/_bucket.py`` and
+docs/variable-shape-eval.md).
+
+There is deliberately no config-file/flag system beyond these: the reference
 uses plain constructor kwargs (SURVEY.md section 5.6) and so do we.
 """
 
@@ -53,3 +61,42 @@ def debug_validation(enabled: bool = True) -> Iterator[None]:
         yield
     finally:
         _debug_validation = prev
+
+
+_shape_bucketing: bool = os.environ.get(
+    "TORCHEVAL_TPU_SHAPE_BUCKETING", ""
+).lower() in ("1", "true", "yes", "on")
+
+
+def shape_bucketing_enabled() -> bool:
+    """True when variable-shape updates are padded to power-of-two buckets."""
+    return _shape_bucketing
+
+
+def set_shape_bucketing(enabled: bool) -> None:
+    global _shape_bucketing
+    _shape_bucketing = bool(enabled)
+
+
+@contextmanager
+def shape_bucketing(enabled: bool = True) -> Iterator[None]:
+    """Context manager enabling retrace-proof shape bucketing.
+
+    Inside the context, bucket-aware metrics pad ragged batch axes up to
+    power-of-two buckets and thread a validity mask into the kernel, so a
+    streaming eval loop with a ragged tail compiles O(log max_batch)
+    programs instead of one per distinct shape. Padded rows contribute
+    exactly zero to every state, so ``compute()`` results match the
+    unbucketed path.
+
+    >>> with shape_bucketing():
+    ...     for batch in loader:           # ragged last batch is fine
+    ...         metric.update(batch.scores, batch.labels)
+    """
+    global _shape_bucketing
+    prev = _shape_bucketing
+    _shape_bucketing = enabled
+    try:
+        yield
+    finally:
+        _shape_bucketing = prev

@@ -132,7 +132,11 @@ class MultiHostGroup(ProcessGroup):
         max_len = int(lengths.max())
         padded = np.zeros(max_len, dtype=np.uint8)
         padded[: payload.size] = payload
-        gathered = multihost_utils.process_allgather(padded, tiled=False)
+        # some jax versions return the gather concatenated (world*max_len,)
+        # instead of stacked (world, max_len); normalize the layout
+        gathered = np.asarray(
+            multihost_utils.process_allgather(padded, tiled=False)
+        ).reshape(self._world, max_len)
         return [
             pickle.loads(gathered[r, : int(lengths[r])].tobytes())
             for r in range(self._world)

@@ -1,0 +1,149 @@
+"""Power-of-two shape bucketing for variable-batch metric updates.
+
+The fused update programs (torcheval_tpu/metrics/_fuse.py) make a
+steady-state ``metric.update()`` cost one async device dispatch — but XLA
+compiles one program per distinct INPUT SHAPE, so a streaming eval loop
+with a ragged last batch (or variable-length token batches) silently pays
+a fresh trace+compile (tens of ms to seconds) whenever a new shape
+arrives. This module makes the compiled-program set finite: batch axes
+are padded up to power-of-two buckets and a validity-extent vector is
+threaded into a mask-aware twin of the kernel, so padded rows contribute
+exactly zero to every state and the whole stream compiles at most
+``ceil(log2(max_batch)) + 1`` programs per metric.
+
+Mechanics:
+
+- A bucket-aware metric's ``_update_plan`` returns an
+  :class:`~torcheval_tpu.metrics.metric.UpdatePlan` with ``masked_kernel``
+  set and ``batch_axes`` naming the ragged axes of each dynamic argument
+  (a tuple of dim labels per argument, positional from axis 0; ``None``
+  for arguments with no ragged axis, e.g. threshold tensors). Arguments
+  sharing a label must agree on that dim's size.
+- :func:`apply_bucketing` (called by ``Metric._apply_update_plan`` and
+  ``toolkit.update_collection``) pads every labeled axis up to its bucket
+  and swaps in the masked kernel with one extra trailing dynamic: the
+  int32 vector of valid extents, ordered by first label appearance. The
+  masked kernel rebuilds the mask from that vector INSIDE the fused
+  program, so distinct valid counts reuse one executable.
+- Host inputs (numpy / torch / sequences) are padded with numpy — zero
+  compiles. Device-resident ``jax.Array`` inputs are padded by a trivial
+  jitted pad (one tiny program per distinct input shape — unavoidable,
+  since the ragged shape must enter some program signature; the expensive
+  fused kernel still compiles once per bucket).
+
+Enabled via ``torcheval_tpu.config.shape_bucketing`` (off by default:
+padding changes the op-level arithmetic of non-power-of-two batches, and
+fixed-shape workloads need none of this).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu import config
+from torcheval_tpu.metrics.metric import UpdatePlan
+
+# Floor for bucket sizes: tiny ragged tails (1..8 rows) share one program
+# instead of compiling buckets 1, 2, 4, 8 separately.
+MIN_BUCKET = 8
+
+
+def bucket_length(n: int, min_bucket: int = MIN_BUCKET) -> int:
+    """Smallest power of two >= ``n`` (floored at ``min_bucket``)."""
+    if n <= min_bucket:
+        return min_bucket
+    return 1 << (int(n) - 1).bit_length()
+
+
+def bucket_bound(max_n: int, min_bucket: int = MIN_BUCKET) -> int:
+    """Max distinct buckets a stream of batch sizes in [1, max_n] can
+    produce — the compile-count ceiling ``bench.py``'s ``variable_batch``
+    config and the retrace-guard test assert against."""
+    lo = bucket_length(1, min_bucket)
+    hi = bucket_length(max_n, min_bucket)
+    return (hi.bit_length() - lo.bit_length()) + 1
+
+
+@partial(jax.jit, static_argnames=("shape",), inline=True)
+def _device_pad(x: jax.Array, shape: tuple) -> jax.Array:
+    return jnp.pad(x, [(0, t - s) for s, t in zip(x.shape, shape)])
+
+
+def _pad_to(arg: Any, target_shape: tuple, cache: Optional[Dict]) -> Any:
+    # the cached entry holds the SOURCE array too: the id() key is only
+    # valid while the source is alive, and the caller may drop its own
+    # reference (update_collection discards pre-bucket plans) — without
+    # the pin, id reuse could serve another argument's pad
+    key = (id(arg), target_shape)
+    if cache is not None and key in cache:
+        return cache[key][1]
+    if isinstance(arg, jax.Array):
+        out = _device_pad(arg, target_shape)
+    else:
+        a = np.asarray(arg)
+        out = np.zeros(target_shape, dtype=a.dtype)
+        out[tuple(slice(0, s) for s in a.shape)] = a
+    if cache is not None:
+        cache[key] = (arg, out)
+    return out
+
+
+def apply_bucketing(plan, pad_cache: Optional[Dict] = None):
+    """Rewrite one update plan for its shape bucket (no-op when bucketing
+    is disabled or the plan declares no masked kernel).
+
+    ``pad_cache`` lets ``update_collection`` pad a batch shared by many
+    metrics once; it must not outlive the call that created it (keys are
+    ``id()``-based).
+    """
+    if (
+        not config.shape_bucketing_enabled()
+        or not isinstance(plan, UpdatePlan)
+        or plan.masked_kernel is None
+        or not plan.batch_axes
+    ):
+        return plan
+
+    sizes: Dict[str, int] = {}
+    order = []
+    for arg, labels in zip(plan.dynamic, plan.batch_axes):
+        for axis, label in enumerate(labels or ()):
+            n = int(np.shape(arg)[axis])
+            if label not in sizes:
+                sizes[label] = n
+                order.append(label)
+            elif sizes[label] != n:
+                raise ValueError(
+                    f"Bucketed axis {label!r} has inconsistent sizes "
+                    f"{sizes[label]} and {n} across update arguments."
+                )
+    buckets = {label: bucket_length(n) for label, n in sizes.items()}
+
+    padded = []
+    for arg, labels in zip(plan.dynamic, plan.batch_axes):
+        if not labels:
+            padded.append(arg)
+            continue
+        shape = list(np.shape(arg))
+        for axis, label in enumerate(labels):
+            shape[axis] = buckets[label]
+        if tuple(shape) == tuple(np.shape(arg)):
+            padded.append(arg)
+        else:
+            padded.append(_pad_to(arg, tuple(shape), pad_cache))
+
+    # Always dispatch the masked kernel — even for exactly-bucket-sized
+    # batches — so each bucket owns ONE program (kernel choice must not
+    # depend on whether the batch happened to be a power of two).
+    valid = np.asarray([sizes[label] for label in order], dtype=np.int32)
+    return plan._replace(
+        kernel=plan.masked_kernel,
+        dynamic=tuple(padded) + (valid,),
+        masked_kernel=None,
+        batch_axes=(),
+    )

@@ -8,6 +8,15 @@ TPU/JAX, per-dispatch latency dominates instead). This helper jits
 ``kernel(*dynamic, *config)`` together with the state adds into ONE
 compiled program, cached per (kernel, config, arity) so repeated updates
 hit the same executable.
+
+Shape bucketing composes upstream of this layer: under
+``config.shape_bucketing()`` plans arrive already rewritten
+(metrics/_bucket.py) — dynamic args padded to their power-of-two bucket
+plus a trailing valid-extent vector, kernel swapped for its mask-aware
+twin — so the per-(kernel, config, arity) caches here see one stable
+signature per bucket instead of one per distinct batch shape. That holds
+for the group path too: an ``update_collection`` over K bucketed metrics
+compiles one group program per bucket, not per ragged shape.
 """
 
 from __future__ import annotations

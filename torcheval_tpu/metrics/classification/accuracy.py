@@ -19,15 +19,19 @@ from torcheval_tpu.metrics.functional.classification.accuracy import (
     _accuracy_update_input_check,
     _binary_accuracy_update,
     _binary_accuracy_update_input_check,
+    _binary_accuracy_update_masked,
     _multiclass_accuracy_update,
+    _multiclass_accuracy_update_masked,
     _multilabel_accuracy_param_check,
     _multilabel_accuracy_update,
     _multilabel_accuracy_update_input_check,
+    _multilabel_accuracy_update_masked,
     _topk_multilabel_accuracy_param_check,
     _topk_multilabel_accuracy_update,
     _topk_multilabel_accuracy_update_input_check,
+    _topk_multilabel_accuracy_update_masked,
 )
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TAccuracy = TypeVar("TAccuracy", bound="MulticlassAccuracy")
 
@@ -75,14 +79,20 @@ class MulticlassAccuracy(Metric[jax.Array]):
                 "num_total", jnp.zeros(num_classes), merge=MergeKind.SUM
             )
 
+    # plans carry mask-aware kernel twins: under config.shape_bucketing()
+    # ragged batches pad to power-of-two buckets (metrics/_bucket.py)
+    _bucketed_update = True
+
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _accuracy_update_input_check(input, target, self.num_classes, self.k)
-        return (
+        return UpdatePlan(
             _multiclass_accuracy_update,
             ("num_correct", "num_total"),
             (input, target),
             (self.average, self.num_classes, self.k),
+            masked_kernel=_multiclass_accuracy_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self: TAccuracy, input, target) -> TAccuracy:
@@ -113,11 +123,13 @@ class BinaryAccuracy(MulticlassAccuracy):
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_accuracy_update_input_check(input, target)
-        return (
+        return UpdatePlan(
             _binary_accuracy_update,
             ("num_correct", "num_total"),
             (input, target),
             (float(self.threshold),),
+            masked_kernel=_binary_accuracy_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self, input, target) -> "BinaryAccuracy":
@@ -153,11 +165,13 @@ class MultilabelAccuracy(MulticlassAccuracy):
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _multilabel_accuracy_update_input_check(input, target)
-        return (
+        return UpdatePlan(
             _multilabel_accuracy_update,
             ("num_correct", "num_total"),
             (input, target),
             (float(self.threshold), self.criteria),
+            masked_kernel=_multilabel_accuracy_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self, input, target) -> "MultilabelAccuracy":
@@ -192,11 +206,13 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _topk_multilabel_accuracy_update_input_check(input, target, self.k)
-        return (
+        return UpdatePlan(
             _topk_multilabel_accuracy_update,
             ("num_correct", "num_total"),
             (input, target),
             (self.criteria, self.k),
+            masked_kernel=_topk_multilabel_accuracy_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self, input, target) -> "TopKMultilabelAccuracy":

@@ -16,11 +16,16 @@ from torcheval_tpu.metrics.functional.classification.binned_precision_recall_cur
     DEFAULT_NUM_THRESHOLD,
     _binary_binned_compute_jit,
     _binary_binned_update_jit,
+    _binary_binned_update_masked_jit,
     _multiclass_binned_precision_recall_curve_compute,
     _multiclass_binned_update_memory_jit,
+    _multiclass_binned_update_memory_masked,
     _multiclass_binned_update_vectorized_jit,
+    _multiclass_binned_update_vectorized_masked,
     _multilabel_binned_update_memory_jit,
+    _multilabel_binned_update_memory_masked,
     _multilabel_binned_update_vectorized_jit,
+    _multilabel_binned_update_vectorized_masked,
     _optimization_param_check,
 )
 from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
@@ -29,7 +34,7 @@ from torcheval_tpu.metrics.functional.classification.precision_recall_curve impo
     _multilabel_precision_recall_curve_update_input_check,
 )
 from torcheval_tpu.metrics.functional.tensor_utils import create_threshold_tensor
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 
 class BinaryBinnedPrecisionRecallCurve(
@@ -63,14 +68,20 @@ class BinaryBinnedPrecisionRecallCurve(
         self._add_state("num_fp", jnp.zeros(num_t), merge=MergeKind.SUM)
         self._add_state("num_fn", jnp.zeros(num_t), merge=MergeKind.SUM)
 
+    # plans carry mask-aware kernel twins (metrics/_bucket.py); the
+    # threshold tensor has no ragged axis and is never padded
+    _bucketed_update = True
+
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_precision_recall_curve_update_input_check(input, target)
         # one fused dispatch: binning kernel + the three counter adds
-        return (
+        return UpdatePlan(
             _binary_binned_update_jit,
             ("num_tp", "num_fp", "num_fn"),
             (input, target, self.threshold),
+            masked_kernel=_binary_binned_update_masked_jit,
+            batch_axes=(("batch",), ("batch",), None),
         )
 
     def update(self, input, target) -> "BinaryBinnedPrecisionRecallCurve":
@@ -121,21 +132,31 @@ class MulticlassBinnedPrecisionRecallCurve(
         self._add_state("num_fp", jnp.zeros((num_t, num_classes)), merge=MergeKind.SUM)
         self._add_state("num_fn", jnp.zeros((num_t, num_classes)), merge=MergeKind.SUM)
 
+    # plans carry mask-aware kernel twins (metrics/_bucket.py)
+    _bucketed_update = True
+
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _multiclass_precision_recall_curve_update_input_check(
             input, target, self.num_classes
         )
+        vectorized = self.optimization == "vectorized"
         kernel = (
             _multiclass_binned_update_vectorized_jit
-            if self.optimization == "vectorized"
+            if vectorized
             else _multiclass_binned_update_memory_jit
         )
         # one fused dispatch: binning kernel + the three counter adds
-        return (
+        return UpdatePlan(
             kernel,
             ("num_tp", "num_fp", "num_fn"),
             (input, target, self.threshold),
+            masked_kernel=(
+                _multiclass_binned_update_vectorized_masked
+                if vectorized
+                else _multiclass_binned_update_memory_masked
+            ),
+            batch_axes=(("batch",), ("batch",), None),
         )
 
     def update(self, input, target) -> "MulticlassBinnedPrecisionRecallCurve":
@@ -184,21 +205,31 @@ class MultilabelBinnedPrecisionRecallCurve(
         self._add_state("num_fp", jnp.zeros((num_t, num_labels)), merge=MergeKind.SUM)
         self._add_state("num_fn", jnp.zeros((num_t, num_labels)), merge=MergeKind.SUM)
 
+    # plans carry mask-aware kernel twins (metrics/_bucket.py)
+    _bucketed_update = True
+
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _multilabel_precision_recall_curve_update_input_check(
             input, target, self.num_labels
         )
+        vectorized = self.optimization == "vectorized"
         kernel = (
             _multilabel_binned_update_vectorized_jit
-            if self.optimization == "vectorized"
+            if vectorized
             else _multilabel_binned_update_memory_jit
         )
         # one fused dispatch: binning kernel + the three counter adds
-        return (
+        return UpdatePlan(
             kernel,
             ("num_tp", "num_fp", "num_fn"),
             (input, target, self.threshold),
+            masked_kernel=(
+                _multilabel_binned_update_vectorized_masked
+                if vectorized
+                else _multilabel_binned_update_memory_masked
+            ),
+            batch_axes=(("batch",), ("batch",), None),
         )
 
     def update(self, input, target) -> "MultilabelBinnedPrecisionRecallCurve":

@@ -14,12 +14,14 @@ import jax.numpy as jnp
 from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
     _binary_confusion_matrix_update_input_check,
     _binary_confusion_matrix_update_jit,
+    _binary_confusion_matrix_update_masked,
     _confusion_matrix_compute,
     _confusion_matrix_param_check,
     _confusion_matrix_update_input_check,
     _confusion_matrix_update_jit,
+    _confusion_matrix_update_masked,
 )
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TMulticlassConfusionMatrix = TypeVar(
     "TMulticlassConfusionMatrix", bound="MulticlassConfusionMatrix"
@@ -55,14 +57,19 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
             merge=MergeKind.SUM,
         )
 
+    # plans carry mask-aware kernel twins (metrics/_bucket.py)
+    _bucketed_update = True
+
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _confusion_matrix_update_input_check(input, target, self.num_classes)
-        return (
+        return UpdatePlan(
             _confusion_matrix_update_jit,
             ("confusion_matrix",),
             (input, target),
             (self.num_classes,),
+            masked_kernel=_confusion_matrix_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(
@@ -109,11 +116,13 @@ class BinaryConfusionMatrix(MulticlassConfusionMatrix):
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_confusion_matrix_update_input_check(input, target)
-        return (
+        return UpdatePlan(
             _binary_confusion_matrix_update_jit,
             ("confusion_matrix",),
             (input, target),
             (float(self.threshold),),
+            masked_kernel=_binary_confusion_matrix_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self, input, target) -> "BinaryConfusionMatrix":

@@ -14,12 +14,14 @@ import jax.numpy as jnp
 from torcheval_tpu.metrics.functional.classification.f1_score import (
     _binary_f1_score_update_input_check,
     _binary_f1_score_update_jit,
+    _binary_f1_score_update_masked,
     _f1_score_compute,
     _f1_score_param_check,
     _f1_score_update_input_check,
     _f1_score_update_jit,
+    _f1_score_update_masked,
 )
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TF1Score = TypeVar("TF1Score", bound="MulticlassF1Score")
 
@@ -53,15 +55,20 @@ class MulticlassF1Score(Metric[jax.Array]):
         self._add_state("num_label", jnp.zeros(shape), merge=MergeKind.SUM)
         self._add_state("num_prediction", jnp.zeros(shape), merge=MergeKind.SUM)
 
+    # plans carry mask-aware kernel twins (metrics/_bucket.py)
+    _bucketed_update = True
+
     def _update_plan(self: TF1Score, input, target):
         input, target = self._input(input), self._input(target)
         _f1_score_update_input_check(input, target, self.num_classes)
         # one fused dispatch: kernel + the three counter adds
-        return (
+        return UpdatePlan(
             _f1_score_update_jit,
             ("num_tp", "num_label", "num_prediction"),
             (input, target),
             (self.num_classes, self.average),
+            masked_kernel=_f1_score_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self: TF1Score, input, target) -> TF1Score:
@@ -93,11 +100,13 @@ class BinaryF1Score(MulticlassF1Score):
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_f1_score_update_input_check(input, target)
-        return (
+        return UpdatePlan(
             _binary_f1_score_update_jit,
             ("num_tp", "num_label", "num_prediction"),
             (input, target),
             (float(self.threshold),),
+            masked_kernel=_binary_f1_score_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self, input, target) -> "BinaryF1Score":

@@ -14,12 +14,14 @@ import jax.numpy as jnp
 from torcheval_tpu.metrics.functional.classification.precision import (
     _binary_precision_update_input_check,
     _binary_precision_update_jit,
+    _binary_precision_update_masked,
     _precision_compute,
     _precision_param_check,
     _precision_update_input_check,
     _precision_update_jit,
+    _precision_update_masked,
 )
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TPrecision = TypeVar("TPrecision", bound="MulticlassPrecision")
 
@@ -57,15 +59,20 @@ class MulticlassPrecision(Metric[jax.Array]):
             merge=MergeKind.SUM,
         )
 
+    # plans carry mask-aware kernel twins (metrics/_bucket.py)
+    _bucketed_update = True
+
     def _update_plan(self: TPrecision, input, target):
         input, target = self._input(input), self._input(target)
         _precision_update_input_check(input, target, self.num_classes)
         # one fused dispatch: kernel + the three counter adds
-        return (
+        return UpdatePlan(
             _precision_update_jit,
             ("num_tp", "num_fp", "num_label"),
             (input, target),
             (self.num_classes, self.average),
+            masked_kernel=_precision_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self: TPrecision, input, target) -> TPrecision:
@@ -97,11 +104,13 @@ class BinaryPrecision(MulticlassPrecision):
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_precision_update_input_check(input, target)
-        return (
+        return UpdatePlan(
             _binary_precision_update_jit,
             ("num_tp", "num_fp", "num_label"),
             (input, target),
             (float(self.threshold),),
+            masked_kernel=_binary_precision_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self, input, target) -> "BinaryPrecision":

@@ -14,13 +14,15 @@ import jax.numpy as jnp
 from torcheval_tpu.metrics.functional.classification.recall import (
     _binary_recall_update_input_check,
     _binary_recall_update_jit,
+    _binary_recall_update_masked,
     _recall_compute,
     _recall_param_check,
     _recall_update_input_check,
     _recall_update_jit,
+    _recall_update_masked,
 )
 from torcheval_tpu.metrics.functional.tensor_utils import nan_safe_divide
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TRecall = TypeVar("TRecall", bound="MulticlassRecall")
 
@@ -54,15 +56,20 @@ class MulticlassRecall(Metric[jax.Array]):
         self._add_state("num_labels", jnp.zeros(shape), merge=MergeKind.SUM)
         self._add_state("num_predictions", jnp.zeros(shape), merge=MergeKind.SUM)
 
+    # plans carry mask-aware kernel twins (metrics/_bucket.py)
+    _bucketed_update = True
+
     def _update_plan(self: TRecall, input, target):
         input, target = self._input(input), self._input(target)
         _recall_update_input_check(input, target, self.num_classes)
         # one fused dispatch: kernel + the three counter adds
-        return (
+        return UpdatePlan(
             _recall_update_jit,
             ("num_tp", "num_labels", "num_predictions"),
             (input, target),
             (self.num_classes, self.average),
+            masked_kernel=_recall_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self: TRecall, input, target) -> TRecall:
@@ -93,14 +100,18 @@ class BinaryRecall(Metric[jax.Array]):
         self._add_state("num_tp", jnp.zeros(()), merge=MergeKind.SUM)
         self._add_state("num_true_labels", jnp.zeros(()), merge=MergeKind.SUM)
 
+    _bucketed_update = True
+
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _binary_recall_update_input_check(input, target)
-        return (
+        return UpdatePlan(
             _binary_recall_update_jit,
             ("num_tp", "num_true_labels"),
             (input, target),
             (float(self.threshold),),
+            masked_kernel=_binary_recall_update_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self, input, target) -> "BinaryRecall":

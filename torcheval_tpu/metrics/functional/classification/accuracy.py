@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
-from torcheval_tpu.metrics.functional.tensor_utils import correct_mask
+from torcheval_tpu.metrics.functional.tensor_utils import correct_mask, valid_mask
 from torcheval_tpu.utils.convert import to_jax
 
 
@@ -69,6 +69,38 @@ def _multiclass_accuracy_update(
     num_total = jax.ops.segment_sum(
         jnp.ones_like(mask), target, num_segments=num_classes
     )
+    return num_correct, num_total
+
+
+@partial(jax.jit, static_argnames=("average", "num_classes", "k"))
+def _multiclass_accuracy_update_masked(
+    input: jax.Array,
+    target: jax.Array,
+    valid_sizes: jax.Array,
+    average: Optional[str],
+    num_classes: Optional[int],
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Mask-aware twin of ``_multiclass_accuracy_update`` (shape
+    bucketing): rows at index >= ``valid_sizes[0]`` are padding and
+    contribute exactly zero to both counters."""
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    if k == 1:
+        if input.ndim == 2:
+            mask = correct_mask(input, target)
+        else:
+            mask = (input == target).astype(jnp.float32)
+    else:
+        target_score = jnp.take_along_axis(input, target[:, None], axis=-1)
+        rank = jnp.sum(input > target_score, axis=-1)
+        mask = (rank < k).astype(jnp.float32)
+    mask = mask * valid
+
+    if average == "micro":
+        return jnp.sum(mask), jnp.sum(valid)
+
+    num_correct = jax.ops.segment_sum(mask, target, num_segments=num_classes)
+    num_total = jax.ops.segment_sum(valid, target, num_segments=num_classes)
     return num_correct, num_total
 
 
@@ -193,6 +225,16 @@ def _binary_accuracy_update(
     return num_correct, jnp.float32(target.shape[0])
 
 
+@partial(jax.jit, static_argnames=("threshold",))
+def _binary_accuracy_update_masked(
+    input: jax.Array, target: jax.Array, valid_sizes: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array]:
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    pred = jnp.where(input < threshold, 0, 1)
+    num_correct = jnp.sum((pred == target).astype(jnp.float32) * valid)
+    return num_correct, jnp.sum(valid)
+
+
 def _binary_accuracy_update_input_check(input: jax.Array, target: jax.Array) -> None:
     if input.shape != target.shape:
         raise ValueError(
@@ -249,12 +291,49 @@ def _multilabel_update(
     return num_correct.astype(jnp.float32), n
 
 
+def _multilabel_update_masked(
+    input_label: jax.Array, target: jax.Array, valid: jax.Array, criteria: str
+) -> Tuple[jax.Array, jax.Array]:
+    """``_multilabel_update`` with padded rows excluded from both counts."""
+    n = jnp.sum(valid)
+    if criteria == "exact_match":
+        row = jnp.all(input_label == target, axis=1).astype(jnp.float32)
+        return jnp.sum(row * valid), n
+    if criteria == "hamming":
+        hit = (input_label == target).astype(jnp.float32) * valid[:, None]
+        return jnp.sum(hit), n * jnp.float32(target.shape[1])
+    if criteria == "overlap":
+        hit = jnp.max((input_label == target) & (input_label == 1), axis=1)
+        all_negative = jnp.all((input_label == 0) & (target == 0), axis=1)
+        row = (hit | all_negative).astype(jnp.float32)
+        return jnp.sum(row * valid), n
+    if criteria == "contain":
+        row = jnp.all(input_label - target >= 0, axis=1).astype(jnp.float32)
+        return jnp.sum(row * valid), n
+    # belong
+    row = jnp.all(input_label - target <= 0, axis=1).astype(jnp.float32)
+    return jnp.sum(row * valid), n
+
+
 @partial(jax.jit, static_argnames=("threshold", "criteria"))
 def _multilabel_accuracy_update(
     input: jax.Array, target: jax.Array, threshold: float, criteria: str
 ) -> Tuple[jax.Array, jax.Array]:
     input_label = jnp.where(input < threshold, 0, 1)
     return _multilabel_update(input_label, target, criteria)
+
+
+@partial(jax.jit, static_argnames=("threshold", "criteria"))
+def _multilabel_accuracy_update_masked(
+    input: jax.Array,
+    target: jax.Array,
+    valid_sizes: jax.Array,
+    threshold: float,
+    criteria: str,
+) -> Tuple[jax.Array, jax.Array]:
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    input_label = jnp.where(input < threshold, 0, 1)
+    return _multilabel_update_masked(input_label, target, valid, criteria)
 
 
 @partial(jax.jit, static_argnames=("criteria", "k"))
@@ -267,6 +346,21 @@ def _topk_multilabel_accuracy_update(
     rows = jnp.arange(input.shape[0])[:, None]
     input_label = jnp.zeros(input.shape, dtype=target.dtype).at[rows, idx].set(1)
     return _multilabel_update(input_label, target, criteria)
+
+
+@partial(jax.jit, static_argnames=("criteria", "k"))
+def _topk_multilabel_accuracy_update_masked(
+    input: jax.Array,
+    target: jax.Array,
+    valid_sizes: jax.Array,
+    criteria: str,
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    _, idx = jax.lax.top_k(input, k)
+    rows = jnp.arange(input.shape[0])[:, None]
+    input_label = jnp.zeros(input.shape, dtype=target.dtype).at[rows, idx].set(1)
+    return _multilabel_update_masked(input_label, target, valid, criteria)
 
 
 def _multilabel_accuracy_param_check(criteria: str) -> None:

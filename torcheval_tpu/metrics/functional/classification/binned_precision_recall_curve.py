@@ -28,6 +28,7 @@ from torcheval_tpu.metrics.functional.classification.precision_recall_curve impo
 from torcheval_tpu.metrics.functional.tensor_utils import (
     create_threshold_tensor,
     nan_safe_divide,
+    valid_mask,
 )
 from torcheval_tpu.utils.convert import to_jax
 
@@ -61,6 +62,33 @@ def _binary_binned_update_jit(
     suffix = jnp.flip(jnp.cumsum(jnp.flip(per_bin, axis=0), axis=0), axis=0)
     num_fp, num_tp = suffix[:, 0], suffix[:, 1]
     num_fn = jnp.sum(target).astype(jnp.float32) - num_tp
+    return num_tp, num_fp, num_fn
+
+
+@jax.jit
+def _binary_binned_update_masked_jit(
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    valid_sizes: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Mask-aware twin of ``_binary_binned_update_jit`` (shape bucketing):
+    padded samples carry histogram weight 0 and are excluded from the
+    positive count feeding ``num_fn``."""
+    valid = valid_mask(input.shape[0], valid_sizes[0])
+    num_thresholds = threshold.shape[0]
+    idx = jnp.searchsorted(threshold, input, side="right") - 1
+    fused = 2 * idx + target.astype(jnp.int32)
+    weight = (idx >= 0).astype(jnp.float32) * valid
+    hist = jax.ops.segment_sum(
+        weight,
+        jnp.clip(fused, 0, 2 * num_thresholds - 1),
+        num_segments=2 * num_thresholds,
+    )
+    per_bin = hist.reshape(num_thresholds, 2)
+    suffix = jnp.flip(jnp.cumsum(jnp.flip(per_bin, axis=0), axis=0), axis=0)
+    num_fp, num_tp = suffix[:, 0], suffix[:, 1]
+    num_fn = jnp.sum(target * valid).astype(jnp.float32) - num_tp
     return num_tp, num_fp, num_fn
 
 
@@ -148,6 +176,56 @@ def _multiclass_binned_update_memory_jit(
     num_fp, num_tp = suffix[..., 0], suffix[..., 1]  # (T, C)
     class_counts = jax.ops.segment_sum(
         jnp.ones_like(target, dtype=jnp.float32), target, num_segments=num_classes
+    )
+    num_fn = class_counts[None, :] - num_tp
+    return num_tp, num_fp, num_fn
+
+
+@jax.jit
+def _multiclass_binned_update_vectorized_masked(
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    valid_sizes: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    num_classes = input.shape[1]
+    valid = valid_mask(input.shape[0], valid_sizes[0])
+    labels = (input >= threshold[:, None, None]) & (
+        valid[None, :, None] > 0
+    )  # (T, N, C)
+    onehot = jax.nn.one_hot(target, num_classes) * valid[:, None]  # (N, C)
+    num_tp = jnp.sum(labels * onehot[None], axis=1)
+    num_fp = jnp.sum(labels, axis=1).astype(jnp.float32) - num_tp
+    num_fn = jnp.sum(onehot, axis=0) - num_tp
+    return num_tp, num_fp, num_fn
+
+
+@jax.jit
+def _multiclass_binned_update_memory_masked(
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    valid_sizes: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    num_samples, num_classes = input.shape
+    num_thresholds = threshold.shape[0]
+    valid = valid_mask(num_samples, valid_sizes[0])
+    idx = jnp.searchsorted(threshold, input, side="right") - 1  # (N, C)
+    classes = jnp.arange(num_classes)
+    is_target = (target[:, None] == classes[None, :]).astype(jnp.int32)
+    fused = 2 * (num_classes * idx + classes[None, :]) + is_target
+    weight = (idx >= 0).astype(jnp.float32) * valid[:, None]
+    nbins = 2 * num_thresholds * num_classes
+    hist = jax.ops.segment_sum(
+        weight.reshape(-1),
+        jnp.clip(fused, 0, nbins - 1).reshape(-1),
+        num_segments=nbins,
+    )
+    per_bin = hist.reshape(num_thresholds, num_classes, 2)
+    suffix = jnp.flip(jnp.cumsum(jnp.flip(per_bin, axis=0), axis=0), axis=0)
+    num_fp, num_tp = suffix[..., 0], suffix[..., 1]
+    class_counts = jax.ops.segment_sum(
+        valid, target, num_segments=num_classes
     )
     num_fn = class_counts[None, :] - num_tp
     return num_tp, num_fp, num_fn
@@ -250,6 +328,54 @@ def _multilabel_binned_update_memory_jit(
     suffix = jnp.flip(jnp.cumsum(jnp.flip(per_bin, axis=0), axis=0), axis=0)
     num_fp, num_tp = suffix[..., 0], suffix[..., 1]
     label_counts = jnp.sum(target, axis=0).astype(jnp.float32)
+    num_fn = label_counts[None, :] - num_tp
+    return num_tp, num_fp, num_fn
+
+
+@jax.jit
+def _multilabel_binned_update_vectorized_masked(
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    valid_sizes: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    valid = valid_mask(input.shape[0], valid_sizes[0])
+    labels = (input >= threshold[:, None, None]) & (
+        valid[None, :, None] > 0
+    )  # (T, N, L)
+    tmask = target.astype(jnp.float32) * valid[:, None]
+    num_tp = jnp.sum(labels * tmask[None], axis=1)
+    num_fp = jnp.sum(labels, axis=1).astype(jnp.float32) - num_tp
+    num_fn = jnp.sum(tmask, axis=0) - num_tp
+    return num_tp, num_fp, num_fn
+
+
+@jax.jit
+def _multilabel_binned_update_memory_masked(
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    valid_sizes: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    num_samples, num_labels = input.shape
+    num_thresholds = threshold.shape[0]
+    valid = valid_mask(num_samples, valid_sizes[0])
+    idx = jnp.searchsorted(threshold, input, side="right") - 1
+    labels = jnp.arange(num_labels)
+    fused = 2 * (num_labels * idx + labels[None, :]) + target.astype(jnp.int32)
+    weight = (idx >= 0).astype(jnp.float32) * valid[:, None]
+    nbins = 2 * num_thresholds * num_labels
+    hist = jax.ops.segment_sum(
+        weight.reshape(-1),
+        jnp.clip(fused, 0, nbins - 1).reshape(-1),
+        num_segments=nbins,
+    )
+    per_bin = hist.reshape(num_thresholds, num_labels, 2)
+    suffix = jnp.flip(jnp.cumsum(jnp.flip(per_bin, axis=0), axis=0), axis=0)
+    num_fp, num_tp = suffix[..., 0], suffix[..., 1]
+    label_counts = jnp.sum(
+        target.astype(jnp.float32) * valid[:, None], axis=0
+    )
     num_fn = label_counts[None, :] - num_tp
     return num_tp, num_fp, num_fn
 

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
-from torcheval_tpu.metrics.functional.tensor_utils import argmax_last
+from torcheval_tpu.metrics.functional.tensor_utils import argmax_last, valid_mask
 from torcheval_tpu.utils.convert import to_jax
 
 
@@ -32,6 +32,31 @@ def _confusion_matrix_update_jit(
         num_segments=num_classes * num_classes,
     )
     return counts.reshape(num_classes, num_classes)
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _confusion_matrix_update_masked(
+    input: jax.Array, target: jax.Array, valid_sizes: jax.Array, num_classes: int
+) -> jax.Array:
+    """Mask-aware twin of ``_confusion_matrix_update_jit`` (shape
+    bucketing): padded rows scatter weight 0 into cell (0, 0)."""
+    valid = valid_mask(target.shape[0], valid_sizes[0], dtype=jnp.int32)
+    if input.ndim == 2:
+        input = argmax_last(input)
+    flat = target.astype(jnp.int32) * num_classes + input.astype(jnp.int32)
+    counts = jax.ops.segment_sum(
+        valid, flat, num_segments=num_classes * num_classes
+    )
+    return counts.reshape(num_classes, num_classes)
+
+
+@partial(jax.jit, static_argnames=("threshold",))
+def _binary_confusion_matrix_update_masked(
+    input: jax.Array, target: jax.Array, valid_sizes: jax.Array, threshold: float
+) -> jax.Array:
+    return _confusion_matrix_update_masked(
+        jnp.where(input < threshold, 0, 1), target, valid_sizes, 2
+    )
 
 
 def _l1_normalize(cm: jax.Array, axis: int) -> jax.Array:

@@ -19,7 +19,11 @@ import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
 
-from torcheval_tpu.metrics.functional.tensor_utils import argmax_last, nan_safe_divide
+from torcheval_tpu.metrics.functional.tensor_utils import (
+    argmax_last,
+    nan_safe_divide,
+    valid_mask,
+)
 from torcheval_tpu.utils.convert import to_jax
 
 _logger: logging.Logger = logging.getLogger(__name__)
@@ -44,6 +48,31 @@ def _f1_score_update_jit(
         ones, input.astype(target.dtype), num_segments=num_classes
     )
     tp_mask = (input == target).astype(jnp.float32)
+    num_tp = jax.ops.segment_sum(tp_mask, target, num_segments=num_classes)
+    return num_tp, num_label, num_prediction
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _f1_score_update_masked(
+    input: jax.Array,
+    target: jax.Array,
+    valid_sizes: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Mask-aware twin of ``_f1_score_update_jit`` (shape bucketing)."""
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    if input.ndim == 2:
+        input = argmax_last(input)
+    if average == "micro":
+        num_tp = jnp.sum((input == target).astype(jnp.float32) * valid)
+        num_label = jnp.sum(valid)
+        return num_tp, num_label, num_label
+    num_label = jax.ops.segment_sum(valid, target, num_segments=num_classes)
+    num_prediction = jax.ops.segment_sum(
+        valid, input.astype(target.dtype), num_segments=num_classes
+    )
+    tp_mask = (input == target).astype(jnp.float32) * valid
     num_tp = jax.ops.segment_sum(tp_mask, target, num_segments=num_classes)
     return num_tp, num_label, num_prediction
 
@@ -161,6 +190,18 @@ def _binary_f1_score_update_jit(
     pred = jnp.where(input < threshold, 0, 1)
     num_tp = jnp.sum(pred * target).astype(jnp.float32)
     num_label = jnp.sum(target).astype(jnp.float32)
+    num_prediction = jnp.sum(pred).astype(jnp.float32)
+    return num_tp, num_label, num_prediction
+
+
+@partial(jax.jit, static_argnames=("threshold",))
+def _binary_f1_score_update_masked(
+    input: jax.Array, target: jax.Array, valid_sizes: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    pred = jnp.where(input < threshold, 0, 1) * valid
+    num_tp = jnp.sum(pred * target).astype(jnp.float32)
+    num_label = jnp.sum(target * valid).astype(jnp.float32)
     num_prediction = jnp.sum(pred).astype(jnp.float32)
     return num_tp, num_label, num_prediction
 

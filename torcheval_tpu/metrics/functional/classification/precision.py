@@ -15,7 +15,11 @@ import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
 
-from torcheval_tpu.metrics.functional.tensor_utils import argmax_last, nan_safe_divide
+from torcheval_tpu.metrics.functional.tensor_utils import (
+    argmax_last,
+    nan_safe_divide,
+    valid_mask,
+)
 from torcheval_tpu.utils.convert import to_jax
 
 _logger: logging.Logger = logging.getLogger(__name__)
@@ -40,6 +44,31 @@ def _precision_update_jit(
     num_tp = jax.ops.segment_sum(tp_mask, target, num_segments=num_classes)
     num_fp = jax.ops.segment_sum(
         1.0 - tp_mask, input.astype(target.dtype), num_segments=num_classes
+    )
+    return num_tp, num_fp, num_label
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _precision_update_masked(
+    input: jax.Array,
+    target: jax.Array,
+    valid_sizes: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Mask-aware twin of ``_precision_update_jit`` (shape bucketing)."""
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    if input.ndim == 2:
+        input = argmax_last(input)
+    if average == "micro":
+        num_tp = jnp.sum((input == target).astype(jnp.float32) * valid)
+        num_fp = jnp.sum((input != target).astype(jnp.float32) * valid)
+        return num_tp, num_fp, jnp.zeros(())
+    num_label = jax.ops.segment_sum(valid, target, num_segments=num_classes)
+    tp_mask = (input == target).astype(jnp.float32) * valid
+    num_tp = jax.ops.segment_sum(tp_mask, target, num_segments=num_classes)
+    num_fp = jax.ops.segment_sum(
+        valid - tp_mask, input.astype(target.dtype), num_segments=num_classes
     )
     return num_tp, num_fp, num_label
 
@@ -160,6 +189,17 @@ def _binary_precision_update_jit(
     input: jax.Array, target: jax.Array, threshold: float
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     pred = jnp.where(input < threshold, 0, 1)
+    num_tp = jnp.sum(pred * target, axis=-1).astype(jnp.float32)
+    num_fp = jnp.sum(pred, axis=-1).astype(jnp.float32) - num_tp
+    return num_tp, num_fp, jnp.zeros(())
+
+
+@partial(jax.jit, static_argnames=("threshold",))
+def _binary_precision_update_masked(
+    input: jax.Array, target: jax.Array, valid_sizes: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    pred = jnp.where(input < threshold, 0, 1) * valid
     num_tp = jnp.sum(pred * target, axis=-1).astype(jnp.float32)
     num_fp = jnp.sum(pred, axis=-1).astype(jnp.float32) - num_tp
     return num_tp, num_fp, jnp.zeros(())

@@ -15,7 +15,11 @@ import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
 
-from torcheval_tpu.metrics.functional.tensor_utils import argmax_last, nan_safe_divide
+from torcheval_tpu.metrics.functional.tensor_utils import (
+    argmax_last,
+    nan_safe_divide,
+    valid_mask,
+)
 from torcheval_tpu.utils.convert import to_jax
 
 _logger: logging.Logger = logging.getLogger(__name__)
@@ -40,6 +44,31 @@ def _recall_update_jit(
         ones, input.astype(target.dtype), num_segments=num_classes
     )
     tp_mask = (input == target).astype(jnp.float32)
+    num_tp = jax.ops.segment_sum(tp_mask, target, num_segments=num_classes)
+    return num_tp, num_labels, num_predictions
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _recall_update_masked(
+    input: jax.Array,
+    target: jax.Array,
+    valid_sizes: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Mask-aware twin of ``_recall_update_jit`` (shape bucketing)."""
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    if input.ndim == 2:
+        input = argmax_last(input)
+    if average == "micro":
+        num_tp = jnp.sum((input == target).astype(jnp.float32) * valid)
+        num_labels = jnp.sum(valid)
+        return num_tp, num_labels, num_labels
+    num_labels = jax.ops.segment_sum(valid, target, num_segments=num_classes)
+    num_predictions = jax.ops.segment_sum(
+        valid, input.astype(target.dtype), num_segments=num_classes
+    )
+    tp_mask = (input == target).astype(jnp.float32) * valid
     num_tp = jax.ops.segment_sum(tp_mask, target, num_segments=num_classes)
     return num_tp, num_labels, num_predictions
 
@@ -156,6 +185,17 @@ def _binary_recall_update_jit(
     pred = jnp.where(input < threshold, 0, 1)
     num_tp = jnp.sum(pred * target, axis=-1).astype(jnp.float32)
     num_true_labels = jnp.sum(target, axis=-1).astype(jnp.float32)
+    return num_tp, num_true_labels
+
+
+@partial(jax.jit, static_argnames=("threshold",))
+def _binary_recall_update_masked(
+    input: jax.Array, target: jax.Array, valid_sizes: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array]:
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    pred = jnp.where(input < threshold, 0, 1) * valid
+    num_tp = jnp.sum(pred * target, axis=-1).astype(jnp.float32)
+    num_true_labels = jnp.sum(target * valid, axis=-1).astype(jnp.float32)
     return num_tp, num_true_labels
 
 

@@ -16,6 +16,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional.tensor_utils import valid_mask
 from torcheval_tpu.utils.convert import to_jax_float
 
 
@@ -31,6 +32,35 @@ def _update_unweighted(
 def _update_weighted(
     input: jax.Array, target: jax.Array, sample_weight: jax.Array
 ) -> Tuple[jax.Array, jax.Array]:
+    squared_error = jnp.square(target - input)
+    if squared_error.ndim == 2:
+        sample_weight = sample_weight[:, None]
+    sum_squared_error = jnp.sum(squared_error * sample_weight, axis=0)
+    return sum_squared_error, jnp.sum(sample_weight, axis=0).squeeze()
+
+
+@jax.jit
+def _update_unweighted_masked(
+    input: jax.Array, target: jax.Array, valid_sizes: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Mask-aware twin of ``_update_unweighted`` (shape bucketing): a
+    padded row's squared error is zeroed and it adds nothing to the
+    weight sum — semantically the weighted update with 0/1 weights."""
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    squared_error = jnp.square(target - input)
+    w = valid[:, None] if squared_error.ndim == 2 else valid
+    return jnp.sum(squared_error * w, axis=0), jnp.sum(valid)
+
+
+@jax.jit
+def _update_weighted_masked(
+    input: jax.Array,
+    target: jax.Array,
+    sample_weight: jax.Array,
+    valid_sizes: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    sample_weight = sample_weight * valid
     squared_error = jnp.square(target - input)
     if squared_error.ndim == 2:
         sample_weight = sample_weight[:, None]

@@ -16,6 +16,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional.tensor_utils import valid_mask
 from torcheval_tpu.utils.convert import to_jax_float
 
 
@@ -27,6 +28,20 @@ def _update(
     sum_obs = jnp.sum(target, axis=0)
     sum_squared_residual = jnp.sum(jnp.square(target - input), axis=0)
     return sum_squared_obs, sum_obs, sum_squared_residual, jnp.float32(target.shape[0])
+
+
+@jax.jit
+def _update_masked(
+    input: jax.Array, target: jax.Array, valid_sizes: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Mask-aware twin of ``_update`` (shape bucketing): padded rows add
+    zero to all four sufficient statistics."""
+    valid = valid_mask(target.shape[0], valid_sizes[0])
+    w = valid[:, None] if target.ndim == 2 else valid
+    sum_squared_obs = jnp.sum(jnp.square(target) * w, axis=0)
+    sum_obs = jnp.sum(target * w, axis=0)
+    sum_squared_residual = jnp.sum(jnp.square(target - input) * w, axis=0)
+    return sum_squared_obs, sum_obs, sum_squared_residual, jnp.sum(valid)
 
 
 def _r2_score_update(

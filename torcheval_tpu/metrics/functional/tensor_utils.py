@@ -24,6 +24,17 @@ def nan_safe_divide(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.where(b == 0, jnp.nan, a / jnp.where(b == 0, 1.0, b))
 
 
+def valid_mask(n: int, valid: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """Length-``n`` validity mask with ``valid`` leading ones (traceable).
+
+    The shared mask builder of the mask-aware kernel twins
+    (shape bucketing, torcheval_tpu/metrics/_bucket.py): ``n`` is the
+    padded (bucket) extent — a static shape — and ``valid`` is the dynamic
+    true extent, so every valid count reuses one compiled program.
+    """
+    return (jnp.arange(n) < valid).astype(dtype)
+
+
 def _match_vma(out: jax.Array, ref: jax.Array) -> jax.Array:
     """Propagate ``ref``'s varying-manual-axes onto ``out``.
 

@@ -48,6 +48,36 @@ def _perplexity_update_jit(
 
 
 @partial(jax.jit, static_argnames=("ignore_index",))
+def _perplexity_update_masked_jit(
+    input: jax.Array,
+    target: jax.Array,
+    valid_sizes: jax.Array,
+    ignore_index: Optional[int],
+) -> Tuple[jax.Array, jax.Array]:
+    """Mask-aware twin of ``_perplexity_update_jit`` (shape bucketing).
+
+    Two ragged axes — batch and sequence — are masked independently:
+    ``valid_sizes = [valid_batch, valid_seq]``. Padded tokens contribute
+    zero NLL and are excluded from the token count, exactly like
+    ``ignore_index`` tokens.
+    """
+    n, s = target.shape
+    keep = (
+        (jnp.arange(n)[:, None] < valid_sizes[0])
+        & (jnp.arange(s)[None, :] < valid_sizes[1])
+    ).reshape(-1)
+    log_probs = jax.nn.log_softmax(input.reshape(-1, input.shape[-1]), axis=-1)
+    flat_target = target.reshape(-1)
+    token_log_probs = jnp.take_along_axis(
+        log_probs, flat_target[:, None], axis=-1, mode="clip"
+    ).squeeze(-1)
+    if ignore_index is not None:
+        keep = keep & (flat_target != ignore_index)
+    token_log_probs = jnp.where(keep, token_log_probs, 0.0)
+    return -jnp.sum(token_log_probs), jnp.sum(keep).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("ignore_index",))
 def _perplexity_update_native_jit(
     input: jax.Array,
     target: jax.Array,

@@ -43,11 +43,14 @@ from typing import (
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu import config
 from torcheval_tpu.metrics._fuse import fused_accumulate
 from torcheval_tpu.utils.convert import (
     canonicalize_device,
     device_descriptor,
     resolve_device_descriptor,
+    to_host,
+    to_host_float,
     to_jax,
     to_jax_float,
 )
@@ -65,6 +68,15 @@ class UpdatePlan(NamedTuple):
     ``kernel`` and ``config`` must be hashable (they key the jit caches);
     ``finalize`` (host-side, optional) runs after the device step and is
     never part of a cache key.
+
+    ``masked_kernel`` + ``batch_axes`` opt the plan into shape bucketing
+    (torcheval_tpu/metrics/_bucket.py): under
+    ``config.shape_bucketing()``, batch axes are padded to power-of-two
+    buckets and ``masked_kernel(*padded_dynamic, valid_sizes, *config)``
+    is dispatched instead — it must make padded rows contribute exactly
+    zero to every state. ``batch_axes`` names the ragged axes of each
+    dynamic argument: one tuple of dim labels per argument (positional
+    from axis 0; ``None``/empty for arguments with no ragged axis).
     """
 
     kernel: Any
@@ -73,6 +85,8 @@ class UpdatePlan(NamedTuple):
     config: tuple = ()
     transform: bool = False
     finalize: Any = None
+    masked_kernel: Any = None
+    batch_axes: tuple = ()
 
 
 class MergeKind(enum.Enum):
@@ -209,18 +223,39 @@ class Metric(Generic[TComputeReturn], ABC):
 
     # --------------------------------------------------------- input boundary
 
+    # True on metrics whose ``_update_plan`` carries a masked kernel: under
+    # shape bucketing their host inputs must STAY on the host (numpy) until
+    # padded to the bucket — a device pad of the ragged shape would compile
+    # per shape, which is the retrace bucketing exists to kill.
+    _bucketed_update: bool = False
+
     def _input(self, x: Any, *, dtype: Any = None) -> jax.Array:
         """Coerce an update() argument onto ``self.device``.
 
         The analogue of the reference's ``input.to(self.device)`` at the top
         of every update (e.g. reference classification/accuracy.py:124-125):
-        accepts jax/numpy/torch/scalars, H2D-copies only when needed.
+        accepts jax/numpy/torch/scalars, H2D-copies only when needed. Under
+        shape bucketing, bucket-aware metrics keep host inputs on the host
+        (the fused dispatch device-puts the padded array once).
         """
+        if (
+            self._bucketed_update
+            and config.shape_bucketing_enabled()
+            and not isinstance(x, jax.Array)
+        ):
+            return to_host(x, dtype=dtype)
+        # jax.Array inputs keep the documented `input.to(self.device)` hop
+        # even under bucketing (the device pad then runs on self.device)
         return to_jax(x, dtype=dtype, device=self._device)
 
     def _input_float(self, x: Any) -> jax.Array:
-        arr = to_jax_float(x, device=self._device)
-        return arr
+        if (
+            self._bucketed_update
+            and config.shape_bucketing_enabled()
+            and not isinstance(x, jax.Array)
+        ):
+            return to_host_float(x)
+        return to_jax_float(x, device=self._device)
 
     # ------------------------------------------------------- abstract surface
 
@@ -256,9 +291,11 @@ class Metric(Generic[TComputeReturn], ABC):
         """Execute one fusable update plan against this metric's states.
         The trailing ``config`` element may be omitted (defaults to ``()``).
         """
+        from torcheval_tpu.metrics._bucket import apply_bucketing
         from torcheval_tpu.metrics._fuse import fused_transform
 
         if isinstance(plan, UpdatePlan):
+            plan = apply_bucketing(plan)
             states = tuple(getattr(self, n) for n in plan.state_names)
             if plan.transform:
                 new_states = fused_transform(

@@ -18,10 +18,12 @@ from torcheval_tpu.metrics.functional.regression.mean_squared_error import (
     _mean_squared_error_param_check,
     _mean_squared_error_update_input_check,
     _update_unweighted,
+    _update_unweighted_masked,
     _update_weighted,
+    _update_weighted_masked,
 )
 from torcheval_tpu.utils.convert import to_jax_float
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TMeanSquaredError = TypeVar("TMeanSquaredError", bound="MeanSquaredError")
 
@@ -75,6 +77,10 @@ class MeanSquaredError(Metric[jax.Array]):
             self._update_plan(input, target, sample_weight=sample_weight)
         )
 
+    # plans carry mask-aware kernel twins (metrics/_bucket.py); masking
+    # reuses the sample-weight semantics (a padded row is a weight-0 row)
+    _bucketed_update = True
+
     def _update_plan(self, input, target, *, sample_weight=None):
         input = self._input_float(input)
         target = self._input_float(target)
@@ -82,10 +88,16 @@ class MeanSquaredError(Metric[jax.Array]):
         names = ("sum_squared_error", "sum_weight")
         # one fused dispatch: squared-error kernel + the two counter adds
         if sample_weight is None:
-            return (_update_unweighted, names, (input, target), ())
-        return (
+            return UpdatePlan(
+                _update_unweighted, names, (input, target),
+                masked_kernel=_update_unweighted_masked,
+                batch_axes=(("batch",), ("batch",)),
+            )
+        return UpdatePlan(
             _update_weighted, names,
-            (input, target, to_jax_float(sample_weight)), (),
+            (input, target, self._input_float(sample_weight)),
+            masked_kernel=_update_weighted_masked,
+            batch_axes=(("batch",), ("batch",), ("batch",)),
         )
 
     def compute(self) -> jax.Array:

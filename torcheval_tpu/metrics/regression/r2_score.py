@@ -18,8 +18,9 @@ from torcheval_tpu.metrics.functional.regression.r2_score import (
     _r2_score_param_check,
     _r2_score_update_input_check,
     _update as _r2_update_kernel,
+    _update_masked as _r2_update_kernel_masked,
 )
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TR2Score = TypeVar("TR2Score", bound="R2Score")
 
@@ -62,15 +63,19 @@ class R2Score(Metric[jax.Array]):
         self._add_state("sum_squared_residual", jnp.zeros(()), merge=MergeKind.SUM)
         self._add_state("num_obs", jnp.zeros(()), merge=MergeKind.SUM)
 
+    # plans carry mask-aware kernel twins (metrics/_bucket.py)
+    _bucketed_update = True
+
     def _update_plan(self, input, target):
         input = self._input_float(input)
         target = self._input_float(target)
         _r2_score_update_input_check(input, target)
-        return (
+        return UpdatePlan(
             _r2_update_kernel,
             ("sum_squared_obs", "sum_obs", "sum_squared_residual", "num_obs"),
             (input, target),
-            (),
+            masked_kernel=_r2_update_kernel_masked,
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(self: TR2Score, input, target) -> TR2Score:

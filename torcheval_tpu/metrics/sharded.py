@@ -24,6 +24,20 @@ Typical use (data-parallel eval with in-step metrics)::
 
 The synced state can be loaded back into the class metric with
 ``metric.load_state_dict`` for reporting/checkpointing.
+
+Variable-shape eval (shape bucketing): the mask-aware kernel twins
+(``*_update_masked``, see torcheval_tpu/metrics/_bucket.py) drop into this
+path unchanged — pad the per-replica batch to its bucket outside the step,
+pass the valid-extent vector as one extra (replicated or per-replica)
+argument, and accumulate the masked kernel's deltas into the same carry::
+
+    nc, nt = _multiclass_accuracy_update_masked(
+        logits_padded, y_padded, valid_sizes, "micro", None, 1)
+
+Masking is a LOCAL concern: state shapes and merge kinds are identical to
+the unmasked path, so ``sync_states_in_jit`` lowers to the exact same
+collectives — zero added to the step program
+(tests/metrics/test_retrace_guard.py pins this structurally).
 """
 
 from __future__ import annotations

@@ -16,10 +16,11 @@ from torcheval_tpu.metrics.functional.text.perplexity import (
     _perplexity_compute,
     _perplexity_input_check,
     _perplexity_update_jit,
+    _perplexity_update_masked_jit,
     _perplexity_update_native_jit,
     _use_native_ce,
 )
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 
 TPerplexity = TypeVar("TPerplexity", bound="Perplexity")
 
@@ -72,6 +73,11 @@ class Perplexity(Metric[jax.Array]):
         # one fused dispatch: NLL kernel + both counter adds
         return self._apply_update_plan(self._update_plan(input, target))
 
+    # plans carry mask-aware kernel twins (metrics/_bucket.py): BOTH the
+    # batch and sequence axes bucket, covering variable-length token
+    # streams, not just ragged batch tails
+    _bucketed_update = True
+
     def _update_plan(self, input, target):
         input = self._input_float(input)
         target = self._input(target)
@@ -81,11 +87,13 @@ class Perplexity(Metric[jax.Array]):
             if input.dtype == jnp.float32 and _use_native_ce(input)
             else _perplexity_update_jit
         )
-        return (
+        return UpdatePlan(
             kernel,
             ("sum_log_probs", "num_total"),
             (input, target),
             (self.ignore_index,),
+            masked_kernel=_perplexity_update_masked_jit,
+            batch_axes=(("batch", "seq"), ("batch", "seq")),
         )
 
     def compute(self) -> jax.Array:

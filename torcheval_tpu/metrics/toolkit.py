@@ -271,6 +271,12 @@ def update_collection(
     already updated — the all-or-nothing guarantee covers the fusable
     group.
 
+    Under ``config.shape_bucketing()``, bucket-rewritten plans form their
+    OWN group program (one per shape bucket); metrics without a
+    mask-aware kernel group separately, so their per-shape retraces
+    cannot drag the bucketed group's compile count above its bound. A
+    mixed panel therefore pays two dispatches per update instead of one.
+
     Args:
         metrics: ``{name: Metric}`` dict or iterable of metrics.
         *args, **kwargs: one batch, passed to every metric's update.
@@ -289,6 +295,7 @@ def update_collection(
         >>> metrics["acc"].compute()
         Array(1., dtype=float32)
     """
+    from torcheval_tpu.metrics._bucket import apply_bucketing
     from torcheval_tpu.metrics._fuse import fused_accumulate_group
     from torcheval_tpu.metrics.metric import UpdatePlan
 
@@ -298,14 +305,26 @@ def update_collection(
     # any metric has mutated state (fallback metrics can only validate
     # inside their own update, in pass 2)
     fallback: List[Metric] = []
-    fusable: List[tuple] = []  # (metric, state_names, finalize)
-    plans: List[tuple] = []
+    # two independent group dispatches: plans REWRITTEN for their shape
+    # bucket vs everything else. Grouping them together would make the
+    # combined program's signature shape-polymorphic — one ragged-shaped
+    # plan (a metric without a masked kernel) would retrace the whole
+    # group per distinct batch shape, silently defeating the bucketed
+    # metrics' O(log max_batch) compile bound. With bucketing off, every
+    # plan lands in the plain group: ONE dispatch, exactly as before.
+    groups = {False: ([], []), True: ([], [])}  # bucketed -> (fusable, plans)
+    # one pad per (array, bucket) even when K metrics share the batch
+    pad_cache: dict = {}
     for metric in items:
         plan = metric._update_plan(*args, **kwargs)
         if plan is None:
             fallback.append(metric)
             continue
+        bucketed = False
         if isinstance(plan, UpdatePlan):
+            rewritten = apply_bucketing(plan, pad_cache)
+            bucketed = rewritten is not plan
+            plan = rewritten
             kernel, names, dynamic, config = (
                 plan.kernel, plan.state_names, plan.dynamic, plan.config
             )
@@ -315,13 +334,16 @@ def update_collection(
             config = rest[0] if rest else ()
             transform, finalize = False, None
         states = tuple(getattr(metric, n) for n in names)
+        fusable, plans = groups[bucketed]
         fusable.append((metric, names, finalize))
         plans.append((kernel, states, dynamic, config, transform))
     # pass 2: execute — fallbacks still validate themselves, but only after
     # every collected plan has passed validation
     for metric in fallback:
         metric.update(*args, **kwargs)
-    if plans:
+    for fusable, plans in groups.values():
+        if not plans:
+            continue
         new_states_group = fused_accumulate_group(plans)
         for (metric, names, finalize), new_states in zip(
             fusable, new_states_group

@@ -16,6 +16,7 @@ align_corners=False)``, fid.py:47) and a single fused forward program.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import flax.linen as nn
@@ -189,6 +190,7 @@ class InceptionV3(nn.Module):
 
 
 _DEFAULT_INIT_CACHE: Optional[Dict[str, Any]] = None
+_DEFAULT_INIT_LOCK = threading.Lock()
 
 
 def init_inception_params(
@@ -202,14 +204,18 @@ def init_inception_params(
     (``jnp.array`` copies): sharing leaves would let a caller that
     donates the tree to a jitted function delete the cache's buffers,
     a process-global failure. The ~100 ms device copy is still ~50x
-    cheaper than re-tracing."""
+    cheaper than re-tracing. First use is double-checked-locked so
+    concurrent callers (eval panels spinning up per-thread FID metrics)
+    cannot both pay the multi-second trace."""
     global _DEFAULT_INIT_CACHE
     if rng is None:
         if _DEFAULT_INIT_CACHE is None:
-            _DEFAULT_INIT_CACHE = InceptionV3().init(
-                jax.random.PRNGKey(0),
-                jnp.zeros((1, 299, 299, 3), dtype=jnp.float32),
-            )
+            with _DEFAULT_INIT_LOCK:
+                if _DEFAULT_INIT_CACHE is None:
+                    _DEFAULT_INIT_CACHE = InceptionV3().init(
+                        jax.random.PRNGKey(0),
+                        jnp.zeros((1, 299, 299, 3), dtype=jnp.float32),
+                    )
         return jax.tree_util.tree_map(jnp.array, _DEFAULT_INIT_CACHE)
     dummy = jnp.zeros((1, 299, 299, 3), dtype=jnp.float32)
     return InceptionV3().init(rng, dummy)

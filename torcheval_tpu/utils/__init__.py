@@ -2,6 +2,10 @@ from torcheval_tpu.utils.checkpoint import (
     load_metric_state,
     save_metric_state,
 )
+from torcheval_tpu.utils.compile_counter import (
+    CompileCounter,
+    enable_persistent_compilation_cache,
+)
 from torcheval_tpu.utils.random_data import (
     get_rand_data_binary,
     get_rand_data_binned_binary,
@@ -12,6 +16,8 @@ from torcheval_tpu.utils.random_data import (
 # Note: the reference defines get_rand_data_multilabel but forgets to export
 # it (reference utils/__init__.py:8-17); we export all four.
 __all__ = [
+    "CompileCounter",
+    "enable_persistent_compilation_cache",
     "get_rand_data_binary",
     "get_rand_data_binned_binary",
     "get_rand_data_multiclass",

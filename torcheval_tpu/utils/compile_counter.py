@@ -1,0 +1,159 @@
+"""XLA compile-count observability.
+
+The retrace-proofing work (shape bucketing, ``metrics/_bucket.py``) makes a
+claim — "a ragged eval stream compiles O(log max_batch) programs" — that is
+invisible without instrumentation: a silent recompile costs tens of ms to
+seconds but produces correct numbers. :class:`CompileCounter` turns compile
+activity into an assertable quantity by listening to JAX's monitoring
+events:
+
+- ``/jax/core/compile/backend_compile_duration`` — this event wraps
+  ``compiler.compile_or_get_cached`` (jax pxla), so one record fires per
+  PROGRAM DEMAND: a fresh backend compile or a persistent-cache load
+  alike. That makes it exactly the quantity the bucket bound limits, warm
+  or cold cache (``programs``).
+- ``/jax/compilation_cache/cache_hits`` — how many of those demands were
+  served from the persistent compilation cache; ``compiles`` (the
+  demands that actually paid the compiler) is the difference.
+
+Used by ``bench.py``'s ``variable_batch`` config and
+``tests/metrics/test_retrace_guard.py``; available to users to audit their
+own eval loops (docs/variable-shape-eval.md).
+
+:func:`enable_persistent_compilation_cache` is the companion knob: with a
+cache directory configured, the bucket set survives process restarts, so a
+re-run of the same eval pipeline pays ZERO backend compiles.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+# jax.monitoring offers registration but (in this JAX generation) no public
+# per-listener removal, so ONE module-level listener is registered lazily
+# and fans out to whichever counters are currently active.
+_ACTIVE: List["CompileCounter"] = []
+_INSTALLED = False
+
+
+def _on_duration(event: str, duration: float, **_kwargs) -> None:
+    if event == BACKEND_COMPILE_EVENT:
+        for counter in _ACTIVE:
+            counter._programs += 1
+            counter._compile_secs += duration
+
+
+def _on_event(event: str, **_kwargs) -> None:
+    if event == CACHE_HIT_EVENT:
+        for counter in _ACTIVE:
+            counter._cache_hits += 1
+
+
+def _install() -> None:
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    jax.monitoring.register_event_listener(_on_event)
+    _INSTALLED = True
+
+
+class CompileCounter:
+    """Counts XLA program demands (compiles / cache loads) within a
+    ``with`` block.
+
+    >>> from torcheval_tpu.utils import CompileCounter
+    >>> with CompileCounter() as cc:
+    ...     for batch in loader:
+    ...         metric.update(batch.scores, batch.labels)
+    >>> cc.programs          # programs demanded (compiled OR cache-loaded)
+    >>> cc.compiles          # of which actually paid the backend compiler
+    >>> cc.cache_hits        # of which replayed from the persistent cache
+    >>> cc.compile_secs      # wall seconds inside compile-or-load
+
+    Counts are process-wide (any JAX computation compiling inside the block
+    is counted), which is the point: a retrace anywhere in the update path
+    shows up here. Reentrant/nested counters each see every event.
+    """
+
+    def __init__(self) -> None:
+        self._programs = 0
+        self._cache_hits = 0
+        self._compile_secs = 0.0
+
+    # ------------------------------------------------------------- results
+
+    @property
+    def programs(self) -> int:
+        """Distinct programs demanded — fresh compiles AND persistent-cache
+        loads. The quantity the bucket bound is asserted against: a warm
+        persistent cache must not make a retrace regression invisible."""
+        return self._programs
+
+    @property
+    def compiles(self) -> int:
+        """Demands that actually paid the backend compiler."""
+        return max(0, self._programs - self._cache_hits)
+
+    @property
+    def cache_hits(self) -> int:
+        return self._cache_hits
+
+    @property
+    def compile_secs(self) -> float:
+        return self._compile_secs
+
+    def reset(self) -> None:
+        self._programs = 0
+        self._cache_hits = 0
+        self._compile_secs = 0.0
+
+    # ------------------------------------------------------------- context
+
+    def __enter__(self) -> "CompileCounter":
+        _install()
+        _ACTIVE.append(self)
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        _ACTIVE.remove(self)
+
+
+def enable_persistent_compilation_cache(
+    cache_dir: Optional[str] = None,
+    *,
+    min_compile_time_secs: float = 1.0,
+) -> str:
+    """Opt into JAX's persistent compilation cache so the bucket set
+    survives process restarts.
+
+    With shape bucketing the compiled-program set is finite
+    (O(log max_batch) per metric); persisting it means a restarted eval
+    pipeline replays every program from disk instead of re-tracing —
+    ``CompileCounter.cache_hits`` counts the replays.
+
+    Args:
+        cache_dir: cache directory. Defaults to ``$JAX_COMPILATION_CACHE_DIR``
+            or ``~/.cache/torcheval_tpu/xla_cache``. Created if missing.
+        min_compile_time_secs: only compiles at least this expensive are
+            persisted (JAX's knob; 0 persists everything, including the
+            trivial pads that are cheaper to re-trace than to read back).
+
+    Returns the cache directory in use.
+    """
+    if cache_dir is None:
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "torcheval_tpu", "xla_cache"
+        )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", float(min_compile_time_secs)
+    )
+    return cache_dir

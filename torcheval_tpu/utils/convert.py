@@ -77,6 +77,38 @@ def to_jax_float(
     return arr
 
 
+def to_host(x: TensorLike, *, dtype: Optional[jnp.dtype] = None):
+    """Coerce ``x`` to a HOST array (numpy), leaving jax.Arrays untouched.
+
+    The shape-bucketing input boundary: host inputs must stay on the host
+    until they are padded to their bucket, because any device-side pad of
+    the original ragged shape would itself compile one program per shape —
+    exactly the retrace the bucketing layer exists to kill. The padded
+    array is device-put once, by the fused update's jit dispatch.
+    """
+    if isinstance(x, jax.Array):
+        return x if dtype is None else x.astype(dtype)
+    if is_torch_tensor(x):
+        arr = x.detach().cpu().numpy()
+    else:
+        arr = np.asarray(x)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    return arr
+
+
+def to_host_float(x: TensorLike):
+    """`to_host` + the `to_jax_float` non-float -> float32 promotion."""
+    arr = to_host(x)
+    if isinstance(arr, jax.Array):
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = arr.astype(jnp.float32)
+        return arr
+    if not np.issubdtype(arr.dtype, np.floating):
+        arr = arr.astype(np.float32)
+    return arr
+
+
 @lru_cache(maxsize=512)
 def _cached_scalar_impl(value: float, dtype) -> jax.Array:
     return jnp.asarray(value, dtype=dtype)

@@ -43,6 +43,53 @@ def collective_count(compiled) -> int:
     return sum(1 for _ in _INSTR.finditer(hlo))
 
 
+def all_reduce_combiner_active() -> bool:
+    """Whether this XLA build merges same-program psums of different
+    shapes into ONE all-reduce (the combiner pass the zero-added-
+    collectives design rides on; see sharded.py).
+
+    True on real TPU toolchains; some CPU XLA builds skip the pass, in
+    which case the structural pins skip rather than asserting a
+    toolchain-dependent instruction count. Probed once per process with a
+    minimal two-psum program, independent of any metric code.
+    """
+    global _COMBINER_ACTIVE
+    if _COMBINER_ACTIVE is None:
+        from functools import partial
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        try:
+            from jax import shard_map
+        except ImportError:  # pre-0.4.38 jax
+            from jax.experimental.shard_map import shard_map
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            return False
+        mesh = Mesh(np.array(devs[:2]), ("dp",))
+
+        @jax.jit
+        @partial(shard_map, mesh=mesh, in_specs=(P("dp"),), out_specs=(P(), P()))
+        def two_psums(x):
+            return (
+                jax.lax.psum(jnp.sum(x), "dp"),
+                jax.lax.psum(x * 2.0, "dp"),
+            )
+
+        compiled = compile_fully_optimized(
+            two_psums.lower(jnp.zeros((2, 8), jnp.float32))
+        )
+        _COMBINER_ACTIVE = collective_count(compiled) == 1
+    return _COMBINER_ACTIVE
+
+
+_COMBINER_ACTIVE = None
+
+
 def compile_fully_optimized(lowered):
     """Compile a ``jax.stages.Lowered`` at full backend optimization
     regardless of process-wide XLA_FLAGS.

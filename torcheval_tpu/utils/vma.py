@@ -22,14 +22,27 @@ from typing import Any, Tuple
 import jax
 from jax import lax
 
+# vma typing landed after jax 0.4.x; older shard_map has no varying-axes
+# bookkeeping, so on those versions both helpers reduce to no-ops (there is
+# no carry-type mismatch to repair when nothing is tracked).
+_HAS_VMA = hasattr(jax, "typeof") and hasattr(lax, "pcast")
+
+
+def _leaf_vma(leaf: Any) -> Tuple[str, ...]:
+    try:
+        return tuple(jax.typeof(leaf).vma)
+    except Exception:
+        return ()
+
 
 def union_vary_axes(*values: Any, axis_name: str) -> Tuple[str, ...]:
     """The union of every leaf's varying manual axes plus ``axis_name``,
     in first-seen order."""
     axes = []
-    for value in values:
-        for leaf in jax.tree_util.tree_leaves(value):
-            axes.extend(jax.typeof(leaf).vma)
+    if _HAS_VMA:
+        for value in values:
+            for leaf in jax.tree_util.tree_leaves(value):
+                axes.extend(_leaf_vma(leaf))
     axes.append(axis_name)
     return tuple(dict.fromkeys(axes))
 
@@ -37,5 +50,7 @@ def union_vary_axes(*values: Any, axis_name: str) -> Tuple[str, ...]:
 def pcast_varying(x: jax.Array, vary_axes: Tuple[str, ...]) -> jax.Array:
     """Mark ``x`` varying over the axes in ``vary_axes`` it does not
     already vary over (``lax.pcast`` rejects re-marking a varying axis)."""
-    missing = tuple(a for a in vary_axes if a not in jax.typeof(x).vma)
+    if not _HAS_VMA:
+        return x
+    missing = tuple(a for a in vary_axes if a not in _leaf_vma(x))
     return lax.pcast(x, missing, to="varying") if missing else x
