@@ -501,6 +501,153 @@ def run_variable_batch():
     }
 
 
+def run_sync_degraded():
+    """Config 7: happy-path overhead of the fault-tolerance sync layer.
+
+    ISSUE 2 acceptance: wrapping a process group in
+    ``resilience.ResilientGroup`` (deadline armed, retries budgeted,
+    quorum degradation configured) must cost ≈0 on the happy path — the
+    machinery lives AROUND the collectives, never in them. This config
+    measures ``sync_and_compute_collection`` over an in-process
+    LocalReplicaGroup world twice (plain vs wrapped, same payloads, same
+    helper, same child) and reports the overhead percentage, plus a
+    collective-count parity check at the ProcessGroup interface (the
+    same quantity tier-1 pins in test_sync_collective_counts.py).
+
+    The payload includes a buffered BinaryAUROC per replica so each sync
+    moves real bytes (pack + crc + gather + unpack), not just counter
+    scalars — the denominator a production sync actually pays.
+    """
+    import jax
+    import numpy as np
+
+    from torcheval_tpu.distributed import LocalReplicaGroup, ProcessGroup
+    from torcheval_tpu.metrics import (
+        BinaryAUROC,
+        MeanSquaredError,
+        MulticlassAccuracy,
+    )
+    from torcheval_tpu.metrics.toolkit import sync_and_compute_collection
+    from torcheval_tpu.resilience import ResilientGroup
+
+    devices = jax.local_devices()
+    world = min(4, len(devices))
+    rng = np.random.default_rng(0)
+
+    def build_replicas():
+        replicas = []
+        for rank in range(world):
+            acc = MulticlassAccuracy()
+            acc.update(
+                np.float32(rng.uniform(size=(256, 16))),
+                rng.integers(0, 16, size=256),
+            )
+            mse = MeanSquaredError()
+            mse.update(
+                np.float32(rng.normal(size=256)),
+                np.float32(rng.normal(size=256)),
+            )
+            auroc = BinaryAUROC()
+            scores = np.float32(rng.uniform(size=65536))
+            auroc.update(scores, (rng.random(65536) < scores).astype(np.float32))
+            replicas.append({"acc": acc, "mse": mse, "auroc": auroc})
+        return replicas
+
+    class _Counting(ProcessGroup):
+        def __init__(self, inner):
+            self.inner, self.gathers = inner, 0
+
+        @property
+        def world_size(self):
+            return self.inner.world_size
+
+        @property
+        def rank(self):
+            return self.inner.rank
+
+        def unwrap(self):
+            return self.inner.unwrap()
+
+        def allgather_object(self, obj):
+            self.gathers += 1
+            return self.inner.allgather_object(obj)
+
+        def allgather_array(self, x):
+            self.gathers += 1
+            return self.inner.allgather_array(x)
+
+    group = LocalReplicaGroup(devices[:world])
+    resilient = ResilientGroup(
+        group, timeout=30.0, retries=2, policy="quorum"
+    )
+
+    # collective parity (one shot, counted at the group interface)
+    replicas = build_replicas()
+    plain_counter = _Counting(LocalReplicaGroup(devices[:world]))
+    sync_and_compute_collection(replicas, plain_counter)
+    resil_counter = _Counting(LocalReplicaGroup(devices[:world]))
+    sync_and_compute_collection(
+        replicas, ResilientGroup(resil_counter, timeout=30.0, policy="quorum")
+    )
+    payload_bytes = sum(
+        np.asarray(v).nbytes
+        for coll in replicas
+        for m in coll.values()
+        for v in jax.tree_util.tree_leaves(m.state_dict())
+    )
+
+    def body_plain():
+        sync_and_compute_collection(replicas, group)
+
+    def body_resilient():
+        sync_and_compute_collection(replicas, resilient)
+
+    # INTERLEAVED min-of-pairs: alternate single syncs and keep each arm's
+    # MINIMUM wall time. Min, not mean (same rationale as _min_us): this
+    # attests the intrinsic cost of the resilience machinery, and on a
+    # shared box every error source (co-load, GC, scheduler) only ever
+    # ADDS time — a windowed mean fabricated ±15-25% "overhead" either
+    # direction in rehearsals depending on where the load bursts landed.
+    body_plain(), body_resilient()  # warm (compile + first merge-prep)
+    best = {"plain": float("inf"), "resilient": float("inf")}
+    arms = (("plain", body_plain), ("resilient", body_resilient))
+    deadline = time.perf_counter() + 14.0
+    pairs = 0
+    while pairs < 60 and time.perf_counter() < deadline:
+        # swap the within-pair order every iteration: a periodic co-load
+        # burst (GC, scheduler tick) that always lands on the same slot
+        # would otherwise bias one arm
+        for which, fn in arms if pairs % 2 == 0 else arms[::-1]:
+            start = time.perf_counter()
+            fn()
+            best[which] = min(best[which], time.perf_counter() - start)
+        pairs += 1
+    best_plain, best_resil = best["plain"], best["resilient"]
+    plain_sps = 1.0 / best_plain
+    resil_sps = 1.0 / best_resil
+    overhead_pct = (best_resil / best_plain - 1.0) * 100.0
+
+    return {
+        "metric": (
+            f"ResilientGroup happy-path sync overhead "
+            f"({world}-replica collection, deadline+quorum armed)"
+        ),
+        "value": round(overhead_pct, 2),
+        "unit": "% overhead vs plain sync (lower is better)",
+        "lower_is_better": True,
+        "syncs_per_s_plain": round(plain_sps, 1),
+        "syncs_per_s_resilient": round(resil_sps, 1),
+        "world": world,
+        "payload_bytes_per_sync": int(payload_bytes),
+        "collectives_plain": plain_counter.gathers,
+        "collectives_resilient": resil_counter.gathers,
+        "collectives_equal": plain_counter.gathers == resil_counter.gathers,
+        # acceptance: ≈0 — guarded at 5% to absorb shared-box timing noise
+        "overhead_within_5pct": overhead_pct <= 5.0,
+        "health": resilient.health.as_dict(),
+    }
+
+
 def run_probe():
     """Tiny op on the default backend — proves the platform is claimable."""
     import jax
@@ -1095,6 +1242,7 @@ CONFIGS = {
     "fid": (run_fid, "ref_fid"),
     "kernels": (run_kernels, None),  # per-backend attestation, no ref number
     "variable_batch": (run_variable_batch, None),  # retrace-proofing audit
+    "sync_degraded": (run_sync_degraded, None),  # fault-tolerance audit
 }
 
 _NO_REF_NOTES = {
@@ -1102,6 +1250,11 @@ _NO_REF_NOTES = {
     "variable_batch": (
         "retrace-proofing audit — the reference retraces per shape by "
         "design, so the comparison is our own fixed-shape number"
+    ),
+    "sync_degraded": (
+        "fault-tolerance happy-path audit — the reference has no "
+        "resilient sync layer, so the comparison is our own plain-sync "
+        "number"
     ),
 }
 

@@ -505,6 +505,16 @@ def build_cases() -> Dict[str, Case]:
     return cases
 
 
+def build_rank_replicas(name: str, world: int):
+    """Per-rank replicas of one registry case, each fed its rank's
+    deterministic updates — the in-process stand-in for ``world`` spawned
+    ranks. Shared by the multihost workers and the fault-injection suite
+    (tests/metrics/test_fault_injection.py), whose quorum-determinism
+    checks need the same rank-asymmetric data the wire tests use."""
+    factory, gen = build_cases()[name]
+    return [run_case(factory(), gen, rank) for rank in range(world)]
+
+
 def run_case(metric, gen, rank: int):
     """Apply rank's updates to a fresh metric instance."""
     import jax.numpy as jnp

@@ -1,0 +1,688 @@
+"""Deterministic fault-injection suite for the resilient sync path.
+
+Proves every ``ResilientGroup`` degradation policy does what it claims
+(ISSUE 2 acceptance):
+
+- with one injected dead rank, ``sync_and_compute`` under ``quorum``
+  returns within the configured deadline with the surviving ranks' merged
+  value and a populated ``SyncHealth``;
+- under ``raise`` it raises a typed ``SyncTimeoutError`` instead of
+  hanging;
+- the happy path adds ZERO extra collectives (also pinned from the
+  collective-count side by ``test_sync_collective_counts.py``);
+- the quorum merge is a deterministic function of the surviving-rank
+  subset alone: the same survivors produce bit-identical merged state no
+  matter WHICH collective attempt lost the rank.
+
+All faults are scripted through ``utils.test_utils.FaultInjectionGroup``
+(seeded, call-indexed — no wall-clock nondeterminism decides what fails).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from tests.metrics._sync_matrix import build_rank_replicas
+from torcheval_tpu import config
+from torcheval_tpu.distributed import LocalReplicaGroup, ProcessGroup
+from torcheval_tpu.metrics import MulticlassAccuracy
+from torcheval_tpu.metrics.toolkit import (
+    get_synced_metric,
+    sync_and_compute,
+)
+from torcheval_tpu.resilience import (
+    PartialGatherError,
+    ResilientGroup,
+    SyncIntegrityError,
+    SyncTimeoutError,
+)
+from torcheval_tpu.utils.test_utils import FaultInjectionGroup, FaultSpec
+
+WORLD = 3
+
+
+@pytest.fixture(autouse=True)
+def _drain_abandoned_collectives():
+    """The in-flight fence is process-global (by design — it must survive
+    group objects): drain this test's abandoned stragglers so they cannot
+    fence the NEXT test's collectives."""
+    yield
+    from torcheval_tpu import resilience
+
+    assert not resilience._still_in_flight(5.0), (
+        "an abandoned collective outlived its test"
+    )
+
+
+def _local_group(world: int = WORLD) -> LocalReplicaGroup:
+    devices = jax.local_devices()
+    assert len(devices) >= world, "conftest provides 8 virtual CPU devices"
+    return LocalReplicaGroup(devices[:world])
+
+
+def _replicas(name: str = "MulticlassAccuracy", world: int = WORLD):
+    return build_rank_replicas(name, world)
+
+
+def _merge_oracle(replicas, ranks):
+    """Reference merge of the given surviving ranks, no wire involved."""
+    survivors = [copy.deepcopy(replicas[r]) for r in ranks]
+    return survivors[0].merge_state(survivors[1:])
+
+
+# --------------------------------------------------------------- happy path
+
+
+class _CountingGroup(ProcessGroup):
+    """Two fake ranks, both holding this process's payload; counts calls."""
+
+    def __init__(self):
+        self.object_gathers = 0
+        self.array_gathers = 0
+
+    @property
+    def world_size(self):
+        return 2
+
+    @property
+    def rank(self):
+        return 0
+
+    def allgather_object(self, obj):
+        self.object_gathers += 1
+        return [obj, copy.deepcopy(obj)]
+
+    def allgather_array(self, x):
+        self.array_gathers += 1
+        x = np.asarray(x)
+        return [x, x.copy()]
+
+
+def test_happy_path_zero_extra_collectives_and_same_value():
+    metric = MulticlassAccuracy()
+    metric.update(
+        np.float32(np.random.default_rng(0).uniform(size=(8, 4))),
+        np.random.default_rng(1).integers(0, 4, size=8),
+    )
+
+    plain = _CountingGroup()
+    want = sync_and_compute(copy.deepcopy(metric), plain)
+
+    counting = _CountingGroup()
+    group = ResilientGroup(counting, timeout=5.0, retries=2, policy="quorum")
+    got = sync_and_compute(copy.deepcopy(metric), group)
+
+    # identical collective budget at the ProcessGroup interface
+    assert counting.object_gathers == plain.object_gathers == 1
+    assert counting.array_gathers == plain.array_gathers <= 1
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    # health: fully participating, nothing degraded
+    assert group.health.full_syncs == 1
+    assert group.health.degraded_syncs == 0
+    assert group.health.participating_ranks == (0, 1)
+    assert group.health.last_good_sync is not None
+
+
+def test_happy_path_local_replicas_unchanged_by_wrapping():
+    replicas = _replicas()
+    want = sync_and_compute([copy.deepcopy(m) for m in replicas], _local_group())
+    group = ResilientGroup(
+        FaultInjectionGroup(_local_group()),  # no faults scripted
+        timeout=5.0,
+        policy="quorum",
+    )
+    got = sync_and_compute([copy.deepcopy(m) for m in replicas], group)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    assert group.health.participating_ranks == tuple(range(WORLD))
+
+
+# ------------------------------------------------------------ dead rank
+
+
+def test_quorum_merges_surviving_ranks_within_deadline():
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(_local_group(), dead_ranks={1})
+    group = ResilientGroup(
+        chaos, timeout=2.0, retries=1, policy="quorum", backoff_base=0.0
+    )
+    start = time.monotonic()
+    synced = get_synced_metric([copy.deepcopy(m) for m in replicas], group)
+    elapsed = time.monotonic() - start
+    assert elapsed < 10.0, "degradation must be bounded, not a hang"
+
+    want = _merge_oracle(replicas, [0, 2]).compute()
+    np.testing.assert_allclose(
+        np.asarray(synced.compute()), np.asarray(want)
+    )
+    # provenance names exactly the contributing ranks
+    assert synced.sync_provenance.ranks == (0, 2)
+    assert synced.sync_provenance.degraded
+    assert synced.sync_provenance.policy == "quorum"
+    # health populated
+    assert group.health.partial_gathers >= 1
+    assert group.health.degraded_syncs == 1
+    assert group.health.participating_ranks == (0, 2)
+    assert group.health.last_good_sync is None  # never a full sync
+
+
+def test_raise_policy_dead_rank_is_typed_not_a_hang():
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(_local_group(), dead_ranks={1})
+    group = ResilientGroup(
+        chaos, timeout=2.0, retries=1, policy="raise", backoff_base=0.0
+    )
+    start = time.monotonic()
+    with pytest.raises(SyncTimeoutError):
+        sync_and_compute([copy.deepcopy(m) for m in replicas], group)
+    assert time.monotonic() - start < 10.0
+
+
+def test_raise_policy_slow_peer_times_out_typed():
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(
+        _local_group(),
+        faults=[FaultSpec(call=0, kind="delay", seconds=0.5, times=99)],
+    )
+    group = ResilientGroup(
+        chaos, timeout=0.05, retries=1, policy="raise", backoff_base=0.0
+    )
+    start = time.monotonic()
+    with pytest.raises(SyncTimeoutError):
+        sync_and_compute([copy.deepcopy(m) for m in replicas], group)
+    assert time.monotonic() - start < 5.0
+    assert group.health.timeouts == 2  # first attempt + one retry
+    # a timed-out collective is NEVER reissued while still in flight
+    # (reissuing would desynchronize the rank-wide collective order):
+    # the retry extended the wait on the ONE issued collective
+    assert chaos.calls == 1
+
+
+def test_late_completion_harvested_instead_of_reissued():
+    """A collective that misses the deadline but completes during the
+    retry wait is harvested — full participation, exactly one collective
+    issued per exchange."""
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(
+        _local_group(),
+        # both collectives of the sync run slow, but finish well inside
+        # the retry's extended wait (backoff + another deadline)
+        faults=[FaultSpec(call=0, kind="delay", seconds=0.15, times=2)],
+    )
+    group = ResilientGroup(
+        chaos, timeout=0.05, retries=2, policy="raise", backoff_base=0.1
+    )
+    want = sync_and_compute(
+        [copy.deepcopy(m) for m in replicas], _local_group()
+    )
+    got = sync_and_compute([copy.deepcopy(m) for m in replicas], group)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    assert group.health.timeouts >= 1
+    assert chaos.calls == 2  # one per exchange, no reissue
+
+
+def test_auto_wrapped_syncs_do_not_leak_worker_threads():
+    """Config-driven wrapping builds a fresh ResilientGroup per toolkit
+    call; the deadline worker is process-shared, so repeated syncs must
+    not accumulate threads."""
+    import threading
+
+    def worker_count():
+        return sum(
+            t.name.startswith("torcheval-sync") for t in threading.enumerate()
+        )
+
+    replicas = _replicas()
+    before = worker_count()  # stragglers poisoned by earlier delay tests
+    with config.sync_resilience(timeout=5.0, degradation="quorum"):
+        for _ in range(25):
+            sync_and_compute(
+                [copy.deepcopy(m) for m in replicas], _local_group()
+            )
+    assert worker_count() - before <= 1, (
+        f"worker threads leaked: {before} -> {worker_count()}"
+    )
+
+
+def test_in_flight_collective_fences_the_next_one():
+    """After a collective is abandoned mid-flight, NO new collective is
+    issued on that group until the stuck one completes — issuing would
+    desynchronize the rank-wide collective order. The fenced collective
+    degrades bounded; once the straggler lands, syncs resume in full."""
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(
+        _local_group(),
+        faults=[FaultSpec(call=0, kind="delay", seconds=0.6, times=1)],
+    )
+    group = ResilientGroup(
+        chaos, timeout=0.05, retries=0, policy="local", backoff_base=0.0
+    )
+    synced = get_synced_metric([copy.deepcopy(m) for m in replicas], group)
+    assert synced.sync_provenance.ranks == (0,)
+    # the payload gather was FENCED, never issued, while the metadata
+    # gather was still in flight on its abandoned worker
+    assert chaos.calls == 1
+    time.sleep(0.7)  # let the straggler land
+    synced2 = get_synced_metric([copy.deepcopy(m) for m in replicas], group)
+    assert synced2.sync_provenance.ranks == tuple(range(WORLD))
+    assert chaos.calls == 3  # both collectives of the second sync issued
+
+
+def test_timed_out_worker_threads_are_daemons():
+    """Abandoned workers stuck in a hung collective must not block
+    interpreter exit (they are daemon threads, and nothing registers an
+    atexit join over them)."""
+    import threading
+
+    chaos = FaultInjectionGroup(
+        _local_group(),
+        faults=[FaultSpec(call=0, kind="delay", seconds=0.3, times=1)],
+    )
+    group = ResilientGroup(
+        chaos, timeout=0.02, retries=0, policy="local", backoff_base=0.0
+    )
+    # times out, degrades to local-only participation
+    _, ranks = group.allgather_object_with_ranks(["a", "b", "c"])
+    assert ranks == [0]
+    stuck = [
+        t for t in threading.enumerate() if t.name.startswith("torcheval-sync")
+    ]
+    assert stuck, "worker thread should exist"
+    assert all(t.daemon for t in stuck)
+
+
+def test_local_policy_falls_back_to_own_state_flagged_stale():
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(_local_group(), dead_ranks={1})
+    group = ResilientGroup(
+        chaos, timeout=2.0, retries=0, policy="local", backoff_base=0.0
+    )
+    synced = get_synced_metric([copy.deepcopy(m) for m in replicas], group)
+    np.testing.assert_allclose(
+        np.asarray(synced.compute()),
+        np.asarray(copy.deepcopy(replicas[0]).compute()),
+    )
+    assert synced.sync_provenance.ranks == (0,)
+    assert synced.sync_provenance.degraded
+
+
+def test_quorum_not_met_raises():
+    replicas = _replicas("MulticlassAccuracy", 4)
+    chaos = FaultInjectionGroup(_local_group(4), dead_ranks={1, 2, 3})
+    group = ResilientGroup(
+        chaos, timeout=2.0, retries=0, policy="quorum", quorum=0.75,
+        backoff_base=0.0,
+    )
+    with pytest.raises(SyncTimeoutError, match="quorum"):
+        sync_and_compute([copy.deepcopy(m) for m in replicas], group)
+
+
+# ------------------------------------------------------- transient + retry
+
+
+def test_transient_fault_is_retried_to_full_participation():
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(
+        _local_group(),
+        faults=[FaultSpec(call=0, kind="transient", times=1)],
+    )
+    group = ResilientGroup(
+        chaos, timeout=5.0, retries=2, policy="raise", backoff_base=0.0
+    )
+    want = sync_and_compute([copy.deepcopy(m) for m in replicas], _local_group())
+    got = sync_and_compute([copy.deepcopy(m) for m in replicas], group)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    assert group.health.transient_errors == 1
+    assert group.health.retries == 1
+    assert group.health.full_syncs == 1
+    assert group.health.participating_ranks == tuple(range(WORLD))
+
+
+def test_transient_drop_recovers_on_retry():
+    """A drop that clears after one attempt (times=1) costs a retry, not a
+    degradation — full participation is restored."""
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(
+        _local_group(),
+        faults=[FaultSpec(call=0, kind="drop", rank=2, times=1)],
+    )
+    group = ResilientGroup(
+        chaos, timeout=5.0, retries=2, policy="raise", backoff_base=0.0
+    )
+    synced = get_synced_metric([copy.deepcopy(m) for m in replicas], group)
+    assert synced.sync_provenance.ranks == tuple(range(WORLD))
+    assert not synced.sync_provenance.degraded
+    assert group.health.partial_gathers == 1
+
+
+# ------------------------------------------------------------- corruption
+
+
+def test_corrupt_payload_dropped_under_quorum():
+    replicas = _replicas()
+    # call 0 is the metadata object gather, call 1 the byte payload gather
+    chaos = FaultInjectionGroup(
+        _local_group(),
+        faults=[FaultSpec(call=1, kind="corrupt", rank=1)],
+    )
+    group = ResilientGroup(
+        chaos, timeout=5.0, retries=0, policy="quorum", backoff_base=0.0
+    )
+    synced = get_synced_metric([copy.deepcopy(m) for m in replicas], group)
+    assert synced.sync_provenance.ranks == (0, 2)
+    want = _merge_oracle(replicas, [0, 2]).compute()
+    np.testing.assert_allclose(np.asarray(synced.compute()), np.asarray(want))
+    assert group.health.corrupt_payloads == 1
+
+
+def test_corrupt_payload_raises_under_raise_policy():
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(
+        _local_group(),
+        faults=[FaultSpec(call=1, kind="corrupt", rank=1)],
+    )
+    group = ResilientGroup(
+        chaos, timeout=5.0, retries=0, policy="raise", backoff_base=0.0
+    )
+    with pytest.raises(SyncIntegrityError, match="checksum"):
+        sync_and_compute([copy.deepcopy(m) for m in replicas], group)
+
+
+def test_duplicate_payload_is_observable():
+    """The duplicate fault swaps rank 1's payload for rank 0's: the merge
+    then double-counts rank 0 — proving the harness really rewires the
+    payload path (and that crc+size metadata travels WITH the payload, so
+    a consistent duplicate is indistinguishable from the real thing, as on
+    a real wire)."""
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(
+        _local_group(),
+        faults=[
+            FaultSpec(call=0, kind="duplicate", rank=1, src=0),
+            FaultSpec(call=1, kind="duplicate", rank=1, src=0),
+        ],
+    )
+    group = ResilientGroup(chaos, timeout=5.0, retries=0, policy="raise")
+    got = sync_and_compute([copy.deepcopy(m) for m in replicas], group)
+    doubled = _merge_oracle(replicas, [0, 0, 2]).compute()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(doubled))
+
+
+# ------------------------------------------------- determinism guarantees
+
+
+@pytest.mark.parametrize(
+    "case_name",
+    ["MulticlassAccuracy", "Sum", "BinaryAUROC", "WindowedMeanSquaredError"],
+)
+def test_quorum_merge_deterministic_across_failing_collective(case_name):
+    """Same surviving-rank subset -> bit-identical merged state, no matter
+    which collective attempt lost the rank (metadata vs payload gather)."""
+
+    def _synced_state(fault_call):
+        replicas = _replicas(case_name)
+        chaos = FaultInjectionGroup(
+            _local_group(),
+            faults=[FaultSpec(call=fault_call, kind="drop", rank=1)],
+        )
+        group = ResilientGroup(
+            chaos, timeout=5.0, retries=0, policy="quorum", backoff_base=0.0
+        )
+        synced = get_synced_metric(
+            [copy.deepcopy(m) for m in replicas], group
+        )
+        assert synced.sync_provenance.ranks == (0, 2)
+        return synced.state_dict()
+
+    state_meta_lost = _synced_state(0)  # metadata gather lost rank 1
+    state_payload_lost = _synced_state(1)  # payload gather lost rank 1
+
+    assert state_meta_lost.keys() == state_payload_lost.keys()
+    flat_a = jax.tree_util.tree_leaves(state_meta_lost)
+    flat_b = jax.tree_util.tree_leaves(state_payload_lost)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)  # bit-identical
+
+
+def test_backoff_schedule_is_seed_deterministic():
+    mk = lambda seed: ResilientGroup(
+        _local_group(), policy="quorum", seed=seed,
+        backoff_base=0.01, backoff_max=0.08, backoff_jitter=0.5,
+    )
+    a, b, c = mk(7), mk(7), mk(8)
+    sched_a = [a._next_backoff(k) for k in range(1, 6)]
+    sched_b = [b._next_backoff(k) for k in range(1, 6)]
+    sched_c = [c._next_backoff(k) for k in range(1, 6)]
+    assert sched_a == sched_b
+    assert sched_a != sched_c
+    for k, delay in enumerate(sched_a, start=1):
+        base = min(0.01 * 2 ** (k - 1), 0.08)
+        assert base <= delay <= base * 1.5  # jitter in [0, 0.5]
+
+
+def test_fault_injection_group_is_deterministic_replay():
+    """Two identical chaos+resilience stacks over identical replicas give
+    identical provenance, health counters, and merged value."""
+
+    def run():
+        replicas = _replicas()
+        chaos = FaultInjectionGroup(
+            _local_group(),
+            faults=[FaultSpec(call=0, kind="transient", times=1)],
+            dead_ranks={2},
+            seed=3,
+        )
+        group = ResilientGroup(
+            chaos, timeout=5.0, retries=2, policy="quorum", backoff_base=0.0,
+            seed=3,
+        )
+        synced = get_synced_metric(
+            [copy.deepcopy(m) for m in replicas], group
+        )
+        return (
+            np.asarray(synced.compute()),
+            synced.sync_provenance,
+            group.health.as_dict(),
+            chaos.calls,
+        )
+
+    value_a, prov_a, health_a, calls_a = run()
+    value_b, prov_b, health_b, calls_b = run()
+    np.testing.assert_array_equal(value_a, value_b)
+    assert prov_a == prov_b
+    health_a.pop("last_good_sync"), health_b.pop("last_good_sync")
+    assert health_a == health_b
+    assert calls_a == calls_b
+
+
+# ----------------------------------------------------------- misc contracts
+
+
+def test_partial_gather_propagates_without_resilience():
+    """The chaos wrapper alone (no ResilientGroup) surfaces peer loss as
+    the typed PartialGatherError carrying the survivors' payloads."""
+    chaos = FaultInjectionGroup(_local_group(), dead_ranks={1})
+    with pytest.raises(PartialGatherError) as err:
+        chaos.allgather_object(["a", "b", "c"])
+    assert sorted(err.value.values) == [0, 2]
+    assert err.value.values[2] == "c"
+
+
+def test_on_failure_overrides_policy_per_call():
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(_local_group(), dead_ranks={1})
+    group = ResilientGroup(
+        chaos, timeout=2.0, retries=0, policy="raise", backoff_base=0.0
+    )
+    with pytest.raises(SyncTimeoutError):
+        sync_and_compute([copy.deepcopy(m) for m in replicas], group)
+    # same group, per-call quorum override; health is shared
+    synced = get_synced_metric(
+        [copy.deepcopy(m) for m in replicas], group, on_failure="quorum"
+    )
+    assert synced.sync_provenance.ranks == (0, 2)
+    assert group.health.degraded_syncs == 1
+
+
+def test_config_knobs_wrap_default_path(monkeypatch):
+    """A configured degradation policy wraps plain groups automatically —
+    callers keep the reference API and still get bounded failure."""
+    replicas = _replicas()
+    chaos = FaultInjectionGroup(_local_group(), dead_ranks={1})
+    with config.sync_resilience(timeout=2.0, retries=0, degradation="quorum"):
+        synced = get_synced_metric([copy.deepcopy(m) for m in replicas], chaos)
+    assert synced.sync_provenance.ranks == (0, 2)
+    want = _merge_oracle(replicas, [0, 2]).compute()
+    np.testing.assert_allclose(np.asarray(synced.compute()), np.asarray(want))
+
+
+def test_resilient_group_rejects_bad_policy_and_quorum():
+    with pytest.raises(ValueError, match="policy"):
+        ResilientGroup(_local_group(), policy="retry-forever")
+    with pytest.raises(ValueError, match="quorum"):
+        ResilientGroup(_local_group(), quorum=0.0)
+
+
+def test_zero_timeout_rejected_everywhere():
+    """timeout=0 would silently DISABLE the deadline (run-inline path) —
+    the un-bounded hang the knob exists to prevent; it must be rejected,
+    not accepted with inverted semantics."""
+    for bad in (0.0, -1.0, float("nan")):
+        with pytest.raises(ValueError, match="positive finite"):
+            ResilientGroup(_local_group(), timeout=bad)
+        with pytest.raises(ValueError, match="positive finite"):
+            config.set_sync_timeout(bad)
+
+
+def test_plain_allgather_refuses_partial_results():
+    """The inherited allgather contract is one payload per rank IN RANK
+    ORDER; after degradation the plain entry points raise instead of
+    silently mis-attributing ranks (rank-aware callers use _with_ranks)."""
+    chaos = FaultInjectionGroup(_local_group(), dead_ranks={1})
+    group = ResilientGroup(
+        chaos, timeout=2.0, retries=0, policy="quorum", backoff_base=0.0
+    )
+    with pytest.raises(SyncTimeoutError, match="with_ranks"):
+        group.allgather_object(["a", "b", "c"])
+    values, ranks = group.allgather_object_with_ranks(["a", "b", "c"])
+    assert ranks == [0, 2] and values == ["a", "c"]
+
+
+def test_world_of_one_carries_full_provenance():
+    """The world_size==1 fast path must honor the documented provenance
+    surface (code branching on .sync_provenance.degraded must not crash
+    in the smallest deployment)."""
+    from torcheval_tpu.distributed import SingleProcessGroup
+
+    m = _replicas(world=1)[0]
+    synced = get_synced_metric(m, SingleProcessGroup())
+    assert synced.sync_provenance.ranks == (0,)
+    assert synced.sync_provenance.world_size == 1
+    assert not synced.sync_provenance.degraded
+
+
+def test_sync_resilience_context_does_not_leak_on_bad_knob():
+    """A validation error on a later knob must not leak earlier knobs
+    past the context."""
+    before = config.sync_timeout()
+    with pytest.raises(ValueError, match="policy"):
+        with config.sync_resilience(timeout=99.0, degradation="quorom"):
+            pass  # never entered
+    assert config.sync_timeout() == before
+
+
+def test_with_policy_keeps_shared_health_policy():
+    """A per-call on_failure override shares the group's SyncHealth but
+    must not rewrite its reported policy."""
+    group = ResilientGroup(_local_group(), policy="raise")
+    sibling = group.with_policy("local")
+    assert sibling.health is group.health
+    assert sibling.policy == "local"
+    assert group.health.policy == "raise"  # the creator's, unclobbered
+
+
+def test_degrading_policy_arms_default_deadline():
+    """A degrading policy without an explicit timeout must still bound a
+    dead-host wait: on a plain group degradation only fires on timeout,
+    so policy != raise arms DEFAULT_DEGRADING_TIMEOUT automatically."""
+    from torcheval_tpu.resilience import DEFAULT_DEGRADING_TIMEOUT
+
+    group = ResilientGroup(_local_group(), policy="quorum")  # no timeout
+    assert group.timeout == DEFAULT_DEGRADING_TIMEOUT
+    # raise policy keeps the reference wait-forever default
+    assert ResilientGroup(_local_group(), policy="raise").timeout is None
+
+
+def test_late_completion_reclaims_worker_thread():
+    """A deadline miss whose collective lands LATE must not leak its
+    worker: the thread is reinstated (or stopped) once the straggler
+    completes, so repeated slow-but-completing syncs stay at one worker."""
+    import threading
+
+    from torcheval_tpu import resilience
+
+    def worker_count():
+        return sum(
+            t.name.startswith("torcheval-sync") and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    replicas = _replicas()
+    assert not resilience._still_in_flight(5.0)  # drain prior stragglers
+    before = worker_count()
+    for _ in range(3):  # each sync: miss deadline, harvest late
+        chaos = FaultInjectionGroup(
+            _local_group(),
+            faults=[FaultSpec(call=0, kind="delay", seconds=0.15, times=2)],
+        )
+        group = ResilientGroup(
+            chaos, timeout=0.05, retries=2, policy="raise", backoff_base=0.1
+        )
+        sync_and_compute([copy.deepcopy(m) for m in replicas], group)
+    assert not resilience._still_in_flight(5.0)
+    time.sleep(0.1)  # stopped surplus workers exit their loops
+    assert worker_count() - before <= 1, "late-completion workers leaked"
+
+
+def test_config_driven_health_reports_effective_policy():
+    """default_sync_health() must report the policy actually in effect
+    for config-driven syncs, not its construction-time default."""
+    from torcheval_tpu.resilience import default_sync_health
+
+    replicas = _replicas()
+    with config.sync_resilience(timeout=5.0, degradation="quorum"):
+        sync_and_compute([copy.deepcopy(m) for m in replicas], _local_group())
+    assert default_sync_health().policy == "quorum"
+
+
+def test_config_driven_syncs_accumulate_default_health():
+    """Auto-wrapped groups live one call each; their counters must land in
+    the process-wide default_sync_health() or the documented observability
+    surface is unreachable in config-driven mode."""
+    from torcheval_tpu.resilience import default_sync_health
+
+    replicas = _replicas()
+    before = default_sync_health().attempts
+    with config.sync_resilience(timeout=5.0, degradation="quorum"):
+        for _ in range(3):
+            sync_and_compute(
+                [copy.deepcopy(m) for m in replicas], _local_group()
+            )
+    grew = default_sync_health().attempts - before
+    assert grew >= 6  # >= 2 collectives per sync, 3 syncs, accumulated
+
+
+def test_retries_env_knob_alone_triggers_wrapping():
+    """Setting only sync_retries still routes syncs through a
+    ResilientGroup (the knob must not be silently inert)."""
+    with config.sync_resilience(retries=5):
+        assert config.sync_resilience_configured()
+    assert not config.sync_resilience_configured()

@@ -228,3 +228,87 @@ def test_retrieval_precision_empty_target_err():
     m.update(A(np.float32([0.3, 0.9, 0.1])), A(np.float32([0.0, 0.0, 0.0])))
     with pytest.raises(ValueError, match=r"no positive value found"):
         m.compute()
+
+
+# ---------------------------------- config.validate_inputs NaN/Inf guard
+# ISSUE 2 satellite: an off/warn/raise policy with a finite-check hook at
+# the Metric.update front door, exercised on the accuracy + MSE families.
+
+from torcheval_tpu import config  # noqa: E402
+from torcheval_tpu.metrics import (  # noqa: E402
+    BinaryAccuracy,
+    MeanSquaredError,
+    MulticlassAccuracy,
+    MultilabelAccuracy,
+    R2Score,
+)
+
+
+def _nan_update_cases():
+    nan_scores = np.float32([[0.9, np.nan], [0.2, 0.8]])
+    inf_pred = np.float32([1.0, np.inf, 0.5, 0.2])
+    tgt = np.float32([1.0, 0.0, 0.5, 0.2])
+    return [
+        ("MulticlassAccuracy", MulticlassAccuracy,
+         (A(nan_scores), A(np.asarray([0, 1])))),
+        ("BinaryAccuracy", BinaryAccuracy,
+         (A(inf_pred), A(tgt))),
+        ("MultilabelAccuracy", MultilabelAccuracy,
+         (A(np.float32([[0.1, np.inf], [0.8, 0.9]])),
+          A(np.float32([[0, 1], [1, 1]])))),
+        ("MeanSquaredError", MeanSquaredError, (A(inf_pred), A(tgt))),
+        ("R2Score", R2Score,
+         (A(np.float32([1.0, np.nan, 0.5, 0.2])), A(tgt))),
+    ]
+
+
+@pytest.mark.parametrize(
+    "case", _nan_update_cases(), ids=[c[0] for c in _nan_update_cases()]
+)
+def test_validate_inputs_raise_policy(case):
+    _, cls, args = case
+    with config.validate_inputs("raise"):
+        with pytest.raises(ValueError, match="non-finite"):
+            cls().update(*args)
+
+
+@pytest.mark.parametrize(
+    "case", _nan_update_cases(), ids=[c[0] for c in _nan_update_cases()]
+)
+def test_validate_inputs_warn_policy_updates_anyway(case):
+    _, cls, args = case
+    metric = cls()
+    with config.validate_inputs("warn"):
+        with pytest.warns(RuntimeWarning, match="non-finite"):
+            metric.update(*args)
+    # warn observes without blocking the update (state did change)
+    assert metric.compute() is not None
+
+
+@pytest.mark.parametrize(
+    "case", _nan_update_cases(), ids=[c[0] for c in _nan_update_cases()]
+)
+def test_validate_inputs_default_off(case):
+    _, cls, args = case
+    cls().update(*args)  # no error, no warning machinery in the hot path
+
+
+def test_validate_inputs_finite_batches_pass_under_raise():
+    with config.validate_inputs("raise"):
+        m = MulticlassAccuracy()
+        m.update(A(np.float32([[0.9, 0.1], [0.2, 0.8]])), A(np.asarray([0, 1])))
+        mse = MeanSquaredError()
+        mse.update(A(np.float32([1.0, 2.0])), A(np.float32([1.5, 2.5])))
+    np.testing.assert_allclose(np.asarray(m.compute()), 1.0)
+
+
+def test_validate_inputs_integer_inputs_exempt():
+    # integer targets can't hold NaN/Inf; the guard must not touch them
+    with config.validate_inputs("raise"):
+        m = MulticlassAccuracy()
+        m.update(A(np.float32([[0.9, 0.1]])), A(np.asarray([0])))
+
+
+def test_validate_inputs_policy_name_checked():
+    with pytest.raises(ValueError, match="policy"):
+        config.set_validate_inputs("explode")

@@ -122,6 +122,37 @@ def test_process_group_calls_constant_in_collection_size(n_metrics):
     )
 
 
+@pytest.mark.parametrize("n_metrics", [1, 12])
+def test_resilient_wrapper_adds_zero_collectives(n_metrics):
+    """ISSUE 2 acceptance: the fault-tolerance layer's happy path must not
+    change the collective budget — a ResilientGroup-wrapped sync issues
+    EXACTLY the same gathers as the bare group (deadline + degradation
+    machinery live around the collectives, never in them; partial-
+    participation metadata and the payload crc ride the metadata exchange
+    the protocol already pays for)."""
+    from torcheval_tpu.resilience import ResilientGroup
+
+    coll = _collection(n_metrics)
+    _feed(coll)
+    bare = CountingGroup()
+    want = sync_and_compute_collection(
+        {k: copy.deepcopy(m) for k, m in coll.items()}, bare
+    )
+
+    counting = CountingGroup()
+    wrapped = ResilientGroup(
+        counting, timeout=30.0, retries=2, policy="quorum"
+    )
+    synced = sync_and_compute_collection(coll, wrapped)
+
+    assert counting.object_gathers == bare.object_gathers == 1
+    assert counting.array_gathers == bare.array_gathers <= 1
+    assert set(synced) == set(want)
+    np.testing.assert_allclose(
+        np.asarray(synced["acc"]), np.asarray(want["acc"]), atol=1e-6
+    )
+
+
 def test_two_rank_sync_matches_per_metric_sync():
     """The batched path and K independent single-metric syncs agree."""
     from torcheval_tpu.metrics.toolkit import sync_and_compute

@@ -28,6 +28,7 @@ def _load(name):
 TPU = _load("bench_r3_tpu_20260731.json")
 CPU = _load("bench_r5_cpu_deadrelay_20260801.json")
 VB = _load("bench_r6_variable_batch_cpu_20260803.json")
+SD = _load("bench_r7_sync_degraded_cpu_20260803.json")
 
 
 def _read(path):
@@ -244,6 +245,41 @@ def test_variable_batch_table_matches_capture():
         vb["fixed_shape_updates_per_s"], rel=0.01
     )
     assert vb["ragged_within_1p5x_of_fixed"]
+
+
+def test_sync_degraded_table_matches_capture():
+    """The fault-tolerance happy-path table traces to its committed
+    capture: overhead %, collective parity, and both arms' sync rates —
+    and the capture itself must satisfy the ≈0-overhead acceptance."""
+    text = _read("docs/benchmarks.md")
+    sd = SD["sync_degraded"]
+    m = re.search(
+        r"happy-path overhead of `ResilientGroup` \| \*\*(-?[\d.]+)%\*\*",
+        text,
+    )
+    assert m, "sync_degraded overhead row not found"
+    assert float(m.group(1)) == pytest.approx(sd["value"], abs=0.005)
+    assert sd["overhead_within_5pct"], "capture violates the ≈0 acceptance"
+    m = re.search(
+        r"collectives per sync, plain vs wrapped \| (\d+) vs (\d+)", text
+    )
+    assert m, "sync_degraded collective-parity row not found"
+    assert int(m.group(1)) == sd["collectives_plain"]
+    assert int(m.group(2)) == sd["collectives_resilient"]
+    assert sd["collectives_equal"]
+    m = re.search(
+        r"plain / resilient syncs per second \| ([\d.]+) / ([\d.]+)", text
+    )
+    assert m, "sync_degraded rate row not found"
+    assert float(m.group(1)) == pytest.approx(
+        sd["syncs_per_s_plain"], rel=0.01
+    )
+    assert float(m.group(2)) == pytest.approx(
+        sd["syncs_per_s_resilient"], rel=0.01
+    )
+    # healthy happy path: no degradation events in the capture's health
+    assert sd["health"]["degraded_syncs"] == 0
+    assert sd["health"]["timeouts"] == 0
 
 
 def test_bridge_numerator_terms_match_dispatch_table():

@@ -1,0 +1,87 @@
+"""Regression: the object-gather length exchange travels as an EXPLICIT
+fixed-width wire dtype (ISSUE 2 satellite).
+
+The seed encoded the payload length as ``np.int64`` — which jax silently
+downcasts to int32 under the default x64-disabled config, so a payload of
+>= 2**31 bytes would have wrapped undetected on the wire. The encoding is
+now an explicit int32 pair (hi, lo base 2**31): no downcast is possible,
+and the full 64-bit length range survives any x64 setting.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from torcheval_tpu.distributed import (
+    LENGTH_WIRE_DTYPE,
+    MultiHostGroup,
+    decode_length,
+    encode_length,
+)
+
+
+@pytest.mark.parametrize(
+    "n",
+    [0, 1, 2**31 - 1, 2**31, 2**31 + 17, 5 << 40, 2**62 - 1],
+)
+def test_length_encoding_roundtrips_full_64bit_range(n):
+    wire = encode_length(n)
+    assert wire.dtype == np.int32  # the pinned wire dtype
+    assert wire.shape == (2,)
+    assert (wire >= 0).all()  # both halves valid as int32 under any config
+    assert decode_length(wire) == n
+
+
+def test_length_encoding_rejects_out_of_range():
+    with pytest.raises(ValueError, match="length must be"):
+        encode_length(-1)
+    with pytest.raises(ValueError, match="length must be"):
+        encode_length(2**62)
+
+
+def test_length_wire_dtype_is_int32():
+    assert LENGTH_WIRE_DTYPE is np.int32
+
+
+def test_multihost_object_gather_uses_pinned_wire_dtype(monkeypatch):
+    """What actually hits process_allgather for the length exchange must be
+    the pinned int32 wire array — an int64 here would be silently
+    downcast by XLA under default (x64-disabled) jax."""
+    from jax.experimental import multihost_utils
+
+    captured = []
+    real = multihost_utils.process_allgather
+
+    def capturing(x, *args, **kwargs):
+        captured.append(np.asarray(x))
+        return real(x, *args, **kwargs)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", capturing)
+
+    group = MultiHostGroup()
+    payload = {"metric": np.arange(100, dtype=np.float32)}
+    out = group.allgather_object(payload)
+
+    assert len(out) == jax.process_count()
+    np.testing.assert_array_equal(out[group.rank]["metric"], payload["metric"])
+    # first gather is the length exchange; it must be the int32 pair
+    lengths = captured[0]
+    assert lengths.dtype == np.int32, (
+        f"length exchange dtype drifted to {lengths.dtype}"
+    )
+    assert lengths.shape == (2,)
+    # remaining gathers carry the byte payload
+    assert all(c.dtype == np.uint8 for c in captured[1:])
+
+
+def test_simulated_downcast_would_have_corrupted_int64_lengths():
+    """Documents the failure mode the pin prevents: int32-truncating a
+    large int64 length corrupts it, while the int32-pair encoding is
+    downcast-proof by construction."""
+    big = 3 << 31
+    assert int(np.int64(big).astype(np.int32)) != big  # the old wire risk
+    wire = encode_length(big)
+    assert decode_length(wire.astype(np.int32)) == big  # already int32

@@ -2,6 +2,8 @@
 every TState kind (tensor counters, list buffers, dict states, int/float,
 windowed ring buffers)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -134,6 +136,206 @@ def test_single_vs_collection_kind_mismatch(tmp_path):
         load_metric_state(
             {"acc": MulticlassAccuracy()}, str(tmp_path / "single")
         )
+
+
+# ------------------------------------------- fault tolerance (ISSUE 2)
+
+
+def _feed_acc(m):
+    m.update(
+        jnp.asarray(RNG.random((16, 4)), jnp.float32),
+        jnp.asarray(RNG.integers(0, 4, 16)),
+    )
+    return m
+
+
+def test_corrupt_checkpoint_rejected_with_clear_error(tmp_path):
+    """Bit-flip a payload file: load must refuse with a digest error, not
+    silently restore garbage into a resumed eval."""
+    m = _feed_acc(MulticlassAccuracy())
+    path = tmp_path / "ck"
+    save_metric_state(m, str(path))
+    # corrupt the largest data file under the checkpoint tree
+    victim = max(
+        (p for p in path.rglob("*") if p.is_file()),
+        key=lambda p: p.stat().st_size,
+    )
+    blob = bytearray(victim.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    victim.write_bytes(bytes(blob))
+    with pytest.raises(RuntimeError, match="corrupt"):
+        load_metric_state(MulticlassAccuracy(), str(path))
+
+
+def test_truncated_checkpoint_rejected(tmp_path):
+    m = _feed_acc(MulticlassAccuracy())
+    path = tmp_path / "ck"
+    save_metric_state(m, str(path))
+    victim = max(
+        (p for p in path.rglob("*") if p.is_file()),
+        key=lambda p: p.stat().st_size,
+    )
+    victim.write_bytes(victim.read_bytes()[: victim.stat().st_size // 2])
+    with pytest.raises(RuntimeError, match="corrupt"):
+        load_metric_state(MulticlassAccuracy(), str(path))
+
+
+def test_missing_file_is_a_clear_error_not_garbage(tmp_path):
+    m = _feed_acc(MulticlassAccuracy())
+    path = tmp_path / "ck"
+    save_metric_state(m, str(path))
+    victim = max(
+        (p for p in path.rglob("*") if p.is_file()),
+        key=lambda p: p.stat().st_size,
+    )
+    victim.unlink()
+    with pytest.raises(RuntimeError, match="corrupt or truncated|corrupt"):
+        load_metric_state(MulticlassAccuracy(), str(path))
+
+
+def test_save_is_atomic_under_mid_write_failure(tmp_path, monkeypatch):
+    """A save that dies mid-write leaves the PREVIOUS checkpoint intact at
+    the published path (write-temp-then-rename)."""
+    import torcheval_tpu.utils.checkpoint as ckpt
+
+    first = _feed_acc(MulticlassAccuracy())
+    path = tmp_path / "ck"
+    save_metric_state(first, str(path))
+
+    class _ExplodingCheckpointer:
+        def save(self, p, tree, force=False):
+            # simulate dying AFTER partially writing the temp location
+            os.makedirs(p, exist_ok=True)
+            with open(os.path.join(p, "partial"), "w") as f:
+                f.write("torn")
+            raise RuntimeError("disk full")
+
+    monkeypatch.setattr(ckpt, "_checkpointer", lambda: _ExplodingCheckpointer())
+    second = _feed_acc(MulticlassAccuracy())
+    with pytest.raises(RuntimeError, match="disk full"):
+        save_metric_state(second, str(path))
+    monkeypatch.undo()
+
+    restored = load_metric_state(MulticlassAccuracy(), str(path))
+    assert_result_close(restored.compute(), first.compute())
+
+
+def test_overwrite_save_roundtrips(tmp_path):
+    """Re-saving over an existing checkpoint path replaces it atomically."""
+    path = tmp_path / "ck"
+    save_metric_state(_feed_acc(MulticlassAccuracy()), str(path))
+    newer = _feed_acc(MulticlassAccuracy())
+    save_metric_state(newer, str(path))
+    restored = load_metric_state(MulticlassAccuracy(), str(path))
+    assert_result_close(restored.compute(), newer.compute())
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["ck"], (
+        "temp/aside write locations must not leak"
+    )
+
+
+def test_legacy_checkpoint_without_digest_still_loads(tmp_path, monkeypatch):
+    """Checkpoints written before the digest existed (or by older code)
+    restore without an integrity check rather than erroring."""
+    import torcheval_tpu.utils.checkpoint as ckpt
+
+    m = _feed_acc(MulticlassAccuracy())
+    path = tmp_path / "ck"
+    monkeypatch.setattr(ckpt, "_digest", lambda tree: "00" * 32)
+    save_metric_state(m, str(path))
+    monkeypatch.undo()
+    # strip the digest the way a legacy writer would never have added it
+    tree = ckpt._checkpointer().restore(str(path))
+    tree.pop("__digest__")
+    ckpt._checkpointer().save(str(path), tree, force=True)
+    restored = load_metric_state(MulticlassAccuracy(), str(path))
+    assert_result_close(restored.compute(), m.compute())
+
+
+def test_missing_checkpoint_is_file_not_found(tmp_path):
+    """A checkpoint that was never written is FileNotFoundError — resume
+    harnesses branch on missing (start fresh) vs corrupt (alert)."""
+    with pytest.raises(FileNotFoundError, match="no metric checkpoint"):
+        load_metric_state(MulticlassAccuracy(), str(tmp_path / "never"))
+
+
+def test_overwrite_failure_rolls_previous_checkpoint_back(
+    tmp_path, monkeypatch
+):
+    """If the final swap fails, the previous checkpoint is restored at the
+    published path (it is renamed aside, never deleted first)."""
+    import torcheval_tpu.utils.checkpoint as ckpt
+
+    first = _feed_acc(MulticlassAccuracy())
+    path = tmp_path / "ck"
+    save_metric_state(first, str(path))
+
+    real_rename = os.rename
+
+    def failing_rename(src, dst):
+        if src.endswith(".tmp"):
+            raise OSError("simulated rename failure")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(ckpt.os, "rename", failing_rename)
+    with pytest.raises(OSError, match="simulated"):
+        save_metric_state(_feed_acc(MulticlassAccuracy()), str(path))
+    monkeypatch.undo()
+
+    restored = load_metric_state(MulticlassAccuracy(), str(path))
+    assert_result_close(restored.compute(), first.compute())
+
+
+def test_save_after_interrupted_save_preserves_aside_snapshot(
+    tmp_path, monkeypatch
+):
+    """After a crash left the last good snapshot only at '<path>.old', a
+    NEW save that itself fails must not destroy it: the aside copy is
+    recovered to the published name before anything clobbers it."""
+    import torcheval_tpu.utils.checkpoint as ckpt
+
+    m = _feed_acc(MulticlassAccuracy())
+    path = tmp_path / "ck"
+    save_metric_state(m, str(path))
+    os.rename(str(path), str(path) + ".old")  # crashed-swap disk state
+
+    real_rename = os.rename
+
+    def failing_rename(src, dst):
+        if src.endswith(".tmp"):
+            raise OSError("simulated rename failure")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(ckpt.os, "rename", failing_rename)
+    with pytest.raises(OSError, match="simulated"):
+        save_metric_state(_feed_acc(MulticlassAccuracy()), str(path))
+    monkeypatch.undo()
+
+    restored = load_metric_state(MulticlassAccuracy(), str(path))
+    assert_result_close(restored.compute(), m.compute())
+
+
+def test_crash_between_swap_renames_recovers_from_aside(tmp_path):
+    """A crash AFTER the old checkpoint was renamed aside but BEFORE the
+    new one landed leaves only '<path>.old'; load recovers it instead of
+    reporting 'no checkpoint' (which would silently discard eval state)."""
+    m = _feed_acc(MulticlassAccuracy())
+    path = tmp_path / "ck"
+    save_metric_state(m, str(path))
+    # simulate the crash window: published path gone, aside copy present
+    os.rename(str(path), str(path) + ".old")
+    restored = load_metric_state(MulticlassAccuracy(), str(path))
+    assert_result_close(restored.compute(), m.compute())
+    assert os.path.exists(str(path))  # recovered back to the published name
+
+
+def test_empty_buffer_digest_roundtrip(tmp_path):
+    """The empty-array encoding (Orbax refuses zero-size arrays) must
+    digest identically on save and load."""
+    m = BinaryAUROC()  # fresh: empty (0,)-shaped lazy buffers
+    path = tmp_path / "ck"
+    save_metric_state(m, str(path))
+    restored = load_metric_state(BinaryAUROC(), str(path))
+    assert restored.num_samples == 0
 
 
 def test_window_cursor_survives_resume(tmp_path):

@@ -19,6 +19,16 @@ of every state, so a whole ragged stream compiles O(log max_batch) programs
 total (see ``torcheval_tpu/metrics/_bucket.py`` and
 docs/variable-shape-eval.md).
 
+The third knob family is *sync resilience* (docs/fault-tolerance.md):
+``sync_timeout`` / ``sync_retries`` / ``sync_degradation`` / ``sync_quorum``
+set the process-wide defaults for ``resilience.ResilientGroup``, and the
+toolkit auto-wraps the default process group when any of them departs from
+the all-ranks-alive default (so a dead host degrades a metrics sync instead
+of hanging the pod). The fourth is ``validate_inputs`` (``off``/``warn``/
+``raise``): a NaN/Inf finite-check at the ``Metric.update`` front door —
+value-level, so it forces a device readback per update and defaults off,
+same budget reasoning as ``debug_validation``.
+
 There is deliberately no config-file/flag system beyond these: the reference
 uses plain constructor kwargs (SURVEY.md section 5.6) and so do we.
 """
@@ -27,7 +37,7 @@ from __future__ import annotations
 
 import os
 from contextlib import contextmanager
-from typing import Iterator
+from typing import Iterator, Optional
 
 _debug_validation: bool = os.environ.get("TORCHEVAL_TPU_DEBUG", "").lower() in (
     "1",
@@ -76,6 +86,244 @@ def shape_bucketing_enabled() -> bool:
 def set_shape_bucketing(enabled: bool) -> None:
     global _shape_bucketing
     _shape_bucketing = bool(enabled)
+
+
+# --------------------------------------------------------- sync resilience
+
+_SYNC_POLICIES = ("raise", "local", "quorum")
+
+
+def _env_invalid(name: str, raw: str, why: str, default) -> None:
+    import warnings
+
+    warnings.warn(
+        f"ignoring env {name}={raw!r}: {why}; using default {default!r}",
+        RuntimeWarning,
+    )
+
+
+def _check_timeout(seconds: float) -> float:
+    import math
+
+    seconds = float(seconds)
+    if not math.isfinite(seconds) or seconds <= 0:
+        # a 0/negative/NaN deadline would silently disable the deadline —
+        # re-creating the unbounded hang the knob exists to prevent
+        raise ValueError(
+            f"sync_timeout must be a positive finite number of seconds "
+            f"(or None for no deadline), got {seconds}"
+        )
+    return seconds
+
+
+def _env_timeout(name: str) -> Optional[float]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return _check_timeout(float(raw))
+    except ValueError:
+        _env_invalid(name, raw, "not a positive finite number", None)
+        return None
+
+
+def _env_int(name: str, default: int, minimum: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        _env_invalid(name, raw, "not an integer", default)
+        return default
+    if value < minimum:
+        _env_invalid(name, raw, f"must be >= {minimum}", default)
+        return default
+    return value
+
+
+def _env_choice(name: str, default: str, choices) -> str:
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    if raw not in choices:
+        # env values ride the SAME validation as the setters: a typo must
+        # not silently flip semantics (e.g. an unknown validate_inputs
+        # policy being treated as "warn" when the user meant "raise")
+        _env_invalid(name, raw, f"must be one of {choices}", default)
+        return default
+    return raw
+
+
+def _env_fraction(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        _env_invalid(name, raw, "not a number", default)
+        return default
+    if not 0.0 < value <= 1.0:
+        _env_invalid(name, raw, "must be a fraction in (0, 1]", default)
+        return default
+    return value
+
+
+_sync_timeout: Optional[float] = _env_timeout("TORCHEVAL_TPU_SYNC_TIMEOUT")
+_SYNC_RETRIES_DEFAULT = 2
+_sync_retries: int = _env_int(
+    "TORCHEVAL_TPU_SYNC_RETRIES", _SYNC_RETRIES_DEFAULT, minimum=0
+)
+_sync_degradation: str = _env_choice(
+    "TORCHEVAL_TPU_SYNC_DEGRADATION", "raise", _SYNC_POLICIES
+)
+_sync_quorum: float = _env_fraction("TORCHEVAL_TPU_SYNC_QUORUM", 0.5)
+
+
+def sync_timeout() -> Optional[float]:
+    """Per-collective metric-sync deadline in seconds (``None`` = wait
+    forever, the reference's behavior). Env ``TORCHEVAL_TPU_SYNC_TIMEOUT``."""
+    return _sync_timeout
+
+
+def set_sync_timeout(seconds: Optional[float]) -> None:
+    global _sync_timeout
+    _sync_timeout = None if seconds is None else _check_timeout(seconds)
+
+
+def sync_retries() -> int:
+    """Extra attempts after a transient sync failure or timeout (default 2).
+    Env ``TORCHEVAL_TPU_SYNC_RETRIES``."""
+    return _sync_retries
+
+
+def set_sync_retries(retries: int) -> None:
+    global _sync_retries
+    if retries < 0:
+        raise ValueError(f"sync_retries must be >= 0, got {retries}")
+    _sync_retries = int(retries)
+
+
+def sync_degradation() -> str:
+    """What a failed sync degrades to: ``"raise"`` (typed error — default),
+    ``"local"`` (unsynced local state, flagged stale), or ``"quorum"``
+    (merge the surviving ranks). Env ``TORCHEVAL_TPU_SYNC_DEGRADATION``."""
+    return _sync_degradation
+
+
+def check_sync_policy(policy: str) -> str:
+    """The ONE validator for degradation-policy names, shared by the
+    setter here and ``resilience.ResilientGroup``."""
+    if policy not in _SYNC_POLICIES:
+        raise ValueError(
+            f"sync degradation policy must be one of {_SYNC_POLICIES}, "
+            f"got {policy!r}"
+        )
+    return policy
+
+
+def set_sync_degradation(policy: str) -> None:
+    global _sync_degradation
+    _sync_degradation = check_sync_policy(policy)
+
+
+def sync_quorum() -> float:
+    """Minimum participating fraction of world size for the ``quorum``
+    policy (default 0.5). Env ``TORCHEVAL_TPU_SYNC_QUORUM``."""
+    return _sync_quorum
+
+
+def set_sync_quorum(fraction: float) -> None:
+    global _sync_quorum
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(f"quorum must be in (0, 1], got {fraction}")
+    _sync_quorum = float(fraction)
+
+
+def sync_resilience_configured() -> bool:
+    """True when a behavior-bearing sync-resilience knob departs from the
+    all-ranks-alive default — the toolkit then wraps the process group in
+    a ``ResilientGroup`` automatically. (``sync_quorum`` alone does not
+    trigger wrapping: it only tunes the ``quorum`` policy.)"""
+    return (
+        _sync_timeout is not None
+        or _sync_degradation != "raise"
+        or _sync_retries != _SYNC_RETRIES_DEFAULT
+    )
+
+
+@contextmanager
+def sync_resilience(
+    *,
+    timeout: Optional[float] = None,
+    retries: Optional[int] = None,
+    degradation: Optional[str] = None,
+    quorum: Optional[float] = None,
+) -> Iterator[None]:
+    """Context manager scoping the sync-resilience defaults.
+
+    >>> with sync_resilience(timeout=30.0, degradation="quorum"):
+    ...     value = sync_and_compute(metric)   # survives a dead host
+    """
+    global _sync_timeout, _sync_retries, _sync_degradation, _sync_quorum
+    prev = (_sync_timeout, _sync_retries, _sync_degradation, _sync_quorum)
+    try:
+        # setters run INSIDE the try: a validation error on a later knob
+        # must not leak the earlier ones past the context
+        if timeout is not None:
+            set_sync_timeout(timeout)
+        if retries is not None:
+            set_sync_retries(retries)
+        if degradation is not None:
+            set_sync_degradation(degradation)
+        if quorum is not None:
+            set_sync_quorum(quorum)
+        yield
+    finally:
+        (_sync_timeout, _sync_retries, _sync_degradation, _sync_quorum) = prev
+
+
+# -------------------------------------------------------- input guardrails
+
+_VALIDATE_POLICIES = ("off", "warn", "raise")
+
+_validate_inputs: str = _env_choice(
+    "TORCHEVAL_TPU_VALIDATE_INPUTS", "off", _VALIDATE_POLICIES
+)
+
+
+def validate_inputs_policy() -> str:
+    """NaN/Inf guard at the ``Metric.update`` front door: ``"off"``
+    (default — value checks force a device readback), ``"warn"``, or
+    ``"raise"``. Env ``TORCHEVAL_TPU_VALIDATE_INPUTS``."""
+    return _validate_inputs
+
+
+def set_validate_inputs(policy: str) -> None:
+    global _validate_inputs
+    if policy not in _VALIDATE_POLICIES:
+        raise ValueError(
+            f"validate_inputs policy must be one of {_VALIDATE_POLICIES}, "
+            f"got {policy!r}"
+        )
+    _validate_inputs = policy
+
+
+@contextmanager
+def validate_inputs(policy: str = "raise") -> Iterator[None]:
+    """Context manager enabling the NaN/Inf input guard.
+
+    >>> with validate_inputs():
+    ...     metric.update(inputs, targets)   # raises on NaN/Inf inputs
+    """
+    global _validate_inputs
+    prev = _validate_inputs
+    set_validate_inputs(policy)
+    try:
+        yield
+    finally:
+        _validate_inputs = prev
 
 
 @contextmanager

@@ -23,11 +23,42 @@ reference implements with dummy-tensor padding (reference synclib.py:159-178).
 from __future__ import annotations
 
 import pickle
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# The length exchange preceding a padded object gather travels as an EXPLICIT
+# fixed-width wire dtype: int64 would be silently downcast to int32 by XLA
+# under the default x64-disabled jax config, so payload sizes >= 2**31 bytes
+# would corrupt undetected. Instead a 64-bit length is split into two int32
+# halves (base 2**31, both non-negative), which survives any x64 setting.
+# Pinned by tests/test_wire_dtype.py.
+LENGTH_WIRE_DTYPE = np.int32
+_LENGTH_BASE = 1 << 31
+
+
+def encode_length(n: int) -> np.ndarray:
+    """Byte length -> shape-(2,) int32 wire array (hi, lo base ``2**31``).
+
+    Covers lengths up to ``2**62 - 1`` (4 EiB) — both halves stay valid
+    non-negative int32 values under any jax x64 setting.
+    """
+    if not 0 <= n < _LENGTH_BASE * _LENGTH_BASE:
+        raise ValueError(
+            f"length must be in [0, 2**62), got {n} (non-negative "
+            "int32-pair wire encoding)"
+        )
+    return np.asarray(
+        [n // _LENGTH_BASE, n % _LENGTH_BASE], dtype=LENGTH_WIRE_DTYPE
+    )
+
+
+def decode_length(arr: Any) -> int:
+    """Inverse of :func:`encode_length` for one rank's (hi, lo) pair."""
+    hi, lo = (int(v) for v in np.asarray(arr).reshape(-1))
+    return hi * _LENGTH_BASE + lo
 
 
 class ProcessGroup:
@@ -48,6 +79,29 @@ class ProcessGroup:
     def allgather_object(self, obj: Any) -> List[Any]:
         """Gather one picklable object from every rank, in rank order."""
         raise NotImplementedError
+
+    # ------------------------------------------------- resilience extensions
+
+    def unwrap(self) -> "ProcessGroup":
+        """The innermost group behind any decorators (``ResilientGroup``,
+        ``FaultInjectionGroup``). Plain groups return themselves; the sync
+        layer dispatches on ``unwrap()`` so wrapping never changes which
+        protocol (local-replica vs multi-host) is spoken."""
+        return self
+
+    def allgather_object_with_ranks(
+        self, obj: Any
+    ) -> Tuple[List[Any], List[int]]:
+        """Gather plus the participating-rank list. Plain groups always
+        return every rank; ``torcheval_tpu.resilience.ResilientGroup``
+        overrides this to report partial participation after degradation."""
+        return self.allgather_object(obj), list(range(self.world_size))
+
+    def allgather_array_with_ranks(
+        self, x: Any
+    ) -> Tuple[List[np.ndarray], List[int]]:
+        """Array-gather twin of :meth:`allgather_object_with_ranks`."""
+        return self.allgather_array(x), list(range(self.world_size))
 
 
 class SingleProcessGroup(ProcessGroup):
@@ -120,16 +174,28 @@ class MultiHostGroup(ProcessGroup):
     def allgather_array(self, x) -> List[np.ndarray]:
         from jax.experimental import multihost_utils
 
-        stacked = multihost_utils.process_allgather(np.asarray(x), tiled=False)
+        arr = np.asarray(x)
+        # normalize the gather layout the same way allgather_object does:
+        # some jax versions return (world*n,) concatenated instead of
+        # (world, n) stacked (and world=1 gathers come back unstacked)
+        stacked = np.asarray(
+            multihost_utils.process_allgather(arr, tiled=False)
+        ).reshape((self._world,) + arr.shape)
         return [np.asarray(s) for s in stacked]
 
     def allgather_object(self, obj) -> List[Any]:
         from jax.experimental import multihost_utils
 
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-        length = np.asarray([payload.size], dtype=np.int64)
-        lengths = multihost_utils.process_allgather(length, tiled=False).reshape(-1)
-        max_len = int(lengths.max())
+        # explicit int32-pair wire encoding: see encode_length (an int64
+        # here would be silently downcast to int32 under x64-disabled jax)
+        lengths = np.asarray(
+            multihost_utils.process_allgather(
+                encode_length(payload.size), tiled=False
+            )
+        ).reshape(self._world, 2)
+        sizes = [decode_length(lengths[r]) for r in range(self._world)]
+        max_len = max(sizes)
         padded = np.zeros(max_len, dtype=np.uint8)
         padded[: payload.size] = payload
         # some jax versions return the gather concatenated (world*max_len,)
@@ -138,7 +204,7 @@ class MultiHostGroup(ProcessGroup):
             multihost_utils.process_allgather(padded, tiled=False)
         ).reshape(self._world, max_len)
         return [
-            pickle.loads(gathered[r, : int(lengths[r])].tobytes())
+            pickle.loads(gathered[r, : sizes[r]].tobytes())
             for r in range(self._world)
         ]
 

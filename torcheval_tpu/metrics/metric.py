@@ -237,16 +237,20 @@ class Metric(Generic[TComputeReturn], ABC):
         accepts jax/numpy/torch/scalars, H2D-copies only when needed. Under
         shape bucketing, bucket-aware metrics keep host inputs on the host
         (the fused dispatch device-puts the padded array once).
+
+        Under ``config.validate_inputs`` (off by default — the finite check
+        forces a device readback) every float input is guarded against
+        NaN/Inf here, the one front door all updates share.
         """
         if (
             self._bucketed_update
             and config.shape_bucketing_enabled()
             and not isinstance(x, jax.Array)
         ):
-            return to_host(x, dtype=dtype)
+            return self._guard_finite(to_host(x, dtype=dtype))
         # jax.Array inputs keep the documented `input.to(self.device)` hop
         # even under bucketing (the device pad then runs on self.device)
-        return to_jax(x, dtype=dtype, device=self._device)
+        return self._guard_finite(to_jax(x, dtype=dtype, device=self._device))
 
     def _input_float(self, x: Any) -> jax.Array:
         if (
@@ -254,8 +258,43 @@ class Metric(Generic[TComputeReturn], ABC):
             and config.shape_bucketing_enabled()
             and not isinstance(x, jax.Array)
         ):
-            return to_host_float(x)
-        return to_jax_float(x, device=self._device)
+            return self._guard_finite(to_host_float(x))
+        return self._guard_finite(to_jax_float(x, device=self._device))
+
+    def _guard_finite(self, x: Any) -> Any:
+        """NaN/Inf guardrail (``config.validate_inputs``: off/warn/raise).
+
+        Value-level, so it syncs the device — which is exactly why it is a
+        policy knob and not always-on (<1% step-overhead budget, module
+        docstring of ``torcheval_tpu.config``). Integer and bool inputs
+        pass through untouched.
+        """
+        policy = config.validate_inputs_policy()
+        if policy == "off":
+            return x
+        if isinstance(x, jax.Array):
+            if not jnp.issubdtype(x.dtype, jnp.inexact):
+                return x
+            finite = bool(jnp.all(jnp.isfinite(x)))
+        else:
+            import numpy as np
+
+            arr = np.asarray(x)
+            if not np.issubdtype(arr.dtype, np.inexact):
+                return x
+            finite = bool(np.all(np.isfinite(arr)))
+        if not finite:
+            message = (
+                f"{type(self).__name__}.update received non-finite values "
+                "(NaN/Inf) in a float input "
+                "(config.validate_inputs guardrail)"
+            )
+            if policy == "raise":
+                raise ValueError(message)
+            import warnings
+
+            warnings.warn(message, RuntimeWarning, stacklevel=4)
+        return x
 
     # ------------------------------------------------------- abstract surface
 

@@ -28,11 +28,23 @@ loop cost ~3-4 per state. Pinned by
 
 All functions take a ``ProcessGroup``; under ``LocalReplicaGroup`` the
 "collectives" are in-process list operations, under ``MultiHostGroup`` they
-ride ICI/DCN.
+ride ICI/DCN. Both paths issue their gathers THROUGH the group object, so
+decorators (``resilience.ResilientGroup`` deadlines/degradation,
+``utils.test_utils.FaultInjectionGroup`` chaos) intercept every exchange.
+
+Fault tolerance (docs/fault-tolerance.md): the gathers use the
+``allgather_*_with_ranks`` protocol, so a degraded group can hand back a
+SUBSET of ranks. ``sync_states`` intersects the participants of the two
+collectives (metadata and payload may lose different ranks), verifies each
+surviving payload against a crc32 that rides the metadata exchange (zero
+extra collectives), and returns a :class:`SyncedStates` list whose
+``.ranks`` records exactly which ranks contributed — the merge downstream
+is then a deterministic function of the surviving-rank subset alone.
 """
 
 from __future__ import annotations
 
+import zlib
 from typing import Any, Dict, List, Tuple
 
 import jax
@@ -40,9 +52,33 @@ import numpy as np
 
 from torcheval_tpu.distributed import LocalReplicaGroup, ProcessGroup
 from torcheval_tpu.metrics.metric import TState
+from torcheval_tpu.resilience import (
+    SyncIntegrityError,
+    SyncTimeoutError,
+    quorum_count,
+)
 
 # A "metric states" payload: {metric_name: {state_name: TState}}
 MetricStates = Dict[str, Dict[str, TState]]
+
+
+class SyncedStates(List[MetricStates]):
+    """Per-rank gathered states plus partial-participation metadata.
+
+    A plain list of the surviving ranks' states (ascending rank order) —
+    existing callers iterate it unchanged — with:
+
+    - ``ranks``: the ranks whose states are present, aligned with the list;
+    - ``world_size``: the group's full world size;
+    - ``degraded``: True when some rank did not contribute.
+    """
+
+    ranks: Tuple[int, ...] = ()
+    world_size: int = 0
+
+    @property
+    def degraded(self) -> bool:
+        return len(self.ranks) < self.world_size
 
 
 def metrics_traversal_order(metric_states: MetricStates) -> List[Tuple[str, str]]:
@@ -134,7 +170,7 @@ def _unpack_rank_states(
 
 def sync_states(
     metric_states: Any, process_group: ProcessGroup
-) -> List[MetricStates]:
+) -> SyncedStates:
     """Gather every rank's metric states to every rank.
 
     Under ``MultiHostGroup``: ``metric_states`` is this process's
@@ -142,38 +178,117 @@ def sync_states(
     synclib.py:216-291 semantics).
     Under ``LocalReplicaGroup``: ``metric_states`` is already the per-replica
     list ``[{metric_name: state_dict}, ...]``; returned re-assembled through
-    the identical pack/unpack protocol.
+    the identical pack/unpack protocol (the gathers are in-process list
+    operations, still issued through the group so resilience/chaos wrappers
+    see them).
 
-    Collective budget: ONE ``allgather_object`` (metadata + scalar states)
-    plus at most ONE ``allgather_array`` (padded byte payload), for ANY
-    number of metrics and states.
+    Collective budget: ONE ``allgather_object`` (metadata + scalar states +
+    payload crc32) plus at most ONE ``allgather_array`` (padded byte
+    payload), for ANY number of metrics and states.
+
+    Returns a :class:`SyncedStates`: the surviving ranks' states in
+    ascending rank order, with ``.ranks``/``.degraded`` recording partial
+    participation when the group degraded (see module docstring).
     """
-    local_mode = isinstance(process_group, LocalReplicaGroup)
+    local_mode = isinstance(process_group.unwrap(), LocalReplicaGroup)
     template = metric_states[0] if local_mode else metric_states
     order = metrics_traversal_order(template)
     world = process_group.world_size
 
     if local_mode:
         packed = [_pack_rank_states(ms, order) for ms in metric_states]
-        metas = [(meta, int(flat.size)) for meta, flat in packed]
-        bufs: List[np.ndarray] = [flat for _, flat in packed]
+        metas, meta_ranks = process_group.allgather_object_with_ranks(
+            [(meta, int(flat.size), zlib.crc32(flat)) for meta, flat in packed]
+        )
+        if all(size == 0 for _, size, _ in metas):
+            bufs = [np.zeros(0, dtype=np.uint8)] * len(metas)
+            buf_ranks = list(meta_ranks)
+        else:
+            bufs, buf_ranks = process_group.allgather_array_with_ranks(
+                [flat for _, flat in packed]
+            )
     else:
         meta, flat = _pack_rank_states(metric_states, order)
         # ONE metadata exchange tells every rank every payload's framing
-        # (and every rank's byte total, fixing the static gather shape)
-        metas = process_group.allgather_object((meta, int(flat.size)))
-        max_bytes = max(size for _, size in metas)
+        # (and every rank's byte total, fixing the static gather shape);
+        # the crc32 rides it so payload integrity costs no extra exchange
+        metas, meta_ranks = process_group.allgather_object_with_ranks(
+            (meta, int(flat.size), zlib.crc32(flat))
+        )
+        max_bytes = max(size for _, size, _ in metas)
         if max_bytes == 0:
-            bufs = [np.zeros(0, dtype=np.uint8) for _ in range(world)]
+            bufs = [np.zeros(0, dtype=np.uint8)] * len(metas)
+            buf_ranks = list(meta_ranks)
         else:
             padded = np.zeros(max_bytes, dtype=np.uint8)
             padded[: flat.size] = flat
             # ONE padded payload gather carries every tensor of every state
-            bufs = process_group.allgather_array(padded)
+            bufs, buf_ranks = process_group.allgather_array_with_ranks(padded)
 
-    return [
-        _unpack_rank_states(
-            template, order, metas[rank][0], np.asarray(bufs[rank])
+    return _assemble(
+        template, order, process_group, world,
+        dict(zip(meta_ranks, metas)), dict(zip(buf_ranks, bufs)),
+    )
+
+
+def _assemble(
+    template: MetricStates,
+    order: List[Tuple[str, str]],
+    process_group: ProcessGroup,
+    world: int,
+    meta_by_rank: Dict[int, Tuple[List[_StateMeta], int, int]],
+    buf_by_rank: Dict[int, np.ndarray],
+) -> SyncedStates:
+    """Intersect the two collectives' participants, verify payload
+    integrity, enforce the quorum, and unpack the survivors."""
+    policy = getattr(process_group, "degradation_policy", "raise")
+    own = process_group.rank
+    survivors: List[int] = []
+    for rank in sorted(meta_by_rank):
+        if rank not in buf_by_rank:
+            continue  # the payload gather lost this rank after metadata
+        _, size, crc = meta_by_rank[rank]
+        buf = np.asarray(buf_by_rank[rank])
+        if zlib.crc32(buf[:size].tobytes()) != crc:
+            if hasattr(process_group, "note_corrupt"):
+                process_group.note_corrupt(rank)
+            if policy == "raise":
+                raise SyncIntegrityError(
+                    f"rank {rank}'s gathered metric-state payload failed "
+                    f"its checksum ({size} bytes); refusing to merge "
+                    "corrupt state (degradation policy 'raise')"
+                )
+            continue  # quorum/local: a corrupt rank is a lost rank
+        survivors.append(rank)
+    if policy == "local" and survivors != sorted(meta_by_rank):
+        # local policy degrades the WHOLE sync to this rank's own state the
+        # moment anything was lost, never a partial peer merge
+        survivors = [own] if own in survivors else []
+    quorum = getattr(process_group, "quorum_fraction", None)
+    if policy == "quorum" and quorum is not None:
+        needed = quorum_count(quorum, world)
+        if len(survivors) < needed:
+            raise SyncTimeoutError(
+                f"metric sync quorum not met after integrity checks: "
+                f"{len(survivors)}/{world} usable ranks, quorum requires "
+                f">= {needed}"
+            )
+    if not survivors:
+        raise SyncTimeoutError(
+            "metric sync retained no usable rank (all payloads lost or "
+            "corrupt)"
         )
-        for rank in range(world)
-    ]
+    if hasattr(process_group, "note_sync_result"):
+        process_group.note_sync_result(survivors, world)
+    out = SyncedStates(
+        _unpack_rank_states(
+            template,
+            order,
+            meta_by_rank[rank][0],
+            np.asarray(buf_by_rank[rank]),
+        )
+        for rank in survivors
+    )
+    out.ranks = tuple(survivors)
+    out.world_size = world
+    return out

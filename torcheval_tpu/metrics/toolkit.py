@@ -29,6 +29,7 @@ from typing import Any, Dict, Iterable, List, Optional, TypeVar, Union
 
 import jax
 
+from torcheval_tpu import config
 from torcheval_tpu.distributed import (
     LocalReplicaGroup,
     ProcessGroup,
@@ -36,6 +37,11 @@ from torcheval_tpu.distributed import (
 )
 from torcheval_tpu.metrics.metric import Metric, TState
 from torcheval_tpu.metrics import synclib
+from torcheval_tpu.resilience import (
+    ResilientGroup,
+    SyncProvenance,
+    default_sync_health,
+)
 
 _logger: logging.Logger = logging.getLogger(__name__)
 
@@ -62,14 +68,45 @@ TMetric = TypeVar("TMetric", bound=Metric)
 MetricOrReplicas = Union[TMetric, List[TMetric]]
 
 
-def _resolve_group(process_group: Optional[ProcessGroup]) -> ProcessGroup:
-    return process_group if process_group is not None else default_process_group()
+def _resolve_group(
+    process_group: Optional[ProcessGroup], on_failure: Optional[str] = None
+) -> ProcessGroup:
+    """Pick the group and apply the resilience policy for this call.
+
+    ``on_failure`` overrides the process-wide ``config.sync_degradation()``
+    for one entry point; either source of a non-default policy (or a
+    configured ``sync_timeout``) wraps the group in a ``ResilientGroup``
+    (docs/fault-tolerance.md). An explicitly passed ``ResilientGroup``
+    keeps its own knobs (and its accumulated ``SyncHealth``)."""
+    group = (
+        process_group if process_group is not None else default_process_group()
+    )
+    if isinstance(group, ResilientGroup):
+        return group.with_policy(on_failure) if on_failure else group
+    if on_failure is not None or config.sync_resilience_configured():
+        # the wrapper lives only for this call: its counters accumulate
+        # into the process-wide default_sync_health() so the documented
+        # observability surface stays reachable in config-driven mode
+        wrapped = ResilientGroup(
+            group, policy=on_failure, health=default_sync_health()
+        )
+        # the process-wide record reports the policy currently in effect
+        # (an explicit group's shared health keeps its creator's policy)
+        wrapped.health.policy = wrapped.policy
+        return wrapped
+    return group
+
+
+def _is_local_replica(group: ProcessGroup) -> bool:
+    # dispatch on the innermost group: resilience/chaos wrappers must not
+    # change which protocol (local-replica vs multi-host) is spoken
+    return isinstance(group.unwrap(), LocalReplicaGroup)
 
 
 def _as_replica_list(
     metric: MetricOrReplicas, group: ProcessGroup
 ) -> Optional[List[Metric]]:
-    if isinstance(group, LocalReplicaGroup):
+    if _is_local_replica(group):
         if not isinstance(metric, (list, tuple)):
             raise TypeError(
                 "With a LocalReplicaGroup, pass the per-replica list of "
@@ -87,31 +124,47 @@ def _as_replica_list(
 def sync_and_compute(
     metric: MetricOrReplicas,
     process_group: Optional[ProcessGroup] = None,
+    on_failure: Optional[str] = None,
 ) -> Any:
     """Sync state across ranks/replicas and compute on the merged state
-    (reference toolkit.py:34-67). Every rank returns the same value."""
-    synced = get_synced_metric(metric, process_group)
+    (reference toolkit.py:34-67). Every rank returns the same value.
+
+    ``on_failure`` (``"raise"`` | ``"local"`` | ``"quorum"``) overrides the
+    configured degradation policy for this call; under a degrading policy a
+    dead host costs a bounded wait instead of a hang, and the returned
+    value reflects the surviving ranks (provenance on
+    ``get_synced_metric(...).sync_provenance`` and the resilient group's
+    ``health`` — see docs/fault-tolerance.md)."""
+    synced = get_synced_metric(metric, process_group, on_failure=on_failure)
     return synced.compute()
 
 
 def sync_and_compute_collection(
     metrics: Union[Dict[str, Metric], List[Dict[str, Metric]]],
     process_group: Optional[ProcessGroup] = None,
+    on_failure: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Sync a ``{name: Metric}`` collection with ONE batched state exchange
-    (reference toolkit.py:70-107, batching note :271)."""
-    synced = get_synced_metric_collection(metrics, process_group)
+    (reference toolkit.py:70-107, batching note :271). ``on_failure``: see
+    :func:`sync_and_compute`."""
+    synced = get_synced_metric_collection(
+        metrics, process_group, on_failure=on_failure
+    )
     return {name: m.compute() for name, m in synced.items()}
 
 
 def get_synced_metric(
     metric: MetricOrReplicas,
     process_group: Optional[ProcessGroup] = None,
+    on_failure: Optional[str] = None,
 ) -> Metric:
     """Gather every rank's state and merge into a fresh metric
-    (reference toolkit.py:206-260)."""
+    (reference toolkit.py:206-260). The result carries a
+    ``sync_provenance`` (:class:`~torcheval_tpu.resilience.SyncProvenance`)
+    naming exactly which ranks contributed; ``on_failure``: see
+    :func:`sync_and_compute`."""
     synced = get_synced_metric_collection(
-        _wrap_collection(metric), process_group
+        _wrap_collection(metric), process_group, on_failure=on_failure
     )
     return synced["_metric"]
 
@@ -125,19 +178,33 @@ def _wrap_collection(metric: MetricOrReplicas):
 def get_synced_metric_collection(
     metrics: Union[Dict[str, Metric], List[Dict[str, Metric]]],
     process_group: Optional[ProcessGroup] = None,
+    on_failure: Optional[str] = None,
 ) -> Dict[str, Metric]:
     """Collection variant: every metric's states travel in one batched
-    exchange ordered by ``synclib.metrics_traversal_order``."""
-    group = _resolve_group(process_group)
+    exchange ordered by ``synclib.metrics_traversal_order``. Every merged
+    metric carries ``sync_provenance``; ``on_failure``: see
+    :func:`sync_and_compute`."""
+    group = _resolve_group(process_group, on_failure)
 
-    if group.world_size == 1 and not isinstance(group, LocalReplicaGroup):
+    if group.world_size == 1 and not _is_local_replica(group):
         _logger.warning(
             "World size is 1, and metric states are not synced; "
             "returning the input metric collection."
         )
-        return metrics if isinstance(metrics, dict) else metrics[0]
+        coll = metrics if isinstance(metrics, dict) else metrics[0]
+        # the documented provenance surface holds in the world-of-one
+        # fast path too: the single rank trivially fully participated
+        provenance = SyncProvenance(
+            ranks=(group.rank,),
+            world_size=1,
+            degraded=False,
+            policy=getattr(group, "degradation_policy", "raise"),
+        )
+        for m in coll.values():
+            m.sync_provenance = provenance
+        return coll
 
-    if isinstance(group, LocalReplicaGroup):
+    if _is_local_replica(group):
         replicas = metrics
         if not isinstance(replicas, (list, tuple)):
             raise TypeError(
@@ -163,6 +230,25 @@ def get_synced_metric_collection(
 
     per_rank_states = synclib.sync_states(payload, group)
 
+    # degraded-result provenance: which ranks actually contributed (full
+    # participation unless a ResilientGroup degraded the exchange)
+    ranks = tuple(
+        getattr(per_rank_states, "ranks", None)
+        or range(len(per_rank_states))
+    )
+    provenance = SyncProvenance(
+        ranks=ranks,
+        world_size=group.world_size,
+        degraded=len(ranks) < group.world_size,
+        policy=getattr(group, "degradation_policy", "raise"),
+    )
+    if provenance.degraded:
+        _logger.warning(
+            "Metric sync degraded: merged state reflects ranks %s of %d "
+            "(policy %r); result may be stale.",
+            list(ranks), group.world_size, provenance.policy,
+        )
+
     merged: Dict[str, Metric] = {}
     for name, base in template.items():
         rank_metrics: List[Metric] = []
@@ -174,6 +260,7 @@ def get_synced_metric_collection(
             rank_metrics.append(clone)
         target = rank_metrics[0].to(base.device)
         target.merge_state(rank_metrics[1:])
+        target.sync_provenance = provenance
         merged[name] = target
     return merged
 
@@ -199,11 +286,13 @@ def _restore_state_types(state_dict: Dict[str, Any]) -> Dict[str, TState]:
 def get_synced_state_dict(
     metric: MetricOrReplicas,
     process_group: Optional[ProcessGroup] = None,
+    on_failure: Optional[str] = None,
 ) -> Dict[str, TState]:
     """Synced metric's ``state_dict()`` (reference toolkit.py:110-145) —
-    rank-0-consistent checkpoint payload."""
-    group = _resolve_group(process_group)
-    if group.world_size == 1 and not isinstance(group, LocalReplicaGroup):
+    rank-0-consistent checkpoint payload. ``on_failure``: see
+    :func:`sync_and_compute`."""
+    group = _resolve_group(process_group, on_failure)
+    if group.world_size == 1 and not _is_local_replica(group):
         m = metric if isinstance(metric, Metric) else metric[0]
         return m.state_dict()
     return get_synced_metric(metric, group).state_dict()
@@ -212,9 +301,10 @@ def get_synced_state_dict(
 def get_synced_state_dict_collection(
     metrics: Union[Dict[str, Metric], List[Dict[str, Metric]]],
     process_group: Optional[ProcessGroup] = None,
+    on_failure: Optional[str] = None,
 ) -> Dict[str, Dict[str, TState]]:
-    group = _resolve_group(process_group)
-    if group.world_size == 1 and not isinstance(group, LocalReplicaGroup):
+    group = _resolve_group(process_group, on_failure)
+    if group.world_size == 1 and not _is_local_replica(group):
         coll = metrics if isinstance(metrics, dict) else metrics[0]
         return {name: m.state_dict() for name, m in coll.items()}
     return {

@@ -1,0 +1,647 @@
+"""Fault-tolerant metric sync: deadlines, retry/backoff, quorum degradation.
+
+The reference toolkit assumes every rank is alive: one dead or slow host
+makes every collective in a ``sync_and_compute`` hang forever (reference
+toolkit.py:206-260 has no timeout surface at all), turning a cheap metrics
+sync into a pod-wide outage. Fault-tolerant collective stacks treat peer
+loss as a *recoverable event* instead — the Prime Collective Communications
+Library (arxiv 2505.14065) degrades to the surviving peers, and EQuARX
+(arxiv 2506.17615) shows the collective layer itself is a legitimate place
+to intervene. This module brings that posture to the metric sync path:
+
+- :class:`ResilientGroup` decorates any ``ProcessGroup`` (``MultiHostGroup``,
+  ``LocalReplicaGroup``, test fakes) with **per-collective deadlines** (the
+  gather runs on a reusable worker thread; the caller's wait is bounded),
+  **retry with exponential backoff + deterministic jitter** for transient
+  failures, and a configurable **degradation policy**:
+
+  - ``"raise"``  — today's behavior, except a bounded, *typed*
+    :class:`SyncTimeoutError` instead of an unbounded hang;
+  - ``"local"``  — fall back to this rank's unsynced state; the merged
+    result is flagged stale via its sync provenance;
+  - ``"quorum"`` — merge the ranks that did respond, provided at least
+    ``quorum`` (a fraction of world size) arrived.
+
+- :class:`SyncHealth` is the observability record (attempts, retries,
+  timeouts, corrupt payloads, last good sync, participating ranks) exposed
+  on every ``ResilientGroup`` — the sync-path sibling of
+  ``utils.CompileCounter``.
+
+The happy path adds **zero extra collectives** (pinned by
+``tests/metrics/test_sync_collective_counts.py``): the wrapper forwards each
+gather exactly once, and the partial-participation metadata rides the
+metadata exchange the protocol already pays for
+(``synclib.sync_states``).
+
+Partial gathers: a fault-aware inner group (production: a PCCL-style
+collective; tests: ``utils.test_utils.FaultInjectionGroup``) signals peer
+loss by raising :class:`PartialGatherError` carrying the payloads of the
+ranks that DID respond. A plain timeout yields no partial data: the
+surviving set is then just this rank.
+
+See docs/fault-tolerance.md for the policy walkthroughs.
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from torcheval_tpu.distributed import LocalReplicaGroup, ProcessGroup
+
+__all__ = [
+    "PartialGatherError",
+    "ResilientGroup",
+    "SyncHealth",
+    "SyncIntegrityError",
+    "SyncProvenance",
+    "SyncTimeoutError",
+    "TransientSyncError",
+    "default_sync_health",
+]
+
+# A degrading policy is a promise that a dead host costs a bounded wait:
+# without a deadline a plain (non-fault-aware) group would still hang
+# forever, so groups constructed with policy != "raise" and no explicit
+# timeout get this default deadline per collective.
+DEFAULT_DEGRADING_TIMEOUT = 300.0
+
+# Health of every config-driven (auto-wrapped) sync: those wrappers are
+# constructed per toolkit call, so their counters would be unreachable and
+# reset every sync without a process-wide record to accumulate into.
+_DEFAULT_HEALTH = None
+
+
+def default_sync_health() -> "SyncHealth":
+    """The process-wide :class:`SyncHealth` accumulated by every
+    config-driven sync (toolkit calls under ``config.sync_resilience`` /
+    env knobs / ``on_failure=``, where the caller never holds the group
+    object). Explicitly constructed ``ResilientGroup``s keep their own."""
+    global _DEFAULT_HEALTH
+    if _DEFAULT_HEALTH is None:
+        _DEFAULT_HEALTH = SyncHealth()
+    return _DEFAULT_HEALTH
+
+
+class SyncTimeoutError(RuntimeError):
+    """A metric-sync collective missed its deadline (or lost too many peers
+    to satisfy the degradation policy) after all retries."""
+
+
+class TransientSyncError(RuntimeError):
+    """A retryable wire glitch (the inner group believes the next attempt
+    may succeed). ``ResilientGroup`` retries these with backoff."""
+
+
+class SyncIntegrityError(RuntimeError):
+    """A gathered payload failed its checksum (rides the metadata exchange
+    — see ``synclib.sync_states``). Raised under the ``raise`` policy;
+    degrading policies drop the corrupt rank instead."""
+
+
+class PartialGatherError(RuntimeError):
+    """A fault-aware collective completed for only a subset of ranks.
+
+    ``values`` maps rank -> that rank's payload for every rank that DID
+    respond. ``ResilientGroup`` turns this into a quorum merge (policy
+    ``"quorum"``), a local fallback (``"local"``), or a
+    :class:`SyncTimeoutError` (``"raise"``).
+
+    CONTRACT for inner groups raising this: every surviving rank must be
+    told the SAME survivor set (fault-tolerant collective stacks provide
+    this via consensus-based membership — PCCL, arxiv 2505.14065 §3).
+    Divergent per-rank survivor sets would make ranks pad the follow-up
+    payload gather to different static shapes and merge different state
+    (split-brain); this layer consumes the membership decision, it does
+    not arbitrate one.
+    """
+
+    def __init__(self, message: str, values: Dict[int, Any]) -> None:
+        super().__init__(message)
+        self.values = dict(values)
+
+
+class SyncProvenance(NamedTuple):
+    """Which ranks contributed to a synced result (attached to metrics
+    returned by ``toolkit.get_synced_metric(_collection)`` as
+    ``metric.sync_provenance``)."""
+
+    ranks: Tuple[int, ...]
+    world_size: int
+    degraded: bool  # True when ranks != all of world (result may be stale)
+    policy: str
+
+
+@dataclass
+class SyncHealth:
+    """Running observability record for one ``ResilientGroup``.
+
+    Counters accumulate over the group's lifetime; ``participating_ranks``
+    and ``last_good_sync`` reflect the most recent sync. Read it off
+    ``group.health`` next to PR 1's compile observability
+    (``utils.CompileCounter``) when deciding whether degraded metrics are
+    trustworthy.
+    """
+
+    attempts: int = 0  # collective attempts issued (retries included)
+    retries: int = 0  # attempts beyond the first, per collective
+    timeouts: int = 0  # attempts that missed the deadline
+    transient_errors: int = 0  # retryable wire glitches observed
+    partial_gathers: int = 0  # fault-aware partial completions observed
+    corrupt_payloads: int = 0  # checksum failures (synclib integrity check)
+    degraded_syncs: int = 0  # syncs that completed without full participation
+    full_syncs: int = 0  # syncs with every rank participating
+    last_good_sync: Optional[float] = None  # time.monotonic() of last full sync
+    participating_ranks: Tuple[int, ...] = ()  # most recent sync's ranks
+    world_size: int = 0
+    policy: str = "raise"
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "attempts": self.attempts,
+            "retries": self.retries,
+            "timeouts": self.timeouts,
+            "transient_errors": self.transient_errors,
+            "partial_gathers": self.partial_gathers,
+            "corrupt_payloads": self.corrupt_payloads,
+            "degraded_syncs": self.degraded_syncs,
+            "full_syncs": self.full_syncs,
+            "last_good_sync": self.last_good_sync,
+            "participating_ranks": list(self.participating_ranks),
+            "world_size": self.world_size,
+            "policy": self.policy,
+        }
+
+
+class _SyncWorker:
+    """One reusable DAEMON worker thread running collective attempts.
+
+    Deliberately not ``concurrent.futures``: its pools register an atexit
+    join of every (non-daemon) worker, so a thread still blocked inside a
+    dead host's collective would hang interpreter exit — re-creating at
+    shutdown exactly the hang the deadline exists to prevent. A daemon
+    loop thread dies with the process, and reusing it keeps the happy-path
+    cost to one queue hop (~tens of µs).
+    """
+
+    def __init__(self) -> None:
+        self._jobs: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="torcheval-sync"
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:  # stop sentinel: surplus reclaimed worker
+                return
+            fn, box, done = job
+            try:
+                box["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 — ferried to caller
+                box["error"] = e
+            done.set()
+
+    def stop(self) -> None:
+        self._jobs.put(None)
+
+    def submit(
+        self, fn: Callable[[], Any]
+    ) -> Tuple[Dict[str, Any], threading.Event]:
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+        self._jobs.put((fn, box, done))
+        return box, done
+
+
+def _harvest(box: Dict[str, Any]) -> Any:
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+# ONE process-wide worker shared by every ResilientGroup: the sync path is
+# caller-serial, and a per-group worker would leak one never-exiting daemon
+# thread per auto-wrapped toolkit call (config-driven wrapping constructs a
+# fresh group per sync). A timed-out worker is poisoned globally — its
+# thread is stuck inside the abandoned collective — and the next call
+# creates a replacement.
+_WORKER_LOCK = threading.Lock()
+_SHARED_WORKER: Optional[_SyncWorker] = None
+# abandoned attempts still in flight — (done event, its worker) —
+# PROCESS-WIDE: the collective-sequence fence must survive group objects
+# (config-driven wrapping constructs a fresh ResilientGroup per sync), so
+# it cannot live on the group
+_IN_FLIGHT: List[Tuple[threading.Event, _SyncWorker]] = []
+
+
+def _reclaim_finished() -> None:
+    """Recycle workers whose abandoned attempt has since completed: one is
+    reinstated as the shared worker, surplus ones are stopped — a
+    deadline miss whose collective lands late must not leak a thread."""
+    global _SHARED_WORKER
+    with _WORKER_LOCK:
+        pending = []
+        for done, worker in _IN_FLIGHT:
+            if not done.is_set():
+                pending.append((done, worker))
+            elif _SHARED_WORKER is None:
+                _SHARED_WORKER = worker  # idle again: back to work
+            else:
+                worker.stop()
+        _IN_FLIGHT[:] = pending
+
+
+def _get_worker() -> _SyncWorker:
+    global _SHARED_WORKER
+    _reclaim_finished()
+    with _WORKER_LOCK:
+        if _SHARED_WORKER is None:
+            _SHARED_WORKER = _SyncWorker()
+        return _SHARED_WORKER
+
+
+def _poison_worker(worker: _SyncWorker, done: threading.Event) -> None:
+    global _SHARED_WORKER
+    with _WORKER_LOCK:
+        if _SHARED_WORKER is worker:
+            _SHARED_WORKER = None
+        _IN_FLIGHT.append((done, worker))
+
+
+def _still_in_flight(budget: float) -> bool:
+    """True when any abandoned collective is STILL running after waiting
+    up to ``budget`` seconds for the stragglers to land."""
+    deadline = time.monotonic() + max(budget, 0.0)
+    _reclaim_finished()
+    with _WORKER_LOCK:
+        pending = [done for done, _ in _IN_FLIGHT]
+    stuck = False
+    for done in pending:
+        if not done.wait(max(deadline - time.monotonic(), 0.0)):
+            stuck = True
+            break
+    _reclaim_finished()
+    return stuck
+
+
+def quorum_count(fraction: float, world: int) -> int:
+    """Minimum surviving-rank count for a quorum ``fraction`` of ``world``
+    — the single definition shared by the per-collective check
+    (``ResilientGroup``) and the post-integrity-intersection check
+    (``synclib._assemble``)."""
+    return max(1, math.ceil(fraction * world))
+
+
+class ResilientGroup(ProcessGroup):
+    """Decorate any ``ProcessGroup`` with deadlines, retries, and graceful
+    degradation. See the module docstring for the policy semantics.
+
+    Args:
+        inner: the group to wrap (``MultiHostGroup``, ``LocalReplicaGroup``,
+            a test fake, or a ``FaultInjectionGroup`` chaos wrapper).
+        timeout: per-collective deadline in seconds; ``None`` (default from
+            ``config.sync_timeout()``) waits forever — the collective runs
+            inline with no worker thread.
+        retries: extra attempts after the first, for transient failures /
+            timeouts (default from ``config.sync_retries()``).
+        policy: ``"raise"`` | ``"local"`` | ``"quorum"`` (default from
+            ``config.sync_degradation()``).
+        quorum: minimum participating fraction of world size for the
+            ``"quorum"`` policy (default from ``config.sync_quorum()``).
+        backoff_base / backoff_max / backoff_jitter / seed: exponential
+            backoff schedule ``min(base * 2**k, max) * (1 + jitter * u)``
+            with ``u`` drawn from a ``random.Random(seed)`` — fully
+            deterministic for a given seed and call sequence.
+        health: share an existing :class:`SyncHealth` (used by
+            :meth:`with_policy`); a fresh one is created by default.
+
+    Examples::
+
+        >>> from torcheval_tpu.distributed import default_process_group
+        >>> from torcheval_tpu.resilience import ResilientGroup
+        >>> group = ResilientGroup(
+        ...     default_process_group(), timeout=30.0, policy="quorum"
+        ... )
+        >>> # value = sync_and_compute(metric, group)  # survives a dead host
+        >>> group.health.timeouts
+        0
+    """
+
+    def __init__(
+        self,
+        inner: ProcessGroup,
+        *,
+        timeout: Optional[float] = None,
+        retries: Optional[int] = None,
+        policy: Optional[str] = None,
+        quorum: Optional[float] = None,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
+        backoff_jitter: float = 0.5,
+        seed: int = 0,
+        health: Optional[SyncHealth] = None,
+    ) -> None:
+        from torcheval_tpu import config
+
+        self._inner = inner
+        self.timeout = (
+            config.sync_timeout()
+            if timeout is None
+            else config._check_timeout(timeout)
+        )
+        self.retries = config.sync_retries() if retries is None else int(retries)
+        policy = config.sync_degradation() if policy is None else policy
+        self.policy = config.check_sync_policy(policy)
+        if self.policy != "raise" and self.timeout is None:
+            # a degrading policy without a deadline would still hang
+            # forever on a plain group (degradation only fires on timeout
+            # / transient / partial signals) — arm the default deadline so
+            # the policy's bounded-failure promise actually holds
+            self.timeout = DEFAULT_DEGRADING_TIMEOUT
+        self.quorum = config.sync_quorum() if quorum is None else float(quorum)
+        if not 0.0 < self.quorum <= 1.0:
+            raise ValueError(
+                f"quorum must be a fraction in (0, 1], got {self.quorum}"
+            )
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.backoff_jitter = backoff_jitter
+        self.seed = seed
+        self._rng = random.Random(seed)
+        # (box, done) of a timed-out attempt still in flight on its worker
+        self._late: Optional[Tuple[Dict[str, Any], threading.Event]] = None
+        self._local_mode = isinstance(inner.unwrap(), LocalReplicaGroup)
+        if health is None:
+            health = SyncHealth()
+            health.policy = self.policy  # shared health keeps its creator's
+        self.health = health
+        self.health.world_size = self.world_size
+
+    # --------------------------------------------------------------- plumbing
+
+    @property
+    def world_size(self) -> int:
+        return self._inner.world_size
+
+    @property
+    def rank(self) -> int:
+        return self._inner.rank
+
+    def unwrap(self) -> ProcessGroup:
+        return self._inner.unwrap()
+
+    @property
+    def degradation_policy(self) -> str:
+        """Read by ``synclib.sync_states`` to decide whether a corrupt or
+        missing rank is droppable or fatal."""
+        return self.policy
+
+    @property
+    def quorum_fraction(self) -> float:
+        return self.quorum
+
+    def with_policy(self, policy: str) -> "ResilientGroup":
+        """A sibling wrapper around the same inner group and the same
+        :class:`SyncHealth`, differing only in degradation policy (used by
+        the toolkit's per-call ``on_failure=`` override)."""
+        if policy == self.policy:
+            return self
+        return ResilientGroup(
+            self._inner,
+            timeout=self.timeout,
+            retries=self.retries,
+            policy=policy,
+            quorum=self.quorum,
+            backoff_base=self.backoff_base,
+            backoff_max=self.backoff_max,
+            backoff_jitter=self.backoff_jitter,
+            seed=self.seed,
+            health=self.health,
+        )
+
+    # ------------------------------------------------------------- observers
+
+    def note_corrupt(self, rank: int) -> None:
+        """Called by ``synclib`` when rank's payload fails its checksum."""
+        with self.health._lock:
+            self.health.corrupt_payloads += 1
+
+    def note_sync_result(self, ranks: List[int], world: int) -> None:
+        """Called by ``synclib`` with the final surviving-rank set of one
+        whole state sync (after cross-collective intersection)."""
+        with self.health._lock:
+            self.health.participating_ranks = tuple(ranks)
+            if len(ranks) == world:
+                self.health.full_syncs += 1
+                self.health.last_good_sync = time.monotonic()
+            else:
+                self.health.degraded_syncs += 1
+
+    # -------------------------------------------------------------- deadline
+
+    def _bounded(self, fn: Callable[[], Any]) -> Any:
+        """Run one collective attempt under the deadline on the reusable
+        daemon worker (see :class:`_SyncWorker`). On timeout the worker is
+        abandoned — still blocked inside the collective — and the in-flight
+        attempt is stashed on ``self._late`` so the retry loop can wait for
+        its LATE completion instead of reissuing (reissuing while the first
+        is still running would desynchronize the rank-wide collective
+        order)."""
+        if self.timeout is None:
+            return fn()
+        worker = _get_worker()
+        box, done = worker.submit(fn)
+        if done.wait(self.timeout):
+            return _harvest(box)
+        self._late = (box, done)
+        _poison_worker(worker, done)  # its thread is stuck in `fn`
+        raise SyncTimeoutError(
+            f"metric sync collective missed its {self.timeout}s deadline"
+        )
+
+    def _next_backoff(self, attempt: int) -> float:
+        """Deterministic exponential backoff with jitter for retry
+        ``attempt`` (1-based)."""
+        base = min(self.backoff_base * (2 ** (attempt - 1)), self.backoff_max)
+        return base * (1.0 + self.backoff_jitter * self._rng.random())
+
+    # ------------------------------------------------------------ collectives
+
+    def _resilient(
+        self,
+        fn: Callable[[], List[Any]],
+        local_only: Callable[[], Tuple[List[Any], List[int]]],
+    ) -> Tuple[List[Any], List[int]]:
+        """Run one collective with retries, then apply the degradation
+        policy. Returns ``(payloads, participating_ranks)``, rank-aligned
+        and ascending.
+
+        A TIMED-OUT attempt is never reissued while still in flight: on a
+        real multi-host group the original collective may eventually
+        complete, and a second issue would pair off-by-one with the peers'
+        collective sequence forever after. Retry attempts after a timeout
+        instead extend the wait on the original (backoff + one more
+        deadline); only transient wire errors — where the attempt
+        definitively FAILED — reissue the collective.
+        """
+        h = self.health
+        world = self.world_size
+        partial: Optional[Dict[int, Any]] = None
+        # FENCE: a previously abandoned collective — from ANY group in
+        # this process, the fence is module-global — must complete (late)
+        # before a new collective is issued, otherwise this rank's
+        # collective sequence pairs off-by-one with its peers' forever
+        # after. Stale results are drained and discarded; while one is
+        # still running, this collective degrades WITHOUT issuing.
+        self._late = None
+        if _still_in_flight(self.timeout or 0.0):
+            with h._lock:
+                h.attempts += 1
+                h.timeouts += 1
+            return self._degrade(None, local_only)
+        for attempt in range(self.retries + 1):
+            delay = 0.0
+            if attempt:
+                with h._lock:
+                    h.retries += 1
+                delay = self._next_backoff(attempt)
+            with h._lock:
+                h.attempts += 1
+            try:
+                if self._late is not None:
+                    # wait out the in-flight original instead of reissuing
+                    box, done = self._late
+                    if not done.wait(delay + (self.timeout or 0.0)):
+                        with h._lock:
+                            h.timeouts += 1
+                        continue
+                    self._late = None
+                    result = _harvest(box)
+                else:
+                    if delay:
+                        time.sleep(delay)
+                    result = self._bounded(fn)
+            except PartialGatherError as e:
+                with h._lock:
+                    h.partial_gathers += 1
+                partial = dict(e.values)
+                # peer loss is not transient: a quorum of survivors is
+                # usable immediately, without burning the retry budget
+                if self.policy == "quorum" and len(
+                    self._with_own(partial, local_only)
+                ) >= self._quorum_count():
+                    break
+                continue
+            except TransientSyncError:
+                with h._lock:
+                    h.transient_errors += 1
+                continue
+            except SyncTimeoutError:
+                with h._lock:
+                    h.timeouts += 1
+                continue
+            return list(result), list(range(world))
+        return self._degrade(partial, local_only)
+
+    def _quorum_count(self) -> int:
+        return quorum_count(self.quorum, self.world_size)
+
+    def _with_own(
+        self,
+        partial: Optional[Dict[int, Any]],
+        local_only: Callable[[], Tuple[List[Any], List[int]]],
+    ) -> Dict[int, Any]:
+        """Survivor map: whatever arrived, plus this rank's own payload
+        (always available without any wire traffic)."""
+        survivors = dict(partial or {})
+        own_vals, own_ranks = local_only()
+        for r, v in zip(own_ranks, own_vals):
+            survivors.setdefault(r, v)
+        return survivors
+
+    def _degrade(
+        self,
+        partial: Optional[Dict[int, Any]],
+        local_only: Callable[[], Tuple[List[Any], List[int]]],
+    ) -> Tuple[List[Any], List[int]]:
+        h = self.health
+        if self.policy == "local":
+            vals, ranks = local_only()
+            return list(vals), list(ranks)
+        if self.policy == "quorum":
+            survivors = self._with_own(partial, local_only)
+            ranks = sorted(survivors)
+            if len(ranks) >= self._quorum_count():
+                return [survivors[r] for r in ranks], ranks
+            raise SyncTimeoutError(
+                f"metric sync quorum not met: {len(ranks)}/{self.world_size} "
+                f"ranks responded, quorum requires >= {self._quorum_count()} "
+                f"(fraction {self.quorum})"
+            )
+        raise SyncTimeoutError(
+            f"metric sync failed after {self.retries + 1} attempt(s) "
+            f"({h.timeouts} timeouts, {h.transient_errors} transient errors "
+            f"so far on this group); policy 'raise' forbids degradation"
+        )
+
+    def _local_object(self, obj: Any) -> Tuple[List[Any], List[int]]:
+        if self._local_mode:
+            # under LocalReplicaGroup the argument IS the per-replica list;
+            # "this rank's own payload" is the controller's replica 0
+            return [obj[self.rank]], [self.rank]
+        return [obj], [self.rank]
+
+    def _local_array(self, x: Any) -> Tuple[List[Any], List[int]]:
+        if self._local_mode:
+            return [np.asarray(x[self.rank])], [self.rank]
+        return [np.asarray(x)], [self.rank]
+
+    def allgather_object_with_ranks(
+        self, obj: Any
+    ) -> Tuple[List[Any], List[int]]:
+        return self._resilient(
+            lambda: self._inner.allgather_object(obj),
+            lambda: self._local_object(obj),
+        )
+
+    def allgather_array_with_ranks(self, x: Any) -> Tuple[List[Any], List[int]]:
+        return self._resilient(
+            lambda: self._inner.allgather_array(x),
+            lambda: self._local_array(x),
+        )
+
+    def _full_or_raise(
+        self, gathered: Tuple[List[Any], List[int]]
+    ) -> List[Any]:
+        """The base-class ``allgather_*`` contract is one payload per rank
+        IN RANK ORDER; a degraded (partial) result cannot satisfy it, and
+        silently returning fewer entries would mis-attribute ranks in any
+        positional caller. Rank-aware callers use the ``_with_ranks``
+        variants (as ``synclib`` does)."""
+        values, ranks = gathered
+        if len(ranks) == self.world_size:
+            return values
+        raise SyncTimeoutError(
+            f"gather degraded to ranks {ranks} of {self.world_size}; the "
+            "plain allgather contract (one payload per rank, in rank "
+            "order) cannot represent a partial result — use "
+            "allgather_object_with_ranks/allgather_array_with_ranks"
+        )
+
+    def allgather_object(self, obj: Any) -> List[Any]:
+        return self._full_or_raise(self.allgather_object_with_ranks(obj))
+
+    def allgather_array(self, x: Any) -> List[Any]:
+        return self._full_or_raise(self.allgather_array_with_ranks(x))

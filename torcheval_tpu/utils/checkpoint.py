@@ -8,18 +8,33 @@ checkpointing" as a core capability). These helpers bind that surface to the
 TPU ecosystem's checkpointing layer: Orbax writes the state pytree (device
 arrays stay sharded-aware on multihost filesystems), and restore routes
 through ``load_state_dict`` so device placement and TState validation apply.
+
+Fault tolerance (docs/fault-tolerance.md):
+
+- **Atomic publish**: ``save_metric_state`` writes to a temporary sibling
+  path and renames it into place, so a crash mid-save leaves either the
+  previous checkpoint or none — never a torn one at the published path.
+- **Payload digest**: a sha256 over the canonical byte encoding of every
+  state leaf travels inside the checkpoint; ``load_metric_state`` recomputes
+  it and rejects corrupt or truncated checkpoints with a clear error
+  instead of silently restoring garbage into a resumed eval.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
-from typing import Dict, Union
+import shutil
+from typing import Any, Dict, Union
 
 import jax
 
 from torcheval_tpu.metrics.metric import Metric
 
 MetricOrCollection = Union[Metric, Dict[str, Metric]]
+
+# digest sidecar key inside the saved tree (reserved; not a metric name)
+_DIGEST_KEY = "__digest__"
 
 
 _CHECKPOINTER = None
@@ -76,9 +91,40 @@ def _from_plain(tree):
     return tree
 
 
+def _digest(tree: Any) -> str:
+    """sha256 over a canonical byte encoding of the plain state tree.
+
+    Every leaf is canonicalized through ``np.asarray`` (python ints/floats
+    and their numpy-scalar restore forms encode identically), and the key
+    path, dtype, and shape are folded in so a corrupted, truncated, or
+    transposed payload cannot collide with the original.
+    """
+    import numpy as np
+
+    h = hashlib.sha256()
+
+    def walk(node: Any, path: str) -> None:
+        if isinstance(node, dict):
+            for key in sorted(node, key=repr):
+                walk(node[key], f"{path}/{key!r}")
+        elif isinstance(node, (list, tuple)):
+            for i, item in enumerate(node):
+                walk(item, f"{path}[{i}]")
+        else:
+            arr = np.asarray(node)
+            h.update(path.encode())
+            h.update(str(arr.dtype).encode())
+            h.update(str(arr.shape).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+
+    walk(tree, "")
+    return h.hexdigest()
+
+
 def save_metric_state(metric: MetricOrCollection, path: str) -> None:
     """Write a metric's (or a ``{name: Metric}`` collection's) state to
-    ``path`` as an Orbax checkpoint.
+    ``path`` as an Orbax checkpoint — atomically, with an embedded payload
+    digest (see module docstring).
 
     For a distributed eval loop, snapshot the *synced* state instead:
     ``save_metric_state(get_synced_metric(metric, pg), path)``.
@@ -86,12 +132,55 @@ def save_metric_state(metric: MetricOrCollection, path: str) -> None:
     >>> save_metric_state(metric, "/ckpt/metrics/step_1000")
     >>> save_metric_state({"acc": acc, "auroc": auroc}, "/ckpt/metrics")
     """
-    path = os.fspath(path)
+    path = os.path.abspath(os.fspath(path))
     if isinstance(metric, Metric):
         tree = {"__single__": _to_plain(metric.state_dict())}
     else:
+        if _DIGEST_KEY in metric:
+            raise ValueError(
+                f"{_DIGEST_KEY!r} is reserved for the checkpoint integrity "
+                "digest and cannot be a metric name"
+            )
         tree = {name: _to_plain(m.state_dict()) for name, m in metric.items()}
-    _checkpointer().save(path, tree, force=True)
+    import numpy as np
+
+    # digest the LOGICAL tree (empty-array encodings decoded), which is
+    # exactly what load recomputes over after restore
+    tree[_DIGEST_KEY] = np.frombuffer(
+        bytes.fromhex(_digest(_from_plain(tree))), dtype=np.uint8
+    ).copy()
+    # atomic publish: write a temp sibling, then rename into place — a
+    # crash mid-save leaves the previous checkpoint (or nothing), never a
+    # torn tree at the published path
+    # fixed (pid-less) sibling names: a restarted process recognizes and
+    # cleans up any leftovers from a crashed earlier save, and load can
+    # recover the aside copy from a swap interrupted mid-way
+    tmp = f"{path}.tmp"
+    old = f"{path}.old"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    # a previous save may have crashed between its two renames, leaving
+    # the last good snapshot ONLY at the aside name: recover it before
+    # anything clobbers it (mirrors load_metric_state's recovery)
+    if not os.path.exists(path) and os.path.exists(old):
+        os.rename(old, path)
+    _checkpointer().save(tmp, tree, force=True)
+    # the previous checkpoint is renamed ASIDE (never deleted) until the
+    # new one is in place, so no crash point destroys the last good
+    # snapshot; the aside copy is removed only after the swap lands
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    had_old = os.path.exists(path)
+    if had_old:
+        os.rename(path, old)
+    try:
+        os.rename(tmp, path)
+    except BaseException:
+        if had_old:
+            os.rename(old, path)  # roll the previous checkpoint back
+        raise
+    if had_old:
+        shutil.rmtree(old, ignore_errors=True)
 
 
 def load_metric_state(
@@ -106,8 +195,35 @@ def load_metric_state(
     """
     from torcheval_tpu.metrics.toolkit import _restore_state_types
 
-    path = os.fspath(path)
-    tree = _from_plain(_checkpointer().restore(path))
+    path = os.path.abspath(os.fspath(path))
+    if not os.path.exists(path):
+        aside = f"{path}.old"
+        if os.path.exists(aside):
+            # a save crashed between its two renames: the last good
+            # snapshot survives at the aside name — recover it rather
+            # than telling the resume harness to start fresh
+            os.rename(aside, path)
+        else:
+            # a missing checkpoint is NOT corruption: resume harnesses
+            # branch on this distinction (start fresh vs alert)
+            raise FileNotFoundError(f"no metric checkpoint at {path}")
+    try:
+        tree = _from_plain(_checkpointer().restore(path))
+    except Exception as e:  # orbax raises backend-specific error types
+        raise RuntimeError(
+            f"checkpoint at {path} is corrupt or truncated "
+            f"(restore failed: {type(e).__name__}: {e})"
+        ) from e
+    saved_digest = tree.pop(_DIGEST_KEY, None)
+    if saved_digest is not None:
+        want = bytes(bytearray(int(b) for b in saved_digest)).hex()
+        got = _digest(tree)
+        if got != want:
+            raise RuntimeError(
+                f"checkpoint at {path} is corrupt: payload digest mismatch "
+                f"(stored {want[:16]}…, recomputed {got[:16]}…); refusing "
+                "to restore garbage metric state"
+            )
     if isinstance(metric, Metric):
         if "__single__" not in tree:
             raise RuntimeError(

@@ -3,6 +3,10 @@ from torcheval_tpu.utils.test_utils.dummy_metric import (
     DummySumListStateMetric,
     DummySumMetric,
 )
+from torcheval_tpu.utils.test_utils.fault_injection import (
+    FaultInjectionGroup,
+    FaultSpec,
+)
 from torcheval_tpu.utils.test_utils.metric_class_tester import (
     MetricClassTester,
 )
@@ -11,5 +15,7 @@ __all__ = [
     "DummySumMetric",
     "DummySumListStateMetric",
     "DummySumDictStateMetric",
+    "FaultInjectionGroup",
+    "FaultSpec",
     "MetricClassTester",
 ]

@@ -1,0 +1,187 @@
+"""Deterministic chaos wrapper for the metric-sync collective layer.
+
+``FaultInjectionGroup`` decorates any ``ProcessGroup`` and injects faults
+into its collectives by a *scripted, seeded* plan — no wall-clock or
+nondeterministic scheduling decides what fails. It is the test harness
+behind ``tests/metrics/test_fault_injection.py`` (proving every
+``resilience.ResilientGroup`` degradation policy does what it claims) and
+is usable in any integration test that needs a dead host, a slow link, a
+flaky wire, or a corrupted payload on demand.
+
+Fault model (every fault is keyed to a 0-based *collective call index* —
+each ``allgather_object``/``allgather_array`` invocation on this wrapper,
+retries included, consumes one index):
+
+- ``drop``: rank N's payload never arrives — the call raises
+  ``PartialGatherError`` carrying the ranks that DID respond, modeling a
+  fault-aware collective (PCCL-style) that detects peer loss;
+- ``delay``: the call sleeps ``seconds`` before returning, modeling a
+  slow/hung peer (trip a ``ResilientGroup`` deadline with
+  ``seconds > timeout``);
+- ``transient``: the call raises ``TransientSyncError`` — a retryable
+  wire glitch;
+- ``corrupt``: rank N's *byte payload* is flipped at a seeded offset
+  (array gathers only — object gathers are not byte-framed in-process),
+  exercising the crc32 integrity check riding ``synclib``'s metadata
+  exchange;
+- ``duplicate``: rank N's payload is replaced with a copy of rank
+  ``src``'s, modeling a misrouted/echoed message.
+
+``dead_ranks`` is the persistent form of ``drop``: those ranks are missing
+from EVERY collective — the deterministic stand-in for a host that died
+mid-eval.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterable, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from torcheval_tpu.distributed import ProcessGroup
+from torcheval_tpu.resilience import PartialGatherError, TransientSyncError
+
+__all__ = ["FaultInjectionGroup", "FaultSpec"]
+
+_KINDS = ("drop", "delay", "transient", "corrupt", "duplicate")
+
+
+class FaultSpec(NamedTuple):
+    """One scripted fault.
+
+    Args:
+        call: 0-based collective call index the fault fires at (each
+            allgather on the wrapper — retries included — consumes one).
+        kind: ``"drop"`` | ``"delay"`` | ``"transient"`` | ``"corrupt"`` |
+            ``"duplicate"``.
+        rank: the target rank for drop/corrupt/duplicate.
+        times: how many consecutive calls (starting at ``call``) the fault
+            covers — ``times=1`` makes it transient across a retry.
+        seconds: sleep duration for ``delay``.
+        src: source rank for ``duplicate`` (default: ``(rank - 1) % world``).
+    """
+
+    call: int
+    kind: str
+    rank: int = 0
+    times: int = 1
+    seconds: float = 0.05
+    src: int = -1
+
+
+class FaultInjectionGroup(ProcessGroup):
+    """Wrap ``inner`` and apply the scripted faults to its collectives.
+
+    Args:
+        inner: the group whose collectives are sabotaged (its gathers run
+            for real first; faults mutate or discard the result).
+        faults: iterable of :class:`FaultSpec`.
+        dead_ranks: ranks missing from every collective (persistent drop).
+        seed: seeds the corrupt-offset choice; two groups with the same
+            seed, faults, and call sequence behave identically.
+
+    Examples::
+
+        >>> from torcheval_tpu.utils.test_utils import (
+        ...     FaultInjectionGroup, FaultSpec,
+        ... )
+        >>> from torcheval_tpu.resilience import ResilientGroup
+        >>> # chaos = FaultInjectionGroup(group, dead_ranks={3})
+        >>> # resilient = ResilientGroup(chaos, timeout=5, policy="quorum")
+        >>> # sync_and_compute(metric, resilient)  # merges ranks != 3
+    """
+
+    def __init__(
+        self,
+        inner: ProcessGroup,
+        faults: Iterable[FaultSpec] = (),
+        *,
+        dead_ranks: Optional[Sequence[int]] = None,
+        seed: int = 0,
+    ) -> None:
+        self._inner = inner
+        self.faults = [FaultSpec(*f) for f in faults]
+        for f in self.faults:
+            if f.kind not in _KINDS:
+                raise ValueError(
+                    f"unknown fault kind {f.kind!r}; expected one of {_KINDS}"
+                )
+        self.dead_ranks = frozenset(dead_ranks or ())
+        self.seed = seed
+        self.calls = 0  # collective calls observed (retries included)
+
+    # --------------------------------------------------------------- plumbing
+
+    @property
+    def world_size(self) -> int:
+        return self._inner.world_size
+
+    @property
+    def rank(self) -> int:
+        return self._inner.rank
+
+    def unwrap(self) -> ProcessGroup:
+        return self._inner.unwrap()
+
+    # ----------------------------------------------------------------- faults
+
+    def _active(self, call: int) -> List[FaultSpec]:
+        return [
+            f for f in self.faults if f.call <= call < f.call + f.times
+        ]
+
+    def _apply(self, result: List[Any], is_array: bool) -> List[Any]:
+        call = self.calls
+        self.calls += 1
+        dropped = set(self.dead_ranks)
+        for f in self._active(call):
+            if f.kind == "delay":
+                time.sleep(f.seconds)
+            elif f.kind == "transient":
+                raise TransientSyncError(
+                    f"injected transient wire fault at collective call {call}"
+                )
+            elif f.kind == "drop":
+                dropped.add(f.rank)
+            elif f.kind == "duplicate":
+                src = f.src if f.src >= 0 else (f.rank - 1) % self.world_size
+                result = list(result)
+                result[f.rank] = _copy_payload(result[src])
+            elif f.kind == "corrupt" and is_array:
+                result = list(result)
+                buf = np.ascontiguousarray(
+                    np.asarray(result[f.rank])
+                ).copy()
+                flat = buf.reshape(-1).view(np.uint8)
+                if flat.size:
+                    rng = np.random.default_rng(self.seed + call)
+                    flat[int(rng.integers(0, flat.size))] ^= 0xFF
+                result[f.rank] = buf
+        if dropped:
+            raise PartialGatherError(
+                f"injected dead rank(s) {sorted(dropped)} at collective "
+                f"call {call}",
+                {
+                    r: result[r]
+                    for r in range(self.world_size)
+                    if r not in dropped
+                },
+            )
+        return result
+
+    # ------------------------------------------------------------ collectives
+
+    def allgather_object(self, obj: Any) -> List[Any]:
+        return self._apply(self._inner.allgather_object(obj), is_array=False)
+
+    def allgather_array(self, x: Any) -> List[np.ndarray]:
+        return self._apply(self._inner.allgather_array(x), is_array=True)
+
+
+def _copy_payload(value: Any) -> Any:
+    import copy
+
+    if isinstance(value, np.ndarray):
+        return value.copy()
+    return copy.deepcopy(value)
