@@ -648,6 +648,163 @@ def run_sync_degraded():
     }
 
 
+def run_sync_payload():
+    """Config 8: bandwidth audit of the eager sync wire.
+
+    ISSUE 3 acceptance: valid-prefix payload trimming + lossless sparse
+    wire encoding must cut the streaming-AUROC sync payload at 100 valid
+    samples by >= 4x vs the r5 bridge figure (65,536 B for the
+    (1, 2, 8192) f32 histogram), with counter-metric payloads unchanged.
+    For each metric family this config reports:
+
+    - ``bytes_before``: what the pre-trimming protocol shipped per rank —
+      the raw byte total of the full ``state_dict`` (exactly the old
+      flat-pack payload);
+    - ``bytes_after``: the actual wire bytes of today's protocol
+      (``_sync_state_dict`` valid-prefix trim + ``synclib`` encodings);
+    - a bit-identical check of the trimmed sync against the eager
+      ``merge_state`` oracle (the trim must be unobservable).
+
+    Plus the hierarchical-vs-flat collective split on an 8-rank thread
+    world (``HierarchicalGroup``): how many gathers ride the inter-node
+    fabric vs intra-node links for one collection sync.
+    """
+    import copy
+
+    import jax
+    import numpy as np
+
+    from torcheval_tpu.distributed import HierarchicalGroup, LocalReplicaGroup
+    from torcheval_tpu.metrics import (
+        BinaryAUROC,
+        MulticlassAccuracy,
+        StreamingBinaryAUROC,
+        WindowedBinaryAUROC,
+    )
+    from torcheval_tpu.metrics import synclib
+    from torcheval_tpu.metrics.toolkit import sync_and_compute
+    from torcheval_tpu.utils.test_utils import ThreadWorld
+
+    valid_samples = 100
+    world = 4
+
+    def feed(metric, rank):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(100 + rank)
+        metric.update(
+            jnp.asarray(rng.random(valid_samples).astype(np.float32)),
+            jnp.asarray(
+                (rng.random(valid_samples) < 0.5).astype(np.float32)
+            ),
+        )
+        return metric
+
+    def acc_feed(metric, rank):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(200 + rank)
+        metric.update(
+            jnp.asarray(rng.uniform(size=(64, 8)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 8, size=64)),
+        )
+        return metric
+
+    families = {
+        "counters": (lambda: MulticlassAccuracy(), acc_feed),
+        "streaming_auroc": (
+            lambda: StreamingBinaryAUROC(num_bins=8192), feed
+        ),
+        "buffered_auroc": (lambda: BinaryAUROC(), feed),
+        "windowed_auroc": (
+            lambda: WindowedBinaryAUROC(max_num_samples=8192), feed
+        ),
+    }
+
+    per_family = {}
+    for name, (factory, feeder) in families.items():
+        replicas = [feeder(factory(), r) for r in range(world)]
+        m = replicas[0]
+        before = int(
+            sum(
+                np.asarray(v).nbytes
+                for v in jax.tree_util.tree_leaves(m.state_dict())
+            )
+        )
+        payload = {"_m": m._sync_state_dict()}
+        order = synclib.metrics_traversal_order(payload)
+        _, flat = synclib._pack_rank_states(payload, order)
+        after = int(flat.size)
+        group = LocalReplicaGroup(jax.devices()[:1] * world)
+        synced = np.asarray(
+            sync_and_compute([copy.deepcopy(r) for r in replicas], group)
+        )
+        oracle = copy.deepcopy(replicas[0])
+        oracle.merge_state([copy.deepcopy(r) for r in replicas[1:]])
+        per_family[name] = {
+            "bytes_before": before,
+            "bytes_after": after,
+            "reduction_x": round(before / max(after, 1), 1),
+            "bit_identical_to_merge_oracle": bool(
+                np.array_equal(synced, np.asarray(oracle.compute()))
+            ),
+        }
+
+    # hierarchical vs flat collective split (8 ranks, 2 nodes of 4)
+    tw = ThreadWorld(8)
+
+    def flat_sync(g):
+        m = feed(BinaryAUROC(), g.rank)
+        sync_and_compute(m, g)
+        return 2  # metadata + payload gathers at the group interface
+
+    flat_counts = tw.run(flat_sync)
+
+    def hier_sync(g):
+        hg = HierarchicalGroup(g, group_size=4)
+        m = feed(BinaryAUROC(), g.rank)
+        sync_and_compute(m, hg)
+        return {"node": hg.node_collectives, "leader": hg.leader_collectives}
+
+    hier_counts = tw.run(hier_sync)
+
+    stream = per_family["streaming_auroc"]
+    return {
+        "metric": (
+            f"eager sync payload bytes per rank, {valid_samples} valid "
+            "samples (valid-prefix trim + sparse wire encoding)"
+        ),
+        "value": stream["bytes_after"],
+        "unit": "bytes (streaming-AUROC family; lower is better)",
+        "lower_is_better": True,
+        "valid_samples": valid_samples,
+        "families": per_family,
+        # acceptance: >= 4x under the r5 bridge figure, counters unchanged
+        "streaming_auroc_r5_bridge_bytes": 65536,
+        "streaming_reduction_at_least_4x": (
+            stream["bytes_before"] == 65536
+            and stream["bytes_after"] * 4 <= stream["bytes_before"]
+        ),
+        "counter_payload_unchanged": (
+            per_family["counters"]["bytes_before"]
+            == per_family["counters"]["bytes_after"]
+        ),
+        "hierarchical": {
+            "world": 8,
+            "group_size": 4,
+            "flat_collectives_per_rank": flat_counts[0],
+            "node_collectives_per_rank": hier_counts[0]["node"],
+            "leader_collectives_per_leader": hier_counts[0]["leader"],
+            "leader_collectives_per_non_leader": hier_counts[1]["leader"],
+            "note": (
+                "flat: every gather spans all 8 ranks; hierarchical: only "
+                "node leaders touch the inter-node fabric, everything else "
+                "rides intra-node links"
+            ),
+        },
+    }
+
+
 def run_probe():
     """Tiny op on the default backend — proves the platform is claimable."""
     import jax
@@ -1243,6 +1400,7 @@ CONFIGS = {
     "kernels": (run_kernels, None),  # per-backend attestation, no ref number
     "variable_batch": (run_variable_batch, None),  # retrace-proofing audit
     "sync_degraded": (run_sync_degraded, None),  # fault-tolerance audit
+    "sync_payload": (run_sync_payload, None),  # bandwidth audit
 }
 
 _NO_REF_NOTES = {
@@ -1255,6 +1413,11 @@ _NO_REF_NOTES = {
         "fault-tolerance happy-path audit — the reference has no "
         "resilient sync layer, so the comparison is our own plain-sync "
         "number"
+    ),
+    "sync_payload": (
+        "bandwidth audit — the comparison is our own pre-trimming payload "
+        "(the reference pickles whole objects, so its bytes are not "
+        "comparable)"
     ),
 }
 

@@ -1,0 +1,100 @@
+"""Worker: subgroup-scoped sync over the REAL jax.distributed wire.
+
+Spawned by ``test_multihost.py::test_subgroup_sync_over_the_wire`` with 4
+cooperating processes. Exercises ``MultiHostGroup.new_subgroup`` — the
+KV-store collective side channel — end to end:
+
+- a 2-of-4 subgroup syncs sync-matrix metrics among its members while
+  NON-MEMBERS run their own code path and stay untouched (the ISSUE
+  acceptance: reference subgroup semantics over spawned ranks);
+- the complement subgroup syncs independently and concurrently;
+- fault injection composes: the members wrap the subgroup in a
+  ``FaultInjectionGroup`` with a scripted transient + a
+  ``ResilientGroup`` retry budget, and still converge;
+- a two-level ``HierarchicalGroup`` over all 4 ranks must equal the flat
+  subgroup-of-everyone sync.
+
+Only KV-store collectives are used (no ``process_allgather``), so this
+worker runs even on jaxlibs whose CPU backend lacks multiprocess XLA
+collectives.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def main() -> None:
+    import jax
+
+    from torcheval_tpu.launcher import init_from_env
+
+    init_from_env()
+    rank = jax.process_index()
+
+    import numpy as np
+
+    from tests.metrics._sync_matrix import build_cases, run_case, to_jsonable
+    from torcheval_tpu.distributed import HierarchicalGroup, MultiHostGroup
+    from torcheval_tpu.metrics.toolkit import sync_and_compute
+    from torcheval_tpu.resilience import ResilientGroup
+    from torcheval_tpu.utils.test_utils import FaultInjectionGroup, FaultSpec
+
+    group = MultiHostGroup()
+    results = {}
+
+    cases = build_cases()
+    names = ["MulticlassAccuracy", "BinaryAUROC", "Throughput"]
+
+    # ---- 2-of-4 subgroup: members (1, 2); non-members untouched ----------
+    sub = group.new_subgroup([1, 2])
+    for name in names:
+        factory, gen = cases[name]
+        metric = run_case(factory(), gen, rank)
+        value = to_jsonable(sync_and_compute(metric, sub))
+        results[f"sub12/{name}"] = value
+    results["sub12/is_member"] = sub.is_member
+
+    # ---- the complement subgroup syncs independently ---------------------
+    comp = group.new_subgroup([0, 3])
+    factory, gen = cases["MulticlassAccuracy"]
+    metric = run_case(factory(), gen, rank)
+    results["sub03/MulticlassAccuracy"] = to_jsonable(
+        sync_and_compute(metric, comp)
+    )
+
+    # ---- fault injection over the subgroup -------------------------------
+    sub2 = group.new_subgroup([1, 2])
+    factory, gen = cases["MulticlassAccuracy"]
+    metric = run_case(factory(), gen, rank)
+    if sub2.is_member:
+        chaos = FaultInjectionGroup(
+            sub2, faults=[FaultSpec(call=0, kind="transient")]
+        )
+        resilient = ResilientGroup(
+            chaos, timeout=120.0, retries=2, policy="raise"
+        )
+        results["faulted/MulticlassAccuracy"] = to_jsonable(
+            sync_and_compute(metric, resilient)
+        )
+        results["faulted/retries"] = resilient.health.transient_errors
+    else:
+        results["faulted/MulticlassAccuracy"] = to_jsonable(
+            sync_and_compute(metric, sub2)
+        )
+
+    # ---- hierarchical (2 nodes of 2) == flat -----------------------------
+    hier = HierarchicalGroup(group, group_size=2)
+    factory, gen = cases["MulticlassAccuracy"]
+    metric = run_case(factory(), gen, rank)
+    results["hier/MulticlassAccuracy"] = to_jsonable(
+        sync_and_compute(metric, hier)
+    )
+    results["hier/leader_collectives"] = hier.leader_collectives
+    results["hier/node_collectives"] = hier.node_collectives
+
+    print("RESULT " + json.dumps({"rank": rank, **results}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

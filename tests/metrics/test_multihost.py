@@ -244,6 +244,75 @@ def test_multihost_sync(nproc):
     assert res["synced_state_dict_sum"] == res["sum"]
 
 
+SUBGROUP_WORKER = os.path.join(
+    REPO, "tests", "metrics", "_multihost_subgroup_worker.py"
+)
+
+
+def test_subgroup_sync_over_the_wire():
+    """ISSUE acceptance: ``sync_and_compute(metric, process_group=
+    subgroup)`` over 2 of 4 SPAWNED ranks matches the reference's
+    subgroup semantics — members gather member states (KV-store
+    collectives, no whole-job XLA gather), non-members return their local
+    metric untouched — exercised through sync-matrix metrics, under
+    fault injection, and through the hierarchical two-level group."""
+    from torcheval_tpu.launcher import launch
+
+    from tests.metrics._sync_matrix import build_rank_replicas, to_jsonable
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    outputs = launch(SUBGROUP_WORKER, nproc=4, timeout=600.0, env=env)
+    results = parse_result_lines(outputs)
+
+    def oracle(name, ranks):
+        replicas = build_rank_replicas(name, 4)
+        merged = replicas[ranks[0]]
+        merged.merge_state([replicas[r] for r in ranks[1:]])
+        return to_jsonable(merged.compute())
+
+    def close(a, b):
+        if isinstance(a, list) and isinstance(b, list):
+            return len(a) == len(b) and all(close(x, y) for x, y in zip(a, b))
+        if isinstance(a, float) and isinstance(b, float):
+            if np.isnan(a) and np.isnan(b):
+                return True
+            return bool(np.isclose(a, b, rtol=1e-4, atol=1e-5))
+        return a == b
+
+    for name in ("MulticlassAccuracy", "BinaryAUROC", "Throughput"):
+        want_members = oracle(name, [1, 2])
+        # both members agree bit-for-bit, and match the oracle
+        assert results[1][f"sub12/{name}"] == results[2][f"sub12/{name}"]
+        assert close(results[1][f"sub12/{name}"], want_members), name
+        for r in (0, 3):  # non-members: local metric untouched
+            local = to_jsonable(build_rank_replicas(name, 4)[r].compute())
+            assert close(results[r][f"sub12/{name}"], local), (name, r)
+    assert [results[r]["sub12/is_member"] for r in range(4)] == [
+        False, True, True, False,
+    ]
+
+    want_comp = oracle("MulticlassAccuracy", [0, 3])
+    assert results[0]["sub03/MulticlassAccuracy"] == results[3][
+        "sub03/MulticlassAccuracy"
+    ]
+    assert close(results[0]["sub03/MulticlassAccuracy"], want_comp)
+
+    # fault injection over the subgroup: scripted transient, retried
+    want_members = oracle("MulticlassAccuracy", [1, 2])
+    for r in (1, 2):
+        assert close(results[r]["faulted/MulticlassAccuracy"], want_members)
+        assert results[r]["faulted/retries"] >= 1
+
+    # hierarchical == flat over all ranks; only leaders touch level 2
+    want_all = oracle("MulticlassAccuracy", [0, 1, 2, 3])
+    for r in range(4):
+        assert close(results[r]["hier/MulticlassAccuracy"], want_all)
+    assert [results[r]["hier/leader_collectives"] for r in range(4)] == [
+        2, 0, 2, 0,
+    ]
+
+
 MATRIX_WORKER = os.path.join(
     REPO, "tests", "metrics", "_multihost_sync_matrix_worker.py"
 )

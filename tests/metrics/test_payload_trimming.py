@@ -1,0 +1,283 @@
+"""Bandwidth-optimal eager sync: valid-prefix trimming + wire encodings.
+
+Three layers under test, each exactness-pinned against the untrimmed path:
+
+- ``Metric._sync_state_dict`` valid-prefix trimming (buffered power-of-2
+  example buffers, pre-wrap ring windows): a sync ships the valid bucket,
+  never the full capacity, and the merged result is BIT-identical to
+  merging full snapshots;
+- ``synclib`` sparse wire encoding: large mostly-zero states (streaming-
+  AUROC histograms) travel as (uint32 indices, values) — LOSSLESS, always
+  on, bit-exact including -0.0 and NaN payloads via the bit view;
+- opt-in bf16 wire compression (``config.sync_compression``): large float
+  payloads travel halved; OFF by default — the default sync is
+  exactness-preserving.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu import config as te_config
+from torcheval_tpu.distributed import LocalReplicaGroup
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    MulticlassAccuracy,
+    StreamingBinaryAUROC,
+    WindowedBinaryAUROC,
+)
+from torcheval_tpu.metrics import synclib
+from torcheval_tpu.metrics.synclib import (
+    _decode_array,
+    _encode_array,
+    _pack_rank_states,
+    _unpack_rank_states,
+    metrics_traversal_order,
+)
+from torcheval_tpu.metrics.toolkit import (
+    sync_and_compute,
+    sync_and_compute_collection,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def _roundtrip(a: np.ndarray, compression: str = "off") -> np.ndarray:
+    entry, chunks = _encode_array(a, compression)
+    buf = (
+        np.concatenate([c.reshape(-1) for c in chunks])
+        if chunks
+        else np.zeros(0, np.uint8)
+    )
+    out, offset = _decode_array(buf, 0, entry)
+    assert offset == buf.size
+    return out
+
+
+# ------------------------------------------------------------ wire encodings
+
+
+def test_sparse_encoding_bit_exact_with_special_values():
+    """Sparse zero-suppression must be lossless to the BIT: -0.0 (zero
+    value, nonzero bytes) and NaN payloads survive; true zeros restore as
+    true zeros."""
+    a = np.zeros(4096, dtype=np.float32)
+    a[7] = -0.0
+    a[100] = np.nan
+    a[2000] = 1.5
+    a[4095] = -np.inf
+    out = _roundtrip(a)
+    np.testing.assert_array_equal(
+        a.view(np.uint32), out.view(np.uint32)
+    )  # bitwise, not just value-wise
+
+
+def test_sparse_engages_only_when_it_halves_the_wire():
+    dense = RNG.normal(size=4096).astype(np.float32)  # no zeros: stays raw
+    entry, chunks = _encode_array(dense, "off")
+    assert entry[2] is None
+    assert sum(c.size for c in chunks) == dense.nbytes
+
+    sparse = np.zeros(4096, dtype=np.float32)
+    sparse[:100] = 1.0
+    entry, chunks = _encode_array(sparse, "off")
+    assert entry[2][0] == "sparse"
+    assert sum(c.size for c in chunks) == 100 * (4 + 4)
+    np.testing.assert_array_equal(_roundtrip(sparse), sparse)
+
+
+def test_small_arrays_never_pay_the_nonzero_scan():
+    tiny = np.zeros(64, dtype=np.float32)
+    entry, chunks = _encode_array(tiny, "off")
+    assert entry[2] is None  # below _SPARSE_MIN_BYTES: raw
+
+
+def test_bf16_compression_opt_in_and_lossy():
+    a = (RNG.normal(size=2048).astype(np.float32) + 1.0) * 1e-3
+    exact = _roundtrip(a, "off")
+    np.testing.assert_array_equal(exact, a)
+    lossy = _roundtrip(a, "bf16")
+    assert lossy.dtype == np.float32
+    np.testing.assert_array_equal(
+        lossy, a.astype(jnp.bfloat16).astype(np.float32)
+    )
+    assert not np.array_equal(lossy, a)  # it IS lossy — hence opt-in
+
+
+def test_int_and_scalar_states_unchanged_by_compression():
+    ints = np.arange(4096, dtype=np.int64)
+    entry, chunks = _encode_array(ints, "bf16")
+    assert entry[2] is None
+    np.testing.assert_array_equal(_roundtrip(ints, "bf16"), ints)
+
+
+def test_pack_unpack_roundtrip_mixed_collection():
+    states = {
+        "hist": {"hist": jnp.zeros((1, 2, 8192), jnp.float32).at[0, 0, 5].set(3.0)},
+        "counters": {"n": jnp.asarray(4.0), "k": 7},
+        "dicty": {"d": {"a": jnp.asarray(1.0), "b": jnp.asarray(2.0)}},
+        "listy": {"l": [jnp.arange(3.0), jnp.arange(2.0)]},
+    }
+    order = metrics_traversal_order(states)
+    meta, flat = _pack_rank_states(states, order)
+    # the 64 KiB histogram must have travelled sparse
+    assert flat.size < 1024, flat.size
+    out = _unpack_rank_states(states, order, meta, flat)
+    np.testing.assert_array_equal(
+        np.asarray(out["hist"]["hist"]), np.asarray(states["hist"]["hist"])
+    )
+    assert out["counters"]["k"] == 7
+    assert float(out["counters"]["n"]) == 4.0
+    assert sorted(out["dicty"]["d"]) == ["a", "b"]
+    np.testing.assert_array_equal(out["listy"]["l"][1], np.arange(2.0))
+
+
+# -------------------------------------------------- valid-prefix trimming
+
+
+def _replicas(factory, world=4, n=100):
+    out = []
+    for r in range(world):
+        m = factory()
+        rng = np.random.default_rng(100 + r)
+        x = rng.random(n).astype(np.float32)
+        t = (rng.random(n) < 0.5).astype(np.float32)
+        m.update(jnp.asarray(x), jnp.asarray(t))
+        out.append(m)
+    return out
+
+
+def _wire_bytes(metric) -> int:
+    payload = {"_m": metric._sync_state_dict()}
+    order = metrics_traversal_order(payload)
+    _, flat = _pack_rank_states(payload, order)
+    return int(flat.size)
+
+
+def _full_bytes(metric) -> int:
+    return int(
+        sum(
+            np.asarray(v).nbytes
+            for v in jax.tree_util.tree_leaves(metric.state_dict())
+        )
+    )
+
+
+@pytest.mark.parametrize(
+    "name,factory",
+    [
+        ("streaming", lambda: StreamingBinaryAUROC(num_bins=8192)),
+        ("windowed", lambda: WindowedBinaryAUROC(max_num_samples=8192)),
+        ("buffered", lambda: BinaryAUROC()),
+    ],
+)
+def test_trimmed_sync_bit_identical_to_merge_oracle(name, factory):
+    """The whole point of trimming is that it must NOT be observable in
+    the result: synced == eager merge of full replicas, bit for bit."""
+    ms = _replicas(factory)
+    group = LocalReplicaGroup(jax.devices("cpu")[:1] * 4)
+    got = np.asarray(sync_and_compute([copy.deepcopy(m) for m in ms], group))
+    oracle = copy.deepcopy(ms[0])
+    oracle.merge_state([copy.deepcopy(m) for m in ms[1:]])
+    want = np.asarray(oracle.compute())
+    np.testing.assert_array_equal(got, want)
+
+
+def test_streaming_histogram_ships_kilobytes_not_64k():
+    """ISSUE acceptance: streaming-AUROC sync payload at 100 valid
+    samples drops >= 4x from the r5 bridge figure (65,536 B for the
+    (1, 2, 8192) f32 histogram); counter metrics are untouched."""
+    (m,) = _replicas(lambda: StreamingBinaryAUROC(num_bins=8192), world=1)
+    full = _full_bytes(m)
+    wire = _wire_bytes(m)
+    assert full == 65536, full  # the published r5 bridge payload
+    assert wire * 4 <= full, (wire, full)
+
+    acc = MulticlassAccuracy()
+    acc.update(
+        jnp.asarray(RNG.uniform(size=(32, 4)).astype(np.float32)),
+        jnp.asarray(RNG.integers(0, 4, size=32)),
+    )
+    assert _wire_bytes(acc) == _full_bytes(acc)  # counters: unchanged
+
+
+def test_windowed_ring_ships_filled_prefix_only():
+    (m,) = _replicas(lambda: WindowedBinaryAUROC(max_num_samples=8192), world=1)
+    full = _full_bytes(m)
+    wire = _wire_bytes(m)
+    assert full > 8192 * 3 * 4  # three preallocated full-window rings
+    assert wire <= 100 * 3 * 4 + 64, (wire, full)  # filled prefix + scalars
+
+    # a WRAPPED ring is fully valid and must ship whole
+    wrapped = WindowedBinaryAUROC(max_num_samples=64)
+    for _ in range(3):
+        wrapped.update(
+            jnp.asarray(RNG.random(40).astype(np.float32)),
+            jnp.asarray((RNG.random(40) < 0.5).astype(np.float32)),
+        )
+    sd = wrapped._sync_state_dict()
+    assert sd["inputs"].shape == (1, 64)
+
+
+def test_buffered_trim_restores_capacity_invariant():
+    """A buffered metric loaded from an over-provisioned snapshot ships
+    the covering bucket, not the inherited capacity."""
+    m = BinaryAUROC()
+    m.update(
+        jnp.asarray(RNG.random(100).astype(np.float32)),
+        jnp.asarray((RNG.random(100) < 0.5).astype(np.float32)),
+    )
+    sd = m.state_dict()
+    big = dict(sd)
+    for name in ("inputs", "targets", "weights"):
+        big[name] = jnp.pad(sd[name], (0, 4096 - sd[name].shape[0]),
+                            constant_values=0.0)
+    m2 = BinaryAUROC()
+    m2.load_state_dict(big)
+    trimmed = m2._sync_state_dict()
+    assert trimmed["inputs"].shape == (128,)  # bucket(100), not 4096
+    # and the valid prefix is intact
+    np.testing.assert_array_equal(
+        np.asarray(trimmed["inputs"][:100]), np.asarray(sd["inputs"][:100])
+    )
+
+
+def test_collection_sync_with_compression_on():
+    """End-to-end: a mixed collection syncs under bf16 compression; float
+    buffer results are bf16-rounded, counters stay exact."""
+    world = 4
+    replicas = []
+    for r in range(world):
+        rng = np.random.default_rng(r)
+        acc = MulticlassAccuracy()
+        acc.update(
+            jnp.asarray(rng.uniform(size=(64, 4)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 4, size=64)),
+        )
+        auroc = BinaryAUROC()
+        auroc.update(
+            jnp.asarray(rng.random(600).astype(np.float32)),
+            jnp.asarray((rng.random(600) < 0.5).astype(np.float32)),
+        )
+        replicas.append({"acc": acc, "auroc": auroc})
+    group = LocalReplicaGroup(jax.devices("cpu")[:1] * world)
+    exact = {
+        k: float(v)
+        for k, v in sync_and_compute_collection(
+            [{k: copy.deepcopy(m) for k, m in c.items()} for c in replicas],
+            group,
+        ).items()
+    }
+    with te_config.sync_compression_mode("bf16"):
+        lossy = {
+            k: float(v)
+            for k, v in sync_and_compute_collection(replicas, group).items()
+        }
+    assert lossy["acc"] == exact["acc"]  # tiny counters: never compressed
+    assert abs(lossy["auroc"] - exact["auroc"]) < 0.01  # bf16-rounded

@@ -269,6 +269,43 @@ def test_record_extension_point_counts_once():
     np.testing.assert_allclose(np.asarray(m.total).squeeze(), 10.0)
 
 
+def test_panel_converts_each_input_once(monkeypatch):
+    """Per-metric preamble regression pin: a K-metric panel coerces each
+    update argument ONCE, not K times (the shared conversion cache in
+    update_collection — on host inputs each duplicate coercion was a full
+    H2D upload; BENCH_r05 measured the 5-metric panel at ~9x one metric's
+    preamble before caching)."""
+    import torcheval_tpu.utils.convert as convert
+
+    conversions = []
+    real = convert._to_jax_impl
+
+    def counting(x, **kw):
+        conversions.append(id(x))
+        return real(x, **kw)
+
+    monkeypatch.setattr(convert, "_to_jax_impl", counting)
+    metrics = _classification_collection()
+    xc, tc = np.asarray(XC), np.asarray(TC)  # host inputs: the costly case
+    conversions.clear()
+    update_collection(metrics, xc, tc)
+    # one conversion per distinct argument object — K metrics share them
+    assert len(conversions) == len(set(conversions)) == 2, conversions
+
+
+def test_plain_update_unaffected_by_cache_scope():
+    """The shared cache is scoped to one update_collection call: separate
+    per-metric updates still convert independently and match."""
+    a = _classification_collection()["acc"]
+    b = _classification_collection()["acc"]
+    x, t = np.asarray(XC), np.asarray(TC)
+    update_collection({"m": a}, x, t)
+    b.update(x, t)
+    np.testing.assert_allclose(
+        float(a.compute()), float(b.compute()), atol=1e-6
+    )
+
+
 def test_mixed_collection_no_partial_update_on_bad_batch():
     """Plan validation runs for EVERY fusable metric before any fallback
     metric mutates: a batch that fails a fusable metric's check must leave

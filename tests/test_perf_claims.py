@@ -29,6 +29,7 @@ TPU = _load("bench_r3_tpu_20260731.json")
 CPU = _load("bench_r5_cpu_deadrelay_20260801.json")
 VB = _load("bench_r6_variable_batch_cpu_20260803.json")
 SD = _load("bench_r7_sync_degraded_cpu_20260803.json")
+SP = _load("bench_r8_sync_payload_cpu_20260803.json")
 
 
 def _read(path):
@@ -280,6 +281,85 @@ def test_sync_degraded_table_matches_capture():
     # healthy happy path: no degradation events in the capture's health
     assert sd["health"]["degraded_syncs"] == 0
     assert sd["health"]["timeouts"] == 0
+
+
+def test_amortized_sync_figure_matches_r5_capture():
+    """VERDICT r5 weak #3: the amortized every-4-batches sync figure must
+    come from ONE capture — the committed r5 CPU capture's
+    ``amortized_every_4_steps_pct`` — everywhere it is published (the r3
+    TPU table row used to still say ~2.9% from the r3 run while the notes
+    said ~3%; both now cite r5 and drift-guard here)."""
+    text = _read("docs/benchmarks.md")
+    want = CPU["sync_overhead"]["amortized_every_4_steps_pct"]
+    rows = re.findall(
+        r"([\d.]+)% amortized at the reference example's every-4-batches",
+        text,
+    )
+    assert rows, "amortized table figure not found"
+    for got in rows:
+        assert float(got) == pytest.approx(want, abs=0.05), (
+            f"published amortized figure {got}% vs r5 capture {want}%"
+        )
+    m = re.search(
+        r"the emulated amortized overhead is ([\d.]+)% "
+        r"\(`amortized_every_4_steps_pct`",
+        text,
+    )
+    assert m, "amortized notes figure not found"
+    assert float(m.group(1)) == pytest.approx(want, abs=0.05)
+
+
+def test_sync_payload_table_matches_capture():
+    """The bandwidth table traces to its committed capture: per-family
+    before/after bytes and reductions — and the capture itself must
+    satisfy the ISSUE acceptance (streaming-AUROC >= 4x below the r5
+    bridge 65,536 B at 100 valid samples, counters unchanged,
+    bit-identical merges)."""
+    text = _read("docs/benchmarks.md")
+    sp = SP["sync_payload"]
+    fams = sp["families"]
+    rows = [
+        (r"streaming AUROC[^|]*\| (\d+) \| \*\*(\d+)\*\* \| \*\*([\d.]+)×\*\*",
+         "streaming_auroc"),
+        (r"windowed AUROC[^|]*\| (\d+) \| \*\*(\d+)\*\* \| \*\*([\d.]+)×\*\*",
+         "windowed_auroc"),
+        (r"buffered AUROC[^|]*\| (\d+) \| (\d+) \| ([\d.]+)×", "buffered_auroc"),
+        (r"counters \(MulticlassAccuracy[^|]*\| (\d+) \| (\d+) \| ([\d.]+)×",
+         "counters"),
+    ]
+    for pattern, fam in rows:
+        m = re.search(pattern, text)
+        assert m, f"sync_payload row not found for {fam}"
+        entry = fams[fam]
+        assert int(m.group(1)) == entry["bytes_before"], fam
+        assert int(m.group(2)) == entry["bytes_after"], fam
+        assert float(m.group(3)) == pytest.approx(
+            entry["reduction_x"], abs=0.05
+        ), fam
+        assert entry["bit_identical_to_merge_oracle"], fam
+    # the acceptance quantities hold in the capture itself
+    assert sp["streaming_reduction_at_least_4x"]
+    assert sp["counter_payload_unchanged"]
+    assert fams["streaming_auroc"]["bytes_before"] == 65536
+    m = re.search(
+        r"measured ([\d.]+)× — with counter payloads byte-identical", text
+    )
+    assert m, "acceptance sentence not found"
+    assert float(m.group(1)) == pytest.approx(
+        fams["streaming_auroc"]["reduction_x"], abs=0.05
+    )
+    # hierarchical split rows
+    hier = sp["hierarchical"]
+    m = re.search(
+        r"issues (\d+) intra-node gathers per rank and only \*\*(\d+) "
+        r"leader-level\s+exchanges per node leader\*\* \((\d+) for every "
+        r"non-leader\)",
+        text,
+    )
+    assert m, "hierarchical split sentence not found"
+    assert int(m.group(1)) == hier["node_collectives_per_rank"]
+    assert int(m.group(2)) == hier["leader_collectives_per_leader"]
+    assert int(m.group(3)) == hier["leader_collectives_per_non_leader"]
 
 
 def test_bridge_numerator_terms_match_dispatch_table():

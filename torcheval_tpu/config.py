@@ -284,6 +284,62 @@ def sync_resilience(
         (_sync_timeout, _sync_retries, _sync_degradation, _sync_quorum) = prev
 
 
+# ------------------------------------------------------- sync compression
+
+_COMPRESSION_POLICIES = ("off", "bf16")
+
+_sync_compression: str = _env_choice(
+    "TORCHEVAL_TPU_SYNC_COMPRESSION", "off", _COMPRESSION_POLICIES
+)
+
+
+def sync_compression() -> str:
+    """Wire compression for LARGE float metric-state payloads during sync:
+    ``"off"`` (default — every sync is exactness-preserving) or ``"bf16"``
+    (EQuARX-spirit lossy compression, arxiv 2506.17615: float buffers over
+    ~1 KiB travel as bfloat16 and are cast back on arrival, halving gather
+    bandwidth at ~3 significant decimal digits of score precision).
+
+    Consumed by both sync paths: the in-jit EXTEND gather
+    (``metrics.sharded.sync_states_in_jit``) and the eager packed protocol
+    (``metrics.synclib``). Counter scalars and integer payloads are never
+    compressed. Env ``TORCHEVAL_TPU_SYNC_COMPRESSION``.
+
+    Scope caveat: the EAGER path reads this knob per sync call; the
+    IN-JIT path reads it at TRACE time, baking the choice into the
+    compiled step — a toggle after tracing does not affect cached
+    programs (pass ``compression=`` to ``sync_states_in_jit`` explicitly
+    to be unambiguous under jit).
+    """
+    return _sync_compression
+
+
+def set_sync_compression(policy: str) -> None:
+    global _sync_compression
+    if policy not in _COMPRESSION_POLICIES:
+        raise ValueError(
+            f"sync_compression must be one of {_COMPRESSION_POLICIES}, "
+            f"got {policy!r}"
+        )
+    _sync_compression = policy
+
+
+@contextmanager
+def sync_compression_mode(policy: str = "bf16") -> Iterator[None]:
+    """Context manager scoping the sync wire-compression policy.
+
+    >>> with sync_compression_mode("bf16"):
+    ...     value = sync_and_compute(metric)   # halved float payloads
+    """
+    global _sync_compression
+    prev = _sync_compression
+    set_sync_compression(policy)
+    try:
+        yield
+    finally:
+        _sync_compression = prev
+
+
 # -------------------------------------------------------- input guardrails
 
 _VALIDATE_POLICIES = ("off", "warn", "raise")

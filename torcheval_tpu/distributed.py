@@ -61,6 +61,21 @@ def decode_length(arr: Any) -> int:
     return hi * _LENGTH_BASE + lo
 
 
+def _check_subgroup_ranks(ranks: Sequence[int], world: int) -> Tuple[int, ...]:
+    """Validate a subgroup rank list: non-empty, unique, sorted into
+    canonical order, within ``[0, world)``."""
+    out = tuple(sorted(int(r) for r in ranks))
+    if not out:
+        raise ValueError("a subgroup needs at least one rank")
+    if len(set(out)) != len(out):
+        raise ValueError(f"duplicate ranks in subgroup: {list(ranks)}")
+    if out[0] < 0 or out[-1] >= world:
+        raise ValueError(
+            f"subgroup ranks {list(ranks)} out of range for world size {world}"
+        )
+    return out
+
+
 class ProcessGroup:
     """Minimal interface the sync layer needs from a replica group."""
 
@@ -71,6 +86,39 @@ class ProcessGroup:
     @property
     def rank(self) -> int:
         raise NotImplementedError
+
+    # ------------------------------------------------------ subgroup scoping
+
+    @property
+    def is_member(self) -> bool:
+        """Whether THIS process participates in the group's collectives.
+
+        Always True for whole-world groups; a subgroup handle held by a
+        non-member process reports False, and the toolkit entry points
+        then return the local metric untouched (the reference's
+        ``process_group=`` subset semantics, reference toolkit.py:34-67).
+        """
+        return True
+
+    @property
+    def ranks(self) -> Tuple[int, ...]:
+        """Global ranks of the members, ascending. Whole-world groups are
+        ``range(world_size)``; subgroups report their member subset (the
+        group-relative ranks used on the wire map through this tuple)."""
+        return tuple(range(self.world_size))
+
+    def new_subgroup(self, ranks: Sequence[int]) -> "ProcessGroup":
+        """A group scoped to ``ranks`` (global, of THIS group) — the
+        analogue of ``torch.distributed.new_group`` (SURVEY §2.8): every
+        toolkit entry point then syncs over exactly that subset. Like the
+        reference, call it on EVERY process of the parent group, in the
+        same order; non-members receive a handle with
+        ``is_member == False``. Composable: ``resilience.ResilientGroup``
+        forwards with its policy intact, and chaos wrappers decorate the
+        returned subgroup like any other group."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support subgroup scoping"
+        )
 
     def allgather_array(self, x: jax.Array) -> List[np.ndarray]:
         """Gather one same-shaped array from every rank, in rank order."""
@@ -122,6 +170,10 @@ class SingleProcessGroup(ProcessGroup):
     def allgather_object(self, obj) -> List[Any]:
         return [obj]
 
+    def new_subgroup(self, ranks: Sequence[int]) -> "SingleProcessGroup":
+        _check_subgroup_ranks(ranks, 1)
+        return self
+
 
 class LocalReplicaGroup(ProcessGroup):
     """N metric replicas driven by one controller process (typically one per
@@ -131,10 +183,19 @@ class LocalReplicaGroup(ProcessGroup):
 
     The sync entry points accept a *list* of per-replica payloads when
     running under this group (single-controller owns all replicas at once).
+
+    ``new_subgroup(ranks)`` scopes the group to a replica subset: the
+    toolkit then accepts EITHER the member-only replica list or the full
+    parent-world list (member replicas are selected by rank, the rest stay
+    untouched — the reference's subset semantics in single-controller
+    form).
     """
 
     def __init__(self, devices: Optional[Sequence[jax.Device]] = None) -> None:
         self.devices = list(devices) if devices is not None else jax.local_devices()
+        # set by new_subgroup on the child it returns
+        self._member_ranks: Optional[Tuple[int, ...]] = None
+        self.parent_world: Optional[int] = None
 
     @property
     def world_size(self) -> int:
@@ -143,6 +204,19 @@ class LocalReplicaGroup(ProcessGroup):
     @property
     def rank(self) -> int:
         return 0
+
+    @property
+    def ranks(self) -> Tuple[int, ...]:
+        if self._member_ranks is not None:
+            return self._member_ranks
+        return tuple(range(self.world_size))
+
+    def new_subgroup(self, ranks: Sequence[int]) -> "LocalReplicaGroup":
+        ranks = _check_subgroup_ranks(ranks, self.world_size)
+        sub = LocalReplicaGroup([self.devices[r] for r in ranks])
+        sub._member_ranks = ranks
+        sub.parent_world = self.world_size
+        return sub
 
     def allgather_array(self, xs) -> List[np.ndarray]:
         # xs is the per-replica list already resident in this process
@@ -206,6 +280,285 @@ class MultiHostGroup(ProcessGroup):
         return [
             pickle.loads(gathered[r, : sizes[r]].tobytes())
             for r in range(self._world)
+        ]
+
+    def new_subgroup(self, ranks: Sequence[int]) -> "MultiHostSubgroup":
+        return MultiHostSubgroup(_check_subgroup_ranks(ranks, self._world))
+
+
+# per-(member tuple) construction counter, namespacing concurrent subgroup
+# instances over the same ranks. Deterministic as long as every process
+# constructs its subgroups in the same order (the documented contract,
+# identical to torch.distributed.new_group).
+_SUBGROUP_SEQ: dict = {}
+
+
+class MultiHostSubgroup(ProcessGroup):
+    """A subset of the multi-host job's processes, synced over the
+    ``jax.distributed`` coordination service's key-value store.
+
+    XLA collectives (``multihost_utils.process_allgather``) are
+    whole-job-only: every process must participate or the pod hangs —
+    which is exactly what subgroup scoping must avoid (non-members stay
+    untouched AND uninvolved). The coordination KV store the job already
+    rendezvoused through has no such constraint, so subgroup gathers ride
+    it: each member publishes its payload under a sequence-numbered key
+    and reads its peers'. Latency is coordinator-RPC, not ICI — right for
+    the eager metrics-sync cadence (occasional, KB-to-MB payloads,
+    already host-side), wrong for anything in a step's hot loop.
+
+    Construction contract (same as ``torch.distributed.new_group``): every
+    process of the parent group constructs the subgroup, in the same
+    order; non-members receive a handle with ``is_member == False`` whose
+    collectives refuse to run (the toolkit short-circuits before calling
+    them).
+
+    Cleanup is lockstep-safe: a member starting collective ``n`` deletes
+    its own key of collective ``n - 2`` — any peer still reading is at
+    ``n - 1`` or later, so no live key is ever deleted. The LAST one or
+    two collectives' keys therefore outlive the exchange; call
+    :meth:`close` once every member is past its final collective (end of
+    the eval job) to sweep them, and REUSE one subgroup across syncs
+    rather than constructing a fresh one per sync — each construction
+    namespaces new keys, so per-sync construction grows the coordinator's
+    KV store by the trailing keys of every instance.
+    """
+
+    def __init__(
+        self, ranks: Sequence[int], *, timeout: float = 600.0
+    ) -> None:
+        self._ranks = tuple(ranks)
+        me = jax.process_index()
+        self._member_index = (
+            self._ranks.index(me) if me in self._ranks else None
+        )
+        self.timeout = float(timeout)
+        key = ("mh-subgroup",) + self._ranks
+        _SUBGROUP_SEQ[key] = _SUBGROUP_SEQ.get(key, 0) + 1
+        self._tag = "-".join(map(str, self._ranks)) + f"/{_SUBGROUP_SEQ[key]}"
+        self._seq = 0
+
+    @property
+    def world_size(self) -> int:
+        return len(self._ranks)
+
+    @property
+    def rank(self) -> int:
+        return -1 if self._member_index is None else self._member_index
+
+    @property
+    def is_member(self) -> bool:
+        return self._member_index is not None
+
+    @property
+    def ranks(self) -> Tuple[int, ...]:
+        return self._ranks
+
+    def new_subgroup(self, ranks: Sequence[int]) -> "MultiHostSubgroup":
+        # ranks are THIS group's (relative); map through to global
+        rel = _check_subgroup_ranks(ranks, len(self._ranks))
+        return MultiHostSubgroup(
+            tuple(self._ranks[r] for r in rel), timeout=self.timeout
+        )
+
+    def _client(self):
+        from jax._src import distributed as jdist
+
+        client = getattr(jdist.global_state, "client", None)
+        if client is None:
+            raise RuntimeError(
+                "MultiHostSubgroup needs the jax.distributed coordination "
+                "service (jax.distributed.initialize / "
+                "torcheval_tpu.launcher.init_from_env) to be initialized"
+            )
+        return client
+
+    def _kv_allgather(self, payload: bytes) -> List[bytes]:
+        if self._member_index is None:
+            raise RuntimeError(
+                f"process {jax.process_index()} is not a member of subgroup "
+                f"{self._ranks}; non-members must not issue its collectives "
+                "(the toolkit returns their local metrics untouched)"
+            )
+        client = self._client()
+        seq = self._seq
+        self._seq += 1
+        prefix = f"torcheval_sync/{self._tag}/{seq}"
+        me = self._ranks[self._member_index]
+        client.key_value_set_bytes(f"{prefix}/{me}", bytes(payload))
+        timeout_ms = max(1, int(self.timeout * 1000))
+        out = [
+            bytes(
+                client.blocking_key_value_get_bytes(
+                    f"torcheval_sync/{self._tag}/{seq}/{r}", timeout_ms
+                )
+            )
+            for r in self._ranks
+        ]
+        if seq >= 2:  # lockstep-safe cleanup (class docstring)
+            try:
+                client.key_value_delete(
+                    f"torcheval_sync/{self._tag}/{seq - 2}/{me}"
+                )
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+        return out
+
+    def close(self) -> None:
+        """Best-effort sweep of this member's trailing KV keys. Call only
+        after every member has finished its last collective on this
+        subgroup — a peer still mid-read would lose the payload."""
+        if self._member_index is None or self._seq == 0:
+            return
+        client = self._client()
+        me = self._ranks[self._member_index]
+        for seq in range(max(0, self._seq - 2), self._seq):
+            try:
+                client.key_value_delete(
+                    f"torcheval_sync/{self._tag}/{seq}/{me}"
+                )
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+
+    def allgather_object(self, obj: Any) -> List[Any]:
+        gathered = self._kv_allgather(pickle.dumps(obj))
+        return [pickle.loads(b) for b in gathered]
+
+    def allgather_array(self, x: Any) -> List[np.ndarray]:
+        arr = np.ascontiguousarray(np.asarray(x))
+        gathered = self._kv_allgather(pickle.dumps(arr))
+        return [np.asarray(pickle.loads(b)) for b in gathered]
+
+
+class HierarchicalGroup(ProcessGroup):
+    """Two-level eager sync: intra-node gather -> one inter-node exchange
+    among node leaders -> intra-node broadcast.
+
+    The pod-scale collective pattern of "Automatic Cross-Replica Sharding
+    of Weight Update" (arxiv 2004.13336): when intra-node links (ICI,
+    NVLink, shared memory) are much faster than the inter-node fabric
+    (DCN), a flat world-size-N gather puts N payloads on the slow fabric;
+    the two-level shape exchanges one aggregate per NODE among the node
+    leaders instead. Opt-in decorator — results are identical to the flat
+    gather (same payloads, same rank order), only the wire pattern
+    changes. ``leader_collectives`` / ``node_collectives`` count the
+    split for observability (``bench.py sync_payload`` reports them).
+
+    Built on :meth:`ProcessGroup.new_subgroup`, so it works over any
+    rank-per-process group that supports subgroup scoping
+    (``MultiHostGroup``, test worlds); construct it on every process.
+
+    Transport honesty: what this class guarantees today is the exchange
+    SHAPE (only leaders exchange across nodes — the quantity the bench
+    counts), not a measured speedup. Over ``MultiHostGroup`` the
+    subgroup collectives currently ride the coordination KV store
+    (``MultiHostSubgroup``), whose per-exchange latency is a coordinator
+    RPC — typically SLOWER than the flat ``process_allgather`` XLA
+    collective for small worlds, so on such jobs treat this as the
+    pattern + observability vehicle, not an optimization. The bandwidth
+    win materializes when the node subgroups map onto a transport where
+    intra-node exchange is genuinely cheap (future subgroup-scoped XLA
+    collectives, or test worlds emulating one).
+    """
+
+    def __init__(
+        self,
+        inner: ProcessGroup,
+        *,
+        group_size: Optional[int] = None,
+        groups: Optional[Sequence[Sequence[int]]] = None,
+    ) -> None:
+        if isinstance(inner.unwrap(), LocalReplicaGroup):
+            raise ValueError(
+                "HierarchicalGroup needs a rank-per-process group "
+                "(MultiHostGroup); a LocalReplicaGroup is one process — "
+                "there is no slow fabric to optimize"
+            )
+        world = inner.world_size
+        if groups is None:
+            if group_size is None or group_size < 1:
+                raise ValueError("pass group_size >= 1 or explicit groups")
+            groups = [
+                list(range(lo, min(lo + group_size, world)))
+                for lo in range(0, world, group_size)
+            ]
+        nodes = [_check_subgroup_ranks(g, world) for g in groups]
+        covered = sorted(r for node in nodes for r in node)
+        if covered != list(range(world)):
+            raise ValueError(
+                f"groups {groups} must partition ranks 0..{world - 1}"
+            )
+        # canonical node order = ascending leader rank: the leaders
+        # subgroup gathers in THAT order, and allgather_object zips the
+        # gathered per-node lists against self._nodes — an unsorted
+        # explicit `groups` would otherwise reassemble payloads under the
+        # wrong ranks
+        nodes.sort(key=lambda n: n[0])
+        self._inner = inner
+        self._nodes = nodes
+        me = inner.rank
+        mine = next((n for n in nodes if me in n), None)
+        if not inner.is_member or mine is None:
+            # the documented contract constructs the hierarchy on every
+            # process of the parent; a non-member gets the same graceful
+            # handle every other group kind returns
+            self._node = None
+            self._leaders = None
+        else:
+            self._node = inner.new_subgroup(mine)
+            self._leaders = inner.new_subgroup([n[0] for n in nodes])
+        self.node_collectives = 0
+        self.leader_collectives = 0
+
+    @property
+    def world_size(self) -> int:
+        return self._inner.world_size
+
+    @property
+    def rank(self) -> int:
+        return self._inner.rank
+
+    @property
+    def is_member(self) -> bool:
+        return self._node is not None
+
+    @property
+    def ranks(self) -> Tuple[int, ...]:
+        return self._inner.ranks
+
+    def unwrap(self) -> ProcessGroup:
+        return self._inner.unwrap()
+
+    def allgather_object(self, obj: Any) -> List[Any]:
+        if self._node is None:
+            raise RuntimeError(
+                "this process is not a member of the hierarchical group's "
+                "parent; non-members must not issue its collectives (the "
+                "toolkit returns their local metrics untouched)"
+            )
+        # level 1: gather within this node
+        self.node_collectives += 1
+        node_vals = self._node.allgather_object(obj)
+        # level 2: ONE exchange among node leaders, each carrying its
+        # whole node's payloads
+        flat: Optional[List[Any]] = None
+        if self._leaders.is_member:
+            self.leader_collectives += 1
+            per_node = self._leaders.allgather_object(node_vals)
+            flat = [None] * self.world_size
+            for node, vals in zip(self._nodes, per_node):
+                for r, v in zip(node, vals):
+                    flat[r] = v
+        # level 3: leaders broadcast the assembled world within their node
+        # (an allgather where only the leader's slot carries data)
+        self.node_collectives += 1
+        shared = self._node.allgather_object(flat)
+        return shared[0]  # the node leader is its subgroup's rank 0
+
+    def allgather_array(self, x: Any) -> List[np.ndarray]:
+        return [
+            np.asarray(a)
+            for a in self.allgather_object(np.ascontiguousarray(np.asarray(x)))
         ]
 
 

@@ -218,6 +218,27 @@ class BufferedExamplesMetric(Metric[jax.Array]):
             if isinstance(buf, jax.Array):
                 setattr(self, name, jnp.copy(buf))
 
+    def _sync_state_dict(self):
+        """Valid-prefix payload trimming: a sync ships each buffer sliced
+        to the smallest power-of-2 bucket covering the valid count, never
+        the full capacity. The slice keeps the neutral fill in the
+        ``[count, bucket)`` tail, so pad-neutral kernels and
+        ``merge_state`` (which reads only ``[0, count)``) see identical
+        data; a clone loaded from the trimmed snapshot simply has a
+        smaller — still power-of-2 — capacity. No-op while capacity equals
+        the bucket (the growth schedule keeps them equal; they diverge
+        after loading an over-provisioned snapshot or a merged peer)."""
+        sd = super()._sync_state_dict()
+        keep = next_capacity(self._num_samples)
+        for name, spec in self._buffer_specs.items():
+            buf = sd.get(name)
+            if not isinstance(buf, jax.Array) or buf.ndim == 0:
+                continue
+            axis = spec.axis if spec.axis >= 0 else buf.ndim + spec.axis
+            if buf.shape[axis] > keep:
+                sd[name] = lax.slice_in_dim(buf, 0, keep, axis=axis)
+        return sd
+
     # ------------------------------------------------------------------- merge
 
     def merge_state(self, metrics) -> "BufferedExamplesMetric":

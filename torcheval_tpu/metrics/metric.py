@@ -439,6 +439,22 @@ class Metric(Generic[TComputeReturn], ABC):
         return {name: self._clone_state(getattr(self, name)) for name in
                 self._state_name_to_default}
 
+    def _sync_state_dict(self) -> Dict[str, TState]:
+        """State snapshot for a SYNC payload (``toolkit`` -> ``synclib``).
+
+        Like :meth:`state_dict` — and defaults to it — but free to TRIM
+        regions that are provably padding (valid-prefix payload trimming):
+        growable example buffers ship their covering power-of-2 bucket
+        instead of full capacity (``_buffer.BufferedExamplesMetric``), and
+        pre-wrap ring windows ship only their filled prefix
+        (``window.WindowedBinaryAUROC``). Contract: loading a trimmed
+        snapshot into a fresh clone and merging must be bit-identical to
+        doing the same with the full :meth:`state_dict` (pinned by
+        tests/metrics/test_payload_trimming.py). Checkpoints always use
+        the untrimmed :meth:`state_dict`.
+        """
+        return self.state_dict()
+
     def load_state_dict(
         self, state_dict: Dict[str, TState], strict: bool = True
     ) -> None:

@@ -25,6 +25,30 @@ Typical use (data-parallel eval with in-step metrics)::
 The synced state can be loaded back into the class metric with
 ``metric.load_state_dict`` for reporting/checkpointing.
 
+``axis_name`` may be a single mesh axis or a TUPLE of axes (``("dp", "sp")``
+on a composed mesh): reductions and gathers then span the product of the
+named axes, with gather order following the axes' row-major linear index —
+bit-identical to merging the same shards eagerly in that order
+(tests/metrics/test_sharded.py::test_composed_axes_*).
+
+Bandwidth: EXTEND buffers travel through a TRUE ``lax.all_gather`` whose
+operand is the local shard — O(size) per hop — never the historical
+gather-as-psum trick that all-reduced a zero ``[world, ...]`` buffer
+(O(world x size)); shard_map's replication checker is satisfied through
+``torcheval_tpu.utils.vma.gather_replicated``. Structurally pinned by
+tests/metrics/test_sync_collective_structure.py::test_extend_sync_lowers_to_all_gather.
+
+Payload trimming: growable power-of-2 buffers are usually mostly padding
+(a streaming-AUROC buffer holding 100 valid samples still has a 128-slot —
+or after a ragged epoch, far larger — capacity). When the host knows a
+bound on every replica's valid count (it fed the batches), pass
+``extend_valid={"state_name": bound}``: the buffer is sliced to the
+smallest power-of-2 bucket covering the bound before the gather, so the
+wire carries O(bucket) instead of O(capacity) per shard. The bound must
+cover the max valid count across replicas (the host-side analogue of
+pmax-ing the counts); padding inside the bucket keeps its neutral fill, so
+pad-neutral compute kernels consume the gathered result unchanged.
+
 Variable-shape eval (shape bucketing): the mask-aware kernel twins
 (``*_update_masked``, see torcheval_tpu/metrics/_bucket.py) drop into this
 path unchanged — pad the per-replica batch to its bucket outside the step,
@@ -42,13 +66,16 @@ collectives — zero added to the step program
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.utils.vma import gather_replicated
+
+AxisNames = Union[str, Tuple[str, ...]]
 
 
 def state_merge_specs(metric: Metric) -> Dict[str, MergeKind]:
@@ -56,26 +83,60 @@ def state_merge_specs(metric: Metric) -> Dict[str, MergeKind]:
     return dict(metric._state_name_to_merge_kind)
 
 
+def _pow2_cover(n: int) -> int:
+    """Smallest power of two >= ``n`` (>= 1) — the trim bucket."""
+    n = int(n)
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
 def sync_states_in_jit(
     states: Dict[str, Any],
-    axis_name: str,
+    axis_name: AxisNames,
     specs: Optional[Dict[str, MergeKind]] = None,
+    *,
+    extend_valid: Optional[Dict[str, int]] = None,
+    compression: Optional[str] = None,
 ) -> Dict[str, Any]:
-    """Merge per-replica metric states across a named mesh axis, inside jit.
+    """Merge per-replica metric states across named mesh axes, inside jit.
 
     - ``SUM`` counters -> ``lax.psum`` (one fused all-reduce over ICI),
-    - ``MAX``/``MIN`` -> ``lax.pmax``/``pmin``,
-    - ``EXTEND`` buffers -> ``lax.all_gather`` + flatten along the example
-      axis. Static-shape precondition: per-replica buffers must be
-      equal-sized. The fixed-shape buffer layer
-      (``torcheval_tpu.metrics._buffer``) guarantees this under SPMD — every
-      replica performs the same update sequence, so capacities match — and
-      its pad-neutral fills mean the padding interleaved in the flattened
-      gather is harmless to the padded-buffer compute kernels.
+    - ``MAX``/``MIN`` -> ``lax.pmax``/``lax.pmin``,
+    - ``EXTEND`` buffers -> a true ``lax.all_gather`` of the local shard
+      (O(size) per hop; replication-checker handling in
+      ``utils.vma.gather_replicated``) + flatten along the example axis.
+      Static-shape precondition: per-replica buffers must be equal-sized.
+      The fixed-shape buffer layer (``torcheval_tpu.metrics._buffer``)
+      guarantees this under SPMD — every replica performs the same update
+      sequence, so capacities match — and its pad-neutral fills mean the
+      padding interleaved in the flattened gather is harmless to the
+      padded-buffer compute kernels.
 
-    ``specs`` defaults to SUM for every state. Unknown/CUSTOM kinds raise:
-    bespoke merges cannot be lowered generically — sync those eagerly via
-    the toolkit.
+    Args:
+        states: ``{name: array}`` local states.
+        axis_name: one mesh axis or a tuple of axes (composed meshes);
+            reductions and gathers span the product of the named axes.
+        specs: per-state merge kinds; defaults to SUM for every state.
+            Unknown/CUSTOM kinds raise: bespoke merges cannot be lowered
+            generically — sync those eagerly via the toolkit.
+        extend_valid: optional ``{name: bound}`` STATIC valid-count bounds
+            for EXTEND buffers (must cover every replica's valid count —
+            the host-side pmax). Each named buffer is sliced to the
+            smallest power-of-2 bucket covering its bound before the
+            gather (module docstring, "Payload trimming").
+        compression: ``"bf16"`` casts float EXTEND payloads (> 1 KiB) to
+            bfloat16 across the wire and back, halving gather bandwidth at
+            ~3 decimal digits of score precision (EQuARX-style lossy
+            compression — arxiv 2506.17615). Defaults to the process-wide
+            ``config.sync_compression()`` knob, which is ``"off"``:
+            exactness is the default, compression is opt-in. TRACE-TIME
+            constant: this function runs inside the caller's jitted step,
+            so the choice is baked into the compiled program — toggling
+            the config after the step is traced has NO effect until the
+            step retraces. To be unambiguous under jit, pass
+            ``compression=`` explicitly rather than relying on the
+            context manager.
 
     All same-kind, same-dtype states are fused into ONE collective
     (flatten-concat -> psum/pmax/pmin -> split): a whole metric collection
@@ -83,6 +144,10 @@ def sync_states_in_jit(
     analogue of the reference's single batched ``all_gather_object`` for
     collections (reference toolkit.py:263-334).
     """
+    from torcheval_tpu import config
+
+    if compression is None:
+        compression = config.sync_compression()
     synced: Dict[str, Any] = {}
     reduce_groups: Dict[Any, list] = {}  # (kind, dtype) -> [(name, value)]
     reducers = {
@@ -98,15 +163,25 @@ def sync_states_in_jit(
                 (name, value)
             )
         elif kind is MergeKind.EXTEND:
-            # Gather-as-psum: scatter the local shard into a zero [world, ...]
-            # buffer at this replica's index, then all-reduce. Semantically an
-            # all_gather, but psum's output is statically known to be
-            # replicated, which shard_map's replication checker requires for
-            # un-partitioned out_specs (lax.all_gather is not so marked).
-            world = lax.psum(1, axis_name)
-            idx = lax.axis_index(axis_name)
-            buf = jnp.zeros((world,) + value.shape, value.dtype).at[idx].set(value)
-            gathered = lax.psum(buf, axis_name)
+            value = jnp.asarray(value)
+            bound = (extend_valid or {}).get(name)
+            if bound is not None:
+                # valid-prefix trim: ship the covering power-of-2 bucket,
+                # not the full capacity (bound is static — the host knows
+                # the counts; a traced bound cannot size an XLA shape)
+                keep = min(_pow2_cover(bound), value.shape[0])
+                value = lax.slice_in_dim(value, 0, keep, axis=0)
+            wire = value
+            if (
+                compression == "bf16"
+                and jnp.issubdtype(value.dtype, jnp.floating)
+                and value.dtype != jnp.bfloat16
+                and value.size * value.dtype.itemsize > 1024
+            ):
+                wire = value.astype(jnp.bfloat16)
+            gathered = gather_replicated(wire, axis_name)
+            if wire.dtype != value.dtype:
+                gathered = gathered.astype(value.dtype)
             synced[name] = jnp.reshape(
                 gathered, (-1,) + tuple(value.shape[1:])
             )

@@ -103,21 +103,47 @@ def _is_local_replica(group: ProcessGroup) -> bool:
     return isinstance(group.unwrap(), LocalReplicaGroup)
 
 
+def _select_replicas(replicas, group: ProcessGroup, what: str) -> list:
+    """The member replicas of a local-replica (sub)group.
+
+    A whole group takes the full per-replica list. A subgroup
+    (``LocalReplicaGroup.new_subgroup``) additionally accepts the PARENT
+    world's full list and selects the member ranks — the reference's
+    subset semantics: non-member replicas are never read or touched.
+    """
+    if not isinstance(replicas, (list, tuple)):
+        raise TypeError(
+            f"With a LocalReplicaGroup, pass the per-replica list of "
+            f"{what} (one per device/replica)."
+        )
+    inner = group.unwrap()
+    member_ranks = getattr(inner, "_member_ranks", None)
+    parent_world = getattr(inner, "parent_world", None)
+    if (
+        member_ranks is not None
+        and parent_world is not None
+        and len(replicas) == parent_world
+        and parent_world != group.world_size
+    ):
+        return [replicas[r] for r in member_ranks]
+    if len(replicas) != group.world_size:
+        expected = (
+            f"{group.world_size}"
+            if parent_world in (None, group.world_size)
+            else f"{group.world_size} (members) or {parent_world} (parent world)"
+        )
+        raise ValueError(
+            f"Got {len(replicas)} replicas for a group of world_size "
+            f"{expected}."
+        )
+    return list(replicas)
+
+
 def _as_replica_list(
     metric: MetricOrReplicas, group: ProcessGroup
 ) -> Optional[List[Metric]]:
     if _is_local_replica(group):
-        if not isinstance(metric, (list, tuple)):
-            raise TypeError(
-                "With a LocalReplicaGroup, pass the per-replica list of "
-                "metrics (one per device/replica)."
-            )
-        if len(metric) != group.world_size:
-            raise ValueError(
-                f"Got {len(metric)} replicas for a group of world_size "
-                f"{group.world_size}."
-            )
-        return list(metric)
+        return _select_replicas(metric, group, "metrics")
     return None
 
 
@@ -186,6 +212,21 @@ def get_synced_metric_collection(
     :func:`sync_and_compute`."""
     group = _resolve_group(process_group, on_failure)
 
+    if not group.is_member:
+        # subgroup semantics (reference toolkit.py:34-67 with a subset
+        # process_group): a non-member process returns its local metrics
+        # UNTOUCHED and issues no collective
+        coll = metrics if isinstance(metrics, dict) else metrics[0]
+        provenance = SyncProvenance(
+            ranks=(),
+            world_size=group.world_size,
+            degraded=False,
+            policy=getattr(group, "degradation_policy", "raise"),
+        )
+        for m in coll.values():
+            m.sync_provenance = provenance
+        return coll
+
     if group.world_size == 1 and not _is_local_replica(group):
         _logger.warning(
             "World size is 1, and metric states are not synced; "
@@ -205,27 +246,22 @@ def get_synced_metric_collection(
         return coll
 
     if _is_local_replica(group):
-        replicas = metrics
-        if not isinstance(replicas, (list, tuple)):
-            raise TypeError(
-                "With a LocalReplicaGroup, pass the per-replica list of "
-                "metric collections."
-            )
-        if len(replicas) != group.world_size:
-            raise ValueError(
-                f"Got {len(replicas)} replicas for world_size {group.world_size}."
-            )
+        replicas = _select_replicas(metrics, group, "metric collections")
         for coll in replicas:
             for m in coll.values():
                 m._prepare_for_merge_state()
+        # _sync_state_dict, not state_dict: buffered/windowed metrics trim
+        # their payloads to the valid prefix (docs/distributed.md,
+        # "Payload trimming"); checkpoints keep the full state_dict
         payload = [
-            {name: m.state_dict() for name, m in coll.items()} for coll in replicas
+            {name: m._sync_state_dict() for name, m in coll.items()}
+            for coll in replicas
         ]
         template = replicas[0]
     else:
         for m in metrics.values():
             m._prepare_for_merge_state()
-        payload = {name: m.state_dict() for name, m in metrics.items()}
+        payload = {name: m._sync_state_dict() for name, m in metrics.items()}
         template = metrics
 
     per_rank_states = synclib.sync_states(payload, group)
@@ -388,12 +424,17 @@ def update_collection(
     from torcheval_tpu.metrics._bucket import apply_bucketing
     from torcheval_tpu.metrics._fuse import fused_accumulate_group
     from torcheval_tpu.metrics.metric import UpdatePlan
+    from torcheval_tpu.utils.convert import shared_conversion_cache
 
     items = list(metrics.values() if isinstance(metrics, dict) else metrics)
     # pass 1: build every fusable plan FIRST — each plan runs its metric's
     # input validation eagerly, so a batch any PLAN rejects raises before
     # any metric has mutated state (fallback metrics can only validate
-    # inside their own update, in pass 2)
+    # inside their own update, in pass 2). The shared conversion cache
+    # makes the K metrics' `_input` coercions of the SAME batch one
+    # conversion per argument, not K (jax arrays are immutable, so
+    # sharing the converted array across metrics is safe; pinned by
+    # test_update_collection.py::test_panel_converts_each_input_once).
     fallback: List[Metric] = []
     # two independent group dispatches: plans REWRITTEN for their shape
     # bucket vs everything else. Grouping them together would make the
@@ -405,32 +446,33 @@ def update_collection(
     groups = {False: ([], []), True: ([], [])}  # bucketed -> (fusable, plans)
     # one pad per (array, bucket) even when K metrics share the batch
     pad_cache: dict = {}
-    for metric in items:
-        plan = metric._update_plan(*args, **kwargs)
-        if plan is None:
-            fallback.append(metric)
-            continue
-        bucketed = False
-        if isinstance(plan, UpdatePlan):
-            rewritten = apply_bucketing(plan, pad_cache)
-            bucketed = rewritten is not plan
-            plan = rewritten
-            kernel, names, dynamic, config = (
-                plan.kernel, plan.state_names, plan.dynamic, plan.config
-            )
-            transform, finalize = plan.transform, plan.finalize
-        else:
-            kernel, names, dynamic, *rest = plan
-            config = rest[0] if rest else ()
-            transform, finalize = False, None
-        states = tuple(getattr(metric, n) for n in names)
-        fusable, plans = groups[bucketed]
-        fusable.append((metric, names, finalize))
-        plans.append((kernel, states, dynamic, config, transform))
-    # pass 2: execute — fallbacks still validate themselves, but only after
-    # every collected plan has passed validation
-    for metric in fallback:
-        metric.update(*args, **kwargs)
+    with shared_conversion_cache():
+        for metric in items:
+            plan = metric._update_plan(*args, **kwargs)
+            if plan is None:
+                fallback.append(metric)
+                continue
+            bucketed = False
+            if isinstance(plan, UpdatePlan):
+                rewritten = apply_bucketing(plan, pad_cache)
+                bucketed = rewritten is not plan
+                plan = rewritten
+                kernel, names, dynamic, config = (
+                    plan.kernel, plan.state_names, plan.dynamic, plan.config
+                )
+                transform, finalize = plan.transform, plan.finalize
+            else:
+                kernel, names, dynamic, *rest = plan
+                config = rest[0] if rest else ()
+                transform, finalize = False, None
+            states = tuple(getattr(metric, n) for n in names)
+            fusable, plans = groups[bucketed]
+            fusable.append((metric, names, finalize))
+            plans.append((kernel, states, dynamic, config, transform))
+        # pass 2: execute — fallbacks still validate themselves, but only
+        # after every collected plan has passed validation
+        for metric in fallback:
+            metric.update(*args, **kwargs)
     for fusable, plans in groups.values():
         if not plans:
             continue

@@ -152,6 +152,24 @@ class WindowedBinaryAUROC(RingCursorSerializationMixin, Metric[jax.Array]):
             transform=True, finalize=finalize,
         )
 
+    def _sync_state_dict(self):
+        """Valid-prefix payload trimming: until the ring wraps, the filled
+        region is exactly the column prefix ``[0, total_samples)`` — a sync
+        ships only that prefix instead of the full preallocated
+        ``max_num_samples`` window (a 16k-sample window holding 100 samples
+        ships ~KBs, not ~192 KiB). ``merge_state`` reads peers'
+        ``[:, :min(total, max)]`` and ``compute``'s partial-window probe
+        sees an empty (trivially all-zero) suffix, so trimmed and full
+        snapshots merge bit-identically
+        (tests/metrics/test_payload_trimming.py). A wrapped ring is fully
+        valid and ships whole."""
+        sd = super()._sync_state_dict()
+        filled = min(self.total_samples, self.max_num_samples)
+        if filled < self.max_num_samples:
+            for name in ("inputs", "targets", "weights"):
+                sd[name] = sd[name][:, :filled]
+        return sd
+
     def compute(self) -> jax.Array:
         """AUROC per task over the windowed samples; empty before updates."""
         if self.total_samples == 0:

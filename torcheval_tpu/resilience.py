@@ -231,62 +231,108 @@ def _harvest(box: Dict[str, Any]) -> Any:
     return box["value"]
 
 
-# ONE process-wide worker shared by every ResilientGroup: the sync path is
-# caller-serial, and a per-group worker would leak one never-exiting daemon
-# thread per auto-wrapped toolkit call (config-driven wrapping constructs a
-# fresh group per sync). A timed-out worker is poisoned globally — its
-# thread is stuck inside the abandoned collective — and the next call
-# creates a replacement.
-_WORKER_LOCK = threading.Lock()
-_SHARED_WORKER: Optional[_SyncWorker] = None
-# abandoned attempts still in flight — (done event, its worker) —
-# PROCESS-WIDE: the collective-sequence fence must survive group objects
-# (config-driven wrapping constructs a fresh ResilientGroup per sync), so
-# it cannot live on the group
-_IN_FLIGHT: List[Tuple[threading.Event, _SyncWorker]] = []
+# ONE worker per CALLER THREAD, shared by every ResilientGroup that thread
+# drives: the sync path is caller-serial PER THREAD, and a per-group worker
+# would leak one never-exiting daemon thread per auto-wrapped toolkit call
+# (config-driven wrapping constructs a fresh group per sync). Thread-local,
+# not process-global: concurrent caller threads (a multi-threaded eval
+# driver, ThreadWorld rank emulation) are independent collective sequences
+# — serializing them through one worker would deadlock rendezvousing
+# collectives, and one thread's straggler must not fence another thread's
+# healthy sync. A timed-out worker is poisoned for its thread — its thread
+# is stuck inside the abandoned collective — and the next call creates a
+# replacement.
+_TLS = threading.local()
+
+
+class _WorkerBox(list):
+    """1-slot box holding a caller thread's idle reusable worker.
+
+    When the caller thread dies, its thread-local storage is released and
+    this box is garbage-collected: stop the idle worker then, so each
+    exiting caller thread does not leave a permanently-parked
+    'torcheval-sync' daemon behind.
+    """
+
+    def __del__(self) -> None:
+        worker = self[0] if self else None
+        if worker is not None:
+            try:
+                worker.stop()
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
+
+
+class _InFlightList(list):
+    """A caller thread's abandoned (done event, worker) attempts.
+
+    On the caller thread's exit this list is GC'd: enqueue each worker's
+    stop sentinel so a straggler whose collective eventually LANDS drains
+    the sentinel next and exits, instead of parking in ``_jobs.get()``
+    forever (the process-global design reclaimed these from any thread;
+    thread-local state must reclaim them at teardown). A worker stuck in
+    a never-returning collective stays stuck — unreclaimable by
+    construction, it dies with the process, same as before.
+    """
+
+    def __del__(self) -> None:
+        for _done, worker in self:
+            try:
+                worker.stop()
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
+
+
+def _tls_state() -> Tuple[List[Optional[_SyncWorker]], list]:
+    """This caller thread's (shared-worker box, in-flight list)."""
+    if not hasattr(_TLS, "worker_box"):
+        _TLS.worker_box = _WorkerBox([None])
+        # abandoned attempts still in flight — PER-THREAD but surviving
+        # group objects (config-driven wrapping constructs a fresh
+        # ResilientGroup per sync), so it cannot live on the group
+        _TLS.in_flight = _InFlightList()
+    return _TLS.worker_box, _TLS.in_flight
 
 
 def _reclaim_finished() -> None:
     """Recycle workers whose abandoned attempt has since completed: one is
     reinstated as the shared worker, surplus ones are stopped — a
     deadline miss whose collective lands late must not leak a thread."""
-    global _SHARED_WORKER
-    with _WORKER_LOCK:
-        pending = []
-        for done, worker in _IN_FLIGHT:
-            if not done.is_set():
-                pending.append((done, worker))
-            elif _SHARED_WORKER is None:
-                _SHARED_WORKER = worker  # idle again: back to work
-            else:
-                worker.stop()
-        _IN_FLIGHT[:] = pending
+    box, in_flight = _tls_state()
+    pending = []
+    for done, worker in in_flight:
+        if not done.is_set():
+            pending.append((done, worker))
+        elif box[0] is None:
+            box[0] = worker  # idle again: back to work
+        else:
+            worker.stop()
+    in_flight[:] = pending
 
 
 def _get_worker() -> _SyncWorker:
-    global _SHARED_WORKER
     _reclaim_finished()
-    with _WORKER_LOCK:
-        if _SHARED_WORKER is None:
-            _SHARED_WORKER = _SyncWorker()
-        return _SHARED_WORKER
+    box, _ = _tls_state()
+    if box[0] is None:
+        box[0] = _SyncWorker()
+    return box[0]
 
 
 def _poison_worker(worker: _SyncWorker, done: threading.Event) -> None:
-    global _SHARED_WORKER
-    with _WORKER_LOCK:
-        if _SHARED_WORKER is worker:
-            _SHARED_WORKER = None
-        _IN_FLIGHT.append((done, worker))
+    box, in_flight = _tls_state()
+    if box[0] is worker:
+        box[0] = None
+    in_flight.append((done, worker))
 
 
 def _still_in_flight(budget: float) -> bool:
-    """True when any abandoned collective is STILL running after waiting
-    up to ``budget`` seconds for the stragglers to land."""
+    """True when any abandoned collective of THIS caller thread is STILL
+    running after waiting up to ``budget`` seconds for the stragglers to
+    land."""
     deadline = time.monotonic() + max(budget, 0.0)
     _reclaim_finished()
-    with _WORKER_LOCK:
-        pending = [done for done, _ in _IN_FLIGHT]
+    _, in_flight = _tls_state()
+    pending = [done for done, _ in in_flight]
     stuck = False
     for done in pending:
         if not done.wait(max(deadline - time.monotonic(), 0.0)):
@@ -401,6 +447,32 @@ class ResilientGroup(ProcessGroup):
 
     def unwrap(self) -> ProcessGroup:
         return self._inner.unwrap()
+
+    @property
+    def is_member(self) -> bool:
+        return self._inner.is_member
+
+    @property
+    def ranks(self):
+        return self._inner.ranks
+
+    def new_subgroup(self, ranks) -> "ResilientGroup":
+        """Subgroup scoping composes with resilience: the inner group's
+        subgroup, wrapped with THIS group's knobs and the same shared
+        :class:`SyncHealth` (quorum fractions then apply to the SUBGROUP's
+        world size — docs/fault-tolerance.md, "Subgroups")."""
+        return ResilientGroup(
+            self._inner.new_subgroup(ranks),
+            timeout=self.timeout,
+            retries=self.retries,
+            policy=self.policy,
+            quorum=self.quorum,
+            backoff_base=self.backoff_base,
+            backoff_max=self.backoff_max,
+            backoff_jitter=self.backoff_jitter,
+            seed=self.seed,
+            health=self.health,
+        )
 
     @property
     def degradation_policy(self) -> str:

@@ -10,13 +10,42 @@ semantics at the reference's API boundary.
 
 from __future__ import annotations
 
+import contextvars
 import math
+from contextlib import contextmanager
 from functools import lru_cache
 from typing import Any, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# Per-call shared input-conversion memo (see shared_conversion_cache):
+# None = caching off (the default for plain metric.update calls).
+_CONVERSION_CACHE: "contextvars.ContextVar[Optional[dict]]" = (
+    contextvars.ContextVar("torcheval_conversion_cache", default=None)
+)
+
+
+@contextmanager
+def shared_conversion_cache():
+    """Scope within which ``to_jax`` memoizes conversions per source object.
+
+    ``toolkit.update_collection`` feeds ONE batch to K metrics; without
+    this, each metric's ``_input`` re-coerces (and for host inputs,
+    re-uploads) the same arrays K times — the dominant share of the
+    per-metric Python preamble on a K-metric panel (bench.py
+    ``sync_payload`` sibling finding; pinned by
+    tests/metrics/test_update_collection.py::test_panel_converts_each_input_once).
+    Keys are ``id``-based with the source object pinned in the entry, so
+    id reuse after garbage collection cannot alias; the cache must not
+    outlive the call that created it.
+    """
+    token = _CONVERSION_CACHE.set({})
+    try:
+        yield
+    finally:
+        _CONVERSION_CACHE.reset(token)
 
 try:  # torch is an optional front-end, never a requirement.
     import torch as _torch
@@ -50,6 +79,24 @@ def to_jax(
     store it). Callers that keep updating a preallocated torch buffer after
     passing it to a buffering metric must pass a copy themselves.
     """
+    cache = _CONVERSION_CACHE.get()
+    if cache is not None:
+        key = (id(x), None if dtype is None else str(dtype), device)
+        hit = cache.get(key)
+        if hit is not None and hit[0] is x:
+            return hit[1]
+        arr = _to_jax_impl(x, dtype=dtype, device=device)
+        cache[key] = (x, arr)  # pin the source: id is only valid while alive
+        return arr
+    return _to_jax_impl(x, dtype=dtype, device=device)
+
+
+def _to_jax_impl(
+    x: TensorLike,
+    *,
+    dtype: Optional[jnp.dtype] = None,
+    device: Optional[jax.Device] = None,
+) -> jax.Array:
     if isinstance(x, jax.Array):
         arr = x if dtype is None else x.astype(dtype)
     elif is_torch_tensor(x):

@@ -10,6 +10,10 @@ from torcheval_tpu.utils.test_utils.fault_injection import (
 from torcheval_tpu.utils.test_utils.metric_class_tester import (
     MetricClassTester,
 )
+from torcheval_tpu.utils.test_utils.thread_world import (
+    ThreadRankGroup,
+    ThreadWorld,
+)
 
 __all__ = [
     "DummySumMetric",
@@ -18,4 +22,6 @@ __all__ = [
     "FaultInjectionGroup",
     "FaultSpec",
     "MetricClassTester",
+    "ThreadRankGroup",
+    "ThreadWorld",
 ]

@@ -124,6 +124,14 @@ class FaultInjectionGroup(ProcessGroup):
     def unwrap(self) -> ProcessGroup:
         return self._inner.unwrap()
 
+    @property
+    def is_member(self) -> bool:
+        return self._inner.is_member
+
+    @property
+    def ranks(self):
+        return self._inner.ranks
+
     # ----------------------------------------------------------------- faults
 
     def _active(self, call: int) -> List[FaultSpec]:

@@ -1,6 +1,6 @@
-"""Varying-manual-axes helpers (shard_map vma bookkeeping).
+"""Varying-manual-axes helpers (shard_map vma/replication bookkeeping).
 
-Two consumers:
+Three consumers:
 
 - scan-carrying parallel primitives (ring attention, GPipe): a
   ``lax.scan`` carry inside ``shard_map`` must be typed varying over every
@@ -12,12 +12,18 @@ Two consumers:
   (pinned by tests/parallel/test_composed_mesh.py);
 - native-kernel outputs (``metrics/functional/tensor_utils._match_vma``):
   ffi_call results come back unmarked and must re-acquire their
-  reference operand's vma.
+  reference operand's vma;
+- the in-jit EXTEND state sync (``metrics/sharded.py``): a true
+  ``lax.all_gather`` produces a value that IS identical on every shard of
+  the gathered axes, but shard_map's replication checker does not know
+  that, so an unpartitioned ``out_specs`` rejects it.
+  :func:`gather_replicated` performs the gather AND makes the checker
+  accept the result, choosing the best mechanism the running jax offers.
 """
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Tuple, Union
 
 import jax
 from jax import lax
@@ -26,6 +32,8 @@ from jax import lax
 # bookkeeping, so on those versions both helpers reduce to no-ops (there is
 # no carry-type mismatch to repair when nothing is tracked).
 _HAS_VMA = hasattr(jax, "typeof") and hasattr(lax, "pcast")
+
+AxisNames = Union[str, Tuple[str, ...]]
 
 
 def _leaf_vma(leaf: Any) -> Tuple[str, ...]:
@@ -54,3 +62,102 @@ def pcast_varying(x: jax.Array, vary_axes: Tuple[str, ...]) -> jax.Array:
         return x
     missing = tuple(a for a in vary_axes if a not in _leaf_vma(x))
     return lax.pcast(x, missing, to="varying") if missing else x
+
+
+# ------------------------------------------------- replicated all_gather
+
+# Tri-state: None = not probed yet; True = the running jax's shard_map
+# rule tables accepted the all_gather replication rule; False = no table
+# to patch (use the psum fallback unless all_gather_invariant exists).
+_AG_RULE_INSTALLED = None
+
+
+def _axis_tuple(axis_name: AxisNames) -> Tuple[str, ...]:
+    return axis_name if isinstance(axis_name, tuple) else (axis_name,)
+
+
+def _install_all_gather_replication_rule() -> bool:
+    """Teach pre-vma shard_map that a (full-group, tiled or stacked)
+    ``all_gather`` output is replicated over the gathered axes.
+
+    jax <= 0.4.x ships shard_map with only the varying->varying "standard
+    collective" rule for ``all_gather_p`` — mathematically too weak (the
+    gathered value IS equal on every shard of the axis), which is why the
+    in-jit EXTEND sync historically used a gather-as-psum (O(world x size)
+    wire) instead. Registering the missing-but-correct rule in both of
+    shard_map's rule tables (the jaxpr replication check and the
+    efficient-transpose rewrite) lets the true all_gather through. Gathers
+    over ``axis_index_groups`` subsets keep the conservative old behavior:
+    a subgroup gather is NOT globally replicated.
+    """
+    global _AG_RULE_INSTALLED
+    if _AG_RULE_INSTALLED is not None:
+        return _AG_RULE_INSTALLED
+    try:
+        from jax.experimental import shard_map as _sm
+        from jax._src.lax import parallel as _par
+
+        ag_p = _par.all_gather_p
+        check_rules = _sm._check_rules
+        rewrite_rules = _sm._rewrite_rules
+    except (ImportError, AttributeError):
+        _AG_RULE_INSTALLED = False
+        return False
+
+    def _ag_check(mesh, x_rep, *, axis_name, axis_index_groups=None, **params):
+        del mesh, params
+        names = _axis_tuple(axis_name)
+        if axis_index_groups is not None or x_rep is None:
+            return x_rep
+        return set(x_rep) | set(names)
+
+    def _ag_rewrite(mesh, in_rep, x, *, axis_name,
+                    axis_index_groups=None, **params):
+        del mesh
+        names = _axis_tuple(axis_name)
+        (x_rep,) = in_rep
+        out = ag_p.bind(
+            x, axis_name=axis_name, axis_index_groups=axis_index_groups,
+            **params,
+        )
+        if axis_index_groups is not None:
+            return [out], [set(x_rep)]
+        return [out], [set(x_rep) | set(names)]
+
+    check_rules[ag_p] = _ag_check
+    rewrite_rules[ag_p] = _ag_rewrite
+    _AG_RULE_INSTALLED = True
+    return True
+
+
+def gather_replicated(x: jax.Array, axis_name: AxisNames) -> jax.Array:
+    """``lax.all_gather(x, axis_name, tiled=True)`` whose result passes
+    shard_map's replication checker — concatenation along axis 0, shards
+    ordered by the axes' row-major linear index.
+
+    Wire cost is the all-gather's O(size) per hop, not the historical
+    psum trick's O(world x size) zero-buffer all-reduce (pinned by
+    tests/metrics/test_sync_collective_structure.py). Mechanism, best
+    first: native ``lax.all_gather_invariant`` (vma-capable jax), the
+    installed replication rule (pre-vma jax, see
+    :func:`_install_all_gather_replication_rule`), else the psum trick as
+    a correctness fallback on jax versions with neither.
+    """
+    if hasattr(lax, "all_gather_invariant"):
+        return lax.all_gather_invariant(x, axis_name, tiled=True)
+    if _install_all_gather_replication_rule():
+        return lax.all_gather(x, axis_name, tiled=True)
+    # fallback: scatter into a zero [world, ...] buffer and all-reduce —
+    # psum output is statically known replicated on every jax version
+    names = _axis_tuple(axis_name)
+    world = 1
+    idx = 0
+    for name in names:  # row-major linearization matches all_gather order
+        size = lax.psum(1, name)
+        world = world * size
+        idx = idx * size + lax.axis_index(name)
+    import jax.numpy as jnp
+
+    buf = jnp.zeros((world,) + x.shape, x.dtype).at[idx].set(x)
+    gathered = lax.psum(buf, names)
+    return jnp.reshape(gathered, (-1,) + tuple(x.shape[1:]))
