@@ -1,0 +1,760 @@
+"""Crash matrix for elastic evaluation (ISSUE 4).
+
+Every injected two-phase-commit crash point (pre-shard, mid-shard,
+pre-manifest, post-manifest) and filesystem fault (truncated shard,
+corrupted shard bytes, corrupted manifest digest) must leave a bundle
+from which ``ElasticSession.restore()`` + continued (fenced) updates
+produce ``compute()`` results BIT-IDENTICAL to the uninterrupted run —
+with no batch double-counted and no partial generation ever loaded.
+World-size-change resumes (4→2 and 2→4 over ``ThreadWorld``) redistribute
+per-rank states through ``merge_state`` and must match the same-order
+merge oracle exactly. Survivor re-formation: after N consecutive syncs
+missing the same ranks, ``ResilientGroup`` re-forms onto the survivors
+and subsequent syncs run undegraded with subgroup-relative provenance.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from torcheval_tpu.elastic import CRASH_POINTS, ElasticSession
+from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
+from torcheval_tpu.metrics.toolkit import (
+    clone_metric,
+    get_synced_metric,
+    sync_and_compute,
+)
+from torcheval_tpu.resilience import ResilientGroup
+from torcheval_tpu.utils.test_utils import (
+    FaultInjectionGroup,
+    InjectedCrash,
+    SnapshotCrashPlan,
+    ThreadWorld,
+    corrupt_manifest_digest,
+    corrupt_shard,
+    truncate_shard,
+)
+
+STEPS = 10
+INTERVAL = 3
+
+
+def _batches(seed: int, steps: int = STEPS):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            np.float32(rng.uniform(size=(8, 4))),
+            rng.integers(0, 4, 8),
+        )
+        for _ in range(steps)
+    ]
+
+
+def _fresh():
+    return {"acc": MulticlassAccuracy(), "auroc": BinaryAUROC()}
+
+
+def _feed(metrics, batch):
+    scores, target = batch
+    metrics["acc"].update(scores, target)
+    metrics["auroc"].update(scores[:, 0], (target == 0).astype(np.float32))
+
+
+def _values(metrics):
+    return {k: np.asarray(m.compute()) for k, m in metrics.items()}
+
+
+def _assert_bit_identical(got, want):
+    for name in want:
+        assert np.array_equal(got[name], want[name]), name
+
+
+def _oracle(batches):
+    metrics = _fresh()
+    for batch in batches:
+        _feed(metrics, batch)
+    return _values(metrics)
+
+
+def _resume_and_finish(directory, batches, *, interval=INTERVAL):
+    """A 'restarted process': fresh metrics, restore, fenced replay."""
+    metrics = _fresh()
+    session = ElasticSession(metrics, directory, interval=interval)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        restored = session.restore()
+    for step, batch in enumerate(batches):
+        if not session.fence(step):
+            continue
+        _feed(metrics, batch)
+        session.step_done(step)
+    session.close()
+    return metrics, restored
+
+
+# ------------------------------------------------------------ crash matrix
+
+
+@pytest.mark.parametrize("point", CRASH_POINTS)
+@pytest.mark.parametrize("at_snapshot", [0, 1])
+def test_crash_matrix_resumes_bit_identical(tmp_path, point, at_snapshot):
+    batches = _batches(11)
+    metrics = _fresh()
+    plan = SnapshotCrashPlan(point, at_snapshot=at_snapshot)
+    session = ElasticSession(
+        metrics, str(tmp_path), interval=INTERVAL, fault_hook=plan
+    )
+    with pytest.raises(InjectedCrash):
+        for step, batch in enumerate(batches):
+            _feed(metrics, batch)
+            session.step_done(step)
+    assert plan.crashed
+
+    resumed, restored = _resume_and_finish(str(tmp_path), batches)
+    _assert_bit_identical(_values(resumed), _oracle(batches))
+    # no batch double-counted: the sample count equals the oracle's
+    assert resumed["auroc"].num_samples == STEPS * 8
+    # a crash before the FIRST commit means a fresh start, never garbage
+    committed_any = point == "post-manifest" or at_snapshot > 0
+    assert (restored is not None) == committed_any
+
+
+def test_no_partial_generation_is_ever_loaded(tmp_path):
+    """A crash between shard write and manifest commit leaves an
+    UNCOMMITTED generation: restore must not touch it, even though its
+    shard file is fully written and internally consistent."""
+    batches = _batches(12)
+    metrics = _fresh()
+    plan = SnapshotCrashPlan("pre-manifest", at_snapshot=1)
+    session = ElasticSession(
+        metrics, str(tmp_path), interval=INTERVAL, fault_hook=plan
+    )
+    with pytest.raises(InjectedCrash):
+        for step, batch in enumerate(batches):
+            _feed(metrics, batch)
+            session.step_done(step)
+    gen_dirs = sorted(p for p in os.listdir(tmp_path) if p.startswith("gen-"))
+    assert len(gen_dirs) == 2  # gen 0 committed, gen 1 torn
+    assert not os.path.exists(tmp_path / gen_dirs[1] / "MANIFEST.json")
+
+    _, restored = _resume_and_finish(str(tmp_path), batches)
+    assert restored is not None and restored.generation == 0
+    assert restored.step == INTERVAL  # the committed cursor, not the torn one
+
+
+@pytest.mark.parametrize(
+    "fault",
+    [
+        lambda d, g: truncate_shard(d, g),
+        lambda d, g: corrupt_shard(d, g),
+        lambda d, g: corrupt_manifest_digest(d, g),
+    ],
+    ids=["truncated-shard", "corrupt-shard", "corrupt-manifest-digest"],
+)
+def test_fs_fault_falls_back_one_generation(tmp_path, fault):
+    batches = _batches(13)
+    metrics = _fresh()
+    session = ElasticSession(
+        metrics, str(tmp_path), interval=INTERVAL, retention=3
+    )
+    for step, batch in enumerate(batches):
+        _feed(metrics, batch)
+        session.step_done(step)
+    session.close()
+    newest = max(
+        int(p.split("-")[1]) for p in os.listdir(tmp_path) if p.startswith("gen-")
+    )
+    fault(str(tmp_path), newest)
+
+    resumed, restored = _resume_and_finish(str(tmp_path), batches)
+    assert restored is not None and restored.generation == newest - 1
+    _assert_bit_identical(_values(resumed), _oracle(batches))
+    assert resumed["auroc"].num_samples == STEPS * 8
+
+
+def test_double_resume_counts_nothing_twice(tmp_path):
+    """Resume, crash again BEFORE any new snapshot, resume again: the
+    second resume restores the same generation and the fence still admits
+    every uncovered batch exactly once."""
+    batches = _batches(14)
+    metrics = _fresh()
+    session = ElasticSession(metrics, str(tmp_path), interval=INTERVAL)
+    for step, batch in enumerate(batches[:5]):
+        _feed(metrics, batch)
+        session.step_done(step)
+    session.close()
+
+    # first resume: process ONE more step, then "die" (no snapshot: the
+    # interval is not due)
+    m1 = _fresh()
+    s1 = ElasticSession(m1, str(tmp_path), interval=INTERVAL)
+    r1 = s1.restore()
+    assert r1.step == INTERVAL
+    _feed(m1, batches[r1.step])
+    s1.step_done(r1.step)
+
+    # second resume: same generation, full fenced replay
+    resumed, r2 = _resume_and_finish(str(tmp_path), batches)
+    assert r2.generation == r1.generation and r2.step == r1.step
+    _assert_bit_identical(_values(resumed), _oracle(batches))
+    assert resumed["auroc"].num_samples == STEPS * 8
+
+
+def test_out_of_order_step_is_rejected(tmp_path):
+    metrics = _fresh()
+    session = ElasticSession(metrics, str(tmp_path), interval=INTERVAL)
+    for step, batch in enumerate(_batches(15)[:5]):
+        _feed(metrics, batch)
+        session.step_done(step)
+    session.close()
+    m2 = _fresh()
+    s2 = ElasticSession(m2, str(tmp_path), interval=INTERVAL)
+    s2.restore()
+    with pytest.raises(RuntimeError, match="fence"):
+        s2.step_done(0)  # already covered by the snapshot
+
+
+def test_retention_rotates_old_generations(tmp_path):
+    metrics = _fresh()
+    session = ElasticSession(
+        metrics, str(tmp_path), interval=2, retention=2
+    )
+    for step, batch in enumerate(_batches(16)):
+        _feed(metrics, batch)
+        session.step_done(step)
+    session.close()
+    gens = sorted(p for p in os.listdir(tmp_path) if p.startswith("gen-"))
+    assert gens == ["gen-00000003", "gen-00000004"]  # newest 2 of 5
+
+
+def test_restore_returns_none_on_fresh_directory(tmp_path):
+    session = ElasticSession(_fresh(), str(tmp_path))
+    assert session.restore() is None
+    assert session.cursor == 0 and session.fence(0)
+
+
+def test_payload_rides_the_bundle(tmp_path):
+    metrics = _fresh()
+    session = ElasticSession(metrics, str(tmp_path), interval=2)
+    for step, batch in enumerate(_batches(17)[:4]):
+        _feed(metrics, batch)
+        session.step_done(step, payload={"iterator": step})
+    session.close()
+    s2 = ElasticSession(_fresh(), str(tmp_path), interval=2)
+    restored = s2.restore()
+    # the payload captured at the snapshot-triggering step
+    assert restored.payload == {"iterator": 3}
+    assert restored.payloads == ({"iterator": 3},)
+
+
+def test_payload_is_retained_until_the_next_snapshot(tmp_path):
+    """A payload passed on a NON-snapshot step must still ride the next
+    snapshot — users only pass it when their iterator state changes."""
+    metrics = _fresh()
+    session = ElasticSession(metrics, str(tmp_path), interval=4)
+    for step, batch in enumerate(_batches(20)[:4]):
+        _feed(metrics, batch)
+        # payload only on step 1; the interval fires at step 3
+        session.step_done(
+            step, payload={"it": 1} if step == 1 else None
+        )
+    session.close()
+    restored = ElasticSession(_fresh(), str(tmp_path), interval=4).restore()
+    assert restored.payload == {"it": 1}
+
+
+def test_writer_recoverable_error_keeps_collective_lockstep(tmp_path):
+    """A per-generation writer failure (ENOSPC-style Exception, not a
+    crash) is ferried to the caller but the writer keeps attempting later
+    queued generations — silently skipping them would desynchronize the
+    digest gathers rank-wide."""
+    batches = _batches(24)
+    metrics = _fresh()
+    session = ElasticSession(
+        metrics, str(tmp_path), interval=INTERVAL, async_writer=True
+    )
+    real_write = session._write_bundle
+    failed = []
+
+    def flaky_write(generation, *args):
+        if generation == 0 and not failed:
+            failed.append(generation)
+            raise OSError("no space left on device")
+        return real_write(generation, *args)
+
+    session._write_bundle = flaky_write
+    session._writer._write_bundle = flaky_write
+    ferried = []
+    for step, batch in enumerate(batches):
+        _feed(metrics, batch)
+        try:
+            session.step_done(step)
+        except OSError as e:  # the loop logs the failed snapshot and keeps on
+            ferried.append(e)
+            # the ferried error raises BEFORE the cursor advance, so the
+            # step is not yet counted: simply retry
+            session.step_done(step)
+    session.close()
+    assert len(ferried) == 1 and "no space left" in str(ferried[0])
+    # generation 0 failed, but LATER generations were still written
+    committed = sorted(
+        p for p in os.listdir(tmp_path)
+        if p.startswith("gen-")
+        and os.path.exists(tmp_path / p / "MANIFEST.json")
+    )
+    assert committed and committed[-1] > "gen-00000000"
+    assert not os.path.exists(tmp_path / "gen-00000000" / "MANIFEST.json")
+
+
+def test_local_replica_group_is_rejected(tmp_path):
+    import jax
+
+    from torcheval_tpu.distributed import LocalReplicaGroup
+
+    group = LocalReplicaGroup(jax.local_devices()[:1])
+    with pytest.raises(TypeError, match="LocalReplicaGroup"):
+        ElasticSession(_fresh(), str(tmp_path), process_group=group)
+
+
+# ------------------------------------------------------------- async mode
+
+
+def test_async_snapshots_restore_bit_identical(tmp_path):
+    batches = _batches(18)
+    metrics = _fresh()
+    with ElasticSession(
+        metrics, str(tmp_path), interval=INTERVAL, async_writer=True
+    ) as session:
+        for step, batch in enumerate(batches[:7]):
+            _feed(metrics, batch)
+            session.step_done(step)
+        session.drain()  # every queued generation is on disk now
+    resumed, restored = _resume_and_finish(str(tmp_path), batches)
+    assert restored is not None and restored.step == 6
+    _assert_bit_identical(_values(resumed), _oracle(batches))
+
+
+def test_async_crash_is_ferried_to_close(tmp_path):
+    """A crash on the background writer (a preemption mid-write) must not
+    vanish: the drain/close path re-raises it, and the on-disk state
+    still resumes bit-identically."""
+    batches = _batches(19)
+    metrics = _fresh()
+    plan = SnapshotCrashPlan("pre-manifest", at_snapshot=1)
+    session = ElasticSession(
+        metrics,
+        str(tmp_path),
+        interval=INTERVAL,
+        async_writer=True,
+        fault_hook=plan,
+    )
+    with pytest.raises(InjectedCrash):
+        for step, batch in enumerate(batches):
+            _feed(metrics, batch)
+            session.step_done(step)
+        session.close()
+    assert plan.crashed
+
+    resumed, restored = _resume_and_finish(str(tmp_path), batches)
+    assert restored is not None and restored.generation == 0
+    _assert_bit_identical(_values(resumed), _oracle(batches))
+
+
+def test_restore_quarantines_unusable_newer_generations(tmp_path):
+    """A committed-but-corrupt generation must not occupy a retention
+    slot after a fallback restore — left in place, the next rotation
+    could evict the very generation that just saved the run."""
+    batches = _batches(21)
+    metrics = _fresh()
+    session = ElasticSession(
+        metrics, str(tmp_path), interval=INTERVAL, retention=2
+    )
+    for step, batch in enumerate(batches):
+        _feed(metrics, batch)
+        session.step_done(step)
+    session.close()
+    newest = max(
+        int(p.split("-")[1]) for p in os.listdir(tmp_path) if p.startswith("gen-")
+    )
+    corrupt_shard(str(tmp_path), newest)
+
+    probe = ElasticSession(_fresh(), str(tmp_path), interval=INTERVAL)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        restored = probe.restore()
+    assert restored.generation == newest - 1
+    # the corrupt generation was quarantined (deleted) by the restore, so
+    # the restored one cannot be rotated out by it — the number is then
+    # free for the resumed run's next (valid) commit
+    assert not os.path.exists(tmp_path / f"gen-{newest:08d}")
+    probe.close()
+
+    resumed, restored = _resume_and_finish(str(tmp_path), batches)
+    assert restored.generation == newest - 1
+    _assert_bit_identical(_values(resumed), _oracle(batches))
+
+
+def test_generation_divergence_fails_loudly_at_commit(tmp_path):
+    """Ranks that disagree on the next generation number (divergent
+    directory scans) must fail the commit, not publish a manifest whose
+    digests reference shards in another generation's directory."""
+    directory = str(tmp_path)
+    world = ThreadWorld(2)
+
+    def body(g):
+        metrics = _fresh()
+        session = ElasticSession(
+            metrics, directory, process_group=g, interval=100
+        )
+        if g.rank == 1:
+            session._next_gen += 1  # simulate a divergent directory scan
+        _feed(metrics, _batches(22)[0])
+        session.step_done(0)
+        if g.rank == 0:
+            with pytest.raises(RuntimeError, match="generations \\[0, 1\\]"):
+                session.snapshot()
+        else:
+            session.snapshot()  # non-leader: writes its shard, no commit
+        return True
+
+    assert world.run(body) == [True, True]
+
+
+def test_async_snapshots_use_a_dedicated_communicator(tmp_path):
+    """The async writer thread must not share a collective sequence with
+    main-thread metric syncs: the session scopes its own whole-world
+    subgroup, so syncs issued while snapshots are in flight stay
+    correctly paired on every rank."""
+    directory = str(tmp_path)
+    world = ThreadWorld(2)
+    per_rank = _per_rank_batches(2, 9, seed=23)
+
+    def body(g):
+        metrics = _fresh()
+        session = ElasticSession(
+            metrics,
+            directory,
+            process_group=g,
+            interval=3,
+            async_writer=True,
+        )
+        assert session._comm is not g  # dedicated communicator
+        values = []
+        for step in range(9):
+            _feed(metrics, per_rank[g.rank][step])
+            session.step_done(step)
+            # a metric sync on the ORIGINAL group every step, while the
+            # writer may be mid-snapshot on its own communicator
+            values.append(
+                float(np.asarray(sync_and_compute(metrics["acc"], g)))
+            )
+        session.close()
+        return values
+
+    results = world.run(body)
+    assert results[0] == results[1]  # every sync paired correctly
+    # and the bundles restore fine at the same world size
+    def body_restore(g):
+        metrics = _fresh()
+        session = ElasticSession(metrics, directory, process_group=g)
+        restored = session.restore()
+        return restored.step
+
+    assert ThreadWorld(2).run(body_restore) == [9, 9]
+
+
+# ------------------------------------------------- world-size-change resume
+
+
+def _per_rank_batches(world, steps, seed):
+    rng = np.random.default_rng(seed)
+    return [
+        [
+            (
+                np.float32(rng.uniform(size=(8, 4))),
+                rng.integers(0, 4, 8),
+            )
+            for _ in range(steps)
+        ]
+        for _ in range(world)
+    ]
+
+
+def _world_change(tmp_path, old_world, new_world):
+    pre = _per_rank_batches(old_world, 6, seed=100 + old_world)
+    post = _per_rank_batches(new_world, 4, seed=200 + new_world)
+    directory = str(tmp_path)
+
+    def body_old(g):
+        metrics = _fresh()
+        session = ElasticSession(
+            metrics, directory, process_group=g, interval=3
+        )
+        for step in range(6):
+            _feed(metrics, pre[g.rank][step])
+            session.step_done(step)
+        session.close()
+
+    ThreadWorld(old_world).run(body_old)
+
+    def body_new(g):
+        metrics = _fresh()
+        session = ElasticSession(
+            metrics, directory, process_group=g, interval=3
+        )
+        restored = session.restore()
+        for step in range(restored.step, restored.step + 4):
+            _feed(metrics, post[g.rank][step - restored.step])
+            session.step_done(step)
+        session.close()
+        synced = {
+            name: get_synced_metric(m, g) for name, m in metrics.items()
+        }
+        return restored, _values(synced), synced["auroc"].num_samples
+
+    results = ThreadWorld(new_world).run(body_new)
+
+    # redistribute ORACLE, in-memory: old-rank metrics fed the pre-crash
+    # stream, contiguously merged onto the new ranks exactly as restore()
+    # does, then fed the post-resume stream and merged across new ranks —
+    # the merge order an uninterrupted elastic run implies.
+    old = [_fresh() for _ in range(old_world)]
+    for rank in range(old_world):
+        for step in range(6):
+            _feed(old[rank], pre[rank][step])
+    from torcheval_tpu.elastic import _assign_shards
+
+    assignment = _assign_shards(old_world, new_world)
+    new = []
+    for rank in range(new_world):
+        assigned = assignment[rank]
+        metrics = _fresh()
+        for name in metrics:
+            peers = [clone_metric(old[r][name]) for r in assigned]
+            if peers:
+                metrics[name] = peers[0]
+                metrics[name].merge_state(peers[1:])
+        new.append(metrics)
+    for rank in range(new_world):
+        for step in range(4):
+            _feed(new[rank], post[rank][step])
+    merged = new[0]
+    for name in merged:
+        merged[name].merge_state([new[r][name] for r in range(1, new_world)])
+    oracle = _values(merged)
+
+    for rank in range(new_world):
+        restored, values, num_samples = results[rank]
+        assert restored.world_size == old_world
+        assert restored.step == 6
+        _assert_bit_identical(values, oracle)
+    # every old rank's shard was assigned exactly once, in ascending order
+    all_assigned = [r for res in results for r in res[0].assigned_ranks]
+    assert all_assigned == list(range(old_world))
+    # no sample lost or double-counted across the world change
+    assert results[0][2] == old_world * 6 * 8 + new_world * 4 * 8
+
+
+def test_world_shrink_4_to_2(tmp_path):
+    _world_change(tmp_path, 4, 2)
+
+
+def test_world_grow_2_to_4(tmp_path):
+    _world_change(tmp_path, 2, 4)
+
+
+# ------------------------------------------------- survivor re-formation
+
+
+def _metric_for(rank):
+    rng = np.random.default_rng(rank)
+    m = MulticlassAccuracy()
+    m.update(np.float32(rng.uniform(size=(16, 4))), rng.integers(0, 4, 16))
+    return m
+
+
+def test_reform_after_consecutive_degraded_syncs():
+    """After ``reform_after`` consecutive quorum-degraded syncs missing
+    the SAME rank, the group re-forms onto the survivors: subsequent
+    syncs run undegraded with subgroup-relative provenance, the reform is
+    visible in SyncHealth, and every provenance from the reform on is
+    stamped ``reformed=True``."""
+    world = ThreadWorld(4)
+
+    def body(g):
+        if g.rank == 3:
+            # the dying host: present for the first two (degraded) syncs,
+            # then gone — it never observes the reform
+            for _ in range(2):
+                get_synced_metric(_metric_for(g.rank), g)
+            return None
+        chaos = FaultInjectionGroup(g, dead_ranks={3})
+        group = ResilientGroup(
+            chaos, timeout=10.0, policy="quorum", reform_after=2
+        )
+        provs = []
+        for _ in range(4):
+            synced = get_synced_metric(_metric_for(g.rank), group)
+            provs.append(synced.sync_provenance)
+        return provs, group.health.as_dict(), group.ranks, float(
+            np.asarray(synced.compute())
+        )
+
+    results = world.run(body)
+    # the post-reform merged value: survivors 0..2, full participation
+    oracle = _metric_for(0)
+    oracle.merge_state([_metric_for(1), _metric_for(2)])
+    want = float(np.asarray(oracle.compute()))
+    for rank in range(3):
+        provs, health, ranks, value = results[rank]
+        # sync 0: degraded, pre-reform
+        assert provs[0].degraded and provs[0].world_size == 4
+        assert provs[0].ranks == (0, 1, 2) and not provs[0].reformed
+        # sync 1: still the old world (the reform lands AFTER the sync
+        # completes), but the reform event is stamped
+        assert provs[1].degraded and provs[1].world_size == 4
+        assert provs[1].reformed
+        # syncs 2-3: survivors-only subgroup, undegraded, full speed
+        for p in provs[2:]:
+            assert not p.degraded
+            assert p.world_size == 3 and p.ranks == (0, 1, 2)
+            assert p.reformed
+        assert health["reforms"] == 1
+        assert health["reformed_to"] == [0, 1, 2]
+        assert health["degraded_syncs"] == 2
+        assert health["full_syncs"] == 2
+        assert ranks == (0, 1, 2)  # the active group is the subgroup
+        assert value == want
+
+
+def test_reform_requires_same_missing_ranks():
+    """Two degraded syncs missing DIFFERENT ranks must not escalate —
+    only a PERSISTENT failure re-forms the group."""
+    world = ThreadWorld(3)
+
+    def body(g):
+        chaos = FaultInjectionGroup(g)
+        group = ResilientGroup(
+            chaos, timeout=10.0, policy="quorum", reform_after=2
+        )
+        from torcheval_tpu.utils.test_utils import FaultSpec
+
+        # sync 0 loses rank 1 (both collectives), sync 1 loses rank 2
+        chaos.faults.extend(
+            [
+                FaultSpec(call=0, kind="drop", rank=1, times=2),
+                FaultSpec(call=2, kind="drop", rank=2, times=2),
+            ]
+        )
+        provs = []
+        for _ in range(2):
+            synced = get_synced_metric(_metric_for(g.rank), group)
+            provs.append(synced.sync_provenance)
+        return provs, group.health.as_dict()
+
+    results = world.run(body)
+    for provs, health in results:
+        assert all(not p.reformed for p in provs)
+        assert health["reforms"] == 0
+        assert health["consecutive_missing_count"] <= 1  # streak reset
+    # rank 0 observed both losses (it was never the dropped rank itself):
+    # two degraded syncs, different survivors, no escalation
+    provs0, _ = results[0]
+    assert provs0[0].ranks != provs0[1].ranks
+    assert all(p.degraded for p in provs0)
+
+
+def test_reform_composes_with_elastic_resume(tmp_path):
+    """The full elastic story: a rank dies, the survivors re-form and
+    keep snapshotting on the smaller world; a replacement job restores
+    those bundles at the new world size."""
+    directory = str(tmp_path)
+    world = ThreadWorld(4)
+    pre = _per_rank_batches(4, 4, seed=42)
+
+    def body(g):
+        metrics = _fresh()
+        if g.rank == 3:
+            # dies before contributing anything durable: participates in
+            # the two degraded syncs, writes no snapshot
+            for _ in range(2):
+                get_synced_metric({"acc": _metric_for(g.rank)}["acc"], g)
+            return None
+        chaos = FaultInjectionGroup(g, dead_ranks={3})
+        group = ResilientGroup(
+            chaos, timeout=10.0, policy="quorum", reform_after=2
+        )
+        for _ in range(2):  # ride out the dead rank; triggers the reform
+            get_synced_metric(_metric_for(g.rank), group)
+        assert group.world_size == 3
+        # survivors snapshot on the REFORMED world: rank identities and
+        # world size come from the reformed group
+        session = ElasticSession(
+            metrics, directory, process_group=group, interval=2
+        )
+        for step in range(4):
+            _feed(metrics, pre[g.rank][step])
+            session.step_done(step)
+        session.close()
+        return sync_and_compute(metrics["acc"], group)
+
+    world.run(body)
+
+    # a replacement 2-rank job restores the 3-survivor bundles
+    def body_new(g):
+        metrics = _fresh()
+        session = ElasticSession(metrics, directory, process_group=g)
+        restored = session.restore()
+        synced = get_synced_metric(metrics["acc"], g)
+        return restored, np.asarray(synced.compute())
+
+    results = ThreadWorld(2).run(body_new)
+    oracle = _fresh()
+    for rank in range(3):
+        for step in range(4):
+            _feed(oracle, pre[rank][step])
+    for restored, value in results:
+        assert restored.world_size == 3 and restored.step == 4
+        assert np.array_equal(value, np.asarray(oracle["acc"].compute()))
+
+
+# ------------------------------------------------------ provenance hygiene
+
+
+def test_reset_clears_stale_sync_provenance():
+    """Satellite regression: ``Metric.reset()`` (and a state restore)
+    must drop the provenance a prior degraded sync attached — stale
+    ``degraded=True`` on a reset metric misreports fresh state."""
+    from torcheval_tpu.resilience import SyncProvenance
+
+    m = _metric_for(0)
+    m.sync_provenance = SyncProvenance(
+        ranks=(0,), world_size=4, degraded=True, policy="quorum"
+    )
+    m.reset()
+    assert not hasattr(m, "sync_provenance")
+
+    m = _metric_for(0)
+    m.sync_provenance = SyncProvenance(
+        ranks=(0,), world_size=4, degraded=True, policy="quorum"
+    )
+    m.load_state_dict(_metric_for(1).state_dict())
+    assert not hasattr(m, "sync_provenance")
+
+
+def test_checkpoint_restore_clears_stale_sync_provenance(tmp_path):
+    from torcheval_tpu.resilience import SyncProvenance
+    from torcheval_tpu.utils import load_metric_state, save_metric_state
+
+    m = _metric_for(0)
+    save_metric_state(m, str(tmp_path / "ck"))
+    target = _metric_for(1)
+    target.sync_provenance = SyncProvenance(
+        ranks=(0,), world_size=4, degraded=True, policy="quorum"
+    )
+    load_metric_state(target, str(tmp_path / "ck"))
+    assert not hasattr(target, "sync_provenance")

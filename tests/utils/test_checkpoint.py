@@ -364,3 +364,126 @@ def test_window_cursor_survives_resume(tmp_path):
         uninterrupted.update(x, t)
         resumed.update(x, t)
     assert_result_close(resumed.compute(), uninterrupted.compute())
+
+
+# ------------------------------------- restored-state validation (ISSUE 4)
+
+
+def test_mismatched_shape_fails_naming_the_leaf(tmp_path):
+    """A checkpoint from a differently-configured metric (another
+    num_classes) must fail with an error naming the offending leaf path,
+    not a cryptic downstream jax broadcast error."""
+    from torcheval_tpu.metrics import MulticlassConfusionMatrix
+
+    m = MulticlassConfusionMatrix(4)
+    m.update(
+        jnp.asarray(RNG.random((8, 4)), jnp.float32),
+        jnp.asarray(RNG.integers(0, 4, 8)),
+    )
+    save_metric_state(m, str(tmp_path / "cm"))
+    with pytest.raises(
+        RuntimeError,
+        match=r"state 'confusion_matrix' holds int32\[4, 4\] but "
+        r"MulticlassConfusionMatrix registered int32\[8, 8\]",
+    ):
+        load_metric_state(MulticlassConfusionMatrix(8), str(tmp_path / "cm"))
+
+
+def test_mismatched_collection_leaf_names_metric_prefix(tmp_path):
+    from torcheval_tpu.metrics import MulticlassConfusionMatrix
+
+    save_metric_state(
+        {"cm": MulticlassConfusionMatrix(4)}, str(tmp_path / "coll")
+    )
+    with pytest.raises(RuntimeError, match="state 'cm.confusion_matrix'"):
+        load_metric_state(
+            {"cm": MulticlassConfusionMatrix(3)}, str(tmp_path / "coll")
+        )
+
+
+def test_kind_mismatch_fails_clearly(tmp_path, monkeypatch):
+    """An array leaf where the metric registered a scalar state (a
+    hand-edited or cross-version checkpoint) is caught by kind."""
+    import torcheval_tpu.utils.checkpoint as ckpt
+
+    m = Throughput()
+    m.update(100, 2.5)
+    save_metric_state(m, str(tmp_path / "tp"))
+    tree = ckpt._checkpointer().restore(str(tmp_path / "tp"))
+    tree["__single__"]["num_total"] = np.zeros(3, np.float32)
+    monkeypatch.setattr(ckpt, "_digest", lambda t: "00" * 32)
+    tree.pop("__digest__")
+    ckpt._checkpointer().save(str(tmp_path / "tp"), tree, force=True)
+    monkeypatch.undo()
+    with pytest.raises(
+        RuntimeError, match="'num_total' holds 'ndarray' but Throughput"
+    ):
+        load_metric_state(Throughput(), str(tmp_path / "tp"))
+
+
+def test_growable_buffer_shapes_still_load(tmp_path):
+    """Buffered metrics register a lazy 0-size sentinel; their restored
+    buffers legitimately differ in shape/dtype and must keep loading."""
+    m = BinaryAUROC()
+    x = RNG.random(100).astype(np.float32)
+    m.update(x, (RNG.random(100) < x).astype(np.float32))
+    restored = _roundtrip(tmp_path, m, BinaryAUROC())
+    assert_result_close(restored.compute(), m.compute())
+
+
+# ---------------------------------- concurrent-writer detection (ISSUE 4)
+
+
+def test_concurrent_writer_to_same_path_fails_loudly(tmp_path):
+    """The fixed (pid-less) tmp/old sibling protocol is single-writer by
+    design: a second live writer to the SAME path must fail loudly, not
+    silently interleave renames."""
+    import torcheval_tpu.utils.checkpoint as ckpt
+
+    m = _feed_acc(MulticlassAccuracy())
+    path = tmp_path / "ck"
+    # a live writer's lock (fresh mtime)
+    with open(str(path) + ".lock", "w") as f:
+        f.write("pid=other t=now\n")
+    with pytest.raises(RuntimeError, match="another save_metric_state writer"):
+        save_metric_state(m, str(path))
+    assert not path.exists()  # the contender wrote nothing
+    # distinct paths never contend
+    save_metric_state(m, str(tmp_path / "other"))
+
+
+def test_stale_lock_from_crashed_writer_is_broken(tmp_path):
+    """A lock left by a crashed writer (older than _LOCK_STALE_SECONDS)
+    is broken with a warning instead of wedging every future save."""
+    import torcheval_tpu.utils.checkpoint as ckpt
+
+    m = _feed_acc(MulticlassAccuracy())
+    path = tmp_path / "ck"
+    lock = str(path) + ".lock"
+    with open(lock, "w") as f:
+        f.write("pid=dead\n")
+    old = os.path.getmtime(lock) - ckpt._LOCK_STALE_SECONDS - 10
+    os.utime(lock, (old, old))
+    with pytest.warns(RuntimeWarning, match="breaking stale checkpoint lock"):
+        save_metric_state(m, str(path))
+    restored = load_metric_state(MulticlassAccuracy(), str(path))
+    assert_result_close(restored.compute(), m.compute())
+    assert not os.path.exists(lock)
+
+
+def test_lock_released_after_failed_save(tmp_path, monkeypatch):
+    """A save that raises must not leave its lock behind (the next save
+    would misdiagnose a concurrent writer)."""
+    import torcheval_tpu.utils.checkpoint as ckpt
+
+    class _Exploding:
+        def save(self, p, tree, force=False):
+            raise RuntimeError("disk full")
+
+    monkeypatch.setattr(ckpt, "_checkpointer", lambda: _Exploding())
+    m = _feed_acc(MulticlassAccuracy())
+    with pytest.raises(RuntimeError, match="disk full"):
+        save_metric_state(m, str(tmp_path / "ck"))
+    monkeypatch.undo()
+    assert not os.path.exists(str(tmp_path / "ck") + ".lock")
+    save_metric_state(m, str(tmp_path / "ck"))  # lock did not wedge

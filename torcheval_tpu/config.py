@@ -27,7 +27,12 @@ the all-ranks-alive default (so a dead host degrades a metrics sync instead
 of hanging the pod). The fourth is ``validate_inputs`` (``off``/``warn``/
 ``raise``): a NaN/Inf finite-check at the ``Metric.update`` front door —
 value-level, so it forces a device readback per update and defaults off,
-same budget reasoning as ``debug_validation``.
+same budget reasoning as ``debug_validation``. The fifth family is
+*elastic evaluation* (docs/fault-tolerance.md, "Elastic evaluation"):
+``snapshot_interval`` / ``snapshot_retention`` default the
+``elastic.ElasticSession`` snapshot cadence and on-disk generation count,
+and ``sync_reform_after`` sets the persistent-failure escalation threshold
+at which a quorum-degrading ``ResilientGroup`` re-forms onto survivors.
 
 There is deliberately no config-file/flag system beyond these: the reference
 uses plain constructor kwargs (SURVEY.md section 5.6) and so do we.
@@ -282,6 +287,72 @@ def sync_resilience(
         yield
     finally:
         (_sync_timeout, _sync_retries, _sync_degradation, _sync_quorum) = prev
+
+
+# ------------------------------------------------------ elastic evaluation
+
+_SNAPSHOT_INTERVAL_DEFAULT = 100
+_snapshot_interval: int = _env_int(
+    "TORCHEVAL_TPU_SNAPSHOT_INTERVAL", _SNAPSHOT_INTERVAL_DEFAULT, minimum=1
+)
+_SNAPSHOT_RETENTION_DEFAULT = 2
+_snapshot_retention: int = _env_int(
+    "TORCHEVAL_TPU_SNAPSHOT_RETENTION", _SNAPSHOT_RETENTION_DEFAULT, minimum=1
+)
+_sync_reform_after: int = _env_int(
+    "TORCHEVAL_TPU_SYNC_REFORM_AFTER", 0, minimum=0
+)
+
+
+def snapshot_interval() -> int:
+    """Default steps between ``elastic.ElasticSession`` snapshots
+    (default 100). Env ``TORCHEVAL_TPU_SNAPSHOT_INTERVAL``."""
+    return _snapshot_interval
+
+
+def set_snapshot_interval(steps: int) -> None:
+    global _snapshot_interval
+    if int(steps) < 1:
+        raise ValueError(f"snapshot_interval must be >= 1 step, got {steps}")
+    _snapshot_interval = int(steps)
+
+
+def snapshot_retention() -> int:
+    """Default number of committed snapshot generations an
+    ``elastic.ElasticSession`` keeps on disk (default 2 — the newest plus
+    one fallback for torn-write recovery). Env
+    ``TORCHEVAL_TPU_SNAPSHOT_RETENTION``."""
+    return _snapshot_retention
+
+
+def set_snapshot_retention(generations: int) -> None:
+    global _snapshot_retention
+    if int(generations) < 1:
+        raise ValueError(
+            f"snapshot_retention must keep >= 1 generation, got {generations}"
+        )
+    _snapshot_retention = int(generations)
+
+
+def sync_reform_after() -> int:
+    """Persistent-failure escalation threshold for
+    ``resilience.ResilientGroup``: after this many CONSECUTIVE
+    quorum-degraded syncs missing the SAME ranks, the group re-forms onto
+    a survivors-only subgroup so later syncs run undegraded. ``0``
+    (default) disables re-formation. Requires a long-lived, explicitly
+    constructed group — the streak lives on the group object
+    (docs/fault-tolerance.md, "Survivor re-formation"). Env
+    ``TORCHEVAL_TPU_SYNC_REFORM_AFTER``."""
+    return _sync_reform_after
+
+
+def set_sync_reform_after(syncs: int) -> None:
+    global _sync_reform_after
+    if int(syncs) < 0:
+        raise ValueError(
+            f"sync_reform_after must be >= 0 (0 disables), got {syncs}"
+        )
+    _sync_reform_after = int(syncs)
 
 
 # ------------------------------------------------------- sync compression

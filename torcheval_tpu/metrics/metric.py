@@ -426,6 +426,9 @@ class Metric(Generic[TComputeReturn], ABC):
                 )
             else:
                 setattr(self, name, self._place_state(self._clone_state(default)))
+        # a provenance left by a prior (possibly degraded) sync describes
+        # state this reset just discarded — it must not outlive it
+        self.__dict__.pop("sync_provenance", None)
         return self
 
     # ---------------------------------------------------------- serialization
@@ -475,6 +478,9 @@ class Metric(Generic[TComputeReturn], ABC):
             value = state_dict[name]
             self._check_state_variable_type(name, value)
             setattr(self, name, self._place_state(self._clone_state(value)))
+        # restored state replaces whatever a prior sync produced: drop the
+        # stale provenance (the sync path re-attaches its own afterwards)
+        self.__dict__.pop("sync_provenance", None)
 
     # ---------------------------------------------------------------- devices
 

@@ -267,22 +267,29 @@ def get_synced_metric_collection(
     per_rank_states = synclib.sync_states(payload, group)
 
     # degraded-result provenance: which ranks actually contributed (full
-    # participation unless a ResilientGroup degraded the exchange)
+    # participation unless a ResilientGroup degraded the exchange). The
+    # world size comes from the SYNC itself, not the group: a
+    # persistent-failure escalation may have re-formed the group onto a
+    # survivors-only subgroup DURING this sync (effective next sync), and
+    # the triggering sync's provenance must still be relative to the
+    # world it actually ran on.
     ranks = tuple(
         getattr(per_rank_states, "ranks", None)
         or range(len(per_rank_states))
     )
+    world = getattr(per_rank_states, "world_size", 0) or group.world_size
     provenance = SyncProvenance(
         ranks=ranks,
-        world_size=group.world_size,
-        degraded=len(ranks) < group.world_size,
+        world_size=world,
+        degraded=len(ranks) < world,
         policy=getattr(group, "degradation_policy", "raise"),
+        reformed=bool(getattr(group, "was_reformed", False)),
     )
     if provenance.degraded:
         _logger.warning(
             "Metric sync degraded: merged state reflects ranks %s of %d "
             "(policy %r); result may be stale.",
-            list(ranks), group.world_size, provenance.policy,
+            list(ranks), world, provenance.policy,
         )
 
     merged: Dict[str, Metric] = {}

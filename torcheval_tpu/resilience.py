@@ -23,9 +23,18 @@ to intervene. This module brings that posture to the metric sync path:
     ``quorum`` (a fraction of world size) arrived.
 
 - :class:`SyncHealth` is the observability record (attempts, retries,
-  timeouts, corrupt payloads, last good sync, participating ranks) exposed
-  on every ``ResilientGroup`` — the sync-path sibling of
+  timeouts, corrupt payloads, last good sync, participating ranks, reform
+  events) exposed on every ``ResilientGroup`` — the sync-path sibling of
   ``utils.CompileCounter``.
+
+- **Survivor re-formation** (persistent-failure escalation, PCCL's peer
+  eviction as a metrics-layer policy): with ``reform_after=N`` (or
+  ``config.sync_reform_after()``), ``N`` consecutive quorum-degraded syncs
+  missing the SAME ranks re-form the group onto a survivors-only subgroup
+  (``new_subgroup``) — later syncs run full-speed and undegraded instead
+  of paying the deadline/quorum machinery for a rank that stays dead
+  forever. Reform events land in :class:`SyncHealth` and are stamped into
+  every subsequent :class:`SyncProvenance` (``reformed=True``).
 
 The happy path adds **zero extra collectives** (pinned by
 ``tests/metrics/test_sync_collective_counts.py``): the wrapper forwards each
@@ -137,6 +146,10 @@ class SyncProvenance(NamedTuple):
     world_size: int
     degraded: bool  # True when ranks != all of world (result may be stale)
     policy: str
+    # True once the group has re-formed onto a survivors-only subgroup
+    # (persistent-failure escalation): ranks/world_size are then relative
+    # to the REFORMED subgroup — map to global ranks via ``group.ranks``.
+    reformed: bool = False
 
 
 @dataclass
@@ -162,6 +175,11 @@ class SyncHealth:
     participating_ranks: Tuple[int, ...] = ()  # most recent sync's ranks
     world_size: int = 0
     policy: str = "raise"
+    # survivor re-formation (persistent-failure escalation)
+    reforms: int = 0  # times the group re-formed onto survivors
+    reformed_to: Tuple[int, ...] = ()  # GLOBAL ranks of the active group
+    consecutive_missing: Tuple[int, ...] = ()  # current same-missing streak
+    consecutive_missing_count: int = 0  # length of that streak
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
     )
@@ -180,6 +198,10 @@ class SyncHealth:
             "participating_ranks": list(self.participating_ranks),
             "world_size": self.world_size,
             "policy": self.policy,
+            "reforms": self.reforms,
+            "reformed_to": list(self.reformed_to),
+            "consecutive_missing": list(self.consecutive_missing),
+            "consecutive_missing_count": self.consecutive_missing_count,
         }
 
 
@@ -370,6 +392,15 @@ class ResilientGroup(ProcessGroup):
             backoff schedule ``min(base * 2**k, max) * (1 + jitter * u)``
             with ``u`` drawn from a ``random.Random(seed)`` — fully
             deterministic for a given seed and call sequence.
+        reform_after: persistent-failure escalation threshold (default
+            from ``config.sync_reform_after()``, 0 = disabled): after this
+            many CONSECUTIVE quorum-degraded syncs missing the SAME ranks
+            the group re-forms onto a survivors-only subgroup
+            (``inner.new_subgroup``), so later syncs run full-speed
+            undegraded. Only meaningful under ``policy="quorum"`` and a
+            long-lived group object — the streak lives here, not in
+            config state. See docs/fault-tolerance.md,
+            "Survivor re-formation".
         health: share an existing :class:`SyncHealth` (used by
             :meth:`with_policy`); a fresh one is created by default.
 
@@ -397,11 +428,28 @@ class ResilientGroup(ProcessGroup):
         backoff_max: float = 2.0,
         backoff_jitter: float = 0.5,
         seed: int = 0,
+        reform_after: Optional[int] = None,
         health: Optional[SyncHealth] = None,
     ) -> None:
         from torcheval_tpu import config
 
         self._inner = inner
+        # the group collectives actually run on: ``inner`` until a
+        # persistent-failure escalation re-forms onto a survivors-only
+        # subgroup of it (see ``note_sync_result``)
+        self._active: ProcessGroup = inner
+        self.reform_after = (
+            config.sync_reform_after()
+            if reform_after is None
+            else int(reform_after)
+        )
+        if self.reform_after < 0:
+            raise ValueError(
+                f"reform_after must be >= 0 (0 disables), got {reform_after}"
+            )
+        self.reform_count = 0
+        self._missing_streak: Tuple[int, ...] = ()
+        self._streak = 0
         self.timeout = (
             config.sync_timeout()
             if timeout is None
@@ -439,30 +487,32 @@ class ResilientGroup(ProcessGroup):
 
     @property
     def world_size(self) -> int:
-        return self._inner.world_size
+        return self._active.world_size
 
     @property
     def rank(self) -> int:
-        return self._inner.rank
+        return self._active.rank
 
     def unwrap(self) -> ProcessGroup:
-        return self._inner.unwrap()
+        return self._active.unwrap()
 
     @property
     def is_member(self) -> bool:
-        return self._inner.is_member
+        return self._active.is_member
 
     @property
     def ranks(self):
-        return self._inner.ranks
+        return self._active.ranks
 
     def new_subgroup(self, ranks) -> "ResilientGroup":
-        """Subgroup scoping composes with resilience: the inner group's
-        subgroup, wrapped with THIS group's knobs and the same shared
-        :class:`SyncHealth` (quorum fractions then apply to the SUBGROUP's
-        world size — docs/fault-tolerance.md, "Subgroups")."""
+        """Subgroup scoping composes with resilience: the active group's
+        subgroup (ranks are relative to the group the caller sees — the
+        reformed subgroup after an escalation), wrapped with THIS group's
+        knobs and the same shared :class:`SyncHealth` (quorum fractions
+        then apply to the SUBGROUP's world size —
+        docs/fault-tolerance.md, "Subgroups")."""
         return ResilientGroup(
-            self._inner.new_subgroup(ranks),
+            self._active.new_subgroup(ranks),
             timeout=self.timeout,
             retries=self.retries,
             policy=self.policy,
@@ -471,6 +521,7 @@ class ResilientGroup(ProcessGroup):
             backoff_max=self.backoff_max,
             backoff_jitter=self.backoff_jitter,
             seed=self.seed,
+            reform_after=self.reform_after,
             health=self.health,
         )
 
@@ -487,10 +538,13 @@ class ResilientGroup(ProcessGroup):
     def with_policy(self, policy: str) -> "ResilientGroup":
         """A sibling wrapper around the same inner group and the same
         :class:`SyncHealth`, differing only in degradation policy (used by
-        the toolkit's per-call ``on_failure=`` override)."""
+        the toolkit's per-call ``on_failure=`` override). The sibling
+        inherits this group's re-formation state (active subgroup,
+        escalation streak), but its own future escalations do not write
+        back — reuse the original group for a durable escalation record."""
         if policy == self.policy:
             return self
-        return ResilientGroup(
+        sibling = ResilientGroup(
             self._inner,
             timeout=self.timeout,
             retries=self.retries,
@@ -500,8 +554,15 @@ class ResilientGroup(ProcessGroup):
             backoff_max=self.backoff_max,
             backoff_jitter=self.backoff_jitter,
             seed=self.seed,
+            reform_after=self.reform_after,
             health=self.health,
         )
+        sibling._active = self._active
+        sibling._local_mode = self._local_mode
+        sibling.reform_count = self.reform_count
+        sibling._missing_streak = self._missing_streak
+        sibling._streak = self._streak
+        return sibling
 
     # ------------------------------------------------------------- observers
 
@@ -512,14 +573,69 @@ class ResilientGroup(ProcessGroup):
 
     def note_sync_result(self, ranks: List[int], world: int) -> None:
         """Called by ``synclib`` with the final surviving-rank set of one
-        whole state sync (after cross-collective intersection)."""
+        whole state sync (after cross-collective intersection). Drives the
+        persistent-failure escalation: ``reform_after`` consecutive
+        degraded syncs missing the SAME ranks re-form this group onto the
+        survivors (``_reform``) — effective from the NEXT sync."""
+        alive = set(ranks)
+        missing = tuple(r for r in range(world) if r not in alive)
+        if not missing:
+            self._missing_streak, self._streak = (), 0
+        elif missing == self._missing_streak:
+            self._streak += 1
+        else:
+            self._missing_streak, self._streak = missing, 1
         with self.health._lock:
             self.health.participating_ranks = tuple(ranks)
+            self.health.consecutive_missing = self._missing_streak
+            self.health.consecutive_missing_count = self._streak
             if len(ranks) == world:
                 self.health.full_syncs += 1
                 self.health.last_good_sync = time.monotonic()
             else:
                 self.health.degraded_syncs += 1
+        if (
+            self.reform_after
+            and self.policy == "quorum"
+            and missing
+            and self._streak >= self.reform_after
+        ):
+            self._reform(list(ranks))
+
+    @property
+    def was_reformed(self) -> bool:
+        """True once this group escalated onto a survivors-only subgroup
+        (stamped into every subsequent :class:`SyncProvenance`)."""
+        return self.reform_count > 0
+
+    def _reform(self, survivors: List[int]) -> None:
+        """Escalate onto a survivors-only subgroup of the active group.
+
+        ``survivors`` are ACTIVE-group-relative ranks. Subsequent
+        collectives run on the subgroup — full-speed, undegraded — and
+        provenance/quorum become subgroup-relative. The dead ranks'
+        processes, if they ever come back, must rebuild their OWN group
+        (e.g. via ``elastic.ElasticSession`` resume); consistent with the
+        ``PartialGatherError`` contract, every surviving rank observed the
+        same survivor set for ``reform_after`` consecutive syncs, so every
+        survivor re-forms the same subgroup at the same sync index."""
+        try:
+            sub = self._active.new_subgroup(sorted(survivors))
+        except NotImplementedError:
+            # the inner group cannot scope to a subset (e.g. a bare test
+            # fake): keep degrading per-sync rather than failing the sync
+            self._missing_streak, self._streak = (), 0
+            return
+        self._active = sub
+        self._local_mode = isinstance(sub.unwrap(), LocalReplicaGroup)
+        self.reform_count += 1
+        self._missing_streak, self._streak = (), 0
+        with self.health._lock:
+            self.health.reforms += 1
+            self.health.reformed_to = tuple(sub.ranks)
+            self.health.world_size = sub.world_size
+            self.health.consecutive_missing = ()
+            self.health.consecutive_missing_count = 0
 
     # -------------------------------------------------------------- deadline
 
@@ -684,13 +800,13 @@ class ResilientGroup(ProcessGroup):
         self, obj: Any
     ) -> Tuple[List[Any], List[int]]:
         return self._resilient(
-            lambda: self._inner.allgather_object(obj),
+            lambda: self._active.allgather_object(obj),
             lambda: self._local_object(obj),
         )
 
     def allgather_array_with_ranks(self, x: Any) -> Tuple[List[Any], List[int]]:
         return self._resilient(
-            lambda: self._inner.allgather_array(x),
+            lambda: self._active.allgather_array(x),
             lambda: self._local_array(x),
         )
 

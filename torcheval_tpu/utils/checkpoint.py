@@ -18,6 +18,20 @@ Fault tolerance (docs/fault-tolerance.md):
   state leaf travels inside the checkpoint; ``load_metric_state`` recomputes
   it and rejects corrupt or truncated checkpoints with a clear error
   instead of silently restoring garbage into a resumed eval.
+- **Schema validation**: restored leaves are checked against the metric's
+  REGISTERED state shapes/dtypes before anything is loaded, so a
+  checkpoint from a differently-configured metric (e.g. another
+  ``num_classes``) fails with an error naming the offending leaf instead
+  of a cryptic downstream jax broadcast/dtype error.
+- **Single-writer protocol**: the atomic-publish temp/aside sibling names
+  (``<path>.tmp`` / ``<path>.old``) are deliberately FIXED (pid-less) so a
+  restarted process can recognize and recover a crashed predecessor's
+  leftovers — which means two live writers saving to the SAME path would
+  silently clobber each other's siblings and interleave renames. A
+  ``<path>.lock`` sentinel (created ``O_EXCL``) detects that race and
+  fails the second writer loudly; a lock older than
+  ``_LOCK_STALE_SECONDS`` is presumed to be a crashed writer's leftover
+  and is broken with a warning. Writers on different paths never contend.
 """
 
 from __future__ import annotations
@@ -25,6 +39,8 @@ from __future__ import annotations
 import hashlib
 import os
 import shutil
+import time
+import warnings
 from typing import Any, Dict, Union
 
 import jax
@@ -121,6 +137,142 @@ def _digest(tree: Any) -> str:
     return h.hexdigest()
 
 
+def _leaf_desc(value: Any) -> str:
+    import numpy as np
+
+    arr = np.asarray(value)
+    return f"{arr.dtype}[{', '.join(str(d) for d in arr.shape)}]"
+
+
+def validate_state_dict(
+    metric: Metric, state: Dict[str, Any], *, context: str, prefix: str = ""
+) -> None:
+    """Check a restored state tree against ``metric``'s REGISTERED states
+    (``_add_state`` defaults) and raise a clear :class:`RuntimeError`
+    naming the offending leaf path — instead of deferring to a cryptic
+    downstream jax broadcast/dtype error when the mismatched value is
+    first used.
+
+    Rules per registered default:
+
+    - array default with a real shape (``size > 0``): the restored leaf
+      must be an array of the SAME dtype and shape (a checkpoint from a
+      differently-configured metric — another ``num_classes``, window
+      size, bin count — fails here);
+    - array default that is a lazy 0-size sentinel (growable buffers fix
+      dtype/row shape on first append): only array-ness is checked;
+    - list / dict defaults: the restored leaf must be a list / dict
+      (element types are validated by ``load_state_dict``);
+    - int/float defaults: the restored leaf must be a scalar (python or
+      0-d numpy number).
+
+    Shared by :func:`load_metric_state` and
+    ``elastic.ElasticSession.restore``.
+    """
+    import numpy as np
+
+    what = type(metric).__name__
+    for name, value in state.items():
+        default = metric._state_name_to_default.get(name)
+        if default is None:
+            continue  # unknown names are strict-mode territory, not ours
+        leaf = f"{prefix}{name}"
+        if isinstance(default, (jax.Array, np.ndarray)):
+            if not isinstance(value, (jax.Array, np.ndarray)):
+                raise RuntimeError(
+                    f"{context}: state '{leaf}' holds "
+                    f"{type(value).__name__!r} but {what} registered an "
+                    f"array state ({_leaf_desc(default)})"
+                )
+            if np.asarray(default).size == 0:
+                continue  # lazy sentinel: dtype/shape fixed by first append
+            d, v = np.asarray(default), np.asarray(value)
+            if v.dtype != d.dtype or v.shape != d.shape:
+                raise RuntimeError(
+                    f"{context}: state '{leaf}' holds {_leaf_desc(value)} "
+                    f"but {what} registered {_leaf_desc(default)} — was "
+                    "the checkpoint written by a differently-configured "
+                    "metric?"
+                )
+        elif isinstance(default, list):
+            if not isinstance(value, (list, tuple)):
+                raise RuntimeError(
+                    f"{context}: state '{leaf}' holds "
+                    f"{type(value).__name__!r} but {what} registered a "
+                    "list state"
+                )
+        elif isinstance(default, dict):
+            if not isinstance(value, dict):
+                raise RuntimeError(
+                    f"{context}: state '{leaf}' holds "
+                    f"{type(value).__name__!r} but {what} registered a "
+                    "dict state"
+                )
+        elif isinstance(default, (int, float)):
+            scalar = isinstance(value, (int, float)) or (
+                isinstance(value, np.ndarray) and value.ndim == 0
+            ) or isinstance(value, np.number)
+            if not scalar:
+                raise RuntimeError(
+                    f"{context}: state '{leaf}' holds "
+                    f"{type(value).__name__!r} but {what} registered a "
+                    "scalar state"
+                )
+
+
+# A crashed writer's leftover lock is broken after this many seconds; a
+# YOUNGER foreign lock means a concurrent live writer — a loud error
+# (module-level so tests and long-save deployments can tune it).
+_LOCK_STALE_SECONDS = 600.0
+
+
+def _acquire_save_lock(path: str) -> str:
+    """Single-writer guard for one checkpoint path (module docstring)."""
+    lock = f"{path}.lock"
+    for attempt in (0, 1):
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, f"pid={os.getpid()} t={time.time()}\n".encode())
+            os.close(fd)
+            return lock
+        except FileExistsError:
+            try:
+                age = time.time() - os.path.getmtime(lock)
+            except OSError:
+                continue  # holder just released it — retry the O_EXCL
+            if age > _LOCK_STALE_SECONDS and attempt == 0:
+                warnings.warn(
+                    f"breaking stale checkpoint lock {lock} "
+                    f"({age:.0f}s old — presumed crashed writer)",
+                    RuntimeWarning,
+                )
+                # break by ATOMIC RENAME to a unique name, not unlink:
+                # with several contenders racing to break the same stale
+                # lock, an unlink could remove a rival's FRESH lock
+                # created a moment after the stale one vanished — rename
+                # moves exactly the stale file, and exactly one contender
+                # wins it (the losers fall through to the O_EXCL race)
+                tomb = f"{lock}.stale-{os.getpid()}-{time.monotonic_ns()}"
+                try:
+                    os.rename(lock, tomb)
+                    os.unlink(tomb)
+                except OSError:
+                    pass  # a rival broke it first; retry the O_EXCL
+                continue
+            raise RuntimeError(
+                f"another save_metric_state writer holds {lock}: the "
+                "atomic-publish protocol uses FIXED (pid-less) "
+                f"'{os.path.basename(path)}.tmp'/'.old' siblings so a "
+                "restarted process can recover a crashed save, which "
+                "makes two CONCURRENT writers to the same path mutually "
+                "destructive (silently interleaved renames). Serialize "
+                "savers or give each its own path; a lock older than "
+                f"{_LOCK_STALE_SECONDS:.0f}s is presumed stale and "
+                "broken automatically."
+            )
+    raise RuntimeError(f"could not acquire checkpoint lock {lock}")
+
+
 def save_metric_state(metric: MetricOrCollection, path: str) -> None:
     """Write a metric's (or a ``{name: Metric}`` collection's) state to
     ``path`` as an Orbax checkpoint — atomically, with an embedded payload
@@ -149,38 +301,47 @@ def save_metric_state(metric: MetricOrCollection, path: str) -> None:
     tree[_DIGEST_KEY] = np.frombuffer(
         bytes.fromhex(_digest(_from_plain(tree))), dtype=np.uint8
     ).copy()
-    # atomic publish: write a temp sibling, then rename into place — a
-    # crash mid-save leaves the previous checkpoint (or nothing), never a
-    # torn tree at the published path
-    # fixed (pid-less) sibling names: a restarted process recognizes and
-    # cleans up any leftovers from a crashed earlier save, and load can
-    # recover the aside copy from a swap interrupted mid-way
-    tmp = f"{path}.tmp"
-    old = f"{path}.old"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    # a previous save may have crashed between its two renames, leaving
-    # the last good snapshot ONLY at the aside name: recover it before
-    # anything clobbers it (mirrors load_metric_state's recovery)
-    if not os.path.exists(path) and os.path.exists(old):
-        os.rename(old, path)
-    _checkpointer().save(tmp, tree, force=True)
-    # the previous checkpoint is renamed ASIDE (never deleted) until the
-    # new one is in place, so no crash point destroys the last good
-    # snapshot; the aside copy is removed only after the swap lands
-    if os.path.exists(old):
-        shutil.rmtree(old)
-    had_old = os.path.exists(path)
-    if had_old:
-        os.rename(path, old)
+    # single-writer guard: the fixed sibling names below are only safe
+    # with ONE live writer per path (module docstring)
+    lock = _acquire_save_lock(path)
     try:
-        os.rename(tmp, path)
-    except BaseException:
+        # atomic publish: write a temp sibling, then rename into place — a
+        # crash mid-save leaves the previous checkpoint (or nothing), never
+        # a torn tree at the published path
+        # fixed (pid-less) sibling names: a restarted process recognizes
+        # and cleans up any leftovers from a crashed earlier save, and load
+        # can recover the aside copy from a swap interrupted mid-way
+        tmp = f"{path}.tmp"
+        old = f"{path}.old"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        # a previous save may have crashed between its two renames, leaving
+        # the last good snapshot ONLY at the aside name: recover it before
+        # anything clobbers it (mirrors load_metric_state's recovery)
+        if not os.path.exists(path) and os.path.exists(old):
+            os.rename(old, path)
+        _checkpointer().save(tmp, tree, force=True)
+        # the previous checkpoint is renamed ASIDE (never deleted) until
+        # the new one is in place, so no crash point destroys the last good
+        # snapshot; the aside copy is removed only after the swap lands
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        had_old = os.path.exists(path)
         if had_old:
-            os.rename(old, path)  # roll the previous checkpoint back
-        raise
-    if had_old:
-        shutil.rmtree(old, ignore_errors=True)
+            os.rename(path, old)
+        try:
+            os.rename(tmp, path)
+        except BaseException:
+            if had_old:
+                os.rename(old, path)  # roll the previous checkpoint back
+            raise
+        if had_old:
+            shutil.rmtree(old, ignore_errors=True)
+    finally:
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
 
 
 def load_metric_state(
@@ -230,6 +391,9 @@ def load_metric_state(
                 f"checkpoint at {path} holds a metric collection "
                 f"({sorted(tree)}); pass the matching {{name: Metric}} dict."
             )
+        validate_state_dict(
+            metric, tree["__single__"], context=f"checkpoint at {path}"
+        )
         metric.load_state_dict(
             _restore_state_types(tree["__single__"]), strict=strict
         )
@@ -249,5 +413,11 @@ def load_metric_state(
         )
     for name, m in metric.items():
         if name in tree:
+            validate_state_dict(
+                m,
+                tree[name],
+                context=f"checkpoint at {path}",
+                prefix=f"{name}.",
+            )
             m.load_state_dict(_restore_state_types(tree[name]), strict=strict)
     return metric

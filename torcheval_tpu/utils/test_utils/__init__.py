@@ -6,6 +6,11 @@ from torcheval_tpu.utils.test_utils.dummy_metric import (
 from torcheval_tpu.utils.test_utils.fault_injection import (
     FaultInjectionGroup,
     FaultSpec,
+    InjectedCrash,
+    SnapshotCrashPlan,
+    corrupt_manifest_digest,
+    corrupt_shard,
+    truncate_shard,
 )
 from torcheval_tpu.utils.test_utils.metric_class_tester import (
     MetricClassTester,
@@ -21,6 +26,11 @@ __all__ = [
     "DummySumDictStateMetric",
     "FaultInjectionGroup",
     "FaultSpec",
+    "InjectedCrash",
+    "SnapshotCrashPlan",
+    "corrupt_manifest_digest",
+    "corrupt_shard",
+    "truncate_shard",
     "MetricClassTester",
     "ThreadRankGroup",
     "ThreadWorld",

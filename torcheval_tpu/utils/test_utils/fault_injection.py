@@ -30,10 +30,22 @@ retries included, consumes one index):
 ``dead_ranks`` is the persistent form of ``drop``: those ranks are missing
 from EVERY collective — the deterministic stand-in for a host that died
 mid-eval.
+
+Beyond the collective layer, this module also drives the CRASH MATRIX of
+``torcheval_tpu.elastic`` (ISSUE 4): :class:`SnapshotCrashPlan` is a
+deterministic crash-point hook for ``ElasticSession(fault_hook=...)`` —
+it raises :class:`InjectedCrash` at a scripted two-phase-commit point
+(``pre-shard`` / ``mid-shard`` / ``pre-manifest`` / ``post-manifest``),
+modeling a preemption at exactly that instant — and the filesystem-fault
+helpers (:func:`truncate_shard`, :func:`corrupt_shard`,
+:func:`corrupt_manifest_digest`) tamper with a committed bundle on disk
+the way a torn write or bit rot would.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from typing import Any, Iterable, List, NamedTuple, Optional, Sequence
 
@@ -42,7 +54,15 @@ import numpy as np
 from torcheval_tpu.distributed import ProcessGroup
 from torcheval_tpu.resilience import PartialGatherError, TransientSyncError
 
-__all__ = ["FaultInjectionGroup", "FaultSpec"]
+__all__ = [
+    "FaultInjectionGroup",
+    "FaultSpec",
+    "InjectedCrash",
+    "SnapshotCrashPlan",
+    "corrupt_manifest_digest",
+    "corrupt_shard",
+    "truncate_shard",
+]
 
 _KINDS = ("drop", "delay", "transient", "corrupt", "duplicate")
 
@@ -132,6 +152,23 @@ class FaultInjectionGroup(ProcessGroup):
     def ranks(self):
         return self._inner.ranks
 
+    def new_subgroup(self, ranks: Sequence[int]) -> "FaultInjectionGroup":
+        """Chaos composes with subgroup scoping (so ``ResilientGroup``'s
+        survivor re-formation can escalate THROUGH the chaos wrapper):
+        the inner subgroup is wrapped with ``dead_ranks`` translated to
+        subgroup-relative indices. Scripted call-indexed faults do NOT
+        carry over — they are keyed to THIS group's call sequence, which
+        the subgroup does not share."""
+        from torcheval_tpu.distributed import _check_subgroup_ranks
+
+        rel = _check_subgroup_ranks(ranks, self.world_size)
+        sub = self._inner.new_subgroup(rel)
+        dead = tuple(
+            i for i, parent_rank in enumerate(rel)
+            if parent_rank in self.dead_ranks
+        )
+        return FaultInjectionGroup(sub, (), dead_ranks=dead, seed=self.seed)
+
     # ----------------------------------------------------------------- faults
 
     def _active(self, call: int) -> List[FaultSpec]:
@@ -193,3 +230,114 @@ def _copy_payload(value: Any) -> Any:
     if isinstance(value, np.ndarray):
         return value.copy()
     return copy.deepcopy(value)
+
+
+# --------------------------------------------------- elastic crash matrix
+
+
+class InjectedCrash(BaseException):
+    """A scripted process death (``SnapshotCrashPlan``). Derives from
+    ``BaseException`` so production ``except Exception`` recovery code
+    cannot accidentally swallow the simulated kill — exactly like a real
+    SIGKILL, the only observable is what was left on disk."""
+
+
+class SnapshotCrashPlan:
+    """Deterministic crash-point hook for ``elastic.ElasticSession``.
+
+    Raises :class:`InjectedCrash` when snapshot number ``at_snapshot``
+    (0-based, counted per rank) reaches two-phase-commit point ``point``
+    on ``rank`` (``None`` = every rank — a whole-pod preemption).
+
+    >>> plan = SnapshotCrashPlan("pre-manifest", at_snapshot=1)
+    >>> session = ElasticSession(metrics, d, fault_hook=plan)  # doctest: +SKIP
+
+    ``crashed`` records whether the plan fired (so tests can assert the
+    scripted death actually happened).
+    """
+
+    def __init__(
+        self,
+        point: str,
+        *,
+        at_snapshot: int = 0,
+        rank: Optional[int] = None,
+    ) -> None:
+        from torcheval_tpu.elastic import CRASH_POINTS
+
+        if point not in CRASH_POINTS:
+            raise ValueError(
+                f"unknown crash point {point!r}; expected one of "
+                f"{CRASH_POINTS}"
+            )
+        self.point = point
+        self.at_snapshot = at_snapshot
+        self.rank = rank
+        self.crashed = False
+        self._seen: dict = {}  # rank -> snapshots observed (pre-shard count)
+
+    def __call__(self, point: str, *, generation: int, rank: int) -> None:
+        if point == "pre-shard":
+            self._seen[rank] = self._seen.get(rank, -1) + 1
+        if self.rank is not None and rank != self.rank:
+            return
+        if point == self.point and self._seen.get(rank, 0) == self.at_snapshot:
+            self.crashed = True
+            raise InjectedCrash(
+                f"injected crash at {point} of snapshot "
+                f"{self.at_snapshot} (generation {generation}, rank {rank})"
+            )
+
+
+def _shard_path(directory: str, generation: int, rank: int) -> str:
+    return os.path.join(
+        directory, f"gen-{generation:08d}", f"shard-{rank:05d}.bin"
+    )
+
+
+def truncate_shard(
+    directory: str, generation: int, rank: int = 0, keep_fraction: float = 0.5
+) -> str:
+    """Truncate one committed shard file in place (a torn write that the
+    manifest's byte count / sha256 must catch). Returns the shard path."""
+    path = _shard_path(directory, generation, rank)
+    size = os.path.getsize(path)
+    with open(path, "rb+") as f:
+        f.truncate(max(1, int(size * keep_fraction)))
+    return path
+
+
+def corrupt_shard(
+    directory: str, generation: int, rank: int = 0, *, seed: int = 0
+) -> str:
+    """Flip one byte of a committed shard at a seeded offset (bit rot that
+    the manifest sha256 must catch). Returns the shard path."""
+    path = _shard_path(directory, generation, rank)
+    with open(path, "rb+") as f:
+        blob = bytearray(f.read())
+        rng = np.random.default_rng(seed + generation)
+        blob[int(rng.integers(0, len(blob)))] ^= 0xFF
+        f.seek(0)
+        f.write(bytes(blob))
+    return path
+
+
+def corrupt_manifest_digest(
+    directory: str, generation: int, rank: int = 0
+) -> str:
+    """Flip a hex digit of one shard's sha256 inside the committed
+    manifest (the digest itself rotting — restore must reject the
+    generation, not trust the shard). Returns the manifest path."""
+    from torcheval_tpu.elastic import MANIFEST_NAME
+
+    path = os.path.join(directory, f"gen-{generation:08d}", MANIFEST_NAME)
+    with open(path) as f:
+        manifest = json.load(f)
+    entry = next(
+        e for e in manifest["shards"] if int(e["rank"]) == rank
+    )
+    digest = entry["sha256"]
+    entry["sha256"] = ("0" if digest[0] != "0" else "1") + digest[1:]
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1)
+    return path
