@@ -963,6 +963,180 @@ def run_checkpoint():
     }
 
 
+def run_observability():
+    """Config 10: step overhead of the observability recorder.
+
+    ISSUE 5 acceptance: the recorder must be near-zero-cost when OFF
+    (the instrumented wrappers add one attribute read per update) and
+    < 2% step overhead when ON. Four arms run the SAME eval loop
+    (accuracy + MSE + buffered AUROC, three updates per step):
+
+    - ``unwrapped``: calls each metric's pre-instrumentation update
+      (``update.__wrapped__``) — the true pre-obs baseline, measurable
+      in-build;
+    - ``off``: the instrumented path, recorder disabled (the shipping
+      default) — its delta vs ``unwrapped`` is the wrapper cost;
+    - ``on``: recorder enabled, events land in the bounded ring;
+    - ``jsonl``: recorder + async JSONL writer (queue hop on the step
+      path; serialization + I/O on the writer thread — drain timed
+      separately, as in the checkpoint config).
+
+    Estimator: interleaved per-step rounds, median of PAIRED per-round
+    differences (see the inline comment — per-arm minima cannot resolve
+    a 2% ratio between near-equal arms on this box's noise floor).
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from torcheval_tpu import obs
+    from torcheval_tpu.metrics import (
+        BinaryAUROC,
+        MeanSquaredError,
+        MulticlassAccuracy,
+    )
+
+    # a production-shaped step (~2 ms on this box): the overhead bound is
+    # a RATIO, so the denominator must be a realistic step, not a toy one
+    # where scheduler noise (±30 µs here) swamps the 2% acceptance line
+    STEPS, REPS = 150, 8
+    rng = np.random.default_rng(0)
+    scores = np.float32(rng.uniform(size=(4096, 128)))
+    labels = rng.integers(0, 128, size=4096)
+    preds = np.float32(rng.normal(size=4096))
+    targets = np.float32(rng.normal(size=4096))
+    auroc_scores = np.float32(rng.uniform(size=128))
+    auroc_targets = (rng.random(128) < auroc_scores).astype(np.float32)
+
+    def build():
+        return {
+            "acc": MulticlassAccuracy(),
+            "mse": MeanSquaredError(),
+            "auroc": BinaryAUROC(),
+        }
+
+    def step(metrics):
+        metrics["acc"].update(scores, labels)
+        metrics["mse"].update(preds, targets)
+        metrics["auroc"].update(auroc_scores, auroc_targets)
+
+    def step_unwrapped(metrics):
+        # the pre-instrumentation functions (wrappers carry __wrapped__)
+        for m in metrics.values():
+            fn = getattr(type(m).update, "__wrapped__", type(m).update)
+            if m is metrics["acc"]:
+                fn(m, scores, labels)
+            elif m is metrics["mse"]:
+                fn(m, preds, targets)
+            else:
+                fn(m, auroc_scores, auroc_targets)
+
+    rec = obs.recorder()
+    tmpdir = tempfile.mkdtemp(prefix="bench-obs-")
+    path = os.path.join(tmpdir, "events.jsonl")
+
+    # INTERLEAVED rounds, MEDIAN-OF-PAIRED-DIFFERENCES estimator: each
+    # round times ONE step of every arm back-to-back (order rotated), and
+    # the published overheads are medians of the per-round DIFFERENCES.
+    # This box's co-load (±2% even at per-arm minima, bursts on 2 cores)
+    # swamps a 2% acceptance line for any estimator comparing arms
+    # measured in different windows — rehearsals put the "free" off arm
+    # anywhere from -4% to +22% of the unwrapped baseline. Differences
+    # within one round share the round's load; the median throws away the
+    # rounds a burst landed in. (Min-of-each-arm — the usual discipline
+    # here — fails for RATIOS of near-equal arms: each arm's min is its
+    # own quietest window, not a shared one.)
+    metrics = build()
+    for _ in range(12):
+        step(metrics)  # warm compiles + first buffer growths
+    writer_prev = rec._writer
+    rec.reset()
+    rec.enable(jsonl=path)  # attach the writer once; arms toggle below
+    writer = rec._writer
+    arms = ("unwrapped", "off", "on", "jsonl")
+    samples = {m: [] for m in arms}
+    drain_s = 0.0
+    try:
+        rec.enabled = False
+        deadline = time.perf_counter() + 22.0
+        rounds = 0
+        while rounds < STEPS * REPS and time.perf_counter() < deadline:
+            # rotate the within-round order so a periodic burst cannot
+            # always land on the same arm's slot
+            offset = rounds % 4
+            took = {}
+            for i in range(4):
+                mode = arms[(i + offset) % 4]
+                if mode == "on":
+                    rec._writer, rec.enabled = None, True
+                elif mode == "jsonl":
+                    rec._writer, rec.enabled = writer, True
+                else:
+                    rec.enabled = False
+                body = step_unwrapped if mode == "unwrapped" else step
+                start = time.perf_counter()
+                body(metrics)
+                took[mode] = time.perf_counter() - start
+            rec.enabled = False
+            for mode, t in took.items():
+                samples[mode].append(t)
+            rounds += 1
+        rec._writer = writer
+        start = time.perf_counter()
+        rec.drain()
+        drain_s = time.perf_counter() - start
+    finally:
+        rec._writer = writer
+        rec.disable()
+        rec._writer = writer_prev
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    from statistics import median
+
+    us = {m: median(samples[m]) * 1e6 for m in arms}
+    n = len(samples["off"])
+    diff_us = {
+        "off_vs_unwrapped": median(
+            (samples["off"][i] - samples["unwrapped"][i]) * 1e6
+            for i in range(n)
+        ),
+        "on_vs_off": median(
+            (samples["on"][i] - samples["off"][i]) * 1e6 for i in range(n)
+        ),
+        "jsonl_vs_off": median(
+            (samples["jsonl"][i] - samples["off"][i]) * 1e6 for i in range(n)
+        ),
+    }
+    off_delta_pct = diff_us["off_vs_unwrapped"] / us["unwrapped"] * 100.0
+    on_overhead_pct = diff_us["on_vs_off"] / us["off"] * 100.0
+    jsonl_overhead_pct = diff_us["jsonl_vs_off"] / us["off"] * 100.0
+
+    return {
+        "metric": (
+            "observability recorder step overhead "
+            "(3-metric loop; off vs on vs on+JSONL)"
+        ),
+        "value": round(on_overhead_pct, 2),
+        "unit": "% step overhead, recorder on vs off (lower is better)",
+        "lower_is_better": True,
+        "samples_per_arm": rounds,
+        "events_per_step": 3,
+        "unwrapped_step_us": round(us["unwrapped"], 1),
+        "off_step_us": round(us["off"], 1),
+        "on_step_us": round(us["on"], 1),
+        "jsonl_step_us": round(us["jsonl"], 1),
+        "off_delta_pct": round(off_delta_pct, 2),
+        "on_overhead_pct": round(on_overhead_pct, 2),
+        "jsonl_overhead_pct": round(jsonl_overhead_pct, 2),
+        "jsonl_drain_ms": round(drain_s * 1e3, 2),
+        # acceptance: disabled ≈ free (wrapper cost is one attribute
+        # read; 1% guard absorbs shared-box noise), enabled < 2%
+        "off_delta_within_1pct": off_delta_pct <= 1.0,
+        "on_overhead_within_2pct": on_overhead_pct <= 2.0,
+    }
+
+
 def run_probe():
     """Tiny op on the default backend — proves the platform is claimable."""
     import jax
@@ -1560,6 +1734,7 @@ CONFIGS = {
     "sync_degraded": (run_sync_degraded, None),  # fault-tolerance audit
     "sync_payload": (run_sync_payload, None),  # bandwidth audit
     "checkpoint": (run_checkpoint, None),  # snapshot-overhead audit
+    "observability": (run_observability, None),  # recorder-overhead audit
 }
 
 _NO_REF_NOTES = {
@@ -1581,6 +1756,10 @@ _NO_REF_NOTES = {
     "checkpoint": (
         "snapshot-overhead audit — the reference has no snapshot/resume "
         "layer, so the comparison is our own no-snapshot loop"
+    ),
+    "observability": (
+        "recorder-overhead audit — the reference has no observability "
+        "layer, so the comparison is our own recorder-off loop"
     ),
 }
 

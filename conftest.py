@@ -50,6 +50,61 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+@pytest.fixture
+def obs_recorder():
+    """Enable the observability recorder (``torcheval_tpu.obs``) for one
+    test, starting from an empty event log. On failure the
+    ``pytest_runtest_makereport`` hook below appends the event-log tail
+    to the report — retries, degradations, sync provenance, snapshot
+    generations — which is exactly the forensics a flaky
+    multihost/fault-injection failure needs. Suites opt in with an
+    autouse fixture depending on this one (see
+    tests/metrics/test_fault_injection.py)."""
+    from torcheval_tpu import obs
+
+    rec = obs.recorder()
+    prev = rec.enabled
+    rec.reset()
+    rec.enable()
+    try:
+        yield rec
+    finally:
+        if not prev:
+            rec.disable()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """When a test fails WITH the observability recorder active, attach
+    the tail of the event log to the failure report. Deliberately reads
+    ``sys.modules`` instead of importing: a failure in a test that never
+    touched torcheval_tpu must not pay (or trigger) a jax import here."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when != "call" or not rep.failed:
+        return
+    try:
+        import sys
+
+        recorder_mod = sys.modules.get("torcheval_tpu.obs.recorder")
+        if (
+            recorder_mod is None
+            or not recorder_mod.RECORDER.enabled
+            or not len(recorder_mod.RECORDER.log)
+        ):
+            return
+        from torcheval_tpu.obs.export import format_report
+
+        rep.sections.append(
+            (
+                "torcheval_tpu observability (event-log tail)",
+                format_report(tail=30),
+            )
+        )
+    except Exception:  # noqa: BLE001 — forensics must never mask the failure
+        pass
+
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -26,6 +26,15 @@ import time
 import numpy as np
 import pytest
 
+
+@pytest.fixture(autouse=True)
+def _dump_events_on_failure(obs_recorder):
+    """Flake forensics: run this whole suite with the observability
+    recorder on, so a failure report carries the event-log tail (every
+    retry, degradation, and sync provenance the test produced — the
+    conftest ``pytest_runtest_makereport`` hook appends it)."""
+    yield
+
 import jax
 
 from tests.metrics._sync_matrix import build_rank_replicas

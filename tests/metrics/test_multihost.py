@@ -22,6 +22,14 @@ import os
 import numpy as np
 import pytest
 
+
+@pytest.fixture(autouse=True)
+def _dump_events_on_failure(obs_recorder):
+    """Flake forensics: recorder on for the spawned-process suite — the
+    parent-side event tail (subgroup syncs, provenance) rides any failure
+    report via the conftest hook."""
+    yield
+
 # slow tier: spawned-process sync matrix (~2-5 min); the per-class coverage
 # enforcement in _sync_matrix.build_cases still fires at collection time
 # in the fast tier

@@ -95,6 +95,34 @@ def test_steady_state_update_is_transfer_free(name):
         metric.update(*args)
 
 
+@pytest.mark.parametrize("name", sorted(CLASS_CASES))
+def test_steady_state_update_is_transfer_free_recorder_on(name):
+    """ISSUE 5 acceptance: the observability recorder must add ZERO host
+    syncs to the steady-state update path — recording is a host-side
+    ring append + TraceAnnotation, never a device readback. Same guard
+    as above, recorder enabled."""
+    from torcheval_tpu import obs
+
+    make, args = CLASS_CASES[name]
+    metric = make()
+    for _ in range(6):
+        metric.update(*args)
+    rec = obs.recorder()
+    prev = rec.enabled
+    rec.enable()
+    try:
+        with jax.transfer_guard("disallow"):
+            metric.update(*args)
+        # the event actually landed (the pin is not vacuous)
+        assert any(
+            e.kind == "update" and e.metric == type(metric).__name__
+            for e in rec.log.tail(5)
+        )
+    finally:
+        if not prev:
+            rec.disable()
+
+
 FUNCTIONAL_CASES = {
     "multiclass_accuracy": lambda: F.multiclass_accuracy(X2, T1),
     "binary_auroc": lambda: F.binary_auroc(XB, TB),

@@ -1,0 +1,530 @@
+"""Tier-1 suite for the observability subsystem (``torcheval_tpu.obs``).
+
+Pins the subsystem's load-bearing contracts:
+
+- OFF by default, and near-zero when off: no events, no attributes, no
+  behavior change (the zero-added-host-syncs / zero-added-collectives
+  twins live in test_no_host_sync.py and test_sync_collective_counts.py);
+- the bounded ring buffer drops oldest and counts drops;
+- the typed event stream: Update/Compute on the metric core,
+  Sync (mirroring ``SyncProvenance``/``SyncHealth`` BIT-IDENTICALLY,
+  happy path and under fault injection), Retry from the resilience
+  layer, Snapshot/Restore from elastic, Compile from the
+  jax.monitoring bridge;
+- exporters: JSONL round-trip, Prometheus exposition grammar, the human
+  report, and ``gather_observability`` over a real rendezvousing
+  ``ThreadWorld`` (the ISSUE acceptance: correlated sync/retry/snapshot
+  events from all ranks in one report);
+- ``Metric.reset``/``load_state_dict`` clear the stamped ``obs_step``
+  (same stale-attribute class as the PR 4 ``sync_provenance`` fix).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torcheval_tpu.metrics as M
+from torcheval_tpu import config, obs
+from torcheval_tpu.distributed import LocalReplicaGroup, ProcessGroup
+from torcheval_tpu.metrics.toolkit import (
+    get_synced_metric,
+    sync_and_compute,
+    sync_and_compute_collection,
+    update_collection,
+)
+from torcheval_tpu.obs import (
+    CompileEvent,
+    EventLog,
+    RetryEvent,
+    SnapshotEvent,
+    SyncEvent,
+    UpdateEvent,
+    event_from_dict,
+)
+from torcheval_tpu.resilience import ResilientGroup, default_sync_health
+from torcheval_tpu.utils.test_utils import (
+    FaultInjectionGroup,
+    FaultSpec,
+    ThreadWorld,
+)
+
+RNG = np.random.default_rng(11)
+
+
+@pytest.fixture
+def rec():
+    """A freshly-reset, ENABLED recorder; restored to disabled after."""
+    r = obs.recorder()
+    prev = r.enabled
+    r.reset()
+    r.enable()
+    try:
+        yield r
+    finally:
+        r.reset()
+        if not prev:
+            r.disable()
+
+
+def _acc(seed=0):
+    m = M.MulticlassAccuracy()
+    rng = np.random.default_rng(seed)
+    m.update(
+        np.float32(rng.uniform(size=(16, 4))), rng.integers(0, 4, size=16)
+    )
+    return m
+
+
+class CountingGroup(ProcessGroup):
+    """Two fake ranks, both holding this process's payload."""
+
+    def __init__(self):
+        self.object_gathers = 0
+        self.array_gathers = 0
+
+    @property
+    def world_size(self):
+        return 2
+
+    @property
+    def rank(self):
+        return 0
+
+    def allgather_object(self, obj):
+        self.object_gathers += 1
+        return [obj, copy.deepcopy(obj)]
+
+    def allgather_array(self, x):
+        self.array_gathers += 1
+        x = np.asarray(x)
+        return [x, x.copy()]
+
+
+# ------------------------------------------------------------ off by default
+
+
+def test_recorder_off_by_default_records_nothing():
+    r = obs.recorder()
+    assert not r.enabled
+    assert not config.observability_enabled()
+    before = r.log.total
+    m = _acc()
+    m.compute()
+    assert r.log.total == before
+    # no observability attributes are stamped while off
+    assert "obs_step" not in m.__dict__
+
+
+def test_config_observability_scopes_and_restores():
+    r = obs.recorder()
+    assert not r.enabled
+    with config.observability():
+        assert r.enabled
+        assert config.observability_enabled()
+    assert not r.enabled
+
+
+# ---------------------------------------------------------------- event log
+
+
+def test_event_log_ring_bounds_and_drop_count(rec):
+    log = EventLog(capacity=4)
+    for i in range(10):
+        log.append(UpdateEvent(metric=f"m{i}"))
+    assert len(log) == 4
+    assert log.total == 10
+    assert log.dropped == 6
+    assert [e.metric for e in log.tail()] == ["m6", "m7", "m8", "m9"]
+    assert log.counts["update"] == 10
+    log.clear()
+    assert len(log) == 0 and log.total == 0 and log.dropped == 0
+
+
+def test_event_log_capacity_validation():
+    with pytest.raises(ValueError):
+        EventLog(capacity=0)
+
+
+# --------------------------------------------------------- metric-core events
+
+
+def test_update_and_compute_events(rec):
+    rec.set_step(7)
+    m = _acc()
+    m.compute()
+    kinds = [e.kind for e in rec.log]
+    assert "update" in kinds and "compute" in kinds
+    update = next(e for e in rec.log if e.kind == "update")
+    assert update.metric == "MulticlassAccuracy"
+    assert update.seconds >= 0.0
+    assert update.step == 7
+    assert update.t_mono > 0.0 and update.t_wall > 0.0
+    compute = next(e for e in rec.log if e.kind == "compute")
+    assert compute.metric == "MulticlassAccuracy"
+    # the step cursor was stamped onto the metric itself
+    assert m.obs_step == 7
+
+
+def test_reset_and_load_state_dict_clear_obs_step(rec):
+    """Satellite regression (same stale-attribute class as the PR 4
+    sync_provenance fix): restored/reset state must not carry the
+    previous life's observability cursor."""
+    rec.set_step(3)
+    m = _acc()
+    assert m.obs_step == 3
+    m.reset()
+    assert "obs_step" not in m.__dict__
+
+    rec.set_step(5)
+    m2 = _acc()
+    snap = _acc(seed=9).state_dict()
+    assert m2.obs_step == 5
+    m2.load_state_dict(snap)
+    assert "obs_step" not in m2.__dict__
+
+
+def test_update_collection_records_one_fused_event(rec):
+    metrics = {"acc": M.MulticlassAccuracy(), "f1": M.MulticlassF1Score()}
+    logits = jnp.asarray(RNG.uniform(size=(8, 2)).astype(np.float32))
+    labels = jnp.asarray(RNG.integers(0, 2, size=8))
+    update_collection(metrics, logits, labels)
+    panel = [
+        e for e in rec.log
+        if e.kind == "update" and e.metric == "update_collection"
+    ]
+    assert len(panel) == 1
+    assert panel[0].fused == 2  # both metrics rode the fused dispatch
+
+
+# --------------------------------------------------------------- sync events
+
+
+def test_sync_event_mirrors_provenance_happy_path(rec):
+    synced = get_synced_metric(_acc(), CountingGroup())
+    ev = next(e for e in reversed(rec.log.tail()) if e.kind == "sync")
+    prov = synced.sync_provenance
+    assert ev.ranks == prov.ranks
+    assert ev.world_size == prov.world_size
+    assert ev.degraded == prov.degraded
+    assert ev.policy == prov.policy
+    assert ev.reformed == prov.reformed
+    assert ev.metrics == 1
+    assert ev.sent_bytes > 0 and ev.recv_bytes >= ev.sent_bytes
+    assert ev.seconds > 0.0
+
+
+def test_sync_event_bit_identical_to_health_under_fault_injection(rec):
+    """ISSUE satellite: SyncEvent fields mirror the SyncHealth /
+    SyncProvenance of a DEGRADED sync bit-identically."""
+    devices = jax.local_devices()[:4]
+    replicas = [_acc(seed=r) for r in range(4)]
+    chaos = FaultInjectionGroup(LocalReplicaGroup(devices), dead_ranks={2})
+    resilient = ResilientGroup(chaos, timeout=10.0, policy="quorum")
+    synced = get_synced_metric(replicas, resilient)
+    prov = synced.sync_provenance
+    assert prov.degraded and prov.ranks == (0, 1, 3)
+
+    ev = next(e for e in reversed(rec.log.tail()) if e.kind == "sync")
+    assert ev.ranks == prov.ranks == resilient.health.participating_ranks
+    assert ev.world_size == prov.world_size == resilient.health.world_size
+    assert ev.degraded == prov.degraded is True
+    assert ev.policy == prov.policy == resilient.health.policy == "quorum"
+    assert ev.reformed == prov.reformed is False
+    # the dead rank's payload was dropped: received < 4 full payloads
+    assert 0 < ev.recv_bytes
+    # ... and the resilience layer narrated the loss as events too
+    reasons = [e.reason for e in rec.log if e.kind == "retry"]
+    assert any(r in ("partial-gather", "degraded-quorum") for r in reasons)
+
+
+def test_retry_event_on_transient_fault(rec):
+    devices = jax.local_devices()[:2]
+    replicas = [_acc(seed=r) for r in range(2)]
+    chaos = FaultInjectionGroup(
+        LocalReplicaGroup(devices),
+        faults=[FaultSpec(call=0, kind="transient")],
+    )
+    resilient = ResilientGroup(chaos, timeout=10.0, retries=2, policy="quorum")
+    sync_and_compute(replicas, resilient)
+    retries = [e for e in rec.log if e.kind == "retry"]
+    assert any(e.reason == "transient" for e in retries)
+    transient = next(e for e in retries if e.reason == "transient")
+    assert transient.policy == "quorum"
+    # the sync still completed undegraded after the retry
+    ev = next(e for e in reversed(rec.log.tail()) if e.kind == "sync")
+    assert not ev.degraded and ev.ranks == (0, 1)
+
+
+# ------------------------------------------------------------ elastic events
+
+
+def test_snapshot_and_restore_events(rec, tmp_path):
+    from torcheval_tpu.elastic import ElasticSession
+
+    metrics = {"acc": _acc()}
+    session = ElasticSession(metrics, os.fspath(tmp_path), interval=2)
+    session.step_done()  # step 1: no snapshot yet
+    assert rec.step_cursor == 1  # the session drives the recorder cursor
+    session.step_done()  # step 2: snapshot fires
+    session.close()
+    snaps = [e for e in rec.log if e.kind == "snapshot"]
+    assert len(snaps) == 1
+    assert snaps[0].generation == 0
+    assert snaps[0].shard_bytes > 0
+    assert snaps[0].seconds > 0.0
+    assert snaps[0].async_writer is False
+    assert snaps[0].rank == 0
+
+    fresh = {"acc": M.MulticlassAccuracy()}
+    session2 = ElasticSession(fresh, os.fspath(tmp_path), interval=2)
+    restored = session2.restore()
+    assert restored is not None and restored.step == 2
+    restores = [e for e in rec.log if e.kind == "restore"]
+    assert len(restores) == 1
+    assert restores[0].generation == 0
+    assert restores[0].restored_step == 2
+    assert restores[0].old_world == restores[0].new_world == 1
+    # the registry tallies moved regardless of event recording
+    stats = obs.default_registry().read()["snapshots"]
+    assert stats["snapshots_written"] >= 1
+    assert stats["restores"] >= 1
+
+
+# ------------------------------------------------------------ compile bridge
+
+
+def test_compile_event_bridge(rec):
+    @jax.jit
+    def fresh(x):
+        return x * 3 + 1  # unique enough to demand a program
+
+    fresh(jnp.arange(17))  # 17: unlikely to be cached by another test
+    assert any(e.kind == "compile" for e in rec.log)
+    ev = next(e for e in rec.log if e.kind == "compile")
+    assert isinstance(ev, CompileEvent)
+    assert ev.seconds >= 0.0
+
+
+def test_span_records_event_and_annotates(rec):
+    with obs.span("test-phase") as sp:
+        pass
+    assert sp.seconds >= 0.0
+    spans = [e for e in rec.log if e.kind == "span"]
+    assert len(spans) == 1 and spans[0].name == "test-phase"
+
+
+# ------------------------------------------------------------------ exporters
+
+
+def test_jsonl_round_trip(rec, tmp_path):
+    path = os.fspath(tmp_path / "events.jsonl")
+    events = [
+        UpdateEvent(metric="Acc", seconds=0.25, step=3),
+        SyncEvent(
+            ranks=(0, 2), world_size=4, degraded=True, policy="quorum",
+            sent_bytes=128, recv_bytes=256, metrics=2, seconds=0.5, rank=0,
+        ),
+        RetryEvent(reason="timeout", attempt=1, policy="quorum", rank=2),
+        SnapshotEvent(generation=4, seconds=0.1, shard_bytes=99, rank=1),
+        CompileEvent(seconds=1.5, cache_hit=True),
+    ]
+    writer = obs.JsonlWriter(path)
+    for ev in events:
+        ev.t_mono, ev.t_wall = 1.0, 2.0  # stamp deterministically
+        writer.write(ev)
+    writer.close()
+    back = obs.read_jsonl(path)
+    assert back == events
+    # every line is one standalone JSON object carrying its kind
+    with open(path) as f:
+        for line in f:
+            assert "kind" in json.loads(line)
+
+
+def test_jsonl_writer_via_recorder_and_config(rec, tmp_path):
+    path = os.fspath(tmp_path / "stream.jsonl")
+    with config.observability(jsonl=path):
+        _acc()
+    events = obs.read_jsonl(path)
+    assert any(e.kind == "update" for e in events)
+    # the scope closed the writer; later events do not leak into the file
+    n = len(events)
+    _acc()
+    assert len(obs.read_jsonl(path)) == n
+
+
+def test_jsonl_writer_bad_path_fails_at_construction(tmp_path):
+    with pytest.raises(OSError):
+        obs.JsonlWriter(os.fspath(tmp_path))  # a directory, not a file
+
+
+def test_nested_observability_scopes_preserve_outer_writer(rec, tmp_path):
+    """Review regression: an inner observability(jsonl=...) scope — or a
+    pause scope — must not close or detach a writer attached OUTSIDE it;
+    the outer stream keeps receiving events after the inner scope."""
+    outer = os.fspath(tmp_path / "outer.jsonl")
+    inner = os.fspath(tmp_path / "inner.jsonl")
+    with config.observability(jsonl=outer):
+        _acc()
+        with config.observability(jsonl=inner):
+            _acc()
+        with config.observability(False):
+            pass  # pause scope: must not touch the outer writer either
+        _acc()  # still streams to the OUTER writer
+        obs.recorder().drain()
+        outer_events = obs.read_jsonl(outer)
+    assert len([e for e in outer_events if e.kind == "update"]) == 2
+    inner_events = obs.read_jsonl(inner)
+    assert len([e for e in inner_events if e.kind == "update"]) == 1
+
+
+def test_span_respects_disabled_recorder(tmp_path):
+    """Review regression: record() is the off-contract choke point — a
+    user span with the recorder disabled must drop its event (and write
+    nothing to an attached-but-paused JSONL stream)."""
+    r = obs.recorder()
+    assert not r.enabled
+    before = r.log.total
+    with obs.span("while-disabled"):
+        pass
+    assert r.log.total == before
+
+
+def test_prometheus_exposition_grammar(rec):
+    _acc()
+    sync_and_compute(_acc(), ResilientGroup(CountingGroup(), timeout=5.0))
+    text = obs.render_prometheus()
+    import re
+
+    name_re = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+    seen = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            assert name_re.match(name), line
+            assert kind in ("counter", "gauge"), line
+            continue
+        name, value = line.split(" ", 1)
+        assert name_re.match(name), line
+        float(value)  # numeric exposition value
+        assert name not in seen, f"duplicate sample {name}"
+        seen.add(name)
+    # the federated sources are all present
+    assert any(s.startswith("torcheval_tpu_compile_") for s in seen)
+    assert any(s.startswith("torcheval_tpu_sync_") for s in seen)
+    assert any(s.startswith("torcheval_tpu_events_") for s in seen)
+    assert any(s.startswith("torcheval_tpu_snapshots_") for s in seen)
+    assert "torcheval_tpu_sync_attempts" in seen
+
+
+def test_counter_registry_reads_and_isolates_errors(rec):
+    reg = obs.default_registry()
+    assert {"compile", "sync", "events", "snapshots"} <= set(reg.sources)
+    read = reg.read()
+    assert read["sync"]["attempts"] == default_sync_health().attempts
+    flat = reg.flat()
+    assert "events.recorded_total" in flat
+
+    def broken():
+        raise RuntimeError("supplier down")
+
+    reg.register("broken", broken)
+    try:
+        read = reg.read()
+        assert "error" in read["broken"]  # one source, not the scrape
+        assert "sync" in read
+    finally:
+        reg.unregister("broken")
+    assert "broken" not in reg.sources
+
+
+def test_format_report_renders_counters_and_events(rec):
+    _acc()
+    report = obs.format_report(tail=5)
+    assert "torcheval_tpu observability report" in report
+    assert "[sync]" in report and "[compile]" in report
+    assert "update" in report
+
+
+# --------------------------------------------- cross-rank gather (acceptance)
+
+
+def test_gather_observability_threadworld_correlates_all_ranks(rec, tmp_path):
+    """ISSUE acceptance: one gather_observability() report over a
+    ThreadWorld run shows correlated sync/retry/snapshot events from ALL
+    ranks."""
+    from torcheval_tpu.elastic import ElasticSession
+
+    world = ThreadWorld(4)
+    shared = os.fspath(tmp_path / "bundles")
+
+    def body(g):
+        m = _acc(seed=g.rank)
+        session = ElasticSession(
+            {"acc": m}, shared, process_group=g, interval=1
+        )
+        session.step_done()  # snapshots generation 0 (all ranks in step)
+        # same scripted transient on EVERY rank: all retry in lockstep
+        chaos = FaultInjectionGroup(
+            g, faults=[FaultSpec(call=0, kind="transient")]
+        )
+        resilient = ResilientGroup(
+            chaos, timeout=30.0, retries=2, policy="quorum"
+        )
+        sync_and_compute(m, resilient)
+        session.close()
+        return obs.gather_observability(g, tail=200)
+
+    reports = world.run(body)
+    # every rank received the SAME merged report
+    assert all(r["ranks"] == [0, 1, 2, 3] for r in reports)
+    report = reports[0]
+    for rank in range(4):
+        own = [
+            e for e in report["per_rank"][rank]["events"]
+            if e.get("rank") == rank
+        ]
+        kinds = {e["kind"] for e in own}
+        assert {"sync", "retry", "snapshot"} <= kinds, (rank, kinds)
+        # correlated: this rank's retry precedes its completed sync
+        retry_t = min(e["t_mono"] for e in own if e["kind"] == "retry")
+        sync_t = max(e["t_mono"] for e in own if e["kind"] == "sync")
+        assert retry_t <= sync_t
+        sync = next(e for e in own if e["kind"] == "sync")
+        assert sync["ranks"] == [0, 1, 2, 3] and not sync["degraded"]
+        counters = report["per_rank"][rank]["counters"]
+        # (the explicit ResilientGroup keeps its OWN health record, so the
+        # process-wide "sync" source stays zeroed here; the event counters
+        # and snapshot tallies are the shared-registry signal)
+        assert counters["events"]["kind_sync"] >= 1
+        assert counters["snapshots"]["snapshots_written"] >= 1
+
+
+def test_gather_observability_rejects_local_replica_group(rec):
+    with pytest.raises(TypeError):
+        obs.gather_observability(
+            LocalReplicaGroup(jax.local_devices()[:2])
+        )
+
+
+def test_gather_observability_non_member_is_graceful(rec):
+    world = ThreadWorld(3)
+
+    def body(g):
+        sub = g.new_subgroup([0, 1])
+        if not sub.is_member:
+            return obs.gather_observability(sub)
+        _acc(seed=g.rank)
+        return obs.gather_observability(sub, tail=10)
+
+    reports = world.run(body)
+    assert reports[2]["per_rank"] == {}  # non-member: no collective issued
+    assert reports[0]["ranks"] == [0, 1]

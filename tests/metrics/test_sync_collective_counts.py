@@ -153,6 +153,48 @@ def test_resilient_wrapper_adds_zero_collectives(n_metrics):
     )
 
 
+@pytest.mark.parametrize("n_metrics", [1, 12])
+def test_recorder_on_adds_zero_collectives(n_metrics):
+    """ISSUE 5 acceptance: enabling the observability recorder must not
+    change the collective budget — the SyncEvent's byte/provenance
+    payload rides the metadata the protocol already exchanges, and
+    recording is host-side. Exactly the same gather counts as the bare
+    run, for plain AND resilient groups."""
+    from torcheval_tpu import obs
+    from torcheval_tpu.resilience import ResilientGroup
+
+    coll = _collection(n_metrics)
+    _feed(coll)
+    bare = CountingGroup()
+    sync_and_compute_collection(
+        {k: copy.deepcopy(m) for k, m in coll.items()}, bare
+    )
+
+    rec = obs.recorder()
+    prev = rec.enabled
+    rec.enable()
+    try:
+        plain = CountingGroup()
+        sync_and_compute_collection(
+            {k: copy.deepcopy(m) for k, m in coll.items()}, plain
+        )
+        resilient = CountingGroup()
+        sync_and_compute_collection(
+            coll, ResilientGroup(resilient, timeout=30.0, policy="quorum")
+        )
+        assert plain.object_gathers == bare.object_gathers == 1
+        assert plain.array_gathers == bare.array_gathers <= 1
+        assert resilient.object_gathers == bare.object_gathers
+        assert resilient.array_gathers == bare.array_gathers
+        # the pin is not vacuous: both syncs were recorded
+        syncs = [e for e in rec.log.tail() if e.kind == "sync"]
+        assert len(syncs) >= 2
+        assert syncs[-1].metrics == n_metrics
+    finally:
+        if not prev:
+            rec.disable()
+
+
 def test_two_rank_sync_matches_per_metric_sync():
     """The batched path and K independent single-metric syncs agree."""
     from torcheval_tpu.metrics.toolkit import sync_and_compute

@@ -27,6 +27,7 @@ MODULES = [
     ("torcheval_tpu.distributed", "distributed"),
     ("torcheval_tpu.resilience", "resilience"),
     ("torcheval_tpu.elastic", "elastic"),
+    ("torcheval_tpu.obs", "obs"),
     ("torcheval_tpu.tools", "tools"),
     ("torcheval_tpu.utils", "utils"),
     ("torcheval_tpu.utils.test_utils", "test_utils"),

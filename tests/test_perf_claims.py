@@ -31,6 +31,7 @@ VB = _load("bench_r6_variable_batch_cpu_20260803.json")
 SD = _load("bench_r7_sync_degraded_cpu_20260803.json")
 SP = _load("bench_r8_sync_payload_cpu_20260803.json")
 CK = _load("bench_r9_checkpoint_cpu_20260803.json")
+OB = _load("bench_r10_observability_cpu_20260803.json")
 
 
 def _read(path):
@@ -398,6 +399,55 @@ def test_checkpoint_table_matches_capture():
     # the prose workload description matches the capture's parameters
     m = re.search(r"snapshot\s+every (\d+) steps", text)
     assert m and int(m.group(1)) == ck["snapshot_every"]
+
+
+def test_observability_table_matches_capture():
+    """The observability-overhead table traces to its committed capture:
+    per-arm median step times and overhead percentages — and the capture
+    itself must satisfy the ISSUE 5 acceptance (recorder-off delta ≈ 0,
+    recorder-on < 2%)."""
+    text = _read("docs/benchmarks.md")
+    ob = OB["observability"]
+    m = re.search(
+        r"recorder OFF \(the shipping default\) \| ([\d.]+) µs \| "
+        r"\*\*([\d.]+)%\*\* vs the pre-instrumentation baseline "
+        r"\(([\d.]+) µs\)",
+        text,
+    )
+    assert m, "observability recorder-off row not found"
+    assert float(m.group(1)) == pytest.approx(ob["off_step_us"], abs=0.05)
+    assert float(m.group(2)) == pytest.approx(ob["off_delta_pct"], abs=0.005)
+    assert float(m.group(3)) == pytest.approx(
+        ob["unwrapped_step_us"], abs=0.05
+    )
+    m = re.search(
+        r"recorder ON \(bounded ring buffer\) \| ([\d.]+) µs \| "
+        r"\*\*([\d.]+)%\*\* vs recorder-off",
+        text,
+    )
+    assert m, "observability recorder-on row not found"
+    assert float(m.group(1)) == pytest.approx(ob["on_step_us"], abs=0.05)
+    assert float(m.group(2)) == pytest.approx(
+        ob["on_overhead_pct"], abs=0.005
+    )
+    assert float(m.group(2)) == pytest.approx(ob["value"], abs=0.005)
+    m = re.search(
+        r"recorder ON \+ async JSONL stream \| ([\d.]+) µs \| ([\d.]+)% vs "
+        r"recorder-off \(batched hand-off; serialization \+ I/O on the "
+        r"writer thread, ([\d.]+) ms drain",
+        text,
+    )
+    assert m, "observability jsonl row not found"
+    assert float(m.group(1)) == pytest.approx(ob["jsonl_step_us"], abs=0.05)
+    assert float(m.group(2)) == pytest.approx(
+        ob["jsonl_overhead_pct"], abs=0.005
+    )
+    assert float(m.group(3)) == pytest.approx(ob["jsonl_drain_ms"], abs=0.005)
+    # the acceptance quantities hold in the capture itself
+    assert ob["off_delta_within_1pct"], "capture violates the ≈0 acceptance"
+    assert ob["on_overhead_within_2pct"], "capture violates the <2% acceptance"
+    assert ob["off_delta_pct"] <= 1.0
+    assert ob["on_overhead_pct"] <= 2.0
 
 
 def test_bridge_numerator_terms_match_dispatch_table():

@@ -453,6 +453,80 @@ def validate_inputs(policy: str = "raise") -> Iterator[None]:
         _validate_inputs = prev
 
 
+# ---------------------------------------------------------- observability
+
+def observability_enabled() -> bool:
+    """True when the process-global event recorder
+    (``torcheval_tpu.obs``) is recording. Off by default — when off, the
+    instrumented hot paths cost one attribute read and add zero host
+    syncs / zero collectives (docs/observability.md). Env
+    ``TORCHEVAL_TPU_OBSERVABILITY`` (truthy enables at import; a value
+    ending in ``.jsonl`` also attaches the JSONL writer)."""
+    from torcheval_tpu.obs.recorder import RECORDER
+
+    return RECORDER.enabled
+
+
+def set_observability(enabled: bool) -> None:
+    """Turn the global event recorder on/off process-wide. Prefer the
+    scoped :func:`observability` context manager in eval code."""
+    from torcheval_tpu.obs.recorder import RECORDER
+
+    if enabled:
+        RECORDER.enable()
+    else:
+        RECORDER.disable()
+
+
+@contextmanager
+def observability(
+    enabled: bool = True,
+    *,
+    jsonl: Optional[str] = None,
+    capacity: Optional[int] = None,
+) -> Iterator[None]:
+    """Context manager scoping structured event recording
+    (docs/observability.md).
+
+    Inside the context the global recorder (``torcheval_tpu.obs``)
+    collects typed lifecycle events — updates, computes, syncs (with
+    provenance + wire bytes), resilience retries/degradations, elastic
+    snapshots/restores, XLA compiles — into a bounded ring buffer, and
+    optionally streams them to ``jsonl`` via the async line writer
+    (drained and closed on exit).
+
+    >>> with observability(jsonl="/tmp/eval-events.jsonl"):
+    ...     value = sync_and_compute(metric)
+    >>> # obs.format_report() / obs.read_jsonl(...) to inspect
+    """
+    from torcheval_tpu.obs.recorder import RECORDER
+
+    prev_enabled = RECORDER.enabled
+    prev_writer = RECORDER._writer
+    try:
+        if enabled:
+            if jsonl is not None:
+                # detach (don't close) any writer attached OUTSIDE this
+                # scope before enable() installs this scope's — the outer
+                # stream must keep working after the scope exits
+                RECORDER._writer = None
+            RECORDER.enable(jsonl=jsonl, capacity=capacity)
+        else:
+            # pause recording only — a writer attached OUTSIDE this scope
+            # must survive the scope (full disable() would close it)
+            RECORDER.enabled = False
+        yield
+    finally:
+        # restore recorder state FIRST (close may raise a ferried writer
+        # error to the caller), then close ONLY the writer THIS scope
+        # attached — never one inherited from outside
+        scoped = RECORDER._writer
+        RECORDER._writer = prev_writer
+        RECORDER.enabled = prev_enabled
+        if scoped is not None and scoped is not prev_writer:
+            scoped.close()
+
+
 @contextmanager
 def shape_bucketing(enabled: bool = True) -> Iterator[None]:
     """Context manager enabling retrace-proof shape bucketing.

@@ -62,6 +62,7 @@ import queue
 import re
 import shutil
 import threading
+import time
 import warnings
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple, Union
 
@@ -71,6 +72,8 @@ from torcheval_tpu.distributed import (
     default_process_group,
 )
 from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.obs import counters as _obs_counters
+from torcheval_tpu.obs.recorder import RECORDER as _OBS
 from torcheval_tpu.utils.checkpoint import (
     _digest,
     _from_plain,
@@ -389,6 +392,11 @@ class ElasticSession:
             self._payload = payload
         self._cursor += 1
         self._since_snapshot += 1
+        if _OBS.enabled:
+            # the session IS the step authority in an elastic loop: keep
+            # the recorder's step cursor in lockstep so every event this
+            # loop emits is step-correlated (docs/observability.md)
+            _OBS.set_step(self._cursor)
         if self._since_snapshot >= self.interval:
             self.snapshot()
 
@@ -487,6 +495,7 @@ class ElasticSession:
         """
         group = self._comm
         rank, world = group.rank, group.world_size
+        write_t0 = time.monotonic()
         self._fault("pre-shard", generation)
         gen_dir = self._generation_dir(generation)
         os.makedirs(gen_dir, exist_ok=True)
@@ -535,6 +544,24 @@ class ElasticSession:
         if rank == 0:
             self._rotate()
         self.snapshots_written += 1
+        seconds = time.monotonic() - write_t0
+        # registry tallies accumulate whether or not event recording is
+        # on (snapshotting is off the hot path; a restart diagnosis wants
+        # them regardless) — the typed event itself is recorder-gated
+        _obs_counters.note_snapshot(generation, seconds)
+        if _OBS.enabled:
+            from torcheval_tpu.obs.events import SnapshotEvent
+
+            _OBS.record(
+                SnapshotEvent(
+                    rank=rank,
+                    step=int(cursor),
+                    generation=generation,
+                    seconds=seconds,
+                    shard_bytes=len(blob),
+                    async_writer=self._writer is not None,
+                )
+            )
 
     def _commit_manifest(
         self,
@@ -638,6 +665,7 @@ class ElasticSession:
         self._raise_writer_error()
         world = self._group.world_size
         rank = self._group.rank
+        restore_t0 = time.monotonic()
         unusable: List[Tuple[int, str]] = []
         for generation, gen_dir in reversed(self._committed_generations()):
             try:
@@ -680,6 +708,23 @@ class ElasticSession:
             self._next_gen = 1 + max(
                 [generation] + [g for g, _ in unusable]
             )
+            seconds = time.monotonic() - restore_t0
+            _obs_counters.note_restore(seconds)
+            if _OBS.enabled:
+                from torcheval_tpu.obs.events import RestoreEvent
+
+                _OBS.set_step(self._cursor)
+                _OBS.record(
+                    RestoreEvent(
+                        rank=rank,
+                        step=self._cursor,
+                        generation=generation,
+                        restored_step=self._cursor,
+                        old_world=old_world,
+                        new_world=world,
+                        seconds=seconds,
+                    )
+                )
             return RestoreResult(
                 step=self._cursor,
                 generation=generation,

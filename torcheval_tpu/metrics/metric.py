@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import copy
 import enum
+import functools
+import time
 from abc import ABC, abstractmethod
 from typing import (
     Any,
@@ -45,6 +47,7 @@ import jax.numpy as jnp
 
 from torcheval_tpu import config
 from torcheval_tpu.metrics._fuse import fused_accumulate
+from torcheval_tpu.obs.recorder import RECORDER as _OBS
 from torcheval_tpu.utils.convert import (
     canonicalize_device,
     device_descriptor,
@@ -136,6 +139,42 @@ def _is_array(x: Any) -> bool:
     return isinstance(x, jax.Array)
 
 
+def _instrumented(fn, phase: str, cls_name: str):
+    """Wrap a subclass's ``update``/``compute`` with observability.
+
+    Recorder OFF (the default): one attribute read, then the original
+    function — no host sync, no allocation (the recorder-ON/OFF parity is
+    pinned by tests/metrics/test_no_host_sync.py and the observability
+    bench). Recorder ON: the call is timed, annotated into the XLA trace
+    (``jax.profiler.TraceAnnotation``), and recorded as an
+    ``UpdateEvent``/``ComputeEvent``; updates also stamp ``obs_step``
+    (the recorder's step cursor) on the metric — cleared by ``reset()``
+    and ``load_state_dict`` like ``sync_provenance``.
+    """
+    from torcheval_tpu.obs.events import ComputeEvent, UpdateEvent
+
+    label = f"torcheval.{phase}/{cls_name}"
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        if not _OBS.enabled:
+            return fn(self, *args, **kwargs)
+        t0 = time.monotonic()
+        with jax.profiler.TraceAnnotation(label):
+            out = fn(self, *args, **kwargs)
+        seconds = time.monotonic() - t0
+        name = type(self).__name__
+        if phase == "update":
+            self.obs_step = _OBS.step_cursor
+            _OBS.record(UpdateEvent(metric=name, seconds=seconds))
+        else:
+            _OBS.record(ComputeEvent(metric=name, seconds=seconds))
+        return out
+
+    wrapper._obs_instrumented = True
+    return wrapper
+
+
 class Metric(Generic[TComputeReturn], ABC):
     """Base class for all torcheval_tpu metrics.
 
@@ -148,6 +187,25 @@ class Metric(Generic[TComputeReturn], ABC):
         self._state_name_to_default: Dict[str, TState] = {}
         self._state_name_to_merge_kind: Dict[str, MergeKind] = {}
         self._device: jax.Device = canonicalize_device(device)
+
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        """Instrument concrete ``update``/``compute`` overrides with the
+        observability recorder (``torcheval_tpu.obs``) — see
+        ``_instrumented`` for the off-by-default cost contract. Only
+        functions defined on THIS class are wrapped (inherited ones were
+        wrapped when their defining class was created), abstract stubs
+        are left alone, and wrapping is idempotent."""
+        super().__init_subclass__(**kwargs)
+        for name in ("update", "compute"):
+            fn = cls.__dict__.get(name)
+            if (
+                fn is None
+                or not callable(fn)
+                or getattr(fn, "__isabstractmethod__", False)
+                or getattr(fn, "_obs_instrumented", False)
+            ):
+                continue
+            setattr(cls, name, _instrumented(fn, name, cls.__name__))
 
     # ------------------------------------------------------------------ state
 
@@ -426,9 +484,12 @@ class Metric(Generic[TComputeReturn], ABC):
                 )
             else:
                 setattr(self, name, self._place_state(self._clone_state(default)))
-        # a provenance left by a prior (possibly degraded) sync describes
-        # state this reset just discarded — it must not outlive it
+        # a provenance left by a prior (possibly degraded) sync — and the
+        # observability step cursor stamped by the last recorded update —
+        # describe state this reset just discarded; they must not outlive
+        # it (same stale-attribute class as the PR 4 sync_provenance fix)
         self.__dict__.pop("sync_provenance", None)
+        self.__dict__.pop("obs_step", None)
         return self
 
     # ---------------------------------------------------------- serialization
@@ -480,7 +541,9 @@ class Metric(Generic[TComputeReturn], ABC):
             setattr(self, name, self._place_state(self._clone_state(value)))
         # restored state replaces whatever a prior sync produced: drop the
         # stale provenance (the sync path re-attaches its own afterwards)
+        # and the stale observability step cursor alike
         self.__dict__.pop("sync_provenance", None)
+        self.__dict__.pop("obs_step", None)
 
     # ---------------------------------------------------------------- devices
 

@@ -70,11 +70,17 @@ class SyncedStates(List[MetricStates]):
 
     - ``ranks``: the ranks whose states are present, aligned with the list;
     - ``world_size``: the group's full world size;
-    - ``degraded``: True when some rank did not contribute.
+    - ``degraded``: True when some rank did not contribute;
+    - ``sent_bytes``/``recv_bytes``: packed wire payload this rank
+      shipped / the surviving ranks' payloads combined (byte accounting
+      for the observability layer's ``SyncEvent`` — free, read off the
+      metadata the protocol already exchanged).
     """
 
     ranks: Tuple[int, ...] = ()
     world_size: int = 0
+    sent_bytes: int = 0
+    recv_bytes: int = 0
 
     @property
     def degraded(self) -> bool:
@@ -296,6 +302,7 @@ def sync_states(
         packed = [
             _pack_rank_states(ms, order, compression) for ms in metric_states
         ]
+        sent_bytes = sum(int(flat.size) for _, flat in packed)
         metas, meta_ranks = process_group.allgather_object_with_ranks(
             [(meta, int(flat.size), zlib.crc32(flat)) for meta, flat in packed]
         )
@@ -308,6 +315,7 @@ def sync_states(
             )
     else:
         meta, flat = _pack_rank_states(metric_states, order, compression)
+        sent_bytes = int(flat.size)
         # ONE metadata exchange tells every rank every payload's framing
         # (and every rank's byte total, fixing the static gather shape);
         # the crc32 rides it so payload integrity costs no extra exchange
@@ -324,10 +332,12 @@ def sync_states(
             # ONE padded payload gather carries every tensor of every state
             bufs, buf_ranks = process_group.allgather_array_with_ranks(padded)
 
-    return _assemble(
+    out = _assemble(
         template, order, process_group, world,
         dict(zip(meta_ranks, metas)), dict(zip(buf_ranks, bufs)),
     )
+    out.sent_bytes = sent_bytes
+    return out
 
 
 def _assemble(
@@ -390,4 +400,5 @@ def _assemble(
     )
     out.ranks = tuple(survivors)
     out.world_size = world
+    out.recv_bytes = sum(meta_by_rank[r][1] for r in survivors)
     return out

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import copy
 import logging
+import time
 from typing import Any, Dict, Iterable, List, Optional, TypeVar, Union
 
 import jax
@@ -37,6 +38,7 @@ from torcheval_tpu.distributed import (
 )
 from torcheval_tpu.metrics.metric import Metric, TState
 from torcheval_tpu.metrics import synclib
+from torcheval_tpu.obs.recorder import RECORDER as _OBS
 from torcheval_tpu.resilience import (
     ResilientGroup,
     SyncProvenance,
@@ -264,6 +266,7 @@ def get_synced_metric_collection(
         payload = {name: m._sync_state_dict() for name, m in metrics.items()}
         template = metrics
 
+    sync_t0 = time.monotonic() if _OBS.enabled else 0.0
     per_rank_states = synclib.sync_states(payload, group)
 
     # degraded-result provenance: which ranks actually contributed (full
@@ -290,6 +293,27 @@ def get_synced_metric_collection(
             "Metric sync degraded: merged state reflects ranks %s of %d "
             "(policy %r); result may be stale.",
             list(ranks), world, provenance.policy,
+        )
+    if _OBS.enabled:
+        # the SyncEvent MIRRORS the provenance (bit-identical fields,
+        # pinned by tests/metrics/test_observability.py) and adds the
+        # wire-byte accounting synclib already computed from its
+        # metadata exchange — host-side only, zero extra collectives
+        from torcheval_tpu.obs.events import SyncEvent
+
+        _OBS.record(
+            SyncEvent(
+                rank=group.rank,
+                ranks=provenance.ranks,
+                world_size=provenance.world_size,
+                degraded=provenance.degraded,
+                policy=provenance.policy,
+                reformed=provenance.reformed,
+                sent_bytes=getattr(per_rank_states, "sent_bytes", 0),
+                recv_bytes=getattr(per_rank_states, "recv_bytes", 0),
+                metrics=len(template),
+                seconds=time.monotonic() - sync_t0,
+            )
         )
 
     merged: Dict[str, Metric] = {}
@@ -433,6 +457,8 @@ def update_collection(
     from torcheval_tpu.metrics.metric import UpdatePlan
     from torcheval_tpu.utils.convert import shared_conversion_cache
 
+    obs_on = _OBS.enabled
+    t0 = time.monotonic() if obs_on else 0.0
     items = list(metrics.values() if isinstance(metrics, dict) else metrics)
     # pass 1: build every fusable plan FIRST — each plan runs its metric's
     # input validation eagerly, so a batch any PLAN rejects raises before
@@ -491,6 +517,19 @@ def update_collection(
                 setattr(metric, name, value)
             if finalize is not None:
                 finalize()
+    if obs_on:
+        # ONE event for the whole fused panel (plan-fused metrics bypass
+        # their individual `update`, so this is their record; fallback
+        # metrics already recorded their own UpdateEvents above)
+        from torcheval_tpu.obs.events import UpdateEvent
+
+        _OBS.record(
+            UpdateEvent(
+                metric="update_collection",
+                seconds=time.monotonic() - t0,
+                fused=len(items) - len(fallback),
+            )
+        )
     return metrics
 
 

@@ -1,0 +1,102 @@
+"""Unified observability for the eval stack (docs/observability.md).
+
+The eval-loop contract this library is built on — cheap ``update()`` per
+step, expensive ``sync_and_compute()`` occasionally — only holds in
+production when operators can SEE where time, bytes, and retries go.
+Before this subsystem that signal was scattered: ``utils.CompileCounter``
+(retraces), ``resilience.SyncHealth`` (sync attempts/degradations),
+``SyncProvenance`` (who contributed), payload byte counts (bench-only),
+elastic snapshot timings (session-local). ``torcheval_tpu.obs`` gives it
+one home, in the shape real collective stacks ship telemetry (Prime
+Collective Communications Library technical report, arxiv 2505.14065;
+EQuARX's byte/overhead accounting, arxiv 2506.17615):
+
+- **Events** (:mod:`~torcheval_tpu.obs.events`): typed lifecycle records
+  — ``UpdateEvent``/``ComputeEvent`` (metric core), ``SyncEvent``
+  (provenance + wire bytes), ``RetryEvent`` (resilience retries,
+  degradations, re-formations), ``SnapshotEvent``/``RestoreEvent``
+  (elastic), ``CompileEvent`` (XLA program demands), ``SpanEvent`` (user
+  phases) — stamped with monotonic + wall time and the step cursor.
+- **Recorder** (:mod:`~torcheval_tpu.obs.recorder`): the process-global
+  sink. OFF by default and near-zero-cost when off — every instrumented
+  site guards on one attribute read; recording adds no host syncs and no
+  collectives to any step path (pinned by tier-1 tests). ``span()``
+  phases also land in XLA traces via ``jax.profiler.TraceAnnotation``.
+- **Counters** (:mod:`~torcheval_tpu.obs.counters`):
+  ``CounterRegistry`` federates the existing counters (CompileCounter,
+  ``default_sync_health()``, elastic timings) behind one read API
+  without touching their call sites.
+- **Exporters** (:mod:`~torcheval_tpu.obs.export`): async JSONL writer,
+  ``render_prometheus()`` text exposition, ``format_report()`` human
+  table, and ``gather_observability(group)`` — one collective merging
+  every rank's summary for the leader.
+
+Enable with ``config.observability(...)``, ``obs.enable()``, or env
+``TORCHEVAL_TPU_OBSERVABILITY=1`` (a ``*.jsonl`` value also attaches the
+line writer)::
+
+    >>> from torcheval_tpu import obs
+    >>> with config.observability(jsonl="/tmp/eval-events.jsonl"):
+    ...     for step, batch in enumerate(loader):
+    ...         obs.recorder().set_step(step)
+    ...         update_collection(metrics, *batch)
+    >>> print(obs.format_report())
+"""
+
+from torcheval_tpu.obs.counters import CounterRegistry, default_registry
+from torcheval_tpu.obs.events import (
+    CompileEvent,
+    ComputeEvent,
+    Event,
+    RestoreEvent,
+    RetryEvent,
+    SnapshotEvent,
+    SpanEvent,
+    SyncEvent,
+    UpdateEvent,
+    event_from_dict,
+)
+from torcheval_tpu.obs.export import (
+    JsonlWriter,
+    format_report,
+    gather_observability,
+    read_jsonl,
+    render_prometheus,
+)
+from torcheval_tpu.obs.recorder import (
+    RECORDER,
+    EventLog,
+    Recorder,
+    disable,
+    enable,
+    enabled,
+    recorder,
+    span,
+)
+
+__all__ = [
+    "CompileEvent",
+    "ComputeEvent",
+    "CounterRegistry",
+    "Event",
+    "EventLog",
+    "JsonlWriter",
+    "Recorder",
+    "RestoreEvent",
+    "RetryEvent",
+    "SnapshotEvent",
+    "SpanEvent",
+    "SyncEvent",
+    "UpdateEvent",
+    "default_registry",
+    "disable",
+    "enable",
+    "enabled",
+    "event_from_dict",
+    "format_report",
+    "gather_observability",
+    "read_jsonl",
+    "recorder",
+    "render_prometheus",
+    "span",
+]

@@ -1,0 +1,209 @@
+"""Typed lifecycle events for the observability subsystem.
+
+One dataclass per event the eval stack emits (docs/observability.md has the
+full schema table). Every event carries the same timing envelope:
+
+- ``t_mono``: ``time.monotonic()`` at record time — orders events and
+  yields durations immune to wall-clock steps;
+- ``t_wall``: ``time.time()`` — correlates with external logs/dashboards;
+- ``step``: the recorder's step cursor (``Recorder.set_step``;
+  ``elastic.ElasticSession`` advances it automatically), ``None`` when no
+  loop is driving one;
+- ``rank``: the emitting rank for group-scoped events (sync, retry,
+  snapshot, restore); ``None`` for process-local events (update, compute,
+  compile, span).
+
+Events are plain data: construct them anywhere, compare them with ``==``,
+serialize with :meth:`Event.as_dict` (JSON-safe: tuples become lists) and
+reconstruct with :func:`event_from_dict` (the JSONL exporter's round-trip
+contract, pinned by tests/metrics/test_observability.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, ClassVar, Dict, Optional, Tuple, Type
+
+__all__ = [
+    "CompileEvent",
+    "ComputeEvent",
+    "Event",
+    "RestoreEvent",
+    "RetryEvent",
+    "SnapshotEvent",
+    "SpanEvent",
+    "SyncEvent",
+    "UpdateEvent",
+    "event_from_dict",
+]
+
+
+@dataclass
+class Event:
+    """Common timing envelope; see the module docstring for field
+    semantics. ``Recorder.record`` stamps the envelope when unset, so
+    instrumentation only fills the payload fields."""
+
+    kind: ClassVar[str] = "event"
+
+    t_mono: float = 0.0
+    t_wall: float = 0.0
+    step: Optional[int] = None
+    rank: Optional[int] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        """JSON-safe dict (``kind`` included, tuples become lists)."""
+        out: Dict[str, Any] = {"kind": self.kind}
+        for f in dataclasses.fields(self):
+            value = getattr(self, f.name)
+            if isinstance(value, tuple):
+                value = list(value)
+            out[f.name] = value
+        return out
+
+
+@dataclass
+class UpdateEvent(Event):
+    """One ``Metric.update`` (or one fused ``toolkit.update_collection``
+    dispatch covering ``fused`` metrics)."""
+
+    kind: ClassVar[str] = "update"
+
+    metric: str = ""
+    seconds: float = 0.0
+    fused: int = 1
+
+
+@dataclass
+class ComputeEvent(Event):
+    """One ``Metric.compute``."""
+
+    kind: ClassVar[str] = "compute"
+
+    metric: str = ""
+    seconds: float = 0.0
+
+
+@dataclass
+class SyncEvent(Event):
+    """One whole eager state sync (``toolkit.get_synced_metric*``).
+
+    ``ranks``/``world_size``/``degraded``/``policy``/``reformed`` mirror
+    the :class:`~torcheval_tpu.resilience.SyncProvenance` attached to the
+    synced metrics — bit-identical, pinned under fault injection by
+    tests/metrics/test_observability.py. ``sent_bytes``/``recv_bytes``
+    are the packed wire payload this rank shipped / the surviving ranks'
+    payloads it received (``synclib.SyncedStates``).
+    """
+
+    kind: ClassVar[str] = "sync"
+
+    ranks: Tuple[int, ...] = ()
+    world_size: int = 0
+    degraded: bool = False
+    policy: str = "raise"
+    reformed: bool = False
+    sent_bytes: int = 0
+    recv_bytes: int = 0
+    metrics: int = 0
+    seconds: float = 0.0
+
+
+@dataclass
+class RetryEvent(Event):
+    """One resilience-layer lifecycle event (``ResilientGroup``): a retry
+    cause (``timeout`` / ``transient`` / ``partial-gather``), a
+    degradation outcome (``degraded-local`` / ``degraded-quorum`` /
+    ``failed``), or a survivor re-formation (``reform``)."""
+
+    kind: ClassVar[str] = "retry"
+
+    reason: str = ""
+    attempt: int = 0
+    policy: str = "raise"
+    detail: str = ""
+
+
+@dataclass
+class SnapshotEvent(Event):
+    """One committed (or attempted) elastic snapshot generation on this
+    rank (``elastic.ElasticSession``)."""
+
+    kind: ClassVar[str] = "snapshot"
+
+    generation: int = -1
+    seconds: float = 0.0
+    shard_bytes: int = 0
+    async_writer: bool = False
+
+
+@dataclass
+class RestoreEvent(Event):
+    """One successful ``ElasticSession.restore`` on this rank."""
+
+    kind: ClassVar[str] = "restore"
+
+    generation: int = -1
+    restored_step: int = 0
+    old_world: int = 0
+    new_world: int = 0
+    seconds: float = 0.0
+
+
+@dataclass
+class CompileEvent(Event):
+    """One XLA program demand (bridged from ``utils.CompileCounter``'s
+    jax.monitoring listeners): a backend compile / persistent-cache load
+    (``cache_hit=False``, ``seconds`` = time inside compile-or-load), or
+    a persistent-cache hit notification (``cache_hit=True``)."""
+
+    kind: ClassVar[str] = "compile"
+
+    seconds: float = 0.0
+    cache_hit: bool = False
+
+
+@dataclass
+class SpanEvent(Event):
+    """One user-named phase closed by ``Recorder.span`` (the phase also
+    appears in XLA traces via ``jax.profiler.TraceAnnotation``)."""
+
+    kind: ClassVar[str] = "span"
+
+    name: str = ""
+    seconds: float = 0.0
+
+
+_EVENT_TYPES: Dict[str, Type[Event]] = {
+    cls.kind: cls
+    for cls in (
+        UpdateEvent,
+        ComputeEvent,
+        SyncEvent,
+        RetryEvent,
+        SnapshotEvent,
+        RestoreEvent,
+        CompileEvent,
+        SpanEvent,
+        Event,
+    )
+}
+
+
+def event_from_dict(data: Dict[str, Any]) -> Event:
+    """Inverse of :meth:`Event.as_dict` — the JSONL read side.
+
+    Unknown keys are ignored (a newer writer's extra fields must not
+    break an older reader); lists are restored to tuples (the only
+    sequence type events use).
+    """
+    kind = data.get("kind", "event")
+    cls = _EVENT_TYPES.get(kind, Event)
+    names = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {
+        k: (tuple(v) if isinstance(v, list) else v)
+        for k, v in data.items()
+        if k in names
+    }
+    return cls(**kwargs)

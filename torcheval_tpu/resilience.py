@@ -64,6 +64,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from torcheval_tpu.distributed import LocalReplicaGroup, ProcessGroup
+from torcheval_tpu.obs.recorder import RECORDER as _OBS
 
 __all__ = [
     "PartialGatherError",
@@ -566,6 +567,26 @@ class ResilientGroup(ProcessGroup):
 
     # ------------------------------------------------------------- observers
 
+    def _note_event(
+        self, reason: str, attempt: int = 0, detail: str = ""
+    ) -> None:
+        """Record one resilience lifecycle event (retry cause, degradation
+        outcome, re-formation) when the observability recorder is on —
+        the event-stream twin of the :class:`SyncHealth` counters. One
+        attribute read when off; host-side only when on."""
+        if _OBS.enabled:
+            from torcheval_tpu.obs.events import RetryEvent
+
+            _OBS.record(
+                RetryEvent(
+                    rank=self.rank,
+                    reason=reason,
+                    attempt=attempt,
+                    policy=self.policy,
+                    detail=detail,
+                )
+            )
+
     def note_corrupt(self, rank: int) -> None:
         """Called by ``synclib`` when rank's payload fails its checksum."""
         with self.health._lock:
@@ -629,6 +650,7 @@ class ResilientGroup(ProcessGroup):
         self._active = sub
         self._local_mode = isinstance(sub.unwrap(), LocalReplicaGroup)
         self.reform_count += 1
+        self._note_event("reform", detail=f"survivors {sorted(survivors)}")
         self._missing_streak, self._streak = (), 0
         with self.health._lock:
             self.health.reforms += 1
@@ -698,6 +720,9 @@ class ResilientGroup(ProcessGroup):
             with h._lock:
                 h.attempts += 1
                 h.timeouts += 1
+            self._note_event(
+                "timeout", detail="abandoned collective still in flight"
+            )
             return self._degrade(None, local_only)
         for attempt in range(self.retries + 1):
             delay = 0.0
@@ -714,6 +739,9 @@ class ResilientGroup(ProcessGroup):
                     if not done.wait(delay + (self.timeout or 0.0)):
                         with h._lock:
                             h.timeouts += 1
+                        self._note_event(
+                            "timeout", attempt, "late original still running"
+                        )
                         continue
                     self._late = None
                     result = _harvest(box)
@@ -724,6 +752,9 @@ class ResilientGroup(ProcessGroup):
             except PartialGatherError as e:
                 with h._lock:
                     h.partial_gathers += 1
+                self._note_event(
+                    "partial-gather", attempt, f"ranks {sorted(e.values)}"
+                )
                 partial = dict(e.values)
                 # peer loss is not transient: a quorum of survivors is
                 # usable immediately, without burning the retry budget
@@ -735,10 +766,12 @@ class ResilientGroup(ProcessGroup):
             except TransientSyncError:
                 with h._lock:
                     h.transient_errors += 1
+                self._note_event("transient", attempt)
                 continue
             except SyncTimeoutError:
                 with h._lock:
                     h.timeouts += 1
+                self._note_event("timeout", attempt)
                 continue
             return list(result), list(range(world))
         return self._degrade(partial, local_only)
@@ -767,17 +800,26 @@ class ResilientGroup(ProcessGroup):
         h = self.health
         if self.policy == "local":
             vals, ranks = local_only()
+            self._note_event("degraded-local", detail=f"ranks {list(ranks)}")
             return list(vals), list(ranks)
         if self.policy == "quorum":
             survivors = self._with_own(partial, local_only)
             ranks = sorted(survivors)
             if len(ranks) >= self._quorum_count():
+                self._note_event(
+                    "degraded-quorum", detail=f"ranks {ranks}"
+                )
                 return [survivors[r] for r in ranks], ranks
+            self._note_event(
+                "failed",
+                detail=f"quorum not met: {len(ranks)}/{self.world_size}",
+            )
             raise SyncTimeoutError(
                 f"metric sync quorum not met: {len(ranks)}/{self.world_size} "
                 f"ranks responded, quorum requires >= {self._quorum_count()} "
                 f"(fraction {self.quorum})"
             )
+        self._note_event("failed", detail="policy 'raise'")
         raise SyncTimeoutError(
             f"metric sync failed after {self.retries + 1} attempt(s) "
             f"({h.timeouts} timeouts, {h.transient_errors} transient errors "

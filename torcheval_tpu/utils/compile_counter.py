@@ -41,18 +41,41 @@ CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 _ACTIVE: List["CompileCounter"] = []
 _INSTALLED = False
 
+# Event sinks: callables ``sink(what, seconds)`` with ``what`` one of
+# "compile" (a program demand; seconds = time inside compile-or-load) or
+# "cache_hit" (a persistent-cache hit; seconds = 0). The observability
+# recorder (torcheval_tpu.obs) registers one to turn compile activity
+# into timestamped CompileEvents; sinks must be cheap and non-raising.
+_EVENT_SINKS: List = []
+
+
+def add_event_sink(sink) -> None:
+    """Register a compile-activity sink (see ``_EVENT_SINKS``)."""
+    _install()  # sinks need the jax.monitoring listeners live
+    if sink not in _EVENT_SINKS:
+        _EVENT_SINKS.append(sink)
+
+
+def remove_event_sink(sink) -> None:
+    if sink in _EVENT_SINKS:
+        _EVENT_SINKS.remove(sink)
+
 
 def _on_duration(event: str, duration: float, **_kwargs) -> None:
     if event == BACKEND_COMPILE_EVENT:
         for counter in _ACTIVE:
             counter._programs += 1
             counter._compile_secs += duration
+        for sink in _EVENT_SINKS:
+            sink("compile", duration)
 
 
 def _on_event(event: str, **_kwargs) -> None:
     if event == CACHE_HIT_EVENT:
         for counter in _ACTIVE:
             counter._cache_hits += 1
+        for sink in _EVENT_SINKS:
+            sink("cache_hit", 0.0)
 
 
 def _install() -> None:
