@@ -92,17 +92,37 @@ def run_accuracy_update():
 
     metric = MulticlassAccuracy()
 
+    # THROUGHPUT with depth-1 pipelining: block on the PREVIOUS update's
+    # state while the current one executes, so the queue stays bounded at
+    # one step but dispatch overlaps execution — exactly how a real jax
+    # eval loop behaves (nothing ever reads the state back per step).
+    # Blocking every update instead measures round-trip LATENCY and
+    # serializes the async runtime against a torch baseline whose eager
+    # ops pay no equivalent sync; that number is still reported below as
+    # ``latency_us_blocked``.
+    prev = [None]
+
     def body():
         metric.update(x, t)
-        jax.block_until_ready(metric.num_total)
+        if prev[0] is not None:
+            jax.block_until_ready(prev[0])
+        prev[0] = metric.num_total
 
     cap = 500 if jax.default_backend() == "cpu" else 50000
     ups = _timed_loop(body, max_iters=cap)
+    jax.block_until_ready(metric.num_total)
+
+    def blocked():
+        metric.update(x, t)
+        jax.block_until_ready(metric.num_total)
+
     return {
         "metric": f"MulticlassAccuracy class update throughput "
         f"(batch={batch}, classes={num_classes})",
         "value": round(ups, 1),
         "unit": "updates/s",
+        "latency_us_blocked": _min_us(blocked, iters=20),
+        "pipelining": "depth-1 (block on previous step's state)",
     }
 
 
@@ -124,13 +144,22 @@ def run_auroc_compute():
         auroc.update(xs[i], ts[i])
         auprc.update(xs[i], ts[i])
 
+    # depth-1 pipelined blocking, same rationale as run_accuracy_update:
+    # block the previous compute's results while the current pair runs
+    prev = [None]
+
     def body():
-        jax.block_until_ready((auroc.compute(), auprc.compute()))
+        out = (auroc.compute(), auprc.compute())
+        if prev[0] is not None:
+            jax.block_until_ready(prev[0])
+        prev[0] = out
 
     # on an accelerator each compute is ~100us: allow enough iterations for
     # the min_time window to dominate the measurement
     cap = 50 if jax.default_backend() == "cpu" else 20000
     cps = _timed_loop(body, min_time=3.0, max_iters=cap)
+    if prev[0] is not None:
+        jax.block_until_ready(prev[0])
 
     # StreamingBinaryAUROC: O(bins) mergeable-state approximate AUROC
     # (beyond-parity; VERDICT r2 item 6) — same data, update+compute loop
@@ -1170,6 +1199,90 @@ def _min_us(fn, iters=15, warm=2, budget_s=4.0):
     return round(min(ts), 1)
 
 
+def _donation_arm():
+    """ISSUE 6 donation arm: (a) per-step alloc check — a steady-state
+    donated update must REUSE the state buffer (zero realloc per step,
+    pinned live via ``unsafe_buffer_pointer`` stability over 50 updates);
+    (b) paired-differences update timing of donation on vs off — the two
+    arms alternate within each round and the MEDIAN of per-round
+    differences is reported (per-arm minima cannot resolve small deltas
+    on this box's ±2% noise floor; same estimator as the observability
+    bench)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torcheval_tpu import config as cfg
+    from torcheval_tpu import metrics as M
+
+    rng = np.random.default_rng(0)
+    batch, classes = 1024, 100
+    xb = jnp.asarray(rng.uniform(size=(batch, classes)).astype(np.float32))
+    tb = jnp.asarray(rng.integers(0, classes, size=(batch,)))
+
+    don = {"enabled_default": cfg.update_donation_enabled()}
+
+    # ---- (a) zero-realloc: the 100x100 confusion matrix (40 KB state)
+    # is the realloc-heaviest counter family
+    with cfg.update_donation(True):
+        cm = M.MulticlassConfusionMatrix(classes)
+        cm.update(xb, tb)
+        cm.update(xb, tb)
+        ptr = cm.confusion_matrix.unsafe_buffer_pointer()
+        reallocs = 0
+        for _ in range(50):
+            cm.update(xb, tb)
+            p = cm.confusion_matrix.unsafe_buffer_pointer()
+            if p != ptr:
+                reallocs += 1
+                ptr = p
+    don["steps_checked"] = 50
+    don["realloc_steps"] = reallocs
+    don["zero_realloc"] = reallocs == 0
+
+    # ---- (b) paired-differences timing, donated vs undonated arms ----
+    def timed_pairs(make, steps=10, rounds=30):
+        arms = {}
+        for donate in (True, False):
+            with cfg.update_donation(donate):
+                m = make()
+                m.update(xb, tb)
+                m.update(xb, tb)  # warm this arm's jit cache entry
+                arms[donate] = m
+        diffs, on_best, off_best = [], float("inf"), float("inf")
+        for _ in range(rounds):
+            per = {}
+            for donate in (True, False):
+                with cfg.update_donation(donate):
+                    m = arms[donate]
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        m.update(xb, tb)
+                    jax.block_until_ready(
+                        getattr(m, next(iter(m._state_name_to_default)))
+                    )
+                    per[donate] = (
+                        (time.perf_counter() - t0) * 1e6 / steps
+                    )
+            on_best = min(on_best, per[True])
+            off_best = min(off_best, per[False])
+            diffs.append(per[False] - per[True])
+        diffs.sort()
+        return {
+            "donated_us": round(on_best, 2),
+            "undonated_us": round(off_best, 2),
+            "paired_diff_median_us": round(diffs[len(diffs) // 2], 2),
+            "rounds": len(diffs),
+            "steps_per_round": steps,
+        }
+
+    don["confusion_matrix_100"] = timed_pairs(
+        lambda: M.MulticlassConfusionMatrix(classes)
+    )
+    don["accuracy_micro"] = timed_pairs(lambda: M.MulticlassAccuracy())
+    return don
+
+
 def run_kernels():
     """Per-backend kernel attestation (VERDICT r3 item 7).
 
@@ -1240,13 +1353,21 @@ def run_kernels():
         )
 
         def ab(native_fn, xla_fn, **extra):
-            """A/B one kernel: median us of the native call and its XLA
-            twin (fewer XLA iterations — it is the slow arm)."""
-            return {
+            """A/B one kernel: min us of the native call and its XLA twin
+            (fewer XLA iterations — it is the slow arm), plus the per-op
+            >=2x acceptance flag (ISSUE 6: every native op must beat its
+            XLA twin by 2x on CPU; test_perf_claims pins the flags in the
+            committed capture)."""
+            entry = {
                 **extra,
                 "native_us": _min_us(native_fn, iters=10),
                 "xla_us": _min_us(xla_fn, iters=6, budget_s=6.0),
             }
+            entry["xla_over_native"] = round(
+                entry["xla_us"] / entry["native_us"], 2
+            )
+            entry["meets_2x"] = entry["xla_over_native"] >= 2.0
+            return entry
 
         cpu0 = jax.devices("cpu")[0]
         ns = 1 << 18
@@ -1302,7 +1423,83 @@ def run_kernels():
             lambda: _perplexity_update_jit(logits, targets, None),
             shape=[b_, s_, v_],
         )
+
+        # ---- ISSUE 6 ops: segment reductions / histogram / top-k ----
+        from torcheval_tpu.ops import (
+            histogram as histogram_op,
+            segment_count,
+            segment_sum,
+            topk as topk_op,
+        )
+        from torcheval_tpu.ops.histogram import _histogram_xla
+        from torcheval_tpu.ops.segment import (
+            _segment_count_xla,
+            _segment_sum_xla,
+        )
+        from torcheval_tpu.ops.topk import _topk_xla
+
+        n_seg, segments = 1 << 18, 10000  # 100-class confusion matrix
+        seg_data = jax.device_put(
+            jnp.asarray(rng.uniform(size=n_seg).astype(np.float32)), cpu0
+        )
+        seg_ids = jax.device_put(
+            jnp.asarray(
+                rng.integers(0, segments, size=n_seg).astype(np.int32)
+            ),
+            cpu0,
+        )
+        seg_native_j = jax.jit(lambda d, i: segment_sum(d, i, segments))
+        seg_xla_j = jax.jit(lambda d, i: _segment_sum_xla(d, i, segments))
+        attempt(
+            "segment_sum",
+            lambda: seg_native_j(seg_data, seg_ids),
+            lambda: seg_xla_j(seg_data, seg_ids),
+            n_samples=n_seg, num_segments=segments,
+        )
+        cnt_native_j = jax.jit(lambda i: segment_count(i, segments))
+        cnt_xla_j = jax.jit(lambda i: _segment_count_xla(i, segments, None))
+        attempt(
+            "segment_count",
+            lambda: cnt_native_j(seg_ids),
+            lambda: cnt_xla_j(seg_ids),
+            n_samples=n_seg, num_segments=segments,
+        )
+        n_hist, bins = 1 << 20, 1000  # calibration-table shape
+        hist_vals = jax.device_put(
+            jnp.asarray(rng.uniform(size=n_hist).astype(np.float32)), cpu0
+        )
+        hist_w = jax.device_put(
+            jnp.asarray(rng.uniform(size=n_hist).astype(np.float32)), cpu0
+        )
+        hist_native_j = jax.jit(
+            lambda v, w: histogram_op(v, bins, bounds=(0.0, 1.0), weights=w)
+        )
+        hist_xla_j = jax.jit(
+            lambda v, w: _histogram_xla(v, w, bins, 0.0, 1.0)
+        )
+        attempt(
+            "histogram",
+            lambda: hist_native_j(hist_vals, hist_w),
+            lambda: hist_xla_j(hist_vals, hist_w),
+            n_samples=n_hist, num_bins=bins,
+        )
+        tk_tasks, tk_n, tk_k = 8, 1 << 16, 128  # retrieval @ 128
+        tk_x = jax.device_put(
+            jnp.asarray(
+                rng.normal(size=(tk_tasks, tk_n)).astype(np.float32)
+            ),
+            cpu0,
+        )
+        tk_native_j = jax.jit(lambda x: topk_op(x, tk_k))
+        tk_xla_j = jax.jit(lambda x: _topk_xla(x, tk_k))
+        attempt(
+            "topk",
+            lambda: tk_native_j(tk_x),
+            lambda: tk_xla_j(tk_x),
+            n_samples=[tk_tasks, tk_n], k=tk_k,
+        )
     out["native_cpu"] = nc
+    out["donation"] = _donation_arm()
 
     # ---- north-star bridge: per-step metric work in us on this backend ----
     import torcheval_tpu.metrics as M
@@ -1784,7 +1981,18 @@ def _cache_env(env):
     return env
 
 
-def _cpu_env():
+# Configs whose workload is a single device stream: they run WITHOUT the
+# 8-way virtual-device split. XLA:CPU divides the host threadpool across
+# virtual devices, so the split handicaps single-stream dispatch ~3x on a
+# 2-core box — a virtualization artifact only the mesh/collective configs
+# actually need, and one the torch reference children never pay.
+_SINGLE_DEVICE_CONFIGS = {
+    "accuracy_update", "auroc_compute", "text_eval", "fid", "kernels",
+    "variable_batch",
+}
+
+
+def _cpu_env(device_count=8):
     env = dict(os.environ)
     # The TPU PJRT plugin registers from sitecustomize only when this is
     # set; scrubbing it gives a pure CPU JAX that cannot hang on the relay.
@@ -1793,7 +2001,7 @@ def _cpu_env():
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count=8"
+            f"{flags} --xla_force_host_platform_device_count={device_count}"
         ).strip()
     return env
 
@@ -1803,7 +2011,11 @@ def _run_child(config, platform, timeout, proc_slot=None):
     live Popen is appended to, so a caller on another thread (the relay
     prober) can kill an in-flight child instead of orphaning it — a probe
     hung on a dead relay would otherwise outlive the parent process."""
-    env = _cache_env(_cpu_env() if platform == "cpu" else dict(os.environ))
+    env = _cache_env(
+        _cpu_env(1 if config in _SINGLE_DEVICE_CONFIGS else 8)
+        if platform == "cpu"
+        else dict(os.environ)
+    )
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "bench.py"), "--child", config],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -2108,6 +2320,38 @@ def _attach_ref(entry, name, refname, ref_cache):
         entry["vs_baseline_error"] = str(e)[-300:]
 
 
+def _apply_baseline_fallback(entry, name, fallback):
+    """When the live reference child failed (this container has no
+    /root/reference) and ``--baseline-from`` named a prior capture,
+    compute vs_baseline against THAT capture's reference measurement —
+    annotated so the ratio stays auditable to the run that measured it."""
+    if (
+        fallback is None
+        or entry is None
+        or entry.get("vs_baseline") is not None
+        or "value" not in entry
+    ):
+        return
+    prior = fallback["configs"].get(name) or {}
+    base = prior.get("baseline_value")
+    if base is None or not base > 0:
+        return
+    if entry.get("lower_is_better"):
+        mine = entry.get("update_plus_sync_overhead_pct", entry["value"])
+        if not mine or mine <= 0:
+            return
+        entry["vs_baseline"] = round(base / mine, 2)
+    else:
+        entry["vs_baseline"] = round(entry["value"] / base, 2)
+    entry["baseline_value"] = base
+    entry.pop("vs_baseline_error", None)
+    entry["vs_baseline_note"] = (
+        "reference environment absent in this container; baseline_value "
+        f"reused from committed capture {fallback['source']} (same "
+        "workload definition, measured when /root/reference was present)"
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", help="run one config in-process (ours)")
@@ -2143,6 +2387,14 @@ def main():
     ap.add_argument(
         "--probe-interval-s", type=float, default=15.0,
         help="pause between failed background probes",
+    )
+    ap.add_argument(
+        "--baseline-from",
+        help="path to a prior committed capture JSON: when the torch "
+        "reference cannot run in this container (/root/reference absent), "
+        "vs_baseline falls back to that capture's baseline_value per "
+        "config, clearly annotated in vs_baseline_note — the reference "
+        "numbers stay auditable to the committed run that measured them",
     )
     args = ap.parse_args()
 
@@ -2207,6 +2459,13 @@ def main():
 
     ref_cache = {}
     configs_out = {}
+    baseline_fallback = None
+    if args.baseline_from:
+        with open(args.baseline_from) as f:
+            baseline_fallback = {
+                "source": os.path.basename(args.baseline_from),
+                "configs": json.load(f).get("configs", {}),
+            }
     _REF_HISTORY.clear()  # per-run tiebreak history (tests call main() repeatedly)
     # the whole first pass is timing-sensitive (our children AND the torch
     # reference children): pause probing until it completes — see
@@ -2262,6 +2521,7 @@ def main():
             # the >1.4x tiebreak _measure_ref applies on disagreement)
             ref_sample()
         _attach_ref(entry, name, refname, ref_cache)
+        _apply_baseline_fallback(entry, name, baseline_fallback)
         configs_out[name] = entry
         print(f"# {name}: {json.dumps(entry)}", file=sys.stderr)
     prober.set_busy(False)
@@ -2314,6 +2574,7 @@ def main():
             entry["cpu_fallback_value"] = old.get("value")
             entry["repromoted_at_s"] = round(time.monotonic() - t0, 1)
             _attach_ref(entry, name, CONFIGS[name][1], ref_cache)
+            _apply_baseline_fallback(entry, name, baseline_fallback)
         finally:
             prober.set_busy(False)
         configs_out[name] = entry

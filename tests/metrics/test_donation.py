@@ -1,0 +1,402 @@
+"""Donation fast-path pins: in-place state updates + snapshot aliasing.
+
+The donation refactor (``config.update_donation``, default ON) routes
+every fusable ``Metric.update`` through jitted steps with
+``donate_argnums`` so XLA writes the new state into the old state's
+buffer — ZERO realloc per step. These tests pin both halves of the
+contract:
+
+- the fast path is real: a steady-state donated update reuses the state
+  buffer (``unsafe_buffer_pointer`` stability) and never retraces;
+- the aliasing discipline holds: ``state_dict()`` / checkpoint /
+  ``ElasticSession`` snapshots of donation-enabled metrics are never
+  mutated (or invalidated) by later donated updates — the ``_buffer.py``
+  "snapshots must not alias live buffers" invariant, extended to every
+  accumulator family.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torcheval_tpu import config
+from torcheval_tpu import metrics as M
+from torcheval_tpu.metrics.toolkit import update_collection
+from torcheval_tpu.utils import CompileCounter
+
+@pytest.fixture(autouse=True)
+def _donation_on():
+    """The donation machinery is what these tests pin; enable it
+    explicitly (the process default is backend-dependent: TPU on,
+    CPU off — see config._resolve_update_donation)."""
+    with config.update_donation(True):
+        yield
+
+
+RNG = np.random.default_rng(23)
+X2 = jnp.asarray(RNG.random((64, 5)).astype(np.float32))
+T1 = jnp.asarray(RNG.integers(0, 5, 64))
+XB = jnp.asarray(RNG.random(64).astype(np.float32))
+TB = jnp.asarray(RNG.integers(0, 2, 64).astype(np.float32))
+
+
+# one representative per donated accumulator family: scalar counters,
+# vector counters, matrix counters, binned-curve counters, ring windows
+FAMILY_CASES = {
+    "MulticlassAccuracy": (lambda: M.MulticlassAccuracy(), (X2, T1), "num_correct"),
+    "MeanSquaredError": (lambda: M.MeanSquaredError(), (XB, TB), "sum_squared_error"),
+    "Sum": (lambda: M.Sum(), (XB,), "weighted_sum"),
+    "Mean": (lambda: M.Mean(), (XB,), "weighted_sum"),
+    "MulticlassConfusionMatrix": (
+        lambda: M.MulticlassConfusionMatrix(num_classes=5),
+        (X2, T1),
+        "confusion_matrix",
+    ),
+    "BinaryBinnedPrecisionRecallCurve": (
+        lambda: M.BinaryBinnedPrecisionRecallCurve(threshold=20),
+        (XB, TB),
+        "num_tp",
+    ),
+    "WindowedMeanSquaredError": (
+        lambda: M.WindowedMeanSquaredError(max_num_updates=4),
+        (XB, TB),
+        "sum_squared_error",
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(FAMILY_CASES))
+def test_donated_update_reuses_state_buffer(name):
+    """Steady-state updates write the new state into the OLD buffer: the
+    device pointer is stable across updates (the zero-realloc claim the
+    bench donation arm measures)."""
+    ctor, args, state = FAMILY_CASES[name]
+    metric = ctor()
+    metric.update(*args)  # compile / first growth
+    metric.update(*args)
+    ptr = getattr(metric, state).unsafe_buffer_pointer()
+    for _ in range(3):
+        metric.update(*args)
+        assert getattr(metric, state).unsafe_buffer_pointer() == ptr, (
+            f"{name}.{state} was reallocated by a donated update"
+        )
+
+
+@pytest.mark.parametrize("name", sorted(FAMILY_CASES))
+def test_donated_update_does_not_retrace(name):
+    ctor, args, _ = FAMILY_CASES[name]
+    metric = ctor()
+    metric.update(*args)
+    metric.update(*args)
+    with CompileCounter() as cc:
+        for _ in range(4):
+            metric.update(*args)
+    assert cc.programs == 0
+
+
+@pytest.mark.parametrize("name", sorted(FAMILY_CASES))
+def test_state_dict_snapshot_survives_donated_updates(name):
+    """The _buffer.py snapshot invariant, generalized: a snapshot taken
+    before N donated updates is still readable and value-identical
+    afterwards (a donated in-place write must never reach it)."""
+    ctor, args, state = FAMILY_CASES[name]
+    metric = ctor()
+    metric.update(*args)
+    sd = metric.state_dict()
+    frozen = {
+        k: np.asarray(v).copy()
+        for k, v in sd.items()
+        if isinstance(v, jax.Array)
+    }
+    for _ in range(3):
+        metric.update(*args)
+    for k, want in frozen.items():
+        got = np.asarray(sd[k])  # raises if the buffer was donated away
+        assert np.array_equal(got, want, equal_nan=True), (
+            f"{name} snapshot state {k!r} mutated by a donated update"
+        )
+    # and the snapshot still round-trips into a fresh clone
+    clone = ctor()
+    clone.load_state_dict(sd)
+    metric2 = ctor()
+    metric2.update(*args)
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(clone.compute())[0]),
+        np.asarray(jax.tree_util.tree_leaves(metric2.compute())[0]),
+    )
+
+
+def test_loaded_state_dict_caller_arrays_survive():
+    """load_state_dict takes ownership: the CALLER's arrays must outlive
+    our donated updates."""
+    src = M.MulticlassAccuracy()
+    src.update(X2, T1)
+    sd = src.state_dict()
+    dst = M.MulticlassAccuracy()
+    dst.load_state_dict(sd)
+    for _ in range(3):
+        dst.update(X2, T1)
+    assert np.asarray(sd["num_correct"]) is not None
+    assert float(src.compute()) == pytest.approx(float(M.MulticlassAccuracy().update(X2, T1).compute()))
+
+
+def test_reset_restores_defaults_after_donated_updates():
+    """reset() must keep working forever: the registered defaults never
+    alias a donated live buffer."""
+    metric = M.MulticlassAccuracy()
+    for _ in range(3):
+        metric.update(X2, T1)
+    metric.reset()
+    assert float(metric.num_total) == 0.0
+    metric.update(X2, T1)
+    want = float(M.MulticlassAccuracy().update(X2, T1).compute())
+    assert float(metric.compute()) == pytest.approx(want)
+    # several reset cycles (each re-places the same stored default)
+    for _ in range(2):
+        metric.reset()
+        metric.update(X2, T1)
+    assert float(metric.compute()) == pytest.approx(want)
+
+
+def test_update_collection_group_donation():
+    """The fused panel path donates too: every member's state buffer is
+    reused in place, and results match individual updates."""
+    panel = {
+        "acc": M.MulticlassAccuracy(),
+        "f1": M.MulticlassF1Score(),
+        "cm": M.MulticlassConfusionMatrix(5),
+    }
+    update_collection(panel, X2, T1)
+    update_collection(panel, X2, T1)
+    ptrs = {
+        "acc": panel["acc"].num_correct.unsafe_buffer_pointer(),
+        "cm": panel["cm"].confusion_matrix.unsafe_buffer_pointer(),
+    }
+    update_collection(panel, X2, T1)
+    assert panel["acc"].num_correct.unsafe_buffer_pointer() == ptrs["acc"]
+    assert panel["cm"].confusion_matrix.unsafe_buffer_pointer() == ptrs["cm"]
+
+    solo = M.MulticlassAccuracy()
+    for _ in range(3):
+        solo.update(X2, T1)
+    assert float(panel["acc"].compute()) == pytest.approx(float(solo.compute()))
+
+
+def test_donation_knob_off_restores_sharing():
+    """With config.update_donation(False) — the CPU process default —
+    old state arrays stay alive (the zero-copy snapshot contract), at
+    the cost of a realloc per step."""
+    with config.update_donation(False):
+        metric = M.MulticlassAccuracy()
+        metric.update(X2, T1)
+        old = metric.num_correct
+        metric.update(X2, T1)
+        # the old buffer was NOT consumed
+        assert np.asarray(old) is not None
+
+
+def test_elastic_snapshot_isolated_from_donated_updates(tmp_path):
+    """ElasticSession bundles capture state_dict() refs at step_done time
+    (async writer may serialize LATER): donated updates running after the
+    capture must not corrupt or invalidate the snapshot."""
+    from torcheval_tpu.elastic import ElasticSession
+
+    metrics = {"acc": M.MulticlassAccuracy(), "mse": M.MeanSquaredError()}
+    session = ElasticSession(metrics, str(tmp_path), interval=1)
+    rng = np.random.default_rng(7)
+    xs = [jnp.asarray(rng.random((16, 5)).astype(np.float32)) for _ in range(4)]
+    ts = [jnp.asarray(rng.integers(0, 5, 16)) for _ in range(4)]
+    for step in range(4):
+        metrics["acc"].update(xs[step], ts[step])
+        metrics["mse"].update(
+            xs[step][:, 0], xs[step][:, 1]
+        )
+        session.step_done(step)
+    session.close()
+
+    fresh = {"acc": M.MulticlassAccuracy(), "mse": M.MeanSquaredError()}
+    restored = ElasticSession(fresh, str(tmp_path), interval=1).restore()
+    # step is the resume cursor: the NEXT step to run after the 4 done
+    assert restored is not None and restored.step == 4
+    # bit-identical to the uninterrupted run
+    assert float(fresh["acc"].num_correct) == float(
+        metrics["acc"].num_correct
+    )
+    assert float(fresh["mse"].sum_squared_error) == float(
+        metrics["mse"].sum_squared_error
+    )
+
+
+def test_donated_sync_step_consumes_carry_and_matches_eager():
+    """sharded.donated_sync_step: the carried state is donated (old carry
+    consumed) and the synced counters match the eager metric oracle."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torcheval_tpu.metrics.functional.classification.accuracy import (
+        _multiclass_accuracy_update,
+    )
+    from torcheval_tpu.metrics.sharded import (
+        donated_sync_step,
+        state_merge_specs,
+    )
+
+    devices = jax.devices("cpu")
+    if len(devices) < 8:
+        pytest.skip("needs xla_force_host_platform_device_count=8")
+    mesh = Mesh(np.array(devices[:8]), ("dp",))
+    metric = M.MulticlassAccuracy()
+    specs = state_merge_specs(metric)
+
+    def upd(xs, ys):
+        nc, nt = _multiclass_accuracy_update(xs, ys, "micro", None, 1)
+        return {"num_correct": nc, "num_total": nt}
+
+    step = donated_sync_step(
+        upd, mesh, "dp", specs, batch_specs=(P("dp"), P("dp"))
+    )
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.uniform(size=(128, 5)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 5, size=(128,)))
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    ys = jax.device_put(y, NamedSharding(mesh, P("dp")))
+
+    state = {"num_correct": jnp.zeros(()), "num_total": jnp.zeros(())}
+    state = step(state, xs, ys)
+    old = state
+    state = step(state, xs, ys)
+    with pytest.raises(RuntimeError):
+        np.asarray(old["num_correct"])  # donated: consumed by the step
+
+    oracle = M.MulticlassAccuracy()
+    oracle.update(x, y)
+    oracle.update(x, y)
+    assert float(state["num_correct"]) == float(oracle.num_correct)
+    assert float(state["num_total"]) == float(oracle.num_total)
+
+
+def test_donated_sync_step_rejects_extend_states():
+    from jax.sharding import Mesh
+
+    from torcheval_tpu.metrics.metric import MergeKind
+    from torcheval_tpu.metrics.sharded import donated_sync_step
+
+    devices = jax.devices("cpu")
+    mesh = Mesh(np.array(devices[:1]), ("dp",))
+    with pytest.raises(NotImplementedError, match="EXTEND"):
+        donated_sync_step(
+            lambda x: {"buf": x},
+            mesh,
+            "dp",
+            {"buf": MergeKind.EXTEND},
+            batch_specs=(),
+        )
+
+
+def test_compute_result_survives_later_donated_updates():
+    """Several computes return a STATE array itself (confusion matrix
+    with normalize=None, Sum/Min/Max): the donation output shield must
+    copy it so the next donated update cannot consume the caller's
+    result (review finding, reproduced as 'Array has been deleted')."""
+    cases = [
+        (M.MulticlassConfusionMatrix(num_classes=5), (X2, T1)),
+        (M.Sum(), (XB,)),
+        (M.Min(), (XB,)),
+        (M.Max(), (XB,)),
+    ]
+    for metric, args in cases:
+        metric.update(*args)
+        result = metric.compute()
+        before = np.asarray(jax.tree_util.tree_leaves(result)[0]).copy()
+        metric.update(*args)
+        after = np.asarray(jax.tree_util.tree_leaves(result)[0])
+        assert np.array_equal(after, before), type(metric).__name__
+
+
+def test_donation_enabled_after_construction_keeps_reset_alive():
+    """A metric constructed while the knob is OFF must survive donation
+    being enabled later: the live state is an unconditional copy of the
+    registered default, so the first donated update can never consume
+    the default's buffer (review finding: reset() permanently broken)."""
+    with config.update_donation(False):
+        metric = M.MulticlassConfusionMatrix(num_classes=5)
+    with config.update_donation(True):
+        metric.update(X2, T1)
+        metric.update(X2, T1)
+        metric.reset()
+        assert int(jnp.sum(metric.confusion_matrix)) == 0
+        metric.update(X2, T1)
+        assert int(jnp.sum(metric.confusion_matrix)) == 64
+
+
+def test_container_state_snapshots_isolated_under_donation():
+    """list/dict states (the documented ``_add_state`` extension point)
+    get leaf-deep clones: a ``state_dict()`` snapshot must not share
+    inner buffers with the live state a donated update may consume, and
+    ``reset()`` must restore buffers independent of the registered
+    default (review finding: containers were shallow-copied)."""
+    from torcheval_tpu.metrics.metric import MergeKind, Metric
+
+    class _ContainerState(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self._add_state(
+                "parts",
+                [jnp.arange(3.0), jnp.arange(3.0) + 10.0],
+                merge=MergeKind.SUM,
+            )
+            self._add_state("table", {"a": jnp.arange(2.0)}, merge=MergeKind.SUM)
+
+        def update(self, x):
+            self.parts = [p + x for p in self.parts]
+            return self
+
+        def compute(self):
+            return self.parts[0]
+
+        def merge_state(self, metrics):
+            return self
+
+    metric = _ContainerState()
+    sd = metric.state_dict()
+    live = {p.unsafe_buffer_pointer() for p in metric.parts}
+    snap = {p.unsafe_buffer_pointer() for p in sd["parts"]}
+    assert live.isdisjoint(snap), "list-state snapshot aliases live buffers"
+    assert (
+        sd["table"]["a"].unsafe_buffer_pointer()
+        != metric.table["a"].unsafe_buffer_pointer()
+    ), "dict-state snapshot aliases live buffers"
+    # the live state is also independent of the registered default
+    metric.update(jnp.float32(1.0))
+    metric.reset()
+    np.testing.assert_array_equal(np.asarray(metric.parts[0]), np.arange(3.0))
+    np.testing.assert_array_equal(np.asarray(metric.parts[1]), np.arange(3.0) + 10.0)
+
+
+def test_reset_and_load_while_donation_off_then_enable():
+    """reset()/load_state_dict() must force-copy like _add_state does: a
+    reset or load performed while the knob is OFF would otherwise alias
+    the live state with the registered default / the caller's snapshot,
+    and a donated update after the knob flips ON would consume those
+    buffers (review finding: metric permanently un-resettable, caller
+    snapshot destroyed)."""
+    with config.update_donation(False):
+        metric = M.MulticlassConfusionMatrix(num_classes=5)
+        metric.update(X2, T1)
+        snap = metric.state_dict()
+        metric.reset()  # while OFF: live state must still not alias default
+        peer = M.MulticlassConfusionMatrix(num_classes=5)
+        peer.load_state_dict(snap)  # while OFF: must not alias snap
+    with config.update_donation(True):
+        metric.update(X2, T1)
+        metric.update(X2, T1)
+        metric.reset()  # default buffer must still be alive
+        assert int(jnp.sum(metric.confusion_matrix)) == 0
+        peer.update(X2, T1)
+        peer.update(X2, T1)
+        for value in snap.values():  # caller's snapshot must survive
+            np.asarray(value)
+        peer.reset()
+        assert int(jnp.sum(peer.confusion_matrix)) == 0

@@ -123,6 +123,22 @@ def test_steady_state_update_is_transfer_free_recorder_on(name):
             rec.disable()
 
 
+def test_donated_update_is_transfer_free_and_in_place():
+    """ISSUE 6 acceptance pin: with donation enabled, the update adds
+    zero host syncs AND reuses the state buffer in place — the per-step
+    zero-realloc claim of the bench ``donation`` arm."""
+    from torcheval_tpu import config
+
+    with config.update_donation(True):
+        metric = M.MulticlassAccuracy()
+        for _ in range(3):
+            metric.update(X2, T1)
+        ptr = metric.num_correct.unsafe_buffer_pointer()
+        with jax.transfer_guard("disallow"):
+            metric.update(*(X2, T1))
+        assert metric.num_correct.unsafe_buffer_pointer() == ptr
+
+
 FUNCTIONAL_CASES = {
     "multiclass_accuracy": lambda: F.multiclass_accuracy(X2, T1),
     "binary_auroc": lambda: F.binary_auroc(XB, TB),

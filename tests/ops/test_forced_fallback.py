@@ -1,0 +1,170 @@
+"""Forced-fallback (no-toolchain) tier: the pure-XLA twins must stay
+exercised and correct even on boxes where the native build succeeds.
+
+``TORCHEVAL_TPU_NO_NATIVE`` (and, in-process, a monkeypatched loader
+cache) force every dispatcher down its fallback branch — the exact code
+path a box without g++ runs — so a twin regression cannot hide behind a
+healthy native library. Also pins the loader-hardening contract: the
+sidecar fingerprint embeds the per-file extra flags AND the full
+symbol->target table, so changing either invalidates the cached .so.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torcheval_tpu.ops import native
+
+
+@pytest.fixture
+def no_native(monkeypatch):
+    """Force ensure_registered() -> False for the duration of a test,
+    restoring the real answer afterwards (the registration itself is
+    process-global and cannot be undone).
+
+    jit caches are cleared on BOTH sides of the scope: the dispatch
+    branch is chosen at trace time, so executables compiled by earlier
+    tests still embed the native custom call (the smoke would silently
+    run native), and executables compiled inside the scope embed the
+    XLA twin (later tests would silently run XLA).
+    """
+    monkeypatch.setenv("TORCHEVAL_TPU_NO_NATIVE", "1")
+    jax.clear_caches()
+    yield
+    # monkeypatch restores the env; the cached _registered answer (if
+    # any) becomes visible again per the knob-before-cache contract
+    jax.clear_caches()
+
+
+def test_env_knob_disables_native(no_native):
+    assert native.ensure_registered() is False
+
+
+def test_forced_fallback_smoke(no_native):
+    """Every public dispatcher must produce correct results with the
+    native library forced off — the no-toolchain degradation tier."""
+    from torcheval_tpu import metrics as M
+    from torcheval_tpu.metrics.functional.classification._curve_kernels import (
+        sort_desc,
+    )
+    from torcheval_tpu.metrics.functional.tensor_utils import argmax_last
+    from torcheval_tpu.ops import (
+        bincount,
+        histogram,
+        segment_count,
+        segment_sum,
+        topk,
+    )
+
+    rng = np.random.default_rng(3)
+    data = jnp.asarray(rng.normal(size=256).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, 8, size=256).astype(np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(segment_sum(data, ids, 8)),
+        np.asarray(jax.ops.segment_sum(data, ids, num_segments=8)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(segment_count(ids, 8)),
+        np.asarray(
+            jax.ops.segment_sum(jnp.ones_like(ids), ids, num_segments=8)
+        ),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bincount(ids, 8)), np.asarray(segment_count(ids, 8))
+    )
+    v = jnp.asarray(rng.uniform(size=512).astype(np.float32))
+    h = np.asarray(histogram(v, 16, bounds=(0.0, 1.0)))
+    assert h.sum() == 512.0
+    x = jnp.asarray(rng.normal(size=(4, 50)).astype(np.float32))
+    tv, ti = topk(x, 5)
+    rv, ri = jax.lax.top_k(x, 5)
+    np.testing.assert_array_equal(np.asarray(ti), np.asarray(ri))
+    s, o = sort_desc(x)
+    assert bool(jnp.all(s[:, :-1] >= s[:, 1:]))
+    assert int(argmax_last(x)[0]) == int(jnp.argmax(x[0]))
+
+    # the class layer end-to-end on the XLA twins
+    acc = M.MulticlassAccuracy()
+    cm = M.MulticlassConfusionMatrix(num_classes=5)
+    xs = jnp.asarray(rng.uniform(size=(64, 5)).astype(np.float32))
+    ts = jnp.asarray(rng.integers(0, 5, size=64))
+    acc.update(xs, ts)
+    cm.update(xs, ts)
+    assert int(jnp.sum(cm.confusion_matrix)) == 64
+    assert 0.0 <= float(acc.compute()) <= 1.0
+
+
+def test_env_knob_respected_in_fresh_process():
+    """The knob must win in a process that COULD build: a subprocess with
+    the env set reports the native library unusable and still computes."""
+    code = (
+        "from torcheval_tpu.ops import native, topk\n"
+        "import jax.numpy as jnp\n"
+        "assert native.ensure_registered() is False\n"
+        "v, i = topk(jnp.array([0.1, 0.9, 0.5]), 2)\n"
+        "assert [int(x) for x in i] == [1, 2]\n"
+        "print('OK')\n"
+    )
+    import os
+
+    env = dict(os.environ)
+    env["TORCHEVAL_TPU_NO_NATIVE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "OK" in out.stdout
+
+
+def test_buildinfo_fingerprints_flags_and_targets():
+    """A flag or target-table change must invalidate the cached library
+    (satellite: no stale .so may load after either changes)."""
+    info = native._expected_buildinfo()
+    assert info["flags"] == native._EXTRA_FLAGS
+    assert info["targets"] == native._TARGETS
+    # every new kernel source participates in the fingerprint
+    for src in ("segment.cc", "histogram.cc", "topk.cc", "sort_desc.cc"):
+        assert src in info["sources"]
+
+
+def test_stale_sidecar_invalidates_cache(tmp_path, monkeypatch):
+    """Simulate a cached .so built with a DIFFERENT flag set / target
+    table: _cache_valid() must reject it."""
+    lib = tmp_path / "lib.so"
+    lib.write_bytes(b"not a real library")
+    sidecar = tmp_path / "lib.so.buildinfo"
+    monkeypatch.setattr(native, "_LIB", str(lib))
+    monkeypatch.setattr(native, "_SIDECAR", str(sidecar))
+
+    good = native._expected_buildinfo()
+    sidecar.write_text(json.dumps(good))
+    assert native._cache_valid()
+
+    stale_flags = dict(good, flags={"segment.cc": ["-O0"]})
+    sidecar.write_text(json.dumps(stale_flags))
+    assert not native._cache_valid()
+
+    stale_targets = dict(
+        good, targets=dict(good["targets"], TopK="renamed_target")
+    )
+    sidecar.write_text(json.dumps(stale_targets))
+    assert not native._cache_valid()
+
+    # legacy sidecar (pre-hardening schema: symbol NAMES only) is also
+    # stale — the upgrade forces one rebuild instead of trusting it
+    legacy = {k: v for k, v in good.items() if k != "targets"}
+    legacy["symbols"] = sorted(good["targets"])
+    sidecar.write_text(json.dumps(legacy))
+    assert not native._cache_valid()

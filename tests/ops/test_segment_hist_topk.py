@@ -1,0 +1,294 @@
+"""Per-kernel parity: Histogram / Bincount / TopK / SegmentSum /
+SegmentCount native CPU kernels vs their pure-XLA twins.
+
+Every dispatcher promises the native path is BIT-IDENTICAL to the XLA
+twin (the fallback contract in docs/api.md). These tests drive the
+public entry points — which route native when the library is loadable —
+and compare against the twins called directly, across dtypes
+(f32 native / bf16 and f64-disabled fallbacks), empty inputs, ties, and
+NaN propagation — mirroring the cross_entropy non-finite parity pin.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torcheval_tpu.ops import bincount, histogram, segment_count, segment_sum, topk
+from torcheval_tpu.ops.histogram import _histogram_xla
+from torcheval_tpu.ops.segment import _segment_count_xla, _segment_sum_xla
+from torcheval_tpu.ops.topk import _topk_xla
+
+RNG = np.random.default_rng(41)
+
+
+def _native_available():
+    from torcheval_tpu.ops import native
+
+    return native.ensure_registered()
+
+
+# ------------------------------------------------------------ segment_sum
+
+
+@pytest.mark.parametrize("n,segments", [(1, 1), (257, 16), (4096, 100)])
+def test_segment_sum_parity(n, segments):
+    data = jnp.asarray(RNG.normal(size=n).astype(np.float32))
+    # includes out-of-range ids on BOTH sides: dropped on both paths
+    ids = jnp.asarray(
+        RNG.integers(-3, segments + 3, size=n).astype(np.int32)
+    )
+    got = segment_sum(data, ids, segments)
+    want = _segment_sum_xla(data, ids, segments)
+    assert got.dtype == want.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_segment_sum_nan_propagates():
+    """A NaN datum poisons exactly its segment, nothing else."""
+    data = jnp.asarray(np.array([1.0, np.nan, 2.0], np.float32))
+    ids = jnp.asarray(np.array([0, 1, 2], np.int32))
+    got = np.asarray(segment_sum(data, ids, 3))
+    assert got[0] == 1.0 and np.isnan(got[1]) and got[2] == 2.0
+
+
+def test_segment_sum_empty_and_f64_fallback():
+    empty = segment_sum(
+        jnp.zeros((0,), jnp.float32), jnp.zeros((0,), jnp.int32), 4
+    )
+    np.testing.assert_array_equal(np.asarray(empty), np.zeros(4, np.float32))
+    # non-f32 data falls back to the XLA twin (same values)
+    data = jnp.asarray(RNG.normal(size=64).astype(np.float32))
+    ids = jnp.asarray(RNG.integers(0, 4, size=64).astype(np.int32))
+    got16 = segment_sum(data.astype(jnp.bfloat16), ids, 4)
+    want16 = _segment_sum_xla(data.astype(jnp.bfloat16), ids, 4)
+    np.testing.assert_array_equal(
+        np.asarray(got16, np.float32), np.asarray(want16, np.float32)
+    )
+
+
+def test_segment_sum_grad_matches_twin():
+    data = jnp.asarray(RNG.normal(size=64).astype(np.float32))
+    ids = jnp.asarray(RNG.integers(-1, 5, size=64).astype(np.int32))
+    g = jax.grad(lambda d: segment_sum(d, ids, 4)[2])(data)
+    gw = jax.grad(lambda d: _segment_sum_xla(d, ids, 4)[2])(data)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(gw))
+
+
+# ---------------------------------------------------------- segment_count
+
+
+@pytest.mark.parametrize("mask", [None, "with_mask"])
+def test_segment_count_parity(mask):
+    ids = jnp.asarray(RNG.integers(-2, 12, size=999).astype(np.int32))
+    m = (
+        None
+        if mask is None
+        else jnp.asarray(RNG.integers(0, 3, size=999).astype(np.int32))
+    )
+    got = segment_count(ids, 10, mask=m)
+    want = _segment_count_xla(ids, 10, m)
+    assert got.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_segment_count_float_mask_parity_and_native():
+    """The house-standard validity mask is float32 (valid_mask's default):
+    the dispatcher normalizes it via ``!= 0`` rather than falling back, so
+    fractional values count as nonzero exactly like the XLA twin."""
+    ids = jnp.asarray(RNG.integers(-2, 12, size=999).astype(np.int32))
+    m = jnp.asarray(RNG.choice([0.0, 0.5, 1.0], size=999).astype(np.float32))
+    got = segment_count(ids, 10, mask=m)
+    want = _segment_count_xla(ids, 10, m)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    if _native_available():
+        text = (
+            jax.jit(lambda i, mm: segment_count(i, 10, mask=mm))
+            .lower(ids, m)
+            .compile()
+            .as_text()
+        )
+        assert "torcheval_segment_count" in text
+
+
+def test_segment_count_empty():
+    got = segment_count(jnp.zeros((0,), jnp.int32), 3)
+    np.testing.assert_array_equal(np.asarray(got), np.zeros(3, np.int32))
+
+
+# -------------------------------------------------------------- histogram
+
+
+@pytest.mark.parametrize(
+    "bounds", [(0.0, 1.0), (0.1, 0.3), (-2.5, 7.0)]
+)
+@pytest.mark.parametrize("weighted", [False, True])
+def test_histogram_parity(bounds, weighted):
+    """Bit-identical across awkward (non-ULP-exact) bounds — the edge
+    constants must be narrowed identically on both paths."""
+    lo, hi = bounds
+    v = jnp.asarray(
+        RNG.uniform(lo - 1.0, hi + 1.0, size=4096).astype(np.float32)
+    )
+    w = (
+        jnp.asarray(RNG.uniform(size=4096).astype(np.float32))
+        if weighted
+        else None
+    )
+    got = histogram(v, 37, bounds=bounds, weights=w)
+    want = _histogram_xla(v, w, 37, lo, hi)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_histogram_nan_and_range_drops():
+    v = jnp.asarray(
+        np.array([0.5, np.nan, -np.inf, np.inf, -0.1, 1.1, 0.0, 1.0],
+                 np.float32)
+    )
+    got = np.asarray(histogram(v, 4, bounds=(0.0, 1.0)))
+    # kept: 0.5 (bin 2), 0.0 (bin 0), 1.0 (last bin, closed right edge)
+    np.testing.assert_array_equal(got, [1.0, 0.0, 1.0, 1.0])
+    # NaN WEIGHT on a valid sample propagates into its bin (both paths)
+    w = jnp.asarray(np.array([np.nan, 1, 1, 1, 1, 1, 1, 1], np.float32))
+    got = np.asarray(histogram(v, 4, bounds=(0.0, 1.0), weights=w))
+    want = np.asarray(_histogram_xla(v, w, 4, 0.0, 1.0))
+    np.testing.assert_array_equal(got, want)
+    assert np.isnan(got[2])
+
+
+def test_histogram_empty_and_dtype_fallback():
+    got = histogram(jnp.zeros((0,), jnp.float32), 5, bounds=(0.0, 1.0))
+    np.testing.assert_array_equal(np.asarray(got), np.zeros(5, np.float32))
+    v = jnp.asarray(RNG.uniform(size=256).astype(np.float32))
+    got16 = histogram(v.astype(jnp.bfloat16), 8, bounds=(0.0, 1.0))
+    want16 = _histogram_xla(
+        v.astype(jnp.bfloat16).astype(jnp.float32), None, 8, 0.0, 1.0
+    )
+    np.testing.assert_array_equal(np.asarray(got16), np.asarray(want16))
+
+
+def test_histogram_rejects_bad_bounds():
+    with pytest.raises(ValueError, match="hi > lo"):
+        histogram(jnp.zeros(4), 4, bounds=(1.0, 1.0))
+
+
+def test_histogram_weight_grad_matches_twin():
+    v = jnp.asarray(RNG.uniform(size=128).astype(np.float32))
+    w = jnp.asarray(RNG.uniform(size=128).astype(np.float32))
+    g = jax.grad(
+        lambda w: histogram(v, 8, bounds=(0.0, 1.0), weights=w)[3]
+    )(w)
+    gw = jax.grad(lambda w: _histogram_xla(v, w, 8, 0.0, 1.0)[3])(w)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(gw))
+
+
+# --------------------------------------------------------------- bincount
+
+
+def test_bincount_counts_and_weights():
+    ids = jnp.asarray(RNG.integers(-1, 12, size=500).astype(np.int32))
+    got = bincount(ids, 10)
+    want = _segment_count_xla(ids, 10, None)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    w = jnp.asarray(RNG.uniform(size=500).astype(np.float32))
+    goww = bincount(ids, 10, weights=w)
+    waww = _segment_sum_xla(w, ids, 10)
+    np.testing.assert_array_equal(np.asarray(goww), np.asarray(waww))
+
+
+def test_bincount_int64_ids_do_not_wrap():
+    """An int64 id past 2^31 must be dropped, not wrapped into range by
+    the int32 cast (possible only under jax_enable_x64 — x64-disabled
+    jax never materializes an int64 array in the first place)."""
+    import jax.experimental
+
+    with jax.experimental.enable_x64():
+        ids = jnp.asarray(
+            np.array([0, 2**31 + 1, 2**33 + 2, -5], np.int64)
+        )
+        assert ids.dtype == jnp.int64
+        got = np.asarray(bincount(ids, 8))
+    want = np.zeros(8, got.dtype)
+    want[0] = 1
+    np.testing.assert_array_equal(got, want)
+
+    with pytest.raises(ValueError, match="integers"):
+        bincount(jnp.zeros(4, jnp.float32), 8)
+
+
+# ------------------------------------------------------------------- topk
+
+
+@pytest.mark.parametrize("shape,k", [((100,), 5), ((7, 257), 17),
+                                     ((3, 64), 64), ((2, 5), 1)])
+def test_topk_parity(shape, k):
+    x = jnp.asarray(RNG.normal(size=shape).astype(np.float32))
+    got_v, got_i = topk(x, k)
+    want_v, want_i = _topk_xla(x, k)
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
+    assert got_i.dtype == want_i.dtype
+
+
+def test_topk_ties_and_specials():
+    """Ties keep ascending index; NaN / ±inf / ±0 follow lax.top_k's
+    descending totalOrder exactly (NaN first, -0 below +0)."""
+    rows = np.array(
+        [
+            [1.0, 3.0, 3.0, 2.0, 3.0, -1.0],
+            [np.nan, 1.0, -np.inf, np.inf, np.nan, 0.5],
+            [0.0, -0.0, 5.0, -5.0, 0.0, -0.0],
+            [2.0, 2.0, 2.0, 2.0, 2.0, 2.0],
+        ],
+        np.float32,
+    )
+    x = jnp.asarray(rows)
+    for k in (1, 3, 6):
+        got_v, got_i = topk(x, k)
+        want_v, want_i = _topk_xla(x, k)
+        np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
+        np.testing.assert_array_equal(
+            np.asarray(got_v), np.asarray(want_v)
+        )  # NaN positions already pinned by the index equality
+
+
+def test_topk_empty_k0_and_dtype_fallback():
+    v, i = topk(jnp.zeros((2, 4), jnp.float32), 0)
+    assert v.shape == (2, 0) and i.shape == (2, 0)
+    x = jnp.asarray(RNG.normal(size=(3, 9)).astype(np.float32))
+    got = topk(x.astype(jnp.bfloat16), 4)
+    want = _topk_xla(x.astype(jnp.bfloat16), 4)
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+    with pytest.raises(ValueError, match="k must be"):
+        topk(x, 10)
+
+
+def test_topk_grad_matches_twin():
+    x = jnp.asarray(RNG.normal(size=(4, 32)).astype(np.float32))
+    g = jax.grad(lambda x: topk(x, 5)[0].sum())(x)
+    gw = jax.grad(lambda x: _topk_xla(x, 5)[0].sum())(x)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(gw))
+
+
+def test_topk_vmap_parity():
+    x = jnp.asarray(RNG.normal(size=(6, 40)).astype(np.float32))
+    got = jax.vmap(lambda r: topk(r, 3))(x)
+    want = jax.vmap(lambda r: _topk_xla(r, 3))(x)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
+# ------------------------------------------------- f64-disabled behavior
+
+
+def test_f64_disabled_int64_guard():
+    """Under default (x64-disabled) jax, int inputs canonicalize to
+    int32 and the native path engages; the parity above covers it. This
+    pin documents that the dispatch NEVER routes raw int64 ids to the
+    int32 kernel (the bincount wrap test is the value-level proof)."""
+    ids = jnp.asarray(np.arange(10, dtype=np.int64))
+    assert ids.dtype == jnp.int32  # canonicalized by x64-disabled jax
+    got = segment_count(ids, 10)
+    np.testing.assert_array_equal(np.asarray(got), np.ones(10, np.int32))

@@ -7,6 +7,7 @@ flush-to-zero tie class for subnormals/±0.
 """
 
 import jax
+import jax.experimental
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -94,7 +95,7 @@ def test_empty_input():
 def test_x64_mode_curve_metric():
     # jax_enable_x64 flips argsort's dtype to int64; the dispatch must
     # still produce equal branch types (reproduces a trace-time crash)
-    with jax.enable_x64(True):
+    with jax.experimental.enable_x64():
         rng = np.random.default_rng(4)
         x = jnp.asarray(rng.uniform(size=64).astype(np.float32))
         s, o = jax.jit(sort_desc)(x)

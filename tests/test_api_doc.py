@@ -34,6 +34,9 @@ MODULES = [
     ("torcheval_tpu.parallel", "parallel"),
     ("torcheval_tpu.models", "models"),
     ("torcheval_tpu.ops.fused_auc", "ops.fused_auc"),
+    ("torcheval_tpu.ops.segment", "ops.segment"),
+    ("torcheval_tpu.ops.histogram", "ops.histogram"),
+    ("torcheval_tpu.ops.topk", "ops.topk"),
 ]
 
 

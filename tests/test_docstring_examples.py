@@ -55,11 +55,14 @@ class _Checker(doctest.OutputChecker):
 def _extra_example_objects():
     """Example-bearing public callables outside the metrics namespaces."""
     from torcheval_tpu.metrics import toolkit
-    from torcheval_tpu.ops import fused_auc
+    from torcheval_tpu.ops import bincount, fused_auc, histogram, topk
     from torcheval_tpu.tools import count_flops
 
     return [
         ("fused_auc", fused_auc),
+        ("histogram", histogram),
+        ("bincount", bincount),
+        ("topk", topk),
         ("update_collection", toolkit.update_collection),
         ("count_flops", count_flops),
     ]

@@ -32,6 +32,7 @@ SD = _load("bench_r7_sync_degraded_cpu_20260803.json")
 SP = _load("bench_r8_sync_payload_cpu_20260803.json")
 CK = _load("bench_r9_checkpoint_cpu_20260803.json")
 OB = _load("bench_r10_observability_cpu_20260803.json")
+KR = _load("bench_r11_kernels_cpu_20260803.json")
 
 
 def _read(path):
@@ -473,3 +474,85 @@ def test_bridge_numerator_terms_match_dispatch_table():
     )
     assert acc and floor
     assert acc.group(1) == floor.group(2)
+
+
+# --------------------------------------------------------- round 11 (ISSUE 6)
+
+R11_KERNEL_ROWS = [
+    (r"segment sum[^|]*\| ([\d.]+) ms \| ([\d.]+) ms \| \*\*([\d.,]+)×\*\*",
+     "segment_sum"),
+    (r"segment count[^|]*\| ([\d.]+) ms \| ([\d.]+) ms \| \*\*([\d.,]+)×\*\*",
+     "segment_count"),
+    (r"fixed-width histogram[^|]*\| ([\d.]+) ms \| ([\d.]+) ms \| \*\*([\d.,]+)×\*\*",
+     "histogram"),
+    (r"top-k selection[^|]*\| ([\d.]+) ms \| ([\d.]+) ms \| \*\*([\d.,]+)×\*\*",
+     "topk"),
+]
+
+
+def test_r11_new_kernel_table_matches_capture():
+    """The round-11 new-op attestation table traces to the committed r11
+    capture (same scheme as the r5 kernel table)."""
+    text = _read("docs/benchmarks.md")
+    kernels = KR["kernels"]["native_cpu"]
+    for pattern, key in R11_KERNEL_ROWS:
+        entry = kernels[key]
+        m = re.search(pattern, text)
+        assert m, f"r11 kernel row not found: /{pattern}/"
+        native_ms = entry["native_us"] / 1000.0
+        xla_ms = entry["xla_us"] / 1000.0
+        assert float(m.group(1)) == pytest.approx(native_ms, abs=0.006)
+        assert float(m.group(2)) == pytest.approx(xla_ms, abs=0.06)
+        assert m.group(3) == _fmt_ratio(xla_ms / native_ms)
+
+
+def test_r11_new_native_ops_meet_2x_acceptance():
+    """ISSUE 6 acceptance: every NEW native op >= 2x its XLA twin on CPU,
+    flagged per-op in the committed capture."""
+    kernels = KR["kernels"]["native_cpu"]
+    assert kernels["available"], "r11 capture ran without the native lib"
+    for op in ("segment_sum", "segment_count", "histogram", "topk"):
+        entry = kernels[op]
+        assert entry["meets_2x"] is True, f"{op}: {entry}"
+        assert entry["xla_over_native"] >= 2.0, f"{op}: {entry}"
+
+
+def test_r11_donation_arm_zero_realloc():
+    """ISSUE 6 acceptance: the donation arm shows ZERO per-step state
+    realloc (the live tier-1 pin is tests/metrics/test_donation.py;
+    this guards the committed capture and its published numbers)."""
+    don = KR["kernels"]["donation"]
+    assert don["zero_realloc"] is True
+    assert don["realloc_steps"] == 0
+    text = _read("docs/benchmarks.md")
+    m = re.search(
+        r"state reallocations over (\d+) donated updates[^|]*\| \*\*0\*\*",
+        text,
+    )
+    assert m, "donation zero-realloc row not found"
+    assert int(m.group(1)) == don["steps_checked"]
+    m = re.search(
+        r"donated vs undonated update \(100×100 confusion matrix\) \| "
+        r"([\d.]+) vs ([\d.]+) µs/step",
+        text,
+    )
+    assert m, "donation timing row not found"
+    cm = don["confusion_matrix_100"]
+    assert float(m.group(1)) == pytest.approx(cm["donated_us"], abs=0.05)
+    assert float(m.group(2)) == pytest.approx(cm["undonated_us"], abs=0.05)
+
+
+def test_r11_headline_configs_meet_2x():
+    """ISSUE 6 acceptance: accuracy_update and auroc_compute both >= 2x
+    vs reference in the committed r11 capture (baseline reused from the
+    committed r5 reference measurement — /root/reference is absent in
+    this container; the capture's vs_baseline_note records that)."""
+    for key in ("accuracy_update", "auroc_compute"):
+        entry = KR[key]
+        assert entry["vs_baseline"] is not None, entry.get(
+            "vs_baseline_error", entry
+        )
+        assert entry["vs_baseline"] >= 2.0, (
+            f"{key}: {entry['vs_baseline']}x vs reference"
+        )
+        assert entry.get("baseline_value"), entry

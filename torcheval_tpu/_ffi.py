@@ -1,0 +1,20 @@
+"""XLA FFI module resolution across jax versions.
+
+``jax.ffi`` (the stable home of ``ffi_call`` / ``register_ffi_target`` /
+``include_dir`` / ``pycapsule``) only exists from jax 0.4.38; on 0.4.37
+the same surface lives at ``jax.extend.ffi``. Every native-kernel call
+site imports the module through here — before this shim, a
+``ModuleNotFoundError`` inside the loader's try/except silently disabled
+the ENTIRE native library on pre-0.4.38 jax (the build-on-first-use
+loader degraded exactly as designed, which made a 4-20x kernel-speed
+loss look like a missing toolchain).
+"""
+
+from __future__ import annotations
+
+try:
+    import jax.ffi as ffi  # jax >= 0.4.38
+except ImportError:  # pragma: no cover - exercised on pre-0.4.38 jax
+    from jax.extend import ffi  # type: ignore[no-redef]
+
+__all__ = ["ffi"]

@@ -44,12 +44,18 @@ import os
 from contextlib import contextmanager
 from typing import Iterator, Optional
 
-_debug_validation: bool = os.environ.get("TORCHEVAL_TPU_DEBUG", "").lower() in (
-    "1",
-    "true",
-    "yes",
-    "on",
-)
+# Accepted spellings for boolean env knobs, shared by every
+# TORCHEVAL_TPU_* flag (here, ops.native, obs.recorder).
+_TRUTHY = ("1", "true", "yes", "on")
+_FALSY = ("0", "false", "no", "off")
+
+
+def env_truthy(name: str) -> bool:
+    """True when env var ``name`` is set to a truthy spelling."""
+    return os.environ.get(name, "").lower() in _TRUTHY
+
+
+_debug_validation: bool = env_truthy("TORCHEVAL_TPU_DEBUG")
 
 
 def debug_validation_enabled() -> bool:
@@ -78,9 +84,7 @@ def debug_validation(enabled: bool = True) -> Iterator[None]:
         _debug_validation = prev
 
 
-_shape_bucketing: bool = os.environ.get(
-    "TORCHEVAL_TPU_SHAPE_BUCKETING", ""
-).lower() in ("1", "true", "yes", "on")
+_shape_bucketing: bool = env_truthy("TORCHEVAL_TPU_SHAPE_BUCKETING")
 
 
 def shape_bucketing_enabled() -> bool:
@@ -91,6 +95,72 @@ def shape_bucketing_enabled() -> bool:
 def set_shape_bucketing(enabled: bool) -> None:
     global _shape_bucketing
     _shape_bucketing = bool(enabled)
+
+
+# -------------------------------------------------------- update donation
+
+# None = not yet resolved (env, else backend default at first use)
+_update_donation: Optional[bool] = None
+
+
+def _resolve_update_donation() -> bool:
+    raw = os.environ.get("TORCHEVAL_TPU_UPDATE_DONATION", "").lower()
+    if raw in _TRUTHY:
+        return True
+    if raw in _FALSY:
+        return False
+    # Backend-dependent default, measured not assumed: on TPU the donated
+    # buffer aliases in HBM and dispatch stays fully async — donation is
+    # a pure win (zero realloc per step). On the CPU PJRT runtime,
+    # acquiring exclusive ownership of the donated buffer WAITS on its
+    # pending producer, serializing back-to-back updates (+70-150 us/step
+    # on the bench box whenever the kernel has real compute — see the
+    # bench `donation` arm's paired-differences numbers). CPU therefore
+    # defaults off; the zero-realloc machinery stays available behind the
+    # knob on every backend.
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def update_donation_enabled() -> bool:
+    """True when fusable metric updates DONATE their state buffers into
+    the jitted step, so XLA writes the new state in place — zero per-step
+    realloc — instead of allocating a fresh buffer every ``update()``
+    (docs/benchmarks.md, "Donation fast path"). Default: on for TPU,
+    off for CPU (see ``_resolve_update_donation`` for the measured why);
+    env ``TORCHEVAL_TPU_UPDATE_DONATION`` overrides either way.
+
+    Consequence when on (the ``_buffer.py`` donated-append discipline,
+    extended to every accumulator family): state arrays handed out by
+    ``state_dict()`` / snapshots are COPIES, and a raw state attribute
+    captured before an update must not be read after it (the donated
+    buffer is consumed). Meant as a one-time process-level choice:
+    flipping it between an update and a snapshot re-exposes the aliasing
+    the snapshot copies exist to prevent.
+    """
+    global _update_donation
+    if _update_donation is None:
+        _update_donation = _resolve_update_donation()
+    return _update_donation
+
+
+def set_update_donation(enabled: bool) -> None:
+    global _update_donation
+    _update_donation = bool(enabled)
+
+
+@contextmanager
+def update_donation(enabled: bool = True) -> Iterator[None]:
+    """Scoped override of :func:`update_donation_enabled` (bench arms and
+    tests; see the one-time-choice caveat on the getter)."""
+    global _update_donation
+    prev = _update_donation
+    _update_donation = bool(enabled)
+    try:
+        yield
+    finally:
+        _update_donation = prev
 
 
 # --------------------------------------------------------- sync resilience

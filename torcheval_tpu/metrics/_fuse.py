@@ -59,6 +59,8 @@ def fused_accumulate(
     states: Tuple[jax.Array, ...],
     dynamic: Tuple[jax.Array, ...],
     config: Tuple = (),
+    *,
+    donate: bool = False,
 ) -> Tuple[jax.Array, ...]:
     """``tuple(s + d for s, d in zip(states, kernel(*dynamic, *config)))``
     as one jitted dispatch.
@@ -66,15 +68,22 @@ def fused_accumulate(
     ``config`` entries must be hashable (they key the cache and are baked
     into the trace as compile-time constants). ``kernel`` may return a
     single array (treated as a 1-tuple) or a tuple matching ``states``.
+
+    ``donate=True`` donates the state tuple (``donate_argnums=0``): XLA
+    aliases each state's input and output buffer — every ``s + d`` is an
+    in-place accumulate, zero realloc per step — and the caller's state
+    arrays are CONSUMED (deleted after the call). Callers own the
+    aliasing discipline: nothing else may hold those array objects
+    (``Metric`` snapshot paths copy; see ``config.update_donation``).
     """
-    key = (kernel, config, len(states), len(dynamic))
+    key = (kernel, config, len(states), len(dynamic), donate)
     fn = _CACHE.get(key)
     if fn is None:
 
         def fused(states, *dyn):
             return _apply_kernel(kernel, config, states, dyn)
 
-        fn = jax.jit(fused)
+        fn = jax.jit(fused, donate_argnums=(0,) if donate else ())
         _CACHE[key] = fn
     return fn(states, *dynamic)
 
@@ -82,18 +91,20 @@ def fused_accumulate(
 _TRANSFORM_CACHE: Dict[Any, Callable] = {}
 
 
-def fused_transform(kernel, states, dynamic, config=()):
+def fused_transform(kernel, states, dynamic, config=(), *, donate=False):
     """``kernel(states, *dynamic, *config)`` -> new states, as one jitted
     dispatch — the non-additive sibling of ``fused_accumulate`` (ring
-    column writes, running extrema). Cached per (kernel, config, arity)."""
-    key = (kernel, config, len(states), len(dynamic))
+    column writes, running extrema). Cached per (kernel, config, arity);
+    ``donate`` as in ``fused_accumulate`` (a ring-buffer column write
+    becomes a true in-place write instead of an O(window) copy)."""
+    key = (kernel, config, len(states), len(dynamic), donate)
     fn = _TRANSFORM_CACHE.get(key)
     if fn is None:
 
         def fused(states, *dyn):
             return _apply_transform(kernel, config, states, dyn)
 
-        fn = jax.jit(fused)
+        fn = jax.jit(fused, donate_argnums=(0,) if donate else ())
         _TRANSFORM_CACHE[key] = fn
     return fn(states, *dynamic)
 
@@ -101,11 +112,12 @@ def fused_transform(kernel, states, dynamic, config=()):
 _GROUP_CACHE: Dict[Any, Callable] = {}
 
 
-def fused_accumulate_group(plans):
+def fused_accumulate_group(plans, *, donate=False):
     """Run MANY fusable update plans as ONE jitted dispatch.
 
     ``plans`` is a sequence of ``(kernel, states, dynamic, config)`` or
-    ``(kernel, states, dynamic, config, transform)`` tuples. Accumulate
+    ``(kernel, states, dynamic, config, transform)`` tuples
+    (``donate=True`` donates every plan's states — in-place group update). Accumulate
     plans apply ``states += kernel(*dynamic, *config)``; transform plans
     apply ``states = kernel(states, *dynamic, *config)``. Returns the new
     states, one tuple per plan, computed by a single XLA program — the
@@ -120,7 +132,7 @@ def fused_accumulate_group(plans):
     configs = tuple(p[3] for p in plans)
     kinds = tuple(bool(p[4]) if len(p) > 4 else False for p in plans)
     arity = tuple((len(p[1]), len(p[2])) for p in plans)
-    key = (kernels, configs, kinds, arity)
+    key = (kernels, configs, kinds, arity, donate)
     fn = _GROUP_CACHE.get(key)
     if fn is None:
 
@@ -137,7 +149,11 @@ def fused_accumulate_group(plans):
                     out.append(_apply_kernel(kernel, config, states, dyn))
             return tuple(out)
 
-        fn = jax.jit(fused)
+        # donation covers the whole states group: only set when EVERY
+        # participating metric follows the snapshot-copy discipline
+        # (toolkit.update_collection checks), since a donated buffer is
+        # consumed for all of them at once
+        fn = jax.jit(fused, donate_argnums=(0,) if donate else ())
         _GROUP_CACHE[key] = fn
     return fn(
         tuple(p[1] for p in plans), tuple(p[2] for p in plans)

@@ -29,6 +29,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu._ffi import ffi as _ffi
+
 
 def _sort_desc_xla(input: jax.Array) -> Tuple[jax.Array, jax.Array]:
     order = jnp.argsort(-input, axis=-1, stable=True)
@@ -41,7 +43,7 @@ def _sort_desc_native(input: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
     n = input.shape[-1]
     x2 = input.reshape(-1, n)
-    call = jax.ffi.ffi_call(
+    call = _ffi.ffi_call(
         "torcheval_sort_desc",
         (
             jax.ShapeDtypeStruct(x2.shape, jnp.float32),
@@ -108,7 +110,7 @@ def _native_area_call(
 
     n = input.shape[-1]
     x2 = input.reshape(-1, n)
-    call = jax.ffi.ffi_call(
+    call = _ffi.ffi_call(
         target_name,
         jax.ShapeDtypeStruct((x2.shape[0],), jnp.float32),
         vmap_method="sequential",

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
+from torcheval_tpu.ops.topk import topk
 from torcheval_tpu.metrics.functional.tensor_utils import correct_mask, valid_mask
 from torcheval_tpu.utils.convert import to_jax
 
@@ -341,8 +342,9 @@ def _topk_multilabel_accuracy_update(
     input: jax.Array, target: jax.Array, criteria: str, k: int
 ) -> Tuple[jax.Array, jax.Array]:
     # Exactly k predicted labels per example (ties broken by index, matching
-    # torch.topk semantics); lax.top_k lowers to an efficient TPU sort.
-    _, idx = jax.lax.top_k(input, k)
+    # torch.topk semantics); lax.top_k lowers to an efficient TPU sort, and
+    # the CPU lowering swaps in the O(n) native selection (ops/native/topk.cc).
+    _, idx = topk(input, k)
     rows = jnp.arange(input.shape[0])[:, None]
     input_label = jnp.zeros(input.shape, dtype=target.dtype).at[rows, idx].set(1)
     return _multilabel_update(input_label, target, criteria)
@@ -357,7 +359,7 @@ def _topk_multilabel_accuracy_update_masked(
     k: int,
 ) -> Tuple[jax.Array, jax.Array]:
     valid = valid_mask(target.shape[0], valid_sizes[0])
-    _, idx = jax.lax.top_k(input, k)
+    _, idx = topk(input, k)
     rows = jnp.arange(input.shape[0])[:, None]
     input_label = jnp.zeros(input.shape, dtype=target.dtype).at[rows, idx].set(1)
     return _multilabel_update_masked(input_label, target, valid, criteria)

@@ -30,6 +30,7 @@ from torcheval_tpu.metrics.functional.tensor_utils import (
     nan_safe_divide,
     valid_mask,
 )
+from torcheval_tpu.ops.segment import safe_ids, segment_sum
 from torcheval_tpu.utils.convert import to_jax
 
 DEFAULT_NUM_THRESHOLD = 100
@@ -52,10 +53,11 @@ def _binary_binned_update_jit(
     idx = jnp.searchsorted(threshold, input, side="right") - 1
     fused = 2 * idx + target.astype(jnp.int32)
     valid = (idx >= 0).astype(jnp.float32)
-    hist = jax.ops.segment_sum(
+    # native one-pass histogram on the CPU lowering (ops/native/segment.cc)
+    hist = segment_sum(
         valid,
-        jnp.clip(fused, 0, 2 * num_thresholds - 1),
-        num_segments=2 * num_thresholds,
+        jnp.clip(fused, 0, 2 * num_thresholds - 1).astype(jnp.int32),
+        2 * num_thresholds,
     )
     per_bin = hist.reshape(num_thresholds, 2)
     # suffix sums: counts with input >= threshold[i]
@@ -80,10 +82,10 @@ def _binary_binned_update_masked_jit(
     idx = jnp.searchsorted(threshold, input, side="right") - 1
     fused = 2 * idx + target.astype(jnp.int32)
     weight = (idx >= 0).astype(jnp.float32) * valid
-    hist = jax.ops.segment_sum(
+    hist = segment_sum(
         weight,
-        jnp.clip(fused, 0, 2 * num_thresholds - 1),
-        num_segments=2 * num_thresholds,
+        jnp.clip(fused, 0, 2 * num_thresholds - 1).astype(jnp.int32),
+        2 * num_thresholds,
     )
     per_bin = hist.reshape(num_thresholds, 2)
     suffix = jnp.flip(jnp.cumsum(jnp.flip(per_bin, axis=0), axis=0), axis=0)
@@ -166,16 +168,18 @@ def _multiclass_binned_update_memory_jit(
     fused = 2 * (num_classes * idx + classes[None, :]) + is_target
     valid = (idx >= 0).astype(jnp.float32)
     nbins = 2 * num_thresholds * num_classes
-    hist = jax.ops.segment_sum(
+    hist = segment_sum(
         valid.reshape(-1),
-        jnp.clip(fused, 0, nbins - 1).reshape(-1),
-        num_segments=nbins,
+        jnp.clip(fused, 0, nbins - 1).reshape(-1).astype(jnp.int32),
+        nbins,
     )
     per_bin = hist.reshape(num_thresholds, num_classes, 2)
     suffix = jnp.flip(jnp.cumsum(jnp.flip(per_bin, axis=0), axis=0), axis=0)
     num_fp, num_tp = suffix[..., 0], suffix[..., 1]  # (T, C)
-    class_counts = jax.ops.segment_sum(
-        jnp.ones_like(target, dtype=jnp.float32), target, num_segments=num_classes
+    class_counts = segment_sum(
+        jnp.ones_like(target, dtype=jnp.float32),
+        safe_ids(target, num_classes),
+        num_classes,
     )
     num_fn = class_counts[None, :] - num_tp
     return num_tp, num_fp, num_fn
@@ -216,17 +220,15 @@ def _multiclass_binned_update_memory_masked(
     fused = 2 * (num_classes * idx + classes[None, :]) + is_target
     weight = (idx >= 0).astype(jnp.float32) * valid[:, None]
     nbins = 2 * num_thresholds * num_classes
-    hist = jax.ops.segment_sum(
+    hist = segment_sum(
         weight.reshape(-1),
-        jnp.clip(fused, 0, nbins - 1).reshape(-1),
-        num_segments=nbins,
+        jnp.clip(fused, 0, nbins - 1).reshape(-1).astype(jnp.int32),
+        nbins,
     )
     per_bin = hist.reshape(num_thresholds, num_classes, 2)
     suffix = jnp.flip(jnp.cumsum(jnp.flip(per_bin, axis=0), axis=0), axis=0)
     num_fp, num_tp = suffix[..., 0], suffix[..., 1]
-    class_counts = jax.ops.segment_sum(
-        valid, target, num_segments=num_classes
-    )
+    class_counts = segment_sum(valid, safe_ids(target, num_classes), num_classes)
     num_fn = class_counts[None, :] - num_tp
     return num_tp, num_fp, num_fn
 
@@ -319,10 +321,10 @@ def _multilabel_binned_update_memory_jit(
     fused = 2 * (num_labels * idx + labels[None, :]) + target.astype(jnp.int32)
     valid = (idx >= 0).astype(jnp.float32)
     nbins = 2 * num_thresholds * num_labels
-    hist = jax.ops.segment_sum(
+    hist = segment_sum(
         valid.reshape(-1),
-        jnp.clip(fused, 0, nbins - 1).reshape(-1),
-        num_segments=nbins,
+        jnp.clip(fused, 0, nbins - 1).reshape(-1).astype(jnp.int32),
+        nbins,
     )
     per_bin = hist.reshape(num_thresholds, num_labels, 2)
     suffix = jnp.flip(jnp.cumsum(jnp.flip(per_bin, axis=0), axis=0), axis=0)
@@ -365,10 +367,10 @@ def _multilabel_binned_update_memory_masked(
     fused = 2 * (num_labels * idx + labels[None, :]) + target.astype(jnp.int32)
     weight = (idx >= 0).astype(jnp.float32) * valid[:, None]
     nbins = 2 * num_thresholds * num_labels
-    hist = jax.ops.segment_sum(
+    hist = segment_sum(
         weight.reshape(-1),
-        jnp.clip(fused, 0, nbins - 1).reshape(-1),
-        num_segments=nbins,
+        jnp.clip(fused, 0, nbins - 1).reshape(-1).astype(jnp.int32),
+        nbins,
     )
     per_bin = hist.reshape(num_thresholds, num_labels, 2)
     suffix = jnp.flip(jnp.cumsum(jnp.flip(per_bin, axis=0), axis=0), axis=0)

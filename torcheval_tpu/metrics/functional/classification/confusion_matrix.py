@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from torcheval_tpu.config import debug_validation_enabled
 from torcheval_tpu.metrics.functional.tensor_utils import argmax_last, valid_mask
+from torcheval_tpu.ops.segment import segment_count
 from torcheval_tpu.utils.convert import to_jax
 
 
@@ -27,10 +28,9 @@ def _confusion_matrix_update_jit(
     if input.ndim == 2:
         input = argmax_last(input)
     flat = target.astype(jnp.int32) * num_classes + input.astype(jnp.int32)
-    counts = jax.ops.segment_sum(
-        jnp.ones_like(flat, dtype=jnp.int32), flat,
-        num_segments=num_classes * num_classes,
-    )
+    # one-pass native count on the CPU lowering (XLA:CPU's scatter-add is
+    # a per-element loop); out-of-range fused ids drop on both paths
+    counts = segment_count(flat, num_classes * num_classes)
     return counts.reshape(num_classes, num_classes)
 
 
@@ -40,12 +40,12 @@ def _confusion_matrix_update_masked(
 ) -> jax.Array:
     """Mask-aware twin of ``_confusion_matrix_update_jit`` (shape
     bucketing): padded rows scatter weight 0 into cell (0, 0)."""
-    valid = valid_mask(target.shape[0], valid_sizes[0], dtype=jnp.int32)
+    valid = valid_mask(target.shape[0], valid_sizes[0])
     if input.ndim == 2:
         input = argmax_last(input)
     flat = target.astype(jnp.int32) * num_classes + input.astype(jnp.int32)
-    counts = jax.ops.segment_sum(
-        valid, flat, num_segments=num_classes * num_classes
+    counts = segment_count(
+        flat, num_classes * num_classes, mask=valid
     )
     return counts.reshape(num_classes, num_classes)
 

@@ -14,6 +14,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.ops.topk import topk
 from torcheval_tpu.utils.convert import to_jax
 
 
@@ -58,7 +59,9 @@ def get_topk(t: jax.Array, k: Optional[int]) -> Tuple[jax.Array, jax.Array]:
     nb_samples = t.shape[-1]
     if k is None:
         k = nb_samples
-    return jax.lax.top_k(t, min(k, nb_samples))
+    # O(n) native selection on the CPU lowering (ops/native/topk.cc);
+    # lax.top_k everywhere else — identical semantics
+    return topk(t, min(k, nb_samples))
 
 
 def _compute_nb_relevant_items_retrieved(

@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torcheval_tpu._ffi import ffi as _ffi
+
 
 def nan_safe_divide(a: jax.Array, b: jax.Array) -> jax.Array:
     """``a / b`` yielding NaN (not inf / a trace error) where ``b == 0``.
@@ -52,7 +54,7 @@ def _match_vma(out: jax.Array, ref: jax.Array) -> jax.Array:
 
 
 def _correct_mask_native(x: jax.Array, target: jax.Array) -> jax.Array:
-    call = jax.ffi.ffi_call(
+    call = _ffi.ffi_call(
         "torcheval_correct_mask",
         jax.ShapeDtypeStruct((x.shape[0],), jnp.float32),
         vmap_method="sequential",
@@ -110,7 +112,7 @@ def _correct_mask_xla(x: jax.Array, target: jax.Array) -> jax.Array:
 def _argmax_last_native(x: jax.Array) -> jax.Array:
     c = x.shape[-1]
     x2 = x.reshape(-1, c)
-    call = jax.ffi.ffi_call(
+    call = _ffi.ffi_call(
         "torcheval_argmax_last",
         jax.ShapeDtypeStruct((x2.shape[0],), jnp.int32),
         vmap_method="sequential",

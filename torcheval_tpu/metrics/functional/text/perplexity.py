@@ -19,6 +19,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu._ffi import ffi as _ffi
+
 from torcheval_tpu.config import debug_validation_enabled
 from torcheval_tpu.utils.convert import to_jax, to_jax_float
 
@@ -83,7 +85,7 @@ def _perplexity_update_native_jit(
     target: jax.Array,
     ignore_index: Optional[int],
 ) -> Tuple[jax.Array, jax.Array]:
-    call = jax.ffi.ffi_call(
+    call = _ffi.ffi_call(
         "torcheval_ce_nll",
         (
             jax.ShapeDtypeStruct((), jnp.float32),

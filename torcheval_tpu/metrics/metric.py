@@ -139,8 +139,23 @@ def _is_array(x: Any) -> bool:
     return isinstance(x, jax.Array)
 
 
+def _shield_compute_output(metric: "Metric", out: Any) -> Any:
+    """Copy array leaves of a ``compute()`` result while donation is
+    active: several computes return a STATE array itself (confusion
+    matrix with ``normalize=None``, Sum/Min/Max), and the next donated
+    update would consume it out from under the caller. Off the donation
+    path this is a no-op (computes stay zero-copy)."""
+    if not metric._donation_active():
+        return out
+    return jax.tree_util.tree_map(
+        lambda x: jnp.copy(x) if _is_array(x) else x, out
+    )
+
+
 def _instrumented(fn, phase: str, cls_name: str):
-    """Wrap a subclass's ``update``/``compute`` with observability.
+    """Wrap a subclass's ``update``/``compute`` with observability (and,
+    for ``compute``, the donation output shield — see
+    ``_shield_compute_output``).
 
     Recorder OFF (the default): one attribute read, then the original
     function — no host sync, no allocation (the recorder-ON/OFF parity is
@@ -154,11 +169,13 @@ def _instrumented(fn, phase: str, cls_name: str):
     from torcheval_tpu.obs.events import ComputeEvent, UpdateEvent
 
     label = f"torcheval.{phase}/{cls_name}"
+    is_compute = phase == "compute"
 
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
         if not _OBS.enabled:
-            return fn(self, *args, **kwargs)
+            out = fn(self, *args, **kwargs)
+            return _shield_compute_output(self, out) if is_compute else out
         t0 = time.monotonic()
         with jax.profiler.TraceAnnotation(label):
             out = fn(self, *args, **kwargs)
@@ -168,6 +185,7 @@ def _instrumented(fn, phase: str, cls_name: str):
             self.obs_step = _OBS.step_cursor
             _OBS.record(UpdateEvent(metric=name, seconds=seconds))
         else:
+            out = _shield_compute_output(self, out)
             _OBS.record(ComputeEvent(metric=name, seconds=seconds))
         return out
 
@@ -229,17 +247,52 @@ class Metric(Generic[TComputeReturn], ABC):
         self._check_state_variable_type(name, default)
         self._state_name_to_default[name] = self._clone_state(default)
         self._state_name_to_merge_kind[name] = merge
-        setattr(self, name, self._place_state(default))
+        # the LIVE state must NEVER alias the registered default
+        # (device_put to the same device is a no-copy identity): a
+        # donated update consumes the live buffer, and if donation is
+        # enabled at ANY point in the metric's life — including via the
+        # config knob AFTER construction — an aliased default would die
+        # with it, permanently breaking reset(). One unconditional copy
+        # per state at construction buys that out.
+        setattr(
+            self, name, self._place_state(self._clone_state(default, force_copy=True))
+        )
 
-    def _clone_state(self, value: TState) -> TState:
+    # Donation fast path (ROADMAP item 4): when True — and the process
+    # knob ``config.update_donation`` is on (TPU default; see its measured
+    # CPU caveat) — this metric's fusable update plans run through jitted
+    # steps with ``donate_argnums``, so XLA writes each new state into the
+    # OLD state's buffer (zero realloc per step). Ownership consequence
+    # (the ``_buffer.py`` donated-append discipline, generalized): state
+    # array objects must never escape the metric — ``_clone_state``
+    # therefore COPIES arrays while donation is in effect, which makes
+    # ``state_dict()`` / ``reset()`` / ``load_state_dict`` hand out and
+    # take in independent buffers. Subclasses whose states intentionally
+    # alias external arrays opt out by setting this False.
+    _donated_update: bool = True
+
+    def _donation_active(self) -> bool:
+        return self._donated_update and config.update_donation_enabled()
+
+    def _clone_state(self, value: TState, *, force_copy: bool = False) -> TState:
         if _is_array(value):
+            if force_copy or self._donation_active():
+                # a later donated update CONSUMES the live buffer; a
+                # snapshot sharing it would die with it
+                return jnp.copy(value)
             return value  # jax.Arrays are immutable; no copy needed
         if isinstance(value, list):
-            return list(value)
+            # clone leaves too: a shallow container copy would share the
+            # inner arrays with the live state, which a donated update
+            # consumes — the same invariant as the bare-array branch
+            return [self._clone_state(v, force_copy=force_copy) for v in value]
         if isinstance(value, DefaultStateDict):
-            return DefaultStateDict(value._device_str, dict(value))
+            return DefaultStateDict(
+                value._device_str,
+                {k: self._clone_state(v, force_copy=force_copy) for k, v in value.items()},
+            )
         if isinstance(value, dict):
-            return dict(value)
+            return {k: self._clone_state(v, force_copy=force_copy) for k, v in value.items()}
         return copy.deepcopy(value)
 
     def _place_state(self, value: TState, device: Optional[jax.Device] = None) -> TState:
@@ -391,16 +444,19 @@ class Metric(Generic[TComputeReturn], ABC):
         from torcheval_tpu.metrics._bucket import apply_bucketing
         from torcheval_tpu.metrics._fuse import fused_transform
 
+        donate = self._donation_active()
         if isinstance(plan, UpdatePlan):
             plan = apply_bucketing(plan)
             states = tuple(getattr(self, n) for n in plan.state_names)
             if plan.transform:
                 new_states = fused_transform(
-                    plan.kernel, states, plan.dynamic, plan.config
+                    plan.kernel, states, plan.dynamic, plan.config,
+                    donate=donate,
                 )
             else:
                 new_states = fused_accumulate(
-                    plan.kernel, states, plan.dynamic, plan.config
+                    plan.kernel, states, plan.dynamic, plan.config,
+                    donate=donate,
                 )
             for name, value in zip(plan.state_names, new_states):
                 setattr(self, name, value)
@@ -410,7 +466,8 @@ class Metric(Generic[TComputeReturn], ABC):
         kernel, state_names, dynamic, *rest = plan
         config = rest[0] if rest else ()
         states = tuple(getattr(self, name) for name in state_names)
-        new_states = fused_accumulate(kernel, states, dynamic, config)
+        new_states = fused_accumulate(kernel, states, dynamic, config,
+                                      donate=donate)
         for name, value in zip(state_names, new_states):
             setattr(self, name, value)
         return self
@@ -483,7 +540,14 @@ class Metric(Generic[TComputeReturn], ABC):
                     self, name, DefaultStateDict(device_descriptor(self._device))
                 )
             else:
-                setattr(self, name, self._place_state(self._clone_state(default)))
+                # force_copy for the same reason _add_state does: the live
+                # state must never alias the registered default, even when
+                # donation only gets enabled AFTER this reset
+                setattr(
+                    self,
+                    name,
+                    self._place_state(self._clone_state(default, force_copy=True)),
+                )
         # a provenance left by a prior (possibly degraded) sync — and the
         # observability step cursor stamped by the last recorded update —
         # describe state this reset just discarded; they must not outlive
@@ -538,7 +602,14 @@ class Metric(Generic[TComputeReturn], ABC):
         for name in registered & provided:
             value = state_dict[name]
             self._check_state_variable_type(name, value)
-            setattr(self, name, self._place_state(self._clone_state(value)))
+            # force_copy: the caller keeps its snapshot arrays — the live
+            # state must not alias them, or a donated update issued after
+            # donation gets enabled would consume the caller's snapshot
+            setattr(
+                self,
+                name,
+                self._place_state(self._clone_state(value, force_copy=True)),
+            )
         # restored state replaces whatever a prior sync produced: drop the
         # stale provenance (the sync path re-attaches its own afterwards)
         # and the stale observability step cursor alike

@@ -211,3 +211,87 @@ def sync_states_in_jit(
 def tree_add(state: Dict[str, Any], delta: Dict[str, Any]) -> Dict[str, Any]:
     """Accumulate an update's counter deltas into the carried state."""
     return jax.tree_util.tree_map(lambda a, b: a + b, state, delta)
+
+
+def donated_sync_step(
+    update_fn,
+    mesh,
+    axis_name: AxisNames,
+    specs: Optional[Dict[str, MergeKind]] = None,
+    *,
+    batch_specs: Tuple,
+    compression: Optional[str] = None,
+):
+    """Build the carried-state eval step with the state DONATED: returns a
+    jitted ``step(state, *batch) -> state`` that runs
+    ``sync_states_in_jit(tree_add(state, update_fn(*batch_shards)))``
+    under ``shard_map`` with ``donate_argnums=(0,)``, so XLA writes each
+    step's synced counters back into the carry's own buffers — zero state
+    realloc per step, the in-jit analogue of the donated class-metric
+    update path (``config.update_donation``).
+
+    Args:
+        update_fn: per-replica update kernel ``(*batch_shards) ->
+            {name: local_delta}`` (e.g. the functional
+            ``_multiclass_accuracy_update`` wrapped into a dict).
+        mesh: the ``jax.sharding.Mesh`` the step runs over.
+        axis_name: mesh axis (or tuple) to sync across.
+        specs: per-state merge kinds. Only the reduce kinds
+            (SUM / MAX / MIN) are supported: an EXTEND gather grows the
+            state by the world size, so its output cannot alias the
+            donated input buffer — carry EXTEND buffers outside the
+            donated carry (or sync them eagerly).
+        batch_specs: one ``PartitionSpec`` per ``update_fn`` argument.
+        compression: forwarded to :func:`sync_states_in_jit`.
+
+    Ownership contract (same as every donated path): the caller's state
+    dict is CONSUMED by each call — rebind the result, never reuse the
+    argument. Seed the carry with fresh arrays (e.g. a metric template's
+    copied ``state_dict()``), not with arrays something else still holds.
+    """
+    from functools import partial
+
+    from jax.sharding import PartitionSpec
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.4.38 jax keeps it under experimental
+        from jax.experimental.shard_map import shard_map
+
+    reduce_kinds = (MergeKind.SUM, MergeKind.MAX, MergeKind.MIN)
+    for name, kind in (specs or {}).items():
+        if kind not in reduce_kinds:
+            raise NotImplementedError(
+                f"donated_sync_step supports only reduce merge kinds "
+                f"(SUM/MAX/MIN); state {name!r} has {kind}. EXTEND "
+                "buffers grow by the world size per gather, so their "
+                "sync output can never alias the donated carry."
+            )
+
+    mergers = {
+        MergeKind.SUM: lambda a, b: a + b,
+        MergeKind.MAX: jnp.maximum,
+        MergeKind.MIN: jnp.minimum,
+    }
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(PartitionSpec(),) + tuple(batch_specs),
+        out_specs=PartitionSpec(),
+    )
+    def _step(state, *batch):
+        # sync the LOCAL deltas, then fold them into the carried state by
+        # merge kind — the carry is already globally synced, so re-syncing
+        # it would multiply SUM counters by the world size
+        synced = sync_states_in_jit(
+            update_fn(*batch), axis_name, specs, compression=compression
+        )
+        return {
+            name: mergers[(specs or {}).get(name, MergeKind.SUM)](
+                state[name], value
+            )
+            for name, value in synced.items()
+        }
+
+    return jax.jit(_step, donate_argnums=(0,))

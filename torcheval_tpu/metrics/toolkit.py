@@ -509,7 +509,11 @@ def update_collection(
     for fusable, plans in groups.values():
         if not plans:
             continue
-        new_states_group = fused_accumulate_group(plans)
+        # the group donation flag covers EVERY plan's states at once, so
+        # it is only set when all participating metrics follow the
+        # snapshot-copy discipline (Metric._donated_update, the default)
+        donate = all(m._donation_active() for m, _, _ in fusable)
+        new_states_group = fused_accumulate_group(plans, donate=donate)
         for (metric, names, finalize), new_states in zip(
             fusable, new_states_group
         ):

@@ -33,6 +33,7 @@ import time
 from collections import deque
 from typing import Dict, Iterator, List, Optional
 
+from torcheval_tpu import config
 from torcheval_tpu.obs.events import Event, SpanEvent
 
 __all__ = ["EventLog", "Recorder", "RECORDER", "enable", "disable", "enabled", "recorder", "span"]
@@ -279,5 +280,5 @@ _ENV = os.environ.get("TORCHEVAL_TPU_OBSERVABILITY", "").strip()
 if _ENV:
     if _ENV.endswith(".jsonl"):
         RECORDER.enable(jsonl=_ENV)
-    elif _ENV.lower() in ("1", "true", "yes", "on"):
+    elif _ENV.lower() in config._TRUTHY:
         RECORDER.enable()

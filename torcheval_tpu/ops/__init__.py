@@ -3,9 +3,17 @@ from torcheval_tpu.ops.fused_auc import (
     fused_auc_histogram,
     fused_auc_histogram_accumulate,
 )
+from torcheval_tpu.ops.histogram import bincount, histogram
+from torcheval_tpu.ops.segment import segment_count, segment_sum
+from torcheval_tpu.ops.topk import topk
 
 __all__ = [
+    "bincount",
     "fused_auc",
     "fused_auc_histogram",
     "fused_auc_histogram_accumulate",
+    "histogram",
+    "segment_count",
+    "segment_sum",
+    "topk",
 ]

@@ -35,6 +35,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu._ffi import ffi as _ffi
+
 DEFAULT_NUM_BINS = 8192
 _CHUNK = 1024
 _LANE = 128
@@ -264,7 +266,7 @@ def _histogram_native(
     else:
         has_weight = 1
     lo, hi = bounds if bounds is not None else (0.0, 0.0)
-    call = jax.ffi.ffi_call(
+    call = _ffi.ffi_call(
         "torcheval_fused_auc_histogram",
         jax.ShapeDtypeStruct((scores2.shape[0], 2, num_bins), jnp.float32),
     )

@@ -1,0 +1,200 @@
+"""Fixed-width histogram + bincount with native CPU kernels.
+
+``histogram`` bins float samples over a fixed ``[lo, hi]`` range (the
+calibration-table primitive; ``torch.histc`` semantics); ``bincount``
+counts / weight-sums precomputed integer bin ids (the ``torch.bincount``
+shape, dispatching onto the segment kernels). Both follow the
+``torcheval_tpu.ops`` fallback contract (see ``ops/segment.py``):
+native C++ on the CPU lowering when the loader has the shared library,
+bit-identical pure-XLA twins everywhere else.
+
+Drop semantics of ``histogram`` (both paths, pinned by
+tests/ops/test_segment_hist_topk.py): samples outside ``[lo, hi]`` and
+NaN samples contribute to no bin; bin ``b`` covers
+``[lo + b*w, lo + (b+1)*w)`` with the last bin closed at ``hi``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu._ffi import ffi as _ffi
+
+from torcheval_tpu.ops.segment import (
+    _native_ready,
+    safe_ids,
+    segment_count,
+    segment_sum,
+)
+
+
+def _histogram_xla(
+    values: jax.Array,
+    weights: Optional[jax.Array],
+    num_bins: int,
+    lo: float,
+    hi: float,
+) -> jax.Array:
+    # bin-edge constants narrowed exactly like the native kernel: lo/hi
+    # to f32, span from the DOUBLE difference (f32(hi) - f32(lo) can be
+    # 1 ULP off f32(hi - lo), shifting edge samples one bin — same trick
+    # as ops/native/fused_auc.cc)
+    lo32 = np.float32(lo)
+    hi32 = np.float32(hi)
+    span32 = np.float32(hi - lo)
+    w = (
+        jnp.ones(values.shape, jnp.float32)
+        if weights is None
+        else weights.astype(jnp.float32)
+    )
+    # NaN fails both comparisons, exactly like the native kernel's guard
+    valid = (values >= lo32) & (values <= hi32)
+    # same f32 expression the native kernel evaluates; invalid lanes may
+    # compute garbage bins (NaN->int is unspecified) but carry weight 0,
+    # and the clip keeps the scatter in range either way
+    idx = jnp.clip(
+        ((values - lo32) / span32 * np.float32(num_bins)).astype(jnp.int32),
+        0,
+        num_bins - 1,
+    )
+    return jax.ops.segment_sum(
+        jnp.where(valid, w, 0.0), idx, num_segments=num_bins
+    )
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5))
+def _histogram_dispatch(
+    values: jax.Array,
+    weights_arr: jax.Array,
+    has_weight: bool,
+    num_bins: int,
+    lo: float,
+    hi: float,
+) -> jax.Array:
+    def native_fn(v, w):
+        from torcheval_tpu.metrics.functional.tensor_utils import _match_vma
+
+        call = _ffi.ffi_call(
+            "torcheval_histogram",
+            jax.ShapeDtypeStruct((num_bins,), jnp.float32),
+            vmap_method="sequential",
+        )
+        return _match_vma(
+            call(v, w, has_weight=int(has_weight), lo=lo, hi=hi), v
+        )
+
+    def xla_fn(v, w):
+        return _histogram_xla(v, w if has_weight else None, num_bins, lo, hi)
+
+    return jax.lax.platform_dependent(
+        values, weights_arr, cpu=native_fn, default=xla_fn
+    )
+
+
+@_histogram_dispatch.defjvp
+def _histogram_jvp(has_weight, num_bins, lo, hi, primals, tangents):
+    values, weights_arr = primals
+    t_weights = tangents[1]
+    out = _histogram_dispatch(values, weights_arr, has_weight, num_bins, lo, hi)
+    # linear in weights, piecewise-constant in values (zero tangent a.e.,
+    # which is also what the XLA twin's integer binning yields)
+    if has_weight:
+        t_out = _histogram_xla(
+            values,
+            jnp.zeros_like(weights_arr) + t_weights,
+            num_bins,
+            lo,
+            hi,
+        )
+    else:
+        t_out = jnp.zeros((num_bins,), jnp.float32)
+    return out, t_out
+
+
+def histogram(
+    values: jax.Array,
+    num_bins: int,
+    *,
+    bounds: Tuple[float, float],
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """(num_bins,) f32 weighted histogram of ``values`` over fixed
+    ``bounds = (lo, hi)``.
+
+    One fused native pass on the CPU lowering (no normalized copy, no
+    materialized unit weights); out-of-range and NaN samples are dropped
+    on every backend.
+
+    >>> import jax.numpy as jnp
+    >>> from torcheval_tpu.ops import histogram
+    >>> histogram(jnp.array([0.1, 0.6, 0.9, 2.0]), 2, bounds=(0.0, 1.0))
+    Array([1., 2.], dtype=float32)
+    """
+    values = jnp.asarray(values)
+    if values.ndim != 1:
+        values = values.reshape(-1)
+    if weights is not None:
+        weights = jnp.asarray(weights).reshape(-1)
+        if weights.shape != values.shape:
+            raise ValueError(
+                f"weights shape {weights.shape} != values {values.shape}"
+            )
+    if num_bins < 1:
+        raise ValueError(f"num_bins must be >= 1, got {num_bins}.")
+    lo, hi = float(bounds[0]), float(bounds[1])
+    if not hi > lo:
+        raise ValueError(f"bounds must satisfy hi > lo, got ({lo}, {hi}).")
+    if not (
+        values.dtype == jnp.float32
+        and values.size > 0
+        and _native_ready()
+    ):
+        return _histogram_xla(
+            values.astype(jnp.float32), weights, num_bins, lo, hi
+        )
+    weight_arr = (
+        jnp.zeros((1,), jnp.float32)  # dummy the kernel never reads
+        if weights is None
+        else weights.astype(jnp.float32)
+    )
+    return _histogram_dispatch(
+        values, weight_arr, weights is not None, num_bins, lo, hi
+    )
+
+
+def bincount(
+    x: jax.Array,
+    num_bins: int,
+    *,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """``torch.bincount``-shaped reduction of integer bin ids: int32
+    counts without ``weights``, f32 weight sums with. Ids outside
+    ``[0, num_bins)`` are dropped (both backends). Dispatches onto the
+    segment kernels (``ops/native/segment.cc``) on the CPU lowering.
+
+    >>> import jax.numpy as jnp
+    >>> from torcheval_tpu.ops import bincount
+    >>> bincount(jnp.array([0, 1, 1, 3]), 3)
+    Array([1, 2, 0], dtype=int32)
+    """
+    x = jnp.asarray(x)
+    if x.ndim != 1:
+        x = x.reshape(-1)
+    if not jnp.issubdtype(x.dtype, jnp.integer):
+        raise ValueError(f"bincount ids must be integers, got {x.dtype}.")
+    if x.dtype != jnp.int32:
+        x = safe_ids(x, num_bins)
+    if weights is None:
+        return segment_count(x, num_bins)
+    weights = jnp.asarray(weights).reshape(-1)
+    if weights.shape != x.shape:
+        raise ValueError(
+            f"weights shape {weights.shape} != ids shape {x.shape}"
+        )
+    return segment_sum(weights.astype(jnp.float32), x, num_bins)

@@ -42,6 +42,10 @@ _TARGETS = {
     "FusedAucHistogram": "torcheval_fused_auc_histogram",
     "CrossEntropyNll": "torcheval_ce_nll",
     "SortDesc": "torcheval_sort_desc",
+    "Histogram": "torcheval_histogram",
+    "SegmentSum": "torcheval_segment_sum",
+    "SegmentCount": "torcheval_segment_count",
+    "TopK": "torcheval_topk",
 }
 
 # per-file extra compile flags; ``cross_entropy.cc``'s reductions only
@@ -54,6 +58,9 @@ _TARGETS = {
 _EXTRA_FLAGS = {
     "argmax_last.cc": ["-march=native"],
     "cross_entropy.cc": ["-ffast-math", "-march=native"],
+    # the chunked prefilter's OR-fold only reaches SIMD width with the
+    # host ISA available (the sidecar CPU fingerprint guards portability)
+    "topk.cc": ["-march=native"],
 }
 
 _lock = threading.Lock()
@@ -77,15 +84,21 @@ def _cpu_fingerprint() -> str:
     return platform.machine()
 
 
+def _file_digest(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
 def _expected_buildinfo() -> dict:
+    # the full symbol->target TABLE (not just the symbol names) and the
+    # per-file extra flags are part of the fingerprint: renaming an FFI
+    # target or changing a file's flags must force a rebuild, never load
+    # a stale cached .so whose registrations silently diverge
     return {
         "cpu": _cpu_fingerprint(),
-        "symbols": sorted(_TARGETS),
+        "targets": dict(_TARGETS),
         "sources": {
-            os.path.basename(s): hashlib.sha256(
-                open(s, "rb").read()
-            ).hexdigest()[:16]
-            for s in _sources()
+            os.path.basename(s): _file_digest(s) for s in _sources()
         },
         "flags": _EXTRA_FLAGS,
     }
@@ -114,9 +127,9 @@ def _build() -> bool:
     """
     import tempfile
 
-    import jax.ffi
+    from torcheval_tpu._ffi import ffi as jffi
 
-    include = f"-I{jax.ffi.include_dir()}"
+    include = f"-I{jffi.include_dir()}"
     try:
         with tempfile.TemporaryDirectory(dir=_DIR) as tmp:
             procs = []
@@ -154,24 +167,39 @@ def _build() -> bool:
         return False
 
 
+def _disabled_by_env() -> bool:
+    """Forced-fallback knob: ``TORCHEVAL_TPU_NO_NATIVE`` truthy disables
+    the native library entirely so every dispatcher takes its pure-XLA
+    twin — the no-toolchain degradation path, testable on boxes where the
+    build would succeed (tests/ops/test_forced_fallback.py)."""
+    from torcheval_tpu import config
+
+    return config.env_truthy("TORCHEVAL_TPU_NO_NATIVE")
+
+
 def ensure_registered() -> bool:
     """Build (if needed) and register the native handlers with XLA CPU.
     Returns True when the FFI targets are usable."""
     global _registered
     with _lock:
+        if _disabled_by_env():
+            # checked BEFORE the cache and never cached: the knob wins
+            # even after a successful registration, and clearing it
+            # restores the cached answer instead of rebuilding
+            return False
         if _registered is not None:
             return _registered
         try:
-            import jax.ffi
+            from torcheval_tpu._ffi import ffi as jffi
 
             if not _cache_valid() and not _build():
                 _registered = False
                 return False
             lib = ctypes.cdll.LoadLibrary(_LIB)
             for symbol, target in _TARGETS.items():
-                jax.ffi.register_ffi_target(
+                jffi.register_ffi_target(
                     target,
-                    jax.ffi.pycapsule(getattr(lib, symbol)),
+                    jffi.pycapsule(getattr(lib, symbol)),
                     platform="cpu",
                 )
             _registered = True

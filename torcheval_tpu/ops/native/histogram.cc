@@ -1,0 +1,92 @@
+// Fixed-width weighted histogram — C++ XLA custom-call (CPU host kernel).
+//
+// The float sibling of the segment reductions (segment.cc): where those
+// consume precomputed integer bin ids, this op owns the whole
+// value->bin->accumulate chain for float samples over a fixed [lo, hi]
+// range — the primitive behind score calibration tables and any binned
+// statistic whose edges are known up front. XLA expresses it as
+// normalize + cast + scatter-add: three passes and a scatter the CPU
+// backend turns into a per-element loop; here it is one pass.
+//
+// Inputs:  values (N,) f32, weights (N,) f32 — or (1,) dummy when
+//          has_weight=0 (implicit unit weights, no ones array
+//          materialized).
+// Attrs:   lo, hi (double) — bin b covers [lo + b*w, lo + (b+1)*w) with
+//          w = (hi - lo) / bins; the LAST bin is closed at hi
+//          (torch.histc convention).
+// Output:  hist (B,) f32.
+//
+// Drop semantics (shared with the XLA twin in
+// torcheval_tpu/ops/histogram.py): values outside [lo, hi] and NaN
+// values contribute to NO bin — torch.histc's out-of-range behavior,
+// and the only NaN rule both backends can implement bit-identically
+// (the twin masks the weight to zero before its scatter). The bin index
+// math mirrors fused_auc.cc: span is computed as f32(hi - lo) in double
+// BEFORE narrowing so both backends bake the identical edge constant.
+//
+// Build: g++ -O3 -fPIC -shared (see native/__init__.py).
+
+#include <algorithm>
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error HistogramImpl(ffi::Buffer<ffi::F32> values,
+                                ffi::Buffer<ffi::F32> weights,
+                                ffi::ResultBuffer<ffi::F32> hist,
+                                int64_t has_weight, double lo_attr,
+                                double hi_attr) {
+  const auto vdims = values.dimensions();
+  if (vdims.size() != 1) {
+    return ffi::Error::InvalidArgument("values must be rank 1");
+  }
+  const auto wdims = weights.dimensions();
+  if (wdims.size() != 1 || (has_weight && wdims[0] != vdims[0])) {
+    return ffi::Error::InvalidArgument(
+        "weights must be (n,), or a (1,) dummy when has_weight=0");
+  }
+  const auto hdims = hist->dimensions();
+  if (hdims.size() != 1) {
+    return ffi::Error::InvalidArgument("hist must be rank 1 (bins)");
+  }
+  const int64_t n = vdims[0];
+  const int64_t bins = hdims[0];
+  const float* v = values.typed_data();
+  const float* w = weights.typed_data();
+  float* h = hist->typed_data();
+  std::fill(h, h + bins, 0.0f);
+  if (bins == 0) {
+    // the clamp below would send in-range samples to h[-1]; the Python
+    // dispatcher rejects num_bins < 1, this guards direct FFI callers
+    return ffi::Error::Success();
+  }
+
+  const float lo = static_cast<float>(lo_attr);
+  const float hi = static_cast<float>(hi_attr);
+  // double-subtract before narrowing: f32(hi) - f32(lo) can differ from
+  // f32(hi - lo) by 1 ULP, shifting edge samples one bin (fused_auc.cc)
+  const float span = static_cast<float>(hi_attr - lo_attr);
+  const float fbins = static_cast<float>(bins);
+  for (int64_t i = 0; i < n; ++i) {
+    const float x = v[i];
+    // NaN fails both comparisons: dropped like the out-of-range samples
+    if (!(x >= lo) || !(x <= hi)) {
+      continue;
+    }
+    int64_t b = static_cast<int64_t>((x - lo) / span * fbins);
+    b = b >= bins ? bins - 1 : (b < 0 ? 0 : b);
+    h[b] += has_weight ? w[i] : 1.0f;
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(Histogram, HistogramImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>()
+                                  .Attr<int64_t>("has_weight")
+                                  .Attr<double>("lo")
+                                  .Attr<double>("hi"));

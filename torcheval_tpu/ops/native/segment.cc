@@ -1,0 +1,106 @@
+// Segment reductions — C++ XLA custom-calls (CPU host kernels).
+//
+// XLA:CPU lowers scatter-add (the lowering of jax.ops.segment_sum) to a
+// per-element update loop with bounds handling replayed per element —
+// tens of nanoseconds per scattered value. The segment reductions here
+// are the single data pass they always wanted to be: one read of
+// (data, ids), one accumulate into the output table. They back the
+// confusion-matrix scatter (fused target*C + input indices), the binned
+// PRC/AUROC threshold histograms, and the per-key reductions of keyed
+// metric tables (ROADMAP item 3).
+//
+// Semantics contract (shared with the pure-XLA twins in
+// torcheval_tpu/ops/segment.py): ids outside [0, num_segments) are
+// DROPPED — exactly what jax.ops.segment_sum does under its default
+// scatter mode — and accumulation runs in ascending input order, so f32
+// sums are bit-identical to a sequential loop (the XLA scatter on CPU is
+// also sequential; parity is pinned by tests/ops/test_segment_hist_topk.py).
+//
+// SegmentSum:   data (N,) f32, ids (N,) s32 -> out (S,) f32.
+// SegmentCount: ids (N,) s32, mask (N,) s32 (or (1,) dummy when
+//               has_mask=0) -> out (S,) s32; counts ids with mask != 0
+//               (unit mask when absent). The confusion-matrix update is
+//               exactly this op: mask carries the shape-bucketing
+//               validity row.
+//
+// Build: g++ -O3 -fPIC -shared (see native/__init__.py).
+
+#include <algorithm>
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error SegmentSumImpl(ffi::Buffer<ffi::F32> data,
+                                 ffi::Buffer<ffi::S32> ids,
+                                 ffi::ResultBuffer<ffi::F32> out) {
+  const auto ddims = data.dimensions();
+  const auto idims = ids.dimensions();
+  if (ddims.size() != 1 || idims.size() != 1 || ddims[0] != idims[0]) {
+    return ffi::Error::InvalidArgument(
+        "data and ids must be rank 1 with equal length");
+  }
+  const auto odims = out->dimensions();
+  if (odims.size() != 1) {
+    return ffi::Error::InvalidArgument("out must be rank 1 (num_segments)");
+  }
+  const int64_t n = ddims[0];
+  const int64_t segments = odims[0];
+  const float* d = data.typed_data();
+  const int32_t* s = ids.typed_data();
+  float* o = out->typed_data();
+  std::fill(o, o + segments, 0.0f);
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t id = s[i];
+    if (id >= 0 && id < segments) {
+      o[id] += d[i];
+    }
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(SegmentSum, SegmentSumImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+static ffi::Error SegmentCountImpl(ffi::Buffer<ffi::S32> ids,
+                                   ffi::Buffer<ffi::S32> mask,
+                                   ffi::ResultBuffer<ffi::S32> out,
+                                   int64_t has_mask) {
+  const auto idims = ids.dimensions();
+  if (idims.size() != 1) {
+    return ffi::Error::InvalidArgument("ids must be rank 1");
+  }
+  const auto mdims = mask.dimensions();
+  if (mdims.size() != 1 || (has_mask && mdims[0] != idims[0])) {
+    return ffi::Error::InvalidArgument(
+        "mask must be (n,), or a (1,) dummy when has_mask=0");
+  }
+  const auto odims = out->dimensions();
+  if (odims.size() != 1) {
+    return ffi::Error::InvalidArgument("out must be rank 1 (num_segments)");
+  }
+  const int64_t n = idims[0];
+  const int64_t segments = odims[0];
+  const int32_t* s = ids.typed_data();
+  const int32_t* m = mask.typed_data();
+  int32_t* o = out->typed_data();
+  std::fill(o, o + segments, 0);
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t id = s[i];
+    if (id >= 0 && id < segments && (!has_mask || m[i] != 0)) {
+      ++o[id];
+    }
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(SegmentCount, SegmentCountImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>()
+                                  .Attr<int64_t>("has_mask"));

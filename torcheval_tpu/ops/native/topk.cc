@@ -1,0 +1,163 @@
+// Top-k selection — C++ XLA custom-call (CPU host kernel).
+//
+// XLA:CPU's top_k lowering sorts/selects at a few ns per element; to
+// actually beat it the scan must do LESS than one branch per element.
+// This kernel keeps a k-entry min-heap of packed (value_key, index)
+// words and screens the stream through a chunked, auto-vectorized
+// prefilter: each 32-element chunk computes its order keys and OR-folds
+// a "beats the current k-th best" flag — for random data almost every
+// chunk folds to zero and is skipped without touching the heap. Only
+// chunks containing a candidate fall back to the scalar insert path.
+// Worst case (ascending input, every element inserts) degrades to the
+// classic O(n log k) heap scan.
+//
+// Semantics are IDENTICAL to jax.lax.top_k on CPU (pinned by
+// tests/ops/test_segment_hist_topk.py): descending IEEE-754 totalOrder —
+// +NaN > +Inf > ... > +0 > -0 > ... > -Inf > -NaN, i.e. bit-pattern
+// order, NOT the NaN-last / ±0-collapsed key sort_desc.cc uses to match
+// argsort(-x) — with ties ranked by ascending original index (stable).
+//
+// Inputs:  x (T, N) f32.
+// Outputs: values (T, K) f32, indices (T, K) s32; K <= N taken from the
+//          result shape (the dispatcher clamps k).
+//
+// Build: g++ -O3 -march=native -fPIC -shared (see native/__init__.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+// Ascending IEEE totalOrder as an unsigned 32-bit key (sign-magnitude ->
+// lexicographic): ascending key == ascending totalOrder. Branchless so
+// the prefilter loop vectorizes: negative b XORs all bits (~b),
+// non-negative XORs just the sign (b | 0x80000000).
+inline uint32_t OrderKey(uint32_t b) {
+  const uint32_t m =
+      static_cast<uint32_t>(static_cast<int32_t>(b) >> 31);
+  return b ^ (m | 0x80000000u);
+}
+
+// Packing ~index into the low bits makes one uint64 comparison implement
+// "value descending, index ascending" exactly.
+inline uint64_t PackKey(uint32_t order_key, int64_t i) {
+  return (static_cast<uint64_t>(order_key) << 32) |
+         static_cast<uint32_t>(~static_cast<uint32_t>(i));
+}
+
+inline int32_t UnpackIndex(uint64_t key) {
+  return static_cast<int32_t>(~static_cast<uint32_t>(key));
+}
+
+constexpr int kChunk = 32;
+
+// Heap-scan one row: keys[0..k) ends holding the k largest packed keys,
+// sorted descending.
+void TopKRow(const float* row, int64_t n, int64_t k, uint64_t* heap) {
+  const uint32_t* bits = reinterpret_cast<const uint32_t*>(row);
+  for (int64_t j = 0; j < k; ++j) {
+    heap[j] = PackKey(OrderKey(bits[j]), j);
+  }
+  std::make_heap(heap, heap + k, std::greater<uint64_t>());
+  // Exactness of the key32-only prefilter: candidates with key32 EQUAL
+  // to the heap minimum's key32 can never displace it — the scan moves
+  // forward, so their packed index bits are strictly smaller.
+  uint32_t min_key = static_cast<uint32_t>(heap[0] >> 32);
+  int64_t i = k;
+  for (; i + kChunk <= n; i += kChunk) {
+    // max-fold prefilter: a pure vertical max over the chunk's keys
+    // (vectorizes to packed unsigned max), one compare per chunk
+    uint32_t mx = 0;
+    for (int c = 0; c < kChunk; ++c) {
+      const uint32_t ok = OrderKey(bits[i + c]);
+      mx = ok > mx ? ok : mx;
+    }
+    if (mx <= min_key) {
+      continue;
+    }
+    for (int c = 0; c < kChunk; ++c) {
+      const uint32_t ok = OrderKey(bits[i + c]);
+      if (ok > min_key) {
+        std::pop_heap(heap, heap + k, std::greater<uint64_t>());
+        heap[k - 1] = PackKey(ok, i + c);
+        std::push_heap(heap, heap + k, std::greater<uint64_t>());
+        min_key = static_cast<uint32_t>(heap[0] >> 32);
+      }
+    }
+  }
+  for (; i < n; ++i) {  // tail
+    const uint32_t ok = OrderKey(bits[i]);
+    if (ok > min_key) {
+      std::pop_heap(heap, heap + k, std::greater<uint64_t>());
+      heap[k - 1] = PackKey(ok, i);
+      std::push_heap(heap, heap + k, std::greater<uint64_t>());
+      min_key = static_cast<uint32_t>(heap[0] >> 32);
+    }
+  }
+  std::sort(heap, heap + k, std::greater<uint64_t>());
+}
+
+}  // namespace
+
+static ffi::Error TopKImpl(ffi::Buffer<ffi::F32> x,
+                           ffi::ResultBuffer<ffi::F32> values,
+                           ffi::ResultBuffer<ffi::S32> indices) {
+  const auto dims = x.dimensions();
+  if (dims.size() != 2) {
+    return ffi::Error::InvalidArgument("x must be rank 2 (tasks, n)");
+  }
+  const int64_t tasks = dims[0];
+  const int64_t n = dims[1];
+  const auto vdims = values->dimensions();
+  const auto idims = indices->dimensions();
+  if (vdims.size() != 2 || idims.size() != 2 || vdims[0] != tasks ||
+      idims[0] != tasks || vdims[1] != idims[1]) {
+    return ffi::Error::InvalidArgument(
+        "values/indices must be (tasks, k) with matching k");
+  }
+  const int64_t k = vdims[1];
+  if (k > n) {
+    return ffi::Error::InvalidArgument("k must be <= n");
+  }
+  const float* in = x.typed_data();
+  float* v = values->typed_data();
+  int32_t* idx = indices->typed_data();
+  if (k == 0) {
+    return ffi::Error::Success();
+  }
+
+  std::vector<uint64_t> keys(n);
+  for (int64_t t = 0; t < tasks; ++t) {
+    const float* row = in + t * n;
+    if (k * 4 >= n) {
+      // large-k: the heap churns on most elements; a straight sort of
+      // all packed keys is cheaper and shares the stability contract
+      const uint32_t* bits = reinterpret_cast<const uint32_t*>(row);
+      for (int64_t i = 0; i < n; ++i) {
+        keys[i] = PackKey(OrderKey(bits[i]), i);
+      }
+      std::sort(keys.begin(), keys.end(), std::greater<uint64_t>());
+    } else {
+      TopKRow(row, n, k, keys.data());
+    }
+    for (int64_t j = 0; j < k; ++j) {
+      const int32_t i = UnpackIndex(keys[j]);
+      idx[t * k + j] = i;
+      v[t * k + j] = row[i];
+    }
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(TopK, TopKImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>());

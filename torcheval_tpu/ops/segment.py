@@ -1,0 +1,172 @@
+"""Segment reductions with native CPU kernels and pure-XLA twins.
+
+``segment_sum`` / ``segment_count`` are the scatter-shaped primitives the
+counter metrics bottleneck on: the confusion-matrix update is a
+segment-count over fused ``target * C + input`` indices, the binned
+PRC/AUROC families histogram threshold indices, and the keyed metric
+table (ROADMAP item 3) reduces per-key traffic with exactly these ops.
+XLA:CPU lowers ``jax.ops.segment_sum`` to a per-element scatter-add loop;
+the native handlers (``ops/native/segment.cc``) make it one linear pass.
+
+Fallback contract (shared by every ``torcheval_tpu.ops`` dispatcher): the
+native kernel is used only when (a) the build-on-first-use loader reports
+the shared library usable (``ops.native.ensure_registered()`` — never
+when ``TORCHEVAL_TPU_NO_NATIVE`` is set), (b) the lowering targets the
+CPU backend (selected per-lowering via ``lax.platform_dependent``), and
+(c) the operand dtypes/shapes match the kernel's contract (f32 data,
+s32 ids here). Anything else takes the pure-XLA twin, which is
+bit-identical: ids outside ``[0, num_segments)`` are dropped on both
+paths, and accumulation order matches (ascending input order).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu._ffi import ffi as _ffi
+
+
+def _ids_ok(segment_ids: jax.Array) -> bool:
+    return segment_ids.dtype == jnp.int32 and segment_ids.ndim == 1
+
+
+def safe_ids(ids: jax.Array, num_segments: int) -> jax.Array:
+    """``ids`` as int32 with out-of-range values funneled to ``-1``.
+
+    The int64-wrap guard every id-consuming call site must apply BEFORE
+    narrowing: an int64 id past 2^31 would wrap INTO ``[0, num_segments)``
+    under a bare int32 cast; funneling to ``-1`` first keeps it an
+    always-dropped id on both the native and XLA paths.
+    """
+    return jnp.where((ids >= 0) & (ids < num_segments), ids, -1).astype(
+        jnp.int32
+    )
+
+
+def _native_ready() -> bool:
+    from torcheval_tpu.ops import native
+
+    return native.ensure_registered()
+
+
+def _segment_sum_xla(
+    data: jax.Array, segment_ids: jax.Array, num_segments: int
+) -> jax.Array:
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_sum(
+    data: jax.Array, segment_ids: jax.Array, num_segments: int
+) -> jax.Array:
+    """``jax.ops.segment_sum(data, segment_ids, num_segments)`` with a
+    one-pass native CPU kernel when available (f32 data, s32 1-D ids).
+
+    Out-of-range ids are dropped on both paths. Differentiable: the
+    gradient never reaches the FFI call (tangents are cut on the native
+    branch exactly where they are zero/linear — the XLA twin's JVP is a
+    gather, replayed by the dispatcher).
+    """
+    if not (
+        data.dtype == jnp.float32
+        and data.ndim == 1
+        and _ids_ok(segment_ids)
+        and data.shape == segment_ids.shape
+        and data.size > 0
+        and _native_ready()
+    ):
+        return _segment_sum_xla(data, segment_ids, num_segments)
+    return _segment_sum_dispatch(data, segment_ids, num_segments)
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(2,))
+def _segment_sum_dispatch(
+    data: jax.Array, segment_ids: jax.Array, num_segments: int
+) -> jax.Array:
+    def native_fn(d, i):
+        from torcheval_tpu.metrics.functional.tensor_utils import _match_vma
+
+        call = _ffi.ffi_call(
+            "torcheval_segment_sum",
+            jax.ShapeDtypeStruct((num_segments,), jnp.float32),
+            vmap_method="sequential",
+        )
+        return _match_vma(call(d, i), d)
+
+    def xla_fn(d, i):
+        return _segment_sum_xla(d, i, num_segments)
+
+    return jax.lax.platform_dependent(
+        data, segment_ids, cpu=native_fn, default=xla_fn
+    )
+
+
+@_segment_sum_dispatch.defjvp
+def _segment_sum_jvp(num_segments, primals, tangents):
+    data, segment_ids = primals
+    t_data = tangents[0]
+    out = _segment_sum_dispatch(data, segment_ids, num_segments)
+    # segment_sum is linear in data; ids are integer (no tangent)
+    t_out = _segment_sum_xla(t_data, segment_ids, num_segments)
+    return out, t_out
+
+
+def _segment_count_xla(
+    segment_ids: jax.Array, num_segments: int, mask: Optional[jax.Array]
+) -> jax.Array:
+    if mask is None:
+        data = jnp.ones(segment_ids.shape, jnp.int32)
+    else:
+        data = (mask != 0).astype(jnp.int32)
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_count(
+    segment_ids: jax.Array,
+    num_segments: int,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Count occurrences of each id in ``[0, num_segments)`` as int32 —
+    ``segment_sum`` of a ones (or ``mask != 0``) vector, in one native
+    pass on CPU. ``mask`` (optional, same length, any dtype) drops
+    positions whose mask is zero — the shape-bucketing validity row
+    (float32 by default) drops straight in.
+    """
+    if not (
+        _ids_ok(segment_ids)
+        and segment_ids.size > 0
+        and (mask is None or mask.shape == segment_ids.shape)
+        and _native_ready()
+    ):
+        return _segment_count_xla(segment_ids, num_segments, mask)
+    if mask is not None:
+        # the kernel reads the mask as s32 zero/nonzero; != 0 (not astype)
+        # so fractional float masks count like the XLA twin's (mask != 0)
+        mask = (mask != 0).astype(jnp.int32)
+
+    def native_fn(ids, m):
+        from torcheval_tpu.metrics.functional.tensor_utils import _match_vma
+
+        call = _ffi.ffi_call(
+            "torcheval_segment_count",
+            jax.ShapeDtypeStruct((num_segments,), jnp.int32),
+            vmap_method="sequential",
+        )
+        return _match_vma(
+            call(ids, m, has_mask=int(mask is not None)),
+            ids,
+        )
+
+    def xla_fn(ids, m):
+        return _segment_count_xla(ids, num_segments, m if mask is not None else None)
+
+    # (1,) dummy the kernel never reads when has_mask=0
+    mask_arr = (
+        jnp.zeros((1,), jnp.int32) if mask is None else mask
+    )
+    return jax.lax.platform_dependent(
+        segment_ids, mask_arr, cpu=native_fn, default=xla_fn
+    )

@@ -1,0 +1,110 @@
+"""Top-k selection with a native CPU kernel and the ``lax.top_k`` twin.
+
+``jax.lax.top_k`` on XLA:CPU sorts the whole row to keep ``k`` values —
+the same single-threaded comparison sort that makes argsort the curve
+metrics' bottleneck. The native kernel (``ops/native/topk.cc``) selects
+instead of sorting: O(n + k log k) per row. Semantics are identical to
+``lax.top_k`` (descending IEEE totalOrder, stable ties by ascending
+index), so the ranking family (retrieval precision @ k) and
+``TopKMultilabelAccuracy`` dispatch through here with no behavior
+change. Fallback contract as in ``ops/segment.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu._ffi import ffi as _ffi
+
+
+def _topk_xla(x: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    # tuple(): on some jax versions top_k's multi-result bind returns a
+    # LIST, which platform_dependent rejects as a branch pytree mismatch
+    values, indices = jax.lax.top_k(x, k)
+    return values, indices
+
+
+def _make_native_call(k: int):
+    def native_fn(x2: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        from torcheval_tpu.metrics.functional.tensor_utils import _match_vma
+
+        call = _ffi.ffi_call(
+            "torcheval_topk",
+            (
+                jax.ShapeDtypeStruct((x2.shape[0], k), jnp.float32),
+                jax.ShapeDtypeStruct((x2.shape[0], k), jnp.int32),
+            ),
+            vmap_method="sequential",
+        )
+        values, indices = call(x2)
+        return _match_vma(values, x2), _match_vma(indices, x2)
+
+    return native_fn
+
+
+def topk(x: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """``jax.lax.top_k(x, k)`` — (values, indices) of the ``k`` largest
+    entries along the last axis, descending, ties by ascending index —
+    with an O(n) native selection kernel on the CPU lowering.
+
+    Differentiable like ``lax.top_k``: the values' tangent rides the
+    selected permutation; indices carry no tangent.
+
+    >>> import jax.numpy as jnp
+    >>> from torcheval_tpu.ops import topk
+    >>> topk(jnp.array([0.1, 0.7, 0.4]), 2)
+    (Array([0.7, 0.4], dtype=float32), Array([1, 2], dtype=int32))
+    """
+    x = jnp.asarray(x)
+    if not 0 <= k <= x.shape[-1]:
+        raise ValueError(
+            f"k must be in [0, {x.shape[-1]}] for input shape {x.shape}, "
+            f"got {k}."
+        )
+    if (
+        x.dtype != jnp.float32
+        or x.size == 0
+        or k == 0
+        or x.shape[-1] >= 2**31
+    ):
+        return _topk_xla(x, k)
+    from torcheval_tpu.ops.segment import _native_ready
+
+    if not _native_ready():
+        return _topk_xla(x, k)
+    return _topk_dispatch(x, k)
+
+
+@partial(jax.custom_jvp, nondiff_argnums=(1,))
+def _topk_dispatch(x: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    n = x.shape[-1]
+    x2 = x.reshape(-1, n)
+
+    def native_fn(x2):
+        return _make_native_call(k)(x2)
+
+    def xla_fn(x2):
+        return _topk_xla(x2, k)
+
+    values, indices = jax.lax.platform_dependent(
+        x2, cpu=native_fn, default=xla_fn
+    )
+    out_shape = x.shape[:-1] + (k,)
+    return values.reshape(out_shape), indices.reshape(out_shape)
+
+
+@_topk_dispatch.defjvp
+def _topk_jvp(k, primals, tangents):
+    # same JVP lax.top_k has: the values' tangent is gathered through the
+    # selected indices; the integer indices output has no tangent (float0)
+    import numpy as np
+
+    (x,), (tx,) = primals, tangents
+    values, indices = _topk_dispatch(x, k)
+    t_values = jnp.take_along_axis(tx, indices, axis=-1)
+    t_indices = np.zeros(indices.shape, dtype=jax.dtypes.float0)
+    return (values, indices), (t_values, t_indices)
