@@ -73,6 +73,21 @@ def obs_recorder():
             rec.disable()
 
 
+def pytest_runtest_setup(item):
+    """Snapshot the analyzer's process-global last-report before each
+    test, so the failure-forensics hook below only attaches a report the
+    failing test itself produced — without this, seeded-violation
+    fixtures (tests/analysis/) leave findings in the global that would
+    be pinned on any later unrelated failure. Same ``sys.modules``
+    discipline as the hook: never import the analyzers here."""
+    import sys
+
+    report_mod = sys.modules.get("torcheval_tpu.analysis.report")
+    item._analysis_report_before = (
+        None if report_mod is None else report_mod.last_report()
+    )
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
     """When a test fails WITH the observability recorder active, attach
@@ -88,19 +103,37 @@ def pytest_runtest_makereport(item, call):
 
         recorder_mod = sys.modules.get("torcheval_tpu.obs.recorder")
         if (
-            recorder_mod is None
-            or not recorder_mod.RECORDER.enabled
-            or not len(recorder_mod.RECORDER.log)
+            recorder_mod is not None
+            and recorder_mod.RECORDER.enabled
+            and len(recorder_mod.RECORDER.log)
         ):
-            return
-        from torcheval_tpu.obs.export import format_report
+            from torcheval_tpu.obs.export import format_report
 
-        rep.sections.append(
-            (
-                "torcheval_tpu observability (event-log tail)",
-                format_report(tail=30),
+            rep.sections.append(
+                (
+                    "torcheval_tpu observability (event-log tail)",
+                    format_report(tail=30),
+                )
             )
-        )
+    except Exception:  # noqa: BLE001 — forensics must never mask the failure
+        pass
+    try:
+        # Static-analysis forensics (ISSUE 7): when the failing test ran an
+        # analyzer (lint / program verifier / lockstep checker), attach its
+        # machine-readable report next to the event tail, so a CI failure
+        # carries WHICH rule fired WHERE without a local rerun. Same
+        # sys.modules discipline: never import the analyzers here.
+        report_mod = sys.modules.get("torcheval_tpu.analysis.report")
+        if report_mod is not None:
+            last = report_mod.last_report()
+            before = getattr(item, "_analysis_report_before", None)
+            if last is not None and last is not before and last.findings:
+                rep.sections.append(
+                    (
+                        "torcheval_tpu static analysis (last report)",
+                        last.format_text(),
+                    )
+                )
     except Exception:  # noqa: BLE001 — forensics must never mask the failure
         pass
 

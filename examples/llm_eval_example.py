@@ -113,7 +113,12 @@ def main() -> None:
     if len(devices) == 1 and jax.devices("cpu"):
         devices = jax.devices("cpu")
     if len(devices) >= 2:
-        from jax import lax, shard_map
+        from jax import lax
+
+        try:
+            from jax import shard_map
+        except ImportError:  # pre-0.4.38 jax keeps it under experimental
+            from jax.experimental.shard_map import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
 
         from torcheval_tpu.models import (

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# The static-analysis gate, exactly as CI runs it
+# (.github/workflows/pre_commit.yaml `static_analysis` job; rule
+# catalogue and suppression syntax in docs/static-analysis.md).
+#
+#   scripts/run_static_analysis.sh            # lint (jax-free, seconds)
+#   scripts/run_static_analysis.sh --full     # + program-verifier smoke
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m torcheval_tpu.analysis torcheval_tpu examples bench.py scripts \
+  --report json --output lint-report.json
+
+if [[ "${1:-}" == "--full" ]]; then
+  python -m torcheval_tpu.analysis --no-lint --programs \
+    --report json --output verifier-report.json
+fi

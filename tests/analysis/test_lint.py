@@ -1,0 +1,371 @@
+"""AST-lint rule registry: every rule FIRES on a seeded violation and
+passes CLEAN over the shipped library (ISSUE 7 acceptance) — plus the
+suppression grammar, the scope model, the CLI, and the obs bridge.
+
+Stdlib-only on the library side: none of the lint tests import jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import torcheval_tpu
+from torcheval_tpu.analysis import RULES, lint_file, lint_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+PACKAGE_DIR = os.path.dirname(os.path.abspath(torcheval_tpu.__file__))
+
+
+def _lint_source(tmp_path, source, name="fixture.py", rules=None):
+    path = tmp_path / name
+    path.write_text(source)
+    return lint_file(str(path), rules=rules)
+
+
+def _active_rules(report):
+    return sorted({f.rule for f in report.active})
+
+
+# ------------------------------------------------- seeded-violation fixtures
+
+SEEDED = {
+    "ffi-import": "import jax.ffi\n",
+    "env-truthy": (
+        "import os\n"
+        'flag = os.environ.get("X", "").lower() in ("1", "true", "yes")\n'
+    ),
+    "host-sync": (
+        "# tev: scope=jit\n"
+        "import numpy as np\n"
+        "def f(arr):\n"
+        "    return np.asarray(arr) + arr.item()\n"
+    ),
+    "time-in-jit": (
+        "# tev: scope=jit\n"
+        "import time\n"
+        "def kernel(x):\n"
+        "    return x * time.time()\n"
+    ),
+    "shard-map-import": "from jax import shard_map\n",
+}
+
+
+@pytest.mark.parametrize("rule", sorted(SEEDED))
+def test_rule_fires_on_seeded_violation(rule, tmp_path):
+    report = _lint_source(tmp_path, SEEDED[rule])
+    assert rule in _active_rules(report), (
+        f"rule {rule} did not fire on its seeded violation:\n"
+        + report.format_text()
+    )
+    assert not report.ok
+
+
+def test_every_registered_rule_has_a_seeded_fixture():
+    """New rules must land with a firing fixture (the acceptance bullet
+    is per-rule, so this meta-test keeps the table honest)."""
+    assert set(SEEDED) == set(RULES)
+
+
+@pytest.mark.parametrize(
+    "source",
+    [
+        "from jax.extend import ffi\n",
+        "from jax.extend.ffi import ffi_call\n",
+        "import jax\nx = jax.ffi.register_ffi_target\n",
+        "import jax\nx = jax.extend.ffi.ffi_call\n",
+    ],
+)
+def test_ffi_import_spellings(source, tmp_path):
+    assert "ffi-import" in _active_rules(_lint_source(tmp_path, source))
+
+
+def test_ffi_shim_itself_is_exempt(tmp_path):
+    (tmp_path / "torcheval_tpu").mkdir()
+    path = tmp_path / "torcheval_tpu" / "_ffi.py"
+    path.write_text("from jax.extend import ffi\n")
+    assert lint_file(str(path)).ok
+
+
+def test_host_sync_needs_jit_scope(tmp_path):
+    """The scope model: the same idiom is clean in a host-side module and
+    a violation under `# tev: scope=jit` (or a jit-reachable path)."""
+    body = "import numpy as np\ndef f(a):\n    return np.asarray(a)\n"
+    assert _lint_source(tmp_path, body).ok
+    assert not _lint_source(tmp_path, "# tev: scope=jit\n" + body).ok
+    # ...and scope=host overrides a jit-reachable path classification
+    (tmp_path / "torcheval_tpu" / "ops").mkdir(parents=True)
+    forced = tmp_path / "torcheval_tpu" / "ops" / "thing.py"
+    forced.write_text("# tev: scope=host\n" + body)
+    assert lint_file(str(forced)).ok
+    unforced = tmp_path / "torcheval_tpu" / "ops" / "other.py"
+    unforced.write_text(body)
+    assert not lint_file(str(unforced)).ok
+
+
+def test_guarded_shard_map_import_is_clean(tmp_path):
+    report = _lint_source(
+        tmp_path,
+        "try:\n"
+        "    from jax import shard_map\n"
+        "except ImportError:\n"
+        "    from jax.experimental.shard_map import shard_map\n",
+    )
+    assert report.ok, report.format_text()
+
+
+def test_bool_spellings_mirror_config():
+    """lint.py keeps a literal copy of the accepted boolean spellings (it
+    must stay importable without the package root's jax deps on some
+    paths); this pins the mirror to config's source of truth."""
+    from torcheval_tpu import config
+    from torcheval_tpu.analysis import lint
+
+    assert lint._BOOL_SPELLINGS == frozenset(config._TRUTHY) | frozenset(
+        config._FALSY
+    )
+
+
+def test_env_truthy_rule_ignores_non_boolean_tuples(tmp_path):
+    report = _lint_source(
+        tmp_path, 'x = mode in ("warn", "raise", "off")\n'
+    )
+    assert report.ok, report.format_text()
+
+
+# ------------------------------------------------------------- suppressions
+
+
+def test_suppression_with_reason_is_honored_and_auditable(tmp_path):
+    report = _lint_source(
+        tmp_path,
+        "# tev: scope=jit\n"
+        "import numpy as np\n"
+        "x = np.asarray([1])  # tev: disable=host-sync -- fixture reason\n",
+    )
+    assert report.ok
+    [finding] = report.findings
+    assert finding.suppressed and finding.suppress_reason == "fixture reason"
+    # suppressed findings stay in the JSON report, flagged
+    payload = json.loads(report.to_json())
+    assert payload["counts"]["suppressed"] == 1
+    assert payload["findings"][0]["suppressed"] is True
+
+
+def test_reasonless_suppression_is_itself_a_finding(tmp_path):
+    report = _lint_source(
+        tmp_path,
+        "# tev: scope=jit\n"
+        "import numpy as np\n"
+        "x = np.asarray([1])  # tev: disable=host-sync\n",
+    )
+    assert not report.ok
+    assert "bad-suppression" in _active_rules(report)
+    assert "host-sync" in _active_rules(report)  # and does NOT suppress
+
+
+def test_suppression_naming_unknown_rule_is_flagged(tmp_path):
+    report = _lint_source(
+        tmp_path, "x = 1  # tev: disable=no-such-rule -- because\n"
+    )
+    assert "bad-suppression" in _active_rules(report)
+
+
+# --------------------------------------------------- clean run + CLI + obs
+
+
+def test_shipped_library_and_examples_are_clean():
+    """The acceptance run: zero unsuppressed errors over everything we
+    ship, and every suppression carries its audit reason."""
+    report = lint_paths(
+        [
+            PACKAGE_DIR,
+            os.path.join(REPO, "examples"),
+            os.path.join(REPO, "bench.py"),
+            os.path.join(REPO, "scripts"),
+        ]
+    )
+    assert report.checked > 100  # the walk actually covered the tree
+    assert report.ok, "\n" + report.format_text(include_suppressed=False)
+    for finding in report.findings:
+        if finding.suppressed:
+            assert finding.suppress_reason
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "torcheval_tpu.analysis", *argv],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+
+
+def test_cli_json_report_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED["ffi-import"])
+    proc = _run_cli(str(bad), "--report", "json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is False
+    assert payload["findings"][0]["rule"] == "ffi-import"
+    assert payload["schema_version"] == 1
+
+    out = tmp_path / "report.json"
+    clean = _run_cli(PACKAGE_DIR, "--report", "json", "--output", str(out))
+    assert clean.returncode == 0, clean.stdout[-2000:] + clean.stderr[-2000:]
+    assert json.loads(out.read_text())["ok"] is True
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in RULES:
+        assert rule in proc.stdout
+
+
+def test_cli_rule_selection(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED["ffi-import"] + SEEDED["shard-map-import"])
+    only = _run_cli(str(bad), "--rules", "shard-map-import", "--report", "json")
+    payload = json.loads(only.stdout)
+    assert {f["rule"] for f in payload["findings"]} == {"shard-map-import"}
+
+
+def test_findings_bridge_to_obs_events(tmp_path):
+    """Active findings mirror into the observability recorder as
+    AnalysisEvents while it is on (CI forensics), and a disabled
+    recorder drops them (the off contract)."""
+    from torcheval_tpu import obs
+
+    seeded = tmp_path / "seeded.py"
+    seeded.write_text(SEEDED["ffi-import"])
+    rec = obs.recorder()
+    prev = rec.enabled
+    rec.reset()
+    rec.enable()
+    try:
+        lint_paths([str(seeded)])  # the recording entry point
+        events = [e for e in rec.log.tail() if e.kind == "analysis"]
+        assert events and events[-1].rule == "ffi-import"
+        assert events[-1].tool == "lint"
+        assert events[-1].path.endswith("seeded.py")
+    finally:
+        if not prev:
+            rec.disable()
+    rec.reset()
+    lint_paths([str(seeded)])
+    assert not [e for e in rec.log.tail() if e.kind == "analysis"]
+
+
+def test_missing_path_is_a_loud_error(tmp_path):
+    """A mistyped/renamed path must fail the gate, never lint nothing
+    and report OK (review finding: the CI job would go permanently
+    green)."""
+    report = lint_paths([str(tmp_path / "no_such_dir")])
+    assert not report.ok
+    assert "missing-path" in {f.rule for f in report.active}
+    # CLI twin: exit code is a usage error, not a green report
+    proc = _run_cli(str(tmp_path / "no_such_dir"))
+    assert proc.returncode == 2, (proc.returncode, proc.stdout, proc.stderr)
+
+
+def test_explicit_non_py_file_is_a_loud_error(tmp_path):
+    """An explicitly-named existing file the walker would skip (e.g. a
+    .sh passed instead of its directory) must fail the gate, not read
+    as linted (review finding: checked>0 from a sibling .py arg kept
+    the zero-checked guard from tripping)."""
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    script = tmp_path / "tool.sh"
+    script.write_text("echo hi\n")
+    report = lint_paths([str(good), str(script)])
+    assert not report.ok
+    assert "unlinted-path" in _active_rules(report)
+
+
+def test_host_sync_device_get_requires_jax_base_name(tmp_path):
+    """`store.device_get(key)` on a non-jax object is not a host sync;
+    `jax.device_get(x)` is (review finding: the rule fired on any
+    attribute spelled device_get)."""
+    clean = _lint_source(
+        tmp_path,
+        "# tev: scope=jit\n"
+        "def f(store, key):\n"
+        "    return store.device_get(key)\n",
+    )
+    assert "host-sync" not in _active_rules(clean), clean.format_text()
+    seeded = _lint_source(
+        tmp_path,
+        "# tev: scope=jit\n"
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.device_get(x)\n",
+        name="seeded.py",
+    )
+    assert "host-sync" in _active_rules(seeded)
+
+
+def test_cli_rejects_unknown_rules_and_tolerates_spaces(tmp_path):
+    bad = tmp_path / "f.py"
+    bad.write_text(SEEDED["ffi-import"])
+    typo = _run_cli(str(bad), "--rules", "no-such-rule")
+    assert typo.returncode == 2
+    assert "unknown rule" in typo.stderr
+    spaced = _run_cli(str(bad), "--rules", "ffi-import, shard-map-import")
+    assert spaced.returncode == 1  # ran, found the seeded violation
+    assert "KeyError" not in spaced.stderr
+
+
+def test_cli_refuses_to_check_nothing():
+    """--no-lint without --programs disables both arms; that must be a
+    usage error, never a green '0 checked -> OK' (review finding: the
+    CI gate could pass while analyzing nothing)."""
+    proc = _run_cli("--no-lint")
+    assert proc.returncode == 2, (proc.returncode, proc.stdout, proc.stderr)
+    assert "nothing was checked" in proc.stderr
+
+
+def test_api_rejects_unknown_rule_ids(tmp_path):
+    """lint_file/lint_paths are documented API: an unknown rule id must
+    raise a named ValueError, not a bare KeyError (review finding) —
+    and lint_paths rejects it even when no file matches."""
+    f = tmp_path / "f.py"
+    f.write_text("x = 1\n")
+    with pytest.raises(ValueError, match="unknown rule"):
+        lint_file(str(f), rules=["no-such-rule"])
+    with pytest.raises(ValueError, match="no-such-rule"):
+        lint_paths([str(tmp_path / "empty")], rules=["no-such-rule"])
+
+
+def test_findings_record_to_obs_exactly_once():
+    """Composite verifiers pass the same Finding objects through several
+    set_last_report layers; each finding must land in the event log
+    exactly once (review finding: double-mirrored forensics)."""
+    from torcheval_tpu import obs
+    from torcheval_tpu.analysis import Finding, Report, set_last_report
+
+    rec = obs.recorder()
+    prev = rec.enabled
+    rec.reset()
+    rec.enable()
+    try:
+        sub = Report(tool="lint")
+        sub.findings.append(
+            Finding(tool="lint", rule="ffi-import", path="x.py", message="m")
+        )
+        set_last_report(sub)
+        parent = Report(tool="lint")
+        parent.extend(sub)  # same Finding objects, new report
+        set_last_report(parent)
+        set_last_report(parent)  # and once more for good measure
+        events = [e for e in rec.log.tail() if e.kind == "analysis"]
+        assert len(events) == 1
+    finally:
+        if not prev:
+            rec.disable()

@@ -1,0 +1,351 @@
+"""Lockstep checker acceptance (ISSUE 7): statically flags a deliberately
+rank-divergent collective program, passes the library's existing
+sharded/subgroup sync programs, and diffs eager synclib call plans.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.38 jax keeps it under experimental
+    from jax.experimental.shard_map import shard_map
+
+from torcheval_tpu import metrics as M
+from torcheval_tpu.analysis import (
+    check_eager_lockstep,
+    check_program_lockstep,
+    collective_plan,
+    eager_sync_plan,
+    verify_rank_lockstep,
+)
+from torcheval_tpu.metrics.metric import MergeKind
+from torcheval_tpu.metrics.sharded import sync_states_in_jit
+
+RNG = np.random.default_rng(11)
+
+
+def _rules(report):
+    return sorted({f.rule for f in report.findings if not f.suppressed})
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    cpus = jax.devices("cpu")
+    if len(cpus) < 8:
+        pytest.skip("needs the 8-device virtual CPU platform")
+    return Mesh(np.array(cpus[:8]), ("dp",))
+
+
+@pytest.fixture(scope="module")
+def mesh2d():
+    cpus = jax.devices("cpu")
+    if len(cpus) < 8:
+        pytest.skip("needs the 8-device virtual CPU platform")
+    return Mesh(np.array(cpus[:8]).reshape(4, 2), ("dp", "sp"))
+
+
+X8 = jax.ShapeDtypeStruct((8,), jnp.float32)
+
+
+# --------------------------------------------------- rank-divergent programs
+
+
+def test_rank_divergent_program_is_flagged(mesh):
+    """The acceptance fixture: a leader-only extra reduction. Every rank
+    but 0 would block forever in the leader's pmax — caught statically,
+    with the offending op's provenance in the finding."""
+
+    def build(rank):
+        @partial(shard_map, mesh=mesh, in_specs=(P("dp"),), out_specs=P())
+        def step(xs):
+            total = jax.lax.psum(xs.sum(), "dp")
+            if rank == 0:  # deliberate: rank-dependent program structure
+                total = total + jax.lax.pmax(xs.max(), "dp")
+            return total
+
+        return step
+
+    report = verify_rank_lockstep(build, range(4), X8, name="leader-extra")
+    assert not report.ok
+    findings = [
+        f for f in report.findings if f.rule == "rank-divergent-collective"
+    ]
+    assert len(findings) == 3  # ranks 1..3 each diverge from rank 0
+    assert "deadlock" in findings[0].message
+    assert "pmax" in findings[0].message
+
+
+def test_rank_uniform_spmd_program_passes(mesh):
+    def build(rank):  # rank ignored: true SPMD
+        @partial(shard_map, mesh=mesh, in_specs=(P("dp"),), out_specs=P())
+        def step(xs):
+            return jax.lax.psum(xs.sum(), "dp")
+
+        return step
+
+    report = verify_rank_lockstep(build, range(8), X8)
+    assert report.ok, report.format_text()
+    assert report.checked == 8
+
+
+def test_reordered_collectives_are_divergence(mesh):
+    """Equal counts, different order — the case a bare census misses."""
+
+    def build(rank):
+        @partial(shard_map, mesh=mesh, in_specs=(P("dp"),), out_specs=P())
+        def step(xs):
+            if rank % 2 == 0:
+                return jax.lax.psum(xs.sum(), "dp") + jax.lax.pmax(
+                    xs.max(), "dp"
+                )
+            return jax.lax.pmax(xs.max(), "dp") + jax.lax.psum(
+                xs.sum(), "dp"
+            )
+
+        return step
+
+    report = verify_rank_lockstep(build, range(2), X8)
+    assert "rank-divergent-collective" in _rules(report)
+
+
+# ------------------------------------------------ structural hazards (1 prog)
+
+
+def test_branch_dependent_collective_is_flagged(mesh):
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp"), P()), out_specs=P("dp"))
+    def step(xs, flag):
+        return jax.lax.cond(
+            flag[0] > 0,
+            lambda v: jax.lax.psum(v, "dp"),
+            lambda v: v * 2.0,
+            xs,
+        )
+
+    report = check_program_lockstep(
+        step, X8, jax.ShapeDtypeStruct((1,), jnp.float32)
+    )
+    assert _rules(report) == ["branch-dependent-collective"]
+    assert "deadlock" in report.findings[0].message
+
+
+def test_symmetric_branches_pass(mesh):
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp"), P()), out_specs=P())
+    def step(xs, flag):
+        return jax.lax.cond(
+            flag[0] > 0,
+            lambda v: jax.lax.psum(v.sum(), "dp"),
+            lambda v: jax.lax.psum(v.max(), "dp") * 0.5,
+            xs,
+        )
+
+    report = check_program_lockstep(
+        step, X8, jax.ShapeDtypeStruct((1,), jnp.float32)
+    )
+    # both arms psum over 'dp': the ranks rendezvous either way
+    assert report.ok, report.format_text()
+
+
+def test_collective_in_while_is_a_warning(mesh):
+    @partial(
+        shard_map, mesh=mesh, in_specs=(P("dp"),), out_specs=P(),
+        check_rep=False,  # jax 0.4.37 has no while replication rule
+    )
+    def step(xs):
+        def body(carry):
+            i, acc = carry
+            return i + 1, acc + jax.lax.psum(xs.sum(), "dp")
+
+        return jax.lax.while_loop(lambda c: c[0] < 3, body, (0, jnp.zeros(())))[1]
+
+    report = check_program_lockstep(step, X8)
+    assert report.ok  # warning-severity: rank-uniform trip counts are fine
+    assert _rules(report) == ["collective-in-loop"]
+    assert all(f.severity == "warning" for f in report.findings)
+
+
+# ------------------------------------------- existing library sync programs
+
+
+def test_library_sync_programs_pass(mesh):
+    """sync_states_in_jit over every merge kind is lockstep-clean, and
+    its plan is the declared one: one gather per EXTEND state, fused
+    reductions per kind."""
+
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp"),), out_specs=P())
+    def sync(xs):
+        return sync_states_in_jit(
+            {"a": xs.sum(), "b": xs.max(), "c": xs.min(), "buf": xs},
+            "dp",
+            {
+                "a": MergeKind.SUM,
+                "b": MergeKind.MAX,
+                "c": MergeKind.MIN,
+                "buf": MergeKind.EXTEND,
+            },
+        )
+
+    report = check_program_lockstep(sync, X8)
+    assert report.ok, report.format_text()
+    plan = collective_plan(sync, X8)
+    assert sorted(op.name for op in plan) == [
+        "all_gather",
+        "pmax",
+        "pmin",
+        "psum2",
+    ]
+    assert all(op.axes == ("dp",) for op in plan)
+    # SPMD: the same builder at any rank yields the identical plan
+    assert verify_rank_lockstep(lambda r: sync, range(8), X8).ok
+
+
+def test_composed_axes_sync_is_lockstep_clean(mesh2d):
+    """The subgroup-scoped hierarchical form from PR 3: reductions and
+    gathers spanning a composed ("dp", "sp") axis tuple."""
+
+    @partial(
+        shard_map, mesh=mesh2d, in_specs=(P(("dp", "sp")),), out_specs=P()
+    )
+    def sync(xs):
+        return sync_states_in_jit(
+            {"n": xs.sum(), "buf": xs},
+            ("dp", "sp"),
+            {"n": MergeKind.SUM, "buf": MergeKind.EXTEND},
+        )
+
+    report = check_program_lockstep(sync, X8)
+    assert report.ok, report.format_text()
+    plan = collective_plan(sync, X8)
+    assert all(op.axes == ("dp", "sp") for op in plan)
+    assert verify_rank_lockstep(lambda r: sync, range(8), X8).ok
+
+
+# ------------------------------------------------------- eager call plans
+
+
+def _collection():
+    coll = {
+        "acc": M.MulticlassAccuracy(),
+        "mse": M.MeanSquaredError(),
+        "auroc": M.BinaryAUROC(),
+    }
+    x2 = jnp.asarray(RNG.random((16, 5)).astype(np.float32))
+    t1 = jnp.asarray(RNG.integers(0, 5, 16))
+    xb = jnp.asarray(RNG.random(16).astype(np.float32))
+    tb = jnp.asarray(RNG.integers(0, 2, 16).astype(np.float32))
+    coll["acc"].update(x2, t1)
+    coll["mse"].update(xb, tb)
+    coll["auroc"].update(xb, tb)
+    return coll
+
+
+def test_identical_collections_have_lockstep_plans():
+    coll = _collection()
+    plans = {
+        rank: eager_sync_plan(coll, world_size=4, rank=rank)
+        for rank in range(4)
+    }
+    report = check_eager_lockstep(plans)
+    assert report.ok, report.format_text()
+    assert report.checked == 4
+    # the plan is the pinned constant-collective-count protocol: one
+    # metadata exchange + one payload gather, any number of metrics
+    assert len(plans[0]) == 2
+    assert plans[0][0].startswith("allgather_object[")
+    assert plans[0][1] == "allgather_array"
+
+
+def test_mismatched_collections_diverge():
+    """One rank constructed an extra metric (the classic init-order bug):
+    its metadata framing differs — flagged as would-deadlock before any
+    collective is issued."""
+    coll = _collection()
+    partial_coll = {k: v for k, v in coll.items() if k != "auroc"}
+    report = check_eager_lockstep(
+        {
+            0: eager_sync_plan(coll, world_size=2, rank=0),
+            1: eager_sync_plan(partial_coll, world_size=2, rank=1),
+        }
+    )
+    assert _rules(report) == ["eager-plan-divergence"]
+    assert "deadlock" in report.findings[0].message
+
+
+def test_fill_level_does_not_fake_divergence():
+    """Rank B buffered fewer samples than rank A — the real protocol pads
+    payloads to the global max, so the plans must still match (the check
+    is structural, not byte-count)."""
+    a = _collection()
+    b = _collection()
+    xb = jnp.asarray(RNG.random(64).astype(np.float32))
+    tb = jnp.asarray(RNG.integers(0, 2, 64).astype(np.float32))
+    b["auroc"].update(xb, tb)  # different fill, same structure
+    report = check_eager_lockstep(
+        {
+            0: eager_sync_plan(a, world_size=2, rank=0),
+            1: eager_sync_plan(b, world_size=2, rank=1),
+        }
+    )
+    assert report.ok, report.format_text()
+
+
+def test_hand_recorded_plans_ignore_local_payload_sizes():
+    """PlanRecordingGroup annotates array gathers with the LOCAL byte
+    count; the padded protocol makes fill level rank-local, so
+    check_eager_lockstep strips the sizes before diffing (review
+    finding: ranks differing only in fill read as would-deadlock). A
+    genuine op-kind mismatch must still fire."""
+    from torcheval_tpu.analysis import PlanRecordingGroup
+
+    g0 = PlanRecordingGroup(world_size=2, rank=0)
+    g1 = PlanRecordingGroup(world_size=2, rank=1)
+    for group, n in ((g0, 10), (g1, 20)):
+        group.allgather_object({"m": ["s"]})
+        group.allgather_array(np.zeros(n, np.float32))
+    assert g0.calls != g1.calls  # raw records keep the forensic sizes
+    assert check_eager_lockstep({0: g0.calls, 1: g1.calls}).ok
+
+    g1.allgather_object({"m": ["s"]})  # extra op: genuine divergence
+    report = check_eager_lockstep({0: g0.calls, 1: g1.calls})
+    assert _rules(report) == ["eager-plan-divergence"]
+
+
+def test_subgroup_scoped_plans_are_lockstep():
+    """Member subsets sync over subgroup-relative worlds; the plan for a
+    given collection is world-size-independent, so subgroup members stay
+    in lockstep with each other by construction — pinned here."""
+    coll = _collection()
+    whole = eager_sync_plan(coll, world_size=4, rank=0)
+    sub = eager_sync_plan(coll, world_size=2, rank=1)
+    assert whole == sub
+    assert check_eager_lockstep({0: whole, 2: sub, 3: sub}).ok
+
+
+def test_eager_plan_does_not_consume_the_metrics():
+    coll = _collection()
+    before = float(coll["auroc"].compute())
+    eager_sync_plan(coll, world_size=2)
+    assert float(coll["auroc"].compute()) == before
+
+
+def test_all_empty_collection_plans_stay_uniform():
+    """Buffered metrics synced before any update pack zero bytes on
+    every rank; the real protocol then skips the payload gather by
+    GLOBAL agreement. The static plan deliberately over-approximates
+    (lists the gather) — what matters is that it does so uniformly:
+    no false divergence, and the dry run still completes."""
+    empty = {"auroc": M.BinaryAUROC()}
+    plans = {
+        rank: eager_sync_plan(empty, world_size=2, rank=rank)
+        for rank in range(2)
+    }
+    assert plans[0] == plans[1]
+    assert check_eager_lockstep(plans).ok

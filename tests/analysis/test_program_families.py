@@ -1,0 +1,94 @@
+"""ISSUE 7 acceptance sweep: the program verifier proves the
+zero-collectives, no-host-escape, dtype-safety, and donation-aliasing
+properties for EVERY registered metric family — statically, from one
+API, without executing a step.
+
+The family table is shared with tests/metrics/test_no_host_sync.py (the
+runtime transfer-guard pins, now thin wrappers over the same analysis
+API), so a metric added there is automatically swept here.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.metrics.test_no_host_sync import CLASS_CASES
+from torcheval_tpu.analysis import (
+    verify_metric_compute,
+    verify_metric_merge,
+    verify_metric_update,
+)
+
+
+def _errors(report):
+    return [
+        f
+        for f in report.findings
+        if f.severity == "error" and not f.suppressed
+    ]
+
+
+@pytest.mark.parametrize("name", sorted(CLASS_CASES))
+def test_update_program_is_verified_statically(name):
+    """No host escapes, ZERO collectives (a local update never syncs),
+    no 64-bit leaks, and — for the donated program variant — every
+    donated state parameter aliased in the optimized module plus a clean
+    call-layer aliasing check of the live states."""
+    make, args = CLASS_CASES[name]
+    metric = make()
+    report = verify_metric_update(metric, *args)
+    if report is None:
+        pytest.skip(
+            f"{name}.update has no fusable plan (buffered append family; "
+            "its donated-append discipline is pinned by test_buffers.py)"
+        )
+    assert report.ok, "\n" + report.format_text()
+    assert report.collectives == (), report.collectives
+    assert report.hlo_collectives == (), report.hlo_collectives
+    assert report.host_escapes == ()
+    # report.ok above is the aliasing proof: any donated BUFFER missing
+    # from input_output_alias is an error finding (0-d scalars XLA chose
+    # not to alias are warning-only — realloc of a scalar is free)
+
+
+@pytest.mark.parametrize("name", sorted(CLASS_CASES))
+def test_donated_variant_is_alias_sound_even_where_donation_is_off(name):
+    """The donation proof must hold for the donated PROGRAM of every
+    fusable family regardless of the process knob (CPU defaults off) —
+    the bug class only bites on TPU, so the static check must not depend
+    on the backend default."""
+    make, args = CLASS_CASES[name]
+    metric = make()
+    report = verify_metric_update(metric, *args, donate=True)
+    if report is None:
+        pytest.skip(f"{name}.update has no fusable plan")
+    assert report.ok, "\n" + report.format_text()
+    assert report.donated_params, "donated variant produced no donation"
+    # every donated non-scalar state must be aliased; report.ok enforces
+    # it (scalar misses are warning-severity, see verify_program)
+    assert report.aliased_params, "nothing aliased despite donation"
+
+
+@pytest.mark.parametrize("name", sorted(CLASS_CASES))
+def test_compute_program_has_no_errors(name):
+    """compute() is host-side finalization: concretization there is a
+    WARNING by house rules (informational; the hard contract binds
+    update), but error-severity findings — host callbacks, 64-bit leaks
+    — must not appear."""
+    make, args = CLASS_CASES[name]
+    metric = make()
+    metric.update(*args)  # buffered metrics need data to trace compute
+    report = verify_metric_compute(metric)
+    assert not _errors(report), "\n" + report.format_text()
+
+
+@pytest.mark.parametrize("name", sorted(CLASS_CASES))
+def test_merge_program_is_local_math(name):
+    """merge_state is local: no collectives (they belong to the sync
+    transport), no host escapes, dtype-safe — for every family."""
+    make, args = CLASS_CASES[name]
+    metric = make()
+    metric.update(*args)
+    report = verify_metric_merge(metric)
+    assert not _errors(report), "\n" + report.format_text()
+    assert report.collectives == ()

@@ -72,17 +72,15 @@ FAMILY_CASES = {
 def test_donated_update_reuses_state_buffer(name):
     """Steady-state updates write the new state into the OLD buffer: the
     device pointer is stable across updates (the zero-realloc claim the
-    bench donation arm measures)."""
+    bench donation arm measures). Thin wrapper (ISSUE 7) over the shared
+    analysis pin — warm=2 (compile / first growth) then 3 pointer-checked
+    steps, the last one also transfer-guarded; the STATIC aliasing proof
+    (donated invars in input_output_alias) lives in
+    tests/analysis/test_program_families.py."""
+    from torcheval_tpu.analysis import assert_donated_update_in_place
+
     ctor, args, state = FAMILY_CASES[name]
-    metric = ctor()
-    metric.update(*args)  # compile / first growth
-    metric.update(*args)
-    ptr = getattr(metric, state).unsafe_buffer_pointer()
-    for _ in range(3):
-        metric.update(*args)
-        assert getattr(metric, state).unsafe_buffer_pointer() == ptr, (
-            f"{name}.{state} was reallocated by a donated update"
-        )
+    assert_donated_update_in_place(ctor(), args, state, warm=2, steps=3)
 
 
 @pytest.mark.parametrize("name", sorted(FAMILY_CASES))

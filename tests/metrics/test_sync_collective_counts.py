@@ -297,3 +297,30 @@ def test_synced_state_dict_world_of_one_passthrough():
         np.asarray(single["num_total"]),
         np.asarray(coll["acc"].state_dict()["num_total"]),
     )
+
+
+def test_eager_plan_matches_observed_group_calls():
+    """ISSUE 7: the static eager call plan (``analysis.eager_sync_plan``,
+    the lockstep checker's view of the protocol) predicts exactly the
+    group calls a real sync issues — the collective-count pin and the
+    lockstep contract are ONE model, not two."""
+    from torcheval_tpu.analysis import check_eager_lockstep, eager_sync_plan
+
+    coll = _collection(4)
+    _feed(coll)
+    plan = eager_sync_plan(coll, world_size=2)
+
+    group = CountingGroup()
+    sync_and_compute_collection(
+        {k: copy.deepcopy(m) for k, m in coll.items()}, group
+    )
+    assert group.object_gathers == sum(
+        1 for op in plan if op.startswith("allgather_object")
+    )
+    assert group.array_gathers == sum(
+        1 for op in plan if op.startswith("allgather_array")
+    )
+    # identical collections on every rank -> lockstep holds
+    assert check_eager_lockstep(
+        {0: plan, 1: eager_sync_plan(coll, world_size=2, rank=1)}
+    ).ok

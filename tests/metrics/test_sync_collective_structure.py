@@ -36,6 +36,8 @@ from torcheval_tpu.metrics.sharded import sync_states_in_jit
 from torcheval_tpu.utils.hlo import (
     all_reduce_combiner_active as _combiner_active,
     collective_count as _collective_count,
+    collective_lines as _collective_lines,
+    collective_sequence as _collective_sequence,
     compile_fully_optimized as _compile_opt,
 )
 
@@ -107,6 +109,10 @@ def test_metric_sync_adds_no_collectives(mesh):
         f"metric sync added collectives: {n_synced} vs {n_plain} — the "
         "psum-combiner fusion the sync design relies on has regressed"
     )
+    # the ORDERED census (ISSUE 7): not just one collective, but exactly
+    # the step's own all-reduce — an all-gather silently replacing it
+    # would pass a bare count
+    assert _collective_sequence(synced) == ("all-reduce",)
 
     # and it still computes the right thing
     loss, synced_state = step_with_sync(x, y, w1, w2, state)
@@ -120,11 +126,12 @@ def _optimized_hlo(fn, *args):
 
 
 def _all_gather_lines(hlo):
-    import re
-
+    # ONE HLO-parsing implementation (ISSUE 7): filter the shared
+    # utils.hlo.collective_lines census instead of a local regex.
     return [
-        line for line in hlo.splitlines()
-        if re.search(r"=\s+\S+\s+all-gather(?:-start)?\(", line)
+        line
+        for op, _, line in _collective_lines(hlo)
+        if op == "all-gather"
     ]
 
 
@@ -155,9 +162,11 @@ def test_extend_sync_lowers_to_all_gather(mesh):
     # operand is the LOCAL SHARD (f32[128]), not a [world, ...] buffer
     operand = ag[0].rsplit("all-gather(", 1)[1]
     assert operand.startswith(f"f32[{per_shard}]"), ag[0]
-    assert _collective_count(_compile_opt(
+    assert _collective_sequence(_compile_opt(
         jax.jit(sync_extend).lower(x)
-    )) == 1, "the gather must be the ONLY collective (no rep-fixup psum)"
+    )) == ("all-gather",), (
+        "the gather must be the ONLY collective (no rep-fixup psum)"
+    )
     assert "all-reduce" not in hlo, (
         "EXTEND sync regressed to the gather-as-psum zero-buffer trick:\n"
         + hlo

@@ -28,6 +28,7 @@ MODULES = [
     ("torcheval_tpu.resilience", "resilience"),
     ("torcheval_tpu.elastic", "elastic"),
     ("torcheval_tpu.obs", "obs"),
+    ("torcheval_tpu.analysis", "analysis"),
     ("torcheval_tpu.tools", "tools"),
     ("torcheval_tpu.utils", "utils"),
     ("torcheval_tpu.utils.test_utils", "test_utils"),

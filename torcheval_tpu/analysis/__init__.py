@@ -1,0 +1,92 @@
+"""Static analysis for torcheval_tpu: verifier, lockstep checker, lint.
+
+Three layers, one :class:`Finding`/:class:`Report` schema
+(docs/static-analysis.md):
+
+- ``analysis.lint`` — AST house rules over source files (stdlib-only:
+  importable and runnable without jax, so the CI lint pass needs no
+  accelerator toolchain);
+- ``analysis.program`` — the metric-program verifier: trace
+  update/compute/merge (or any step fn) with abstract inputs and
+  statically prove no-host-escapes, the collective census, donation
+  soundness, and dtype safety — without executing a step;
+- ``analysis.lockstep`` — cross-rank collective lockstep: per-rank
+  program diffs, branch-dependent-collective hazards, and eager
+  synclib call-plan diffs, reported as would-deadlock findings.
+
+CLI: ``python -m torcheval_tpu.analysis [paths...] --report json``.
+
+Import discipline: this module eagerly exposes only the stdlib layers
+(``report``, ``lint``); the jax-backed verifier/lockstep symbols load
+lazily on first attribute access (PEP 562), so ``from torcheval_tpu
+import analysis`` in a jax-free process stays jax-free.
+"""
+
+from __future__ import annotations
+
+from torcheval_tpu.analysis.lint import (
+    RULES,
+    LintRule,
+    lint_file,
+    lint_paths,
+    register_rule,
+)
+from torcheval_tpu.analysis.report import (
+    Finding,
+    Report,
+    last_report,
+    set_last_report,
+)
+
+# jax-backed symbols, resolved lazily via __getattr__
+_LAZY = {
+    "ProgramReport": "program",
+    "assert_donated_update_in_place": "program",
+    "assert_update_transfer_free": "program",
+    "check_donation_aliasing": "program",
+    "compare_collective_sequences": "program",
+    "verify_metric_compute": "program",
+    "verify_metric_merge": "program",
+    "verify_metric_update": "program",
+    "verify_program": "program",
+    "CollectiveOp": "lockstep",
+    "PlanRecordingGroup": "lockstep",
+    "check_eager_lockstep": "lockstep",
+    "check_program_lockstep": "lockstep",
+    "collective_plan": "lockstep",
+    "eager_sync_plan": "lockstep",
+    "verify_rank_lockstep": "lockstep",
+}
+
+__all__ = sorted(
+    [
+        "Finding",
+        "LintRule",
+        "RULES",
+        "Report",
+        "last_report",
+        "lint_file",
+        "lint_paths",
+        "register_rule",
+        "set_last_report",
+        *_LAZY,
+    ]
+)
+
+
+def __getattr__(name: str):
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    mod = importlib.import_module(f"{__name__}.{module}")
+    value = getattr(mod, name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return __all__

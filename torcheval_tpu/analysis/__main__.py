@@ -1,0 +1,155 @@
+"""CLI: ``python -m torcheval_tpu.analysis [paths...] [options]``.
+
+Runs the AST lint over the given paths (default: the installed
+``torcheval_tpu`` package) and prints a text or machine-readable JSON
+report (docs/static-analysis.md, "CLI"). Exit status 0 iff no
+unsuppressed error-severity finding remains — the CI gate.
+
+``--programs`` additionally runs the fast program-verifier smoke — a
+representative metric family per merge kind, statically proving the
+no-host-escape / zero-collective / donation-aliasing contracts. That arm
+imports jax; the plain lint run never does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from torcheval_tpu.analysis.lint import RULES, lint_paths
+from torcheval_tpu.analysis.report import Report
+
+
+def _default_paths() -> list:
+    package_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [package_dir]
+
+
+def _program_smoke() -> Report:
+    """Fast static proof over one representative metric per family —
+    the CI smoke (the full per-family sweep lives in
+    tests/analysis/test_program_families.py)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from torcheval_tpu import metrics as M
+    from torcheval_tpu.analysis.program import (
+        verify_metric_compute,
+        verify_metric_merge,
+        verify_metric_update,
+    )
+
+    rng = np.random.default_rng(0)
+    x2 = jnp.asarray(rng.random((32, 5)).astype(np.float32))
+    t1 = jnp.asarray(rng.integers(0, 5, 32))
+    xb = jnp.asarray(rng.random(32).astype(np.float32))
+    tb = jnp.asarray(rng.integers(0, 2, 32).astype(np.float32))
+
+    cases = [
+        (M.MulticlassAccuracy(), (x2, t1)),  # SUM counters
+        (M.Mean(), (xb,)),  # weighted-sum pair
+        (M.MeanSquaredError(), (xb, tb)),  # regression family
+    ]
+    combined = Report(tool="program")
+    for metric, args in cases:
+        report = verify_metric_update(metric, *args)
+        if report is not None:
+            combined.extend(report)
+        combined.extend(verify_metric_compute(metric))
+        combined.extend(verify_metric_merge(metric))
+    return combined
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torcheval_tpu.analysis",
+        description="torcheval_tpu static analysis (lint / verifier)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to lint (default: the installed "
+        "torcheval_tpu package)",
+    )
+    parser.add_argument(
+        "--report",
+        choices=("text", "json"),
+        default="text",
+        help="output format (json is the machine-readable CI artifact)",
+    )
+    parser.add_argument(
+        "--output",
+        default=None,
+        metavar="FILE",
+        help="also write the report to FILE",
+    )
+    parser.add_argument(
+        "--rules",
+        default=None,
+        metavar="ID[,ID...]",
+        help="run only these lint rules",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the lint rule catalogue and exit",
+    )
+    parser.add_argument(
+        "--programs",
+        action="store_true",
+        help="also run the program-verifier smoke (imports jax)",
+    )
+    parser.add_argument(
+        "--no-lint",
+        action="store_true",
+        help="skip the AST lint (with --programs: verifier only)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id in sorted(RULES):
+            print(f"{rule_id}: {RULES[rule_id].description}")
+        return 0
+
+    combined = Report(tool="analysis")
+    if not args.no_lint:
+        rules = None
+        if args.rules:
+            rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        try:
+            lint_report = lint_paths(
+                args.paths or _default_paths(), rules=rules
+            )
+        except ValueError as exc:  # unknown rule ids (lint._select_rules)
+            parser.error(str(exc))
+        if lint_report.checked == 0:
+            # a lint that examined nothing must not pass the CI gate
+            parser.error(
+                "no Python files found under the given paths — "
+                "nothing was linted"
+            )
+        combined.extend(lint_report)
+    if args.programs:
+        combined.extend(_program_smoke())
+
+    if combined.checked == 0:
+        # an analysis that examined nothing must not pass the CI gate
+        # (--no-lint without --programs leaves both arms disabled)
+        parser.error("nothing was checked — --no-lint requires --programs")
+
+    text = (
+        combined.to_json()
+        if args.report == "json"
+        else combined.format_text()
+    )
+    print(text)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    return 0 if combined.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,602 @@
+"""AST lint: the house rules that keep regressing, as a rule registry.
+
+Each rule codifies a convention this repo already enforces by review (and
+has re-fixed more than once — see docs/static-analysis.md for the incident
+behind each rule):
+
+- ``ffi-import``: the jax FFI surface moved between 0.4.37 and 0.4.38
+  (``jax.extend.ffi`` -> ``jax.ffi``); importing either spelling directly
+  silently disabled the whole native-op layer on the other version (PR 6).
+  Everything must import through ``torcheval_tpu/_ffi.py``.
+- ``env-truthy``: boolean env knobs must parse through
+  ``config.env_truthy`` / ``config._TRUTHY`` — inline truthy tuples
+  drifted apart 4 times before PR 6 consolidated them.
+- ``host-sync``: ``.item()`` / ``.tolist()`` / ``np.asarray`` /
+  ``jax.device_get`` in jit-reachable modules puts a host<->device round
+  trip on the hot path (60-300 ms/call tunnel-amplified on remote TPUs).
+- ``time-in-jit``: a wall-clock read in a jit-reachable module traces to
+  a compile-time constant — silently wrong, not just slow.
+- ``shard-map-import``: bare ``from jax import shard_map`` breaks on
+  pre-0.4.38 jax (the seed was shipped broken this way); the import must
+  sit in a try/except with the ``jax.experimental.shard_map`` fallback.
+
+Scope model: ``host-sync`` and ``time-in-jit`` only apply to modules whose
+code is traced into XLA programs (``_JIT_REACHABLE``); host-side protocol
+code (``distributed.py``, ``synclib.py``, text metrics operating on Python
+strings, the native-op build loader) legitimately touches numpy. A file
+can override its classification with a ``# tev: scope=jit`` /
+``# tev: scope=host`` comment in its first lines.
+
+Suppression: ``# tev: disable=<rule-id>[,<rule-id>...] -- <reason>`` on
+the offending line. The reason is mandatory — a reasonless suppression is
+itself a finding (``bad-suppression``). Suppressed findings stay in the
+JSON report, flagged, so they remain auditable.
+
+Stdlib-only by design: CI's lint pass must not need jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from torcheval_tpu.analysis.report import Finding, Report, set_last_report
+
+__all__ = [
+    "LintRule",
+    "RULES",
+    "lint_file",
+    "lint_paths",
+    "register_rule",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tev:\s*disable=([\w\-,]+)(?:\s*--\s*(.*\S))?\s*$"
+)
+_SCOPE_RE = re.compile(r"#\s*tev:\s*scope=(jit|host)\b")
+
+# Accepted boolean env spellings — mirrors config._TRUTHY/_FALSY (kept
+# literal here so the lint stays importable without the package root).
+_BOOL_SPELLINGS = frozenset(
+    {"1", "true", "yes", "on", "0", "false", "no", "off"}
+)
+
+# Modules whose code is traced into XLA programs: host-sync idioms and
+# wall-clock reads there land on the jitted hot path. Matched against the
+# normalized path suffix starting at "torcheval_tpu/".
+_JIT_REACHABLE_PREFIXES = (
+    "torcheval_tpu/metrics/functional/",
+    "torcheval_tpu/ops/",
+)
+_JIT_EXEMPT_PREFIXES = (
+    # text metrics tokenize Python strings on the host by design
+    "torcheval_tpu/metrics/functional/text/",
+    # the native-op loader is host-side build/cache code
+    "torcheval_tpu/ops/native/",
+)
+_JIT_REACHABLE_FILES = (
+    "torcheval_tpu/metrics/sharded.py",  # in-jit sync bodies
+    "torcheval_tpu/metrics/_fuse.py",  # traced fused-update bodies
+    "torcheval_tpu/utils/vma.py",  # shard_map rep-rule bodies
+)
+
+
+def _package_relpath(path: str) -> str:
+    norm = path.replace(os.sep, "/")
+    idx = norm.rfind("torcheval_tpu/")
+    return norm[idx:] if idx >= 0 else norm
+
+
+def is_jit_reachable(path: str, source_head: str = "") -> bool:
+    """Whether ``host-sync``/``time-in-jit`` apply to this file."""
+    scope = _SCOPE_RE.search(source_head)
+    if scope:
+        return scope.group(1) == "jit"
+    rel = _package_relpath(path)
+    if rel in _JIT_REACHABLE_FILES:
+        return True
+    if any(rel.startswith(p) for p in _JIT_EXEMPT_PREFIXES):
+        return False
+    return any(rel.startswith(p) for p in _JIT_REACHABLE_PREFIXES)
+
+
+@dataclass(frozen=True)
+class LintRule:
+    """One registered house rule.
+
+    ``check(ctx)`` yields ``(line, col, message)`` violations;
+    ``applies(ctx)`` gates by file (scope model above).
+    """
+
+    id: str
+    description: str
+    check: Callable[["_FileContext"], Iterator[Tuple[int, int, str]]]
+    applies: Callable[["_FileContext"], bool] = lambda ctx: True
+    severity: str = "error"
+
+
+@dataclass
+class _FileContext:
+    path: str
+    rel: str
+    tree: ast.AST
+    lines: List[str]
+    jit: bool
+
+
+RULES: Dict[str, LintRule] = {}
+
+
+def register_rule(rule: LintRule) -> LintRule:
+    if rule.id in RULES:
+        raise ValueError(f"duplicate lint rule id {rule.id!r}")
+    RULES[rule.id] = rule
+    return rule
+
+
+# ------------------------------------------------------------- ffi-import
+
+
+def _is_jax_ffi_module(name: str) -> bool:
+    return name in ("jax.ffi", "jax.extend.ffi") or name.startswith(
+        ("jax.ffi.", "jax.extend.ffi.")
+    )
+
+
+def _check_ffi_import(ctx: _FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if _is_jax_ffi_module(alias.name):
+                    yield (
+                        node.lineno,
+                        node.col_offset,
+                        f"direct `import {alias.name}`: the FFI surface "
+                        "moved across jax versions — import `ffi` from "
+                        "torcheval_tpu._ffi instead",
+                    )
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if _is_jax_ffi_module(mod) or (
+                mod in ("jax", "jax.extend")
+                and any(a.name == "ffi" for a in node.names)
+            ):
+                yield (
+                    node.lineno,
+                    node.col_offset,
+                    f"direct ffi import from `{mod}`: import `ffi` from "
+                    "torcheval_tpu._ffi instead (version shim)",
+                )
+        elif isinstance(node, ast.Attribute) and node.attr == "ffi":
+            base = node.value
+            if (isinstance(base, ast.Name) and base.id == "jax") or (
+                isinstance(base, ast.Attribute)
+                and base.attr == "extend"
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "jax"
+            ):
+                yield (
+                    node.lineno,
+                    node.col_offset,
+                    "attribute access on jax's ffi module: use "
+                    "torcheval_tpu._ffi (version shim)",
+                )
+
+
+register_rule(
+    LintRule(
+        id="ffi-import",
+        description=(
+            "jax FFI must be imported through torcheval_tpu._ffi "
+            "(jax.ffi vs jax.extend.ffi moved across versions)"
+        ),
+        check=_check_ffi_import,
+        applies=lambda ctx: not ctx.rel.endswith("/_ffi.py"),
+    )
+)
+
+
+# ------------------------------------------------------------- env-truthy
+
+
+def _str_elts(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        values = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            ):
+                return None
+            values.append(elt.value.lower())
+        return values
+    return None
+
+
+def _check_env_truthy(ctx: _FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            continue
+        for comparator in node.comparators:
+            elts = _str_elts(comparator)
+            if elts is None:
+                continue
+            hits = sum(1 for v in elts if v in _BOOL_SPELLINGS)
+            if hits >= 2:
+                yield (
+                    node.lineno,
+                    node.col_offset,
+                    "inline truthy env-spelling tuple: use "
+                    "config.env_truthy(name) (or config._TRUTHY/_FALSY) "
+                    "so the accepted spellings cannot drift",
+                )
+
+
+register_rule(
+    LintRule(
+        id="env-truthy",
+        description=(
+            "boolean env parsing must go through config.env_truthy, "
+            "not inline spelling tuples"
+        ),
+        check=_check_env_truthy,
+        applies=lambda ctx: not ctx.rel.endswith("torcheval_tpu/config.py"),
+    )
+)
+
+
+# -------------------------------------------------------------- host-sync
+
+_HOST_SYNC_METHODS = ("item", "tolist")
+_NUMPY_NAMES = ("np", "numpy")
+_NUMPY_SYNC_FNS = ("asarray", "array", "ascontiguousarray")
+
+
+def _check_host_sync(ctx: _FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        if fn.attr in _HOST_SYNC_METHODS and not node.args:
+            yield (
+                node.lineno,
+                node.col_offset,
+                f"`.{fn.attr}()` in a jit-reachable module forces a "
+                "device->host readback on the hot path",
+            )
+        elif (
+            fn.attr in _NUMPY_SYNC_FNS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in _NUMPY_NAMES
+        ):
+            yield (
+                node.lineno,
+                node.col_offset,
+                f"`np.{fn.attr}(...)` in a jit-reachable module pulls the "
+                "operand to the host; use jnp (or move the code to a "
+                "host-side module)",
+            )
+        elif (
+            fn.attr == "device_get"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "jax"
+        ):
+            yield (
+                node.lineno,
+                node.col_offset,
+                "`jax.device_get` in a jit-reachable module is an "
+                "explicit host readback on the hot path",
+            )
+
+
+register_rule(
+    LintRule(
+        id="host-sync",
+        description=(
+            ".item()/.tolist()/np.asarray/device_get in jit-reachable "
+            "modules (device->host round trip per call)"
+        ),
+        check=_check_host_sync,
+        applies=lambda ctx: ctx.jit,
+    )
+)
+
+
+# ------------------------------------------------------------ time-in-jit
+
+_CLOCK_FNS = ("time", "monotonic", "perf_counter", "process_time")
+
+
+def _check_time_in_jit(ctx: _FileContext):
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CLOCK_FNS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time"
+        ):
+            yield (
+                node.lineno,
+                node.col_offset,
+                f"`time.{node.func.attr}()` in a jit-reachable module: "
+                "under tracing this is a compile-time constant, not a "
+                "clock read — silently wrong, not just slow",
+            )
+
+
+register_rule(
+    LintRule(
+        id="time-in-jit",
+        description=(
+            "wall-clock reads in jit-reachable modules trace to "
+            "constants"
+        ),
+        check=_check_time_in_jit,
+        applies=lambda ctx: ctx.jit,
+    )
+)
+
+
+# -------------------------------------------------------- shard-map-import
+
+
+def _check_shard_map_import(ctx: _FileContext):
+    guarded: set = set()
+
+    def _mark_guarded(body: Iterable[ast.stmt]) -> None:
+        for stmt in body:
+            for node in ast.walk(stmt):
+                guarded.add(id(node))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Try):
+            handles_import_error = any(
+                h.type is None
+                or (
+                    isinstance(h.type, ast.Name)
+                    and h.type.id
+                    in ("ImportError", "ModuleNotFoundError", "Exception")
+                )
+                or (
+                    isinstance(h.type, ast.Tuple)
+                    and any(
+                        isinstance(e, ast.Name)
+                        and e.id
+                        in ("ImportError", "ModuleNotFoundError", "Exception")
+                        for e in h.type.elts
+                    )
+                )
+                for h in node.handlers
+            )
+            if handles_import_error:
+                _mark_guarded(node.body)
+
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.ImportFrom)
+            and node.module == "jax"
+            and any(a.name == "shard_map" for a in node.names)
+            and id(node) not in guarded
+        ):
+            yield (
+                node.lineno,
+                node.col_offset,
+                "bare `from jax import shard_map` breaks on pre-0.4.38 "
+                "jax: guard with try/except ImportError and fall back to "
+                "jax.experimental.shard_map",
+            )
+
+
+register_rule(
+    LintRule(
+        id="shard-map-import",
+        description=(
+            "from jax import shard_map must be guarded with the "
+            "jax.experimental fallback (pre-0.4.38 compat)"
+        ),
+        check=_check_shard_map_import,
+    )
+)
+
+
+# ----------------------------------------------------------------- driver
+
+
+def _parse_suppressions(
+    lines: List[str],
+) -> Tuple[Dict[int, Tuple[set, str]], List[Tuple[int, int, str]]]:
+    """Per-line suppression map + bad (reasonless) suppression findings."""
+    suppressions: Dict[int, Tuple[set, str]] = {}
+    bad: List[Tuple[int, int, str]] = []
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        ids = {part.strip() for part in m.group(1).split(",") if part.strip()}
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            bad.append(
+                (
+                    i,
+                    m.start(),
+                    "suppression without a reason: write "
+                    "`# tev: disable=<rule> -- <why this is intentional>`",
+                )
+            )
+            continue
+        unknown = ids - set(RULES)
+        if unknown:
+            bad.append(
+                (
+                    i,
+                    m.start(),
+                    f"suppression names unknown rule(s) {sorted(unknown)}; "
+                    f"known: {sorted(RULES)}",
+                )
+            )
+        suppressions[i] = (ids, reason)
+    return suppressions, bad
+
+
+def _select_rules(rules: Optional[Iterable[str]]) -> List[LintRule]:
+    """Resolve rule ids to :class:`LintRule` objects, rejecting unknown
+    ids with a message naming the catalogue (raw ``KeyError`` is useless
+    to a CLI/API caller who mistyped a rule)."""
+    if rules is None:
+        return list(RULES.values())
+    ids = list(rules)
+    unknown = sorted(set(ids) - set(RULES))
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {unknown}; known: {sorted(RULES)}"
+        )
+    return [RULES[r] for r in ids]
+
+
+def lint_file(path: str, *, rules: Optional[Iterable[str]] = None) -> Report:
+    """Lint one Python file against the registered rules."""
+    selected = _select_rules(rules)
+    report = Report(tool="lint")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    except (OSError, UnicodeDecodeError) as exc:
+        report.findings.append(
+            Finding(
+                tool="lint",
+                rule="parse-error",
+                path=path,
+                message=f"unreadable: {exc}",
+                severity="warning",
+            )
+        )
+        return report
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        report.findings.append(
+            Finding(
+                tool="lint",
+                rule="parse-error",
+                path=path,
+                line=exc.lineno or 0,
+                message=f"syntax error: {exc.msg}",
+                severity="warning",
+            )
+        )
+        return report
+
+    lines = source.splitlines()
+    head = "\n".join(lines[:5])
+    ctx = _FileContext(
+        path=path,
+        rel=_package_relpath(path),
+        tree=tree,
+        lines=lines,
+        jit=is_jit_reachable(path, head),
+    )
+    suppressions, bad = _parse_suppressions(lines)
+    for line, col, message in bad:
+        report.findings.append(
+            Finding(
+                tool="lint",
+                rule="bad-suppression",
+                path=path,
+                line=line,
+                col=col,
+                message=message,
+            )
+        )
+
+    report.checked = 1
+    for rule in selected:
+        if not rule.applies(ctx):
+            continue
+        for line, col, message in rule.check(ctx):
+            ids_reason = suppressions.get(line)
+            suppressed = bool(ids_reason and rule.id in ids_reason[0])
+            report.findings.append(
+                Finding(
+                    tool="lint",
+                    rule=rule.id,
+                    path=path,
+                    line=line,
+                    col=col,
+                    message=message,
+                    severity=rule.severity,
+                    suppressed=suppressed,
+                    suppress_reason=ids_reason[1] if suppressed else "",
+                )
+            )
+    report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return report
+
+
+def _iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d
+                    for d in dirs
+                    if d not in ("__pycache__", ".git", "node_modules")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        elif path.endswith(".py"):
+            yield path
+
+
+def lint_paths(
+    paths: Iterable[str], *, rules: Optional[Iterable[str]] = None
+) -> Report:
+    """Lint every ``.py`` under ``paths`` (files or directories); the
+    result becomes :func:`torcheval_tpu.analysis.last_report` for the
+    conftest failure-forensics hook.
+
+    A path that does not exist is an ERROR finding (``missing-path``),
+    not a silent no-op: a mistyped/renamed directory must fail the CI
+    gate loudly, never turn it green by linting nothing."""
+    _select_rules(rules)  # reject unknown ids even when no file matches
+    report = Report(tool="lint")
+    paths = list(paths)
+    for path in paths:
+        if not os.path.exists(path):
+            report.findings.append(
+                Finding(
+                    tool="lint",
+                    rule="missing-path",
+                    path=path,
+                    message=(
+                        "path does not exist — nothing here was linted "
+                        "(mistyped argument, renamed directory, or wrong "
+                        "working directory?)"
+                    ),
+                )
+            )
+        elif not os.path.isdir(path) and not path.endswith(".py"):
+            # Same loud-failure contract as missing-path: an explicitly
+            # named file the walker would skip must not read as linted.
+            report.findings.append(
+                Finding(
+                    tool="lint",
+                    rule="unlinted-path",
+                    path=path,
+                    message=(
+                        "explicitly-named file is not a .py file — it was "
+                        "not linted (pass the containing directory or a "
+                        "Python file)"
+                    ),
+                )
+            )
+    for path in _iter_py_files(paths):
+        report.extend(lint_file(path, rules=rules))
+    return set_last_report(report)

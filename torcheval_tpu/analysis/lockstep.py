@@ -1,0 +1,486 @@
+"""Collective lockstep checker: catch would-deadlock divergence statically.
+
+A multi-rank program deadlocks when any two ranks disagree about the next
+collective — a hazard Prime CCL (arXiv:2505.14065) detects *dynamically*
+with timeouts and lockstep heartbeats. Here we catch the same hazard
+classes *before* the job runs, by extracting each rank's ordered
+collective plan and diffing:
+
+- **rank-divergent programs** (:func:`verify_rank_lockstep`): trace the
+  per-rank program each member of a mesh/subgroup would run (builders are
+  parameterized by rank — the only way per-rank programs differ in this
+  library) and diff the ordered (primitive, axes) sequences. Any
+  divergence is a would-deadlock finding with the first diverging op's
+  jaxpr provenance.
+- **branch-dependent collectives** (:func:`check_program_lockstep`): a
+  collective under a ``lax.cond`` whose branches carry *different*
+  collective sequences deadlocks the moment the predicate differs across
+  ranks. Statically, a predicate cannot be proven rank-uniform, so
+  asymmetric branches are errors; a collective inside a ``while`` body is
+  a warning (the trip count must be rank-uniform — true for this
+  library's fixed-size loops, unprovable in general).
+- **eager call plans** (:func:`eager_sync_plan` +
+  :func:`check_eager_lockstep`): the host-side ``synclib``/toolkit sync
+  issues ``ProcessGroup`` collectives whose *sequence depends on the
+  metric states* (the payload gather is skipped when every rank's packed
+  payload is empty). Recording the plan against a
+  :class:`PlanRecordingGroup` — a loop-back group that never
+  communicates — and diffing across ranks turns the thread-local
+  in-flight-fence discipline (PR 2-3) into a statically checkable
+  contract: same metrics, same op sequence, every rank.
+
+All checks share :class:`~torcheval_tpu.analysis.report.Finding` records
+with the verifier and the lint, so one JSON report (and the conftest
+forensics hook) covers all three layers.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+from dataclasses import dataclass
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+import jax
+import numpy as np
+
+from torcheval_tpu.analysis.program import (
+    COLLECTIVE_PRIMITIVES,
+    _abstractize,
+    _eqn_provenance,
+    _sub_jaxprs,
+)
+from torcheval_tpu.analysis.report import Finding, Report, set_last_report
+
+__all__ = [
+    "CollectiveOp",
+    "PlanRecordingGroup",
+    "check_eager_lockstep",
+    "check_program_lockstep",
+    "collective_plan",
+    "eager_sync_plan",
+    "verify_rank_lockstep",
+]
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    """One collective in a program's ordered plan.
+
+    ``axes`` is the named mesh axis (or axis tuple) the op spans;
+    ``context`` is the control-flow path from the top level (e.g.
+    ``("cond[branch1]",)`` for an op inside a conditional arm);
+    ``provenance`` is the user source line the jaxpr records. Two ops
+    must agree on ``(name, axes)`` to rendezvous — ``context`` and
+    ``provenance`` are diagnostics, excluded from equality checks.
+    """
+
+    name: str
+    axes: Tuple[str, ...] = ()
+    context: Tuple[str, ...] = ()
+    provenance: str = ""
+
+    @property
+    def key(self) -> Tuple[str, Tuple[str, ...]]:
+        return (self.name, self.axes)
+
+    def format(self) -> str:
+        where = f" under {'/'.join(self.context)}" if self.context else ""
+        axes = f"[{','.join(self.axes)}]" if self.axes else ""
+        return f"{self.name}{axes}{where} ({self.provenance})"
+
+
+def _axes_of(eqn) -> Tuple[str, ...]:
+    """Named mesh axes an eqn's collective spans (param spelling varies
+    by primitive: psum/pmax/pmin use ``axes``, gather/permute forms use
+    ``axis_name``)."""
+    params = eqn.params
+    raw = params.get("axes", params.get("axis_name", ()))
+    if raw is None:
+        raw = ()
+    if not isinstance(raw, (tuple, list)):
+        raw = (raw,)
+    return tuple(str(a) for a in raw)
+
+
+def _iter_with_context(jaxpr, context: Tuple[str, ...] = ()):
+    """Program-order (eqn, control-flow-context) pairs, descending into
+    every sub-jaxpr. ``cond``/``while`` arms get labeled context entries
+    so hazards report *which* arm carries the divergent collective."""
+    for eqn in jaxpr.eqns:
+        yield eqn, context
+        pname = eqn.primitive.name
+        if pname == "cond":
+            for i, branch in enumerate(eqn.params["branches"]):
+                yield from _iter_with_context(
+                    branch.jaxpr, context + (f"cond[branch{i}]",)
+                )
+        elif pname == "while":
+            yield from _iter_with_context(
+                eqn.params["cond_jaxpr"].jaxpr, context + ("while[cond]",)
+            )
+            yield from _iter_with_context(
+                eqn.params["body_jaxpr"].jaxpr, context + ("while[body]",)
+            )
+        else:
+            label = {"scan": "scan[body]"}.get(pname)
+            for sub in _sub_jaxprs(eqn.params):
+                yield from _iter_with_context(
+                    sub, context + (label,) if label else context
+                )
+
+
+def _plan_of_jaxpr(jaxpr, context=()) -> Tuple[CollectiveOp, ...]:
+    ops = []
+    for eqn, ctx in _iter_with_context(jaxpr, context):
+        if eqn.primitive.name in COLLECTIVE_PRIMITIVES:
+            ops.append(
+                CollectiveOp(
+                    name=eqn.primitive.name,
+                    axes=_axes_of(eqn),
+                    context=ctx,
+                    provenance=_eqn_provenance(eqn),
+                )
+            )
+    return tuple(ops)
+
+
+def collective_plan(fn, *args: Any) -> Tuple[CollectiveOp, ...]:
+    """The ordered collective plan of one traceable program (jaxpr level,
+    nothing executes — concrete args are abstracted first)."""
+    closed = jax.make_jaxpr(fn)(*(_abstractize(a) for a in args))
+    return _plan_of_jaxpr(closed.jaxpr)
+
+
+# ------------------------------------------------- single-program hazards
+
+
+def _structural_hazards(jaxpr, label: str) -> List[Finding]:
+    """Structural lockstep hazards of one already-traced jaxpr (the
+    shared engine of :func:`check_program_lockstep` and
+    :func:`verify_rank_lockstep` — each program is traced exactly once)."""
+    findings: List[Finding] = []
+    for eqn, ctx in _iter_with_context(jaxpr):
+        pname = eqn.primitive.name
+        if pname == "cond":
+            branch_plans = [
+                tuple(op.key for op in _plan_of_jaxpr(b.jaxpr))
+                for b in eqn.params["branches"]
+            ]
+            if len(set(branch_plans)) > 1:
+                detail = "; ".join(
+                    f"branch{i}={list(p)}" for i, p in enumerate(branch_plans)
+                )
+                findings.append(
+                    Finding(
+                        tool="lockstep",
+                        rule="branch-dependent-collective",
+                        path=label,
+                        message=(
+                            f"cond at {_eqn_provenance(eqn)} has branches "
+                            f"with different collective sequences ({detail})"
+                            ": if the predicate ever differs across ranks, "
+                            "the ranks issue mismatched collectives and "
+                            "the job deadlocks"
+                        ),
+                    )
+                )
+        elif pname == "while":
+            body_ops = _plan_of_jaxpr(eqn.params["body_jaxpr"].jaxpr)
+            cond_ops = _plan_of_jaxpr(eqn.params["cond_jaxpr"].jaxpr)
+            for op in cond_ops + body_ops:
+                # Each collective is attributed to its INNERMOST enclosing
+                # while (reported when the walk reaches that eqn); skipping
+                # deeper-nested ops here keeps one hazard = one finding.
+                if any(c.startswith("while[") for c in op.context):
+                    continue
+                findings.append(
+                    Finding(
+                        tool="lockstep",
+                        rule="collective-in-loop",
+                        path=label,
+                        severity="warning",
+                        message=(
+                            f"collective {op.format()} inside a while at "
+                            f"{_eqn_provenance(eqn)}: the trip count must "
+                            "be identical on every rank or the collective "
+                            "counts diverge (would-deadlock)"
+                        ),
+                    )
+                )
+    return findings
+
+
+def check_program_lockstep(
+    fn, *args: Any, name: Optional[str] = None
+) -> Report:
+    """Structural lockstep hazards of ONE program: asymmetric-branch
+    collectives (error — the predicate cannot be proven rank-uniform)
+    and collectives under a ``while`` (warning — the trip count must be
+    rank-uniform)."""
+    label = name or getattr(fn, "__name__", None) or "<program>"
+    report = Report(tool="lockstep")
+    report.checked = 1
+    closed = jax.make_jaxpr(fn)(*(_abstractize(a) for a in args))
+    report.findings.extend(_structural_hazards(closed.jaxpr, label))
+    return set_last_report(report)
+
+
+# --------------------------------------------------- per-rank program diff
+
+
+def _diff_plans(
+    report: Report,
+    label: str,
+    rule: str,
+    plans: Mapping[Any, Sequence[Any]],
+    fmt: Callable[[Any], str],
+) -> None:
+    """Diff every member's ordered plan against the first member's; emit
+    one finding per diverging member at the first point of divergence."""
+    members = sorted(plans)
+    base_member = members[0]
+    base = list(plans[base_member])
+    for member in members[1:]:
+        plan = list(plans[member])
+        if [getattr(p, "key", p) for p in plan] == [
+            getattr(p, "key", p) for p in base
+        ]:
+            continue
+        # first index where the two plans disagree (or one runs out)
+        i = 0
+        while (
+            i < len(base)
+            and i < len(plan)
+            and getattr(base[i], "key", base[i])
+            == getattr(plan[i], "key", plan[i])
+        ):
+            i += 1
+        mine = fmt(plan[i]) if i < len(plan) else "<no further collectives>"
+        theirs = fmt(base[i]) if i < len(base) else "<no further collectives>"
+        report.findings.append(
+            Finding(
+                tool="lockstep",
+                rule=rule,
+                path=label,
+                message=(
+                    f"rank {member} diverges from rank {base_member} at "
+                    f"collective #{i}: {mine} vs {theirs} — mismatched "
+                    "collectives never rendezvous; the job deadlocks at "
+                    f"this op (full plans: rank {base_member}="
+                    f"{[fmt(p) for p in base]}, rank {member}="
+                    f"{[fmt(p) for p in plan]})"
+                ),
+            )
+        )
+
+
+def verify_rank_lockstep(
+    build_fn: Callable[[int], Callable],
+    ranks: Iterable[int],
+    *args: Any,
+    name: Optional[str] = None,
+    check_structure: bool = True,
+) -> Report:
+    """Trace ``build_fn(rank)`` for every member and diff the ordered
+    collective plans — the static form of "every rank must issue the
+    identical collective sequence".
+
+    ``build_fn`` returns the traceable program rank ``r`` would run
+    (SPMD programs are rank-independent by construction and trivially
+    pass; the hazard is rank-parameterized construction — leader-only
+    reductions, rank-gated branches). ``args`` may be concrete or
+    abstract; nothing executes. With ``check_structure`` each per-rank
+    program is also checked for the structural hazards of
+    :func:`check_program_lockstep`, from the same single trace per rank.
+    """
+    label = name or getattr(build_fn, "__name__", None) or "<program>"
+    report = Report(tool="lockstep")
+    plans: Dict[int, Tuple[CollectiveOp, ...]] = {}
+    abstract_args = tuple(_abstractize(a) for a in args)
+    for rank in ranks:
+        closed = jax.make_jaxpr(build_fn(rank))(*abstract_args)
+        plans[rank] = _plan_of_jaxpr(closed.jaxpr)
+        report.checked += 1
+        if check_structure:
+            report.findings.extend(
+                _structural_hazards(closed.jaxpr, f"{label}[rank {rank}]")
+            )
+    if plans:
+        _diff_plans(
+            report,
+            label,
+            "rank-divergent-collective",
+            plans,
+            lambda op: op.format(),
+        )
+    return set_last_report(report)
+
+
+# ------------------------------------------------------- eager call plans
+
+
+class PlanRecordingGroup:
+    """A loop-back :class:`~torcheval_tpu.distributed.ProcessGroup` that
+    RECORDS the collective call plan instead of communicating.
+
+    Every gather returns ``world_size`` copies of the local payload, so
+    the sync protocol runs to completion in-process — a dry run of the
+    eager plan, no wire, no peers. ``calls`` is the ordered op-name
+    sequence (with LOCAL payload byte sizes for array gathers —
+    forensics only; :func:`check_eager_lockstep` strips them before
+    diffing, since the padded protocol makes fill level rank-local) the dry run
+    issued — what a real group would be asked to perform *given this
+    rank's local view* (globally-coordinated decisions, e.g. the
+    all-ranks-empty payload skip, can differ; see
+    :func:`eager_sync_plan`).
+    """
+
+    def __init__(self, world_size: int = 2, rank: int = 0):
+        self._world = int(world_size)
+        self._rank = int(rank)
+        self.calls: List[str] = []
+
+    # --- ProcessGroup surface (duck-typed; synclib dispatches on unwrap)
+
+    @property
+    def world_size(self) -> int:
+        return self._world
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def is_member(self) -> bool:
+        return True
+
+    @property
+    def ranks(self) -> Tuple[int, ...]:
+        return tuple(range(self._world))
+
+    def unwrap(self):
+        return self
+
+    def allgather_object(self, obj: Any) -> List[Any]:
+        self.calls.append("allgather_object")
+        return [copy.deepcopy(obj) for _ in range(self._world)]
+
+    def allgather_array(self, x: Any) -> List[np.ndarray]:
+        arr = np.asarray(x)
+        self.calls.append(f"allgather_array[{arr.nbytes}B]")
+        return [arr.copy() for _ in range(self._world)]
+
+    def allgather_object_with_ranks(self, obj: Any):
+        return self.allgather_object(obj), list(range(self._world))
+
+    def allgather_array_with_ranks(self, x: Any):
+        return self.allgather_array(x), list(range(self._world))
+
+
+def _array_leaves(value: Any):
+    if isinstance(value, dict):
+        for v in value.values():
+            yield from _array_leaves(v)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _array_leaves(v)
+    elif hasattr(value, "shape") and hasattr(value, "dtype"):
+        yield value
+
+
+def eager_sync_plan(
+    metrics: Mapping[str, Any],
+    *,
+    world_size: int = 2,
+    rank: int = 0,
+) -> Tuple[str, ...]:
+    """The ordered ``ProcessGroup`` op sequence one rank's eager
+    collection sync would issue for ``metrics`` (``{name: Metric}``):
+    one metadata ``allgather_object`` — annotated with the state
+    traversal order, the framing every rank must agree on — plus one
+    payload ``allgather_array`` when the collection carries any
+    array-valued state.
+
+    The protocol is dry-run to completion against a
+    :class:`PlanRecordingGroup` (no wire, no peers; metrics are
+    deep-copied so buffered states are not consumed), but the returned
+    plan is computed from the collection's STRUCTURE, not this rank's
+    fill level: the real protocol pads payloads to the global max and
+    skips the payload gather only by *global* agreement, so local byte
+    counts must not (and here cannot) fake a divergence.
+
+    One deliberate over-approximation follows: when EVERY rank's packed
+    payload is empty (e.g. a collection of buffered metrics synced
+    before any update), the real protocol skips the payload gather by
+    that same global agreement, while this plan still lists it. The
+    skip is rank-uniform by construction — the decision rides the
+    metadata every rank just exchanged — so it can never deadlock and
+    never produces a divergence finding; the plan simply errs on the
+    side of listing every op the structure can require."""
+    from torcheval_tpu.metrics import synclib
+
+    group = PlanRecordingGroup(world_size=world_size, rank=rank)
+    states = {
+        name: copy.deepcopy(m)._sync_state_dict()
+        for name, m in metrics.items()
+    }
+    order = synclib.metrics_traversal_order(states)
+    synclib.sync_states(states, group)  # dry run: the protocol must work
+    plan = [
+        "allgather_object["
+        + ",".join(f"{m}.{s}" for m, s in order)
+        + "]"
+    ]
+    if any(
+        True
+        for m, s in order
+        for _ in _array_leaves(states[m][s])
+    ):
+        plan.append("allgather_array")
+    return tuple(plan)
+
+
+# PlanRecordingGroup annotates array gathers with the LOCAL payload byte
+# count (useful forensics); the real protocol pads payloads to the global
+# max, so local sizes must be ignored when diffing or two ranks that
+# differ only in fill level would read as divergent.
+_LOCAL_SIZE = re.compile(r"\[\d+B\]")
+
+
+def check_eager_lockstep(
+    plans: Mapping[int, Sequence[str]], *, name: str = "<eager sync>"
+) -> Report:
+    """Diff per-rank eager call plans (from :func:`eager_sync_plan`, or
+    hand-recorded via :class:`PlanRecordingGroup`). Any divergence —
+    op kind or payload framing — is a would-deadlock finding: the ranks
+    would issue mismatched (or differently-counted) group collectives.
+
+    Local payload byte-size annotations (``allgather_array[40B]``) are
+    stripped before comparison: the padded protocol makes fill level a
+    per-rank free variable, never a lockstep hazard (the same
+    normalization :func:`eager_sync_plan` gets by construction)."""
+    report = Report(tool="lockstep")
+    report.checked = len(plans)
+    if plans:
+        _diff_plans(
+            report,
+            name,
+            "eager-plan-divergence",
+            {
+                r: [_LOCAL_SIZE.sub("", str(op)) for op in p]
+                for r, p in plans.items()
+            },
+            str,
+        )
+    return set_last_report(report)

@@ -1,0 +1,797 @@
+"""Metric-program verifier: static checks at the jaxpr/HLO layer.
+
+Traces a function (or a metric's fused update plan) with abstract inputs —
+``jax.make_jaxpr`` for the primitive-level view, ``jax.jit(...).lower()``
+plus a fully-optimized compile for the XLA view — and checks the library's
+core contracts WITHOUT executing a step:
+
+- **no host escapes**: no ``pure_callback`` / ``io_callback`` /
+  ``debug_callback`` primitives on the update path (the transfer-guard
+  tests catch runtime transfers; this catches the callback class those
+  guards cannot see until the callback actually fires);
+- **collective census**: the count AND ordered opcode sequence of
+  collectives, checked against declared expectations — the
+  zero-added-collectives north star becomes a one-line assertion
+  (``expect_collectives=0`` for local update programs,
+  :func:`compare_collective_sequences` for full synced steps);
+- **donation soundness**: every donated invar must appear in the
+  compiled module's ``input_output_alias`` (jax only warns), and — at
+  the call layer — no donated buffer may be passed twice or also appear
+  in a non-donated position (the read-after-consume bug class PR 6's
+  reviews caught by hand, now checked by
+  :func:`check_donation_aliasing`);
+- **dtype safety**: 64-bit avals (accidental f64/i64 promotion that
+  changes numerics between x64-enabled and -disabled runs) and silent
+  64→32-bit narrowing casts (the int64 wire downcast class fixed in
+  PR 2). The int32 id-arithmetic wrap funnel is handled constructively
+  by ``ops.segment.safe_ids``; this rule guards the promotion/narrowing
+  class around it.
+
+The runtime pins that predate this module (transfer-guard no-host-sync,
+donation pointer stability) are kept available here as
+``assert_update_transfer_free`` / ``assert_donated_update_in_place`` so
+the legacy tier-1 tests are thin wrappers over one API.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+from dataclasses import dataclass, field
+from typing import (
+    Any,
+    Dict,
+    Iterable,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    Union,
+)
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.analysis.report import Finding, Report, set_last_report
+from torcheval_tpu.utils import hlo as hlo_utils
+
+__all__ = [
+    "ProgramReport",
+    "assert_donated_update_in_place",
+    "assert_update_transfer_free",
+    "check_donation_aliasing",
+    "compare_collective_sequences",
+    "verify_metric_compute",
+    "verify_metric_merge",
+    "verify_metric_update",
+    "verify_program",
+]
+
+# jaxpr-level cross-replica collective primitives (the lax.p* family and
+# the gather/scatter forms sync_states_in_jit can emit). ``psum2`` is the
+# spelling shard_map's replication-rewrite emits on jax 0.4.37+.
+# Deliberately NOT listed: ``pbroadcast`` — the rewrite inserts it as a
+# device-local replication cast that lowers to no communication, so
+# counting it would fake collective divergence between programs that
+# differ only in replication bookkeeping.
+COLLECTIVE_PRIMITIVES = frozenset(
+    {
+        "psum",
+        "psum2",
+        "pmax",
+        "pmin",
+        "ppermute",
+        "all_gather",
+        "all_gather_invariant",
+        "all_to_all",
+        "pgather",
+        "psum_scatter",
+        "reduce_scatter",
+    }
+)
+
+# 64-bit-PRECISION dtypes — the ones whose numerics change between
+# x64-enabled and -disabled runs. Matched by name, not itemsize: complex64
+# is 8 bytes but 32-bit precision (no x64 hazard), while complex128 is the
+# 16-byte one an itemsize==8 test would miss.
+_64BIT_DTYPES = frozenset({"int64", "uint64", "float64", "complex128"})
+_32BIT_DTYPES = frozenset({"int32", "uint32", "float32", "complex64"})
+
+# Host-escape primitives: anything lowering to a host callback. Matched by
+# exact name or the "callback" substring so new jax spellings fail closed.
+_HOST_ESCAPE_EXACT = frozenset({"debug_print", "host_local_array_to_global"})
+
+
+def _is_host_escape(prim_name: str) -> bool:
+    return "callback" in prim_name or prim_name in _HOST_ESCAPE_EXACT
+
+
+def _eqn_provenance(eqn) -> str:
+    try:
+        from jax._src import source_info_util
+
+        return source_info_util.summarize(eqn.source_info)
+    except Exception:  # pragma: no cover - jax-internal API drift
+        return "<unknown>"
+
+
+try:
+    # The stable home since jax 0.4.35; the jax.core spellings were
+    # removed from the public namespace in jax >= 0.6, which pyproject's
+    # jax>=0.9 floor installs in CI.
+    from jax.extend.core import ClosedJaxpr as _ClosedJaxpr
+    from jax.extend.core import Jaxpr as _Jaxpr
+except ImportError:  # pragma: no cover - pre-jax.extend.core releases
+    from jax.core import ClosedJaxpr as _ClosedJaxpr
+    from jax.core import Jaxpr as _Jaxpr
+
+
+def _sub_jaxprs(params: Dict[str, Any]):
+    """Every sub-jaxpr reachable from one eqn's params (cond branches,
+    while cond/body, scan/jit bodies, custom_* calls)."""
+    for value in params.values():
+        if isinstance(value, _ClosedJaxpr):
+            yield value.jaxpr
+        elif isinstance(value, _Jaxpr):
+            yield value
+        elif isinstance(value, (tuple, list)):
+            for item in value:
+                if isinstance(item, _ClosedJaxpr):
+                    yield item.jaxpr
+                elif isinstance(item, _Jaxpr):
+                    yield item
+
+
+def iter_eqns(jaxpr):
+    """Depth-first, program-order traversal of a jaxpr and every
+    sub-jaxpr (shared by the verifier and the lockstep checker)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def _abstractize(x: Any) -> Any:
+    """Concrete array leaves -> ShapeDtypeStruct (verification must not
+    depend on values, and must not upload anything)."""
+
+    def leaf(v):
+        if isinstance(v, (jax.Array,)) or type(v).__module__ == "numpy":
+            arr = jnp.shape(v), jnp.result_type(v)
+            return jax.ShapeDtypeStruct(arr[0], arr[1])
+        return v
+
+    return jax.tree_util.tree_map(leaf, x)
+
+
+@dataclass
+class ProgramReport(Report):
+    """A :class:`Report` plus the traced program's census, for callers
+    that assert on structure directly."""
+
+    name: str = "<program>"
+    collectives: Tuple[str, ...] = ()  # jaxpr primitive names, in order
+    hlo_collectives: Tuple[str, ...] = ()  # optimized-HLO opcodes, in order
+    host_escapes: Tuple[str, ...] = ()
+    donated_params: Tuple[int, ...] = ()
+    aliased_params: Tuple[int, ...] = ()
+    jaxpr_text: str = ""
+
+    def __post_init__(self):
+        self.tool = "program"
+
+    def as_dict(self) -> Dict[str, Any]:
+        out = super().as_dict()
+        out.update(
+            name=self.name,
+            collectives=list(self.collectives),
+            hlo_collectives=list(self.hlo_collectives),
+            host_escapes=list(self.host_escapes),
+            donated_params=list(self.donated_params),
+            aliased_params=list(self.aliased_params),
+        )
+        return out
+
+
+def _finding(report: ProgramReport, rule: str, message: str, **kw) -> None:
+    report.findings.append(
+        Finding(
+            tool="program", rule=rule, path=report.name, message=message, **kw
+        )
+    )
+
+
+# One alias entry of the module header's input_output_alias table, e.g.
+# `{0}: (0, {}, may-alias)` — param number captured. The table nests
+# braces (`input_output_alias={ {0}: (0, {}, may-alias), ... }`), so the
+# pairs are matched directly off the header line rather than trying to
+# regex-delimit the block.
+_ALIAS_PAIR = re.compile(
+    r"\(\s*(\d+)\s*,\s*\{[^{}]*\}\s*,\s*(?:may|must)[-_]alias\s*\)"
+)
+
+
+def _aliased_param_numbers(hlo_text: str) -> Tuple[int, ...]:
+    for line in hlo_text.splitlines():
+        if "input_output_alias=" in line:
+            seg = line.split("input_output_alias=", 1)[1]
+            return tuple(
+                sorted({int(p) for p in _ALIAS_PAIR.findall(seg)})
+            )
+    return ()
+
+
+def _donated_flat_indices(
+    args: Sequence[Any], donate_argnums: Sequence[int]
+) -> Tuple[int, ...]:
+    """Flat parameter indices (jit flattening order) of the donated
+    top-level arguments."""
+    donated: List[int] = []
+    offset = 0
+    for i, arg in enumerate(args):
+        leaves = jax.tree_util.tree_leaves(arg)
+        n = len(leaves)
+        if i in donate_argnums:
+            donated.extend(range(offset, offset + n))
+        offset += n
+    return tuple(donated)
+
+
+def verify_program(
+    fn,
+    *args: Any,
+    name: Optional[str] = None,
+    donate_argnums: Sequence[int] = (),
+    expect_collectives: Optional[Union[int, Sequence[str]]] = None,
+    expect_hlo_collectives: Optional[Union[int, Sequence[str]]] = None,
+    allow_host_escapes: bool = False,
+    check_dtypes: bool = True,
+    compile_hlo: bool = True,
+) -> ProgramReport:
+    """Statically verify one traceable program against the rule set.
+
+    ``args`` may be concrete arrays or ``ShapeDtypeStruct``s — concrete
+    leaves are abstracted before tracing, so nothing executes. With
+    ``donate_argnums``, donation soundness is checked on the OPTIMIZED
+    module's ``input_output_alias`` table. ``expect_collectives`` pins
+    the jaxpr-level census (an int pins the count, a sequence pins the
+    ordered primitive names); ``expect_hlo_collectives`` does the same
+    for optimized-HLO opcodes (``utils.hlo.collective_sequence``).
+    """
+    label = name or getattr(fn, "__name__", None) or "<program>"
+    report = ProgramReport(tool="program", name=label, checked=1)
+    abstract_args = tuple(_abstractize(a) for a in args)
+
+    closed = jax.make_jaxpr(fn)(*abstract_args)
+    report.jaxpr_text = str(closed)
+
+    collectives: List[str] = []
+    escapes: List[str] = []
+    for eqn in iter_eqns(closed.jaxpr):
+        pname = eqn.primitive.name
+        if pname in COLLECTIVE_PRIMITIVES:
+            collectives.append(pname)
+        if _is_host_escape(pname):
+            escapes.append(pname)
+            if not allow_host_escapes:
+                _finding(
+                    report,
+                    "host-callback",
+                    f"host escape `{pname}` in the traced program at "
+                    f"{_eqn_provenance(eqn)} — callbacks force a host "
+                    "round trip per step and break the async dispatch "
+                    "contract",
+                )
+        if check_dtypes:
+            for var in tuple(eqn.invars) + tuple(eqn.outvars):
+                aval = getattr(var, "aval", None)
+                dtype = getattr(aval, "dtype", None)
+                if dtype is not None and jnp.dtype(dtype).name in _64BIT_DTYPES:
+                    _finding(
+                        report,
+                        "dtype-64bit",
+                        f"64-bit value ({jnp.dtype(dtype).name}) flows "
+                        f"through `{pname}` at {_eqn_provenance(eqn)}: "
+                        "numerics silently change between x64-enabled "
+                        "and -disabled runs",
+                    )
+                    break  # one finding per eqn is enough
+            if eqn.primitive.name == "convert_element_type":
+                src = getattr(eqn.invars[0], "aval", None)
+                dst = eqn.params.get("new_dtype")
+                if (
+                    src is not None
+                    and dst is not None
+                    and jnp.dtype(src.dtype).name in _64BIT_DTYPES
+                    and jnp.dtype(dst).name in _32BIT_DTYPES
+                ):
+                    _finding(
+                        report,
+                        "dtype-narrowing",
+                        f"silent 64->32-bit cast "
+                        f"({jnp.dtype(src.dtype).name} -> "
+                        f"{jnp.dtype(dst).name}) at "
+                        f"{_eqn_provenance(eqn)}: the wire-downcast bug "
+                        "class — make the narrowing explicit and "
+                        "range-checked (see distributed.encode_length)",
+                    )
+    report.collectives = tuple(collectives)
+    report.host_escapes = tuple(escapes)
+
+    if expect_collectives is not None:
+        _check_census(
+            report, "collective-census", report.collectives, expect_collectives
+        )
+
+    if compile_hlo:
+        jitted = jax.jit(fn, donate_argnums=tuple(donate_argnums))
+        compiled = hlo_utils.compile_fully_optimized(
+            jitted.lower(*abstract_args)
+        )
+        hlo_text = compiled.as_text()
+        report.hlo_collectives = hlo_utils.collective_sequence(hlo_text)
+        if expect_hlo_collectives is not None:
+            _check_census(
+                report,
+                "collective-census",
+                report.hlo_collectives,
+                expect_hlo_collectives,
+            )
+        if donate_argnums:
+            report.donated_params = _donated_flat_indices(
+                abstract_args, tuple(donate_argnums)
+            )
+            report.aliased_params = _aliased_param_numbers(hlo_text)
+            missing = sorted(
+                set(report.donated_params) - set(report.aliased_params)
+            )
+            if missing:
+                flat = [
+                    leaf
+                    for a in abstract_args
+                    for leaf in jax.tree_util.tree_leaves(a)
+                ]
+                # the zero-realloc contract is about BUFFERS; a 0-d
+                # scalar XLA chose not to alias (e.g. a derived state the
+                # kernel recomputes instead of reads) costs nothing per
+                # step — reported, but as an auditable warning
+                buffers = [i for i in missing if getattr(flat[i], "shape", ())]
+                scalars = [i for i in missing if i not in buffers]
+                if buffers:
+                    _finding(
+                        report,
+                        "donated-not-aliased",
+                        f"donated parameter(s) {buffers} missing from the "
+                        "compiled module's input_output_alias: XLA could "
+                        "not reuse the donated buffer (jax only warns) — "
+                        "the zero-realloc contract silently does not hold",
+                    )
+                if scalars:
+                    _finding(
+                        report,
+                        "donated-not-aliased",
+                        f"donated 0-d scalar parameter(s) {scalars} not "
+                        "aliased in the optimized module (reallocating a "
+                        "scalar is free; flagged for audit only)",
+                        severity="warning",
+                    )
+    return set_last_report(report)
+
+
+def _check_census(
+    report: ProgramReport,
+    rule: str,
+    got: Tuple[str, ...],
+    expect: Union[int, Sequence[str]],
+) -> None:
+    if isinstance(expect, int):
+        if len(got) != expect:
+            _finding(
+                report,
+                rule,
+                f"expected {expect} collective(s), found {len(got)}: "
+                f"{list(got)}",
+            )
+    elif tuple(got) != tuple(expect):
+        _finding(
+            report,
+            rule,
+            f"collective sequence {list(got)} != declared expectation "
+            f"{list(expect)} (order matters: reordering breaks rank "
+            "lockstep even at equal counts)",
+        )
+
+
+# -------------------------------------------------- donation (call layer)
+
+
+def _buffer_key(leaf: Any):
+    if isinstance(leaf, jax.Array):
+        try:
+            return ("ptr", leaf.unsafe_buffer_pointer())
+        except Exception:  # sharded/committed arrays: fall back to identity
+            return ("id", id(leaf))
+    return None
+
+
+def check_donation_aliasing(
+    args: Sequence[Any],
+    donate_argnums: Sequence[int],
+    *,
+    name: str = "<call>",
+) -> Report:
+    """Call-layer donation soundness for one concrete call: no donated
+    buffer may appear twice among the donated leaves (XLA would write
+    one output over another's input), and no donated buffer may ALSO be
+    passed in a non-donated position (it would be read after the donated
+    alias consumed it) — PR 6's hand-caught review bug class as a check.
+    """
+    report = Report(tool="program")
+    report.checked = 1
+    donate = set(donate_argnums)
+    seen_donated: Dict[Any, str] = {}
+    plain: Dict[Any, str] = {}
+    for i, arg in enumerate(args):
+        for j, leaf in enumerate(jax.tree_util.tree_leaves(arg)):
+            key = _buffer_key(leaf)
+            if key is None:
+                continue
+            where = f"arg {i} leaf {j}"
+            if i in donate:
+                if key in seen_donated:
+                    report.findings.append(
+                        Finding(
+                            tool="program",
+                            rule="donated-twice",
+                            path=name,
+                            message=(
+                                f"the same buffer is donated at "
+                                f"{seen_donated[key]} and {where}: XLA "
+                                "aliases both outputs onto one buffer — "
+                                "one result silently overwrites the other"
+                            ),
+                        )
+                    )
+                seen_donated[key] = where
+            else:
+                plain[key] = where
+    for key, where in seen_donated.items():
+        if key in plain:
+            report.findings.append(
+                Finding(
+                    tool="program",
+                    rule="donated-also-read",
+                    path=name,
+                    message=(
+                        f"buffer donated at {where} is also passed "
+                        f"un-donated at {plain[key]}: it will be read "
+                        "after the donated alias consumed it"
+                    ),
+                )
+            )
+    return report
+
+
+# ------------------------------------------------------- metric verifiers
+
+
+def _normalized_plan(metric, *args):
+    """(kernel, state_names, dynamic, config, transform, plan-or-None);
+    the trailing entry is the raw :class:`UpdatePlan` when the metric
+    declares one (so the caller can reach ``masked_kernel``)."""
+    from torcheval_tpu.metrics.metric import UpdatePlan
+
+    plan = metric._update_plan(*args)
+    if plan is None:
+        return None
+    if isinstance(plan, UpdatePlan):
+        return (
+            plan.kernel,
+            plan.state_names,
+            plan.dynamic,
+            plan.config,
+            plan.transform,
+            plan,
+        )
+    kernel, state_names, dynamic, *rest = plan
+    return kernel, state_names, dynamic, (rest[0] if rest else ()), False, None
+
+
+def _abstract_bucketed_dynamic(plan) -> Tuple[Any, ...]:
+    """The masked-kernel variant's abstract argument avals: every batch
+    axis padded to its power-of-two bucket, plus the int32 valid-extent
+    vector. Mirrors the SHAPE logic of ``_bucket.apply_bucketing`` (the
+    dispatch that actually runs under ``config.shape_bucketing()``) at
+    the aval level, so the verifier covers the bucketed program without
+    touching the knob, the device, or concrete padding."""
+    from torcheval_tpu.metrics import _bucket
+
+    sizes: Dict[str, int] = {}
+    order: List[str] = []
+    for arg, labels in zip(plan.dynamic, plan.batch_axes):
+        for axis, label in enumerate(labels or ()):
+            n = int(jnp.shape(arg)[axis])
+            if label not in sizes:
+                sizes[label] = n
+                order.append(label)
+    buckets = {label: _bucket.bucket_length(n) for label, n in sizes.items()}
+    padded = []
+    for arg, labels in zip(plan.dynamic, plan.batch_axes):
+        shape = list(jnp.shape(arg))
+        for axis, label in enumerate(labels or ()):
+            shape[axis] = buckets[label]
+        padded.append(
+            jax.ShapeDtypeStruct(tuple(shape), jnp.result_type(arg))
+        )
+    return tuple(padded) + (jax.ShapeDtypeStruct((len(order),), jnp.int32),)
+
+
+def verify_metric_update(
+    metric,
+    *args: Any,
+    donate: Optional[bool] = None,
+    expect_collectives: Union[int, Sequence[str]] = 0,
+) -> Optional[ProgramReport]:
+    """Statically verify a metric's fused update program: no host
+    escapes, zero collectives (a LOCAL update must never sync), dtype
+    safety, and — by default, regardless of the process donation knob —
+    donation soundness of the donated program variant plus call-layer
+    aliasing of the metric's live states. Returns ``None`` for metrics
+    whose update has no fusable plan (host-side text metrics, buffered
+    appends — their donated-append discipline is pinned by
+    tests/metrics/test_buffers.py)."""
+    from torcheval_tpu.metrics import _fuse
+
+    normalized = _normalized_plan(metric, *args)
+    if normalized is None:
+        return None
+    kernel, state_names, dynamic, config, transform, plan = normalized
+    states = tuple(getattr(metric, n) for n in state_names)
+    if donate is None:
+        donate = metric._donated_update
+
+    def _fused(use_kernel):
+        if transform:
+
+            def fused(states, *dyn):
+                return _fuse._apply_transform(use_kernel, config, states, dyn)
+
+        else:
+
+            def fused(states, *dyn):
+                return _fuse._apply_kernel(use_kernel, config, states, dyn)
+
+        return fused
+
+    report = verify_program(
+        _fused(kernel),
+        states,
+        *dynamic,
+        name=f"{type(metric).__name__}.update",
+        donate_argnums=(0,) if donate else (),
+        expect_collectives=expect_collectives,
+    )
+    if plan is not None and plan.masked_kernel is not None and plan.batch_axes:
+        # under config.shape_bucketing() the metric dispatches the MASKED
+        # kernel over padded buckets — verify that program too (same
+        # contracts), regardless of the process knob: certifying only the
+        # unbucketed twin would bless a program production never runs
+        report.extend(
+            verify_program(
+                _fused(plan.masked_kernel),
+                states,
+                *_abstract_bucketed_dynamic(plan),
+                name=f"{type(metric).__name__}.update[bucketed]",
+                donate_argnums=(0,) if donate else (),
+                expect_collectives=expect_collectives,
+            )
+        )
+    if donate:
+        call_report = check_donation_aliasing(
+            (states,) + tuple(dynamic),
+            (0,),
+            name=report.name,
+        )
+        report.extend(call_report)
+    return set_last_report(report)
+
+
+def _abstract_states(metric) -> Dict[str, Any]:
+    """Array-valued states as abstract leaves (int/float states stay
+    concrete host scalars — they are not device state)."""
+    out = {}
+    for sname in metric._state_name_to_default:
+        value = getattr(metric, sname)
+        if isinstance(value, (jax.Array, list, dict)):
+            out[sname] = _abstractize(
+                list(value) if isinstance(value, list) else
+                dict(value) if isinstance(value, dict) else value
+            )
+    return out
+
+
+def verify_metric_compute(metric) -> ProgramReport:
+    """Statically trace ``compute()`` over abstract states. A compute
+    that CONCRETIZES device state (``float(arr)``, ``if arr:``) fails to
+    trace — reported as a ``compute-host-sync`` warning (compute is
+    host-side finalization, off the hot path, so this is informational
+    by house rules — the hard no-host-sync contract binds ``update``)."""
+    clone = copy.deepcopy(metric)
+    names = sorted(_abstract_states(clone))
+
+    def run(state_values):
+        for sname, value in zip(names, state_values):
+            setattr(clone, sname, value)
+        return clone.compute()
+
+    abstract = tuple(_abstract_states(clone)[n] for n in names)
+    name = f"{type(metric).__name__}.compute"
+    try:
+        report = verify_program(
+            run, abstract, name=name, expect_collectives=0, compile_hlo=False
+        )
+    except (
+        jax.errors.ConcretizationTypeError,
+        jax.errors.TracerArrayConversionError,
+        jax.errors.TracerBoolConversionError,
+    ) as exc:
+        report = ProgramReport(tool="program", name=name, checked=1)
+        first_line = str(exc).strip().splitlines()[0]
+        _finding(
+            report,
+            "compute-host-sync",
+            f"compute() reads device values on the host ({first_line})",
+            severity="warning",
+        )
+        report = set_last_report(report)
+    except RuntimeError as exc:
+        # ONLY the buffered no-data precondition (_buffer.py: "has no
+        # data: call update() before compute()") is a non-verdict —
+        # callers wanting a real trace should update once first. Any
+        # other RuntimeError is a genuine compute() defect and must not
+        # be downgraded to a warning the CI gate would wave through.
+        if "call update() before" not in str(exc):
+            raise
+        report = ProgramReport(tool="program", name=name, checked=1)
+        _finding(
+            report,
+            "compute-untraceable",
+            f"compute() not traceable on this instance ({exc}); update "
+            "the metric once before verifying compute",
+            severity="warning",
+        )
+        report = set_last_report(report)
+    return report
+
+
+def verify_metric_merge(metric) -> ProgramReport:
+    """Statically trace the declarative ``merge_state`` program (two
+    abstract replicas): no host escapes, no collectives (merge itself is
+    local math — collectives belong to the sync transport), dtype-safe."""
+    mine = copy.deepcopy(metric)
+    theirs = copy.deepcopy(metric)
+    names = sorted(_abstract_states(mine))
+
+    def run(mine_states, theirs_states):
+        for sname, value in zip(names, mine_states):
+            setattr(mine, sname, value)
+        for sname, value in zip(names, theirs_states):
+            setattr(theirs, sname, value)
+        mine.merge_state([theirs])
+        return tuple(getattr(mine, sname) for sname in names)
+
+    abstract = tuple(_abstract_states(mine)[n] for n in names)
+    return verify_program(
+        run,
+        abstract,
+        abstract,
+        name=f"{type(metric).__name__}.merge_state",
+        expect_collectives=0,
+        compile_hlo=False,
+    )
+
+
+# --------------------------------------------- zero-added-collectives diff
+
+
+def compare_collective_sequences(
+    baseline_fn,
+    baseline_args: Sequence[Any],
+    synced_fn,
+    synced_args: Sequence[Any],
+    *,
+    name: str = "<step>",
+    allow_added: Union[int, Sequence[str]] = 0,
+) -> ProgramReport:
+    """Compile both step programs fully optimized and diff their ordered
+    HLO collective sequences — the zero-added-collectives property as
+    one call. ``allow_added`` relaxes the pin where an addition is the
+    declared cost (e.g. one ``all-gather`` for an EXTEND state): an int
+    bounds the number of added ops, a sequence pins exactly which
+    opcodes may be added (as a multiset)."""
+    base = hlo_utils.collective_sequence(
+        hlo_utils.compile_fully_optimized(
+            jax.jit(baseline_fn).lower(*map(_abstractize, baseline_args))
+        )
+    )
+    synced = hlo_utils.collective_sequence(
+        hlo_utils.compile_fully_optimized(
+            jax.jit(synced_fn).lower(*map(_abstractize, synced_args))
+        )
+    )
+    report = ProgramReport(tool="program", name=name, checked=2)
+    report.hlo_collectives = synced
+    added = list(synced)
+    for op in base:
+        if op in added:
+            added.remove(op)
+    if isinstance(allow_added, int):
+        over_budget = len(added) > allow_added
+    else:
+        budget = list(allow_added)
+        extra = list(added)
+        for op in budget:
+            if op in extra:
+                extra.remove(op)
+        over_budget = bool(extra)
+    if over_budget:
+        _finding(
+            report,
+            "added-collectives",
+            f"synced step collectives {list(synced)} vs baseline "
+            f"{list(base)}: added {added} exceeds the declared budget "
+            f"{allow_added!r} — the metric sync no longer rides the "
+            "step's existing collectives",
+        )
+    return set_last_report(report)
+
+
+# --------------------------------------------------- runtime pin wrappers
+
+
+def assert_update_transfer_free(metric, args: Sequence[Any], *, warm: int = 6):
+    """RUNTIME pin (legacy tier-1 wrapper): after ``warm`` settling
+    updates, one more ``update(*args)`` must execute under
+    ``jax.transfer_guard("disallow")`` — the dynamic counterpart of
+    :func:`verify_metric_update`'s static host-escape check."""
+    for _ in range(warm):
+        metric.update(*args)
+    with jax.transfer_guard("disallow"):
+        metric.update(*args)
+    return metric
+
+
+def assert_donated_update_in_place(
+    metric,
+    args: Sequence[Any],
+    state_name: str,
+    *,
+    warm: int = 3,
+    steps: int = 1,
+):
+    """RUNTIME pin (legacy tier-1 wrapper): with donation enabled, after
+    ``warm`` settling updates every one of ``steps`` further updates must
+    reuse ``state_name``'s buffer in place (zero realloc), and the final
+    one must also be transfer-free."""
+    from torcheval_tpu import config
+
+    def _ptr():
+        return getattr(metric, state_name).unsafe_buffer_pointer()
+
+    with config.update_donation(True):
+        for _ in range(warm):
+            metric.update(*args)
+        ptr = _ptr()
+        for _ in range(max(steps - 1, 0)):
+            metric.update(*args)
+            assert _ptr() == ptr, (
+                f"{type(metric).__name__}.{state_name} was reallocated by "
+                "a donated update (zero-realloc contract)"
+            )
+        with jax.transfer_guard("disallow"):
+            metric.update(*args)
+        assert _ptr() == ptr, (
+            f"{type(metric).__name__}.{state_name} was reallocated by a "
+            "donated update (zero-realloc contract)"
+        )
+    return metric

@@ -45,7 +45,10 @@ from contextlib import contextmanager
 from typing import Iterator, Optional
 
 # Accepted spellings for boolean env knobs, shared by every
-# TORCHEVAL_TPU_* flag (here, ops.native, obs.recorder).
+# TORCHEVAL_TPU_* flag (here, ops.native, obs.recorder). The `env-truthy`
+# lint rule (torcheval_tpu/analysis/lint.py) forbids inline copies of
+# these tuples elsewhere; its jax-free mirror of the spellings is
+# drift-guarded against this file by tests/analysis/test_lint.py.
 _TRUTHY = ("1", "true", "yes", "on")
 _FALSY = ("0", "false", "no", "off")
 

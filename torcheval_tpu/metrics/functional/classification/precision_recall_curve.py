@@ -53,7 +53,7 @@ def _binary_precision_recall_curve_compute(
     dropped host-side before compaction."""
     # one batched device->host readback (4 separate np.asarray pulls cost
     # 4 synchronous round trips on remote TPUs)
-    precision, recall, threshold, is_end = jax.device_get(
+    precision, recall, threshold, is_end = jax.device_get(  # tev: disable=host-sync -- curve COMPUTE finalization: one deliberate batched readback (comment above), off the update path
         _prc_arrays_jit(input, target)
     )
     if valid_count is not None:
@@ -169,7 +169,7 @@ def _multiclass_precision_recall_curve_compute(
     valid_count: Optional[int] = None,
 ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
     p_full, r_full, t_full, end_full = (
-        jax.device_get(_multiclass_prc_full_jit(input, target))
+        jax.device_get(_multiclass_prc_full_jit(input, target))  # tev: disable=host-sync -- curve COMPUTE finalization: one deliberate batched readback, off the update path
     )
     if valid_count is not None:
         pad = p_full.shape[-1] - valid_count
@@ -241,7 +241,7 @@ def _multilabel_precision_recall_curve_compute(
     valid_count: Optional[int] = None,
 ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
     p_full, r_full, t_full, end_full = (
-        jax.device_get(_multilabel_prc_full_jit(input, target))
+        jax.device_get(_multilabel_prc_full_jit(input, target))  # tev: disable=host-sync -- curve COMPUTE finalization: one deliberate batched readback, off the update path
     )
     if valid_count is not None:
         pad = p_full.shape[-1] - valid_count

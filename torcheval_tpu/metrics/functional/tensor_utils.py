@@ -215,7 +215,7 @@ def create_threshold_tensor(
             # silent 0)
             raise ValueError("Last value in `threshold` should be 1.")
         return _cached_linspace_grid(threshold)
-    t = np.asarray(threshold, dtype=np.float32)
+    t = np.asarray(threshold, dtype=np.float32)  # tev: disable=host-sync -- constructor-arg grid validated host-side BEFORE device placement (docstring above); never on the update path
     if t.ndim != 1:
         raise ValueError(
             f"The `threshold` should be a one-dimensional tensor, got shape "

@@ -45,6 +45,7 @@ line writer)::
 
 from torcheval_tpu.obs.counters import CounterRegistry, default_registry
 from torcheval_tpu.obs.events import (
+    AnalysisEvent,
     CompileEvent,
     ComputeEvent,
     Event,
@@ -75,6 +76,7 @@ from torcheval_tpu.obs.recorder import (
 )
 
 __all__ = [
+    "AnalysisEvent",
     "CompileEvent",
     "ComputeEvent",
     "CounterRegistry",

@@ -26,6 +26,7 @@ from dataclasses import dataclass
 from typing import Any, ClassVar, Dict, Optional, Tuple, Type
 
 __all__ = [
+    "AnalysisEvent",
     "CompileEvent",
     "ComputeEvent",
     "Event",
@@ -175,9 +176,27 @@ class SpanEvent(Event):
     seconds: float = 0.0
 
 
+@dataclass
+class AnalysisEvent(Event):
+    """One active static-analysis finding (``torcheval_tpu.analysis``),
+    mirrored from :class:`~torcheval_tpu.analysis.report.Finding` when an
+    analyzer runs while the recorder is on — so a CI failure's event tail
+    carries the forensics that explain it (which rule, where, why)."""
+
+    kind: ClassVar[str] = "analysis"
+
+    tool: str = ""
+    rule: str = ""
+    path: str = ""
+    line: int = 0
+    severity: str = "error"
+    message: str = ""
+
+
 _EVENT_TYPES: Dict[str, Type[Event]] = {
     cls.kind: cls
     for cls in (
+        AnalysisEvent,
         UpdateEvent,
         ComputeEvent,
         SyncEvent,

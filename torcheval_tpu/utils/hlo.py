@@ -37,10 +37,40 @@ _INSTR = re.compile(
 )
 
 
+def _as_text(hlo) -> str:
+    """Accept a ``jax.stages.Compiled`` (or anything with ``as_text``) or
+    a raw HLO string — every parser below shares this one front door."""
+    return hlo if isinstance(hlo, str) else hlo.as_text()
+
+
+def collective_lines(hlo):
+    """All collective instructions of an optimized-HLO module, in program
+    order: ``[(opcode, line_number, stripped_instruction_line), ...]``.
+
+    The ONE HLO-parsing implementation (ISSUE 7): ``collective_count``,
+    ``collective_sequence``, the sync-structure pins, and the
+    ``analysis`` program verifier all derive from this list, so the
+    instruction grammar lives in exactly one regex (``_INSTR`` above).
+    """
+    out = []
+    for lineno, line in enumerate(_as_text(hlo).splitlines(), start=1):
+        m = _INSTR.search(line)
+        if m:
+            out.append((m.group(1), lineno, line.strip()))
+    return out
+
+
+def collective_sequence(hlo):
+    """The ORDERED opcode sequence of collectives in an optimized HLO
+    module — the census the program verifier checks against declared
+    expectations (count alone cannot catch an all-reduce silently
+    becoming an all-gather, or a reordering that breaks lockstep)."""
+    return tuple(op for op, _, _ in collective_lines(hlo))
+
+
 def collective_count(compiled) -> int:
     """Number of collective ops in a ``jax.stages.Compiled``'s optimized HLO."""
-    hlo = compiled.as_text()
-    return sum(1 for _ in _INSTR.finditer(hlo))
+    return len(collective_sequence(compiled))
 
 
 def all_reduce_combiner_active() -> bool:
