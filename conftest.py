@@ -79,13 +79,20 @@ def pytest_runtest_setup(item):
     failing test itself produced — without this, seeded-violation
     fixtures (tests/analysis/) leave findings in the global that would
     be pinned on any later unrelated failure. Same ``sys.modules``
-    discipline as the hook: never import the analyzers here."""
+    discipline as the hook: never import the analyzers here.
+
+    Also clears the causal-tracing error stack (``obs/trace.py``): the
+    span path the failure hook attaches must belong to THIS test, not to
+    an earlier one that raised through an instrumented site."""
     import sys
 
     report_mod = sys.modules.get("torcheval_tpu.analysis.report")
     item._analysis_report_before = (
         None if report_mod is None else report_mod.last_report()
     )
+    trace_mod = sys.modules.get("torcheval_tpu.obs.trace")
+    if trace_mod is not None:
+        trace_mod.clear_error_stack()
 
 
 @pytest.hookimpl(hookwrapper=True)
@@ -115,6 +122,25 @@ def pytest_runtest_makereport(item, call):
                     format_report(tail=30),
                 )
             )
+    except Exception:  # noqa: BLE001 — forensics must never mask the failure
+        pass
+    try:
+        # Causal-tracing forensics (ISSUE 8): the span path active when
+        # the exception escaped an instrumented site — "which update of
+        # which metric, inside which panel/sync" — next to the event
+        # tail. Captured by obs/trace.py's Scope at raise time (the
+        # frames themselves are popped during unwinding), cleared per
+        # test in pytest_runtest_setup.
+        trace_mod = sys.modules.get("torcheval_tpu.obs.trace")
+        if trace_mod is not None:
+            stack = trace_mod.last_error_stack()
+            if stack:
+                rep.sections.append(
+                    (
+                        "torcheval_tpu trace (span stack at failure)",
+                        " > ".join(stack) + "\n",
+                    )
+                )
     except Exception:  # noqa: BLE001 — forensics must never mask the failure
         pass
     try:

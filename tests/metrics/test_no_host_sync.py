@@ -97,10 +97,12 @@ def test_steady_state_update_is_transfer_free(name):
 
 @pytest.mark.parametrize("name", sorted(CLASS_CASES))
 def test_steady_state_update_is_transfer_free_recorder_on(name):
-    """ISSUE 5 acceptance: the observability recorder must add ZERO host
-    syncs to the steady-state update path — recording is a host-side
-    ring append + TraceAnnotation, never a device readback. Same guard
-    as above, recorder enabled."""
+    """ISSUE 5 acceptance, extended by ISSUE 8 to the tracing-enabled
+    variant: the observability recorder — now including the causal span
+    frame, trace/span id stamping, and the latency-histogram insert —
+    must add ZERO host syncs to the steady-state update path. Recording
+    is a host-side ring append + TraceAnnotation + list/int work, never
+    a device readback. Same guard as above, recorder enabled."""
     from torcheval_tpu import obs
 
     make, args = CLASS_CASES[name]
@@ -113,11 +115,13 @@ def test_steady_state_update_is_transfer_free_recorder_on(name):
     try:
         with jax.transfer_guard("disallow"):
             metric.update(*args)
-        # the event actually landed (the pin is not vacuous)
-        assert any(
-            e.kind == "update" and e.metric == type(metric).__name__
-            for e in rec.log.tail(5)
+        # the event actually landed AND was traced (the pin covers the
+        # tracing-enabled path, not a trace-stripped recorder)
+        ev = next(
+            e for e in reversed(rec.log.tail(5))
+            if e.kind == "update" and e.metric == type(metric).__name__
         )
+        assert ev.trace is not None and ev.span is not None
     finally:
         if not prev:
             rec.disable()

@@ -410,13 +410,14 @@ def test_prometheus_exposition_grammar(rec):
         if line.startswith("# TYPE "):
             _, _, name, kind = line.split(" ")
             assert name_re.match(name), line
-            assert kind in ("counter", "gauge"), line
+            assert kind in ("counter", "gauge", "histogram"), line
             continue
-        name, value = line.split(" ", 1)
+        sample, value = line.rsplit(" ", 1)
+        name, _, labels = sample.partition("{")
         assert name_re.match(name), line
         float(value)  # numeric exposition value
-        assert name not in seen, f"duplicate sample {name}"
-        seen.add(name)
+        assert sample not in seen, f"duplicate sample {sample}"
+        seen.add(sample)
     # the federated sources are all present
     assert any(s.startswith("torcheval_tpu_compile_") for s in seen)
     assert any(s.startswith("torcheval_tpu_sync_") for s in seen)

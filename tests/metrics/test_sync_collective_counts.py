@@ -155,11 +155,14 @@ def test_resilient_wrapper_adds_zero_collectives(n_metrics):
 
 @pytest.mark.parametrize("n_metrics", [1, 12])
 def test_recorder_on_adds_zero_collectives(n_metrics):
-    """ISSUE 5 acceptance: enabling the observability recorder must not
-    change the collective budget — the SyncEvent's byte/provenance
-    payload rides the metadata the protocol already exchanges, and
-    recording is host-side. Exactly the same gather counts as the bare
-    run, for plain AND resilient groups."""
+    """ISSUE 5 acceptance, extended by ISSUE 8 to the tracing-enabled
+    variant: enabling the observability recorder — now including span
+    frames, the cross-rank flow ordinal, and latency-histogram inserts —
+    must not change the collective budget. The SyncEvent's
+    byte/provenance payload rides the metadata the protocol already
+    exchanges, the flow ordinal is a thread-local counter, and recording
+    is host-side. Exactly the same gather counts as the bare run, for
+    plain AND resilient groups."""
     from torcheval_tpu import obs
     from torcheval_tpu.resilience import ResilientGroup
 
@@ -186,10 +189,16 @@ def test_recorder_on_adds_zero_collectives(n_metrics):
         assert plain.array_gathers == bare.array_gathers <= 1
         assert resilient.object_gathers == bare.object_gathers
         assert resilient.array_gathers == bare.array_gathers
-        # the pin is not vacuous: both syncs were recorded
+        # the pin is not vacuous: both syncs were recorded, TRACED, and
+        # flow-stamped (the zero-collective budget covers the
+        # tracing-enabled recorder, not a trace-stripped one)
         syncs = [e for e in rec.log.tail() if e.kind == "sync"]
         assert len(syncs) >= 2
         assert syncs[-1].metrics == n_metrics
+        assert all(
+            s.trace is not None and s.span is not None and s.flow >= 1
+            for s in syncs
+        )
     finally:
         if not prev:
             rec.disable()

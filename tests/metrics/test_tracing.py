@@ -1,0 +1,856 @@
+"""Tier-1 suite for causal tracing + device-cost profiling (ISSUE 8).
+
+Pins the profiling layer's load-bearing contracts on top of the PR 5
+``obs/`` subsystem:
+
+- **trace context** (``obs/trace.py``): thread-local span stacks build
+  connected parent/child trees, roots open fresh trace ids, threads are
+  independent, a mismatched pop cannot poison the stack, and the error
+  stack captures the INNERMOST failing span path (what the conftest
+  failure hook attaches);
+- **event stamping**: update/compute/sync/snapshot/span events carry
+  trace/span/parent ids, point events (retry, compile) inherit the open
+  span, the bucketed dispatch attributes compiles to the metric family
+  AND shape bucket that demanded them, and syncs carry the cross-rank
+  flow ordinal;
+- **latency digests** (``obs/hist.py``): O(1) log2-bucket inserts,
+  conservative quantiles, and the merge oracle — merging per-rank
+  snapshots in ascending-rank order is deterministic and bit-identical
+  on every rank;
+- **exporters**: Chrome trace-event JSON grammar (required
+  ``ph``/``ts``/``pid``/``tid``, complete X slices — the acceptance
+  grammar test), Prometheus ``histogram`` exposition with cumulative
+  ``_bucket``/``_sum``/``_count`` series where EVERY line parses
+  (label escaping included), JSONL ``schema`` versioning with
+  unknown-field tolerance;
+- **cross-rank merge**: ``gather_traces`` over a rendezvousing
+  ThreadWorld-4 yields spans from all 4 ranks with flow ids linking the
+  same sync across ranks, in EXACTLY ONE allgather (the acceptance
+  criterion);
+- **device-cost accounting** (``obs/memory.py``): per-metric state
+  bytes for every registered family WITHOUT executing a step (and
+  without a single host transfer), compile-time program costs with
+  graceful ``None`` degradation, and the ``CounterRegistry``
+  federation.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torcheval_tpu.metrics as M
+from torcheval_tpu import config, obs
+from torcheval_tpu.distributed import LocalReplicaGroup, ProcessGroup
+from torcheval_tpu.metrics.toolkit import (
+    sync_and_compute,
+    update_collection,
+)
+from torcheval_tpu.obs import hist as obs_hist
+from torcheval_tpu.obs import trace as obs_trace
+from torcheval_tpu.obs.events import (
+    SCHEMA_VERSION,
+    MemoryEvent,
+    SyncEvent,
+    UpdateEvent,
+    event_from_dict,
+)
+from torcheval_tpu.resilience import ResilientGroup
+from torcheval_tpu.utils.test_utils import (
+    FaultInjectionGroup,
+    FaultSpec,
+    ThreadWorld,
+)
+
+from tests.metrics.test_observability import CountingGroup
+from tests.metrics.test_no_host_sync import CLASS_CASES
+
+RNG = np.random.default_rng(8)
+
+
+@pytest.fixture
+def rec():
+    """A freshly-reset, ENABLED recorder with a clean latency registry;
+    both restored after."""
+    r = obs.recorder()
+    prev = r.enabled
+    r.reset()
+    obs_hist.reset()
+    r.enable()
+    try:
+        yield r
+    finally:
+        r.reset()
+        obs_hist.reset()
+        if not prev:
+            r.disable()
+
+
+def _acc(seed=0):
+    m = M.MulticlassAccuracy()
+    rng = np.random.default_rng(seed)
+    m.update(
+        np.float32(rng.uniform(size=(16, 4))), rng.integers(0, 4, size=16)
+    )
+    return m
+
+
+# ------------------------------------------------------------- trace context
+
+
+def test_scope_nesting_builds_tree():
+    with obs_trace.Scope("root") as root:
+        assert root.parent_id is None
+        with obs_trace.Scope("child") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            with obs_trace.Scope("grandchild") as grand:
+                assert grand.trace_id == root.trace_id
+                assert grand.parent_id == child.span_id
+                assert obs_trace.trace_path() == "root > child > grandchild"
+            assert obs_trace.current() is child
+    assert obs_trace.current() is None
+
+
+def test_root_spans_get_fresh_traces():
+    with obs_trace.Scope("a") as a:
+        pass
+    with obs_trace.Scope("b") as b:
+        pass
+    assert a.trace_id != b.trace_id
+    assert a.span_id != b.span_id
+
+
+def test_threads_have_independent_stacks():
+    seen = {}
+
+    def body(name):
+        with obs_trace.Scope(name) as frame:
+            seen[name] = (frame.trace_id, obs_trace.trace_path())
+
+    threads = [
+        threading.Thread(target=body, args=(f"t{i}",)) for i in range(3)
+    ]
+    with obs_trace.Scope("main"):
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert obs_trace.trace_path() == "main"
+    traces = {trace for trace, _ in seen.values()}
+    assert len(traces) == 3  # each thread rooted its own trace
+    assert all(path == name for name, (_, path) in seen.items())
+
+
+def test_pop_tolerates_mismatched_exit():
+    outer = obs_trace.push("outer")
+    inner = obs_trace.push("inner")
+    # a buggy site pops the OUTER frame first: the stack unwinds through
+    # it instead of corrupting later sites
+    obs_trace.pop(outer)
+    assert obs_trace.current() is None
+    obs_trace.pop(inner)  # stale pop: harmless no-op
+    assert obs_trace.current() is None
+
+
+def test_annotate_noop_outside_span():
+    obs_trace.annotate(bucket=64)  # no open frame: must not raise
+    with obs_trace.Scope("s") as frame:
+        obs_trace.annotate(bucket=32, family="acc")
+        assert frame.annotations == {"bucket": 32, "family": "acc"}
+
+
+def test_error_stack_innermost_capture_and_clear():
+    obs_trace.clear_error_stack()
+    with pytest.raises(ValueError):
+        with obs_trace.Scope("outer"):
+            with obs_trace.Scope("inner"):
+                raise ValueError("boom")
+    # the INNERMOST capture survived the unwind (outer scopes saw the
+    # same exception and left the deeper path in place)
+    assert obs_trace.last_error_stack() == ["outer", "inner"]
+    obs_trace.clear_error_stack()
+    assert obs_trace.last_error_stack() is None
+
+
+# ------------------------------------------------------------ event stamping
+
+
+def test_update_compute_events_carry_span_ids(rec):
+    m = _acc()
+    m.compute()
+    update = next(e for e in rec.log if e.kind == "update")
+    compute = next(e for e in rec.log if e.kind == "compute")
+    for ev in (update, compute):
+        assert ev.trace is not None and ev.span is not None
+        assert ev.parent is None  # top-level: a root span
+        assert ev.tid == threading.get_ident()
+    assert update.trace != compute.trace  # two separate root trees
+    # the latency digests were fed alongside
+    snap = obs_hist.snapshot()
+    assert snap["update/MulticlassAccuracy"].count == 1
+    assert snap["compute/MulticlassAccuracy"].count == 1
+
+
+def test_update_inside_user_span_parents_to_it(rec):
+    with obs.span("eval-step"):
+        _acc()
+    span = next(e for e in rec.log if e.kind == "span")
+    update = next(e for e in rec.log if e.kind == "update")
+    assert update.trace == span.trace
+    assert update.parent == span.span
+
+
+def test_update_collection_is_one_root_span(rec):
+    metrics = {
+        "acc": M.BinaryAccuracy(),
+        "auroc": M.BinaryAUROC(),  # no fusable plan: per-metric fallback
+    }
+    scores = np.float32(RNG.uniform(size=16))
+    targets = np.float32(RNG.integers(0, 2, size=16))
+    update_collection(metrics, scores, targets)
+    panel = next(
+        e for e in rec.log
+        if e.kind == "update" and e.metric == "update_collection"
+    )
+    fallback = next(
+        e for e in rec.log
+        if e.kind == "update" and e.metric == "BinaryAUROC"
+    )
+    # the fallback metric's own update span nests under the panel span
+    assert fallback.trace == panel.trace
+    assert fallback.parent == panel.span
+    assert panel.parent is None
+    assert obs_hist.snapshot()["update/update_collection"].count == 1
+
+
+def test_sync_event_carries_flow_and_span(rec):
+    m = _acc()
+    sync_and_compute(m, CountingGroup())
+    sync = next(e for e in rec.log if e.kind == "sync")
+    assert sync.flow >= 1
+    assert sync.trace is not None and sync.span is not None
+    assert obs_hist.snapshot()["sync"].count == 1
+
+
+def test_retry_parents_into_sync_trace(rec):
+    m = _acc()
+    chaos = FaultInjectionGroup(
+        CountingGroup(), faults=[FaultSpec(call=0, kind="transient")]
+    )
+    sync_and_compute(
+        m, ResilientGroup(chaos, timeout=30.0, retries=2, policy="quorum")
+    )
+    sync = next(e for e in rec.log if e.kind == "sync")
+    retry = next(e for e in rec.log if e.kind == "retry")
+    # the retry fired INSIDE the sync's span tree: same trace, parented
+    # to a span underneath it (the resilient-collective span)
+    assert retry.trace == sync.trace
+    assert retry.parent is not None
+    collective = next(
+        e for e in rec.log
+        if e.kind == "span" and e.name == "torcheval.collective"
+    )
+    assert retry.parent == collective.span
+    assert collective.parent == sync.span
+    assert obs_hist.snapshot()["collective"].count >= 1
+
+
+def test_compile_event_site_attribution(rec):
+    class FreshForSite(M.Mean):  # fresh class: its programs can't be cached
+        pass
+
+    FreshForSite().update(np.float32(RNG.uniform(size=19)))
+    compiles = [
+        e for e in rec.log if e.kind == "compile" and not e.cache_hit
+    ]
+    assert any(
+        e.site == "torcheval.update/Mean" for e in compiles
+    ), [(e.site, e.cache_hit) for e in rec.log if e.kind == "compile"]
+
+
+def test_compile_event_bucket_attribution(rec):
+    class FreshForBucket(M.MulticlassAccuracy):
+        pass
+
+    with config.shape_bucketing(True):
+        m = FreshForBucket()
+        m.update(
+            np.float32(RNG.uniform(size=(23, 4))),
+            RNG.integers(0, 4, size=23),
+        )
+    stamped = [
+        e for e in rec.log
+        if e.kind == "compile" and e.bucket > 0 and "update" in e.site
+    ]
+    assert stamped, [
+        (e.site, e.bucket) for e in rec.log if e.kind == "compile"
+    ]
+    assert all(e.bucket == 32 for e in stamped)  # 23 pads to the 32 bucket
+
+
+def test_snapshot_event_carries_span(rec, tmp_path):
+    from torcheval_tpu.elastic import ElasticSession
+
+    session = ElasticSession({"acc": _acc()}, os.fspath(tmp_path), interval=1)
+    session.step_done()
+    session.close()
+    snap = next(e for e in rec.log if e.kind == "snapshot")
+    assert snap.trace is not None and snap.span is not None
+    assert obs_hist.snapshot()["snapshot"].count == 1
+
+
+def test_panel_compile_never_stamps_a_metric_bucket(rec):
+    """Review regression: in `update_collection` the open frame is the
+    SHARED panel span and compiles fire later, during the fused group
+    dispatch — a per-metric bucket stamp there would be last-writer-wins
+    and could name the wrong metric's bucket. Panel compiles must carry
+    the panel site with bucket=0 instead of a plausible lie."""
+
+    class FreshPanelA(M.MulticlassAccuracy):
+        pass
+
+    class FreshPanelB(M.MulticlassAccuracy):
+        pass
+
+    with config.shape_bucketing(True):
+        update_collection(
+            {"a": FreshPanelA(), "b": FreshPanelB()},
+            np.float32(RNG.uniform(size=(23, 4))),
+            RNG.integers(0, 4, size=23),
+        )
+    panel_compiles = [
+        e for e in rec.log
+        if e.kind == "compile" and e.site == "torcheval.update_collection"
+    ]
+    assert panel_compiles  # the fused bucketed program did compile
+    assert all(e.bucket == 0 for e in panel_compiles)
+
+
+def test_clean_scopes_inside_outer_except_capture_nothing(rec):
+    """Review regression: `sys.exc_info()` inside a finally reports an
+    OUTER already-handled exception — a fully successful sync / panel /
+    snapshot executed inside an `except` block must NOT capture an error
+    stack (the conftest hook would pin bogus forensics on the next
+    failing test)."""
+    obs_trace.clear_error_stack()
+    try:
+        raise RuntimeError("outer, already handled")
+    except RuntimeError:
+        m = _acc()
+        update_collection(
+            {"acc": M.MulticlassAccuracy()},
+            np.float32(RNG.uniform(size=(8, 4))),
+            RNG.integers(0, 4, size=8),
+        )
+        sync_and_compute(m, CountingGroup())
+    assert obs_trace.last_error_stack() is None
+
+
+def test_chrome_export_error_surfaces_after_handled_exception(tmp_path):
+    """Review regression: a clean observability scope running inside an
+    outer `except` handler must still RAISE a chrome-trace export error
+    (`sys.exc_info()` made it look like an exception was propagating, so
+    the error was silently swallowed)."""
+    bad = os.fspath(tmp_path / "no-such-dir" / "trace.json")
+    try:
+        raise ValueError("outer, already handled")
+    except ValueError:
+        with pytest.raises(OSError):
+            with config.observability(chrome_trace=bad):
+                _acc()
+
+
+# ----------------------------------------------------------- latency digests
+
+
+def test_bucket_index_boundaries():
+    assert obs_hist.bucket_index(0.0) == 0
+    assert obs_hist.bucket_index(0.5e-6) == 0  # sub-µs
+    assert obs_hist.bucket_index(1e-6) == 1
+    assert obs_hist.bucket_index(3e-6) == 2  # [2, 4) µs
+    assert obs_hist.bucket_index(4e-6) == 3
+    assert obs_hist.bucket_index(1e9) == obs_hist.NUM_BUCKETS - 1
+    bounds = obs_hist.bucket_upper_bounds_us()
+    assert len(bounds) == obs_hist.NUM_BUCKETS
+    assert bounds[-1] == float("inf")
+
+
+def test_observe_and_quantile_conservative():
+    h = obs_hist.LatencyHistogram()
+    samples = [1e-6 * (i + 1) for i in range(100)]  # 1..100 µs
+    for s in samples:
+        h.observe(s)
+    assert h.count == 100
+    assert h.sum == pytest.approx(sum(samples))
+    for q in (0.5, 0.9, 0.99):
+        true = samples[min(int(q * 100), 99)]
+        got = h.quantile(q)
+        # conservative (never under-reports) and within one log2 bucket
+        assert true <= got <= true * 2.0 + 1e-6, (q, true, got)
+    assert obs_hist.LatencyHistogram().quantile(0.5) is None
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_merge_oracle_bit_identical():
+    rng = np.random.default_rng(42)
+    per_rank = [
+        [float(s) for s in rng.gamma(2.0, 1e-4, size=200)] for _ in range(4)
+    ]
+    snapshots = []
+    oracle = obs_hist.LatencyHistogram()
+    for samples in per_rank:
+        h = obs_hist.LatencyHistogram()
+        for s in samples:
+            h.observe(s)
+            oracle.counts[obs_hist.bucket_index(s)] += 0  # no-op; clarity
+        snapshots.append(h.as_dict())
+    # every "rank" folds the same snapshots in the same ascending order:
+    # the results must be bit-identical (integer counts; float sum
+    # accumulated in a fixed order)
+    merges = []
+    for _ in range(3):
+        m = obs_hist.LatencyHistogram.from_dict(snapshots[0])
+        for snap in snapshots[1:]:
+            m.merge(obs_hist.LatencyHistogram.from_dict(snap))
+        merges.append(m)
+    assert merges[0] == merges[1] == merges[2]
+    assert merges[0].sum.hex() == merges[1].sum.hex()  # BIT-identical
+    # and the merge is the elementwise-count oracle
+    for i in range(obs_hist.NUM_BUCKETS):
+        assert merges[0].counts[i] == sum(
+            obs_hist.LatencyHistogram.from_dict(s).counts[i]
+            for s in snapshots
+        )
+    assert merges[0].count == 800
+
+
+def test_from_dict_validates_bucket_count():
+    with pytest.raises(ValueError):
+        obs_hist.LatencyHistogram.from_dict({"counts": [1, 2], "sum": 0.0})
+
+
+def test_registry_snapshot_isolated_from_live_inserts():
+    obs_hist.reset()
+    obs_hist.observe("op", 1e-3)
+    snap = obs_hist.snapshot()
+    obs_hist.observe("op", 1e-3)
+    assert snap["op"].count == 1  # the snapshot is a copy, not a view
+    assert obs_hist.snapshot()["op"].count == 2
+    obs_hist.reset()
+    assert obs_hist.snapshot() == {}
+
+
+# ---------------------------------------------------- Prometheus exposition
+
+# The exposition-format line grammar: a comment/TYPE line, or
+# name{labels} value — with label values containing only escaped
+# backslash/quote/newline.
+_PROM_LINE = re.compile(
+    r"^(?:# (?:TYPE|HELP) [a-zA-Z_][a-zA-Z0-9_]* \w+$"
+    r"|[a-zA-Z_][a-zA-Z0-9_]*"
+    r"(?:\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\")*\})?"
+    r" [0-9.eE+-]+(?:$|\s))"
+)
+
+
+def test_histogram_exposition_cumulative_and_typed(rec):
+    obs_hist.reset()
+    for us in (1, 3, 3, 900, 5_000_000):
+        obs_hist.observe("update/Acc", us * 1e-6)
+    text = obs.render_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE torcheval_tpu_latency_seconds histogram" in lines
+    buckets = [
+        l for l in lines
+        if l.startswith('torcheval_tpu_latency_seconds_bucket{op="update/Acc"')
+    ]
+    assert len(buckets) == obs_hist.NUM_BUCKETS
+    counts = [int(l.rsplit(" ", 1)[1]) for l in buckets]
+    assert counts == sorted(counts)  # cumulative
+    assert counts[-1] == 5
+    assert buckets[-1].count('le="+Inf"') == 1
+    assert 'torcheval_tpu_latency_seconds_sum{op="update/Acc"}' in text
+    assert (
+        'torcheval_tpu_latency_seconds_count{op="update/Acc"} 5' in text
+    )
+
+
+def test_exposition_grammar_every_line_parses(rec):
+    """Satellite: label values escaped, names sanitized — EVERY emitted
+    line (histogram series included) matches the exposition grammar."""
+    obs_hist.reset()
+    # a hostile digest key: quote, backslash, newline, spaces
+    obs_hist.observe('up"da\\te\nop x', 2e-6)
+    obs_hist.observe("sync", 1e-3)
+    registry = obs.default_registry()
+    registry.register(
+        "99 bad source!", lambda: {"0weird counter": 7, "ok": 1.5}
+    )
+    try:
+        text = obs.render_prometheus(registry)
+    finally:
+        registry.unregister("99 bad source!")
+    _acc()  # land counters too (event tallies)
+    for line in text.splitlines():
+        assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
+    # the hostile label VALUE round-trips its escapes
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    # sanitized names: no line starts with a digit or contains a space
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            name = re.split(r"[{ ]", line, 1)[0]
+            assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", name), line
+
+
+def test_format_report_renders_latency_digests(rec):
+    obs_hist.reset()
+    for _ in range(10):
+        obs_hist.observe("update/Acc", 128e-6)
+    report = obs.format_report()
+    assert "[latency]" in report
+    assert "update/Acc" in report
+    assert "p99<=" in report and "n=10" in report
+
+
+# ----------------------------------------------------------- Chrome export
+
+
+def _check_chrome_grammar(trace):
+    """The acceptance grammar: every record has ph/ts/pid/tid; duration
+    events are complete X slices (never unmatched B/E); flow records
+    carry an id."""
+    assert isinstance(trace, dict) and "traceEvents" in trace
+    begins = []
+    for record in trace["traceEvents"]:
+        for field in ("ph", "ts", "pid", "tid"):
+            assert field in record, (field, record)
+        ph = record["ph"]
+        assert ph in {"X", "i", "M", "s", "t", "f"}, record
+        if ph == "X":
+            assert "dur" in record and record["dur"] >= 0.0, record
+        if ph == "B":
+            begins.append((record["pid"], record["tid"]))
+        if ph == "E":
+            assert begins.pop() == (record["pid"], record["tid"])
+        if ph in {"s", "t", "f"}:
+            assert "id" in record, record
+    assert not begins, "unmatched B events"
+
+
+def test_chrome_trace_grammar_and_file(rec, tmp_path):
+    m = _acc()
+    with obs.span("phase"):
+        m.compute()
+    sync_and_compute(m, CountingGroup())
+    path = os.fspath(tmp_path / "trace.json")
+    out = obs.export_chrome_trace(path=path)
+    _check_chrome_grammar(out)
+    on_disk = json.loads(open(path).read())
+    _check_chrome_grammar(on_disk)
+    cats = {r.get("cat") for r in out["traceEvents"]}
+    assert {"update", "compute", "span", "sync"} <= cats
+    slices = [r for r in out["traceEvents"] if r["ph"] == "X"]
+    # span/parent ids ride in args so Perfetto queries can rebuild the tree
+    assert any(r["args"].get("span") for r in slices)
+
+
+def test_chrome_trace_accepts_explicit_events(rec):
+    events = [
+        UpdateEvent(metric="Acc", seconds=0.001, t_mono=1.0),
+        SyncEvent(rank=1, seconds=0.002, t_mono=2.0, flow=7),
+    ]
+    out = obs.export_chrome_trace(events)
+    _check_chrome_grammar(out)
+    pids = {r["pid"] for r in out["traceEvents"] if r["ph"] == "X"}
+    assert pids == {0, 1}  # rank-less events land in lane 0
+
+
+def test_flow_arrows_are_timestamp_ordered(rec):
+    """Review regression: same-id flow events bind in ts order per the
+    trace-event contract — the s/t/f sequence must follow TIMESTAMPS,
+    not rank order, or a sync that rank 1 entered first renders as a
+    backwards arrow Perfetto drops."""
+    events = [
+        # rank 1's sync STARTED (and ended) before rank 0's
+        SyncEvent(rank=1, seconds=0.010, t_mono=1.010, flow=5),
+        SyncEvent(rank=0, seconds=0.010, t_mono=1.050, flow=5),
+        SyncEvent(rank=2, seconds=0.010, t_mono=1.020, flow=5),
+    ]
+    out = obs.export_chrome_trace(events)
+    arrows = [r for r in out["traceEvents"] if r["ph"] in {"s", "t", "f"}]
+    assert [a["ph"] for a in arrows] == ["s", "t", "f"]
+    assert [a["ts"] for a in arrows] == sorted(a["ts"] for a in arrows)
+    assert [a["pid"] for a in arrows] == [1, 2, 0]  # time order, not rank
+
+
+def test_chrome_trace_scope_exports_only_its_own_events(rec, tmp_path):
+    """Review regression: the ring is process-global — a chrome_trace
+    scope must export the events recorded DURING the scope, not an
+    earlier eval's retained history."""
+    _acc(seed=99)  # recorded by the outer `rec` scope, NOT ours
+    before = [e for e in rec.log if e.kind == "update"]
+    assert before, "precondition: the ring holds pre-scope events"
+    path = os.fspath(tmp_path / "scoped.json")
+    with config.observability(chrome_trace=path):
+        with obs.span("inner-phase"):
+            pass
+    out = json.loads(open(path).read())
+    cats = {r.get("cat") for r in out["traceEvents"] if r["ph"] == "X"}
+    assert "span" in cats
+    assert "update" not in cats  # the pre-scope history stayed out
+
+
+def test_config_observability_writes_chrome_trace_on_exception(tmp_path):
+    path = os.fspath(tmp_path / "crash.json")
+    with pytest.raises(RuntimeError):
+        with config.observability(chrome_trace=path):
+            _acc()
+            raise RuntimeError("eval crashed")
+    # the crashed eval still left its timeline behind
+    _check_chrome_grammar(json.loads(open(path).read()))
+
+
+# ------------------------------------------- cross-rank merge (acceptance)
+
+
+class _CountingView(ProcessGroup):
+    """Forwarding wrapper counting allgather_object calls on ONE rank's
+    ThreadWorld view (the exactly-one-allgather acceptance pin)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.object_gathers = 0
+
+    @property
+    def world_size(self):
+        return self._inner.world_size
+
+    @property
+    def rank(self):
+        return self._inner.rank
+
+    @property
+    def is_member(self):
+        return self._inner.is_member
+
+    def unwrap(self):
+        return self._inner.unwrap()
+
+    def allgather_object(self, obj):
+        self.object_gathers += 1
+        return self._inner.allgather_object(obj)
+
+    def allgather_array(self, x):
+        return self._inner.allgather_array(x)
+
+
+def test_gather_traces_threadworld4_flows_in_one_allgather(rec):
+    """ISSUE acceptance: gather_traces over ThreadWorld-4 yields spans
+    from all 4 ranks with flow ids linking the same sync across ranks,
+    in exactly one allgather — and the merged latency digests are
+    bit-identical on every rank."""
+    world = ThreadWorld(4)
+
+    def body(g):
+        m = _acc(seed=g.rank)
+        sync_and_compute(m, g)
+        counting = _CountingView(g)
+        result = obs.gather_traces(counting, tail=400)
+        return counting.object_gathers, result
+
+    results = world.run(body)
+    assert all(calls == 1 for calls, _ in results)  # exactly one allgather
+    merged = results[0][1]
+    assert merged["ranks"] == [0, 1, 2, 3]
+    flows_by_rank = {}
+    for rank in range(4):
+        events = merged["per_rank"][rank]["events"]
+        own_syncs = [
+            e for e in events if e["kind"] == "sync" and e["rank"] == rank
+        ]
+        assert own_syncs, f"rank {rank} contributed no sync span"
+        assert all(e["span"] is not None for e in own_syncs)
+        flows_by_rank[rank] = {e["flow"] for e in own_syncs}
+        # update spans from this rank's thread also made it over
+        assert any(e["kind"] == "update" for e in events)
+    # the SAME flow ordinal names the sync on every rank (lockstep)
+    shared = set.intersection(*flows_by_rank.values())
+    assert shared, flows_by_rank
+    # merged latency digests: bit-identical on every rank (merge oracle)
+    for _, result in results[1:]:
+        assert result["latency"] == merged["latency"]
+    # the merge is the sum of the per-rank snapshot counts (ThreadWorld
+    # ranks share one process-global registry, so each of the 4
+    # contributions already holds all 4 ranks' sync observations)
+    assert merged["latency"]["sync"].count == sum(
+        merged["per_rank"][r]["hist"]["sync"]["count"] for r in range(4)
+    )
+    # the merged result renders as a multi-lane Perfetto trace with flow
+    # arrows binding the shared sync across the 4 rank lanes
+    chrome = obs.export_chrome_trace(merged)
+    _check_chrome_grammar(chrome)
+    lanes = {r["pid"] for r in chrome["traceEvents"] if r["ph"] == "X"}
+    assert {0, 1, 2, 3} <= lanes
+    arrows = [r for r in chrome["traceEvents"] if r["ph"] in {"s", "t", "f"}]
+    flow_ids = {r["id"] for r in arrows}
+    assert shared & flow_ids, (shared, flow_ids)
+    for fid in shared & flow_ids:
+        group = [r for r in arrows if r["id"] == fid]
+        assert {r["ph"] for r in group} == {"s", "t", "f"}
+        assert {r["pid"] for r in group} == {0, 1, 2, 3}
+
+
+def test_gather_traces_rejects_local_replica_group(rec):
+    with pytest.raises(TypeError):
+        obs.gather_traces(LocalReplicaGroup(jax.local_devices()[:2]))
+
+
+def test_gather_traces_non_member_is_graceful(rec):
+    world = ThreadWorld(3)
+
+    def body(g):
+        sub = g.new_subgroup([0, 1])
+        if not sub.is_member:
+            return obs.gather_traces(sub)
+        _acc(seed=g.rank)
+        return obs.gather_traces(sub, tail=10)
+
+    reports = world.run(body)
+    assert reports[2]["per_rank"] == {} and reports[2]["latency"] == {}
+    assert reports[0]["ranks"] == [0, 1]
+
+
+# ------------------------------------------------- device-cost accounting
+
+
+def test_memory_report_every_family_without_a_step():
+    """ISSUE acceptance: memory_report() returns per-metric state bytes
+    for EVERY registered family without executing a step — and without
+    a single host transfer during the walk."""
+    metrics = {name: make() for name, (make, _) in CLASS_CASES.items()}
+    with jax.transfer_guard("disallow"):
+        report = obs.memory_report(metrics)
+    assert set(report) == set(CLASS_CASES)
+    for name, entry in report.items():
+        assert entry["metric"] == type(metrics[name]).__name__
+        assert entry["state_bytes"] >= 0
+        assert entry["states"], f"{name} reported no states"
+        assert entry["state_bytes"] == sum(entry["states"].values())
+
+
+def test_state_bytes_matches_nbytes():
+    m = M.MulticlassConfusionMatrix(num_classes=6)
+    per_state = obs.state_bytes(m)
+    assert per_state["confusion_matrix"] == m.confusion_matrix.nbytes
+    assert per_state["confusion_matrix"] == 6 * 6 * 4  # f32[6,6]
+
+
+def test_memory_report_emits_events_when_recording(rec):
+    obs.memory_report({"acc": M.MulticlassAccuracy()})
+    events = [e for e in rec.log if e.kind == "memory"]
+    assert len(events) == 1
+    assert events[0].metric == "acc" and events[0].state_bytes >= 8
+
+
+def test_program_costs_fields_and_degradation():
+    costs = obs.program_costs(
+        lambda x: (x * 2.0).sum(), jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    )
+    assert set(costs) == {
+        "flops", "argument_bytes", "output_bytes", "temp_bytes",
+        "peak_bytes", "generated_code_bytes",
+    }
+    assert costs["argument_bytes"] == 64 * 64 * 4
+    assert costs["output_bytes"] == 4
+    if costs["peak_bytes"] is not None:
+        assert costs["peak_bytes"] >= costs["argument_bytes"]
+    # a non-lowerable callable degrades to all-None, never raises
+    bad = obs.program_costs(lambda: open("/nonexistent"))
+    assert all(v is None for v in bad.values())
+
+
+def test_metric_update_costs_fused_and_fallback():
+    scores = np.float32(RNG.uniform(size=(16, 4)))
+    labels = RNG.integers(0, 4, size=16)
+    costs = obs.metric_update_costs(M.MulticlassAccuracy(), scores, labels)
+    assert costs is not None and costs["argument_bytes"] > 0
+    # buffered metrics have no fusable plan: None, not a crash
+    assert (
+        obs.metric_update_costs(
+            M.BinaryAUROC(),
+            np.float32([0.1, 0.9]),
+            np.float32([0.0, 1.0]),
+        )
+        is None
+    )
+
+
+def test_track_metrics_federates_into_registry(rec):
+    metrics = {"acc": M.MulticlassAccuracy(), "mse": M.MeanSquaredError()}
+    registry = obs.CounterRegistry()
+    obs.track_metrics(metrics, registry=registry)
+    read = registry.read()["memory"]
+    assert read["acc_state_bytes"] >= 8
+    assert read["total_state_bytes"] == (
+        read["acc_state_bytes"] + read["mse_state_bytes"]
+    )
+    # live supplier: growing a state grows the NEXT scrape
+    metrics["mse"].update(
+        np.float32(RNG.normal(size=8)), np.float32(RNG.normal(size=8))
+    )
+    assert registry.read()["memory"]["total_state_bytes"] >= read[
+        "total_state_bytes"
+    ]
+    text = obs.render_prometheus(registry)
+    assert "torcheval_tpu_memory_acc_state_bytes" in text
+    registry.unregister("memory")
+    assert "memory" not in registry.read()
+
+
+# ------------------------------------------------------- JSONL schema field
+
+
+def test_schema_version_on_every_jsonl_line(rec, tmp_path):
+    path = os.fspath(tmp_path / "events.jsonl")
+    with config.observability(jsonl=path):
+        m = _acc()
+        sync_and_compute(m, CountingGroup())
+        obs.memory_report({"acc": m})
+    with open(path) as f:
+        lines = [json.loads(line) for line in f]
+    assert lines
+    assert all(d["schema"] == SCHEMA_VERSION for d in lines)
+
+
+def test_unknown_future_fields_are_tolerated():
+    d = UpdateEvent(metric="Acc", seconds=0.5, trace=9, span=3).as_dict()
+    assert d["schema"] == SCHEMA_VERSION
+    d["from_the_future"] = {"nested": True}
+    restored = event_from_dict(d)
+    assert isinstance(restored, UpdateEvent)
+    assert restored.metric == "Acc" and restored.trace == 9
+
+
+def test_new_event_kinds_round_trip(rec):
+    originals = [
+        MemoryEvent(metric="acc", state_bytes=4096, states=2, step=7),
+        SyncEvent(
+            rank=2, world_size=4, flow=3, trace=11, span=5, parent=1,
+            seconds=0.25, ranks=(0, 1, 2, 3),
+        ),
+    ]
+    for original in originals:
+        restored = event_from_dict(json.loads(json.dumps(original.as_dict())))
+        assert restored == original, type(original).__name__

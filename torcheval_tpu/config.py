@@ -557,6 +557,7 @@ def observability(
     *,
     jsonl: Optional[str] = None,
     capacity: Optional[int] = None,
+    chrome_trace: Optional[str] = None,
 ) -> Iterator[None]:
     """Context manager scoping structured event recording
     (docs/observability.md).
@@ -564,9 +565,14 @@ def observability(
     Inside the context the global recorder (``torcheval_tpu.obs``)
     collects typed lifecycle events — updates, computes, syncs (with
     provenance + wire bytes), resilience retries/degradations, elastic
-    snapshots/restores, XLA compiles — into a bounded ring buffer, and
-    optionally streams them to ``jsonl`` via the async line writer
-    (drained and closed on exit).
+    snapshots/restores, XLA compiles — into a bounded ring buffer, with
+    causal trace/span ids connecting them into per-step trees
+    (docs/observability.md, "Causal tracing"), and optionally streams
+    them to ``jsonl`` via the async line writer (drained and closed on
+    exit). ``chrome_trace`` additionally writes the scope's retained
+    events as Chrome trace-event JSON (``obs.export_chrome_trace``,
+    loadable in Perfetto) when the scope exits — including an exit by
+    exception, so a crashed eval leaves its timeline behind.
 
     >>> with observability(jsonl="/tmp/eval-events.jsonl"):
     ...     value = sync_and_compute(metric)
@@ -576,6 +582,13 @@ def observability(
 
     prev_enabled = RECORDER.enabled
     prev_writer = RECORDER._writer
+    # NOT sys.exc_info(): inside an outer `except` handler that call
+    # reports the already-HANDLED exception, which would both mask a
+    # chrome-trace export error after a fully successful scope and
+    # mislabel a clean exit as a crash — only an exception escaping the
+    # scope BODY counts
+    propagating: Optional[BaseException] = None
+    events_before = RECORDER.log.total
     try:
         if enabled:
             if jsonl is not None:
@@ -589,7 +602,29 @@ def observability(
             # must survive the scope (full disable() would close it)
             RECORDER.enabled = False
         yield
+    except BaseException as e:
+        propagating = e
+        raise
     finally:
+        export_error: Optional[BaseException] = None
+        if enabled and chrome_trace is not None:
+            # write the timeline even when the scope exits by exception
+            # (a crashed eval leaves its trace behind); an unwritable
+            # path surfaces — but only after the recorder/writer state
+            # below is restored, and never MASKING a propagating error.
+            # Only THIS SCOPE's events (the documented contract): the
+            # ring is process-global and may hold an earlier eval's
+            # events — export the suffix recorded since entry. (Events
+            # beyond the ring capacity are gone either way; tail(0)
+            # would mean ALL retained, hence the explicit [] branch.)
+            from torcheval_tpu.obs.export import export_chrome_trace
+
+            new = RECORDER.log.total - events_before
+            scope_events = RECORDER.log.tail(new) if new > 0 else []
+            try:
+                export_chrome_trace(scope_events, path=chrome_trace)
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                export_error = e
         # restore recorder state FIRST (close may raise a ferried writer
         # error to the caller), then close ONLY the writer THIS scope
         # attached — never one inherited from outside
@@ -598,6 +633,8 @@ def observability(
         RECORDER.enabled = prev_enabled
         if scoped is not None and scoped is not prev_writer:
             scoped.close()
+        if export_error is not None and propagating is None:
+            raise export_error
 
 
 @contextmanager

@@ -73,6 +73,7 @@ from torcheval_tpu.distributed import (
 )
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.obs import counters as _obs_counters
+from torcheval_tpu.obs import trace as _obs_trace
 from torcheval_tpu.obs.recorder import RECORDER as _OBS
 from torcheval_tpu.utils.checkpoint import (
     _digest,
@@ -493,9 +494,50 @@ class ElasticSession:
         async mode a dedicated whole-world subgroup whose collective
         sequence nothing else shares.
         """
+        write_t0 = time.monotonic()
+        # causal tracing: the whole two-phase commit is one span (the
+        # digest allgather and any fault-hook retries parent to it);
+        # recorder off = no frame, nothing to pay
+        with _obs_trace.scope_or_null(
+            "torcheval.snapshot", _OBS.enabled
+        ) as snap_frame:
+            shard_bytes = self._write_bundle_body(
+                generation, metric_states, cursor, payload
+            )
+        seconds = time.monotonic() - write_t0
+        # registry tallies accumulate whether or not event recording is
+        # on (snapshotting is off the hot path; a restart diagnosis wants
+        # them regardless) — the typed event itself is recorder-gated
+        _obs_counters.note_snapshot(generation, seconds)
+        if _OBS.enabled and snap_frame is not None:
+            from torcheval_tpu.obs import hist as _obs_hist
+            from torcheval_tpu.obs.events import SnapshotEvent
+
+            _obs_hist.observe("snapshot", seconds)
+            _OBS.record(
+                SnapshotEvent(
+                    rank=self._comm.rank,
+                    step=int(cursor),
+                    generation=generation,
+                    seconds=seconds,
+                    shard_bytes=shard_bytes,
+                    async_writer=self._writer is not None,
+                    trace=snap_frame.trace_id,
+                    span=snap_frame.span_id,
+                    parent=snap_frame.parent_id,
+                )
+            )
+
+    def _write_bundle_body(
+        self,
+        generation: int,
+        metric_states: Dict[str, Dict[str, Any]],
+        cursor: int,
+        payload: Any,
+    ) -> int:
+        """The commit itself; returns this rank's shard size in bytes."""
         group = self._comm
         rank, world = group.rank, group.world_size
-        write_t0 = time.monotonic()
         self._fault("pre-shard", generation)
         gen_dir = self._generation_dir(generation)
         os.makedirs(gen_dir, exist_ok=True)
@@ -544,24 +586,7 @@ class ElasticSession:
         if rank == 0:
             self._rotate()
         self.snapshots_written += 1
-        seconds = time.monotonic() - write_t0
-        # registry tallies accumulate whether or not event recording is
-        # on (snapshotting is off the hot path; a restart diagnosis wants
-        # them regardless) — the typed event itself is recorder-gated
-        _obs_counters.note_snapshot(generation, seconds)
-        if _OBS.enabled:
-            from torcheval_tpu.obs.events import SnapshotEvent
-
-            _OBS.record(
-                SnapshotEvent(
-                    rank=rank,
-                    step=int(cursor),
-                    generation=generation,
-                    seconds=seconds,
-                    shard_bytes=len(blob),
-                    async_writer=self._writer is not None,
-                )
-            )
+        return len(blob)
 
     def _commit_manifest(
         self,
@@ -711,8 +736,10 @@ class ElasticSession:
             seconds = time.monotonic() - restore_t0
             _obs_counters.note_restore(seconds)
             if _OBS.enabled:
+                from torcheval_tpu.obs import hist as _obs_hist
                 from torcheval_tpu.obs.events import RestoreEvent
 
+                _obs_hist.observe("restore", seconds)
                 _OBS.set_step(self._cursor)
                 _OBS.record(
                     RestoreEvent(

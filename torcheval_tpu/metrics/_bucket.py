@@ -137,6 +137,26 @@ def apply_bucketing(plan, pad_cache: Optional[Dict] = None):
         else:
             padded.append(_pad_to(arg, tuple(shape), pad_cache))
 
+    # Causal compile attribution (obs/trace.py): stamp the bucket length
+    # this dispatch padded to onto the CURRENT span frame (the update
+    # wrapper's), so a compile fired by this bucket's first dispatch is
+    # attributed to the metric family AND the shape bucket that demanded
+    # it. The frame dies with the update call — no stale attribution —
+    # and with the recorder off this is skipped entirely. ONLY on the
+    # single-metric path (`pad_cache is None`): in `update_collection`
+    # the open frame is the shared panel span and the compiles fire
+    # later, during the fused group dispatch — per-metric stamps there
+    # would be last-writer-wins and could name the WRONG metric's
+    # bucket, so panel compiles carry site="torcheval.update_collection"
+    # and bucket=0 instead of a plausible lie.
+    if pad_cache is None:
+        from torcheval_tpu.obs.recorder import RECORDER as _OBS
+
+        if _OBS.enabled:
+            from torcheval_tpu.obs import trace as _obs_trace
+
+            _obs_trace.annotate(bucket=max(buckets.values(), default=0))
+
     # Always dispatch the masked kernel — even for exactly-bucket-sized
     # batches — so each bucket owns ONE program (kernel choice must not
     # depend on whether the batch happened to be a power of two).

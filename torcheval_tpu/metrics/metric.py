@@ -161,11 +161,18 @@ def _instrumented(fn, phase: str, cls_name: str):
     function — no host sync, no allocation (the recorder-ON/OFF parity is
     pinned by tests/metrics/test_no_host_sync.py and the observability
     bench). Recorder ON: the call is timed, annotated into the XLA trace
-    (``jax.profiler.TraceAnnotation``), and recorded as an
+    (``jax.profiler.TraceAnnotation``), wrapped in a causal-tracing span
+    frame (``obs/trace.py`` — a compile or retry fired inside parents to
+    this update, and the event carries trace/span/parent ids), fed into
+    the per-family latency digest (``obs/hist.py``), and recorded as an
     ``UpdateEvent``/``ComputeEvent``; updates also stamp ``obs_step``
     (the recorder's step cursor) on the metric — cleared by ``reset()``
-    and ``load_state_dict`` like ``sync_provenance``.
+    and ``load_state_dict`` like ``sync_provenance``. All of it is
+    host-side bookkeeping: zero host syncs, zero collectives (pinned by
+    the recorder-ON tier-1 variants).
     """
+    from torcheval_tpu.obs import hist as _obs_hist
+    from torcheval_tpu.obs import trace as _obs_trace
     from torcheval_tpu.obs.events import ComputeEvent, UpdateEvent
 
     label = f"torcheval.{phase}/{cls_name}"
@@ -176,17 +183,45 @@ def _instrumented(fn, phase: str, cls_name: str):
         if not _OBS.enabled:
             out = fn(self, *args, **kwargs)
             return _shield_compute_output(self, out) if is_compute else out
+        # inline frame management (not trace.Scope): this is THE hot
+        # instrumented path, and on a saturated box every µs of host
+        # python here is amplified by core competition with async XLA
+        # (see the bench `tracing` config's capture notes)
+        frame = _obs_trace.push(label)
         t0 = time.monotonic()
-        with jax.profiler.TraceAnnotation(label):
-            out = fn(self, *args, **kwargs)
+        try:
+            with jax.profiler.TraceAnnotation(label):
+                out = fn(self, *args, **kwargs)
+        except BaseException as e:
+            _obs_trace.capture_error(e)
+            raise
+        finally:
+            _obs_trace.pop(frame)
         seconds = time.monotonic() - t0
         name = type(self).__name__
+        _obs_hist.observe(f"{phase}/{name}", seconds)
         if phase == "update":
             self.obs_step = _OBS.step_cursor
-            _OBS.record(UpdateEvent(metric=name, seconds=seconds))
+            _OBS.record(
+                UpdateEvent(
+                    metric=name,
+                    seconds=seconds,
+                    trace=frame.trace_id,
+                    span=frame.span_id,
+                    parent=frame.parent_id,
+                )
+            )
         else:
             out = _shield_compute_output(self, out)
-            _OBS.record(ComputeEvent(metric=name, seconds=seconds))
+            _OBS.record(
+                ComputeEvent(
+                    metric=name,
+                    seconds=seconds,
+                    trace=frame.trace_id,
+                    span=frame.span_id,
+                    parent=frame.parent_id,
+                )
+            )
         return out
 
     wrapper._obs_instrumented = True

@@ -38,6 +38,7 @@ from torcheval_tpu.distributed import (
 )
 from torcheval_tpu.metrics.metric import Metric, TState
 from torcheval_tpu.metrics import synclib
+from torcheval_tpu.obs import trace as _obs_trace
 from torcheval_tpu.obs.recorder import RECORDER as _OBS
 from torcheval_tpu.resilience import (
     ResilientGroup,
@@ -266,8 +267,19 @@ def get_synced_metric_collection(
         payload = {name: m._sync_state_dict() for name, m in metrics.items()}
         template = metrics
 
-    sync_t0 = time.monotonic() if _OBS.enabled else 0.0
-    per_rank_states = synclib.sync_states(payload, group)
+    # causal tracing (recorder ON only): the sync runs inside a span
+    # frame, so resilience retries/degradations emitted underneath parent
+    # to it, and the SyncEvent carries a cross-rank FLOW ordinal — the
+    # N-th sync issued from this thread, identical on every rank by
+    # lockstep (obs/trace.py next_flow_id), which is what lets a merged
+    # Perfetto trace draw arrows between the same collective's spans on
+    # every contributing rank with zero extra communication.
+    sync_t0, sync_flow, sync_on = 0.0, 0, _OBS.enabled
+    if sync_on:
+        sync_flow = _obs_trace.next_flow_id()
+        sync_t0 = time.monotonic()
+    with _obs_trace.scope_or_null("torcheval.sync", sync_on) as sync_frame:
+        per_rank_states = synclib.sync_states(payload, group)
 
     # degraded-result provenance: which ranks actually contributed (full
     # participation unless a ResilientGroup degraded the exchange). The
@@ -294,13 +306,16 @@ def get_synced_metric_collection(
             "(policy %r); result may be stale.",
             list(ranks), world, provenance.policy,
         )
-    if _OBS.enabled:
+    if _OBS.enabled and sync_frame is not None:
         # the SyncEvent MIRRORS the provenance (bit-identical fields,
         # pinned by tests/metrics/test_observability.py) and adds the
         # wire-byte accounting synclib already computed from its
         # metadata exchange — host-side only, zero extra collectives
+        from torcheval_tpu.obs import hist as _obs_hist
         from torcheval_tpu.obs.events import SyncEvent
 
+        sync_seconds = time.monotonic() - sync_t0
+        _obs_hist.observe("sync", sync_seconds)
         _OBS.record(
             SyncEvent(
                 rank=group.rank,
@@ -312,7 +327,11 @@ def get_synced_metric_collection(
                 sent_bytes=getattr(per_rank_states, "sent_bytes", 0),
                 recv_bytes=getattr(per_rank_states, "recv_bytes", 0),
                 metrics=len(template),
-                seconds=time.monotonic() - sync_t0,
+                seconds=sync_seconds,
+                flow=sync_flow,
+                trace=sync_frame.trace_id,
+                span=sync_frame.span_id,
+                parent=sync_frame.parent_id,
             )
         )
 
@@ -479,59 +498,71 @@ def update_collection(
     groups = {False: ([], []), True: ([], [])}  # bucketed -> (fusable, plans)
     # one pad per (array, bucket) even when K metrics share the batch
     pad_cache: dict = {}
-    with shared_conversion_cache():
-        for metric in items:
-            plan = metric._update_plan(*args, **kwargs)
-            if plan is None:
-                fallback.append(metric)
+    # the whole fused panel is ONE span: fallback metrics' own update
+    # spans (and any compile the dispatch demands) parent to it, so a
+    # step's update tree has a single root
+    with _obs_trace.scope_or_null(
+        "torcheval.update_collection", obs_on
+    ) as panel_frame:
+        with shared_conversion_cache():
+            for metric in items:
+                plan = metric._update_plan(*args, **kwargs)
+                if plan is None:
+                    fallback.append(metric)
+                    continue
+                bucketed = False
+                if isinstance(plan, UpdatePlan):
+                    rewritten = apply_bucketing(plan, pad_cache)
+                    bucketed = rewritten is not plan
+                    plan = rewritten
+                    kernel, names, dynamic, config = (
+                        plan.kernel, plan.state_names, plan.dynamic, plan.config
+                    )
+                    transform, finalize = plan.transform, plan.finalize
+                else:
+                    kernel, names, dynamic, *rest = plan
+                    config = rest[0] if rest else ()
+                    transform, finalize = False, None
+                states = tuple(getattr(metric, n) for n in names)
+                fusable, plans = groups[bucketed]
+                fusable.append((metric, names, finalize))
+                plans.append((kernel, states, dynamic, config, transform))
+            # pass 2: execute — fallbacks still validate themselves, but
+            # only after every collected plan has passed validation
+            for metric in fallback:
+                metric.update(*args, **kwargs)
+        for fusable, plans in groups.values():
+            if not plans:
                 continue
-            bucketed = False
-            if isinstance(plan, UpdatePlan):
-                rewritten = apply_bucketing(plan, pad_cache)
-                bucketed = rewritten is not plan
-                plan = rewritten
-                kernel, names, dynamic, config = (
-                    plan.kernel, plan.state_names, plan.dynamic, plan.config
-                )
-                transform, finalize = plan.transform, plan.finalize
-            else:
-                kernel, names, dynamic, *rest = plan
-                config = rest[0] if rest else ()
-                transform, finalize = False, None
-            states = tuple(getattr(metric, n) for n in names)
-            fusable, plans = groups[bucketed]
-            fusable.append((metric, names, finalize))
-            plans.append((kernel, states, dynamic, config, transform))
-        # pass 2: execute — fallbacks still validate themselves, but only
-        # after every collected plan has passed validation
-        for metric in fallback:
-            metric.update(*args, **kwargs)
-    for fusable, plans in groups.values():
-        if not plans:
-            continue
-        # the group donation flag covers EVERY plan's states at once, so
-        # it is only set when all participating metrics follow the
-        # snapshot-copy discipline (Metric._donated_update, the default)
-        donate = all(m._donation_active() for m, _, _ in fusable)
-        new_states_group = fused_accumulate_group(plans, donate=donate)
-        for (metric, names, finalize), new_states in zip(
-            fusable, new_states_group
-        ):
-            for name, value in zip(names, new_states):
-                setattr(metric, name, value)
-            if finalize is not None:
-                finalize()
-    if obs_on:
+            # the group donation flag covers EVERY plan's states at once,
+            # so it is only set when all participating metrics follow the
+            # snapshot-copy discipline (Metric._donated_update, the default)
+            donate = all(m._donation_active() for m, _, _ in fusable)
+            new_states_group = fused_accumulate_group(plans, donate=donate)
+            for (metric, names, finalize), new_states in zip(
+                fusable, new_states_group
+            ):
+                for name, value in zip(names, new_states):
+                    setattr(metric, name, value)
+                if finalize is not None:
+                    finalize()
+    if obs_on and panel_frame is not None:
         # ONE event for the whole fused panel (plan-fused metrics bypass
         # their individual `update`, so this is their record; fallback
         # metrics already recorded their own UpdateEvents above)
+        from torcheval_tpu.obs import hist as _obs_hist
         from torcheval_tpu.obs.events import UpdateEvent
 
+        seconds = time.monotonic() - t0
+        _obs_hist.observe("update/update_collection", seconds)
         _OBS.record(
             UpdateEvent(
                 metric="update_collection",
-                seconds=time.monotonic() - t0,
+                seconds=seconds,
                 fused=len(items) - len(fallback),
+                trace=panel_frame.trace_id,
+                span=panel_frame.span_id,
+                parent=panel_frame.parent_id,
             )
         )
     return metrics

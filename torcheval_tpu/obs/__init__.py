@@ -45,10 +45,12 @@ line writer)::
 
 from torcheval_tpu.obs.counters import CounterRegistry, default_registry
 from torcheval_tpu.obs.events import (
+    SCHEMA_VERSION,
     AnalysisEvent,
     CompileEvent,
     ComputeEvent,
     Event,
+    MemoryEvent,
     RestoreEvent,
     RetryEvent,
     SnapshotEvent,
@@ -59,10 +61,21 @@ from torcheval_tpu.obs.events import (
 )
 from torcheval_tpu.obs.export import (
     JsonlWriter,
+    export_chrome_trace,
     format_report,
     gather_observability,
+    gather_traces,
     read_jsonl,
     render_prometheus,
+)
+from torcheval_tpu.obs.hist import LatencyHistogram
+from torcheval_tpu.obs.hist import snapshot as latency_snapshot
+from torcheval_tpu.obs.memory import (
+    memory_report,
+    metric_update_costs,
+    program_costs,
+    state_bytes,
+    track_metrics,
 )
 from torcheval_tpu.obs.recorder import (
     RECORDER,
@@ -74,8 +87,10 @@ from torcheval_tpu.obs.recorder import (
     recorder,
     span,
 )
+from torcheval_tpu.obs.trace import trace_path
 
 __all__ = [
+    "SCHEMA_VERSION",
     "AnalysisEvent",
     "CompileEvent",
     "ComputeEvent",
@@ -83,6 +98,8 @@ __all__ = [
     "Event",
     "EventLog",
     "JsonlWriter",
+    "LatencyHistogram",
+    "MemoryEvent",
     "Recorder",
     "RestoreEvent",
     "RetryEvent",
@@ -95,10 +112,19 @@ __all__ = [
     "enable",
     "enabled",
     "event_from_dict",
+    "export_chrome_trace",
     "format_report",
     "gather_observability",
+    "gather_traces",
+    "latency_snapshot",
+    "memory_report",
+    "metric_update_costs",
+    "program_costs",
     "read_jsonl",
     "recorder",
     "render_prometheus",
     "span",
+    "state_bytes",
+    "trace_path",
+    "track_metrics",
 ]

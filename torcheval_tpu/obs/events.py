@@ -11,12 +11,22 @@ full schema table). Every event carries the same timing envelope:
   loop is driving one;
 - ``rank``: the emitting rank for group-scoped events (sync, retry,
   snapshot, restore); ``None`` for process-local events (update, compute,
-  compile, span).
+  compile, span);
+- ``tid``: the emitting thread's identifier (stamped by
+  ``Recorder.record`` — the Chrome exporter's per-thread tracks);
+- ``trace``/``span``/``parent``: the causal-tracing ids
+  (``obs/trace.py``) — duration events carry their OWN span id (+ the
+  parent they nest under); point events recorded inside a span carry
+  the trace id and that span as ``parent``. ``None`` everywhere when no
+  span is open.
 
 Events are plain data: construct them anywhere, compare them with ``==``,
-serialize with :meth:`Event.as_dict` (JSON-safe: tuples become lists) and
-reconstruct with :func:`event_from_dict` (the JSONL exporter's round-trip
-contract, pinned by tests/metrics/test_observability.py).
+serialize with :meth:`Event.as_dict` (JSON-safe: tuples become lists, and
+every dict carries ``"schema": SCHEMA_VERSION`` so readers can detect
+future layout changes) and reconstruct with :func:`event_from_dict` (the
+JSONL exporter's round-trip contract, pinned by
+tests/metrics/test_observability.py; unknown fields from newer writers
+are ignored, pinned by tests/metrics/test_tracing.py).
 """
 
 from __future__ import annotations
@@ -26,10 +36,12 @@ from dataclasses import dataclass
 from typing import Any, ClassVar, Dict, Optional, Tuple, Type
 
 __all__ = [
+    "SCHEMA_VERSION",
     "AnalysisEvent",
     "CompileEvent",
     "ComputeEvent",
     "Event",
+    "MemoryEvent",
     "RestoreEvent",
     "RetryEvent",
     "SnapshotEvent",
@@ -38,6 +50,10 @@ __all__ = [
     "UpdateEvent",
     "event_from_dict",
 ]
+
+# Bumped only on an incompatible layout change; new OPTIONAL fields do
+# not bump it (readers ignore unknown keys by contract).
+SCHEMA_VERSION = 1
 
 
 @dataclass
@@ -52,10 +68,15 @@ class Event:
     t_wall: float = 0.0
     step: Optional[int] = None
     rank: Optional[int] = None
+    tid: Optional[int] = None
+    trace: Optional[int] = None
+    span: Optional[int] = None
+    parent: Optional[int] = None
 
     def as_dict(self) -> Dict[str, Any]:
-        """JSON-safe dict (``kind`` included, tuples become lists)."""
-        out: Dict[str, Any] = {"kind": self.kind}
+        """JSON-safe dict (``kind`` and ``schema`` included, tuples
+        become lists)."""
+        out: Dict[str, Any] = {"kind": self.kind, "schema": SCHEMA_VERSION}
         for f in dataclasses.fields(self):
             value = getattr(self, f.name)
             if isinstance(value, tuple):
@@ -109,6 +130,11 @@ class SyncEvent(Event):
     recv_bytes: int = 0
     metrics: int = 0
     seconds: float = 0.0
+    # cross-rank flow ordinal (obs/trace.py next_flow_id): the N-th sync
+    # issued from this thread — identical on every rank by lockstep, so
+    # merged traces can link the same collective across ranks with zero
+    # communication. 0 = no flow recorded.
+    flow: int = 0
 
 
 @dataclass
@@ -163,6 +189,14 @@ class CompileEvent(Event):
 
     seconds: float = 0.0
     cache_hit: bool = False
+    # causal attribution (obs/trace.py): the innermost open span at the
+    # moment the compile fired — e.g. "torcheval.update/MulticlassAccuracy"
+    # names the metric family that demanded the program — and the shape
+    # bucket length of the bucketed dispatch that triggered it (0 when
+    # the compile happened outside a bucketed dispatch). Ends the era of
+    # anonymous compile events from the CompileCounter bridge.
+    site: str = ""
+    bucket: int = 0
 
 
 @dataclass
@@ -174,6 +208,20 @@ class SpanEvent(Event):
 
     name: str = ""
     seconds: float = 0.0
+
+
+@dataclass
+class MemoryEvent(Event):
+    """One per-metric device-cost accounting snapshot
+    (``obs.memory.memory_report``): the bytes this metric's registered
+    state leaves pin in device memory, from a host-side metadata walk —
+    no step executes, no device sync."""
+
+    kind: ClassVar[str] = "memory"
+
+    metric: str = ""
+    state_bytes: int = 0
+    states: int = 0
 
 
 @dataclass
@@ -197,6 +245,7 @@ _EVENT_TYPES: Dict[str, Type[Event]] = {
     cls.kind: cls
     for cls in (
         AnalysisEvent,
+        MemoryEvent,
         UpdateEvent,
         ComputeEvent,
         SyncEvent,

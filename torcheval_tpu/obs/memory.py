@@ -1,0 +1,229 @@
+"""Device-cost accounting: what does a metric panel cost to keep?
+
+The reference paper's ``tools/`` layer answers this for MODELS (module
+summaries, FLOP counts); this module answers it for METRIC STATE and the
+programs that update it — the question a serving-scale eval panel has to
+answer before it can be scheduled: *how many device bytes does each
+metric's state pin, and what does one update program cost to run?*
+
+Three layers, all static — nothing here executes a step:
+
+- :func:`state_bytes` / :func:`memory_report` — per-metric state bytes
+  from a host-side walk of the REGISTERED state leaves (``jax.Array``
+  ``nbytes`` is shape×dtype metadata; int/float scalars count as 8).
+  Works on any constructed metric, fed or not.
+- :func:`program_costs` — per-program ``peak``/``temp``/``argument``
+  bytes via ``compiled.memory_analysis()`` and FLOPs via the
+  ``cost_analysis()`` path ``tools/flops.py`` established. Both APIs are
+  backend/version-dependent, so every field degrades to ``None`` rather
+  than raising (the jax-version posture of ``_ffi.py``).
+- :func:`metric_update_costs` — :func:`program_costs` of a metric's own
+  fused update program, lowered from its ``_update_plan`` with the
+  CURRENT state avals (the same program ``_apply_update_plan``
+  dispatches; compile-cached by jit, so repeated calls are cheap).
+
+:func:`track_metrics` federates the state-bytes walk into the
+``CounterRegistry`` as a pull-based source, so one Prometheus scrape
+answers "what does this metric panel cost" next to the sync/compile/
+snapshot counters (ISSUE 8 tentpole d).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Optional
+
+__all__ = [
+    "memory_report",
+    "metric_update_costs",
+    "program_costs",
+    "state_bytes",
+    "track_metrics",
+]
+
+
+def _leaf_bytes(value: Any) -> int:
+    """Device bytes of one TState leaf (metadata only — no device sync).
+
+    int/float scalar states count as 8 (one 64-bit host word): they live
+    on the host, but they are part of the state a sync ships and a
+    snapshot persists, so the report includes them rather than hiding
+    them at 0.
+    """
+    import jax
+
+    if isinstance(value, jax.Array):
+        return int(value.nbytes)
+    if isinstance(value, (int, float)):
+        return 8
+    if isinstance(value, (list, tuple)):
+        return sum(_leaf_bytes(v) for v in value)
+    if isinstance(value, dict):
+        return sum(_leaf_bytes(v) for v in value.values())
+    return 0
+
+
+def state_bytes(metric) -> Dict[str, int]:
+    """Per-state device bytes of one metric: ``{state_name: bytes}``
+    over the states registered via ``Metric._add_state`` (the same
+    registry ``state_dict``/sync/snapshot traverse)."""
+    return {
+        name: _leaf_bytes(getattr(metric, name))
+        for name in metric._state_name_to_default
+    }
+
+
+def memory_report(
+    metrics: Mapping[str, Any],
+) -> Dict[str, Dict[str, Any]]:
+    """Per-metric state-byte accounting for a ``{name: Metric}`` panel.
+
+    Returns ``{name: {"metric": class-name, "state_bytes": total,
+    "states": {state: bytes}}}``. Pure metadata walk — no step executes,
+    no device sync, no collective (pinned by the transfer-guard variant
+    in tests/metrics/test_tracing.py). When the observability recorder
+    is on, one :class:`~torcheval_tpu.obs.events.MemoryEvent` per metric
+    lands in the event stream.
+    """
+    from torcheval_tpu.obs.recorder import RECORDER
+
+    report: Dict[str, Dict[str, Any]] = {}
+    for name, metric in metrics.items():
+        per_state = state_bytes(metric)
+        total = sum(per_state.values())
+        report[name] = {
+            "metric": type(metric).__name__,
+            "state_bytes": total,
+            "states": per_state,
+        }
+        if RECORDER.enabled:
+            from torcheval_tpu.obs.events import MemoryEvent
+
+            RECORDER.record(
+                MemoryEvent(
+                    metric=name,
+                    state_bytes=total,
+                    states=len(per_state),
+                )
+            )
+    return report
+
+
+def program_costs(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Dict[str, Optional[float]]:
+    """Compile-time cost sheet of one jittable call: FLOPs (the
+    ``tools/flops.py`` cost-analysis path) and bytes from
+    ``compiled.memory_analysis()``. Args may be arrays or
+    ``jax.ShapeDtypeStruct`` avals — nothing executes.
+
+    Returns ``{"flops", "argument_bytes", "output_bytes", "temp_bytes",
+    "peak_bytes", "generated_code_bytes"}``; any field the jax version
+    or backend cannot supply is ``None`` (never raises for a missing
+    API). ``peak_bytes`` is the buffer-liveness upper bound
+    ``argument + output + temp`` when XLA does not report a tighter
+    peak directly.
+    """
+    import jax
+
+    out: Dict[str, Optional[float]] = {
+        "flops": None,
+        "argument_bytes": None,
+        "output_bytes": None,
+        "temp_bytes": None,
+        "peak_bytes": None,
+        "generated_code_bytes": None,
+    }
+    try:
+        compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    except Exception:  # noqa: BLE001 — a non-lowerable fn costs None, not a crash
+        return out
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — version/backend-dependent API
+        ma = None
+    if ma is not None:
+        for field, attr in (
+            ("argument_bytes", "argument_size_in_bytes"),
+            ("output_bytes", "output_size_in_bytes"),
+            ("temp_bytes", "temp_size_in_bytes"),
+            ("generated_code_bytes", "generated_code_size_in_bytes"),
+        ):
+            value = getattr(ma, attr, None)
+            if value is not None:
+                out[field] = int(value)
+        peak = getattr(ma, "peak_memory_in_bytes", None)
+        if peak is None and None not in (
+            out["argument_bytes"], out["output_bytes"], out["temp_bytes"]
+        ):
+            peak = out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]  # type: ignore[operator]
+        if peak is not None:
+            out["peak_bytes"] = int(peak)
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):  # older jax: one dict per device program
+            ca = ca[0] if ca else None
+        if ca and "flops" in ca:
+            out["flops"] = float(ca["flops"])
+    except Exception:  # noqa: BLE001 — version/backend-dependent API
+        pass
+    return out
+
+
+def metric_update_costs(metric, *args: Any, **kwargs: Any) -> Optional[Dict[str, Optional[float]]]:
+    """:func:`program_costs` of ``metric``'s fused update program for
+    one example batch — the program ``_apply_update_plan`` actually
+    dispatches, lowered with the metric's live state avals. Returns
+    ``None`` for metrics without a fusable plan (host-side text
+    processing, buffered appends)."""
+    from torcheval_tpu.metrics import _fuse
+    from torcheval_tpu.metrics.metric import UpdatePlan
+
+    plan = metric._update_plan(*args, **kwargs)
+    if plan is None:
+        return None
+    if isinstance(plan, UpdatePlan):
+        kernel, names, dynamic, config = (
+            plan.kernel, plan.state_names, plan.dynamic, plan.config
+        )
+        transform = plan.transform
+    else:
+        kernel, names, dynamic, *rest = plan
+        config = rest[0] if rest else ()
+        transform = False
+    states = tuple(getattr(metric, n) for n in names)
+    apply_fn = _fuse._apply_transform if transform else _fuse._apply_kernel
+
+    def fused(states, *dyn):
+        return apply_fn(kernel, config, states, dyn)
+
+    return program_costs(fused, states, *dynamic)
+
+
+def track_metrics(
+    metrics: Mapping[str, Any],
+    *,
+    source: str = "memory",
+    registry=None,
+) -> Callable[[], Dict[str, Any]]:
+    """Register a pull-based ``{metric}_state_bytes`` counter source for
+    a metric panel, so ``render_prometheus()`` / ``format_report()`` /
+    ``gather_observability()`` carry the panel's device-byte cost next
+    to the existing counters. The MAPPING is captured, not a snapshot:
+    every scrape re-walks the live metrics (zero cost between scrapes —
+    the ``CounterRegistry`` supplier contract). Returns the supplier;
+    unregister with ``registry.unregister(source)``."""
+    from torcheval_tpu.obs.counters import default_registry
+
+    if registry is None:
+        registry = default_registry()
+
+    def supplier() -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        total = 0
+        for name, metric in metrics.items():
+            n = sum(state_bytes(metric).values())
+            out[f"{name}_state_bytes"] = n
+            total += n
+        out["total_state_bytes"] = total
+        return out
+
+    registry.register(source, supplier)
+    return supplier

@@ -34,6 +34,7 @@ from collections import deque
 from typing import Dict, Iterator, List, Optional
 
 from torcheval_tpu import config
+from torcheval_tpu.obs import trace as _trace
 from torcheval_tpu.obs.events import Event, SpanEvent
 
 __all__ = ["EventLog", "Recorder", "RECORDER", "enable", "disable", "enabled", "recorder", "span"]
@@ -96,7 +97,9 @@ class _Span:
     """Context manager timing one named phase.
 
     Enters a ``jax.profiler.TraceAnnotation`` so the phase shows up in
-    XLA traces (TensorBoard/Perfetto), and records a
+    XLA traces (TensorBoard/Perfetto), opens a causal-tracing frame
+    (``obs/trace.py`` — nested spans and events recorded inside parent
+    to this one), and records a
     :class:`~torcheval_tpu.obs.events.SpanEvent` with the measured wall
     duration on exit.
     """
@@ -107,22 +110,33 @@ class _Span:
         self.seconds = 0.0
         self._t0 = 0.0
         self._annotation = None
+        self._scope = _trace.Scope(name)
+        self.frame = None
 
     def __enter__(self) -> "_Span":
         import jax
 
         self._annotation = jax.profiler.TraceAnnotation(self.name)
         self._annotation.__enter__()
+        self.frame = self._scope.__enter__()
         self._t0 = time.monotonic()
         return self
 
     def __exit__(self, *exc_info) -> None:
         self.seconds = time.monotonic() - self._t0
         try:
+            self._scope.__exit__(*exc_info)
             self._annotation.__exit__(*exc_info)
         finally:
+            frame = self.frame
             self._recorder.record(
-                SpanEvent(name=self.name, seconds=self.seconds)
+                SpanEvent(
+                    name=self.name,
+                    seconds=self.seconds,
+                    trace=frame.trace_id if frame else None,
+                    span=frame.span_id if frame else None,
+                    parent=frame.parent_id if frame else None,
+                )
             )
 
 
@@ -191,8 +205,23 @@ class Recorder:
 
         def sink(what: str, seconds: float) -> None:
             if self.enabled:
+                # causal attribution: the innermost open span at compile
+                # time NAMES the site that demanded the program (e.g. the
+                # update wrapper's "torcheval.update/<Metric>"), and the
+                # bucketed dispatch annotates its bucket length on the
+                # frame — a retrace is no longer an anonymous event
+                frame = _trace.current()
                 self.record(
-                    CompileEvent(seconds=seconds, cache_hit=(what == "cache_hit"))
+                    CompileEvent(
+                        seconds=seconds,
+                        cache_hit=(what == "cache_hit"),
+                        site=frame.name if frame is not None else "",
+                        bucket=(
+                            int(frame.annotations.get("bucket", 0))
+                            if frame is not None
+                            else 0
+                        ),
+                    )
                 )
 
         compile_counter.add_event_sink(sink)
@@ -214,6 +243,17 @@ class Recorder:
             event.t_wall = time.time()
         if event.step is None:
             event.step = self.step_cursor
+        if event.tid is None:
+            event.tid = threading.get_ident()
+        if event.trace is None:
+            # causal stamp: a point event recorded inside an open span
+            # inherits its trace and parents to it (duration events set
+            # their own span/parent before recording and skip this)
+            frame = _trace.current()
+            if frame is not None:
+                event.trace = frame.trace_id
+                if event.span is None and event.parent is None:
+                    event.parent = frame.span_id
         self.log.append(event)
         writer = self._writer
         if writer is not None:

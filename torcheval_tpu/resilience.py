@@ -694,6 +694,30 @@ class ResilientGroup(ProcessGroup):
         fn: Callable[[], List[Any]],
         local_only: Callable[[], Tuple[List[Any], List[int]]],
     ) -> Tuple[List[Any], List[int]]:
+        """Observability shell around :meth:`_resilient_impl`: with the
+        recorder on, the whole collective (every retry attempt and the
+        degradation decision) runs inside ONE span — the
+        ``RetryEvent``\\ s emitted underneath parent to it, giving the
+        per-collective, per-peer timing telemetry Prime-CCL-style
+        operations need — and its wall time feeds the ``collective``
+        latency digest. Recorder off: one attribute read, the original
+        path."""
+        if not _OBS.enabled:
+            return self._resilient_impl(fn, local_only)
+        from torcheval_tpu.obs import hist as _obs_hist
+
+        t0 = time.monotonic()
+        try:
+            with _OBS.span("torcheval.collective"):
+                return self._resilient_impl(fn, local_only)
+        finally:
+            _obs_hist.observe("collective", time.monotonic() - t0)
+
+    def _resilient_impl(
+        self,
+        fn: Callable[[], List[Any]],
+        local_only: Callable[[], Tuple[List[Any], List[int]]],
+    ) -> Tuple[List[Any], List[int]]:
         """Run one collective with retries, then apply the degradation
         policy. Returns ``(payloads, participating_ranks)``, rank-aligned
         and ascending.
