@@ -1431,6 +1431,200 @@ def run_tracing():
     }
 
 
+def run_sharded_state():
+    """Config 13: sharded metric state (ZeRO-for-metrics, ISSUE 9).
+
+    For two big-state workloads — an 8192-class confusion matrix
+    ((C, C) int32, 256 MiB logical) and a 1,048,576-bin histogram binned
+    AUROC ((2T,) int32, 8 MiB logical) — this config measures, sharded
+    (eager ShardContext, world 4) vs replicated:
+
+    - ``logical_bytes`` / ``per_rank_bytes``: what one replica would pin
+      vs what this rank pins (``obs.memory_report`` metadata walk), with
+      the acceptance flag ``per_rank_within_bound`` pinning
+      ``per_rank <= logical/world + 64 KiB`` (the outbox/bookkeeping
+      constant);
+    - ``sync_payload_bytes``: the wire bytes one rank ships per sync
+      (``_sync_state_dict`` leaf walk — the shard + trimmed outbox vs
+      the full replica), with ``wire_below_replicated`` flagging the
+      strict inequality the acceptance demands;
+    - ``update_us``: INTERLEAVED PAIRED-DIFFERENCES step timing — each
+      round updates the sharded then the replicated instance on the SAME
+      device batch and records both walls plus their difference; the
+      published estimate is the median of per-round differences (the r10
+      estimator: per-arm minima cannot resolve arm deltas on this box's
+      ±2% noise floor, but co-load cancels inside a pair);
+    - ``compute_us``: min-of-rounds compute wall (the sharded compute
+      includes its logical-view assembly — the honest gather cost).
+
+    Bit-identity of sharded vs replicated results is pinned by tier-1
+    (tests/metrics/test_shardspec.py), not re-proven here.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torcheval_tpu.metrics import (
+        HistogramBinnedAUROC,
+        MulticlassConfusionMatrix,
+        ShardContext,
+    )
+    from torcheval_tpu.obs.memory import (
+        _leaf_bytes,
+        logical_state_bytes,
+        per_rank_state_bytes,
+    )
+
+    world = 4
+    rounds = 10
+    bound_const = 64 * 1024
+    rng = np.random.default_rng(13)
+    out = {
+        "world": world,
+        "rounds": rounds,
+        "estimator": "median of per-round (replicated - sharded) pairs",
+        "per_rank_bound_const_bytes": bound_const,
+    }
+
+    def measure(name, make_replicated, make_sharded, batches):
+        rep, sh = make_replicated(), make_sharded()
+        for b in batches[:2]:
+            rep.update(*b)
+            sh.update(*b)
+        jax.block_until_ready(
+            [getattr(rep, n) for n in rep._state_name_to_default
+             if isinstance(getattr(rep, n), jax.Array)]
+        )
+        sh_us, rep_us, diffs = [], [], []
+        for r in range(rounds):
+            b = batches[2 + (r % (len(batches) - 2))]
+            t0 = time.perf_counter()
+            sh.update(*b)
+            jax.block_until_ready(getattr(sh, list(sh._sharded_states)[0]))
+            t1 = time.perf_counter()
+            rep.update(*b)
+            jax.block_until_ready(getattr(rep, list(sh._sharded_states)[0]))
+            t2 = time.perf_counter()
+            sh_us.append((t1 - t0) * 1e6)
+            rep_us.append((t2 - t1) * 1e6)
+            diffs.append((t2 - t1) * 1e6 - (t1 - t0) * 1e6)
+        diffs.sort()
+
+        def _compute_us(m):
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready(
+                    jax.tree_util.tree_leaves(m.compute())
+                )
+                ts.append((time.perf_counter() - t0) * 1e6)
+            return round(min(ts), 1)
+
+        def _payload_bytes(m):
+            return int(
+                sum(_leaf_bytes(v) for v in m._sync_state_dict().values())
+            )
+
+        # wire bytes at the point of sync: shard + pow2-trimmed outbox
+        # accumulated over the timing rounds vs the full replica
+        sh_payload = _payload_bytes(sh)
+        rep_payload = _payload_bytes(rep)
+        # per-rank steady state: sharded loops drain the outbox by
+        # adopting the synced result (toolkit.adopt_synced); emulate one
+        # adopt cycle — merge this rank's carrier into the logical state
+        # and re-load — then leave ONE batch pending, which is the
+        # steady-state footprint the acceptance bound is about
+        import copy as _copy
+
+        merged = _copy.deepcopy(sh)
+        merged.merge_state([])
+        sh.load_state_dict(merged.state_dict())
+        del merged
+        sh.update(*batches[0])
+        logical = sum(logical_state_bytes(sh).values())
+        per_rank = sum(per_rank_state_bytes(sh).values())
+        entry = {
+            "logical_bytes": logical,
+            "per_rank_bytes": per_rank,
+            "replicated_per_rank_bytes": int(
+                sum(per_rank_state_bytes(rep).values())
+            ),
+            "per_rank_within_bound": per_rank
+            <= logical // world + bound_const,
+            "sync_payload_bytes": {
+                "sharded": sh_payload,
+                "replicated": rep_payload,
+            },
+            "wire_below_replicated": sh_payload < rep_payload,
+            "update_us": {
+                "sharded_min": round(min(sh_us), 1),
+                "replicated_min": round(min(rep_us), 1),
+                "paired_diff_median": round(
+                    diffs[len(diffs) // 2], 1
+                ),
+            },
+            "compute_us": {
+                "sharded": _compute_us(sh),
+                "replicated": _compute_us(rep),
+            },
+        }
+        out[name] = entry
+
+    C = 8192
+    cm_batches = [
+        (
+            jnp.asarray(rng.integers(0, C, 1024).astype(np.int32)),
+            jnp.asarray(rng.integers(0, C, 1024).astype(np.int32)),
+        )
+        for _ in range(6)
+    ]
+    measure(
+        "confusion_8k",
+        lambda: MulticlassConfusionMatrix(C),
+        lambda: MulticlassConfusionMatrix(C, shard=ShardContext(0, world)),
+        cm_batches,
+    )
+    T = 1 << 20
+    au_batches = [
+        (
+            jnp.asarray(rng.uniform(size=4096).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 2, 4096).astype(np.int32)),
+        )
+        for _ in range(6)
+    ]
+    measure(
+        "binned_auroc_1m",
+        lambda: HistogramBinnedAUROC(threshold=T),
+        lambda: HistogramBinnedAUROC(
+            threshold=T, shard=ShardContext(0, world)
+        ),
+        au_batches,
+    )
+    out["acceptance"] = {
+        "per_rank_within_bound": all(
+            out[k]["per_rank_within_bound"]
+            for k in ("confusion_8k", "binned_auroc_1m")
+        ),
+        "wire_below_replicated": all(
+            out[k]["wire_below_replicated"]
+            for k in ("confusion_8k", "binned_auroc_1m")
+        ),
+    }
+    return {
+        "metric": (
+            "sharded metric state: per-rank bytes + sync wire + step time, "
+            f"sharded (world {world}) vs replicated"
+        ),
+        "value": round(
+            out["confusion_8k"]["logical_bytes"]
+            / max(out["confusion_8k"]["per_rank_bytes"], 1),
+            2,
+        ),
+        "unit": "x per-rank state reduction (8k-class confusion matrix)",
+        "sharded_state": out,
+    }
+
+
 def run_probe():
     """Tiny op on the default backend — proves the platform is claimable."""
     import jax
@@ -1763,6 +1957,68 @@ def run_kernels():
             lambda: tk_xla_j(tk_x),
             n_samples=[tk_tasks, tk_n], k=tk_k,
         )
+        # the round-11 small-row gap (64x1000 only ~1.3x): per-row fixed
+        # costs — the low initial selection threshold sending the early
+        # chunks through the scalar insert path, at two heap sifts per
+        # displacement — stopped amortizing at n=1000. topk.cc's seed
+        # window (heap primed from the first 4k+64 elements) plus the
+        # single sift-down ReplaceMin narrow it; this arm pins the shape.
+        tks_tasks, tks_n, tks_k = 64, 1000, 8
+        tks_x = jax.device_put(
+            jnp.asarray(
+                rng.normal(size=(tks_tasks, tks_n)).astype(np.float32)
+            ),
+            cpu0,
+        )
+        tks_native_j = jax.jit(lambda x: topk_op(x, tks_k))
+        tks_xla_j = jax.jit(lambda x: _topk_xla(x, tks_k))
+
+        def _pipelined_pair(fn_a, fn_b, loop=48, rounds=10):
+            """Per-call amortized µs of BOTH arms, rounds interleaved.
+            The per-call-blocked ``_min_us`` floors a ~100 µs op at the
+            XLA:CPU dispatch latency both arms pay, hiding the
+            kernel-time gap the small-row fix is about — an eval loop
+            runs pipelined, so throughput is the steady-state quantity —
+            and interleaving the arms' rounds keeps this box's multi-x
+            whole-run load swings from landing on one arm only."""
+            jax.block_until_ready(fn_a())
+            jax.block_until_ready(fn_b())
+            best_a = best_b = float("inf")
+            for _ in range(rounds):
+                for which, fn in ((0, fn_a), (1, fn_b)):
+                    t0 = time.perf_counter()
+                    r = None
+                    for _ in range(loop):
+                        r = fn()
+                    jax.block_until_ready(r)
+                    us = (time.perf_counter() - t0) / loop * 1e6
+                    if which == 0:
+                        best_a = min(best_a, us)
+                    else:
+                        best_b = min(best_b, us)
+            return round(best_a, 1), round(best_b, 1)
+
+        try:
+            tks_nat_us, tks_xla_us = _pipelined_pair(
+                lambda: tks_native_j(tks_x), lambda: tks_xla_j(tks_x)
+            )
+            entry = {
+                "n_samples": [tks_tasks, tks_n],
+                "k": tks_k,
+                "estimator": (
+                    "pipelined throughput (48-deep dispatch), "
+                    "arm rounds interleaved"
+                ),
+                "native_us": tks_nat_us,
+                "xla_us": tks_xla_us,
+            }
+            entry["xla_over_native"] = round(
+                entry["xla_us"] / entry["native_us"], 2
+            )
+            entry["meets_2x"] = entry["xla_over_native"] >= 2.0
+            nc["topk_small"] = entry
+        except Exception as e:  # noqa: BLE001
+            nc["topk_small"] = {"error": str(e)[-200:]}
     out["native_cpu"] = nc
     out["donation"] = _donation_arm()
 
@@ -2198,6 +2454,7 @@ CONFIGS = {
     "checkpoint": (run_checkpoint, None),  # snapshot-overhead audit
     "observability": (run_observability, None),  # recorder-overhead audit
     "tracing": (run_tracing, None),  # causal-tracing-overhead audit
+    "sharded_state": (run_sharded_state, None),  # ZeRO-for-metrics audit
 }
 
 _NO_REF_NOTES = {
@@ -2227,6 +2484,10 @@ _NO_REF_NOTES = {
     "tracing": (
         "causal-tracing-overhead audit — the reference has no tracing "
         "layer, so the comparison is our own recorder-off loop"
+    ),
+    "sharded_state": (
+        "sharded-state audit — the reference replicates every state, so "
+        "the comparison is our own replicated arm"
     ),
 }
 
@@ -2258,7 +2519,7 @@ def _cache_env(env):
 # actually need, and one the torch reference children never pay.
 _SINGLE_DEVICE_CONFIGS = {
     "accuracy_update", "auroc_compute", "text_eval", "fid", "kernels",
-    "variable_batch",
+    "variable_batch", "sharded_state",
 }
 
 

@@ -10,6 +10,7 @@ API), so a metric added there is automatically swept here.
 
 from __future__ import annotations
 
+import numpy as np
 import pytest
 
 from tests.metrics.test_no_host_sync import CLASS_CASES
@@ -17,7 +18,57 @@ from torcheval_tpu.analysis import (
     verify_metric_compute,
     verify_metric_merge,
     verify_metric_update,
+    verify_program,
 )
+
+RNG = np.random.default_rng(23)
+_X16 = RNG.integers(0, 16, 64)
+_T16 = RNG.integers(0, 16, 64)
+_XB = RNG.uniform(size=64).astype(np.float32)
+_TB = RNG.integers(0, 2, 64).astype(np.int32)
+_CTR = RNG.integers(0, 2, (8, 16)).astype(np.float32)
+_CTW = RNG.uniform(0.5, 2.0, (8, 16)).astype(np.float32)
+
+
+def _sharded_cases():
+    """Every SHARDED family's instances for the static sweep (ISSUE 9):
+    the update program must stay host-escape-free, zero-collective, and
+    donation-alias-sound even though it now routes through the scatter
+    kernel + outbox append, and compute/merge must verify like any
+    family. Built lazily — constructing sharded metrics registers their
+    outbox states."""
+    from torcheval_tpu.metrics import (
+        HistogramBinnedAUROC,
+        MulticlassConfusionMatrix,
+        ShardContext,
+        WindowedClickThroughRate,
+    )
+
+    return {
+        "MulticlassConfusionMatrix[sharded]": (
+            lambda: MulticlassConfusionMatrix(16, shard=ShardContext(1, 4)),
+            (_X16, _T16),
+        ),
+        "HistogramBinnedAUROC": (
+            lambda: HistogramBinnedAUROC(threshold=32),
+            (_XB, _TB),
+        ),
+        "HistogramBinnedAUROC[sharded]": (
+            lambda: HistogramBinnedAUROC(
+                threshold=32, shard=ShardContext(1, 4)
+            ),
+            (_XB, _TB),
+        ),
+        "WindowedClickThroughRate[sharded]": (
+            lambda: WindowedClickThroughRate(
+                num_tasks=8, max_num_updates=4, shard=ShardContext(1, 4)
+            ),
+            (_CTR, _CTW),
+        ),
+    }
+
+
+SHARDED_CASES = _sharded_cases()
 
 
 def _errors(report):
@@ -92,3 +143,155 @@ def test_merge_program_is_local_math(name):
     report = verify_metric_merge(metric)
     assert not _errors(report), "\n" + report.format_text()
     assert report.collectives == ()
+
+
+# ----------------------------------------------- sharded families (ISSUE 9)
+
+
+@pytest.mark.parametrize("name", sorted(SHARDED_CASES))
+def test_sharded_update_program_is_verified_statically(name):
+    """The sharded scatter-route update (owned-cell segment scatter +
+    outbox append) keeps every local-update contract: no host escapes,
+    ZERO collectives, dtype-safe — statically, without executing."""
+    make, args = SHARDED_CASES[name]
+    report = verify_metric_update(make(), *args)
+    assert report is not None
+    assert report.ok, "\n" + report.format_text()
+    assert report.collectives == (), report.collectives
+    assert report.hlo_collectives == (), report.hlo_collectives
+    assert report.host_escapes == ()
+
+
+@pytest.mark.parametrize("name", sorted(SHARDED_CASES))
+def test_sharded_update_donated_variant_is_alias_sound(name):
+    """Donation soundness of the sharded update: the shard add and the
+    outbox ``dynamic_update_slice`` must alias in place in the optimized
+    module (the 0-d outbox cursor may legally re-materialize — warning
+    severity by house rules)."""
+    make, args = SHARDED_CASES[name]
+    report = verify_metric_update(make(), *args, donate=True)
+    assert report is not None
+    assert report.ok, "\n" + report.format_text()
+    assert report.donated_params
+    assert report.aliased_params
+
+
+@pytest.mark.parametrize("name", sorted(SHARDED_CASES))
+def test_sharded_compute_program_has_no_errors(name):
+    """The carrier compute (local logical-view assembly + the family
+    kernel) must not host-escape or leak 64-bit dtypes."""
+    make, args = SHARDED_CASES[name]
+    metric = make()
+    metric.update(*args)
+    report = verify_metric_compute(metric)
+    assert not _errors(report), "\n" + report.format_text()
+
+
+@pytest.mark.parametrize("name", sorted(SHARDED_CASES))
+def test_sharded_merge_program_is_local_math(name):
+    """The reassembling sharded merge (shard placement + outbox counts
+    application) is local math: zero collectives, no host escapes."""
+    make, args = SHARDED_CASES[name]
+    metric = make()
+    metric.update(*args)
+    report = verify_metric_merge(metric)
+    assert not _errors(report), "\n" + report.format_text()
+    assert report.collectives == ()
+
+
+def test_owner_partitioned_sync_lowers_to_one_reduce_scatter():
+    """ISSUE 9 acceptance: the sharded in-jit sync program's collective
+    census is exactly ONE owner-shard reduction — jaxpr ``psum_scatter``,
+    optimized-HLO ``reduce-scatter`` — never an all-reduce that would
+    re-materialize a replica, and no host escapes."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    from torcheval_tpu.metrics import ShardSpec
+    from torcheval_tpu.metrics.metric import MergeKind
+    from torcheval_tpu.metrics.sharded import sync_states_in_jit
+
+    devices = jax.devices("cpu")
+    if len(devices) < 8:
+        pytest.skip("needs xla_force_host_platform_device_count=8")
+    mesh = Mesh(np.array(devices[:8]), ("dp",))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("dp"), P("dp")),
+        out_specs={"cm": P("dp")},
+        check_rep=False,
+    )
+    def sync_step(state_block, delta):
+        synced = sync_states_in_jit(
+            {"cm": delta},
+            "dp",
+            {"cm": MergeKind.SUM},
+            shard_specs={"cm": ShardSpec(axis=0)},
+        )
+        return {"cm": state_block + synced["cm"]}
+
+    state = jax.ShapeDtypeStruct((64, 16), jnp.int32)
+    delta = jax.ShapeDtypeStruct((64, 16), jnp.int32)
+    report = verify_program(
+        sync_step,
+        state,
+        delta,
+        name="sharded_sync_step",
+        expect_collectives=1,
+        expect_hlo_collectives=["reduce-scatter"],
+    )
+    assert report.ok, "\n" + report.format_text()
+    # jax spells lax.psum_scatter's primitive `reduce_scatter` on 0.4.x
+    # and `psum_scatter` on newer releases; either is the one owner-shard
+    # reduction the census must show
+    assert report.collectives[0] in ("psum_scatter", "reduce_scatter")
+    assert report.host_escapes == ()
+
+
+def test_replicated_vs_sharded_sync_collective_sequences_differ_as_declared():
+    """The same SUM state synced replicated lowers to an all-reduce; the
+    owner-partitioned form to a reduce-scatter — the declared sequence
+    swap, pinned on optimized HLO so a silent fallback to all-reduce
+    (which would undo the wire reduction) fails the census."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    from torcheval_tpu.metrics.metric import MergeKind
+    from torcheval_tpu.metrics.sharded import sync_states_in_jit
+
+    devices = jax.devices("cpu")
+    if len(devices) < 8:
+        pytest.skip("needs xla_force_host_platform_device_count=8")
+    mesh = Mesh(np.array(devices[:8]), ("dp",))
+
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp"),), out_specs=P())
+    def replicated_sync(delta):
+        return sync_states_in_jit(
+            {"cm": jnp.sum(delta, axis=0)}, "dp", {"cm": MergeKind.SUM}
+        )
+
+    report = verify_program(
+        replicated_sync,
+        jax.ShapeDtypeStruct((64, 16), jnp.float32),
+        name="replicated_sync_step",
+        expect_hlo_collectives=["all-reduce"],
+    )
+    assert report.ok, "\n" + report.format_text()

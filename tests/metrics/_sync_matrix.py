@@ -317,6 +317,10 @@ def build_cases() -> Dict[str, Case]:
         "BinaryConfusionMatrix": (
             lambda: M.BinaryConfusionMatrix(), _bin_pair("BinaryConfusionMatrix")
         ),
+        "HistogramBinnedAUROC": (
+            lambda: M.HistogramBinnedAUROC(threshold=7),
+            _bin_pair("HistogramBinnedAUROC"),
+        ),
         "BinaryF1Score": (lambda: M.BinaryF1Score(), _bin_pair("BinaryF1Score")),
         "BinaryNormalizedEntropy": (
             lambda: M.BinaryNormalizedEntropy(),

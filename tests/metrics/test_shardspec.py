@@ -1,0 +1,491 @@
+"""Sharded metric state (ISSUE 9): ZeRO-for-metrics acceptance pins.
+
+Per-rank state bytes and sync wire must drop to ~size/world, while
+``compute()`` after a sync stays BIT-identical to the replicated merge
+oracle — on the eager ThreadWorld path and on the 8-virtual-device mesh.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torcheval_tpu.metrics import (
+    HistogramBinnedAUROC,
+    MulticlassConfusionMatrix,
+    ShardContext,
+    ShardSpec,
+    WindowedClickThroughRate,
+)
+from torcheval_tpu.metrics.toolkit import (
+    adopt_synced,
+    get_synced_metric,
+    sync_and_compute,
+    update_collection,
+)
+from torcheval_tpu.utils.test_utils import ThreadWorld
+
+RNG = np.random.default_rng(90)
+C, WORLD = 16, 4
+CM_BATCHES = [
+    (RNG.integers(0, C, 64), RNG.integers(0, C, 64)) for _ in range(8)
+]
+AU_BATCHES = [
+    (
+        RNG.uniform(size=64).astype(np.float32),
+        RNG.integers(0, 2, 64).astype(np.int32),
+    )
+    for _ in range(8)
+]
+
+
+def _cm_oracle():
+    """Replicated merge oracle: one metric per rank fed its stream, all
+    merged in rank order — the semantics every sync reproduces."""
+    ranks = [MulticlassConfusionMatrix(C) for _ in range(WORLD)]
+    for r in range(WORLD):
+        for i in range(r, len(CM_BATCHES), WORLD):
+            ranks[r].update(*CM_BATCHES[i])
+    target = copy.deepcopy(ranks[0])
+    target.merge_state(ranks[1:])
+    return np.asarray(target.compute())
+
+
+def _cm_shards():
+    shards = [
+        MulticlassConfusionMatrix(C, shard=ShardContext(r, WORLD))
+        for r in range(WORLD)
+    ]
+    for r in range(WORLD):
+        for i in range(r, len(CM_BATCHES), WORLD):
+            shards[r].update(*CM_BATCHES[i])
+    return shards
+
+
+# ------------------------------------------------------------- eager path
+
+
+def test_sharded_merge_bit_identical_to_replicated_oracle():
+    oracle = _cm_oracle()
+    shards = _cm_shards()
+    target = copy.deepcopy(shards[0])
+    target.merge_state(shards[1:])
+    np.testing.assert_array_equal(np.asarray(target.compute()), oracle)
+
+
+def test_shard_shapes_and_carrier_descriptor():
+    m = MulticlassConfusionMatrix(C, shard=ShardContext(2, WORLD))
+    assert m.confusion_matrix.shape == (C // WORLD, C)
+    assert m._shard_rank == 2 and m._shard_world == WORLD
+    assert "confusion_matrix" in m._routed_states
+
+
+def test_local_compute_equals_replicated_local_compute():
+    """A shard carrier's un-synced compute() assembles its LOCAL logical
+    view (own shard + own outbox) — bit-identical to a replicated
+    metric's local compute on the same stream."""
+    sh = MulticlassConfusionMatrix(C, shard=ShardContext(1, WORLD))
+    rep = MulticlassConfusionMatrix(C)
+    for i in range(1, len(CM_BATCHES), WORLD):
+        sh.update(*CM_BATCHES[i])
+        rep.update(*CM_BATCHES[i])
+    np.testing.assert_array_equal(
+        np.asarray(sh.compute()), np.asarray(rep.compute())
+    )
+
+
+def test_threadworld_sync_and_compute_matches_oracle():
+    oracle = _cm_oracle()
+
+    def body(g):
+        m = MulticlassConfusionMatrix(C, shard=ShardContext(g.rank, WORLD))
+        for i in range(g.rank, len(CM_BATCHES), WORLD):
+            m.update(*CM_BATCHES[i])
+        return np.asarray(sync_and_compute(m, g))
+
+    for result in ThreadWorld(WORLD).run(body):
+        np.testing.assert_array_equal(result, oracle)
+
+
+def test_adopt_synced_drains_outbox_and_reshards():
+    oracle = _cm_oracle()
+
+    def body(g):
+        m = MulticlassConfusionMatrix(C, shard=ShardContext(g.rank, WORLD))
+        for i in range(g.rank, len(CM_BATCHES), WORLD):
+            m.update(*CM_BATCHES[i])
+        assert int(m.confusion_matrix__obh) > 0
+        synced = adopt_synced(m, g)
+        # the working metric is back to its OWN shard with an empty
+        # outbox (the steady-state drain point), and further updates work
+        assert m.confusion_matrix.shape == (C // WORLD, C)
+        assert int(m.confusion_matrix__obh) == 0
+        assert m._shard_rank == g.rank
+        m.update(*CM_BATCHES[0])
+        return np.asarray(synced.compute())
+
+    for result in ThreadWorld(WORLD).run(body):
+        np.testing.assert_array_equal(result, oracle)
+
+
+def test_sync_payload_ships_shard_plus_trimmed_outbox():
+    sh = _cm_shards()[0]
+    rep = MulticlassConfusionMatrix(C)
+    for i in range(0, len(CM_BATCHES), WORLD):
+        rep.update(*CM_BATCHES[i])
+    from torcheval_tpu.obs.memory import _leaf_bytes
+
+    sh_bytes = sum(_leaf_bytes(v) for v in sh._sync_state_dict().values())
+    rep_bytes = sum(_leaf_bytes(v) for v in rep._sync_state_dict().values())
+    assert sh_bytes < rep_bytes
+    # the outbox ships its covering power-of-2 bucket, not capacity
+    cnt = int(sh.confusion_matrix__obh)
+    shipped = sh._sync_state_dict()["confusion_matrix__obi"]
+    assert shipped.shape[0] == 1 << (cnt - 1).bit_length()
+
+
+def test_logical_payload_reslices_into_any_rank():
+    shards = _cm_shards()
+    target = copy.deepcopy(shards[0])
+    target.merge_state(shards[1:])
+    logical = np.asarray(target.confusion_matrix)
+    for r in range(WORLD):
+        w = MulticlassConfusionMatrix(C, shard=ShardContext(r, WORLD))
+        w.load_state_dict(target.state_dict())
+        rows = C // WORLD
+        np.testing.assert_array_equal(
+            np.asarray(w.confusion_matrix), logical[r * rows:(r + 1) * rows]
+        )
+        assert w._shard_rank == r and int(w.confusion_matrix__obh) == 0
+
+
+def test_reset_restores_shard_defaults_and_descriptor():
+    m = _cm_shards()[1]
+    m.reset()
+    assert m.confusion_matrix.shape == (C // WORLD, C)
+    assert not np.asarray(m.confusion_matrix).any()
+    assert m._shard_rank == 1 and m._shard_world == WORLD
+    assert int(m.confusion_matrix__obh) == 0
+
+
+def test_foreign_carrier_update_raises():
+    m = MulticlassConfusionMatrix(C, shard=ShardContext(0, WORLD))
+    m.load_state_dict(
+        MulticlassConfusionMatrix(C, shard=ShardContext(3, WORLD))
+        ._sync_state_dict(),
+        strict=False,
+    )
+    with pytest.raises(RuntimeError, match="foreign shard carriers"):
+        m.update(*CM_BATCHES[0])
+
+
+def test_indivisible_dimension_raises():
+    with pytest.raises(ValueError, match="does not divide evenly"):
+        MulticlassConfusionMatrix(10, shard=ShardContext(0, 4))
+
+
+def test_update_collection_fuses_sharded_plans():
+    from torcheval_tpu.metrics import MulticlassAccuracy
+
+    oracle = MulticlassConfusionMatrix(C)
+    panel = {
+        "cm": MulticlassConfusionMatrix(C, shard=ShardContext(0, WORLD)),
+        "acc": MulticlassAccuracy(),
+    }
+    for i in range(0, len(CM_BATCHES), WORLD):
+        update_collection(panel, *CM_BATCHES[i])
+        oracle.update(*CM_BATCHES[i])
+    np.testing.assert_array_equal(
+        np.asarray(panel["cm"].compute()), np.asarray(oracle.compute())
+    )
+
+
+# -------------------------------------------------- histogram binned AUROC
+
+
+def test_hist_binned_auroc_matches_buffered_reference():
+    from torcheval_tpu.metrics import BinaryBinnedAUROC
+
+    h = HistogramBinnedAUROC(threshold=32)
+    b = BinaryBinnedAUROC(threshold=32)
+    for x, y in AU_BATCHES:
+        h.update(x, y)
+        b.update(x, y)
+    np.testing.assert_allclose(
+        float(h.compute()[0]), float(b.compute()[0]), rtol=1e-6
+    )
+
+
+def test_sharded_hist_auroc_bit_identical_to_replicated_oracle():
+    reps = [HistogramBinnedAUROC(threshold=32) for _ in range(WORLD)]
+    shs = [
+        HistogramBinnedAUROC(threshold=32, shard=ShardContext(r, WORLD))
+        for r in range(WORLD)
+    ]
+    for r in range(WORLD):
+        for i in range(r, len(AU_BATCHES), WORLD):
+            reps[r].update(*AU_BATCHES[i])
+            shs[r].update(*AU_BATCHES[i])
+    to = copy.deepcopy(reps[0])
+    to.merge_state(reps[1:])
+    ts = copy.deepcopy(shs[0])
+    ts.merge_state(shs[1:])
+    assert (
+        np.asarray(ts.compute()[0]).tobytes()
+        == np.asarray(to.compute()[0]).tobytes()
+    )
+
+
+def test_sharded_hist_auroc_threadworld_sync():
+    reps = [HistogramBinnedAUROC(threshold=32) for _ in range(WORLD)]
+    for r in range(WORLD):
+        for i in range(r, len(AU_BATCHES), WORLD):
+            reps[r].update(*AU_BATCHES[i])
+    to = copy.deepcopy(reps[0])
+    to.merge_state(reps[1:])
+    oracle = np.asarray(to.compute()[0])
+
+    def body(g):
+        m = HistogramBinnedAUROC(
+            threshold=32, shard=ShardContext(g.rank, WORLD)
+        )
+        for i in range(g.rank, len(AU_BATCHES), WORLD):
+            m.update(*AU_BATCHES[i])
+        return np.asarray(sync_and_compute(m, g)[0])
+
+    for result in ThreadWorld(WORLD).run(body):
+        assert result.tobytes() == oracle.tobytes()
+
+
+# --------------------------------------------------------- windowed family
+
+
+def test_sharded_window_reassembles_single_stream_oracle():
+    """Owner-partitioned windows: every rank feeds the SAME stream, each
+    persists only its task rows; the reassembled window is bit-identical
+    to the one metric that saw the stream."""
+    NT = 8
+    stream = [
+        (
+            RNG.integers(0, 2, (NT, 16)).astype(np.float32),
+            RNG.uniform(0.5, 2.0, (NT, 16)).astype(np.float32),
+        )
+        for _ in range(7)
+    ]
+    oracle = WindowedClickThroughRate(num_tasks=NT, max_num_updates=4)
+    for x, w in stream:
+        oracle.update(x, w)
+    lo, wo = oracle.compute()
+    shs = [
+        WindowedClickThroughRate(
+            num_tasks=NT, max_num_updates=4, shard=ShardContext(r, WORLD)
+        )
+        for r in range(WORLD)
+    ]
+    for x, w in stream:
+        for m in shs:
+            m.update(x, w)
+    assert shs[0].windowed_click_total.shape == (NT // WORLD, 4)
+    # carrier compute covers its OWNED tasks
+    lr, wr = shs[1].compute()
+    np.testing.assert_array_equal(np.asarray(wr), np.asarray(wo)[2:4])
+    target = copy.deepcopy(shs[0])
+    target.merge_state(shs[1:])
+    lm, wm = target.compute()
+    assert np.asarray(lm).tobytes() == np.asarray(lo).tobytes()
+    assert np.asarray(wm).tobytes() == np.asarray(wo).tobytes()
+
+
+# --------------------------------------------------------------- mesh path
+
+
+def _mesh_ctx():
+    devices = jax.devices("cpu")
+    if len(devices) < 8:
+        pytest.skip("needs xla_force_host_platform_device_count=8")
+    from jax.sharding import Mesh
+
+    return ShardContext.from_mesh(Mesh(np.array(devices[:8]), ("dp",)), "dp")
+
+
+def test_mesh_sharded_cm_stays_distributed_and_matches_replicated():
+    ctx = _mesh_ctx()
+    m = MulticlassConfusionMatrix(C, shard=ctx)
+    r = MulticlassConfusionMatrix(C)
+    for t, p in CM_BATCHES:
+        m.update(p, t)
+        r.update(p, t)
+    # the update's out_shardings pin kept the state distributed
+    assert not m.confusion_matrix.sharding.is_fully_replicated
+    shard_shape = m.confusion_matrix.sharding.shard_shape(
+        m.confusion_matrix.shape
+    )
+    assert shard_shape == (C // 8, C)
+    np.testing.assert_array_equal(
+        np.asarray(m.compute()), np.asarray(r.compute())
+    )
+
+
+def test_mesh_sharded_hist_auroc_bit_identical():
+    ctx = _mesh_ctx()
+    h = HistogramBinnedAUROC(threshold=32, shard=ctx)
+    hr = HistogramBinnedAUROC(threshold=32)
+    for x, y in AU_BATCHES:
+        h.update(x, y)
+        hr.update(x, y)
+    assert not h.hist.sharding.is_fully_replicated
+    assert (
+        np.asarray(h.compute()[0]).tobytes()
+        == np.asarray(hr.compute()[0]).tobytes()
+    )
+
+
+# --------------------------------------------------------- memory accounting
+
+
+def test_memory_report_logical_vs_per_rank_columns():
+    from torcheval_tpu.obs import memory_report
+
+    rep = memory_report(
+        {
+            "sharded": MulticlassConfusionMatrix(
+                C, shard=ShardContext(0, WORLD)
+            ),
+            "replicated": MulticlassConfusionMatrix(C),
+        }
+    )
+    srow, rrow = rep["sharded"], rep["replicated"]
+    assert rrow["logical_bytes"] == rrow["per_rank_bytes"]
+    assert not rrow["sharded"]
+    assert srow["sharded"]
+    assert srow["logical_bytes"] >= C * C * 4
+    assert (
+        srow["per_rank_bytes"]
+        <= srow["logical_bytes"] // WORLD + 64 * 1024
+    )
+
+
+def test_memory_report_mesh_per_device_bytes():
+    ctx = _mesh_ctx()
+    from torcheval_tpu.obs import memory_report
+
+    row = memory_report({"m": MulticlassConfusionMatrix(C, shard=ctx)})["m"]
+    assert row["sharded"]
+    assert row["per_rank_bytes"] <= row["logical_bytes"] // 8 + 64 * 1024
+
+
+def test_memory_report_is_transfer_free_on_sharded_metrics():
+    metrics = {
+        "cm": MulticlassConfusionMatrix(C, shard=ShardContext(0, WORLD)),
+        "au": HistogramBinnedAUROC(threshold=32),
+    }
+    metrics["cm"].update(*CM_BATCHES[0])
+    from torcheval_tpu.obs import memory_report
+
+    with jax.transfer_guard("disallow"):
+        memory_report(metrics)
+
+
+def test_track_metrics_reports_per_rank_bytes():
+    from torcheval_tpu.obs.counters import CounterRegistry
+    from torcheval_tpu.obs.memory import track_metrics
+
+    registry = CounterRegistry()
+    track_metrics(
+        {"cm": MulticlassConfusionMatrix(C, shard=ShardContext(0, WORLD))},
+        registry=registry,
+    )
+    counters = registry.read()["memory"]
+    assert counters["cm_per_rank_bytes"] < counters["cm_state_bytes"] * 2
+    assert "total_per_rank_bytes" in counters
+
+
+# ------------------------------------------------------------- in-jit carry
+
+
+def test_donated_sharded_carry_matches_oracle_and_stays_sharded():
+    devices = jax.devices("cpu")
+    if len(devices) < 8:
+        pytest.skip("needs xla_force_host_platform_device_count=8")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torcheval_tpu.metrics.metric import MergeKind
+    from torcheval_tpu.metrics.sharded import donated_sync_step
+    from torcheval_tpu.ops import segment
+
+    mesh = Mesh(np.array(devices[:8]), ("dp",))
+    CC = 16
+
+    def update_fn(t, p):
+        flat = t.astype(jnp.int32) * CC + p.astype(jnp.int32)
+        return {
+            "cm": segment.segment_count(flat, CC * CC)
+            .reshape(CC, CC)
+            .astype(jnp.int32)
+        }
+
+    step = donated_sync_step(
+        update_fn,
+        mesh,
+        "dp",
+        {"cm": MergeKind.SUM},
+        batch_specs=(P("dp"), P("dp")),
+        shard_specs={"cm": ShardSpec(axis=0)},
+    )
+    state = {
+        "cm": jax.device_put(
+            jnp.zeros((CC, CC), jnp.int32), NamedSharding(mesh, P("dp"))
+        )
+    }
+    expect = np.zeros((CC, CC), np.int64)
+    rng = np.random.default_rng(5)
+    for _ in range(4):
+        t, p = rng.integers(0, CC, 64), rng.integers(0, CC, 64)
+        np.add.at(expect, (t, p), 1)
+        state = step(
+            state,
+            jax.device_put(jnp.asarray(t), NamedSharding(mesh, P("dp"))),
+            jax.device_put(jnp.asarray(p), NamedSharding(mesh, P("dp"))),
+        )
+    assert not state["cm"].sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(state["cm"]), expect)
+
+
+def test_sharded_sync_requires_sum_kind():
+    from torcheval_tpu.metrics.metric import MergeKind
+    from torcheval_tpu.metrics.sharded import donated_sync_step
+
+    with pytest.raises(NotImplementedError, match="SUM-kind"):
+        donated_sync_step(
+            lambda x: {"s": x},
+            None,
+            "dp",
+            {"s": MergeKind.MAX},
+            batch_specs=(None,),
+            shard_specs={"s": ShardSpec(axis=0)},
+        )
+
+
+def test_acceptance_sizes_per_rank_bytes():
+    """ISSUE 9 acceptance at the named scales: an 8,192-class confusion
+    matrix and a 1,048,576-bin binned AUROC constructed SHARDED pin
+    per-rank state bytes at <= logical/world + 64 KiB — measured through
+    ``obs.memory_report`` (metadata walk; the shard is the only big
+    allocation this test makes)."""
+    from torcheval_tpu.obs import memory_report
+
+    cm = MulticlassConfusionMatrix(8192, shard=ShardContext(0, 4))
+    row = memory_report({"cm": cm})["cm"]
+    assert row["logical_bytes"] >= 8192 * 8192 * 4
+    assert row["per_rank_bytes"] <= row["logical_bytes"] // 4 + 64 * 1024
+    au = HistogramBinnedAUROC(
+        threshold=jnp.linspace(0.0, 1.0, 1 << 20),
+        shard=ShardContext(0, 4),
+    )
+    row = memory_report({"au": au})["au"]
+    assert row["logical_bytes"] >= 2 * (1 << 20) * 4
+    assert row["per_rank_bytes"] <= row["logical_bytes"] // 4 + 64 * 1024

@@ -624,3 +624,81 @@ def test_r11_headline_configs_meet_2x():
             f"{key}: {entry['vs_baseline']}x vs reference"
         )
         assert entry.get("baseline_value"), entry
+
+
+# --------------------------------------------------------- round 13 (ISSUE 9)
+
+SH = _load("bench_r13_sharded_cpu_20260803.json")
+KR13 = _load("bench_r13_kernels_cpu_20260803.json")
+
+
+def test_r13_sharded_state_acceptance_flags():
+    """ISSUE 9 acceptance, pinned on the committed capture: for BOTH big
+    workloads (8k-class confusion matrix, 1M-bin binned AUROC) the
+    sharded arm's per-rank state bytes stay within logical/world + the
+    declared constant, and its sync wire is STRICTLY below the
+    replicated payload."""
+    sh = SH["sharded_state"]["sharded_state"]
+    assert sh["acceptance"]["per_rank_within_bound"] is True
+    assert sh["acceptance"]["wire_below_replicated"] is True
+    world = sh["world"]
+    const = sh["per_rank_bound_const_bytes"]
+    for key in ("confusion_8k", "binned_auroc_1m"):
+        entry = sh[key]
+        assert entry["per_rank_bytes"] <= (
+            entry["logical_bytes"] // world + const
+        ), key
+        wire = entry["sync_payload_bytes"]
+        assert wire["sharded"] < wire["replicated"], key
+        # the headline reduction: per-rank state ~= logical/world
+        assert entry["per_rank_bytes"] * (world - 1) < entry["logical_bytes"]
+
+
+def test_r13_sharded_state_table_matches_capture():
+    """The round-13 sharded-state table in docs/benchmarks.md traces to
+    the committed capture (bytes exact, times as captured)."""
+    text = _read("docs/benchmarks.md")
+    sh = SH["sharded_state"]["sharded_state"]
+    for key, label in (
+        ("confusion_8k", "8,192-class confusion matrix"),
+        ("binned_auroc_1m", "1,048,576-bin binned AUROC"),
+    ):
+        entry = sh[key]
+        pattern = (
+            re.escape(label)
+            + r"[^|]*\| ([\d,]+) B \| ([\d,]+) B \| ([\d,]+) B \| ([\d,]+) B"
+        )
+        m = re.search(pattern, text)
+        assert m, f"r13 sharded row not found: /{pattern}/"
+        assert int(m.group(1).replace(",", "")) == entry["logical_bytes"]
+        assert int(m.group(2).replace(",", "")) == entry["per_rank_bytes"]
+        wire = entry["sync_payload_bytes"]
+        assert int(m.group(3).replace(",", "")) == wire["replicated"]
+        assert int(m.group(4).replace(",", "")) == wire["sharded"]
+
+
+def test_r13_topk_small_row_gap_narrowed():
+    """ISSUE 9 satellite: the small-row top-k arm (64x1000, k=8) of the
+    re-captured kernels config must show the native kernel ahead of the
+    XLA twin by >= 1.3x pipelined (the r11 note measured ~1.3x at best;
+    the remaining distance to the big-shape ratios is per-call dispatch
+    overhead both arms pay — see docs/benchmarks.md round 13)."""
+    small = KR13["kernels"]["native_cpu"]["topk_small"]
+    assert "error" not in small, small
+    assert small["xla_over_native"] >= 1.3, small
+    # and the re-capture must not have traded the big shape away
+    big = KR13["kernels"]["native_cpu"]["topk"]
+    assert big["xla_over_native"] >= 2.0, big
+    assert big["meets_2x"] is True
+
+
+def test_r13_recaptured_kernels_still_meet_r11_acceptance():
+    """The topk.cc rework rides the same acceptance the r11 ops pinned:
+    every native op >= 2x its XLA twin in the RE-captured kernels run
+    (segment/histogram/topk), and the donation arm still shows zero
+    realloc."""
+    kernels = KR13["kernels"]["native_cpu"]
+    assert kernels["available"]
+    for op in ("segment_sum", "segment_count", "histogram", "topk"):
+        assert kernels[op]["meets_2x"] is True, (op, kernels[op])
+    assert KR13["kernels"]["donation"]["zero_realloc"] is True

@@ -57,7 +57,7 @@ SYMBOL_ROWS = [
 # §2.4 class counts per category (SURVEY inventory totals)
 CATEGORY_COUNTS = [
     ("aggregation", 7),
-    ("classification", 33),  # 31 parity + streaming AUROC/AUPRC extensions
+    ("classification", 34),  # 31 parity + streaming AUROC/AUPRC + HistogramBinnedAUROC extensions
     ("image", 2),
     ("ranking", 5),
     ("regression", 2),

@@ -51,6 +51,18 @@ def _program_smoke() -> Report:
         (M.MulticlassAccuracy(), (x2, t1)),  # SUM counters
         (M.Mean(), (xb,)),  # weighted-sum pair
         (M.MeanSquaredError(), (xb, tb)),  # regression family
+        # sharded-state layer (ISSUE 9): the scatter-route update + the
+        # reassembling merge must verify like any family
+        (
+            M.MulticlassConfusionMatrix(8, shard=M.ShardContext(1, 4)),
+            (t1, t1),
+        ),
+        (
+            M.HistogramBinnedAUROC(
+                threshold=16, shard=M.ShardContext(0, 2)
+            ),
+            (xb, jnp.asarray(rng.integers(0, 2, 32))),
+        ),
     ]
     combined = Report(tool="program")
     for metric, args in cases:

@@ -831,7 +831,19 @@ class ElasticSession:
         the first shard's state loads directly, the rest merge in via
         ``merge_state`` in old-rank order (the redistribution step of a
         world-size-change resume). Ranks with no assignment keep freshly
-        reset metrics — the merge identity."""
+        reset metrics — the merge identity.
+
+        SHARDED metrics (``Metric._sharded_states``) redistribute
+        differently when the world size changed: their per-rank payloads
+        are slices of ONE logical state (plus routed outboxes that may
+        target ANY rank's slice), so a contiguous old-rank split would
+        drop cross-slice contributions. Every new rank instead merges
+        ALL old shards — the reassembling sharded merge rebuilds the
+        logical state exactly once — and then re-slices to its own new
+        shard (``_reshard_to_own``): slices partition the cells, so
+        globally every contribution survives exactly once. At an
+        UNCHANGED world size the per-rank shard is self-describing and
+        loads directly (no logical materialization)."""
         from torcheval_tpu.metrics.toolkit import (
             _restore_state_types,
             clone_metric,
@@ -839,8 +851,15 @@ class ElasticSession:
 
         for name, metric in self.metrics.items():
             metric.reset()
+            metric_assigned = assigned
+            sharded = bool(getattr(metric, "_sharded_states", None))
+            world_changed = len(shards) != self._group.world_size
+            if sharded and world_changed:
+                # world size changed: this sharded metric needs every
+                # old rank's shard + outbox
+                metric_assigned = tuple(range(len(shards)))
             states = []
-            for old_rank in assigned:
+            for old_rank in metric_assigned:
                 state = shards[old_rank]["metrics"].get(name)
                 if state is None:
                     raise RuntimeError(
@@ -867,3 +886,8 @@ class ElasticSession:
                 peers.append(peer)
             if peers:
                 metric.merge_state(peers)
+            if sharded and world_changed:
+                # the reassembled logical state re-slices to this rank's
+                # NEW shard; cells partition, so across the new world
+                # every old contribution lands exactly once
+                metric._reshard_to_own()

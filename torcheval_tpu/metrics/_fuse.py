@@ -61,6 +61,7 @@ def fused_accumulate(
     config: Tuple = (),
     *,
     donate: bool = False,
+    out_shardings=None,
 ) -> Tuple[jax.Array, ...]:
     """``tuple(s + d for s, d in zip(states, kernel(*dynamic, *config)))``
     as one jitted dispatch.
@@ -75,36 +76,52 @@ def fused_accumulate(
     arrays are CONSUMED (deleted after the call). Callers own the
     aliasing discipline: nothing else may hold those array objects
     (``Metric`` snapshot paths copy; see ``config.update_donation``).
+
+    ``out_shardings`` (a tuple matching the state tuple, hashable —
+    ``NamedSharding`` per state) pins the output placement for
+    mesh-sharded metric states: without it XLA may resolve a replicated
+    output layout and silently gather a distributed state back into a
+    full per-device replica (``Metric._mesh_out_shardings``).
     """
-    key = (kernel, config, len(states), len(dynamic), donate)
+    key = (kernel, config, len(states), len(dynamic), donate, out_shardings)
     fn = _CACHE.get(key)
     if fn is None:
 
         def fused(states, *dyn):
             return _apply_kernel(kernel, config, states, dyn)
 
-        fn = jax.jit(fused, donate_argnums=(0,) if donate else ())
+        fn = _jit(fused, donate, out_shardings)
         _CACHE[key] = fn
     return fn(states, *dynamic)
+
+
+def _jit(fused, donate: bool, out_shardings):
+    kwargs = {"donate_argnums": (0,) if donate else ()}
+    if out_shardings is not None:
+        kwargs["out_shardings"] = out_shardings
+    return jax.jit(fused, **kwargs)
 
 
 _TRANSFORM_CACHE: Dict[Any, Callable] = {}
 
 
-def fused_transform(kernel, states, dynamic, config=(), *, donate=False):
+def fused_transform(
+    kernel, states, dynamic, config=(), *, donate=False, out_shardings=None
+):
     """``kernel(states, *dynamic, *config)`` -> new states, as one jitted
     dispatch — the non-additive sibling of ``fused_accumulate`` (ring
-    column writes, running extrema). Cached per (kernel, config, arity);
-    ``donate`` as in ``fused_accumulate`` (a ring-buffer column write
-    becomes a true in-place write instead of an O(window) copy)."""
-    key = (kernel, config, len(states), len(dynamic), donate)
+    column writes, running extrema, sharded scatter-routing). Cached per
+    (kernel, config, arity); ``donate`` and ``out_shardings`` as in
+    ``fused_accumulate`` (a ring-buffer column write becomes a true
+    in-place write instead of an O(window) copy)."""
+    key = (kernel, config, len(states), len(dynamic), donate, out_shardings)
     fn = _TRANSFORM_CACHE.get(key)
     if fn is None:
 
         def fused(states, *dyn):
             return _apply_transform(kernel, config, states, dyn)
 
-        fn = jax.jit(fused, donate_argnums=(0,) if donate else ())
+        fn = _jit(fused, donate, out_shardings)
         _TRANSFORM_CACHE[key] = fn
     return fn(states, *dynamic)
 

@@ -17,6 +17,7 @@ from torcheval_tpu.metrics.classification.binned_auprc import (
 )
 from torcheval_tpu.metrics.classification.binned_auroc import (
     BinaryBinnedAUROC,
+    HistogramBinnedAUROC,
     MulticlassBinnedAUROC,
 )
 from torcheval_tpu.metrics.classification.binned_precision_recall_curve import (
@@ -63,6 +64,7 @@ __all__ = [
     "BinaryAUROC",
     "BinaryBinnedAUPRC",
     "BinaryBinnedAUROC",
+    "HistogramBinnedAUROC",
     "BinaryBinnedPrecisionRecallCurve",
     "BinaryConfusionMatrix",
     "BinaryF1Score",

@@ -21,10 +21,16 @@ from torcheval_tpu.metrics.functional.classification.binned_auroc import (
     DEFAULT_NUM_THRESHOLD,
     _binary_binned_auroc_compute_jit,
     _binary_binned_auroc_param_check,
+    _hist_binned_auroc_compute,
+    _hist_binned_flat_index,
+    _hist_binned_update,
     _multiclass_binned_auroc_compute_jit,
     _multiclass_binned_auroc_param_check,
 )
 from torcheval_tpu.metrics.functional.tensor_utils import create_threshold_tensor
+from torcheval_tpu.metrics import shardspec
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
+from torcheval_tpu.metrics.shardspec import ShardSpec
 
 
 class BinaryBinnedAUROC(_BufferedPairMetric):
@@ -68,6 +74,99 @@ class BinaryBinnedAUROC(_BufferedPairMetric):
         inputs, targets = self._padded()
         return (
             _binary_binned_auroc_compute_jit(inputs, targets, self.threshold),
+            self.threshold,
+        )
+
+
+class HistogramBinnedAUROC(Metric[Tuple[jax.Array, jax.Array]]):
+    """Binned AUROC from a per-bin count histogram — O(num_thresholds)
+    state, O(batch·log T) updates, and the library's million-bin,
+    SHARDABLE binned-AUROC family.
+
+    Unlike :class:`BinaryBinnedAUROC` (which buffers raw examples), the
+    state is one ``(2T,)`` int32 histogram: each sample increments the
+    cell of the inter-threshold bin its score falls in (negatives in
+    ``[0, T)``, positives in ``[T, 2T)``); ``compute()`` rebuilds the
+    per-threshold tp/fp counters by suffix sums — integer-exact, so the
+    result is bit-identical however the histogram was accumulated,
+    merged, or sharded. That makes threshold grids of 1M+ bins
+    practical: per-rank state drops to ``2T/world`` cells under a
+    ``shard`` context, updates scatter owned bins natively
+    (``ops.segment``) and outbox the rest, and sync ships
+    ``shard + outbox`` instead of the whole grid.
+
+    Examples::
+
+        >>> import jax.numpy as jnp
+        >>> from torcheval_tpu.metrics import HistogramBinnedAUROC
+        >>> metric = HistogramBinnedAUROC(threshold=4)
+        >>> metric.update(jnp.array([0.1, 0.5, 0.7, 0.8]),
+        ...               jnp.array([0, 0, 1, 1]))
+        >>> auroc, thresholds = metric.compute()
+    """
+
+    _extra_device_attrs = ("threshold",)
+
+    def __init__(
+        self,
+        *,
+        threshold: Union[int, List[float], jax.Array] = DEFAULT_NUM_THRESHOLD,
+        device=None,
+        shard=None,
+    ) -> None:
+        super().__init__(device=device, shard=shard)
+        threshold = jax.device_put(
+            create_threshold_tensor(threshold), self._input_placement()
+        )
+        _binary_binned_auroc_param_check(1, threshold)
+        self.threshold = threshold
+        self.num_thresholds = int(threshold.shape[0])
+        self._add_state(
+            "hist",
+            jnp.zeros((2 * self.num_thresholds,), dtype=jnp.int32),
+            merge=MergeKind.SUM,
+            shard=ShardSpec(axis=0),
+        )
+        shardspec.enable_routing(self, "hist")
+
+    def _update_plan(self, input, target):
+        input, target = self._input(input), self._input(target)
+        _binary_auroc_update_input_check(input, target, 1)
+        if self._route_active("hist"):
+            names = self._routed_states["hist"]
+            n = int(target.shape[0])
+            shardspec.ensure_outbox_capacity(self, "hist", n)
+            info = self._sharded_states["hist"]
+            start, stop = self._shard_ctx.shard_range(info.logical_shape[0])
+            kernel = shardspec.route_scatter_kernel(
+                _hist_binned_flat_index, start, stop
+            )
+
+            def finalize():
+                setattr(self, names.obh, getattr(self, names.obh) + n)
+
+            return UpdatePlan(
+                kernel,
+                ("hist", names.obi, names.obn),
+                (input, target, self.threshold),
+                (),
+                transform=True,
+                finalize=finalize,
+            )
+        return UpdatePlan(
+            _hist_binned_update,
+            ("hist",),
+            (input, target, self.threshold),
+        )
+
+    def update(self, input, target) -> "HistogramBinnedAUROC":
+        return self._apply_update_plan(self._update_plan(input, target))
+
+    def compute(self) -> Tuple[jax.Array, jax.Array]:
+        return (
+            _hist_binned_auroc_compute(
+                self._logical_state("hist"), self.num_thresholds
+            ),
             self.threshold,
         )
 

@@ -16,12 +16,15 @@ from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
     _binary_confusion_matrix_update_jit,
     _binary_confusion_matrix_update_masked,
     _confusion_matrix_compute,
+    _confusion_matrix_flat_index,
     _confusion_matrix_param_check,
     _confusion_matrix_update_input_check,
     _confusion_matrix_update_jit,
     _confusion_matrix_update_masked,
 )
+from torcheval_tpu.metrics import shardspec
 from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
+from torcheval_tpu.metrics.shardspec import ShardSpec
 
 TMulticlassConfusionMatrix = TypeVar(
     "TMulticlassConfusionMatrix", bound="MulticlassConfusionMatrix"
@@ -46,8 +49,16 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
         *,
         normalize: Optional[str] = None,
         device=None,
+        shard=None,
     ) -> None:
-        super().__init__(device=device)
+        """``shard`` (a :class:`~torcheval_tpu.metrics.shardspec.ShardContext`)
+        partitions the ``(C, C)`` matrix by TRUE-class rows across the
+        shard world: per-rank state drops to ``C*C/world`` cells, eager
+        updates scatter owned cells natively and outbox the rest, sync
+        ships ``shard + outbox`` instead of the full matrix. Counts are
+        int32, so sharded results are bit-identical to the replicated
+        metric."""
+        super().__init__(device=device, shard=shard)
         _confusion_matrix_param_check(num_classes, normalize)
         self.num_classes = num_classes
         self.normalize = normalize
@@ -55,7 +66,9 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
             "confusion_matrix",
             jnp.zeros((num_classes, num_classes), dtype=jnp.int32),
             merge=MergeKind.SUM,
+            shard=ShardSpec(axis=0),
         )
+        shardspec.enable_routing(self, "confusion_matrix")
 
     # plans carry mask-aware kernel twins (metrics/_bucket.py)
     _bucketed_update = True
@@ -63,6 +76,11 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
     def _update_plan(self, input, target):
         input, target = self._input(input), self._input(target)
         _confusion_matrix_update_input_check(input, target, self.num_classes)
+        if self._route_active("confusion_matrix"):
+            return self._sharded_update_plan(input, target)
+        # replicated instances, world-1 shards, and desharded
+        # (post-merge logical) carriers all update densely — with the
+        # masked twin, so shape bucketing keeps working for them
         return UpdatePlan(
             _confusion_matrix_update_jit,
             ("confusion_matrix",),
@@ -72,6 +90,35 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
             batch_axes=(("batch",), ("batch",)),
         )
 
+    def _sharded_update_plan(self, input, target):
+        """One fused dispatch: flat-index routing -> owned-cell scatter
+        into the local shard + foreign-index outbox append (see
+        ``shardspec.route_scatter_kernel``)."""
+        name = "confusion_matrix"
+        names = self._routed_states[name]
+        n = int(target.shape[0])
+        shardspec.ensure_outbox_capacity(self, name, n)
+        info = self._sharded_states[name]
+        start, stop = self._shard_ctx.shard_range(info.logical_shape[0])
+        kernel = shardspec.route_scatter_kernel(
+            _confusion_matrix_flat_index,
+            start * self.num_classes,
+            stop * self.num_classes,
+            (self.num_classes,),
+        )
+
+        def finalize():
+            setattr(self, names.obh, getattr(self, names.obh) + n)
+
+        return UpdatePlan(
+            kernel,
+            (name, names.obi, names.obn),
+            (input, target),
+            (),
+            transform=True,
+            finalize=finalize,
+        )
+
     def update(
         self: TMulticlassConfusionMatrix, input, target
     ) -> TMulticlassConfusionMatrix:
@@ -79,13 +126,21 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
         return self._apply_update_plan(self._update_plan(input, target))
 
     def compute(self) -> jax.Array:
-        return _confusion_matrix_compute(self.confusion_matrix, self.normalize)
+        # _logical_state: the live matrix on replicated/mesh/desharded
+        # instances; a shard carrier assembles its LOCAL logical view
+        # (own rows + own outbox) — equal to a replicated metric's local
+        # state, so un-synced compute semantics are unchanged
+        return _confusion_matrix_compute(
+            self._logical_state("confusion_matrix"), self.normalize
+        )
 
     def normalized(self, normalize: Optional[str] = None) -> jax.Array:
         """Return the matrix under a different normalization
         (reference confusion_matrix.py:198-206)."""
         _confusion_matrix_param_check(self.num_classes, normalize)
-        return _confusion_matrix_compute(self.confusion_matrix, normalize)
+        return _confusion_matrix_compute(
+            self._logical_state("confusion_matrix"), normalize
+        )
 
 
 class BinaryConfusionMatrix(MulticlassConfusionMatrix):

@@ -65,6 +65,56 @@ def _binary_binned_auroc_compute_jit(
     return auroc[0] if squeeze else auroc
 
 
+def _hist_binned_flat_index(
+    input: jax.Array, target: jax.Array, threshold: jax.Array
+) -> jax.Array:
+    """Flat histogram cell per sample for the O(1)-state binned AUROC:
+    ``target * T + bin`` where ``bin`` is the rightmost threshold <= the
+    score (so ``score >= threshold[j]  <=>  bin >= j``, making suffix
+    sums of the histogram reproduce the dense ``input >= threshold[j]``
+    counters exactly). Scores below ``threshold[0]`` map to ``-1``
+    (dropped — the dense kernel counts them at no threshold either).
+    Consumed by the sharded routing layer and the dense update alike.
+    """
+    num_t = threshold.shape[0]
+    b = jnp.searchsorted(threshold, input, side="right") - 1
+    return jnp.where(
+        b < 0,
+        -1,
+        target.astype(jnp.int32) * num_t + b.astype(jnp.int32),
+    )
+
+
+def _hist_binned_update(
+    input: jax.Array, target: jax.Array, threshold: jax.Array
+) -> jax.Array:
+    """Dense histogram delta ``(2T,)`` int32 for one batch — the
+    replicated-instance update kernel of ``HistogramBinnedAUROC``
+    (negatives in ``[0, T)``, positives in ``[T, 2T)``). One
+    ``segment_count`` (PR 6 native one-pass on CPU); O(n log T) per
+    batch instead of the dense compare's O(n*T)."""
+    from torcheval_tpu.ops import segment
+
+    num_t = threshold.shape[0]
+    idx = _hist_binned_flat_index(input, target, threshold)
+    return segment.segment_count(
+        segment.safe_ids(idx, 2 * num_t), 2 * num_t
+    )
+
+
+def _hist_binned_auroc_compute(
+    hist: jax.Array, num_t: int
+) -> jax.Array:
+    """AUROC from the ``(2T,)`` histogram: suffix sums rebuild the
+    per-threshold tp/fp counters (integer-exact), then the shared
+    trapezoid (``_binned_auroc_from_counts``) — bit-identical outputs
+    for bit-identical histograms, any world size."""
+    neg, pos = hist[:num_t], hist[num_t:]
+    tp = jnp.cumsum(pos[::-1])[::-1].astype(jnp.float32)
+    fp = jnp.cumsum(neg[::-1])[::-1].astype(jnp.float32)
+    return _binned_auroc_from_counts(tp, fp)
+
+
 def _binary_binned_auroc_compute(
     input: jax.Array, target: jax.Array, threshold: jax.Array
 ) -> Tuple[jax.Array, jax.Array]:

@@ -34,6 +34,19 @@ def _confusion_matrix_update_jit(
     return counts.reshape(num_classes, num_classes)
 
 
+def _confusion_matrix_flat_index(
+    input: jax.Array, target: jax.Array, num_classes: int
+) -> jax.Array:
+    """Flat ``target * C + prediction`` cell index per sample — the
+    routing view of the scatter above, consumed by the sharded-state
+    layer (``shardspec.route_scatter_kernel``): owned cells land in the
+    local shard, foreign cells in the outbox. Same argmax/int32
+    semantics as ``_confusion_matrix_update_jit``."""
+    if input.ndim == 2:
+        input = argmax_last(input)
+    return target.astype(jnp.int32) * num_classes + input.astype(jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("num_classes",))
 def _confusion_matrix_update_masked(
     input: jax.Array, target: jax.Array, valid_sizes: jax.Array, num_classes: int

@@ -29,6 +29,7 @@ import copy
 import enum
 import functools
 import time
+import types
 from abc import ABC, abstractmethod
 from typing import (
     Any,
@@ -236,10 +237,23 @@ class Metric(Generic[TComputeReturn], ABC):
     registered merge kinds unless overridden.
     """
 
-    def __init__(self, *, device: Optional[Union[jax.Device, str]] = None) -> None:
+    def __init__(
+        self,
+        *,
+        device: Optional[Union[jax.Device, str]] = None,
+        shard: Optional["ShardContext"] = None,
+    ) -> None:
         self._state_name_to_default: Dict[str, TState] = {}
         self._state_name_to_merge_kind: Dict[str, MergeKind] = {}
         self._device: jax.Device = canonicalize_device(device)
+        # sharded-state layer (metrics/shardspec.py): `shard` names where
+        # this instance's sharded states live (eager rank/world or a mesh
+        # axis); `_sharded_states` records the ShardInfo per state name;
+        # `_routed_states` the outbox bookkeeping of scatter-routed states
+        self._shard_ctx = shard
+        self._sharded_states: Dict[str, Any] = {}
+        self._routed_states: Dict[str, Any] = {}
+        self._shard_bookkeeping_registered = False
 
     def __init_subclass__(cls, **kwargs: Any) -> None:
         """Instrument concrete ``update``/``compute`` overrides with the
@@ -272,13 +286,39 @@ class Metric(Generic[TComputeReturn], ABC):
         default: TState,
         *,
         merge: MergeKind = MergeKind.CUSTOM,
+        shard: Optional["ShardSpec"] = None,
     ) -> None:
         """Register a state variable (reference metric.py:49-65).
 
         ``default`` must be a jax.Array, a list of jax.Arrays, a dict with
         jax.Array values, an int, or a float. It is snapshotted for
         ``reset()`` and the live value is placed on ``self.device``.
+
+        ``shard`` (a :class:`~torcheval_tpu.metrics.shardspec.ShardSpec`)
+        declares the state partitioned across the metric's shard context
+        (``Metric(shard=...)``): under an EAGER context the registered
+        default becomes this rank's contiguous slice along ``shard.axis``
+        (the per-rank shard IS the persisted state — snapshots, syncs and
+        the elastic on-disk layout all ship ``size/world`` bytes); under a
+        MESH context the state keeps its logical shape but is placed with
+        a ``NamedSharding`` over the mesh axis (per-device bytes drop to
+        ``size/world``; the fused update jits pin ``out_shardings`` so
+        updates never silently re-replicate it). Ignored without a shard
+        context, so one metric class serves replicated and sharded use.
         """
+        if shard is not None and self._shard_ctx is not None:
+            if not isinstance(default, jax.Array):
+                raise TypeError(
+                    f"sharded state {name!r} requires an array default"
+                )
+            if not self._shard_ctx.is_mesh and shard.axis != 0:
+                raise ValueError(
+                    "eager sharding currently partitions axis 0 only "
+                    f"(state {name!r} declared axis {shard.axis})"
+                )
+            default, info = self._shard_ctx.prepare_state(name, default, shard)
+            self._sharded_states[name] = info
+            self._ensure_shard_bookkeeping()
         self._check_state_variable_type(name, default)
         self._state_name_to_default[name] = self._clone_state(default)
         self._state_name_to_merge_kind[name] = merge
@@ -290,8 +330,23 @@ class Metric(Generic[TComputeReturn], ABC):
         # with it, permanently breaking reset(). One unconditional copy
         # per state at construction buys that out.
         setattr(
-            self, name, self._place_state(self._clone_state(default, force_copy=True))
+            self, name, self._place_named(name, self._clone_state(default, force_copy=True))
         )
+
+    def _ensure_shard_bookkeeping(self) -> None:
+        """Register the carried-shard descriptor states once per eager
+        sharded metric: ``_shard_rank``/``_shard_world`` describe which
+        shard the LIVE arrays currently hold (normally this rank's own;
+        ``-1``/``0`` after a reassembling merge desharded the instance to
+        the logical state). They are ordinary int states, so snapshots,
+        syncs and checkpoints are self-describing — a restore knows which
+        slice it is looking at without any side channel."""
+        ctx = self._shard_ctx
+        if ctx is None or ctx.is_mesh or self._shard_bookkeeping_registered:
+            return
+        self._shard_bookkeeping_registered = True
+        self._add_state("_shard_rank", int(ctx.rank), merge=MergeKind.CUSTOM)
+        self._add_state("_shard_world", int(ctx.world), merge=MergeKind.CUSTOM)
 
     # Donation fast path (ROADMAP item 4): when True — and the process
     # knob ``config.update_donation`` is on (TPU default; see its measured
@@ -305,6 +360,16 @@ class Metric(Generic[TComputeReturn], ABC):
     # take in independent buffers. Subclasses whose states intentionally
     # alias external arrays opt out by setting this False.
     _donated_update: bool = True
+
+    # class-level fallbacks so instances restored from pre-sharding
+    # pickles (and lightweight test doubles skipping __init__) behave as
+    # replicated metrics. READ-ONLY mappings: a write through an
+    # instance that skipped __init__ must raise, never land on the
+    # class and turn every Metric in the process into a "sharded" one.
+    _shard_ctx = None
+    _sharded_states: Dict[str, Any] = types.MappingProxyType({})
+    _routed_states: Dict[str, Any] = types.MappingProxyType({})
+    _shard_bookkeeping_registered = False
 
     def _donation_active(self) -> bool:
         return self._donated_update and config.update_donation_enabled()
@@ -343,6 +408,22 @@ class Metric(Generic[TComputeReturn], ABC):
             return placed
         return value
 
+    def _place_named(
+        self, name: str, value: TState, device: Optional[jax.Device] = None
+    ) -> TState:
+        """``_place_state`` that preserves a mesh-sharded state's
+        ``NamedSharding`` placement (a plain ``device_put`` to one device
+        would silently gather the shards back into a replica)."""
+        info = self._sharded_states.get(name) if self._sharded_states else None
+        if (
+            info is not None
+            and getattr(info, "sharding", None) is not None
+            and device is None
+            and _is_array(value)
+        ):
+            return jax.device_put(value, info.sharding)
+        return self._place_state(value, device)
+
     def _check_state_variable_type(self, name: str, value: TState) -> None:
         """Runtime TState validation (reference metric.py:260-281)."""
         if _is_array(value) or isinstance(value, (int, float)):
@@ -375,6 +456,19 @@ class Metric(Generic[TComputeReturn], ABC):
     # per shape, which is the retrace bucketing exists to kill.
     _bucketed_update: bool = False
 
+    def _input_placement(self):
+        """Where ``update()`` inputs (and array-valued config attributes
+        like binned thresholds) are committed: ``self._device`` normally;
+        REPLICATED over the mesh for a mesh-sharded metric — a state
+        distributed over 8 devices cannot be jitted together with a batch
+        committed to one of them."""
+        ctx = self._shard_ctx
+        if ctx is not None and ctx.is_mesh:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return NamedSharding(ctx.mesh, PartitionSpec())
+        return self._device
+
     def _input(self, x: Any, *, dtype: Any = None) -> jax.Array:
         """Coerce an update() argument onto ``self.device``.
 
@@ -396,7 +490,7 @@ class Metric(Generic[TComputeReturn], ABC):
             return self._guard_finite(to_host(x, dtype=dtype))
         # jax.Array inputs keep the documented `input.to(self.device)` hop
         # even under bucketing (the device pad then runs on self.device)
-        return self._guard_finite(to_jax(x, dtype=dtype, device=self._device))
+        return self._guard_finite(to_jax(x, dtype=dtype, device=self._input_placement()))
 
     def _input_float(self, x: Any) -> jax.Array:
         if (
@@ -405,7 +499,7 @@ class Metric(Generic[TComputeReturn], ABC):
             and not isinstance(x, jax.Array)
         ):
             return self._guard_finite(to_host_float(x))
-        return self._guard_finite(to_jax_float(x, device=self._device))
+        return self._guard_finite(to_jax_float(x, device=self._input_placement()))
 
     def _guard_finite(self, x: Any) -> Any:
         """NaN/Inf guardrail (``config.validate_inputs``: off/warn/raise).
@@ -483,15 +577,16 @@ class Metric(Generic[TComputeReturn], ABC):
         if isinstance(plan, UpdatePlan):
             plan = apply_bucketing(plan)
             states = tuple(getattr(self, n) for n in plan.state_names)
+            shardings = self._mesh_out_shardings(plan.state_names)
             if plan.transform:
                 new_states = fused_transform(
                     plan.kernel, states, plan.dynamic, plan.config,
-                    donate=donate,
+                    donate=donate, out_shardings=shardings,
                 )
             else:
                 new_states = fused_accumulate(
                     plan.kernel, states, plan.dynamic, plan.config,
-                    donate=donate,
+                    donate=donate, out_shardings=shardings,
                 )
             for name, value in zip(plan.state_names, new_states):
                 setattr(self, name, value)
@@ -501,11 +596,36 @@ class Metric(Generic[TComputeReturn], ABC):
         kernel, state_names, dynamic, *rest = plan
         config = rest[0] if rest else ()
         states = tuple(getattr(self, name) for name in state_names)
-        new_states = fused_accumulate(kernel, states, dynamic, config,
-                                      donate=donate)
+        new_states = fused_accumulate(
+            kernel, states, dynamic, config, donate=donate,
+            out_shardings=self._mesh_out_shardings(state_names),
+        )
         for name, value in zip(state_names, new_states):
             setattr(self, name, value)
         return self
+
+    def _mesh_out_shardings(self, state_names) -> Optional[tuple]:
+        """Output shardings pinning a mesh-sharded metric's state layout
+        through the fused update jits: sharded states keep their
+        ``NamedSharding``, the rest stay replicated over the same mesh.
+        Without the pin XLA is free to pick a replicated output layout —
+        silently gathering the state back to a full per-device copy and
+        defeating the size/world memory contract. ``None`` (no
+        constraint) off the mesh path."""
+        ctx = self._shard_ctx
+        if ctx is None or not ctx.is_mesh or not self._sharded_states:
+            return None
+        if not any(n in self._sharded_states for n in state_names):
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        replicated = NamedSharding(ctx.mesh, PartitionSpec())
+        return tuple(
+            self._sharded_states[n].sharding
+            if n in self._sharded_states
+            else replicated
+            for n in state_names
+        )
 
     @abstractmethod
     def compute(self) -> TComputeReturn:
@@ -527,7 +647,15 @@ class Metric(Generic[TComputeReturn], ABC):
         ``_add_state``; metrics with bespoke semantics (e.g. windowed ring
         buffers, reference window/normalized_entropy.py:232-296) override
         this method or individual kinds via ``_merge_custom_state``.
+
+        Sharded instances (``Metric(shard=...)`` with eager-sharded
+        states) route to :meth:`_merge_sharded`: peers are shard CARRIERS
+        (each holding one rank's slice plus its routed outbox), and the
+        merge REASSEMBLES the logical state instead of reducing replicas.
         """
+        metrics = list(metrics)
+        if self._sharded_states and self._is_shard_carrier():
+            return self._merge_sharded(metrics)
         for other in metrics:
             for name, kind in self._state_name_to_merge_kind.items():
                 mine = getattr(self, name)
@@ -564,6 +692,280 @@ class Metric(Generic[TComputeReturn], ABC):
             "_merge_custom_state."
         )
 
+    # ---------------------------------------------------------- sharded state
+
+    def _is_shard_carrier(self) -> bool:
+        """True while the live sharded states hold ONE rank's slice (the
+        steady state of an eager sharded metric). False on replicated
+        and mesh instances, and after a reassembling merge desharded the
+        instance to the logical state."""
+        return bool(self._sharded_states) and int(
+            getattr(self, "_shard_world", 0)
+        ) > 0
+
+    def _own_shard_active(self) -> bool:
+        """True when the live states hold exactly this rank's configured
+        shard — the precondition for the sharded (routing) update plans.
+        A carrier of a FOREIGN rank's shard (a transient sync/restore
+        clone) must not be updated; a desharded (logical) instance
+        updates through the dense plans instead."""
+        if not self._is_shard_carrier():
+            return False
+        ctx = self._shard_ctx
+        if ctx is None or ctx.is_mesh:
+            return False
+        rk = int(getattr(self, "_shard_rank"))
+        wd = int(getattr(self, "_shard_world"))
+        if rk == ctx.rank and wd == ctx.world:
+            return True
+        raise RuntimeError(
+            f"{type(self).__name__} holds shard {rk} of world {wd} but is "
+            f"configured as rank {ctx.rank} of world {ctx.world}; foreign "
+            "shard carriers are merge/sync intermediates and cannot be "
+            "updated"
+        )
+
+    def _route_active(self, name: str) -> bool:
+        """Whether ``update()`` should take the sharded scatter-route
+        plan for ``name``: the state is routed, the live shard is this
+        rank's own, and the world is > 1 (at world 1 every cell is owned
+        — the dense plans are strictly better than filling the outbox
+        with dropped slots)."""
+        return (
+            name in self._routed_states
+            and self._shard_ctx is not None
+            and self._shard_ctx.world > 1
+            and self._own_shard_active()
+        )
+
+    def _merge_sharded(self: TSelf, metrics: List[TSelf]) -> TSelf:
+        """Reassemble the logical state from shard carriers.
+
+        ``self`` plus every peer is a carrier of one rank's slice (the
+        carried rank/world ride the ``_shard_rank``/``_shard_world``
+        states, so clones loaded from any rank's payload self-describe).
+        Per sharded state: place every carrier's slice into a fresh
+        logical array (scatter-ADD, so two carriers of the same rank
+        merge like replicas), then apply every carrier's routed outbox
+        entries in ascending carried-rank order. Routed states are
+        integer counters, so the result is bit-identical to the
+        replicated merge oracle regardless of interleaving. Non-sharded
+        states merge by their declared kinds; CUSTOM non-sharded scalars
+        keep ``self``'s value (sharded families require them
+        rank-identical — the owner-partitioned update contract).
+
+        Afterwards ``self`` is DESHARDED (``_shard_rank == -1``): it
+        carries the logical state, ``compute()`` works locally, and
+        loading its ``state_dict`` back into a sharded working metric
+        re-slices to that rank's shard.
+        """
+        from torcheval_tpu.metrics import shardspec
+
+        carriers = sorted(
+            [self] + list(metrics),
+            key=lambda c: int(getattr(c, "_shard_rank", -1)),
+        )
+        worlds = {
+            int(getattr(c, "_shard_world", 0)) for c in carriers
+        } - {0}
+        if len(worlds) > 1:
+            raise RuntimeError(
+                f"cannot merge shard carriers from different worlds {sorted(worlds)}"
+            )
+        merged: Dict[str, jax.Array] = {}
+        for name, info in self._sharded_states.items():
+            logical = jnp.zeros(info.logical_shape, info.dtype)
+            for c in carriers:
+                value = self._place_state(getattr(c, name))
+                rk = int(getattr(c, "_shard_rank", -1))
+                wd = int(getattr(c, "_shard_world", 0))
+                if rk < 0 or wd <= 0:
+                    # an already-logical carrier folds in whole (only
+                    # meaningful for SUM-kind counters)
+                    logical = logical + value
+                    continue
+                start, stop = self._shard_ctx.shard_range(
+                    info.logical_shape[0], rk, wd
+                )
+                logical = logical.at[start:stop].add(value)
+            names = self._routed_states.get(name)
+            if names is not None:
+                flat = logical.reshape(-1)
+                for c in carriers:
+                    cnt = int(getattr(c, names.obh, 0))
+                    entries = getattr(c, names.obi)[:cnt]
+                    flat = shardspec.apply_outbox_counts(
+                        flat, self._place_state(entries)
+                    )
+                logical = flat.reshape(info.logical_shape)
+            merged[name] = logical
+        skip = set(self._sharded_states) | self._routed_aux_names()
+        skip.update(("_shard_rank", "_shard_world"))
+        for other in carriers:
+            if other is self:
+                continue
+            for name, kind in self._state_name_to_merge_kind.items():
+                if name in skip or kind is MergeKind.CUSTOM:
+                    continue
+                mine = getattr(self, name)
+                theirs = self._place_state(getattr(other, name))
+                setattr(self, name, self._merge_one(name, kind, mine, theirs))
+        for name, value in merged.items():
+            setattr(self, name, value)
+        self._clear_outboxes()
+        self._shard_rank = -1
+        self._shard_world = 0
+        return self
+
+    def _routed_aux_names(self) -> set:
+        out = set()
+        for names in self._routed_states.values():
+            out.update((names.obi, names.obn, names.obh))
+        return out
+
+    def _clear_outboxes(self) -> None:
+        for names in self._routed_states.values():
+            setattr(self, names.obi, jnp.zeros((0,), jnp.int32))
+            setattr(
+                self,
+                names.obn,
+                self._place_state(jnp.zeros((), jnp.int32)),
+            )
+            setattr(self, names.obh, 0)
+
+    def _logical_state(self, name: str) -> jax.Array:
+        """The logically-full view of one state.
+
+        Replicated, mesh-sharded (the global array IS logical — XLA holds
+        it distributed), and desharded instances return the live state
+        untouched. A shard carrier assembles a LOCAL logical view: its
+        slice placed at the carried range plus its own outbox entries —
+        exactly the contributions this rank observed, so a sharded
+        metric's un-synced ``compute()`` equals a replicated metric's
+        local compute bit-for-bit (integer counters). Transient: the
+        assembled array is not retained.
+        """
+        value = getattr(self, name)
+        info = self._sharded_states.get(name) if self._sharded_states else None
+        if info is None or not self._is_shard_carrier():
+            return value
+        from torcheval_tpu.metrics import shardspec
+
+        rk = int(getattr(self, "_shard_rank"))
+        wd = int(getattr(self, "_shard_world"))
+        start, stop = self._shard_ctx.shard_range(
+            info.logical_shape[0], rk, wd
+        )
+        logical = (
+            jnp.zeros(info.logical_shape, info.dtype).at[start:stop].set(value)
+        )
+        names = self._routed_states.get(name)
+        if names is not None:
+            cnt = int(getattr(self, names.obh, 0))
+            logical = shardspec.apply_outbox_counts(
+                logical.reshape(-1), getattr(self, names.obi)[:cnt]
+            ).reshape(info.logical_shape)
+        return logical
+
+    def _reshard_to_own(self: TSelf) -> TSelf:
+        """Re-slice a DESHARDED (logical-carrying) instance back to this
+        rank's configured shard — the tail step of a world-size-change
+        restore: the elastic merge reassembles the full logical state
+        from every old rank's shard + outbox, and each new rank keeps
+        only its slice (slices partition the cells, so globally every
+        contribution survives exactly once)."""
+        ctx = self._shard_ctx
+        if not self._sharded_states or ctx is None or ctx.is_mesh:
+            return self
+        rk = int(getattr(self, "_shard_rank", -1))
+        wd = int(getattr(self, "_shard_world", 0))
+        if rk == ctx.rank and wd == ctx.world:
+            return self
+        if rk >= 0 and wd == 1:
+            # a world-1 carrier's shard IS the logical state, and its
+            # outboxes are structurally empty (every cell was owned) —
+            # safe to re-slice like a desharded instance
+            if any(
+                int(getattr(self, names.obh, 0)) != 0
+                for names in self._routed_states.values()
+            ):
+                raise RuntimeError(
+                    "world-1 shard carrier has pending outbox entries; "
+                    "refusing to reshard"
+                )
+        elif rk >= 0:
+            raise RuntimeError(
+                "reshard requires a desharded (merged) logical state or "
+                f"this rank's own shard; live state carries shard {rk} of "
+                f"world {wd}"
+            )
+        for name, info in self._sharded_states.items():
+            start, stop = ctx.shard_range(info.logical_shape[0])
+            setattr(
+                self,
+                name,
+                jax.lax.slice_in_dim(getattr(self, name), start, stop, axis=0),
+            )
+        self._clear_outboxes()
+        self._shard_rank = ctx.rank
+        self._shard_world = ctx.world
+        return self
+
+    def _adopt_shard_payload(
+        self, state_dict: Dict[str, TState]
+    ) -> Dict[str, TState]:
+        """Normalize an incoming snapshot for a sharded instance.
+
+        A payload carrying ``_shard_rank >= 0`` is adopted verbatim (the
+        live states become that rank's carrier — how sync clones and
+        same-world restores work). A LOGICAL payload (``_shard_rank ==
+        -1``, or legacy/in-jit dicts whose arrays have the logical
+        shapes) is re-sliced to this rank's configured shard with empty
+        outboxes — how a merged result or a world-size-change restore
+        lands back in a working metric."""
+        import numpy as np
+
+        ctx = self._shard_ctx
+        # world-1 contexts skip routing entirely (shardspec.enable_routing),
+        # so their payloads carry no outbox states; fill empty ones so a
+        # strict load into a routed multi-world instance accepts them
+        for names in self._routed_states.values():
+            state_dict.setdefault(names.obi, jnp.zeros((0,), jnp.int32))
+            state_dict.setdefault(names.obn, jnp.zeros((), jnp.int32))
+            state_dict.setdefault(names.obh, 0)
+        rk = state_dict.get("_shard_rank")
+        logical = rk is not None and int(np.asarray(rk)) < 0
+        if rk is None:
+            # no descriptor: infer from shapes (all-or-nothing)
+            shapes = []
+            for name, info in self._sharded_states.items():
+                value = state_dict.get(name)
+                if value is None:
+                    continue
+                shapes.append(
+                    tuple(np.shape(value)) == tuple(info.logical_shape)
+                    and tuple(info.logical_shape)
+                    != tuple(np.shape(getattr(self, name)))
+                )
+            logical = bool(shapes) and all(shapes)
+            if not logical:
+                return state_dict
+        if not logical:
+            return state_dict
+        for name, info in self._sharded_states.items():
+            value = state_dict.get(name)
+            if value is None:
+                continue
+            start, stop = ctx.shard_range(info.logical_shape[0])
+            state_dict[name] = jnp.asarray(value)[start:stop]
+        state_dict["_shard_rank"] = ctx.rank
+        state_dict["_shard_world"] = ctx.world
+        for names in self._routed_states.values():
+            state_dict[names.obi] = jnp.zeros((0,), jnp.int32)
+            state_dict[names.obn] = jnp.zeros((), jnp.int32)
+            state_dict[names.obh] = 0
+        return state_dict
+
     # ------------------------------------------------------------------ reset
 
     def reset(self: TSelf) -> TSelf:
@@ -581,7 +983,9 @@ class Metric(Generic[TComputeReturn], ABC):
                 setattr(
                     self,
                     name,
-                    self._place_state(self._clone_state(default, force_copy=True)),
+                    self._place_named(
+                        name, self._clone_state(default, force_copy=True)
+                    ),
                 )
         # a provenance left by a prior (possibly degraded) sync — and the
         # observability step cursor stamped by the last recorded update —
@@ -615,14 +1019,36 @@ class Metric(Generic[TComputeReturn], ABC):
         doing the same with the full :meth:`state_dict` (pinned by
         tests/metrics/test_payload_trimming.py). Checkpoints always use
         the untrimmed :meth:`state_dict`.
+
+        Sharded metrics inherit the discipline for their routed outboxes:
+        the sync ships each outbox sliced to the power-of-2 bucket
+        covering its entry count — so the sharded sync wire is
+        ``shard (size/world) + O(entries)`` per rank, never the buffer
+        capacity, and never the full logical state.
         """
-        return self.state_dict()
+        sd = self.state_dict()
+        if self._routed_states:
+            for names in self._routed_states.values():
+                cnt = int(getattr(self, names.obh, 0))
+                keep = 1 << (cnt - 1).bit_length() if cnt > 0 else 0
+                buf = sd.get(names.obi)
+                if _is_array(buf) and buf.shape[0] > keep:
+                    sd[names.obi] = buf[:keep]
+        return sd
 
     def load_state_dict(
         self, state_dict: Dict[str, TState], strict: bool = True
     ) -> None:
-        """Load a snapshot (reference metric.py:168-210)."""
+        """Load a snapshot (reference metric.py:168-210).
+
+        Sharded instances accept two payload forms (see
+        :meth:`_adopt_shard_payload`): a self-describing shard carrier's
+        snapshot (adopted verbatim) or a logical-full snapshot (re-sliced
+        to this rank's configured shard).
+        """
         state_dict = dict(state_dict)
+        if self._sharded_states and self._shard_ctx is not None and not self._shard_ctx.is_mesh:
+            state_dict = self._adopt_shard_payload(state_dict)
         registered = set(self._state_name_to_default)
         provided = set(state_dict)
         if strict and registered != provided:
@@ -643,7 +1069,9 @@ class Metric(Generic[TComputeReturn], ABC):
             setattr(
                 self,
                 name,
-                self._place_state(self._clone_state(value, force_copy=True)),
+                self._place_named(
+                    name, self._clone_state(value, force_copy=True)
+                ),
             )
         # restored state replaces whatever a prior sync produced: drop the
         # stale provenance (the sync path re-attaches its own afterwards)
@@ -682,4 +1110,4 @@ class Metric(Generic[TComputeReturn], ABC):
         # Unpickled arrays materialize on the process default backend; restore
         # the device invariant so cross-host sync keeps state where declared.
         for name in self._state_name_to_default:
-            setattr(self, name, self._place_state(getattr(self, name)))
+            setattr(self, name, self._place_named(name, getattr(self, name)))

@@ -1,0 +1,364 @@
+"""Sharded metric state (ZeRO-for-metrics, ROADMAP item 1).
+
+Every rank of a data-parallel eval traditionally holds a FULL replica of
+every metric state. For big states — confusion matrices with thousands of
+classes, million-bin binned PRC/AUROC histograms, windowed rings with huge
+task counts — the replica caps per-host memory and makes the sync wire
+scale as ``world x size``. "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training" (arXiv:2004.13336) is the blueprint this
+module applies to metric state: **partition the state itself across the
+data-parallel world**, so per-rank state bytes and sync wire both drop to
+``~size/world``.
+
+Two realizations share one declaration (:class:`ShardSpec`, passed to
+``Metric._add_state``):
+
+- **Eager sharding** (``ShardContext(rank, world)``): one rank per
+  process/thread (``ThreadWorld``, ``MultiHostGroup``). Each rank's live
+  state is its contiguous slice along ``spec.axis``. For *routed* states
+  (:func:`enable_routing` — counter states fed by scatter updates), an
+  ``update()`` scatters the batch's owned contributions straight into the
+  local shard (the PR 6 ``segment_count`` kernels do the routing) and
+  appends foreign flat indices to a small **outbox** buffer; the sync
+  ships ``shard + outbox`` (``~size/world`` per rank) instead of the full
+  replica, and the merge reassembles the logical state from the owner
+  shards before applying every rank's outbox in rank order. All routed
+  states are integer COUNTERS, so reassembly is exact (integer adds
+  commute) — the synced ``compute()`` is bit-identical to the replicated
+  merge oracle.
+- **Mesh sharding** (``ShardContext.from_mesh(mesh, axis)``): the
+  single-controller path. States keep their logical shape but are placed
+  with ``NamedSharding(mesh, PartitionSpec(axis))``; the fused update
+  jits pin ``out_shardings`` so XLA keeps the state distributed (and the
+  donated variant keeps aliasing each device's shard in place). Sync is
+  a no-op — the state is already owner-partitioned — and the in-jit
+  carry form lowers to ONE ``reduce-scatter`` instead of an all-reduce
+  (``sharded.sync_states_in_jit(..., shard_specs=...)``).
+
+Exactness contract: routed scatter states must be integer-valued
+counters (int dtypes, or float counts below 2**24) — reassembly then
+reproduces the replicated oracle bit-for-bit regardless of add order.
+Non-routed sharded states (windowed rings) are owner-partitioned: every
+rank must observe the SAME update stream (the SPMD in-step discipline or
+a pre-aggregated ingestion tier), each rank persists only its owned rows,
+and sync is a reshard of disjoint rows — no reduction at all.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "ShardContext",
+    "ShardSpec",
+    "ShardInfo",
+    "enable_routing",
+    "route_scatter_kernel",
+]
+
+_OUTBOX_MIN_CAPACITY = 64
+
+
+class ShardSpec(NamedTuple):
+    """Per-state sharding declaration (``Metric._add_state(shard=...)``).
+
+    ``axis`` is the state dimension partitioned across the world. The
+    dimension must divide evenly by the world size — metric state shapes
+    are configuration (num_classes, bins, tasks), so the caller rounds
+    the configuration up rather than this layer padding silently.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import ShardSpec
+        >>> ShardSpec(axis=0)
+        ShardSpec(axis=0)
+    """
+
+    axis: int = 0
+
+
+class ShardInfo(NamedTuple):
+    """Registered bookkeeping for one sharded state."""
+
+    spec: ShardSpec
+    logical_shape: Tuple[int, ...]
+    dtype: Any
+    sharding: Any = None  # NamedSharding under a mesh context
+
+    @property
+    def logical_size(self) -> int:
+        size = 1
+        for d in self.logical_shape:
+            size *= int(d)
+        return size
+
+
+class ShardContext:
+    """Where a metric's sharded states live.
+
+    - ``ShardContext(rank, world)`` — eager: this process/thread owns
+      shard ``rank`` of ``world`` (build one per rank, e.g. from the
+      process group via :meth:`from_group`).
+    - ``ShardContext.from_mesh(mesh, axis)`` — single-controller: all
+      shards live in-process, distributed over the mesh axis's devices
+      via ``NamedSharding``.
+
+    Examples::
+
+        >>> from torcheval_tpu.metrics import MulticlassConfusionMatrix, ShardContext
+        >>> cm = MulticlassConfusionMatrix(8, shard=ShardContext(rank=1, world=4))
+        >>> cm.confusion_matrix.shape  # this rank's slice, not (8, 8)
+        (2, 8)
+    """
+
+    def __init__(self, rank: int, world: int) -> None:
+        world = int(world)
+        rank = int(rank)
+        if world < 1:
+            raise ValueError(f"shard world must be >= 1, got {world}")
+        if not 0 <= rank < world:
+            raise ValueError(
+                f"shard rank {rank} out of range for world {world}"
+            )
+        self.rank = rank
+        self.world = world
+        self.mesh = None
+        self.mesh_axis: Optional[str] = None
+
+    @classmethod
+    def from_group(cls, group) -> "ShardContext":
+        """Eager context matching a ``ProcessGroup``'s rank/world."""
+        return cls(group.rank, group.world_size)
+
+    @classmethod
+    def from_mesh(cls, mesh, axis: str = "dp") -> "ShardContext":
+        """Single-controller context over one named mesh axis."""
+        ctx = cls.__new__(cls)
+        ctx.rank = 0
+        ctx.world = int(mesh.shape[axis])
+        ctx.mesh = mesh
+        ctx.mesh_axis = axis
+        return ctx
+
+    @property
+    def is_mesh(self) -> bool:
+        return self.mesh is not None
+
+    # a context is configuration, not state: clones/deepcopies of a
+    # metric share it (a Mesh holds live Device objects that cannot be
+    # deep-copied, and eager rank/world are immutable ints)
+    def __deepcopy__(self, memo) -> "ShardContext":
+        return self
+
+    def __copy__(self) -> "ShardContext":
+        return self
+
+    def shard_range(
+        self, dim: int, rank: Optional[int] = None, world: Optional[int] = None
+    ) -> Tuple[int, int]:
+        """Contiguous ``[start, stop)`` owned along a sharded dimension."""
+        world = self.world if world is None else int(world)
+        rank = self.rank if rank is None else int(rank)
+        dim = int(dim)
+        if dim % world != 0:
+            raise ValueError(
+                f"sharded dimension {dim} does not divide evenly over "
+                f"world {world}; size the metric configuration (classes/"
+                "bins/tasks) to a multiple of the shard world"
+            )
+        k = dim // world
+        return rank * k, (rank + 1) * k
+
+    def prepare_state(
+        self, name: str, default, spec: ShardSpec
+    ) -> Tuple[Any, ShardInfo]:
+        """The registered default and :class:`ShardInfo` for one sharded
+        state: eager contexts slice the logical default to the owned
+        range; mesh contexts keep the logical default and record the
+        ``NamedSharding`` placement."""
+        if not isinstance(default, jax.Array):
+            raise TypeError(
+                f"sharded state {name!r} must register an array default, "
+                f"got {type(default).__name__}"
+            )
+        axis = spec.axis
+        if not 0 <= axis < default.ndim:
+            raise ValueError(
+                f"sharded state {name!r}: axis {axis} out of range for "
+                f"shape {default.shape}"
+            )
+        logical_shape = tuple(int(d) for d in default.shape)
+        if self.is_mesh:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # divisibility checked up front (device_put would only fail later)
+            self.shard_range(logical_shape[axis])
+            pspec = PartitionSpec(
+                *[
+                    self.mesh_axis if d == axis else None
+                    for d in range(default.ndim)
+                ]
+            )
+            sharding = NamedSharding(self.mesh, pspec)
+            info = ShardInfo(spec, logical_shape, default.dtype, sharding)
+            return default, info
+        start, stop = self.shard_range(logical_shape[axis])
+        shard_default = lax.slice_in_dim(default, start, stop, axis=axis)
+        info = ShardInfo(spec, logical_shape, default.dtype)
+        return shard_default, info
+
+
+# ---------------------------------------------------------------- routing
+
+
+class RoutedInfo(NamedTuple):
+    """Outbox bookkeeping for one routed (scatter-counter) state.
+
+    ``obi`` — int32 device buffer of foreign FLAT indices (``-1`` =
+    dropped slot: an owned entry, or an out-of-range index);
+    ``obn`` — int32 device scalar write cursor (advanced in-kernel, so
+    the steady-state update uploads nothing);
+    ``obh`` — host int mirror of the cursor (advanced by the plan's
+    ``finalize``), used for capacity growth and payload trimming.
+    """
+
+    state: str
+    obi: str
+    obn: str
+    obh: str
+
+
+def routed_names(state: str) -> RoutedInfo:
+    return RoutedInfo(
+        state, f"{state}__obi", f"{state}__obn", f"{state}__obh"
+    )
+
+
+def enable_routing(metric, state: str) -> Optional[RoutedInfo]:
+    """Register the outbox states for one sharded counter state.
+
+    Call right after ``_add_state(state, ..., shard=ShardSpec(...))``.
+    No-op (returns ``None``) unless the metric has an EAGER shard
+    context — mesh and replicated instances need no outbox (XLA and the
+    dense kernels route for them).
+    """
+    from torcheval_tpu.metrics.metric import MergeKind
+
+    ctx = metric._shard_ctx
+    if ctx is None or ctx.is_mesh or state not in metric._sharded_states:
+        return None
+    # world 1 still REGISTERS the (forever-empty) outbox states: its
+    # snapshots then interchange with multi-world shard payloads (a
+    # scale-in restore loads old outboxes into the world-1 instance and
+    # the merge applies them), while Metric._route_active keeps the
+    # world-1 UPDATE on the dense plans — routing there would only fill
+    # the outbox with -1 slots, one per sample, forever.
+    info = metric._sharded_states[state]
+    if info.logical_size >= 2**31:
+        raise ValueError(
+            f"routed state {state!r} has {info.logical_size} logical "
+            "cells; flat routing indices must fit int32"
+        )
+    names = routed_names(state)
+    # 0-size sentinel like _buffer.py: capacity fixed by the first append
+    metric._add_state(names.obi, jnp.zeros((0,), jnp.int32), merge=MergeKind.CUSTOM)
+    metric._add_state(names.obn, jnp.zeros((), jnp.int32), merge=MergeKind.CUSTOM)
+    metric._add_state(names.obh, 0, merge=MergeKind.CUSTOM)
+    metric._routed_states[state] = names
+    return names
+
+
+def _outbox_capacity(n: int) -> int:
+    if n <= _OUTBOX_MIN_CAPACITY:
+        return _OUTBOX_MIN_CAPACITY
+    return 1 << (n - 1).bit_length()
+
+
+def ensure_outbox_capacity(metric, state: str, n_new: int) -> None:
+    """Grow the outbox buffer (power-of-2, ``-1`` fill) to admit ``n_new``
+    more entries — the host-side half of the append, mirroring
+    ``_buffer.BufferedExamplesMetric._ensure_capacity``."""
+    names = metric._routed_states[state]
+    buf = getattr(metric, names.obi)
+    needed = getattr(metric, names.obh) + int(n_new)
+    cap = buf.shape[0]
+    if needed <= cap:
+        return
+    new_cap = _outbox_capacity(needed)
+    setattr(
+        metric,
+        names.obi,
+        jnp.pad(buf, (0, new_cap - cap), constant_values=-1),
+    )
+
+
+# cached per (index_fn, start, stop, cfg): a STABLE kernel object per
+# shard range, so the _fuse jit caches hit across updates (the
+# _window_transform discipline)
+_ROUTE_KERNEL_CACHE: Dict[Any, Any] = {}
+
+
+def route_scatter_kernel(index_fn, start: int, stop: int, cfg: Tuple = ()):
+    """The fused sharded-scatter update kernel for one routed state.
+
+    ``index_fn(*dynamic, *cfg) -> flat int indices`` maps one batch to
+    logical flat cells (negative = drop). The returned transform takes
+    ``states = (shard, outbox_idx, outbox_cursor)`` plus the dynamic
+    batch and, in ONE device program:
+
+    - scatters owned contributions (``start <= idx < stop``) into the
+      local shard via ``ops.segment.segment_count`` (the PR 6 one-pass
+      native kernel on CPU);
+    - masks owned entries to ``-1`` and appends the batch's index vector
+      to the outbox at the device-side cursor (no host upload — the
+      cursor is carried state);
+    - advances the cursor.
+
+    Under donation all three states alias in place (the shard add and
+    the ``dynamic_update_slice`` append are in-place writes; the 0-d
+    cursor may legally re-materialize).
+    """
+    key = (index_fn, int(start), int(stop), cfg)
+    fn = _ROUTE_KERNEL_CACHE.get(key)
+    if fn is not None:
+        return fn
+    from torcheval_tpu.ops import segment
+
+    n_local = int(stop) - int(start)
+
+    def transform(states, *dynamic):
+        shard, obi, obn = states
+        idx = jnp.asarray(index_fn(*dynamic, *cfg))
+        owned = (idx >= start) & (idx < stop)
+        local = jnp.where(owned, idx - start, n_local).astype(jnp.int32)
+        delta = segment.segment_count(local, n_local + 1)[:n_local]
+        new_shard = (
+            shard.reshape(-1) + delta.astype(shard.dtype)
+        ).reshape(shard.shape)
+        foreign = jnp.where(owned, -1, idx).astype(jnp.int32)
+        new_obi = lax.dynamic_update_slice(obi, foreign, (obn,))
+        return new_shard, new_obi, obn + jnp.int32(idx.shape[0])
+
+    _ROUTE_KERNEL_CACHE[key] = transform
+    return transform
+
+
+def apply_outbox_counts(
+    logical_flat: jax.Array, entries: jax.Array
+) -> jax.Array:
+    """Add one rank's outbox entries (flat indices, ``-1`` = dropped)
+    into a flat logical counter state. Pure jnp — traceable, and exact
+    for the integer-valued counters routing supports."""
+    from torcheval_tpu.ops import segment
+
+    if entries.shape[0] == 0:
+        return logical_flat
+    size = logical_flat.shape[0]
+    counts = segment.segment_count(
+        segment.safe_ids(entries, size), size
+    )
+    return logical_flat + counts.astype(logical_flat.dtype)

@@ -51,6 +51,7 @@ _logger: logging.Logger = logging.getLogger(__name__)
 # mirrors the reference toolkit's public surface (reference
 # torcheval/metrics/toolkit.py) plus the beyond-parity update_collection
 __all__ = [
+    "adopt_synced",
     "sync_and_compute",
     "sync_and_compute_collection",
     "get_synced_metric",
@@ -397,6 +398,50 @@ def get_synced_state_dict_collection(
         name: m.state_dict()
         for name, m in get_synced_metric_collection(metrics, group).items()
     }
+
+
+def adopt_synced(
+    metric: MetricOrReplicas,
+    process_group: Optional[ProcessGroup] = None,
+    on_failure: Optional[str] = None,
+) -> Metric:
+    """Sync, then load the merged state back into the working metric —
+    the steady-state drain point for SHARDED metrics.
+
+    An eager-sharded metric's routed outbox accumulates foreign
+    contributions between syncs (O(batch x steps) entries). A plain
+    ``sync_and_compute`` leaves the working metric untouched (syncs are
+    non-mutating), so long-running loops adopt the synced result
+    periodically: the merged LOGICAL state re-slices to this rank's
+    shard and the outbox empties — per-rank bytes return to
+    ``size/world + one-batch outbox``. Returns the synced (logical)
+    metric so the caller can also ``compute()`` it without a second
+    exchange.
+
+    SHARDED metrics only: the sharded adopt re-slices every rank to
+    DISJOINT shards, so later syncs stay exact. Loading the merged
+    state back into REPLICATED metrics would leave every rank holding
+    the already-global totals — the next SUM sync would multiply them
+    by the world size — so replicated metrics are rejected rather than
+    silently double-counted.
+    """
+    targets = (
+        metric if isinstance(metric, (list, tuple)) else [metric]
+    )
+    for m in targets:
+        if not getattr(m, "_sharded_states", None):
+            raise TypeError(
+                f"adopt_synced requires sharded metrics; "
+                f"{type(m).__name__} is replicated — adopting the merged "
+                "state would double-count it at the next sync (use "
+                "sync_and_compute / get_synced_metric instead)"
+            )
+    synced = get_synced_metric(metric, process_group, on_failure=on_failure)
+    payload = synced.state_dict()
+    for m in targets:
+        m.load_state_dict(payload)
+        m.sync_provenance = synced.sync_provenance
+    return synced
 
 
 def clone_metric(metric: TMetric) -> TMetric:

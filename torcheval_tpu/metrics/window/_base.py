@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from torcheval_tpu.utils.convert import cached_index
 
 from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
+from torcheval_tpu.metrics.shardspec import ShardSpec
 
 TWindowed = TypeVar("TWindowed", bound="WindowedTaskCounterMetric")
 
@@ -35,13 +36,22 @@ TWindowed = TypeVar("TWindowed", bound="WindowedTaskCounterMetric")
 _WINDOW_TRANSFORM_CACHE: dict = {}
 
 
-def _window_transform(kernel, n_counters: int, lifetime: bool, config):
+def _window_transform(
+    kernel, n_counters: int, lifetime: bool, config, row_slice=None
+):
     """A stable (cacheable) transform closure: counter kernel + lifetime
     accumulates + ring-column writes over a names-ordered flat state tuple
     ``(lifetime..., rings...)``. Used both by single-metric updates (via
     ``fused_transform``) and by ``toolkit.update_collection`` group
-    programs — the SAME function object per key, so the jit caches hit."""
-    key = (kernel, n_counters, lifetime, config)
+    programs — the SAME function object per key, so the jit caches hit.
+
+    ``row_slice`` (the sharded-window variant): the per-update counter
+    vectors span ALL tasks, but this rank's ring and lifetime states hold
+    only the ``[start, stop)`` task rows — the deltas are sliced before
+    the accumulate/column write, so the state stays ``tasks/world`` and
+    every rank persists exactly its owned rows of the same global update
+    stream."""
+    key = (kernel, n_counters, lifetime, config, row_slice)
     fn = _WINDOW_TRANSFORM_CACHE.get(key)
     if fn is None:
 
@@ -53,6 +63,13 @@ def _window_transform(kernel, n_counters: int, lifetime: bool, config):
                 raise ValueError(
                     f"kernel {kernel.__name__} returned {len(deltas)} "
                     f"counter values for {n_counters} counters"
+                )
+            if row_slice is not None:
+                # scalar deltas broadcast to every owned row, exactly as
+                # they broadcast to every task row unsharded
+                deltas = tuple(
+                    d if jnp.ndim(d) == 0 else d[row_slice[0]:row_slice[1]]
+                    for d in deltas
                 )
             if lifetime:
                 lt, rings = states[:n_counters], states[n_counters:]
@@ -139,16 +156,28 @@ class WindowedTaskCounterMetric(RingCursorSerializationMixin, Metric):
         self._add_state("max_num_updates", max_num_updates, merge=MergeKind.CUSTOM)
         self.next_inserted = 0
         self._add_state("total_updates", 0, merge=MergeKind.CUSTOM)
+        # sharded windows (metrics/shardspec.py): rings and lifetime
+        # vectors partition by TASK rows across the shard world — the
+        # serving-scale per-key layout, where num_tasks is the big axis.
+        # Owner-partitioned contract: every rank must observe the SAME
+        # update stream (counter vectors are per-task, not per-example);
+        # each rank persists only its owned rows, sync is a reshard of
+        # disjoint rows, and the reassembled window equals the one
+        # metric that saw the stream — bit-for-bit.
+        ring_shard = ShardSpec(axis=0)
         if enable_lifetime:
             if lifetime_defaults is None:
                 lifetime_defaults = [jnp.zeros(num_tasks) for _ in counter_names]
             for name, default in zip(counter_names, lifetime_defaults):
-                self._add_state(name, default, merge=MergeKind.CUSTOM)
+                self._add_state(
+                    name, default, merge=MergeKind.CUSTOM, shard=ring_shard
+                )
         for name in counter_names:
             self._add_state(
                 f"windowed_{name}",
                 jnp.zeros((num_tasks, max_num_updates)),
                 merge=MergeKind.CUSTOM,
+                shard=ring_shard,
             )
 
     # ------------------------------------------------------------- accumulate
@@ -179,6 +208,9 @@ class WindowedTaskCounterMetric(RingCursorSerializationMixin, Metric):
             tuple(counter_names) if self.enable_lifetime else ()
         ) + tuple(f"windowed_{n}" for n in counter_names)
         col = self.next_inserted
+        row_slice = None
+        if self._sharded_states and self._own_shard_active():
+            row_slice = self._shard_ctx.shard_range(self.num_tasks)
 
         def finalize():
             self.next_inserted = (col + 1) % self.max_num_updates
@@ -186,7 +218,8 @@ class WindowedTaskCounterMetric(RingCursorSerializationMixin, Metric):
 
         return UpdatePlan(
             _window_transform(
-                kernel, len(counter_names), self.enable_lifetime, config
+                kernel, len(counter_names), self.enable_lifetime, config,
+                row_slice,
             ),
             names,
             (cached_index(col),) + tuple(dynamic),
@@ -229,8 +262,15 @@ class WindowedTaskCounterMetric(RingCursorSerializationMixin, Metric):
         ``tests/metrics/window/test_window_merge_semantics.py`` pins this
         against the reference implementation. Every consumer is a
         column-sum, so no correctness invariant depends on eviction order.
+
+        Sharded instances route to the reassembling merge
+        (``Metric._merge_sharded``): carriers hold disjoint TASK rows of
+        the same global window (the owner-partitioned update contract),
+        so the merge places rows instead of concatenating columns.
         """
         metrics = list(metrics)
+        if self._sharded_states and self._is_shard_carrier():
+            return Metric.merge_state(self, metrics)
         merged_cols = self.max_num_updates + sum(m.max_num_updates for m in metrics)
         cur_size = min(self.total_updates, self.max_num_updates)
         new_bufs = {}

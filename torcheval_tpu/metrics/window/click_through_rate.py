@@ -47,8 +47,14 @@ class WindowedClickThroughRate(
         max_num_updates: int = 100,
         enable_lifetime: bool = True,
         device: Optional[jax.Device] = None,
+        shard=None,
     ) -> None:
-        super().__init__(device=device)
+        """``shard`` (a :class:`~torcheval_tpu.metrics.shardspec.ShardContext`)
+        partitions the rings and lifetime totals by TASK rows: per-rank
+        state drops to ``num_tasks/world`` rows. Owner-partitioned
+        contract — every rank must feed the SAME update stream (see
+        docs/distributed.md, "Sharded metric state")."""
+        super().__init__(device=device, shard=shard)
         self._init_window_states(
             ("click_total", "weight_total"),
             num_tasks=num_tasks,
@@ -75,7 +81,13 @@ class WindowedClickThroughRate(
         return self._window_plan(kernel, args)
 
     def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
-        """Windowed (and lifetime) CTR per task; empty before any update."""
+        """Windowed (and lifetime) CTR per task; empty before any update.
+
+        SHARDED instances return values for their OWNED task rows only —
+        shape ``(num_tasks/world,)``, covering tasks
+        ``[rank*num_tasks/world, (rank+1)*num_tasks/world)`` — the
+        per-owned-task view of the global stream; sync/merge reassembles
+        the full ``(num_tasks,)`` result."""
         if self.total_updates == 0:
             return self._empty_result()
         click_sum, weight_sum = self._windowed_counter_sums()

@@ -71,8 +71,10 @@ from torcheval_tpu.obs.export import (
 from torcheval_tpu.obs.hist import LatencyHistogram
 from torcheval_tpu.obs.hist import snapshot as latency_snapshot
 from torcheval_tpu.obs.memory import (
+    logical_state_bytes,
     memory_report,
     metric_update_costs,
+    per_rank_state_bytes,
     program_costs,
     state_bytes,
     track_metrics,
@@ -117,6 +119,7 @@ __all__ = [
     "gather_observability",
     "gather_traces",
     "latency_snapshot",
+    "logical_state_bytes",
     "memory_report",
     "metric_update_costs",
     "program_costs",
@@ -124,6 +127,7 @@ __all__ = [
     "recorder",
     "render_prometheus",
     "span",
+    "per_rank_state_bytes",
     "state_bytes",
     "trace_path",
     "track_metrics",

@@ -222,6 +222,11 @@ class MemoryEvent(Event):
     metric: str = ""
     state_bytes: int = 0
     states: int = 0
+    # sharded-state accounting (ISSUE 9): what the state would cost
+    # replicated vs what THIS rank/device actually pins. Equal on
+    # replicated families; per_rank_bytes ~= logical/world on sharded.
+    logical_bytes: int = 0
+    per_rank_bytes: int = 0
 
 
 @dataclass

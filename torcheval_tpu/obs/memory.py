@@ -33,8 +33,10 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Mapping, Optional
 
 __all__ = [
+    "logical_state_bytes",
     "memory_report",
     "metric_update_costs",
+    "per_rank_state_bytes",
     "program_costs",
     "state_bytes",
     "track_metrics",
@@ -72,16 +74,79 @@ def state_bytes(metric) -> Dict[str, int]:
     }
 
 
+def _shard_count(value: Any) -> int:
+    """How many equal shards a mesh-distributed array splits into (1 for
+    replicated / single-device arrays) — metadata only, from the
+    sharding's shard shape."""
+    import jax
+
+    if not isinstance(value, jax.Array):
+        return 1
+    sharding = getattr(value, "sharding", None)
+    if sharding is None or getattr(sharding, "is_fully_replicated", True):
+        return 1
+    try:
+        shard_shape = sharding.shard_shape(value.shape)
+    except Exception:  # noqa: BLE001 — exotic shardings degrade to replicated
+        return 1
+    full = 1
+    for a, b in zip(value.shape, shard_shape):
+        if b:
+            full *= -(-int(a) // int(b))  # ceil-div per partitioned dim
+    return max(int(full), 1)
+
+
+def per_rank_state_bytes(metric) -> Dict[str, int]:
+    """Per-state bytes THIS rank/device actually pins.
+
+    Eager-sharded states already live as this rank's slice, so the live
+    walk is the answer; mesh-sharded states report ``nbytes / shards``
+    (the per-device block, from sharding metadata — no device sync).
+    Replicated states equal :func:`state_bytes`.
+    """
+    out: Dict[str, int] = {}
+    for name in metric._state_name_to_default:
+        value = getattr(metric, name)
+        out[name] = _leaf_bytes(value) // _shard_count(value)
+    return out
+
+
+def logical_state_bytes(metric) -> Dict[str, int]:
+    """Per-state bytes of the LOGICAL (unsharded) state — what one
+    replica would pin. Sharded states report their registered logical
+    shape (``Metric._sharded_states``); everything else equals the live
+    walk. Routed outbox buffers are per-rank overhead and count as-is
+    (the ``small constant`` in the size/world contract)."""
+    import numpy as np
+
+    sharded = getattr(metric, "_sharded_states", None) or {}
+    out: Dict[str, int] = {}
+    for name in metric._state_name_to_default:
+        info = sharded.get(name)
+        if info is not None:
+            out[name] = int(
+                info.logical_size * np.dtype(info.dtype).itemsize
+            )
+        else:
+            out[name] = _leaf_bytes(getattr(metric, name))
+    return out
+
+
 def memory_report(
     metrics: Mapping[str, Any],
 ) -> Dict[str, Dict[str, Any]]:
     """Per-metric state-byte accounting for a ``{name: Metric}`` panel.
 
     Returns ``{name: {"metric": class-name, "state_bytes": total,
-    "states": {state: bytes}}}``. Pure metadata walk — no step executes,
-    no device sync, no collective (pinned by the transfer-guard variant
-    in tests/metrics/test_tracing.py). When the observability recorder
-    is on, one :class:`~torcheval_tpu.obs.events.MemoryEvent` per metric
+    "logical_bytes": ..., "per_rank_bytes": ..., "sharded": bool,
+    "states": {state: bytes}}}``. ``logical_bytes`` is what one
+    unsharded replica would pin; ``per_rank_bytes`` is what THIS
+    rank/device pins (equal for replicated families; ``~logical/world +
+    outbox`` for sharded ones — the ISSUE 9 acceptance measurement).
+    Pure metadata walk — no step executes, no device sync, no collective
+    (pinned by the transfer-guard variant in
+    tests/metrics/test_tracing.py). When the observability recorder is
+    on, one :class:`~torcheval_tpu.obs.events.MemoryEvent` per metric
     lands in the event stream.
     """
     from torcheval_tpu.obs.recorder import RECORDER
@@ -90,9 +155,14 @@ def memory_report(
     for name, metric in metrics.items():
         per_state = state_bytes(metric)
         total = sum(per_state.values())
+        logical = sum(logical_state_bytes(metric).values())
+        per_rank = sum(per_rank_state_bytes(metric).values())
         report[name] = {
             "metric": type(metric).__name__,
             "state_bytes": total,
+            "logical_bytes": logical,
+            "per_rank_bytes": per_rank,
+            "sharded": per_rank != logical,
             "states": per_state,
         }
         if RECORDER.enabled:
@@ -103,6 +173,8 @@ def memory_report(
                     metric=name,
                     state_bytes=total,
                     states=len(per_state),
+                    logical_bytes=logical,
+                    per_rank_bytes=per_rank,
                 )
             )
     return report
@@ -218,11 +290,16 @@ def track_metrics(
     def supplier() -> Dict[str, Any]:
         out: Dict[str, Any] = {}
         total = 0
+        total_rank = 0
         for name, metric in metrics.items():
             n = sum(state_bytes(metric).values())
+            pr = sum(per_rank_state_bytes(metric).values())
             out[f"{name}_state_bytes"] = n
+            out[f"{name}_per_rank_bytes"] = pr
             total += n
+            total_rank += pr
         out["total_state_bytes"] = total
+        out["total_per_rank_bytes"] = total_rank
         return out
 
     registry.register(source, supplier)

@@ -58,19 +58,63 @@ inline int32_t UnpackIndex(uint64_t key) {
 
 constexpr int kChunk = 32;
 
+// Replace the min-heap's root (the current k-th best) and restore the
+// heap property with ONE sift-down. std::pop_heap + push_heap walks the
+// tree twice per displacement; a displacing candidate always evicts the
+// root, so the single sift halves the per-insert tree work — the
+// per-row fixed cost that dominated small rows (64x1000: the seed
+// threshold starts low, so the first chunks nearly all fall through the
+// prefilter into this path). Heap is min-at-root under
+// std::greater<uint64_t>.
+inline void ReplaceMin(uint64_t* heap, int64_t k, uint64_t v) {
+  int64_t i = 0;
+  for (;;) {
+    const int64_t l = 2 * i + 1;
+    const int64_t r = l + 1;
+    int64_t s = i;
+    uint64_t sv = v;
+    if (l < k && heap[l] < sv) {
+      s = l;
+      sv = heap[l];
+    }
+    if (r < k && heap[r] < sv) {
+      s = r;
+    }
+    if (s == i) {
+      break;
+    }
+    heap[i] = heap[s];
+    i = s;
+  }
+  heap[i] = v;
+}
+
 // Heap-scan one row: keys[0..k) ends holding the k largest packed keys,
 // sorted descending.
+//
+// Seed window: the heap is seeded from the first min(n, 4k+64) elements
+// via one nth_element + make_heap instead of just the first k. The
+// running threshold then starts near its final value, so the expected
+// number of chunks that fall through the vectorized prefilter into the
+// scalar insert path drops from ~k·ln(n/k) spread over the early chunks
+// to ~k·ln(n/window) — the early-phase scalar scans were the other half
+// of the small-row fixed cost.
 void TopKRow(const float* row, int64_t n, int64_t k, uint64_t* heap) {
   const uint32_t* bits = reinterpret_cast<const uint32_t*>(row);
-  for (int64_t j = 0; j < k; ++j) {
+  const int64_t seed = std::min<int64_t>(n, 4 * k + 64);
+  for (int64_t j = 0; j < seed; ++j) {
     heap[j] = PackKey(OrderKey(bits[j]), j);
+  }
+  if (seed > k) {
+    std::nth_element(heap, heap + (k - 1), heap + seed,
+                     std::greater<uint64_t>());
   }
   std::make_heap(heap, heap + k, std::greater<uint64_t>());
   // Exactness of the key32-only prefilter: candidates with key32 EQUAL
   // to the heap minimum's key32 can never displace it — the scan moves
   // forward, so their packed index bits are strictly smaller.
   uint32_t min_key = static_cast<uint32_t>(heap[0] >> 32);
-  int64_t i = k;
+  int64_t i = seed;
   for (; i + kChunk <= n; i += kChunk) {
     // max-fold prefilter: a pure vertical max over the chunk's keys
     // (vectorizes to packed unsigned max), one compare per chunk
@@ -85,9 +129,7 @@ void TopKRow(const float* row, int64_t n, int64_t k, uint64_t* heap) {
     for (int c = 0; c < kChunk; ++c) {
       const uint32_t ok = OrderKey(bits[i + c]);
       if (ok > min_key) {
-        std::pop_heap(heap, heap + k, std::greater<uint64_t>());
-        heap[k - 1] = PackKey(ok, i + c);
-        std::push_heap(heap, heap + k, std::greater<uint64_t>());
+        ReplaceMin(heap, k, PackKey(ok, i + c));
         min_key = static_cast<uint32_t>(heap[0] >> 32);
       }
     }
@@ -95,9 +137,7 @@ void TopKRow(const float* row, int64_t n, int64_t k, uint64_t* heap) {
   for (; i < n; ++i) {  // tail
     const uint32_t ok = OrderKey(bits[i]);
     if (ok > min_key) {
-      std::pop_heap(heap, heap + k, std::greater<uint64_t>());
-      heap[k - 1] = PackKey(ok, i);
-      std::push_heap(heap, heap + k, std::greater<uint64_t>());
+      ReplaceMin(heap, k, PackKey(ok, i));
       min_key = static_cast<uint32_t>(heap[0] >> 32);
     }
   }
