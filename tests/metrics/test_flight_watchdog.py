@@ -1,0 +1,588 @@
+"""Flight recorder + stall watchdog acceptance pins (ISSUE 11).
+
+The tentpole contracts:
+
+- **flight rings**: every collective through the group wrapper layer
+  leaves a per-thread :class:`FlightRecord` with live state transitions
+  (``enqueued -> issued -> completed/failed``); a ``ResilientGroup``
+  wrapping an instrumented plain group records ONE record per logical
+  collective (worker-thread suppression), never two;
+- **hang forensics** (the acceptance criterion): a
+  ``FaultInjectionGroup``-delayed collective in a rendezvousing
+  ThreadWorld-4 trips the watchdog DURING the stall, the dump includes
+  all four ranks' flight rings, and ``diff_flight_rings()`` names the
+  injected stalled rank and its last completed seq;
+- **error forensics**: a sync that times out raises with the flight-ring
+  tail attached (``e.flight_tail``) and the ``RetryEvent`` carries it
+  too;
+- **cost**: flight + watchdog + monitor ON add zero collectives (the
+  extended pin lives in test_sync_collective_counts.py) and zero host
+  syncs (test_no_host_sync.py).
+"""
+
+from __future__ import annotations
+
+import io
+import time
+
+import numpy as np
+import pytest
+
+from torcheval_tpu import config, obs
+from torcheval_tpu.metrics import Sum
+from torcheval_tpu.metrics.toolkit import sync_and_compute
+from torcheval_tpu.obs import flight as obs_flight
+from torcheval_tpu.obs import watchdog as obs_watchdog
+from torcheval_tpu.obs.flight import FLIGHT, diff_flight_rings
+from torcheval_tpu.resilience import ResilientGroup, SyncTimeoutError
+from torcheval_tpu.utils.test_utils import (
+    FaultInjectionGroup,
+    FaultSpec,
+    ThreadWorld,
+)
+
+
+@pytest.fixture
+def flight_on():
+    """Flight recording enabled with clean rings; fully restored after."""
+    FLIGHT.reset()
+    FLIGHT.enable("test")
+    try:
+        yield FLIGHT
+    finally:
+        FLIGHT.disable("test")
+        FLIGHT.reset()
+
+
+# ------------------------------------------------------------ ring basics
+
+
+def test_flight_record_lifecycle_and_counters(flight_on):
+    r = FLIGHT.start(
+        "allgather_object", payload_bytes=64, rank=1, world_size=4,
+        state="enqueued",
+    )
+    assert r.state == "enqueued" and r.in_flight
+    FLIGHT.issued(r)
+    assert r.state == "issued" and r.attempts == 1
+    FLIGHT.complete(r, ranks=(0, 1, 2, 3))
+    assert r.state == "completed" and not r.in_flight
+    assert r.ranks == (0, 1, 2, 3)
+    counters = FLIGHT.counters()
+    assert counters["completed_total"] == 1
+    assert counters["in_flight"] == 0
+    assert counters["enabled"] == 1
+    snap = FLIGHT.snapshot()
+    (ring,) = snap.values()
+    assert ring["last_completed_seq"] == 1
+    assert ring["records"][0]["op"] == "allgather_object"
+    assert ring["records"][0]["payload_bytes"] == 64
+    # wall timestamps were stamped at each transition
+    rec = ring["records"][0]
+    assert 0 < rec["t_enqueued"] <= rec["t_issued"] <= rec["t_done"]
+
+
+def test_flight_ring_is_bounded(flight_on):
+    FLIGHT.capacity = 8
+    try:
+        for _ in range(50):
+            r = FLIGHT.start("allgather_object", rank=0, world_size=1)
+            FLIGHT.complete(r, ranks=(0,))
+    finally:
+        FLIGHT.capacity = obs_flight.DEFAULT_RING_CAPACITY
+    (ring,) = FLIGHT.rings().values()
+    records = ring.tail()
+    assert len(records) <= 8
+    assert records[-1].seq == 50  # seq keeps counting past evictions
+
+
+def test_disabled_flight_costs_one_attribute_read():
+    FLIGHT.reset()
+    assert not FLIGHT.enabled
+    assert FLIGHT.start("allgather_object") is None
+    FLIGHT.complete(None)  # no-ops, never raises
+    FLIGHT.fail(None)
+    FLIGHT.issued(None)
+    assert FLIGHT.rings() == {}
+
+
+def test_source_keyed_enable_survives_recorder_disable():
+    """An armed watchdog's flight source outlives the event recorder:
+    recorder on+off must not blind the watchdog."""
+    FLIGHT.reset()
+    rec = obs.recorder()
+    prev = rec.enabled
+    FLIGHT.enable("watchdog")
+    try:
+        rec.enable()
+        assert FLIGHT.enabled
+        rec.disable()
+        assert FLIGHT.enabled  # the watchdog source holds it on
+    finally:
+        FLIGHT.disable("watchdog")
+        if prev:
+            rec.enable()
+    assert not FLIGHT.enabled
+
+
+def test_resilient_wrapper_records_one_record_per_collective(flight_on):
+    """The resilient layer's record IS the collective's record: a worker
+    thread running the inner gather must not add a second one (the
+    suppression contract)."""
+    world = ThreadWorld(2)
+
+    def run(view):
+        g = ResilientGroup(view, timeout=10.0, policy="quorum")
+        g.allgather_object({"r": view.rank})
+        g.allgather_object({"r": view.rank})
+        return FLIGHT._ring().tail()
+
+    results = world.run(run)
+    for rank, records in enumerate(results):
+        assert len(records) == 2, f"rank {rank}: one record per collective"
+        assert [r.seq for r in records] == [1, 2]
+        assert all(r.state == "completed" for r in records)
+        assert all(r.attempts == 1 for r in records)
+
+
+def test_retry_keeps_one_record_with_attempt_count(flight_on):
+    """A transient wire glitch reissues the collective — the flight ring
+    keeps ONE record whose ``attempts`` counts the reissues."""
+    import copy
+
+    class TwoRankFake:
+        world_size = 2
+        rank = 0
+        is_member = True
+        ranks = (0, 1)
+
+        def unwrap(self):
+            return self
+
+        def allgather_object(self, obj):
+            return [obj, copy.deepcopy(obj)]
+
+        def allgather_array(self, x):
+            x = np.asarray(x)
+            return [x, x.copy()]
+
+    g = ResilientGroup(
+        FaultInjectionGroup(TwoRankFake(), [FaultSpec(0, "transient")]),
+        timeout=10.0, retries=2, policy="quorum",
+        backoff_base=0.001, backoff_max=0.002,
+    )
+    g.allgather_object({"r": 0})
+    (record,) = FLIGHT._ring().tail()
+    assert record.state == "completed"
+    assert record.attempts == 2  # first attempt + one reissue
+
+
+# ----------------------------------------------------------------- diffing
+
+
+def _records(specs, rank):
+    """specs: list of (seq, op, state)."""
+    return [
+        {
+            "seq": seq, "op": op, "state": state, "rank": rank,
+            "t_issued": time.time(), "payload_bytes": 0,
+        }
+        for seq, op, state in specs
+    ]
+
+
+def test_diff_names_stalled_rank_and_last_completed_seq():
+    per_rank = {}
+    for rank in range(4):
+        if rank == 2:
+            per_rank[rank] = _records(
+                [(1, "allgather_object", "completed"),
+                 (2, "allgather_object", "completed"),
+                 (3, "allgather_object", "issued")],
+                rank,
+            )
+        else:
+            per_rank[rank] = _records(
+                [(1, "allgather_object", "completed"),
+                 (2, "allgather_object", "completed"),
+                 (3, "allgather_object", "completed"),
+                 (4, "allgather_object", "issued")],
+                rank,
+            )
+    diff = diff_flight_rings(per_rank)
+    assert not diff.ok
+    assert diff.stalled_rank == 2
+    assert diff.stalled_seq == 2  # its last COMPLETED ordinal
+    assert diff.stalled_op == "allgather_object"
+    assert diff.last_completed == {0: 3, 1: 3, 2: 2, 3: 3}
+    assert "rank 2" in diff.format()
+
+
+def test_diff_names_diverging_rank_via_collective_op_shapes():
+    per_rank = {
+        0: _records(
+            [(1, "allgather_object", "completed"),
+             (2, "allgather_array", "completed")], 0,
+        ),
+        1: _records(
+            [(1, "allgather_object", "completed"),
+             (2, "allgather_object", "completed")], 1,
+        ),
+    }
+    diff = diff_flight_rings(per_rank)
+    assert not diff.ok
+    assert diff.diverged_rank == 1
+    assert diff.divergence_seq == 2
+    assert "would-deadlock" in diff.format()
+
+
+def test_diff_consistent_rings_are_ok():
+    per_rank = {
+        r: _records([(1, "allgather_object", "completed")], r)
+        for r in range(3)
+    }
+    diff = diff_flight_rings(per_rank)
+    assert diff.ok and diff.findings == []
+
+
+# ----------------------------------------------- hang forensics (acceptance)
+
+
+def test_watchdog_trips_on_injected_stall_and_diff_names_the_rank():
+    """ISSUE 11 acceptance: a FaultInjectionGroup-delayed collective in a
+    ThreadWorld-4 trips the watchdog DURING the stall; the dump includes
+    all ranks' flight rings; diff_flight_rings names the injected
+    stalled rank and its last completed seq. Deterministic: the fault is
+    scripted by call index, the watchdog deadline is far below the
+    injected delay, and the delay is far below the collective timeout —
+    the trip always lands inside the stall window."""
+    sink = io.StringIO()
+    FLIGHT.reset()
+    rec = obs.recorder()
+    prev = rec.enabled
+    rec.reset()
+    rec.enable()
+    wd = obs_watchdog.arm_watchdog(0.25, poll=0.05, sink=sink)
+    try:
+        world = ThreadWorld(4, timeout=30.0)
+
+        def run(view):
+            # rank 2's third collective stalls 1.5 s >> 0.25 s deadline
+            faults = (
+                [FaultSpec(2, "delay", seconds=1.5)]
+                if view.rank == 2 else []
+            )
+            g = ResilientGroup(
+                FaultInjectionGroup(view, faults),
+                timeout=20.0, policy="quorum",
+            )
+            for i in range(4):
+                g.allgather_object({"rank": view.rank, "i": i})
+
+        world.run(run)
+        assert wd.trips >= 1
+        trip = wd.last_trip
+        assert trip["rank"] == 2
+        assert trip["op"] == "allgather_object"
+        assert trip["age_seconds"] >= 0.25
+
+        # the dump carried ALL four ranks' rings
+        assert sorted(trip["flight"]) == [0, 1, 2, 3]
+        dump = sink.getvalue()
+        assert "stall watchdog" in dump
+        for rank in range(4):
+            assert f"rank {rank}" in dump
+        assert "IN FLIGHT" in dump
+
+        # diff of the trip-time rings names the injected rank and its
+        # last completed seq: rank 2 completed 2 collectives (seq 1-2)
+        # and stalled in its 3rd, while peers completed 3 and block in
+        # their 4th
+        diff = diff_flight_rings(trip["flight"])
+        assert not diff.ok
+        assert diff.stalled_rank == 2
+        assert diff.stalled_seq == 2
+        assert diff.stalled_op == "allgather_object"
+        assert max(diff.last_completed.values()) == 3
+
+        # the StallEvent landed in the event ring, typed
+        stalls = [e for e in rec.log.tail() if e.kind == "stall"]
+        assert stalls, "watchdog trip must record a StallEvent"
+        assert stalls[-1].op == "allgather_object"
+        assert stalls[-1].rank == 2
+        assert stalls[-1].deadline == 0.25
+    finally:
+        obs_watchdog.disarm_watchdog()
+        rec.reset()
+        if not prev:
+            rec.disable()
+        FLIGHT.reset()
+    assert obs_watchdog.current_watchdog() is None
+
+
+def test_watchdog_jsonl_dump_is_synchronous(tmp_path):
+    """The forensics line is on disk when trip() returns — the process
+    may be SIGKILLed the next instant."""
+    import json
+
+    path = tmp_path / "stalls.jsonl"
+    FLIGHT.reset()
+    FLIGHT.enable("test")
+    wd = obs_watchdog.StallWatchdog(0.05, sink=None, jsonl=str(path))
+    try:
+        r = FLIGHT.start("allgather_object", rank=3, world_size=4)
+        time.sleep(0.06)
+        wd.trip(r, time.monotonic())
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 1
+        payload = json.loads(lines[0])
+        assert payload["kind"] == "stall"
+        assert payload["op"] == "allgather_object"
+        assert payload["rank"] == 3
+        assert payload["schema"] == 1
+        assert payload["flight"], "dump carries the flight snapshot"
+        FLIGHT.complete(r, ranks=(0, 1, 2, 3))
+    finally:
+        FLIGHT.disable("test")
+        FLIGHT.reset()
+
+
+def test_watchdog_one_trip_per_stall_and_rearm():
+    """A sustained stall logs ONE trip; after progress resumes a new
+    stall trips again."""
+    FLIGHT.reset()
+    FLIGHT.enable("test")
+    wd = obs_watchdog.StallWatchdog(0.08, poll=0.02, sink=None)
+    wd.arm()
+    try:
+        r = FLIGHT.start("allgather_object", rank=0, world_size=2)
+        time.sleep(0.4)  # several poll ticks past the deadline
+        assert wd.trips == 1
+        assert wd.tripped
+        FLIGHT.complete(r, ranks=(0, 1))
+        time.sleep(0.1)
+        assert not wd.tripped  # progress cleared the stall
+        r2 = FLIGHT.start("allgather_array", rank=0, world_size=2)
+        time.sleep(0.2)
+        assert wd.trips == 2
+        FLIGHT.complete(r2, ranks=(0, 1))
+    finally:
+        wd.disarm()
+        FLIGHT.disable("test")
+        FLIGHT.reset()
+    assert not wd.armed
+
+
+def test_config_scope_arms_and_disarms_watchdog():
+    with config.observability(watchdog=5.0):
+        wd = obs_watchdog.current_watchdog()
+        assert wd is not None and wd.armed
+        assert wd.deadline == 5.0
+        assert FLIGHT.enabled
+        reg = obs.default_registry()
+        assert "watchdog" in reg.sources
+        assert reg.read()["watchdog"]["armed"] == 1
+    assert obs_watchdog.current_watchdog() is None
+    assert "watchdog" not in obs.default_registry().sources
+    assert not FLIGHT.enabled
+
+
+# --------------------------------------------------------- error forensics
+
+
+def test_timeout_error_carries_flight_tail_and_retry_event():
+    """ISSUE 11: on the ResilientGroup timeout path the raised error and
+    the RetryEvent both carry the flight-ring tail."""
+    FLIGHT.reset()
+    rec = obs.recorder()
+    prev = rec.enabled
+    rec.reset()
+    rec.enable()
+    try:
+        world = ThreadWorld(2, timeout=30.0)
+
+        def run(view):
+            if view.rank == 1:
+                # rank 1 stays healthy: it deposits for rank 0's gather
+                # so the delayed collective can eventually land (the
+                # worker thread drains it late)
+                view.allgather_object({"r": 1})
+                return None
+            faults = [FaultSpec(0, "delay", seconds=0.6, times=3)]
+            g = ResilientGroup(
+                FaultInjectionGroup(view, faults),
+                timeout=0.1, retries=0, policy="raise",
+            )
+            with pytest.raises(SyncTimeoutError) as ei:
+                g.allgather_object({"r": 0})
+            return ei.value
+
+        err = world.run(run)[0]
+        assert hasattr(err, "flight_tail")
+        assert "allgather_object" in err.flight_tail
+        retry_events = [e for e in rec.log.tail() if e.kind == "retry"]
+        timeouts = [e for e in retry_events if e.reason == "timeout"]
+        assert timeouts
+        assert any("allgather_object" in e.flight for e in timeouts)
+    finally:
+        rec.reset()
+        if not prev:
+            rec.disable()
+        FLIGHT.reset()
+
+
+# ------------------------------------------------------------ cross-rank IO
+
+
+def test_gather_flight_merges_per_rank_rings():
+    FLIGHT.reset()
+    FLIGHT.enable("test")
+    try:
+        world = ThreadWorld(4)
+
+        def run(view):
+            g = ResilientGroup(view, timeout=20.0)
+            g.allgather_object({"r": view.rank})
+            return obs_flight.gather_flight(view)
+
+        results = world.run(run)
+        for merged in results:
+            assert merged["world_size"] == 4
+            assert merged["ranks"] == [0, 1, 2, 3]
+            for rank in range(4):
+                records = merged["per_rank"][rank]
+                assert records, f"rank {rank} contributed records"
+                assert records[0]["op"] == "allgather_object"
+        # the gather itself was suppressed from the rings
+        for ring in FLIGHT.rings().values():
+            assert all(r.op != "kv_allgather" for r in ring.tail())
+    finally:
+        FLIGHT.disable("test")
+        FLIGHT.reset()
+
+
+def test_flight_rides_config_observability_and_eager_sync(tmp_path):
+    """config.observability() alone (the PR 5 knob) now also leaves
+    flight records for the eager sync's collectives — and restores the
+    off state at scope exit."""
+
+    class TwoRankGroup:
+        world_size = 2
+        rank = 0
+        is_member = True
+        ranks = (0, 1)
+
+        def unwrap(self):
+            return self
+
+        def allgather_object(self, obj):
+            import copy
+
+            return [obj, copy.deepcopy(obj)]
+
+        def allgather_array(self, x):
+            x = np.asarray(x)
+            return [x, x.copy()]
+
+    FLIGHT.reset()
+    m = Sum()
+    m.update(np.float32([1.0, 2.0]))
+    with config.observability():
+        group = ResilientGroup(TwoRankGroup(), timeout=20.0)
+        value = sync_and_compute(m, group)
+        assert float(value) == pytest.approx(6.0)
+        per_rank = FLIGHT.per_rank()
+        assert 0 in per_rank
+        ops = [r["op"] for r in per_rank[0]]
+        assert "allgather_object" in ops
+        assert all(r["state"] == "completed" for r in per_rank[0])
+    assert not FLIGHT.enabled
+    FLIGHT.reset()
+
+
+def test_diff_flags_symmetric_hang_via_stall_age():
+    """Review fix: a SYMMETRIC hang (every rank equally deep in a dead
+    collective — same last-completed seq everywhere) must still be
+    reported once the in-flight records age past ``stall_after``; a
+    fresh snapshot of healthy ranks mid-collective must not."""
+    def rings(issued_ago):
+        return {
+            r: [
+                {"seq": 1, "op": "allgather_object", "state": "completed",
+                 "rank": r, "t_issued": time.time() - issued_ago},
+                {"seq": 2, "op": "allgather_object", "state": "issued",
+                 "rank": r, "t_issued": time.time() - issued_ago},
+            ]
+            for r in range(4)
+        }
+
+    dead = diff_flight_rings(rings(issued_ago=60.0), stall_after=5.0)
+    assert not dead.ok
+    assert dead.stalled_rank == 0  # tie -> lowest rank named first
+    assert dead.stalled_seq == 1
+    assert dead.stalled_age >= 5.0
+    assert "all ranks stalled" in dead.format()
+
+    healthy = diff_flight_rings(rings(issued_ago=0.001), stall_after=5.0)
+    assert healthy.ok  # a snapshot mid-collective is not a hang
+
+
+def test_plain_group_issued_record_counts_its_attempt(flight_on):
+    """Review fix: a record born in the issued state (plain groups — no
+    queueing layer) carries attempts=1 and a real t_issued."""
+    out = obs_flight.guarded_collective(
+        "allgather_object", 16, 0, 2, lambda: ["a", "a"]
+    )
+    assert out == ["a", "a"]
+    (record,) = FLIGHT._ring().tail()
+    assert record.attempts == 1
+    assert record.t_issued > 0.0
+
+
+def test_scope_restores_preexisting_watchdog_and_monitor():
+    """Review fix: a scoped watchdog/monitor must hand BACK whatever the
+    process had armed before the scope, not strip it."""
+    from torcheval_tpu.obs import monitor as obs_monitor
+
+    outer_wd = obs_watchdog.arm_watchdog(120.0, sink=None)
+    outer_mon = obs_monitor.arm_monitor()
+    try:
+        with config.observability(watchdog=5.0, slos=[]):
+            inner = obs_watchdog.current_watchdog()
+            assert inner is not None and inner.deadline == 5.0
+            assert inner is not outer_wd
+            assert obs_monitor.current_monitor() is not outer_mon
+        restored = obs_watchdog.current_watchdog()
+        assert restored is outer_wd and restored.armed
+        assert restored.deadline == 120.0
+        assert obs_monitor.current_monitor() is outer_mon
+        assert "watchdog" in obs.default_registry().sources
+        assert "slo" in obs.default_registry().sources
+    finally:
+        obs_watchdog.disarm_watchdog()
+        obs_monitor.disarm_monitor()
+    assert obs_watchdog.current_watchdog() is None
+
+
+def test_failed_server_start_does_not_leak_armed_watchdog():
+    """Review fix: arming happens INSIDE the scope's try — a serve port
+    that fails to bind still tears down the already-armed watchdog and
+    monitor."""
+    import socket
+
+    from torcheval_tpu.obs import monitor as obs_monitor
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        with pytest.raises(OSError):
+            with config.observability(watchdog=5.0, slos=[], serve=port):
+                raise AssertionError("scope must not open")
+        assert obs_watchdog.current_watchdog() is None
+        assert obs_monitor.current_monitor() is None
+        assert obs.current_server() is None
+        assert not FLIGHT.enabled
+    finally:
+        blocker.close()

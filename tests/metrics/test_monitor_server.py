@@ -1,0 +1,392 @@
+"""SLO/anomaly monitor + live health endpoint acceptance pins (ISSUE 11).
+
+- **monitor**: EWMA/z-score drift over observed values and latency-digest
+  deltas, threshold SLOs over counter/latency sources, burn-rate SLOs
+  over error/total counter pairs, typed ``AlertEvent``s in the event
+  envelope, cooldown + active-alert clearing;
+- **server**: ``/metrics`` (grammar-checked Prometheus exposition),
+  ``/healthz`` (200/503 semantics driven by watchdog + alerts),
+  ``/flight``, ``/report`` — all served in-process, and the server
+  thread shuts down cleanly on ``config.observability`` scope exit (the
+  acceptance criterion);
+- **satellites**: ``render_prometheus`` under concurrent writers (ring
+  mutation during scrape), ``event_from_dict`` on schema-1 payloads of
+  the new Stall/Alert kinds.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torcheval_tpu import config, obs
+from torcheval_tpu.obs import hist as obs_hist
+from torcheval_tpu.obs import monitor as obs_monitor
+from torcheval_tpu.obs import server as obs_server
+from torcheval_tpu.obs.counters import CounterRegistry
+from torcheval_tpu.obs.events import (
+    AlertEvent,
+    StallEvent,
+    event_from_dict,
+)
+from torcheval_tpu.obs.monitor import EwmaStat, Monitor, SloSpec
+
+# the exposition-format line grammar (same pin as test_tracing.py)
+_PROM_LINE = re.compile(
+    r"^(?:# (?:TYPE|HELP) [a-zA-Z_][a-zA-Z0-9_]* \w+$"
+    r"|[a-zA-Z_][a-zA-Z0-9_]*"
+    r"(?:\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\")*\})?"
+    r" [0-9.eEinf+-]+(?:$|\s))"
+)
+
+
+@pytest.fixture
+def rec():
+    r = obs.recorder()
+    prev = r.enabled
+    r.reset()
+    r.enable()
+    try:
+        yield r
+    finally:
+        r.reset()
+        if not prev:
+            r.disable()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+# ----------------------------------------------------------------- monitor
+
+
+def test_ewma_stat_warmup_and_zscore():
+    s = EwmaStat(alpha=0.2, warmup=4)
+    rng = np.random.default_rng(0)
+    zs = [s.update(1.0 + float(rng.normal(0, 0.01))) for _ in range(4)]
+    assert zs == [None] * 4  # warm-up reports nothing
+    # steady noisy series: in-band z
+    for _ in range(50):
+        z = s.update(1.0 + float(rng.normal(0, 0.01)))
+        assert z is not None and abs(z) < 6
+    # a huge step is flagged
+    assert abs(s.update(2.0)) > 6
+    # a CONSTANT series that then moves reports +/-inf, not a crash
+    c = EwmaStat(alpha=0.2, warmup=2)
+    for _ in range(5):
+        assert c.update(3.0) in (None, 0.0)
+    assert c.update(4.0) == float("inf")
+
+
+def test_drift_alert_raised_cleared_and_cooldown(rec):
+    m = Monitor(z_threshold=3.0, warmup=4, cooldown=30.0)
+    for _ in range(10):
+        m.observe("ctr", 0.5)
+    z = m.observe("ctr", 50.0)
+    assert z is not None and abs(z) >= 3.0
+    active = m.active_alerts()
+    assert len(active) == 1 and active[0]["alert"] == "drift"
+    events = [e for e in rec.log.tail() if e.kind == "alert"]
+    assert events and events[-1].name == "ctr" and events[-1].z == z
+    # cooldown: an immediate second breach records no second AlertEvent
+    m.observe("ctr", 60.0)
+    assert len([e for e in rec.log.tail() if e.kind == "alert"]) == len(events)
+    # back in band (the EWMA absorbed some of the spike; feed values
+    # near the new mean): the standing alert clears
+    for _ in range(20):
+        m.observe("ctr", m._series["ctr"].mean)
+    assert m.active_alerts() == []
+
+
+def test_threshold_slo_over_counter_and_latency_sources(rec):
+    registry = CounterRegistry()
+    registry.register("svc", lambda: {"errors": 12})
+    obs_hist.reset()
+    try:
+        for _ in range(32):
+            obs_hist.observe("sync", 0.5)  # p99 = 0.5-1s bucket
+        m = Monitor(cooldown=0.0)
+        m.add_slo(SloSpec("svc-errors", "svc.errors", kind="max", bound=10))
+        m.add_slo(
+            SloSpec("sync-p99", "latency/sync:p99", kind="max", bound=0.1)
+        )
+        m.add_slo(SloSpec("ok-floor", "svc.errors", kind="min", bound=1))
+        raised = m.check(registry=registry)
+        names = {a["name"] for a in raised}
+        assert names >= {"svc-errors", "sync-p99"}
+        assert "ok-floor" not in names  # 12 >= 1: in bounds
+        counters = m.counters()
+        assert counters["active_alerts"] >= 2
+        assert counters["breach_svc_errors".replace("svc_errors", "svc-errors")] == 1
+        assert counters["breach_ok-floor"] == 0
+        alerts = [e for e in rec.log.tail() if e.kind == "alert"]
+        assert {e.alert for e in alerts} == {"threshold"}
+    finally:
+        obs_hist.reset()
+
+
+def test_burn_rate_slo(rec):
+    state = {"err": 0, "tot": 0}
+    registry = CounterRegistry()
+    registry.register(
+        "sync", lambda: {"timeouts": state["err"], "attempts": state["tot"]}
+    )
+    m = Monitor(cooldown=0.0)
+    m.add_slo(
+        SloSpec(
+            "sync-budget", "sync.timeouts", kind="burn-rate", bound=2.0,
+            total="sync.attempts", budget=0.01, window=300.0,
+        )
+    )
+    m.check(registry=registry)  # baseline snapshot
+    state.update(err=1, tot=100)  # 1% error rate = 1x budget: no alert
+    assert not m.check(registry=registry)
+    state.update(err=11, tot=200)  # +10 errors over +100: 10x budget
+    raised = m.check(registry=registry)
+    assert raised and raised[0]["alert"] == "burn-rate"
+    assert raised[0]["value"] >= 2.0
+    events = [e for e in rec.log.tail() if e.kind == "alert"]
+    assert events[-1].name == "sync-budget"
+
+
+def test_latency_drift_detected_from_digest_deltas(rec):
+    obs_hist.reset()
+    try:
+        m = Monitor(z_threshold=3.0, warmup=4, cooldown=0.0)
+        # 10 checks of ~1 ms traffic warm the EWMA
+        for _ in range(10):
+            for _ in range(8):
+                obs_hist.observe("update/Acc", 1e-3)
+            m.check(registry=CounterRegistry())
+        # the service quietly becomes 100x slower
+        for _ in range(8):
+            obs_hist.observe("update/Acc", 0.1)
+        raised = m.check(registry=CounterRegistry())
+        assert any(
+            a["name"] == "latency/update/Acc:p99" and a["alert"] == "drift"
+            for a in raised
+        )
+    finally:
+        obs_hist.reset()
+
+
+def test_toolkit_feeds_monitor_with_host_scalar_computes(rec):
+    """sync_and_compute auto-feeds the armed monitor when the computed
+    value is ALREADY a host scalar — and never reads a device array."""
+    from torcheval_tpu.distributed import SingleProcessGroup
+    from torcheval_tpu.metrics import Throughput
+    from torcheval_tpu.metrics.toolkit import sync_and_compute
+
+    monitor = obs_monitor.arm_monitor()
+    try:
+        m = Throughput()
+        m.update(64, 2.0)
+        value = sync_and_compute(m, SingleProcessGroup())
+        assert isinstance(value, float)
+        key = "computed/Throughput"
+        assert key in monitor._series
+        assert monitor._series[key].n == 1
+    finally:
+        obs_monitor.disarm_monitor()
+    assert obs_monitor.current_monitor() is None
+
+
+# ------------------------------------------------------- event round-trips
+
+
+def test_stall_and_alert_events_round_trip_schema_1():
+    """Satellite: ``event_from_dict`` on schema-1 payloads of the new
+    kinds — exact round-trip, and unknown future fields are ignored."""
+    stall = StallEvent(
+        rank=2, op="allgather_object", seq=7, age_seconds=12.5,
+        deadline=5.0, span_path="torcheval.sync > torcheval.collective",
+        detail="#7 allgather_object issued",
+    )
+    alert = AlertEvent(
+        name="sync-p99", alert="threshold", value=0.5, bound=0.1,
+        z=4.2, message="too slow",
+    )
+    for event in (stall, alert):
+        payload = event.as_dict()
+        assert payload["schema"] == 1
+        restored = event_from_dict(json.loads(json.dumps(payload)))
+        assert type(restored) is type(event)
+        assert restored == event
+        # a NEWER writer's extra field must not break this reader
+        payload["future_field"] = {"x": 1}
+        assert event_from_dict(payload) == event
+    assert event_from_dict({"kind": "stall", "schema": 1, "seq": 3}).seq == 3
+    assert event_from_dict({"kind": "alert", "name": "n"}).name == "n"
+
+
+def test_retry_event_flight_field_round_trips():
+    from torcheval_tpu.obs.events import RetryEvent
+
+    e = RetryEvent(reason="timeout", flight="#3 allgather_object issued")
+    restored = event_from_dict(e.as_dict())
+    assert restored.flight == e.flight
+
+
+# ------------------------------------- prometheus under concurrent writers
+
+
+def test_render_prometheus_under_concurrent_writers(rec):
+    """Satellite: a scrape racing live ring mutation and histogram
+    inserts must neither crash nor emit an unparseable line."""
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        rng = np.random.default_rng(1)
+        try:
+            while not stop.is_set():
+                rec.record(obs.UpdateEvent(metric=f"M{i % 7}", seconds=1e-4))
+                obs_hist.observe(f"op{i % 3}", float(rng.uniform(1e-6, 1e-2)))
+                i += 1
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 1.0
+        scrapes = 0
+        while time.monotonic() < deadline:
+            text = obs.render_prometheus()
+            for line in text.splitlines():
+                assert _PROM_LINE.match(line), f"unparseable: {line!r}"
+            scrapes += 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(5.0)
+        obs_hist.reset()
+    assert not errors
+    assert scrapes >= 3
+
+
+# ------------------------------------------------------------------ server
+
+
+def test_endpoints_serve_valid_responses_in_process(rec):
+    """ISSUE 11 acceptance: /healthz and /metrics serve valid responses
+    in-process (exposition grammar-checked), /flight and /report too."""
+    with config.observability(watchdog=30.0, serve=0, slos=[]):
+        srv = obs.current_server()
+        assert srv is not None and srv.port > 0
+
+        status, text = _get(srv.url + "/metrics")
+        assert status == 200
+        assert text.strip(), "exposition must not be empty"
+        for line in text.splitlines():
+            assert _PROM_LINE.match(line), f"unparseable: {line!r}"
+        assert "torcheval_tpu_flight_enabled 1" in text
+        assert "torcheval_tpu_watchdog_armed 1" in text
+
+        status, body = _get(srv.url + "/healthz")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["status"] == "ok" and payload["healthy"]
+        assert payload["watchdog"]["armed"] == 1
+        assert payload["flight"]["enabled"] == 1
+        assert "sync" in payload and "alerts" in payload
+
+        status, body = _get(srv.url + "/flight")
+        assert status == 200
+        json.loads(body)  # valid JSON
+
+        status, text = _get(srv.url + "/report")
+        assert status == 200
+        assert "observability report" in text
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/nope")
+        assert ei.value.code == 404
+        assert srv.requests >= 5
+
+
+def test_healthz_503_when_alerting_and_recovers(rec):
+    with config.observability(serve=0, slos=[]):
+        srv = obs.current_server()
+        monitor = obs_monitor.current_monitor()
+        monitor.cooldown = 0.0
+        monitor.z_threshold = 3.0
+        for _ in range(10):
+            monitor.observe("ctr", 0.5)
+        monitor.observe("ctr", 100.0)  # drift alert now active
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/healthz")
+        assert ei.value.code == 503
+        payload = json.loads(ei.value.read().decode())
+        assert payload["status"] == "alerting"
+        assert payload["alerts"]
+        # recovery: series back in band clears the alert
+        for _ in range(30):
+            monitor.observe("ctr", monitor._series["ctr"].mean)
+        status, body = _get(srv.url + "/healthz")
+        assert status == 200
+
+
+def test_healthz_503_when_watchdog_tripped(rec):
+    from torcheval_tpu.obs.flight import FLIGHT
+
+    FLIGHT.reset()
+    with config.observability(watchdog=0.05, serve=0):
+        srv = obs.current_server()
+        wd = obs.current_watchdog()
+        wd._sink = None  # keep the test log clean
+        r = FLIGHT.start("allgather_object", rank=0, world_size=2)
+        time.sleep(0.3)  # poll ticks past the deadline -> trip
+        assert wd.tripped
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/healthz")
+        assert ei.value.code == 503
+        payload = json.loads(ei.value.read().decode())
+        assert payload["status"] == "stalled"
+        assert payload["watchdog"]["last_trip"]["op"] == "allgather_object"
+        FLIGHT.complete(r, ranks=(0, 1))
+    FLIGHT.reset()
+
+
+def test_server_shuts_down_cleanly_on_scope_exit():
+    """ISSUE 11 acceptance: the server thread stops on scope exit — the
+    port refuses connections and the thread is joined."""
+    with config.observability(serve=0):
+        srv = obs.current_server()
+        url = srv.url
+        thread = srv._thread
+        assert thread.is_alive()
+        _get(url + "/healthz")
+    assert obs.current_server() is None
+    assert not thread.is_alive()
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urllib.request.urlopen(url + "/healthz", timeout=1)
+
+
+def test_server_shuts_down_on_scope_exit_by_exception():
+    with pytest.raises(RuntimeError, match="boom"):
+        with config.observability(serve=0, watchdog=10.0, slos=[]):
+            srv = obs.current_server()
+            assert srv is not None
+            raise RuntimeError("boom")
+    assert obs.current_server() is None
+    assert obs.current_watchdog() is None
+    assert obs_monitor.current_monitor() is None
+
+
+def test_healthz_payload_usable_without_server():
+    payload = obs_server.healthz_payload()
+    assert payload["status"] in ("ok", "degraded", "alerting", "stalled")
+    assert "flight" in payload and "sync" in payload

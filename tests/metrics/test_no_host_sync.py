@@ -127,6 +127,30 @@ def test_steady_state_update_is_transfer_free_recorder_on(name):
             rec.disable()
 
 
+@pytest.mark.parametrize(
+    "name", ["MulticlassAccuracy", "MulticlassConfusionMatrix", "Mean"]
+)
+def test_steady_state_update_is_transfer_free_monitoring_armed(name):
+    """ISSUE 11 acceptance: the FULL live-diagnosis stack — recorder ON,
+    flight recorder ON, stall watchdog armed, SLO monitor armed — adds
+    ZERO host syncs to the steady-state update path. Flight records only
+    exist at the group collective layer (not touched by update), the
+    watchdog polls host-side ring state, and the monitor is pull-based;
+    none of it may ever read a device value."""
+    from torcheval_tpu import config, obs
+
+    make, args = CLASS_CASES[name]
+    metric = make()
+    for _ in range(6):
+        metric.update(*args)
+    with config.observability(watchdog=60.0, slos=[]):
+        assert obs.current_watchdog() is not None
+        assert obs.current_monitor() is not None
+        assert obs.FLIGHT.enabled
+        with jax.transfer_guard("disallow"):
+            metric.update(*args)
+
+
 def test_donated_update_is_transfer_free_and_in_place():
     """ISSUE 6 acceptance pin: with donation enabled, the update adds
     zero host syncs AND reuses the state buffer in place — the per-step

@@ -529,3 +529,58 @@ def test_gather_observability_non_member_is_graceful(rec):
     reports = world.run(body)
     assert reports[2]["per_rank"] == {}  # non-member: no collective issued
     assert reports[0]["ranks"] == [0, 1]
+
+
+def test_gather_observability_and_traces_on_reformed_group(rec):
+    """ISSUE 11 satellite: after a survivor re-formation the
+    observability gathers must still work — gather_observability and
+    gather_traces succeed on the reformed (survivors-only) group, the
+    report covers exactly the survivor set, and post-reform events carry
+    SUBGROUP-relative ranks (global rank 1 is the reformed group's rank
+    0)."""
+    from torcheval_tpu.metrics.toolkit import get_synced_metric
+
+    world = ThreadWorld(4)
+
+    def body(g):
+        if g.rank == 0:
+            # the dying host: present for the two (degraded) syncs that
+            # drive the escalation, then gone — it never observes the
+            # reform and must not join the post-reform gathers
+            for _ in range(2):
+                get_synced_metric(_acc(seed=g.rank), g)
+            return None
+        chaos = FaultInjectionGroup(g, dead_ranks={0})
+        group = ResilientGroup(
+            chaos, timeout=10.0, policy="quorum", reform_after=2
+        )
+        for _ in range(4):
+            synced = get_synced_metric(_acc(seed=g.rank), group)
+        assert synced.sync_provenance.reformed
+        obs_report = obs.gather_observability(group, tail=100)
+        trace_report = obs.gather_traces(group, tail=100)
+        return g.rank, group.rank, obs_report, trace_report
+
+    results = world.run(body)
+    for result in results[1:]:
+        global_rank, relative_rank, obs_report, trace_report = result
+        # global survivors (1, 2, 3) are the reformed group's (0, 1, 2)
+        assert relative_rank == global_rank - 1
+        assert obs_report["world_size"] == 3
+        assert obs_report["ranks"] == [0, 1, 2]
+        assert trace_report["ranks"] == [0, 1, 2]
+        for rel in range(3):
+            events = obs_report["per_rank"][rel]["events"]
+            syncs = [e for e in events if e["kind"] == "sync"]
+            assert syncs, f"relative rank {rel} contributed sync events"
+            # post-reform syncs: subgroup-relative rank stamps and
+            # subgroup-relative participation
+            reformed = [e for e in syncs if e["reformed"]]
+            assert reformed
+            assert all(e["rank"] == rel for e in reformed)
+            assert any(
+                e["ranks"] == [0, 1, 2] and e["world_size"] == 3
+                and not e["degraded"]
+                for e in reformed
+            )
+        assert trace_report["latency"], "merged latency digests present"

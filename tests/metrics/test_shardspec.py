@@ -489,3 +489,144 @@ def test_acceptance_sizes_per_rank_bytes():
     row = memory_report({"au": au})["au"]
     assert row["logical_bytes"] >= 2 * (1 << 20) * 4
     assert row["per_rank_bytes"] <= row["logical_bytes"] // 4 + 64 * 1024
+
+
+# ---------------------------------------- shape bucketing x sharded plans
+
+
+RAGGED_CM = [
+    (RNG.integers(0, C, n), RNG.integers(0, C, n))
+    for n in (64, 37, 12, 5, 21, 33, 7, 50)
+]
+
+
+def _ragged_cm_oracle():
+    ranks = [MulticlassConfusionMatrix(C) for _ in range(WORLD)]
+    for r in range(WORLD):
+        for i in range(r, len(RAGGED_CM), WORLD):
+            ranks[r].update(*RAGGED_CM[i])
+    target = copy.deepcopy(ranks[0])
+    target.merge_state(ranks[1:])
+    return np.asarray(target.compute())
+
+
+def test_bucketed_sharded_update_bit_identical_to_oracle():
+    """ISSUE 11 satellite (the PR 9 'remaining' item): routed sharded
+    plans now carry masked-kernel twins, so shape bucketing composes
+    with sharding — ragged batches under config.shape_bucketing() merge
+    BIT-identically to the unbucketed replicated oracle (padded rows
+    contribute zero to shard, outbox, and cursor)."""
+    from torcheval_tpu import config
+
+    want = _ragged_cm_oracle()
+    with config.shape_bucketing():
+        shards = [
+            MulticlassConfusionMatrix(C, shard=ShardContext(r, WORLD))
+            for r in range(WORLD)
+        ]
+        for r in range(WORLD):
+            for i in range(r, len(RAGGED_CM), WORLD):
+                shards[r].update(*RAGGED_CM[i])
+        target = copy.deepcopy(shards[0])
+        target.merge_state(shards[1:])
+        got = np.asarray(target.compute())
+    assert np.array_equal(got, want)
+    # the device cursor and its host mirror agree after ragged appends
+    # (the masked kernel advances by the VALID count, not the padded one)
+    for r in range(WORLD):
+        assert int(np.asarray(shards[r].confusion_matrix__obn)) == int(
+            shards[r].confusion_matrix__obh
+        )
+
+
+def test_bucketed_sharded_hist_auroc_bit_identical_to_oracle():
+    from torcheval_tpu import config
+
+    ragged = [
+        (
+            RNG.uniform(size=n).astype(np.float32),
+            RNG.integers(0, 2, n).astype(np.int32),
+        )
+        for n in (64, 30, 9, 17, 42)
+    ]
+    refs = [HistogramBinnedAUROC(threshold=32) for _ in range(2)]
+    for r in range(2):
+        for i in range(r, len(ragged), 2):
+            refs[r].update(*ragged[i])
+    rt = copy.deepcopy(refs[0])
+    rt.merge_state(refs[1:])
+    want = np.asarray(rt.compute()[0])
+
+    with config.shape_bucketing():
+        shards = [
+            HistogramBinnedAUROC(threshold=32, shard=ShardContext(r, 2))
+            for r in range(2)
+        ]
+        for r in range(2):
+            for i in range(r, len(ragged), 2):
+                shards[r].update(*ragged[i])
+        tt = copy.deepcopy(shards[0])
+        tt.merge_state(shards[1:])
+        got = np.asarray(tt.compute()[0])
+    assert np.array_equal(got, want)
+
+
+def test_bucketed_sharded_update_is_retrace_proof():
+    """The point of the twins: fresh ragged sizes inside warmed buckets
+    compile ZERO new programs on a sharded metric (each size previously
+    paid a full retrace), while the unbucketed path still compiles one
+    per distinct size."""
+    from torcheval_tpu import config
+    from torcheval_tpu.utils import CompileCounter
+
+    warm_sizes = (8, 16, 32, 64)
+    fresh_sizes = (6, 10, 18, 34)
+
+    def feed(metric, n):
+        metric.update(RNG.integers(0, C, n), RNG.integers(0, C, n))
+
+    with config.shape_bucketing():
+        m = MulticlassConfusionMatrix(C, shard=ShardContext(1, WORLD))
+        # pre-grow the outbox past everything this test appends, so
+        # capacity growth cannot add program signatures mid-measurement
+        feed(m, 256)
+        for n in warm_sizes:
+            feed(m, n)
+        with CompileCounter() as bucketed:
+            for n in fresh_sizes:
+                feed(m, n)
+    assert bucketed.programs == 0, (
+        f"fresh ragged sizes retraced {bucketed.programs} programs "
+        "under bucketing"
+    )
+
+    m2 = MulticlassConfusionMatrix(C, shard=ShardContext(1, WORLD))
+    feed(m2, 256)
+    for n in warm_sizes:
+        feed(m2, n)
+    with CompileCounter() as unbucketed:
+        for n in fresh_sizes:
+            feed(m2, n)
+    assert unbucketed.programs == len(fresh_sizes)
+
+
+def test_bucketed_outbox_capacity_admits_padded_write():
+    """ensure_outbox_capacity reserves the BUCKETED width under shape
+    bucketing: without it, dynamic_update_slice's start clamp would
+    shift a full-capacity padded write backwards over live entries."""
+    from torcheval_tpu import config
+    from torcheval_tpu.metrics import shardspec
+
+    with config.shape_bucketing():
+        m = MulticlassConfusionMatrix(C, shard=ShardContext(1, WORLD))
+        # fill the outbox to exactly its capacity boundary, then append
+        # a ragged batch whose PADDED width would not fit the old cap
+        feed_n = 64 - 3
+        m.update(RNG.integers(0, C, feed_n), RNG.integers(0, C, feed_n))
+        cap_before = getattr(m, "confusion_matrix__obi").shape[0]
+        m.update(RNG.integers(0, C, 5), RNG.integers(0, C, 5))
+        cap_after = getattr(m, "confusion_matrix__obi").shape[0]
+        # 61 + bucket(5)=8 = 69 > 64: capacity must have grown
+        assert cap_before == 64 and cap_after >= 69
+        assert int(m.confusion_matrix__obh) == feed_n + 5
+        assert int(np.asarray(m.confusion_matrix__obn)) == feed_n + 5

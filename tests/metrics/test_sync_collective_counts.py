@@ -204,6 +204,48 @@ def test_recorder_on_adds_zero_collectives(n_metrics):
             rec.disable()
 
 
+@pytest.mark.parametrize("n_metrics", [1, 12])
+def test_flight_watchdog_monitor_on_adds_zero_collectives(n_metrics):
+    """ISSUE 11 acceptance: the full live-diagnosis stack — flight
+    recorder, armed stall watchdog, armed SLO monitor, recorder ON —
+    must not change the collective budget. Flight records are host-side
+    per-thread ring appends at the group wrapper layer; the watchdog is
+    a poll thread that only READS them; the monitor is pull-based.
+    Exactly the same gather counts as the bare run, and the collectives
+    actually landed in the flight ring (the pin is not vacuous)."""
+    from torcheval_tpu import config, obs
+    from torcheval_tpu.obs.flight import FLIGHT
+    from torcheval_tpu.resilience import ResilientGroup
+
+    coll = _collection(n_metrics)
+    _feed(coll)
+    bare = CountingGroup()
+    sync_and_compute_collection(
+        {k: copy.deepcopy(m) for k, m in coll.items()}, bare
+    )
+
+    FLIGHT.reset()
+    with config.observability(watchdog=60.0, slos=[]):
+        counting = CountingGroup()
+        sync_and_compute_collection(
+            coll, ResilientGroup(counting, timeout=30.0, policy="quorum")
+        )
+        assert counting.object_gathers == bare.object_gathers == 1
+        assert counting.array_gathers == bare.array_gathers <= 1
+        # every gather left exactly one completed flight record
+        records = FLIGHT._ring().tail()
+        assert len(records) == (
+            counting.object_gathers + counting.array_gathers
+        )
+        assert all(r.state == "completed" for r in records)
+        assert [r.seq for r in records] == list(
+            range(1, len(records) + 1)
+        )
+        assert obs.current_watchdog() is not None
+        assert obs.current_monitor() is not None
+    FLIGHT.reset()
+
+
 def test_two_rank_sync_matches_per_metric_sync():
     """The batched path and K independent single-metric syncs agree."""
     from torcheval_tpu.metrics.toolkit import sync_and_compute

@@ -702,3 +702,58 @@ def test_r13_recaptured_kernels_still_meet_r11_acceptance():
     for op in ("segment_sum", "segment_count", "histogram", "topk"):
         assert kernels[op]["meets_2x"] is True, (op, kernels[op])
     assert KR13["kernels"]["donation"]["zero_realloc"] is True
+
+
+MON = _load("bench_r14_monitoring_cpu_20260804.json")
+
+
+def test_monitoring_table_matches_capture():
+    """ISSUE 11: the live-diagnosis overhead table traces to its
+    committed capture — and the capture itself must satisfy the
+    acceptance (flight + watchdog + monitor paired increment over the
+    recorder baseline < 2% of the step)."""
+    text = _read("docs/benchmarks.md")
+    mon = MON["monitoring"]
+    m = re.search(
+        r"\| all off \(shipping default\) \| ([\d.]+) µs \| — \|\n"
+        r"\| event recorder ON \(PR 5/8 baseline\) \| ([\d.]+) µs \| "
+        r"([\d.]+) µs vs off",
+        text,
+    )
+    assert m, "monitoring off/recorder rows not found"
+    assert float(m.group(1)) == pytest.approx(mon["off_step_us"], abs=0.05)
+    assert float(m.group(2)) == pytest.approx(mon["obs_step_us"], abs=0.05)
+    assert float(m.group(3)) == pytest.approx(mon["obs_vs_off_us"], abs=0.05)
+    m = re.search(
+        r"\| \+ flight \+ watchdog \+ SLO monitor armed \| ([\d.]+) µs \| "
+        r"\*\*([\d.]+) µs = ([\d.]+)%\*\* vs recorder-on",
+        text,
+    )
+    assert m, "monitoring armed row not found"
+    assert float(m.group(1)) == pytest.approx(
+        mon["monitoring_step_us"], abs=0.05
+    )
+    assert float(m.group(2)) == pytest.approx(
+        mon["monitoring_increment_us"], abs=0.05
+    )
+    assert float(m.group(3)) == pytest.approx(
+        mon["monitoring_increment_pct"], abs=0.005
+    )
+    assert float(m.group(3)) == pytest.approx(mon["value"], abs=0.005)
+    # the prose figures trace too
+    m = re.search(r"full-stack-vs-off figure \(([\d.]+)% on this", text)
+    assert m and float(m.group(1)) == pytest.approx(
+        mon["monitoring_vs_off_pct"], abs=0.005
+    )
+    m = re.search(r"latency digests — costs ([\d.]+) µs", text)
+    assert m and float(m.group(1)) == pytest.approx(
+        mon["healthz_scrape_us"], abs=0.05
+    )
+    m = re.search(r"completed ([\d,]+) records over the run", text)
+    assert m and int(m.group(1).replace(",", "")) == mon[
+        "flight_completed_total"
+    ]
+    # the acceptance quantities hold in the capture itself
+    assert mon["monitoring_increment_within_2pct"] is True
+    assert mon["monitoring_increment_pct"] <= 2.0
+    assert mon["flight_failed_total"] == 0

@@ -71,7 +71,64 @@ def _program_smoke() -> Report:
             combined.extend(report)
         combined.extend(verify_metric_compute(metric))
         combined.extend(verify_metric_merge(metric))
+    combined.extend(_flight_lockstep_smoke())
     return combined
+
+
+def _flight_lockstep_smoke() -> Report:
+    """ISSUE 11: the live-diagnosis layer must be telemetry, not
+    behavior — with the flight recorder (and monitor) armed, the eager
+    sync's ordered ProcessGroup op plan is IDENTICAL to the diagnosis-off
+    plan on every rank (flight records are ring appends around the
+    collectives, never extra collectives). Dry-run statically via
+    ``eager_sync_plan``; any added/removed/reordered op is a would-break
+    finding."""
+    from torcheval_tpu import metrics as M
+    from torcheval_tpu.analysis.lockstep import (
+        check_eager_lockstep,
+        eager_sync_plan,
+    )
+    from torcheval_tpu.analysis.report import Finding
+    from torcheval_tpu.obs.flight import FLIGHT
+    from torcheval_tpu.obs.monitor import arm_monitor, disarm_monitor
+
+    import jax.numpy as jnp
+
+    coll = {"acc": M.MulticlassAccuracy(), "mean": M.Mean()}
+    coll["acc"].update(jnp.ones((4, 3)), jnp.zeros((4,), jnp.int32))
+    coll["mean"].update(jnp.ones((4,)))
+    baseline = {
+        r: eager_sync_plan(coll, world_size=2, rank=r) for r in range(2)
+    }
+    FLIGHT.enable("analysis")
+    arm_monitor()
+    try:
+        armed = {
+            r: eager_sync_plan(coll, world_size=2, rank=r)
+            for r in range(2)
+        }
+    finally:
+        disarm_monitor()
+        FLIGHT.disable("analysis")
+    report = check_eager_lockstep(
+        {0: baseline[0], 1: armed[1]}, name="<flight+monitor sync plan>"
+    )
+    report.checked += 1
+    if baseline != armed:
+        report.findings.append(
+            Finding(
+                tool="lockstep",
+                rule="eager-plan-divergence",
+                path="<flight+monitor sync plan>",
+                message=(
+                    "arming the flight recorder / SLO monitor changed "
+                    f"the eager sync plan: {baseline} -> {armed} — the "
+                    "diagnosis layer must never add, drop, or reorder "
+                    "collectives"
+                ),
+            )
+        )
+    return report
 
 
 def main(argv=None) -> int:

@@ -29,6 +29,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torcheval_tpu.obs import flight as _flight
+from torcheval_tpu.obs.flight import FLIGHT as _FLIGHT
+
 # The length exchange preceding a padded object gather travels as an EXPLICIT
 # fixed-width wire dtype: int64 would be silently downcast to int32 by XLA
 # under the default x64-disabled jax config, so payload sizes >= 2**31 bytes
@@ -246,9 +249,19 @@ class MultiHostGroup(ProcessGroup):
         return self._rank
 
     def allgather_array(self, x) -> List[np.ndarray]:
+        arr = np.asarray(x)
+        if _FLIGHT.enabled:
+            # flight-recorded (ISSUE 11): the per-thread ring sees this
+            # collective enter and leave — one attribute read when off
+            return _flight.guarded_collective(
+                "allgather_array", arr.nbytes, self._rank, self._world,
+                lambda: self._allgather_array_impl(arr),
+            )
+        return self._allgather_array_impl(arr)
+
+    def _allgather_array_impl(self, arr: np.ndarray) -> List[np.ndarray]:
         from jax.experimental import multihost_utils
 
-        arr = np.asarray(x)
         # normalize the gather layout the same way allgather_object does:
         # some jax versions return (world*n,) concatenated instead of
         # (world, n) stacked (and world=1 gathers come back unstacked)
@@ -258,9 +271,17 @@ class MultiHostGroup(ProcessGroup):
         return [np.asarray(s) for s in stacked]
 
     def allgather_object(self, obj) -> List[Any]:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        if _FLIGHT.enabled:
+            return _flight.guarded_collective(
+                "allgather_object", payload.nbytes, self._rank, self._world,
+                lambda: self._allgather_object_impl(payload),
+            )
+        return self._allgather_object_impl(payload)
+
+    def _allgather_object_impl(self, payload: np.ndarray) -> List[Any]:
         from jax.experimental import multihost_utils
 
-        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
         # explicit int32-pair wire encoding: see encode_length (an int64
         # here would be silently downcast to int32 under x64-disabled jax)
         lengths = np.asarray(
@@ -380,6 +401,15 @@ class MultiHostSubgroup(ProcessGroup):
                 f"{self._ranks}; non-members must not issue its collectives "
                 "(the toolkit returns their local metrics untouched)"
             )
+        if _FLIGHT.enabled:
+            return _flight.guarded_collective(
+                "kv_allgather", len(payload),
+                self._ranks[self._member_index], len(self._ranks),
+                lambda: self._kv_allgather_impl(payload),
+            )
+        return self._kv_allgather_impl(payload)
+
+    def _kv_allgather_impl(self, payload: bytes) -> List[bytes]:
         client = self._client()
         seq = self._seq
         self._seq += 1

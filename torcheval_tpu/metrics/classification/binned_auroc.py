@@ -145,6 +145,9 @@ class HistogramBinnedAUROC(Metric[Tuple[jax.Array, jax.Array]]):
             def finalize():
                 setattr(self, names.obh, getattr(self, names.obh) + n)
 
+            # the masked routed twin keeps sharded instances
+            # retrace-proof under shape bucketing (threshold carries no
+            # ragged axis — only the sample vectors pad)
             return UpdatePlan(
                 kernel,
                 ("hist", names.obi, names.obn),
@@ -152,6 +155,10 @@ class HistogramBinnedAUROC(Metric[Tuple[jax.Array, jax.Array]]):
                 (),
                 transform=True,
                 finalize=finalize,
+                masked_kernel=shardspec.route_scatter_kernel_masked(
+                    _hist_binned_flat_index, start, stop
+                ),
+                batch_axes=(("batch",), ("batch",), None),
             )
         return UpdatePlan(
             _hist_binned_update,

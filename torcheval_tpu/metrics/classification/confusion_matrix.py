@@ -93,19 +93,22 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
     def _sharded_update_plan(self, input, target):
         """One fused dispatch: flat-index routing -> owned-cell scatter
         into the local shard + foreign-index outbox append (see
-        ``shardspec.route_scatter_kernel``)."""
+        ``shardspec.route_scatter_kernel``). Carries the masked routed
+        twin, so shape bucketing keeps sharded instances retrace-proof
+        too (one program per bucket instead of one per ragged size)."""
         name = "confusion_matrix"
         names = self._routed_states[name]
         n = int(target.shape[0])
         shardspec.ensure_outbox_capacity(self, name, n)
         info = self._sharded_states[name]
         start, stop = self._shard_ctx.shard_range(info.logical_shape[0])
-        kernel = shardspec.route_scatter_kernel(
+        flat_args = (
             _confusion_matrix_flat_index,
             start * self.num_classes,
             stop * self.num_classes,
             (self.num_classes,),
         )
+        kernel = shardspec.route_scatter_kernel(*flat_args)
 
         def finalize():
             setattr(self, names.obh, getattr(self, names.obh) + n)
@@ -117,6 +120,8 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
             (),
             transform=True,
             finalize=finalize,
+            masked_kernel=shardspec.route_scatter_kernel_masked(*flat_args),
+            batch_axes=(("batch",), ("batch",)),
         )
 
     def update(

@@ -58,6 +58,7 @@ __all__ = [
     "ShardInfo",
     "enable_routing",
     "route_scatter_kernel",
+    "route_scatter_kernel_masked",
 ]
 
 _OUTBOX_MIN_CAPACITY = 64
@@ -281,10 +282,23 @@ def _outbox_capacity(n: int) -> int:
 def ensure_outbox_capacity(metric, state: str, n_new: int) -> None:
     """Grow the outbox buffer (power-of-2, ``-1`` fill) to admit ``n_new``
     more entries — the host-side half of the append, mirroring
-    ``_buffer.BufferedExamplesMetric._ensure_capacity``."""
+    ``_buffer.BufferedExamplesMetric._ensure_capacity``.
+
+    Under shape bucketing the masked routed kernel WRITES the padded
+    batch length at the cursor (the tail beyond the valid count is
+    ``-1`` scratch, overwritten by the next append) — capacity must
+    admit the full bucketed write or ``dynamic_update_slice``'s start
+    clamp would silently shift it backwards over live entries."""
+    from torcheval_tpu import config
+
     names = metric._routed_states[state]
     buf = getattr(metric, names.obi)
-    needed = getattr(metric, names.obh) + int(n_new)
+    width = int(n_new)
+    if config.shape_bucketing_enabled():
+        from torcheval_tpu.metrics._bucket import bucket_length
+
+        width = bucket_length(width)
+    needed = getattr(metric, names.obh) + width
     cap = buf.shape[0]
     if needed <= cap:
         return
@@ -342,6 +356,54 @@ def route_scatter_kernel(index_fn, start: int, stop: int, cfg: Tuple = ()):
         foreign = jnp.where(owned, -1, idx).astype(jnp.int32)
         new_obi = lax.dynamic_update_slice(obi, foreign, (obn,))
         return new_shard, new_obi, obn + jnp.int32(idx.shape[0])
+
+    _ROUTE_KERNEL_CACHE[key] = transform
+    return transform
+
+
+def route_scatter_kernel_masked(index_fn, start: int, stop: int, cfg: Tuple = ()):
+    """Mask-aware twin of :func:`route_scatter_kernel` for shape
+    bucketing (ISSUE 11 satellite; closes the PR 9 "remaining" item:
+    sharded metrics retraced once per ragged batch size).
+
+    Signature after ``_bucket.apply_bucketing`` rewrites the plan:
+    ``transform(states, *padded_dynamic, valid)`` where ``valid`` is the
+    int32 valid-extent vector (one entry — the batch label). Padded rows
+    (position >= ``valid[0]``) contribute exactly zero everywhere:
+
+    - their flat index is forced to ``-1`` (the drop sentinel), so they
+      are neither owned (no shard scatter) nor foreign (``-1`` outbox
+      slots);
+    - the outbox WRITE is the padded length (static shape — that is the
+      point), but the cursor advances by ``valid[0]`` only, so the
+      padded tail is scratch the next append overwrites and the device
+      cursor stays equal to the host mirror ``obh`` (the plan's
+      ``finalize`` adds the true batch length). Capacity for the padded
+      write is reserved by ``ensure_outbox_capacity``'s bucketed width.
+    """
+    key = (index_fn, int(start), int(stop), cfg, "masked")
+    fn = _ROUTE_KERNEL_CACHE.get(key)
+    if fn is not None:
+        return fn
+    from torcheval_tpu.ops import segment
+
+    n_local = int(stop) - int(start)
+
+    def transform(states, *dynamic_and_valid):
+        dynamic, valid = dynamic_and_valid[:-1], dynamic_and_valid[-1]
+        shard, obi, obn = states
+        idx = jnp.asarray(index_fn(*dynamic, *cfg))
+        row_ok = jnp.arange(idx.shape[0], dtype=jnp.int32) < valid[0]
+        idx = jnp.where(row_ok, idx, -1)
+        owned = (idx >= start) & (idx < stop)
+        local = jnp.where(owned, idx - start, n_local).astype(jnp.int32)
+        delta = segment.segment_count(local, n_local + 1)[:n_local]
+        new_shard = (
+            shard.reshape(-1) + delta.astype(shard.dtype)
+        ).reshape(shard.shape)
+        foreign = jnp.where(owned, -1, idx).astype(jnp.int32)
+        new_obi = lax.dynamic_update_slice(obi, foreign, (obn,))
+        return new_shard, new_obi, obn + valid[0]
 
     _ROUTE_KERNEL_CACHE[key] = transform
     return transform

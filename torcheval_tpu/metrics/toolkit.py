@@ -166,7 +166,9 @@ def sync_and_compute(
     ``get_synced_metric(...).sync_provenance`` and the resilient group's
     ``health`` — see docs/fault-tolerance.md)."""
     synced = get_synced_metric(metric, process_group, on_failure=on_failure)
-    return synced.compute()
+    value = synced.compute()
+    _maybe_observe_computed(f"computed/{type(synced).__name__}", value)
+    return value
 
 
 def sync_and_compute_collection(
@@ -180,7 +182,38 @@ def sync_and_compute_collection(
     synced = get_synced_metric_collection(
         metrics, process_group, on_failure=on_failure
     )
-    return {name: m.compute() for name, m in synced.items()}
+    values = {name: m.compute() for name, m in synced.items()}
+    for name, value in values.items():
+        _maybe_observe_computed(f"computed/{name}", value)
+    return values
+
+
+def _maybe_observe_computed(key: str, value: Any) -> None:
+    """Feed a computed value into the armed SLO/anomaly monitor
+    (``obs.monitor``) — ONLY when it is already a host scalar. A
+    ``jax.Array`` result is deliberately NOT read (that would force a
+    device sync on a path pinned transfer-free); callers who want drift
+    detection on device-valued metrics call ``Monitor.observe`` with the
+    value they read at their own latency budget.
+
+    Series-key scheme (stable, by design): collection syncs key by the
+    caller's dict name (``computed/<name>`` — two ``Mean()``s under
+    different names must not merge into one series), single-metric
+    ``sync_and_compute`` by the class name (``computed/<ClassName>`` —
+    the only stable identity a bare metric has). Switching a metric
+    between the two APIs therefore moves its series; keep one API per
+    monitored metric, or feed ``Monitor.observe`` under your own key."""
+    from torcheval_tpu.obs.monitor import current_monitor
+
+    monitor = current_monitor()
+    if monitor is None:
+        return
+    import numpy as np
+
+    if isinstance(value, (bool, np.bool_)):
+        value = int(value)
+    if isinstance(value, (int, float, np.integer, np.floating)):
+        monitor.observe(key, float(value))
 
 
 def get_synced_metric(

@@ -46,6 +46,7 @@ line writer)::
 from torcheval_tpu.obs.counters import CounterRegistry, default_registry
 from torcheval_tpu.obs.events import (
     SCHEMA_VERSION,
+    AlertEvent,
     AnalysisEvent,
     CompileEvent,
     ComputeEvent,
@@ -55,9 +56,40 @@ from torcheval_tpu.obs.events import (
     RetryEvent,
     SnapshotEvent,
     SpanEvent,
+    StallEvent,
     SyncEvent,
     UpdateEvent,
     event_from_dict,
+)
+from torcheval_tpu.obs.flight import (
+    FLIGHT,
+    FlightDiff,
+    FlightRecord,
+    FlightRecorder,
+    diff_flight_rings,
+    format_flight,
+    gather_flight,
+)
+from torcheval_tpu.obs.monitor import (
+    EwmaStat,
+    Monitor,
+    SloSpec,
+    arm_monitor,
+    current_monitor,
+    disarm_monitor,
+)
+from torcheval_tpu.obs.server import (
+    ObsServer,
+    current_server,
+    healthz_payload,
+    start_server,
+    stop_server,
+)
+from torcheval_tpu.obs.watchdog import (
+    StallWatchdog,
+    arm_watchdog,
+    current_watchdog,
+    disarm_watchdog,
 )
 from torcheval_tpu.obs.export import (
     JsonlWriter,
@@ -92,32 +124,54 @@ from torcheval_tpu.obs.recorder import (
 from torcheval_tpu.obs.trace import trace_path
 
 __all__ = [
+    "FLIGHT",
     "SCHEMA_VERSION",
+    "AlertEvent",
     "AnalysisEvent",
     "CompileEvent",
     "ComputeEvent",
     "CounterRegistry",
     "Event",
     "EventLog",
+    "EwmaStat",
+    "FlightDiff",
+    "FlightRecord",
+    "FlightRecorder",
     "JsonlWriter",
     "LatencyHistogram",
     "MemoryEvent",
+    "Monitor",
+    "ObsServer",
     "Recorder",
     "RestoreEvent",
     "RetryEvent",
+    "SloSpec",
     "SnapshotEvent",
     "SpanEvent",
+    "StallEvent",
+    "StallWatchdog",
     "SyncEvent",
     "UpdateEvent",
+    "arm_monitor",
+    "arm_watchdog",
+    "current_monitor",
+    "current_server",
+    "current_watchdog",
     "default_registry",
+    "diff_flight_rings",
     "disable",
+    "disarm_monitor",
+    "disarm_watchdog",
     "enable",
     "enabled",
     "event_from_dict",
     "export_chrome_trace",
+    "format_flight",
     "format_report",
+    "gather_flight",
     "gather_observability",
     "gather_traces",
+    "healthz_payload",
     "latency_snapshot",
     "logical_state_bytes",
     "memory_report",
@@ -128,7 +182,9 @@ __all__ = [
     "render_prometheus",
     "span",
     "per_rank_state_bytes",
+    "start_server",
     "state_bytes",
+    "stop_server",
     "trace_path",
     "track_metrics",
 ]

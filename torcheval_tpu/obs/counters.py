@@ -78,6 +78,12 @@ def _sync_source() -> Dict[str, Any]:
     return default_sync_health().as_dict()
 
 
+def _flight_source() -> Dict[str, Any]:
+    from torcheval_tpu.obs.flight import FLIGHT
+
+    return FLIGHT.counters()
+
+
 def _events_source() -> Dict[str, Any]:
     from torcheval_tpu.obs.recorder import RECORDER
 
@@ -176,5 +182,8 @@ def default_registry() -> CounterRegistry:
             registry.register("sync", _sync_source)
             registry.register("events", _events_source)
             registry.register("snapshots", _snapshot_source)
+            # flight-recorder ring stats (ISSUE 11); the watchdog and
+            # SLO monitor register "watchdog"/"slo" sources when armed
+            registry.register("flight", _flight_source)
             _DEFAULT = registry
         return _DEFAULT

@@ -37,6 +37,7 @@ from typing import Any, ClassVar, Dict, Optional, Tuple, Type
 
 __all__ = [
     "SCHEMA_VERSION",
+    "AlertEvent",
     "AnalysisEvent",
     "CompileEvent",
     "ComputeEvent",
@@ -46,6 +47,7 @@ __all__ = [
     "RetryEvent",
     "SnapshotEvent",
     "SpanEvent",
+    "StallEvent",
     "SyncEvent",
     "UpdateEvent",
     "event_from_dict",
@@ -142,7 +144,11 @@ class RetryEvent(Event):
     """One resilience-layer lifecycle event (``ResilientGroup``): a retry
     cause (``timeout`` / ``transient`` / ``partial-gather``), a
     degradation outcome (``degraded-local`` / ``degraded-quorum`` /
-    ``failed``), or a survivor re-formation (``reform``)."""
+    ``failed``), or a survivor re-formation (``reform``).
+
+    ``flight`` carries the formatted flight-ring tail (``obs/flight.py``)
+    on timeout/failure events while the flight recorder is on — *which*
+    collective in the sequence stalled, not just that one did."""
 
     kind: ClassVar[str] = "retry"
 
@@ -150,6 +156,7 @@ class RetryEvent(Event):
     attempt: int = 0
     policy: str = "raise"
     detail: str = ""
+    flight: str = ""
 
 
 @dataclass
@@ -246,11 +253,55 @@ class AnalysisEvent(Event):
     message: str = ""
 
 
+@dataclass
+class StallEvent(Event):
+    """One stall-watchdog trip (``obs/watchdog.py``): a collective sat in
+    the flight ring past the deadline with no flight progress anywhere in
+    the process. Emitted (and dumped to stderr/JSONL) *before* the
+    process dies or an operator kills it — the hang forensics record.
+
+    ``op``/``seq`` identify the stuck collective on this thread's flight
+    ring (``seq`` is the per-thread collective ordinal — comparable
+    across ranks by lockstep); ``span_path`` is the innermost open span
+    path of the stalled thread at trip time."""
+
+    kind: ClassVar[str] = "stall"
+
+    op: str = ""
+    seq: int = 0
+    age_seconds: float = 0.0
+    deadline: float = 0.0
+    span_path: str = ""
+    detail: str = ""
+
+
+@dataclass
+class AlertEvent(Event):
+    """One SLO/anomaly monitor alert (``obs/monitor.py``): a streaming
+    drift detection (``alert="drift"``, EWMA z-score over observed metric
+    values or latency-digest quantiles), a threshold breach
+    (``alert="threshold"``), or an error-budget burn
+    (``alert="burn-rate"``). ``name`` is the SLO/series name; ``value``
+    the observed quantity; ``bound`` the configured limit; ``z`` the
+    z-score for drift alerts."""
+
+    kind: ClassVar[str] = "alert"
+
+    name: str = ""
+    alert: str = ""
+    value: float = 0.0
+    bound: float = 0.0
+    z: float = 0.0
+    message: str = ""
+
+
 _EVENT_TYPES: Dict[str, Type[Event]] = {
     cls.kind: cls
     for cls in (
+        AlertEvent,
         AnalysisEvent,
         MemoryEvent,
+        StallEvent,
         UpdateEvent,
         ComputeEvent,
         SyncEvent,

@@ -181,7 +181,7 @@ _PROM_NAME = re.compile(r"[^a-zA-Z0-9_]")
 _PROM_COUNTER_HINTS = (
     "attempts", "retries", "timeouts", "errors", "gathers", "payloads",
     "syncs", "reforms", "programs", "compiles", "hits", "written", "total",
-    "restores", "kind_", "recorded",
+    "restores", "kind_", "recorded", "trips",
 )
 
 

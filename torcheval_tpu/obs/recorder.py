@@ -182,13 +182,25 @@ class Recorder:
                 self._writer.close()
             self._writer = JsonlWriter(jsonl)
         self._install_compile_sink()
+        # the collective flight recorder (obs/flight.py) rides the same
+        # switch: recording ON means the sync path's collectives leave
+        # per-thread flight rings too. Source-keyed, so an armed stall
+        # watchdog keeps flight data when the event recorder turns off.
+        from torcheval_tpu.obs.flight import FLIGHT
+
+        FLIGHT.enable("recorder")
         self.enabled = True
         return self
 
     def disable(self) -> None:
         """Turn recording off; drain and close any attached JSONL writer
-        (writer errors ferried by the writer surface here)."""
+        (writer errors ferried by the writer surface here). Releases the
+        recorder's flight-recorder enable source (an armed watchdog's
+        source, if any, keeps flight recording on)."""
         self.enabled = False
+        from torcheval_tpu.obs.flight import FLIGHT
+
+        FLIGHT.disable("recorder")
         writer, self._writer = self._writer, None
         if writer is not None:
             writer.close()

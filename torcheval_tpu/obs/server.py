@@ -1,0 +1,230 @@
+# tev: scope=host — the health endpoint is a host-side daemon HTTP
+# server by design: nothing in this module is jit-reachable.
+"""Live health endpoint: a pull-based scrape surface for serving-scale eval.
+
+Everything else in ``obs/`` ends up in files or return values; an online
+multi-tenant eval service (ROADMAP item 3) is scraped, probed, and paged
+— it needs the state served live. :class:`ObsServer` is a stdlib
+``http.server`` running on a background daemon thread (no new
+dependencies, one import), serving:
+
+- ``GET /metrics`` — ``render_prometheus()`` text exposition (counters,
+  the flight/watchdog/slo sources when armed, latency histograms) —
+  point a Prometheus scraper at it;
+- ``GET /healthz`` — JSON liveness summary with an HTTP status a load
+  balancer understands: **200** healthy, **503** when the stall watchdog
+  is tripped or any SLO alert is active (sync-degradation/quorum state
+  is reported but does not fail the probe — a degraded quorum still
+  serves); each probe also runs ``Monitor.check()`` so SLOs are
+  evaluated at scrape cadence with no loop code;
+- ``GET /flight`` — the collective flight rings as JSON (the hang
+  forensics a ``kubectl exec curl`` can fetch from a wedged pod);
+- ``GET /report`` — ``format_report()`` plain text for humans.
+
+Lifecycle: :func:`start_server` binds (port 0 = ephemeral, the test
+default), serves until :func:`stop_server` — or scope exit when started
+via ``config.observability(serve=<port>)``, which is the recommended
+form (the server never outlives the eval it reports on). Binding is on
+the caller's thread so a bad port fails loudly at start, not inside the
+daemon.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "ObsServer",
+    "current_server",
+    "healthz_payload",
+    "start_server",
+    "stop_server",
+]
+
+
+def healthz_payload() -> Dict[str, Any]:
+    """The ``/healthz`` body: watchdog + flight + quorum/sync + alert
+    status with an overall ``status`` of ``ok`` / ``stalled`` /
+    ``alerting`` / ``degraded`` (first match wins; only ``stalled`` and
+    ``alerting`` fail the probe). Usable without the server — tests and
+    non-HTTP health integrations call it directly."""
+    from torcheval_tpu.obs import flight as _flight
+    from torcheval_tpu.obs import monitor as _monitor
+    from torcheval_tpu.obs import watchdog as _watchdog
+    from torcheval_tpu.resilience import default_sync_health
+
+    wd = _watchdog.current_watchdog()
+    mon = _monitor.current_monitor()
+    alerts = []
+    if mon is not None:
+        mon.check()
+        alerts = mon.active_alerts()
+    health = default_sync_health()
+    with health._lock:
+        sync = {
+            "world_size": health.world_size,
+            "participating_ranks": list(health.participating_ranks),
+            "degraded_syncs": health.degraded_syncs,
+            "full_syncs": health.full_syncs,
+            "consecutive_missing": list(health.consecutive_missing),
+            "reforms": health.reforms,
+        }
+    stalled = wd is not None and wd.tripped
+    degraded = bool(sync["consecutive_missing"])
+    if stalled:
+        status = "stalled"
+    elif alerts:
+        status = "alerting"
+    elif degraded:
+        status = "degraded"
+    else:
+        status = "ok"
+    return {
+        "status": status,
+        "healthy": status not in ("stalled", "alerting"),
+        "watchdog": wd.status() if wd is not None else {"armed": 0},
+        "flight": _flight.FLIGHT.counters(),
+        "sync": sync,
+        "alerts": alerts,
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # quiet by default: per-request stderr lines do not belong in an
+    # eval job's output (the server object keeps a request counter)
+    def log_message(self, *args: Any) -> None:
+        pass
+
+    def _send(
+        self, status: int, content_type: str, body: str
+    ) -> None:
+        payload = body.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        from torcheval_tpu.obs import flight as _flight
+        from torcheval_tpu.obs.export import format_report, render_prometheus
+
+        server: "ObsServer" = self.server.obs_server  # type: ignore[attr-defined]
+        server.requests += 1
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(
+                    200,
+                    "text/plain; version=0.0.4; charset=utf-8",
+                    render_prometheus(),
+                )
+            elif path == "/healthz" or path == "/":
+                payload = healthz_payload()
+                self._send(
+                    200 if payload["healthy"] else 503,
+                    "application/json",
+                    json.dumps(payload),
+                )
+            elif path == "/flight":
+                snapshot = _flight.FLIGHT.snapshot()
+                self._send(
+                    200,
+                    "application/json",
+                    json.dumps(
+                        {str(tid): ring for tid, ring in snapshot.items()}
+                    ),
+                )
+            elif path == "/report":
+                self._send(200, "text/plain; charset=utf-8", format_report())
+            else:
+                self._send(
+                    404,
+                    "text/plain; charset=utf-8",
+                    "not found; endpoints: /metrics /healthz /flight /report\n",
+                )
+        except BrokenPipeError:
+            pass  # scraper went away mid-response
+        except Exception as e:  # noqa: BLE001 — a scrape must not die silent
+            try:
+                self._send(
+                    500, "text/plain; charset=utf-8",
+                    f"{type(e).__name__}: {e}\n",
+                )
+            except Exception:  # noqa: BLE001 — connection already gone
+                pass
+
+
+class ObsServer:
+    """The background health/metrics HTTP server (module docstring)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1") -> None:
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.obs_server = self  # type: ignore[attr-defined]
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self.requests = 0
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "ObsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.05},
+                daemon=True,
+                name="torcheval-obs-http",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Shut down cleanly: stop accepting, join the serve loop, close
+        the socket (idempotent)."""
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            self._httpd.shutdown()
+            thread.join(timeout=5.0)
+        self._httpd.server_close()
+
+
+_SERVER: Optional[ObsServer] = None
+_SERVER_LOCK = threading.Lock()
+
+
+def current_server() -> Optional[ObsServer]:
+    """The running process-global server, or ``None``."""
+    srv = _SERVER
+    return srv if srv is not None and srv.running else None
+
+
+def start_server(port: int = 0, host: str = "127.0.0.1") -> ObsServer:
+    """Start the process-global health server (replacing any running
+    one). ``port=0`` binds an ephemeral port — read it off the returned
+    server's ``.port``. Scoped use: ``config.observability(serve=<port>)``."""
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            _SERVER.stop()
+        _SERVER = ObsServer(port, host).start()
+        return _SERVER
+
+
+def stop_server() -> None:
+    """Stop the process-global health server (no-op when none runs)."""
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            _SERVER.stop()
+            _SERVER = None

@@ -53,10 +53,18 @@ __all__ = [
     "pop",
     "push",
     "scope_or_null",
+    "thread_paths",
     "trace_path",
 ]
 
 _TLS = threading.local()
+
+# Cross-thread view of the per-thread span stacks, for the stall watchdog
+# (obs/watchdog.py): a watchdog thread diagnosing a hang must name the
+# span path of the STALLED thread, which thread-local state alone cannot
+# answer. Each thread registers its (mutable) stack list on first use;
+# entries are tiny and thread counts bounded, so stale tids are harmless.
+_ALL_STACKS: Dict[int, List["SpanFrame"]] = {}
 
 # Span ids are process-unique (itertools.count.__next__ is atomic under
 # the GIL); trace ids additionally carry a random 32-bit process prefix
@@ -96,6 +104,7 @@ def _stack() -> List[SpanFrame]:
     stack = getattr(_TLS, "stack", None)
     if stack is None:
         stack = _TLS.stack = []
+        _ALL_STACKS[threading.get_ident()] = stack
     return stack
 
 
@@ -107,6 +116,7 @@ def push(name: str) -> SpanFrame:
         stack = _TLS.stack
     except AttributeError:
         stack = _TLS.stack = []
+        _ALL_STACKS[threading.get_ident()] = stack
     if stack:
         top = stack[-1]
         frame = SpanFrame(top.trace_id, next(_SPAN_IDS), top.span_id, name)
@@ -159,6 +169,19 @@ def trace_path(frames: Optional[List[SpanFrame]] = None) -> str:
     if frames is None:
         frames = active_stack()
     return " > ".join(f.name for f in frames)
+
+
+def thread_paths() -> Dict[int, str]:
+    """Every thread's current span path (``{tid: "a > b"}``), threads
+    with no open span omitted — the watchdog's "where was each thread"
+    answer. List append/pop is atomic under the GIL and the snapshot
+    copies before formatting, so no locking is needed."""
+    out: Dict[int, str] = {}
+    for tid, stack in list(_ALL_STACKS.items()):
+        frames = list(stack)
+        if frames:
+            out[tid] = " > ".join(f.name for f in frames)
+    return out
 
 
 def annotate(**kwargs: Any) -> None:

@@ -64,6 +64,8 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from torcheval_tpu.distributed import LocalReplicaGroup, ProcessGroup
+from torcheval_tpu.obs import flight as _flight
+from torcheval_tpu.obs.flight import FLIGHT as _FLIGHT
 from torcheval_tpu.obs.recorder import RECORDER as _OBS
 
 __all__ = [
@@ -573,10 +575,15 @@ class ResilientGroup(ProcessGroup):
         """Record one resilience lifecycle event (retry cause, degradation
         outcome, re-formation) when the observability recorder is on —
         the event-stream twin of the :class:`SyncHealth` counters. One
-        attribute read when off; host-side only when on."""
+        attribute read when off; host-side only when on. Timeout/failure
+        events carry the flight-ring tail (ISSUE 11) when the flight
+        recorder is on: *which* collective in the sequence stalled."""
         if _OBS.enabled:
             from torcheval_tpu.obs.events import RetryEvent
 
+            flight_tail = ""
+            if _FLIGHT.enabled and reason in ("timeout", "failed"):
+                flight_tail = _FLIGHT.tail_text()
             _OBS.record(
                 RetryEvent(
                     rank=self.rank,
@@ -584,6 +591,7 @@ class ResilientGroup(ProcessGroup):
                     attempt=attempt,
                     policy=self.policy,
                     detail=detail,
+                    flight=flight_tail,
                 )
             )
 
@@ -693,6 +701,8 @@ class ResilientGroup(ProcessGroup):
         self,
         fn: Callable[[], List[Any]],
         local_only: Callable[[], Tuple[List[Any], List[int]]],
+        op: str = "collective",
+        nbytes: int = 0,
     ) -> Tuple[List[Any], List[int]]:
         """Observability shell around :meth:`_resilient_impl`: with the
         recorder on, the whole collective (every retry attempt and the
@@ -700,23 +710,59 @@ class ResilientGroup(ProcessGroup):
         ``RetryEvent``\\ s emitted underneath parent to it, giving the
         per-collective, per-peer timing telemetry Prime-CCL-style
         operations need — and its wall time feeds the ``collective``
-        latency digest. Recorder off: one attribute read, the original
-        path."""
-        if not _OBS.enabled:
-            return self._resilient_impl(fn, local_only)
-        from torcheval_tpu.obs import hist as _obs_hist
-
-        t0 = time.monotonic()
+        latency digest. With the flight recorder on (ISSUE 11), the whole
+        collective is ONE :class:`~torcheval_tpu.obs.flight.FlightRecord`
+        — enqueued here, issued per attempt, completed/failed with the
+        surviving ranks — visible MID-FLIGHT to the stall watchdog; a
+        raised :class:`SyncTimeoutError` carries the ring tail as
+        ``e.flight_tail``. Both off: one attribute read each, the
+        original path."""
+        record = None
+        if _FLIGHT.enabled:
+            record = _FLIGHT.start(
+                op, payload_bytes=nbytes, rank=self.rank,
+                world_size=self.world_size, state="enqueued",
+            )
+            if record is not None:
+                inner = fn
+                # the inner gather may run on the deadline WORKER thread,
+                # whose own thread-local depth guard cannot see this
+                # record — suppress explicitly so wrapped plain groups do
+                # not record the same logical collective twice
+                fn = lambda: _flight.suppressed(inner)  # noqa: E731
         try:
-            with _OBS.span("torcheval.collective"):
-                return self._resilient_impl(fn, local_only)
-        finally:
-            _obs_hist.observe("collective", time.monotonic() - t0)
+            if not _OBS.enabled:
+                result = self._resilient_impl(fn, local_only, record)
+            else:
+                from torcheval_tpu.obs import hist as _obs_hist
+
+                t0 = time.monotonic()
+                try:
+                    with _OBS.span("torcheval.collective"):
+                        result = self._resilient_impl(fn, local_only, record)
+                finally:
+                    _obs_hist.observe("collective", time.monotonic() - t0)
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            _FLIGHT.fail(record, f"{type(e).__name__}: {e}")
+            if record is not None and isinstance(e, SyncTimeoutError):
+                e.flight_tail = _FLIGHT.tail_text()
+            raise
+        values, ranks = result
+        _FLIGHT.complete(
+            record,
+            ranks=tuple(ranks),
+            detail=(
+                "" if len(ranks) == self.world_size
+                else f"degraded to ranks {list(ranks)}"
+            ),
+        )
+        return result
 
     def _resilient_impl(
         self,
         fn: Callable[[], List[Any]],
         local_only: Callable[[], Tuple[List[Any], List[int]]],
+        flight_record=None,
     ) -> Tuple[List[Any], List[int]]:
         """Run one collective with retries, then apply the degradation
         policy. Returns ``(payloads, participating_ranks)``, rank-aligned
@@ -772,6 +818,7 @@ class ResilientGroup(ProcessGroup):
                 else:
                     if delay:
                         time.sleep(delay)
+                    _FLIGHT.issued(flight_record)
                     result = self._bounded(fn)
             except PartialGatherError as e:
                 with h._lock:
@@ -868,12 +915,16 @@ class ResilientGroup(ProcessGroup):
         return self._resilient(
             lambda: self._active.allgather_object(obj),
             lambda: self._local_object(obj),
+            "allgather_object",
+            _flight.payload_nbytes(obj),
         )
 
     def allgather_array_with_ranks(self, x: Any) -> Tuple[List[Any], List[int]]:
         return self._resilient(
             lambda: self._active.allgather_array(x),
             lambda: self._local_array(x),
+            "allgather_array",
+            _flight.payload_nbytes(x),
         )
 
     def _full_or_raise(
