@@ -1830,6 +1830,178 @@ def run_sharded_state():
     }
 
 
+def run_metric_table():
+    """Config 15: keyed metric table (ISSUE 12).
+
+    Serving-scale audit of ``torcheval_tpu.table.MetricTable`` at the
+    acceptance sizes — 100,000 keys, table world 4:
+
+    - ``ingest``: steady-state keys/sec of the fused ingest program on a
+      WARMED world-4 rank (mixed ownership: ~1/world of each batch
+      scatters into owned slots, the rest append to the foreign outbox)
+      and on a world-1 table (all owned), min-of-rounds wall per 4096-row
+      batch with the result blocked;
+    - ``memory``: ``logical_bytes`` vs ``per_rank_bytes`` through
+      ``obs.memory_report`` at the post-adopt steady state (4 tables
+      fed pre-partitioned traffic, merged, adopted), with the acceptance
+      flag ``per_rank_within_band`` pinning per-rank state inside
+      ``[logical/(2*world), 2*logical/world]`` — the pow2 slot-capacity
+      slack band around the ideal 1/world;
+    - ``sync_payload_bytes``: the trimmed wire payload a world-4 rank
+      ships after one fresh mixed batch vs the world-1 (replicated-
+      equivalent) table's full payload;
+    - ``zero_retrace``: CompileCounter over fresh ragged batch sizes on
+      a warmed bucketed table must stay 0 (the PR 1 contract composed
+      with the table).
+
+    Bit-identity of table values vs per-key standalone metrics is pinned
+    by tier-1 (tests/table/), not re-proven here.
+    """
+    import jax
+    import numpy as np
+
+    from torcheval_tpu import config as tev_config
+    from torcheval_tpu.metrics import ShardContext
+    from torcheval_tpu.obs.memory import (
+        _leaf_bytes,
+        logical_state_bytes,
+        per_rank_state_bytes,
+    )
+    from torcheval_tpu.table import MetricTable, hash_keys, owner_of
+    from torcheval_tpu.utils import CompileCounter
+
+    world = 4
+    n_keys = 100_000
+    batch = 4096
+    rounds = 20
+    rng = np.random.default_rng(15)
+    keys = rng.permutation(n_keys).astype(np.int64)
+    hk = hash_keys(keys)
+    out = {
+        "world": world,
+        "keys": n_keys,
+        "batch_rows": batch,
+        "rounds": rounds,
+        "family": "ctr",
+    }
+
+    def _mixed_batch():
+        idx = rng.integers(0, n_keys, batch)
+        return (
+            keys[idx],
+            rng.integers(0, 2, batch).astype(np.float32),
+            np.ones(batch, np.float32),
+        )
+
+    def _ingest_rate(world_, rank):
+        t = MetricTable(
+            "ctr", shard=ShardContext(rank, world_), repr_limit=0
+        )
+        mine = keys if world_ == 1 else keys[owner_of(hk, world_) == rank]
+        # admit every owned key up front (steady state: no admissions)
+        t.ingest(mine, np.ones(mine.size, np.float32))
+        # pre-grow the outbox past ALL the measured traffic so pow2
+        # growth (a new program signature per capacity) never lands
+        # inside a timed round, then warm the bucket-4096 program
+        if world_ > 1:
+            t._ensure_outbox(rounds * batch + batch)
+            for _ in range(2):
+                t.ingest(*_mixed_batch())
+        walls = []
+        for _ in range(rounds):
+            b = _mixed_batch()
+            t0 = time.perf_counter()
+            t.ingest(*b)
+            jax.block_until_ready(t.out_n if world_ > 1 else t.col_click)
+            walls.append(time.perf_counter() - t0)
+        best = min(walls)
+        return {
+            "min_us_per_batch": round(best * 1e6, 1),
+            "keys_per_sec": round(batch / best),
+            "occupancy": t.occupancy,
+            "outbox_entries": int(t.out_h),
+        }
+
+    out["ingest"] = {
+        "world4_rank0": _ingest_rate(world, 0),
+        "world1": _ingest_rate(1, 0),
+    }
+
+    # ---- memory at the post-adopt steady state (in-process emulation)
+    import copy as _copy
+
+    tables = [
+        MetricTable("ctr", shard=ShardContext(r, world), repr_limit=0)
+        for r in range(world)
+    ]
+    for r, t in enumerate(tables):
+        mine = keys[owner_of(hk, world) == r]
+        t.ingest(mine, np.ones(mine.size, np.float32))
+    merged = _copy.deepcopy(tables[0])
+    merged.merge_state([_copy.deepcopy(x) for x in tables[1:]])
+    payload = merged.state_dict()
+    tables[0].load_state_dict(payload)
+    logical = sum(logical_state_bytes(tables[0]).values())
+    per_rank = sum(per_rank_state_bytes(tables[0]).values())
+    out["memory"] = {
+        "logical_bytes": logical,
+        "per_rank_bytes": per_rank,
+        "per_rank_over_logical": round(per_rank / logical, 3),
+        "occupancy": tables[0].occupancy,
+        "per_rank_within_band": (
+            logical // (2 * world) <= per_rank <= 2 * logical // world
+        ),
+    }
+
+    # ---- sync wire: world-4 rank payload (one fresh mixed batch
+    # pending) vs the world-1 full-table payload
+    tables[0].ingest(*_mixed_batch())
+    w4_payload = int(
+        sum(_leaf_bytes(v) for v in tables[0]._sync_state_dict().values())
+    )
+    w1 = MetricTable("ctr", repr_limit=0)
+    w1.ingest(keys, np.ones(n_keys, np.float32))
+    w1_payload = int(
+        sum(_leaf_bytes(v) for v in w1._sync_state_dict().values())
+    )
+    out["sync_payload_bytes"] = {
+        "world4_rank": w4_payload,
+        "world1_full": w1_payload,
+    }
+
+    # ---- retrace audit: warmed bucketed table, fresh ragged sizes
+    with tev_config.shape_bucketing():
+        t = MetricTable("ctr", shard=ShardContext(1, world), repr_limit=0)
+        big = np.concatenate([keys[:4096]] * 2)
+        t.ingest(big, np.ones(big.size, np.float32))
+        for n in (8, 16, 32, 64):
+            b = _mixed_batch()
+            t.ingest(b[0][:n], b[1][:n], b[2][:n])
+        with CompileCounter() as cc:
+            for n in (6, 10, 18, 34, 57):
+                b = _mixed_batch()
+                t.ingest(b[0][:n], b[1][:n], b[2][:n])
+        fresh_programs = cc.programs
+    out["retrace"] = {
+        "fresh_ragged_programs": fresh_programs,
+        "zero_retrace": fresh_programs == 0,
+    }
+    out["acceptance"] = {
+        "per_rank_within_band": out["memory"]["per_rank_within_band"],
+        "wire_below_full_table": w4_payload < w1_payload,
+        "zero_retrace": out["retrace"]["zero_retrace"],
+    }
+    return {
+        "metric": (
+            f"keyed metric table: ingest keys/sec at {n_keys:,} keys + "
+            f"per-rank vs logical bytes at world {world}"
+        ),
+        "value": out["ingest"]["world4_rank0"]["keys_per_sec"],
+        "unit": "keys/sec (world-4 rank, 4096-row batches)",
+        "metric_table": out,
+    }
+
+
 def run_probe():
     """Tiny op on the default backend — proves the platform is claimable."""
     import jax
@@ -2661,6 +2833,7 @@ CONFIGS = {
     "tracing": (run_tracing, None),  # causal-tracing-overhead audit
     "sharded_state": (run_sharded_state, None),  # ZeRO-for-metrics audit
     "monitoring": (run_monitoring, None),  # live-diagnosis-overhead audit
+    "metric_table": (run_metric_table, None),  # keyed-table serving audit
 }
 
 _NO_REF_NOTES = {
@@ -2700,6 +2873,11 @@ _NO_REF_NOTES = {
         "recorder/watchdog/SLO layer, so the comparison is our own "
         "all-off loop"
     ),
+    "metric_table": (
+        "keyed-table serving audit — the reference has no keyed metric "
+        "collection, so the comparisons are our own world-1 ingest arm "
+        "and the world-1 full-table payload"
+    ),
 }
 
 REF_FNS = {
@@ -2730,7 +2908,7 @@ def _cache_env(env):
 # actually need, and one the torch reference children never pay.
 _SINGLE_DEVICE_CONFIGS = {
     "accuracy_update", "auroc_compute", "text_eval", "fid", "kernels",
-    "variable_batch", "sharded_state", "monitoring",
+    "variable_batch", "sharded_state", "monitoring", "metric_table",
 }
 
 

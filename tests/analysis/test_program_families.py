@@ -199,6 +199,43 @@ def test_sharded_merge_program_is_local_math(name):
     assert report.collectives == ()
 
 
+# ------------------------------------------------ keyed table (ISSUE 12)
+
+
+@pytest.mark.parametrize("family", ["ctr", "windowed_ne"])
+def test_table_ingest_program_statically_verified(family):
+    """The keyed table's fused ingest (device slot lookup + owned
+    segment scatter + compacted foreign outbox append) keeps every
+    local-update contract — no host escapes, ZERO collectives — and its
+    donated variant aliases every accumulating buffer in place. Verified
+    on the warmed steady state (the host intake has admitted the keys)."""
+    from torcheval_tpu.metrics import ShardContext
+    from torcheval_tpu.table import MetricTable
+
+    rng = np.random.default_rng(12)
+    keys = rng.integers(0, 64, 32)
+    if family == "ctr":
+        args = (rng.integers(0, 2, 32).astype(np.float32),)
+    else:
+        args = (
+            rng.uniform(0.05, 0.95, 32).astype(np.float32),
+            rng.integers(0, 2, 32).astype(np.float32),
+        )
+    table = MetricTable(family, shard=ShardContext(1, 4))
+    table.ingest(keys, *args)  # warm: keys admitted, outbox grown
+    report = verify_metric_update(table, keys, *args)
+    assert report is not None and report.ok, "\n" + report.format_text()
+    assert report.collectives == ()
+    assert report.hlo_collectives == ()
+    assert report.host_escapes == ()
+    report = verify_metric_update(table, keys, *args, donate=True)
+    assert report.ok, "\n" + report.format_text()
+    assert report.donated_params and report.aliased_params
+    # compute is a pure slice + family formula: no error findings
+    report = verify_metric_compute(table)
+    assert not _errors(report), "\n" + report.format_text()
+
+
 def test_owner_partitioned_sync_lowers_to_one_reduce_scatter():
     """ISSUE 9 acceptance: the sharded in-jit sync program's collective
     census is exactly ONE owner-shard reduction — jaxpr ``psum_scatter``,

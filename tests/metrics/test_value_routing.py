@@ -1,0 +1,312 @@
+"""Float-payload outbox lane (ISSUE 12 satellite; the PR 9 "remaining"
+item): weighted (f32) routed sharded states for WeightedCalibration.
+
+The counter lane could reassociate freely (integer adds commute); the
+float lane cannot, so the exactness contract here is the per-batch
+boundary fold: sharded results must be BIT-identical to the replicated
+oracle fed the same row stream.
+"""
+
+from __future__ import annotations
+
+import copy
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from torcheval_tpu import config
+from torcheval_tpu.metrics import ShardContext, WeightedCalibration
+from torcheval_tpu.metrics.toolkit import adopt_synced, sync_and_compute
+from torcheval_tpu.utils import CompileCounter
+from torcheval_tpu.utils.test_utils import ThreadWorld
+
+T, WORLD = 16, 4
+RNG = np.random.default_rng(90)
+ROWS = [
+    (
+        RNG.uniform(size=48).astype(np.float32),
+        RNG.integers(0, 2, 48).astype(np.float32),
+        RNG.uniform(0.5, 2.0, 48).astype(np.float32),
+        RNG.integers(0, T, 48),
+    )
+    for _ in range(8)
+]
+
+
+def _replicated_oracle():
+    reps = [WeightedCalibration(num_tasks=T) for _ in range(WORLD)]
+    for r in range(WORLD):
+        for i in range(r, len(ROWS), WORLD):
+            x, t, w, ids = ROWS[i]
+            reps[r].update(x, t, w, task_ids=ids)
+    target = copy.deepcopy(reps[0])
+    target.merge_state(reps[1:])
+    return np.asarray(target.compute())
+
+
+def _sharded_rank(rank, world=WORLD):
+    m = WeightedCalibration(num_tasks=T, shard=ShardContext(rank, world))
+    for i in range(rank, len(ROWS), world):
+        x, t, w, ids = ROWS[i]
+        m.update(x, t, w, task_ids=ids)
+    return m
+
+
+def test_row_update_form_matches_dense_scatter_semantics():
+    """The new task_ids row form on a REPLICATED metric equals manual
+    per-task accumulation."""
+    m = WeightedCalibration(num_tasks=4)
+    x = np.array([0.5, 0.25, 0.75, 1.0], np.float32)
+    t = np.array([1.0, 0.0, 1.0, 1.0], np.float32)
+    ids = np.array([0, 0, 2, 3])
+    m.update(x, t, 2.0, task_ids=ids)
+    np.testing.assert_allclose(
+        np.asarray(m.weighted_input_sum), [1.5, 0.0, 1.5, 2.0]
+    )
+    np.testing.assert_allclose(
+        np.asarray(m.weighted_target_sum), [2.0, 0.0, 2.0, 2.0]
+    )
+    # out-of-range task ids are dropped, matching segment semantics
+    m2 = WeightedCalibration(num_tasks=4)
+    m2.update(x, t, 2.0, task_ids=np.array([0, 0, 2, 99]))
+    assert float(m2.weighted_input_sum[3]) == 0.0
+
+
+def test_sharded_merge_bit_identical_to_replicated_oracle():
+    want = _replicated_oracle()
+    shards = [_sharded_rank(r) for r in range(WORLD)]
+    assert shards[0].weighted_input_sum.shape == (T // WORLD,)
+    assert int(getattr(shards[0], "weighted_input_sum__obh")) > 0
+    target = copy.deepcopy(shards[0])
+    target.merge_state(shards[1:])
+    got = np.asarray(target.compute())
+    assert got.tobytes() == want.tobytes()
+
+
+def test_threadworld_sync_and_adopt_drain():
+    want = _replicated_oracle()
+
+    def body(g):
+        m = _sharded_rank(g.rank)
+        out = np.asarray(sync_and_compute(m, g))
+        synced = adopt_synced(m, g)
+        # drained: own shard, empty outbox (and boundary buffer)
+        assert int(getattr(m, "weighted_input_sum__obh")) == 0
+        assert int(getattr(m, "weighted_input_sum__obbh")) == 0
+        assert m.weighted_input_sum.shape == (T // WORLD,)
+        # post-adopt row updates keep working
+        x, t, w, ids = ROWS[0]
+        m.update(x, t, w, task_ids=ids)
+        return out, np.asarray(synced.compute())
+
+    for out, adopted in ThreadWorld(WORLD).run(body):
+        assert out.tobytes() == want.tobytes()
+        assert adopted.tobytes() == want.tobytes()
+
+
+def test_carrier_local_compute_equals_replicated_local():
+    sh = _sharded_rank(1)
+    rep = WeightedCalibration(num_tasks=T)
+    for i in range(1, len(ROWS), WORLD):
+        x, t, w, ids = ROWS[i]
+        rep.update(x, t, w, task_ids=ids)
+    assert (
+        np.asarray(sh.compute()).tobytes()
+        == np.asarray(rep.compute()).tobytes()
+    )
+
+
+def test_dense_updates_on_sharded_instance_are_owner_partitioned():
+    """Full-(T, B) updates follow the windowed-family contract: every
+    rank sees the same stream, each persists its rows; the reassembled
+    merge equals the replicated metric."""
+    rng = np.random.default_rng(7)
+    shs = [
+        WeightedCalibration(num_tasks=T, shard=ShardContext(r, WORLD))
+        for r in range(WORLD)
+    ]
+    rep = WeightedCalibration(num_tasks=T)
+    for _ in range(3):
+        x = rng.uniform(size=(T, 8)).astype(np.float32)
+        t = rng.integers(0, 2, (T, 8)).astype(np.float32)
+        for m in shs:
+            m.update(x, t)
+        rep.update(x, t)
+    assert shs[0].weighted_input_sum.shape == (T // WORLD,)
+    target = copy.deepcopy(shs[0])
+    target.merge_state(shs[1:])
+    assert (
+        np.asarray(target.compute()).tobytes()
+        == np.asarray(rep.compute()).tobytes()
+    )
+
+
+def test_sync_payload_trims_value_outbox_to_pow2_bucket():
+    sh = _sharded_rank(0)
+    cnt = int(getattr(sh, "weighted_input_sum__obh"))
+    sd = sh._sync_state_dict()
+    keep = 1 << (cnt - 1).bit_length()
+    assert sd["weighted_input_sum__obi"].shape[0] == keep
+    assert sd["weighted_input_sum__obv"].shape == (keep, 2)
+    nb = int(getattr(sh, "weighted_input_sum__obbh"))
+    bkeep = 1 << (nb - 1).bit_length()
+    assert sd["weighted_input_sum__obb"].shape[0] == bkeep
+    # the trimmed payload round-trips: load into a clone, merge, equal
+    want = _replicated_oracle()
+    clones = []
+    for r in range(WORLD):
+        src = _sharded_rank(r)
+        clone = WeightedCalibration(num_tasks=T, shard=ShardContext(0, WORLD))
+        clone.load_state_dict(src._sync_state_dict(), strict=False)
+        clones.append(clone)
+    target = clones[0]
+    target.merge_state(clones[1:])
+    assert np.asarray(target.compute()).tobytes() == want.tobytes()
+
+
+# ------------------------------------------------- bucketing composition
+
+
+def _ragged_stream(seed):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            rng.uniform(size=n).astype(np.float32),
+            rng.integers(0, 2, n).astype(np.float32),
+            rng.uniform(0.5, 2.0, n).astype(np.float32),
+            rng.integers(0, T, n),
+        )
+        for n in (7, 13, 29, 5, 18)
+    ]
+
+
+def test_bucketed_routed_update_bit_identical_and_cursor_exact():
+    plain = WeightedCalibration(num_tasks=T, shard=ShardContext(0, WORLD))
+    for x, t, w, ids in _ragged_stream(42):
+        plain.update(x, t, w, task_ids=ids)
+    with config.shape_bucketing():
+        bucketed = WeightedCalibration(
+            num_tasks=T, shard=ShardContext(0, WORLD)
+        )
+        for x, t, w, ids in _ragged_stream(42):
+            bucketed.update(x, t, w, task_ids=ids)
+    a = np.asarray(plain._logical_state("weighted_input_sum"))
+    b = np.asarray(bucketed._logical_state("weighted_input_sum"))
+    assert a.tobytes() == b.tobytes()
+    # device cursors equal their host mirrors after ragged appends
+    assert int(np.asarray(bucketed.weighted_input_sum__obn)) == int(
+        bucketed.weighted_input_sum__obh
+    )
+    assert int(np.asarray(bucketed.weighted_input_sum__obc)) == int(
+        bucketed.weighted_input_sum__obbh
+    )
+
+
+def test_bucketed_routed_update_is_retrace_proof():
+    def stream(n_list, seed):
+        rng = np.random.default_rng(seed)
+        return [
+            (
+                rng.uniform(size=n).astype(np.float32),
+                rng.integers(0, 2, n).astype(np.float32),
+                rng.uniform(0.5, 2.0, n).astype(np.float32),
+                rng.integers(0, T, n),
+            )
+            for n in n_list
+        ]
+
+    with config.shape_bucketing():
+        m = WeightedCalibration(num_tasks=T, shard=ShardContext(1, WORLD))
+        big = stream((256,), 1)[0]
+        m.update(*big[:3], task_ids=big[3])  # pre-grow the outbox
+        for x, t, w, ids in stream((8, 16, 32, 64), 2):
+            m.update(x, t, w, task_ids=ids)
+        with CompileCounter() as warmed:
+            for x, t, w, ids in stream((6, 10, 18, 34), 3):
+                m.update(x, t, w, task_ids=ids)
+        assert warmed.programs == 0, warmed.programs
+
+
+# ----------------------------------------------------------- elastic / misc
+
+
+@pytest.mark.parametrize("new_world", [2, 4])
+def test_elastic_world_change_resume(new_world):
+    from torcheval_tpu.elastic import ElasticSession
+
+    want = _replicated_oracle()
+    with tempfile.TemporaryDirectory() as d:
+
+        def writer(g):
+            m = _sharded_rank(g.rank)
+            sess = ElasticSession(m, d, process_group=g, interval=10**9)
+            sess.snapshot()
+
+        ThreadWorld(WORLD).run(writer)
+
+        def resume(g):
+            m = WeightedCalibration(
+                num_tasks=T, shard=ShardContext(g.rank, new_world)
+            )
+            sess = ElasticSession(m, d, process_group=g, interval=10**9)
+            restored = sess.restore()
+            assert restored is not None and restored.world_size == WORLD
+            assert m.weighted_input_sum.shape == (T // new_world,)
+            return np.asarray(sync_and_compute(m, g))
+
+        for got in ThreadWorld(new_world).run(resume):
+            assert got.tobytes() == want.tobytes()
+
+
+def test_world1_sharded_instance_stays_on_dense_plans():
+    m = WeightedCalibration(num_tasks=T, shard=ShardContext(0, 1))
+    x, t, w, ids = ROWS[0]
+    m.update(x, t, w, task_ids=ids)
+    # world 1 owns every task: nothing routed, outbox structurally empty
+    assert int(getattr(m, "weighted_input_sum__obh")) == 0
+    rep = WeightedCalibration(num_tasks=T)
+    rep.update(x, t, w, task_ids=ids)
+    assert (
+        np.asarray(m.compute()).tobytes()
+        == np.asarray(rep.compute()).tobytes()
+    )
+
+
+def test_row_form_input_validation():
+    m = WeightedCalibration(num_tasks=4)
+    with pytest.raises(ValueError, match="one-dimensional"):
+        m.update(
+            np.ones((2, 3), np.float32),
+            np.ones((2, 3), np.float32),
+            task_ids=np.zeros(6),
+        )
+    with pytest.raises(ValueError, match="task_ids"):
+        m.update(
+            np.ones(3, np.float32),
+            np.ones(3, np.float32),
+            task_ids=np.zeros(2),
+        )
+    with pytest.raises(ValueError, match="Weight must be"):
+        m.update(
+            np.ones(3, np.float32),
+            np.ones(3, np.float32),
+            np.ones(2, np.float32),
+            task_ids=np.zeros(3),
+        )
+
+
+def test_static_verifier_passes_routed_float_program():
+    """The fused routed row program verifies like the counter lane:
+    zero collectives, no host escapes, donation-sound."""
+    from torcheval_tpu.analysis import verify_metric_update
+
+    m = WeightedCalibration(num_tasks=T, shard=ShardContext(1, WORLD))
+    x, t, w, ids = ROWS[0]
+    report = verify_metric_update(m, x, t, 1.0, task_ids=ids)
+    assert report is not None and report.ok, "\n" + report.format_text()
+    assert report.collectives == ()
+    assert report.host_escapes == ()
+    report = verify_metric_update(m, x, t, 1.0, donate=True, task_ids=ids)
+    assert report.ok and report.donated_params and report.aliased_params

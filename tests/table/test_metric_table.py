@@ -1,0 +1,497 @@
+"""Keyed metric table (ISSUE 12 tentpole): local semantics.
+
+Per-key values must be BIT-identical to standalone per-key metric
+instances fed the same rows — the tentpole's exactness contract — and
+the serving-scale mechanics (device slot resolution, pow2 growth, shape
+bucketing, eviction bookkeeping, memory accounting) must hold without a
+process group. Distributed/elastic behavior lives in
+tests/table/test_table_distributed.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from torcheval_tpu import config
+from torcheval_tpu.metrics import (
+    ClickThroughRate,
+    HitRate,
+    ShardContext,
+    WeightedCalibration,
+)
+from torcheval_tpu.table import MetricTable, TableValues, hash_keys, owner_of
+from torcheval_tpu.utils import CompileCounter
+
+RNG = np.random.default_rng(12)
+N_KEYS = 24
+
+
+def _ctr_batches(n_batches=6, rows=32, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            rng.integers(0, N_KEYS, rows),
+            rng.integers(0, 2, rows).astype(np.float32),
+            (rng.integers(1, 8, rows) / 8).astype(np.float32),
+        )
+        for _ in range(n_batches)
+    ]
+
+
+# ------------------------------------------------------------ family oracles
+
+
+def test_ctr_per_key_bit_identical_to_standalone():
+    batches = _ctr_batches()
+    t = MetricTable("ctr")
+    for keys, c, w in batches:
+        t.ingest(keys, c, w)
+    vals = t.compute().as_dict()
+    for k in np.unique(np.concatenate([b[0] for b in batches])):
+        m = ClickThroughRate()
+        for keys, c, w in batches:
+            sel = keys == k
+            if sel.any():
+                m.update(jnp.asarray(c[sel]), jnp.asarray(w[sel]))
+        assert vals[int(k)] == float(m.compute()[0]), int(k)
+
+
+def test_weighted_calibration_per_key_bit_identical_to_standalone():
+    batches = _ctr_batches(seed=5)
+    t = MetricTable("weighted_calibration")
+    for keys, preds, w in batches:
+        targets = (preds > 0.4).astype(np.float32)
+        t.ingest(keys, preds, targets, w)
+    vals = t.compute().as_dict()
+    checked = 0
+    for k in np.unique(np.concatenate([b[0] for b in batches])):
+        m = WeightedCalibration()
+        for keys, preds, w in batches:
+            sel = keys == k
+            if sel.any():
+                targets = (preds > 0.4).astype(np.float32)
+                m.update(
+                    jnp.asarray(preds[sel]),
+                    jnp.asarray(targets[sel]),
+                    jnp.asarray(w[sel]),
+                )
+        want = np.asarray(m.compute())
+        if want.size:  # standalone returns empty on zero target mass
+            assert vals[int(k)] == float(want[0]), int(k)
+            checked += 1
+    assert checked > 5
+
+
+def test_hit_rate_per_key_matches_standalone_mean():
+    rng = np.random.default_rng(8)
+    batches = [
+        (
+            rng.integers(0, N_KEYS, 16),
+            rng.uniform(size=(16, 5)).astype(np.float32),
+            rng.integers(0, 5, 16),
+        )
+        for _ in range(5)
+    ]
+    t = MetricTable("hit_rate", k=2)
+    for b in batches:
+        t.ingest(*b)
+    vals = t.compute().as_dict()
+    for k in np.unique(np.concatenate([b[0] for b in batches])):
+        m = HitRate(k=2)
+        for keys, s, tg in batches:
+            sel = keys == k
+            if sel.any():
+                m.update(jnp.asarray(s[sel]), jnp.asarray(tg[sel]))
+        scores = jnp.asarray(np.asarray(m.compute()))
+        want = float(jnp.sum(scores) / jnp.float32(scores.size))
+        assert vals[int(k)] == want, int(k)
+
+
+def test_windowed_ne_rings_commit_per_drain_epoch():
+    """Windowed families aggregate per DRAIN EPOCH: each adopt commits
+    the pending counters as one ring column for keys with traffic, and
+    compute covers the last ``window`` committed epochs — equal to a
+    standalone windowed NE recorded once per epoch with the same
+    counters."""
+    from torcheval_tpu.metrics import WindowedBinaryNormalizedEntropy
+    from torcheval_tpu.metrics.toolkit import adopt_synced
+
+    rng = np.random.default_rng(11)
+    W, EPOCHS = 3, 5
+    t = MetricTable("windowed_ne", window=W)
+    per_epoch = []
+    for _ in range(EPOCHS):
+        keys = rng.integers(0, 6, 20)
+        preds = rng.uniform(0.05, 0.95, 20).astype(np.float32)
+        targets = rng.integers(0, 2, 20).astype(np.float32)
+        per_epoch.append((keys, preds, targets))
+        t.ingest(keys, preds, targets)
+        adopt_synced(t)
+    vals = t.compute().as_dict()
+    from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+        _ne_ce_rows,
+    )
+
+    for k in range(6):
+        m = WindowedBinaryNormalizedEntropy(
+            max_num_updates=W, enable_lifetime=False
+        )
+        for keys, preds, targets in per_epoch:
+            sel = keys == k
+            if not sel.any():
+                continue
+            ce, tt = _ne_ce_rows(jnp.asarray(preds[sel]), jnp.asarray(targets[sel]), False)
+            w = jnp.ones_like(tt)
+            m._record(
+                (
+                    jnp.atleast_1d(jnp.sum(w * ce)),
+                    jnp.atleast_1d(jnp.sum(w)),
+                    jnp.atleast_1d(jnp.sum(w * tt)),
+                )
+            )
+        if m.total_updates:
+            assert vals[k] == float(np.asarray(m.compute())[0]), k
+
+
+def test_string_keys_hash_deterministically_and_scrape():
+    t = MetricTable("ctr")
+    t.ingest(["us/mobile", "us/web", "us/mobile"], jnp.array([1.0, 0.0, 0.0]))
+    vals = t.compute().as_dict()
+    assert vals["us/mobile"] == 0.5 and vals["us/web"] == 0.0
+    scraped = t.scrape_values()
+    assert scraped["value_us_mobile"] == 0.5
+    # the hash function is fixed (not python's salted hash)
+    h1 = hash_keys(["us/mobile"])[0]
+    h2 = hash_keys(["us/mobile"])[0]
+    assert h1 == h2
+
+
+# ------------------------------------------------------- mechanics / growth
+
+
+def test_slot_growth_and_arrival_order_independence():
+    """Slot order is key-hash order, not arrival order: two tables fed
+    the same rows in different batch orders hold identical state."""
+    batches = _ctr_batches()
+    a, b = MetricTable("ctr"), MetricTable("ctr")
+    for batch in batches:
+        a.ingest(*batch)
+    for batch in reversed(batches):
+        b.ingest(*batch)
+    assert np.array_equal(a.compute().keys, b.compute().keys)
+    got_a = a.compute().as_dict()
+    got_b = b.compute().as_dict()
+    assert set(got_a) == set(got_b)
+    # f32 sums over the same per-key rows in different batch order are
+    # close (bit-identity is an ORDER contract, pinned in the oracle
+    # tests where order matches)
+    for k in got_a:
+        assert got_a[k] == pytest.approx(got_b[k], rel=1e-5)
+
+
+def test_warmed_table_processes_fresh_ragged_batches_with_zero_compiles():
+    """ISSUE 12 acceptance: a warmed table (keys admitted, buckets seen,
+    outbox capacity grown) pays ZERO new compiled programs for fresh
+    ragged batch sizes under shape bucketing."""
+    rng = np.random.default_rng(5)
+    keyspace = rng.integers(0, 1000, 2000)
+
+    def feed(t, n):
+        keys = keyspace[rng.integers(0, keyspace.size, n)]
+        t.ingest(
+            keys,
+            rng.integers(0, 2, n).astype(np.float32),
+            (rng.integers(1, 8, n) / 8).astype(np.float32),
+        )
+
+    with config.shape_bucketing():
+        t = MetricTable("ctr", shard=ShardContext(1, 4))
+        # admit the keyspace and pre-grow the outbox past the test sizes
+        big = np.concatenate([keyspace, keyspace])
+        t.ingest(
+            big,
+            np.zeros(big.size, np.float32),
+            np.ones(big.size, np.float32),
+        )
+        for n in (8, 16, 32, 64):
+            feed(t, n)
+        with CompileCounter() as warmed:
+            for n in (6, 10, 18, 34, 57):
+                feed(t, n)
+        assert warmed.programs == 0, (
+            f"fresh ragged sizes retraced {warmed.programs} programs"
+        )
+    # control: without bucketing every fresh size retraces
+    t2 = MetricTable("ctr", shard=ShardContext(1, 4))
+    t2.ingest(big, np.zeros(big.size, np.float32), np.ones(big.size, np.float32))
+    for n in (8, 16, 32, 64):
+        feed(t2, n)
+    with CompileCounter() as cold:
+        for n in (6, 10, 18, 34):
+            feed(t2, n)
+    assert cold.programs == 4
+
+
+def test_bucketed_ingest_bit_identical_to_unbucketed():
+    batches = [
+        (RNG.integers(0, 30, n), RNG.integers(0, 2, n).astype(np.float32),
+         (RNG.integers(1, 8, n) / 8).astype(np.float32))
+        for n in (7, 13, 29, 5)
+    ]
+    plain = MetricTable("ctr", shard=ShardContext(0, 2))
+    for b in batches:
+        plain.ingest(*b)
+    with config.shape_bucketing():
+        bucketed = MetricTable("ctr", shard=ShardContext(0, 2))
+        for b in batches:
+            bucketed.ingest(*b)
+    a, b = plain.compute(), bucketed.compute()
+    assert np.array_equal(a.keys, b.keys)
+    assert np.asarray(a.values).tobytes() == np.asarray(b.values).tobytes()
+    # the compacted outbox holds only foreign entries, identically
+    assert int(plain.out_h) == int(bucketed.out_h)
+    assert int(np.asarray(bucketed.out_n)) == int(bucketed.out_h)
+    np.testing.assert_array_equal(
+        np.asarray(plain.out_hi[: int(plain.out_h)]),
+        np.asarray(bucketed.out_hi[: int(bucketed.out_h)]),
+    )
+
+
+def test_outbox_holds_only_foreign_traffic():
+    t = MetricTable("ctr", shard=ShardContext(0, 2))
+    keys = np.arange(64)
+    hk = hash_keys(keys)
+    t.ingest(keys, np.ones(64, np.float32))
+    n_foreign = int((owner_of(hk, 2) != 0).sum())
+    assert int(t.out_h) == n_foreign
+    assert int(np.asarray(t.out_n)) == n_foreign
+    assert t.occupancy == 64 - n_foreign
+
+
+# -------------------------------------------------------- eviction / TTL
+
+
+def test_ttl_eviction_is_deterministic_and_counted():
+    t = MetricTable("ctr", ttl=1)
+    from torcheval_tpu.metrics.toolkit import adopt_synced
+
+    t.ingest([1, 2, 3], np.ones(3, np.float32))
+    adopt_synced(t)  # epoch 0 -> 1; all seen at epoch 0, ttl=1 keeps them
+    assert t.occupancy == 3
+    t.ingest([1], np.ones(1, np.float32))  # only key 1 seen in epoch 1
+    adopt_synced(t)
+    assert t.occupancy == 1
+    assert int(t.evictions_total) == 2
+    assert list(t.compute().as_dict()) == [1]
+
+
+def test_max_keys_evicts_oldest_first_ties_by_hash():
+    from torcheval_tpu.metrics.toolkit import adopt_synced
+
+    t = MetricTable("ctr", max_keys=2)
+    t.ingest([1, 2, 3, 4], np.ones(4, np.float32))
+    adopt_synced(t)
+    assert t.occupancy == 2
+    # all four share last_seen; survivors are the two LARGEST hashes
+    # (oldest-first, ties by ascending hash -> ascending hashes dropped)
+    hk = np.sort(hash_keys(np.array([1, 2, 3, 4])))
+    assert set(int(h) for h in t._keys) == set(int(h) for h in hk[2:])
+    assert int(t.evictions_total) == 2
+
+
+def test_eviction_replay_is_identical():
+    """The same logical stream replayed into a fresh table makes
+    identical eviction decisions (the determinism eviction contract at
+    world 1; the cross-rank version is pinned in
+    test_table_distributed.py)."""
+    from torcheval_tpu.metrics.toolkit import adopt_synced
+
+    def run():
+        rng = np.random.default_rng(77)
+        t = MetricTable("ctr", ttl=2, max_keys=12)
+        for _ in range(5):
+            keys = rng.integers(0, 40, 24)
+            t.ingest(keys, np.ones(24, np.float32))
+            adopt_synced(t)
+        return sorted(int(h) for h in t._keys), int(t.evictions_total)
+
+    assert run() == run()
+
+
+# ------------------------------------------------------------ observability
+
+
+def test_memory_report_logical_vs_per_rank_at_serving_scale():
+    """ISSUE 12 acceptance: a 100k-key table at world 4 holds ~1/4 of
+    the logical state per rank (within pow2 slot slack), measured
+    through obs.memory_report at the post-adopt steady state."""
+    import copy
+
+    from torcheval_tpu.obs import memory_report
+
+    N = 100_000
+    keys = np.arange(N, dtype=np.int64)
+    hk = hash_keys(keys)
+    tables = [MetricTable("ctr", shard=ShardContext(r, 4)) for r in range(4)]
+    for r, t in enumerate(tables):
+        mine = keys[owner_of(hk, 4) == r]  # steady state: owned traffic
+        t.ingest(mine, np.ones(mine.size, np.float32))
+    merged = copy.deepcopy(tables[0])
+    merged.merge_state([copy.deepcopy(x) for x in tables[1:]])
+    tables[0].load_state_dict(merged.state_dict())
+    row = memory_report({"table": tables[0]})["table"]
+    assert row["sharded"]
+    assert int(tables[0].global_keys) == N
+    # ~1/4: within [logical/8, logical/2] — the pow2 slot slack band
+    assert row["per_rank_bytes"] <= row["logical_bytes"] // 2
+    assert row["per_rank_bytes"] >= row["logical_bytes"] // 8
+    assert tables[0].occupancy < N // 3
+
+
+def test_counters_track_and_prometheus_scrape():
+    from torcheval_tpu.obs.counters import CounterRegistry
+    from torcheval_tpu.obs.export import render_prometheus
+
+    t = MetricTable("ctr", ttl=4)
+    t.ingest([5, 6, 7], np.ones(3, np.float32))
+    reg = CounterRegistry()
+    t.track(registry=reg)
+    t.track_values(registry=reg)
+    counters = reg.read()
+    assert counters["metric_table"]["occupancy"] == 3
+    assert counters["metric_table"]["inserts_total"] == 3
+    assert counters["metric_table"]["evictions_total"] == 0
+    assert counters["metric_table"]["per_rank_bytes"] > 0
+    assert set(counters["metric_table_values"]) == {
+        "value_5", "value_6", "value_7"
+    }
+    text = render_prometheus(reg, histograms={})
+    assert "torcheval_tpu_metric_table_occupancy 3" in text
+    assert "torcheval_tpu_metric_table_values_value_5 1" in text
+
+
+def test_memory_report_is_transfer_free():
+    import jax
+
+    t = MetricTable("ctr", shard=ShardContext(0, 4))
+    t.ingest(np.arange(64), np.ones(64, np.float32))
+    from torcheval_tpu.obs import memory_report
+
+    with jax.transfer_guard("disallow"):
+        memory_report({"t": t})
+
+
+# ------------------------------------------------------------------ errors
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="unknown table family"):
+        MetricTable("nope")
+    with pytest.raises(ValueError, match="ttl"):
+        MetricTable("ctr", ttl=0)
+    with pytest.raises(ValueError, match="max_keys"):
+        MetricTable("ctr", max_keys=0)
+    with pytest.raises(ValueError, match="k should be"):
+        MetricTable("hit_rate", k=0)
+    with pytest.raises(TypeError, match="unexpected table family"):
+        MetricTable("ctr", window=4)
+    import jax
+
+    devices = jax.devices("cpu")
+    if len(devices) >= 8:
+        from jax.sharding import Mesh
+
+        ctx = ShardContext.from_mesh(
+            Mesh(np.array(devices[:8]), ("dp",)), "dp"
+        )
+        with pytest.raises(NotImplementedError, match="mesh"):
+            MetricTable("ctr", shard=ctx)
+
+
+def test_row_count_mismatch_and_bad_keys():
+    t = MetricTable("ctr")
+    with pytest.raises(ValueError, match="rows"):
+        t.ingest([1, 2, 3], np.ones(2, np.float32))
+    with pytest.raises(TypeError, match="keys must be integers or strings"):
+        t.ingest(np.ones(2, np.float32), np.ones(2, np.float32))
+
+
+def test_merged_table_rejects_ingest_and_reslices_on_load():
+    import copy
+
+    t = MetricTable("ctr", shard=ShardContext(0, 2))
+    t.ingest(np.arange(16), np.ones(16, np.float32))
+    merged = copy.deepcopy(t)
+    merged.merge_state([])
+    assert int(merged._owner_rank) == -1
+    with pytest.raises(RuntimeError, match="merged"):
+        merged.ingest([1], np.ones(1, np.float32))
+    # compute covers the union (owned + outbox-observed keys)
+    assert len(merged.compute().keys) == 16
+    # loading the logical payload back re-slices to owned keys
+    t.load_state_dict(merged.state_dict())
+    assert int(t._owner_rank) == 0 and int(t.out_h) == 0
+    assert t.occupancy < 16
+    assert int(t.global_keys) == 16
+
+
+def test_foreign_carrier_rejects_ingest():
+    a = MetricTable("ctr", shard=ShardContext(0, 2))
+    b = MetricTable("ctr", shard=ShardContext(1, 2))
+    b.ingest(np.arange(8), np.ones(8, np.float32))
+    a.load_state_dict(b.state_dict(), strict=False)
+    with pytest.raises(RuntimeError, match="foreign carriers"):
+        a.ingest([1], np.ones(1, np.float32))
+
+
+def test_strict_load_names_missing_and_unexpected_keys():
+    t = MetricTable("ctr")
+    sd = t.state_dict()
+    sd.pop("n_keys")
+    sd["bogus"] = 1
+    with pytest.raises(RuntimeError, match="missing keys.*n_keys"):
+        t.load_state_dict(sd)
+
+
+def test_reset_restores_empty_table():
+    t = MetricTable("ctr", ttl=3)
+    t.ingest([1, 2], np.ones(2, np.float32))
+    t.reset()
+    assert t.occupancy == 0
+    assert t._keys.size == 0 and t._reprs == {}
+    assert int(t.inserts_total) == 0
+    t.ingest([4], np.ones(1, np.float32))
+    assert t.compute().as_dict() == {4: 1.0}
+
+
+def test_compute_returns_tablevalues_in_key_order():
+    t = MetricTable("ctr")
+    t.ingest([9, 1, 5], np.ones(3, np.float32))
+    tv = t.compute()
+    assert isinstance(tv, TableValues)
+    assert np.array_equal(tv.keys, np.sort(tv.keys))
+    assert len(tv.keys) == 3 == np.asarray(tv.values).shape[0]
+
+
+def test_repr_limit_bounds_host_map():
+    t = MetricTable("ctr", repr_limit=2)
+    t.ingest([1, 2, 3, 4], np.ones(4, np.float32))
+    assert len(t._reprs) == 2
+    vals = t.compute().as_dict()
+    assert len(vals) == 4  # unmapped keys fall back to their hash
+
+
+def test_object_dtype_int_keys_hash_like_int_arrays():
+    """numpy promotes to object dtype when any int exceeds int64; the
+    same logical key must hash identically either way (an object-array
+    int routed through its string repr would silently split one key
+    into two slots)."""
+    a = hash_keys(np.array([5, 7], dtype=np.int64))
+    b = hash_keys(np.array([5, 2**70, 7], dtype=object))
+    assert b[0] == a[0] and b[2] == a[1]
+    # and an int key never collides with its string spelling
+    assert hash_keys(["5"])[0] != a[0]
+    with pytest.raises(TypeError, match="integers or strings"):
+        hash_keys(np.array([5, None], dtype=object))

@@ -1,0 +1,357 @@
+"""Keyed metric table (ISSUE 12): distributed + elastic acceptance.
+
+ThreadWorld-4 sync/adopt pinned BIT-identical to per-key standalone
+metric oracles merged through the toolkit semantics, deterministic
+cross-rank eviction, 2->4 / 4->2 elastic resume of a populated table,
+per-tenant subgroup scoping, and the adopt_synced replicated-member
+rejection regression (the PR 9 scalar-path error, satellite 2).
+"""
+
+from __future__ import annotations
+
+import copy
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from torcheval_tpu.elastic import ElasticSession
+from torcheval_tpu.metrics import (
+    ClickThroughRate,
+    MulticlassAccuracy,
+    ShardContext,
+)
+from torcheval_tpu.metrics.toolkit import adopt_synced, sync_and_compute
+from torcheval_tpu.table import MetricTable, hash_keys, owner_of
+from torcheval_tpu.utils.test_utils import ThreadWorld
+
+WORLD = 4
+RNG = np.random.default_rng(21)
+BATCHES = [
+    (
+        RNG.integers(0, 40, 32),
+        RNG.integers(0, 2, 32).astype(np.float32),
+        (RNG.integers(1, 8, 32) / 8).astype(np.float32),
+    )
+    for _ in range(8)
+]
+
+
+def _per_key_oracle(world=WORLD, batches=BATCHES):
+    """Per-key standalone CTR metrics, one per rank, merged in rank
+    order — exactly the toolkit merge semantics the table must
+    reproduce bit-for-bit."""
+    out = {}
+    for k in np.unique(np.concatenate([b[0] for b in batches])):
+        per_rank = []
+        for r in range(world):
+            m = ClickThroughRate()
+            for i in range(r, len(batches), world):
+                keys, c, w = batches[i]
+                sel = keys == k
+                if sel.any():
+                    m.update(jnp.asarray(c[sel]), jnp.asarray(w[sel]))
+            per_rank.append(m)
+        target = copy.deepcopy(per_rank[0])
+        target.merge_state(per_rank[1:])
+        out[int(k)] = float(target.compute()[0])
+    return out
+
+
+def _feed(table, rank, world=WORLD, batches=BATCHES):
+    for i in range(rank, len(batches), world):
+        table.ingest(*batches[i])
+
+
+def test_threadworld_adopt_bit_identical_to_per_key_oracle():
+    want = _per_key_oracle()
+
+    def body(g):
+        t = MetricTable("ctr", shard=ShardContext(g.rank, WORLD))
+        _feed(t, g.rank)
+        assert int(t.out_h) > 0  # foreign traffic accumulated
+        synced = adopt_synced(t, g)
+        # drained: own keys only, empty outbox, provenance attached
+        assert int(t.out_h) == 0
+        assert int(t._owner_rank) == g.rank
+        assert t.sync_provenance.ranks == tuple(range(WORLD))
+        # further ingest works post-adopt
+        t.ingest(*BATCHES[0])
+        return synced.compute().as_dict()
+
+    for vals in ThreadWorld(WORLD).run(body):
+        assert set(vals) == set(want)
+        assert all(vals[k] == want[k] for k in want)
+
+
+def test_threadworld_sync_and_compute_does_not_mutate_working_table():
+    def body(g):
+        t = MetricTable("ctr", shard=ShardContext(g.rank, WORLD))
+        _feed(t, g.rank)
+        before = int(t.out_h)
+        tv = sync_and_compute(t, g)
+        assert int(t.out_h) == before  # plain syncs are non-mutating
+        return tv.as_dict()
+
+    want = _per_key_oracle()
+    for vals in ThreadWorld(WORLD).run(body):
+        assert all(vals[k] == want[k] for k in want)
+
+
+def test_cross_rank_eviction_is_deterministic_and_world_independent():
+    """Eviction decisions are a deterministic function of the merged
+    logical stream: every rank of a world-4 run agrees on the surviving
+    key set, AND a world-1 replay of the same global stream (same drain
+    points) survives the identical keys — the re-hash determinism that
+    makes eviction safe across world sizes."""
+    rng = np.random.default_rng(31)
+    epochs = [
+        [
+            (
+                rng.integers(0, 48, 24),
+                np.ones(24, np.float32),
+            )
+            for _ in range(4)
+        ]
+        for _ in range(4)
+    ]
+
+    def world4(g):
+        t = MetricTable(
+            "ctr", shard=ShardContext(g.rank, WORLD), ttl=1, max_keys=10
+        )
+        for batches in epochs:
+            for i in range(g.rank, len(batches), WORLD):
+                t.ingest(*batches[i])
+            adopt_synced(t, g)
+        return sorted(int(h) for h in t._keys), int(t.evictions_total)
+
+    results = ThreadWorld(WORLD).run(world4)
+    union4 = sorted(h for keys, _ in results for h in keys)
+    assert all(ev == results[0][1] for _, ev in results)
+
+    t1 = MetricTable("ctr", ttl=1, max_keys=10)
+    for batches in epochs:
+        for b in batches:
+            t1.ingest(*b)
+        adopt_synced(t1)
+    assert sorted(int(h) for h in t1._keys) == union4
+    assert int(t1.evictions_total) == results[0][1]
+
+
+# ----------------------------------------------------------------- elastic
+
+
+def _wc_batches():
+    rng = np.random.default_rng(2)
+    return [
+        (
+            rng.integers(0, 30, 24),
+            rng.uniform(size=24).astype(np.float32),
+            rng.integers(0, 2, 24).astype(np.float32),
+        )
+        for _ in range(8)
+    ]
+
+
+@pytest.mark.parametrize("new_world", [2, 4])
+def test_elastic_world_change_resume_bit_identical(new_world):
+    """A populated table snapshotted at world 4 resumes at world 2 (and
+    4) with bit-identical post-drain per-key values — the elastic
+    re-hash contract (hashes are deterministic; ownership re-derives as
+    hash % new_world)."""
+    batches = _wc_batches()
+
+    def truth():
+        def body(g):
+            t = MetricTable(
+                "weighted_calibration", shard=ShardContext(g.rank, WORLD)
+            )
+            _feed(t, g.rank, WORLD, batches)
+            return adopt_synced(t, g).compute().as_dict()
+
+        return ThreadWorld(WORLD).run(body)[0]
+
+    want = truth()
+    with tempfile.TemporaryDirectory() as d:
+
+        def writer(g):
+            t = MetricTable(
+                "weighted_calibration", shard=ShardContext(g.rank, WORLD)
+            )
+            sess = ElasticSession(t, d, process_group=g, interval=10**9)
+            _feed(t, g.rank, WORLD, batches)
+            sess.snapshot()
+
+        ThreadWorld(WORLD).run(writer)
+
+        def resume(g):
+            t = MetricTable(
+                "weighted_calibration",
+                shard=ShardContext(g.rank, new_world),
+            )
+            sess = ElasticSession(t, d, process_group=g, interval=10**9)
+            restored = sess.restore()
+            assert restored is not None and restored.world_size == WORLD
+            if new_world != WORLD:
+                # world changed: the restore reassembled + re-sliced
+                assert int(t._owner_rank) == g.rank
+                assert int(t._owner_world) == new_world
+            return adopt_synced(t, g).compute().as_dict()
+
+        for vals in ThreadWorld(new_world).run(resume):
+            assert set(vals) == set(want)
+            assert all(vals[k] == want[k] for k in want)
+
+
+def test_elastic_scale_up_from_world_2_to_4():
+    batches = _wc_batches()
+
+    def truth():
+        def body(g):
+            t = MetricTable(
+                "weighted_calibration", shard=ShardContext(g.rank, 2)
+            )
+            _feed(t, g.rank, 2, batches)
+            return adopt_synced(t, g).compute().as_dict()
+
+        return ThreadWorld(2).run(body)[0]
+
+    want = truth()
+    with tempfile.TemporaryDirectory() as d:
+
+        def writer(g):
+            t = MetricTable(
+                "weighted_calibration", shard=ShardContext(g.rank, 2)
+            )
+            sess = ElasticSession(t, d, process_group=g, interval=10**9)
+            _feed(t, g.rank, 2, batches)
+            sess.snapshot()
+
+        ThreadWorld(2).run(writer)
+
+        def resume(g):
+            t = MetricTable(
+                "weighted_calibration", shard=ShardContext(g.rank, 4)
+            )
+            sess = ElasticSession(t, d, process_group=g, interval=10**9)
+            assert sess.restore().world_size == 2
+            return adopt_synced(t, g).compute().as_dict()
+
+        for vals in ThreadWorld(4).run(resume):
+            assert all(vals[k] == want[k] for k in want)
+
+
+def test_elastic_same_world_resume_is_carrier_fast_path():
+    batches = _wc_batches()
+    with tempfile.TemporaryDirectory() as d:
+
+        def writer(g):
+            t = MetricTable(
+                "weighted_calibration", shard=ShardContext(g.rank, 2)
+            )
+            sess = ElasticSession(t, d, process_group=g, interval=10**9)
+            _feed(t, g.rank, 2, batches)
+            sess.snapshot()
+            return int(t.out_h), t.occupancy
+
+        wrote = ThreadWorld(2).run(writer)
+
+        def resume(g):
+            t = MetricTable(
+                "weighted_calibration", shard=ShardContext(g.rank, 2)
+            )
+            sess = ElasticSession(t, d, process_group=g, interval=10**9)
+            assert sess.restore() is not None
+            # same world: the carrier payload loads verbatim, OUTBOX
+            # INCLUDED (pending foreign traffic survives the restart)
+            return int(t.out_h), t.occupancy
+
+        assert ThreadWorld(2).run(resume) == wrote
+
+
+# ------------------------------------------------------- tenancy / adopt
+
+
+def test_per_tenant_subgroup_scoping():
+    """Two tenants on one 4-rank world: each tenant's table lives on a
+    2-rank subgroup (ownership hashed over the subgroup world), syncs
+    only within it, and non-members never participate."""
+    rng = np.random.default_rng(41)
+    tenant_batches = {
+        0: [(rng.integers(0, 12, 16), np.ones(16, np.float32)) for _ in range(4)],
+        1: [(rng.integers(12, 24, 16), np.ones(16, np.float32)) for _ in range(4)],
+    }
+
+    def body(g):
+        tenant = g.rank // 2
+        sub = g.new_subgroup([0, 1] if tenant == 0 else [2, 3])
+        t = MetricTable("ctr", shard=ShardContext.from_group(sub))
+        batches = tenant_batches[tenant]
+        for i in range(sub.rank, len(batches), 2):
+            t.ingest(*batches[i])
+        synced = adopt_synced(t, sub)
+        return tenant, synced.compute().as_dict()
+
+    results = ThreadWorld(WORLD).run(body)
+    by_tenant = {0: None, 1: None}
+    for tenant, vals in results:
+        if by_tenant[tenant] is None:
+            by_tenant[tenant] = vals
+        else:
+            assert vals == by_tenant[tenant]
+    assert set(by_tenant[0]) == set(
+        int(k) for k in np.unique(np.concatenate([b[0] for b in tenant_batches[0]]))
+    )
+    assert set(by_tenant[0]).isdisjoint(by_tenant[1])
+
+
+def test_adopt_synced_rejects_replicated_members_with_clear_error():
+    """Satellite 2 regression: draining a table must reject replicated
+    member metrics with the same clear error as the PR 9 scalar path —
+    single-metric AND collection forms."""
+    with pytest.raises(TypeError, match="replicated — adopting the merged"):
+        adopt_synced(MulticlassAccuracy())
+    with pytest.raises(TypeError, match="member 'acc'.*replicated"):
+        adopt_synced(
+            {"t": MetricTable("ctr"), "acc": MulticlassAccuracy()}
+        )
+    # and a pure-table collection drains in one batched exchange
+    def body(g):
+        coll = {
+            "ctr": MetricTable("ctr", shard=ShardContext(g.rank, 2)),
+            "wc": MetricTable(
+                "weighted_calibration", shard=ShardContext(g.rank, 2)
+            ),
+        }
+        coll["ctr"].ingest(*BATCHES[g.rank][:2])
+        keys, preds, w = BATCHES[g.rank]
+        coll["wc"].ingest(keys, preds, (preds > 0.5).astype(np.float32))
+        synced = adopt_synced(coll, g)
+        assert int(coll["ctr"].out_h) == 0 and int(coll["wc"].out_h) == 0
+        return sorted(synced)
+
+    for names in ThreadWorld(2).run(body):
+        assert names == ["ctr", "wc"]
+
+
+def test_sync_payload_ships_live_rows_not_capacity():
+    """The sync payload is the TRIMMED snapshot: live slots + the
+    compacted foreign outbox, never slot/outbox capacity."""
+    from torcheval_tpu.obs.memory import _leaf_bytes
+
+    t = MetricTable("ctr", shard=ShardContext(0, 4))
+    keys = np.arange(100)
+    t.ingest(keys, np.ones(100, np.float32))
+    sd = t._sync_state_dict()
+    assert sd["slot_hi"].shape[0] == t.occupancy < t.slot_hi.shape[0]
+    assert sd["out_hi"].shape[0] <= 1 << (int(t.out_h) - 1).bit_length()
+    payload = sum(
+        _leaf_bytes(v) for v in sd.values() if hasattr(v, "nbytes")
+    )
+    capacity = sum(
+        _leaf_bytes(getattr(t, n))
+        for n in t._state_name_to_default
+    )
+    assert payload < capacity

@@ -24,6 +24,7 @@ MODULES = [
     ("torcheval_tpu.metrics.toolkit", "toolkit"),
     ("torcheval_tpu.metrics.synclib", "synclib"),
     ("torcheval_tpu.metrics.sharded", "sharded"),
+    ("torcheval_tpu.table", "table"),
     ("torcheval_tpu.distributed", "distributed"),
     ("torcheval_tpu.resilience", "resilience"),
     ("torcheval_tpu.elastic", "elastic"),

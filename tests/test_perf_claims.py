@@ -757,3 +757,64 @@ def test_monitoring_table_matches_capture():
     assert mon["monitoring_increment_within_2pct"] is True
     assert mon["monitoring_increment_pct"] <= 2.0
     assert mon["flight_failed_total"] == 0
+
+
+MT = _load("bench_r15_metric_table_cpu_20260804.json")
+
+
+def test_metric_table_matches_capture():
+    """ISSUE 12: the round-15 keyed-table section in docs/benchmarks.md
+    traces to its committed capture, and the capture itself satisfies
+    the acceptance — per-rank state inside the pow2 band around
+    logical/world, wire strictly below the full-table payload, and zero
+    fresh-ragged-size retraces on the warmed bucketed table."""
+    text = _read("docs/benchmarks.md")
+    mt = MT["metric_table"]["metric_table"]
+    acc = mt["acceptance"]
+    assert acc["per_rank_within_band"] is True
+    assert acc["wire_below_full_table"] is True
+    assert acc["zero_retrace"] is True
+    world = mt["world"]
+    mem = mt["memory"]
+    assert mem["logical_bytes"] // (2 * world) <= mem["per_rank_bytes"]
+    assert mem["per_rank_bytes"] <= 2 * mem["logical_bytes"] // world
+    # published numbers == capture
+    w4 = mt["ingest"]["world4_rank0"]
+    w1 = mt["ingest"]["world1"]
+    m = re.search(
+        r"world-4 rank 0 [^|]*\| ([\d.]+) µs/batch \| \*\*([\d,]+)\*\*",
+        text,
+    )
+    assert m, "r15 world-4 ingest row not found"
+    assert float(m.group(1)) == w4["min_us_per_batch"]
+    assert int(m.group(2).replace(",", "")) == w4["keys_per_sec"]
+    m = re.search(
+        r"world 1 \(all owned, no outbox\) \| ([\d.]+) µs/batch \| ([\d,]+)",
+        text,
+    )
+    assert m, "r15 world-1 ingest row not found"
+    assert float(m.group(1)) == w1["min_us_per_batch"]
+    assert int(m.group(2).replace(",", "")) == w1["keys_per_sec"]
+    m = re.search(
+        r"logical \(all 100,000 keys, pow2 slot capacity\) \| ([\d,]+) B", text
+    )
+    assert m and int(m.group(1).replace(",", "")) == mem["logical_bytes"]
+    m = re.search(
+        r"per-rank \(rank 0, pow2 slot capacity\) \| ([\d,]+) B "
+        r"\(\*\*([\d.]+)×\*\*",
+        text,
+    )
+    assert m, "r15 per-rank row not found"
+    assert int(m.group(1).replace(",", "")) == mem["per_rank_bytes"]
+    assert float(m.group(2)) == mem["per_rank_over_logical"]
+    wire = mt["sync_payload_bytes"]
+    m = re.search(
+        r"sync payload, world-4 rank [^|]*\| ([\d,]+) B", text
+    )
+    assert m and int(m.group(1).replace(",", "")) == wire["world4_rank"]
+    m = re.search(
+        r"sync payload, world-1 full table \| ([\d,]+) B", text
+    )
+    assert m and int(m.group(1).replace(",", "")) == wire["world1_full"]
+    assert wire["world4_rank"] < wire["world1_full"]
+    assert mt["retrace"]["fresh_ragged_programs"] == 0

@@ -47,31 +47,82 @@ def _program_smoke() -> Report:
     xb = jnp.asarray(rng.random(32).astype(np.float32))
     tb = jnp.asarray(rng.integers(0, 2, 32).astype(np.float32))
 
+    task_ids = jnp.asarray(rng.integers(0, 8, 32).astype(np.int32))
     cases = [
-        (M.MulticlassAccuracy(), (x2, t1)),  # SUM counters
-        (M.Mean(), (xb,)),  # weighted-sum pair
-        (M.MeanSquaredError(), (xb, tb)),  # regression family
+        (M.MulticlassAccuracy(), (x2, t1), {}),  # SUM counters
+        (M.Mean(), (xb,), {}),  # weighted-sum pair
+        (M.MeanSquaredError(), (xb, tb), {}),  # regression family
         # sharded-state layer (ISSUE 9): the scatter-route update + the
         # reassembling merge must verify like any family
         (
             M.MulticlassConfusionMatrix(8, shard=M.ShardContext(1, 4)),
             (t1, t1),
+            {},
         ),
         (
             M.HistogramBinnedAUROC(
                 threshold=16, shard=M.ShardContext(0, 2)
             ),
             (xb, jnp.asarray(rng.integers(0, 2, 32))),
+            {},
+        ),
+        # float-payload outbox lane (ISSUE 12 satellite): the routed
+        # row-form WeightedCalibration update must verify like the
+        # int-count lane — zero collectives, no host escapes,
+        # donation-sound
+        (
+            M.WeightedCalibration(num_tasks=8, shard=M.ShardContext(1, 4)),
+            (xb, tb, 1.0),
+            {"task_ids": task_ids},
         ),
     ]
     combined = Report(tool="program")
-    for metric, args in cases:
-        report = verify_metric_update(metric, *args)
+    for metric, args, kwargs in cases:
+        report = verify_metric_update(metric, *args, **kwargs)
         if report is not None:
             combined.extend(report)
         combined.extend(verify_metric_compute(metric))
         combined.extend(verify_metric_merge(metric))
+    combined.extend(_table_ingest_smoke())
     combined.extend(_flight_lockstep_smoke())
+    return combined
+
+
+def _table_ingest_smoke() -> Report:
+    """ISSUE 12 tentpole: the keyed metric table's fused ingest program
+    — statically proven transfer-free (no host escapes once the host
+    intake has admitted the keys), collective-free, and donation-sound,
+    for a plain and a windowed family, on the warmed steady state."""
+    import numpy as np
+
+    from torcheval_tpu.analysis.program import (
+        verify_metric_compute,
+        verify_metric_update,
+    )
+    from torcheval_tpu.metrics import ShardContext
+    from torcheval_tpu.table import MetricTable
+
+    rng = np.random.default_rng(12)
+    keys = rng.integers(0, 64, 32)
+    combined = Report(tool="program")
+    for family, args in (
+        ("ctr", (rng.integers(0, 2, 32).astype(np.float32),)),
+        (
+            "windowed_ne",
+            (
+                rng.uniform(0.05, 0.95, 32).astype(np.float32),
+                rng.integers(0, 2, 32).astype(np.float32),
+            ),
+        ),
+    ):
+        table = MetricTable(family, shard=ShardContext(1, 4))
+        # warm the host intake (key admission + outbox growth) so the
+        # verified program is the steady-state ingest
+        table.ingest(keys, *args)
+        report = verify_metric_update(table, keys, *args)
+        if report is not None:
+            combined.extend(report)
+        combined.extend(verify_metric_compute(table))
     return combined
 
 

@@ -475,13 +475,15 @@ def check_donation_aliasing(
 # ------------------------------------------------------- metric verifiers
 
 
-def _normalized_plan(metric, *args):
+def _normalized_plan(metric, *args, **kwargs):
     """(kernel, state_names, dynamic, config, transform, plan-or-None);
     the trailing entry is the raw :class:`UpdatePlan` when the metric
-    declares one (so the caller can reach ``masked_kernel``)."""
+    declares one (so the caller can reach ``masked_kernel``). ``kwargs``
+    forward to ``_update_plan`` (keyword-only update forms like
+    WeightedCalibration's ``task_ids=``)."""
     from torcheval_tpu.metrics.metric import UpdatePlan
 
-    plan = metric._update_plan(*args)
+    plan = metric._update_plan(*args, **kwargs)
     if plan is None:
         return None
     if isinstance(plan, UpdatePlan):
@@ -531,6 +533,7 @@ def verify_metric_update(
     *args: Any,
     donate: Optional[bool] = None,
     expect_collectives: Union[int, Sequence[str]] = 0,
+    **update_kwargs: Any,
 ) -> Optional[ProgramReport]:
     """Statically verify a metric's fused update program: no host
     escapes, zero collectives (a LOCAL update must never sync), dtype
@@ -542,7 +545,7 @@ def verify_metric_update(
     tests/metrics/test_buffers.py)."""
     from torcheval_tpu.metrics import _fuse
 
-    normalized = _normalized_plan(metric, *args)
+    normalized = _normalized_plan(metric, *args, **update_kwargs)
     if normalized is None:
         return None
     kernel, state_names, dynamic, config, transform, plan = normalized

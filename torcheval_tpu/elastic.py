@@ -852,7 +852,13 @@ class ElasticSession:
         for name, metric in self.metrics.items():
             metric.reset()
             metric_assigned = assigned
-            sharded = bool(getattr(metric, "_sharded_states", None))
+            # axis-sharded states AND hash-partitioned key tables
+            # (torcheval_tpu.table.MetricTable) redistribute the same
+            # way: reassemble the logical state from every old shard,
+            # then re-slice to this rank's new shard / owned key set
+            sharded = bool(
+                getattr(metric, "_sharded_states", None)
+            ) or bool(getattr(metric, "_hash_partitioned", False))
             world_changed = len(shards) != self._group.world_size
             if sharded and world_changed:
                 # world size changed: this sharded metric needs every

@@ -22,13 +22,13 @@ from torcheval_tpu.config import debug_validation_enabled
 from torcheval_tpu.utils.convert import to_jax
 
 
-@partial(jax.jit, static_argnames=("from_logits",))
-def _ne_update_jit(
-    input: jax.Array,
-    target: jax.Array,
-    weight: Optional[jax.Array],
-    from_logits: bool,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+def _ne_ce_rows(
+    input: jax.Array, target: jax.Array, from_logits: bool
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-element cross entropy (and the f32 target) — the single home
+    of the CE formula, shared by the task-counter update below and the
+    keyed metric table's per-key NE family (``torcheval_tpu.table``), so
+    their per-row arithmetic cannot drift."""
     target = target.astype(jnp.float32)
     input = input.astype(jnp.float32)
     if from_logits:
@@ -50,6 +50,17 @@ def _ne_update_jit(
         logx = jnp.maximum(jnp.log(input), -100.0)
         log1mx = jnp.maximum(jnp.log1p(-input), -100.0)
         ce = -(target * logx + (1.0 - target) * log1mx)
+    return ce, target
+
+
+@partial(jax.jit, static_argnames=("from_logits",))
+def _ne_update_jit(
+    input: jax.Array,
+    target: jax.Array,
+    weight: Optional[jax.Array],
+    from_logits: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    ce, target = _ne_ce_rows(input, target, from_logits)
     w = jnp.ones_like(target) if weight is None else weight.astype(jnp.float32)
     cross_entropy = jnp.sum(w * ce, axis=-1)
     num_examples = jnp.sum(w, axis=-1)

@@ -774,6 +774,17 @@ class Metric(Generic[TComputeReturn], ABC):
             )
         merged: Dict[str, jax.Array] = {}
         for name, info in self._sharded_states.items():
+            names = self._routed_states.get(name)
+            if names is not None and names.is_value_lane:
+                # FLOAT-value lane: fold per-carrier contributions S_q
+                # in carried-rank order — each S_q is the carrier's
+                # shard slice plus its per-batch outbox folds, so the
+                # addition order equals the replicated oracle's exactly
+                # (see shardspec.RoutedInfo)
+                merged[name] = self._merge_value_routed_state(
+                    name, info, names, carriers
+                )
+                continue
             logical = jnp.zeros(info.logical_shape, info.dtype)
             for c in carriers:
                 value = self._place_state(getattr(c, name))
@@ -788,7 +799,6 @@ class Metric(Generic[TComputeReturn], ABC):
                     info.logical_shape[0], rk, wd
                 )
                 logical = logical.at[start:stop].add(value)
-            names = self._routed_states.get(name)
             if names is not None:
                 flat = logical.reshape(-1)
                 for c in carriers:
@@ -817,10 +827,55 @@ class Metric(Generic[TComputeReturn], ABC):
         self._shard_world = 0
         return self
 
+    def _merge_value_routed_state(
+        self, name: str, info, names, carriers
+    ) -> jax.Array:
+        """One float-value-routed state's reassembling merge (see
+        :meth:`_merge_sharded`): ``sum_q S_q`` in carried-rank order,
+        ``S_q`` = carrier q's shard slice placed into a fresh logical
+        array plus its outbox folded one batch at a time."""
+        import numpy as np
+
+        from torcheval_tpu.metrics import shardspec
+
+        col = names.states.index(name)
+        logical = jnp.zeros(info.logical_shape, info.dtype)
+        for c in carriers:
+            value = self._place_state(getattr(c, name))
+            rk = int(getattr(c, "_shard_rank", -1))
+            wd = int(getattr(c, "_shard_world", 0))
+            if rk < 0 or wd <= 0:
+                contrib = value
+            else:
+                start, stop = self._shard_ctx.shard_range(
+                    info.logical_shape[0], rk, wd
+                )
+                contrib = (
+                    jnp.zeros(info.logical_shape, info.dtype)
+                    .at[start:stop]
+                    .set(value)
+                )
+                cnt = int(getattr(c, names.obh, 0))
+                if cnt:
+                    nb = int(getattr(c, names.obbh, 0))
+                    bounds = shardspec.complete_bounds(
+                        np.asarray(getattr(c, names.obb)[:nb]), cnt
+                    )
+                    contrib = shardspec.apply_outbox_values(
+                        contrib.reshape(-1),
+                        self._place_state(getattr(c, names.obi))[:cnt],
+                        self._place_state(getattr(c, names.obv))[:cnt, col],
+                        bounds,
+                    ).reshape(info.logical_shape)
+            logical = logical + contrib
+        return logical
+
     def _routed_aux_names(self) -> set:
         out = set()
         for names in self._routed_states.values():
             out.update((names.obi, names.obn, names.obh))
+            if names.is_value_lane:
+                out.update((names.obv, names.obb, names.obc, names.obbh))
         return out
 
     def _clear_outboxes(self) -> None:
@@ -832,6 +887,19 @@ class Metric(Generic[TComputeReturn], ABC):
                 self._place_state(jnp.zeros((), jnp.int32)),
             )
             setattr(self, names.obh, 0)
+            if names.is_value_lane:
+                setattr(
+                    self,
+                    names.obv,
+                    jnp.zeros((0, len(names.states))),
+                )
+                setattr(self, names.obb, jnp.zeros((0,), jnp.int32))
+                setattr(
+                    self,
+                    names.obc,
+                    self._place_state(jnp.zeros((), jnp.int32)),
+                )
+                setattr(self, names.obbh, 0)
 
     def _logical_state(self, name: str) -> jax.Array:
         """The logically-full view of one state.
@@ -860,7 +928,23 @@ class Metric(Generic[TComputeReturn], ABC):
             jnp.zeros(info.logical_shape, info.dtype).at[start:stop].set(value)
         )
         names = self._routed_states.get(name)
-        if names is not None:
+        if names is not None and names.is_value_lane:
+            import numpy as np
+
+            cnt = int(getattr(self, names.obh, 0))
+            if cnt:
+                nb = int(getattr(self, names.obbh, 0))
+                bounds = shardspec.complete_bounds(
+                    np.asarray(getattr(self, names.obb)[:nb]), cnt
+                )
+                col = names.states.index(name)
+                logical = shardspec.apply_outbox_values(
+                    logical.reshape(-1),
+                    getattr(self, names.obi)[:cnt],
+                    getattr(self, names.obv)[:cnt, col],
+                    bounds,
+                ).reshape(info.logical_shape)
+        elif names is not None:
             cnt = int(getattr(self, names.obh, 0))
             logical = shardspec.apply_outbox_counts(
                 logical.reshape(-1), getattr(self, names.obi)[:cnt]
@@ -933,6 +1017,13 @@ class Metric(Generic[TComputeReturn], ABC):
             state_dict.setdefault(names.obi, jnp.zeros((0,), jnp.int32))
             state_dict.setdefault(names.obn, jnp.zeros((), jnp.int32))
             state_dict.setdefault(names.obh, 0)
+            if names.is_value_lane:
+                state_dict.setdefault(
+                    names.obv, jnp.zeros((0, len(names.states)))
+                )
+                state_dict.setdefault(names.obb, jnp.zeros((0,), jnp.int32))
+                state_dict.setdefault(names.obc, jnp.zeros((), jnp.int32))
+                state_dict.setdefault(names.obbh, 0)
         rk = state_dict.get("_shard_rank")
         logical = rk is not None and int(np.asarray(rk)) < 0
         if rk is None:
@@ -964,6 +1055,11 @@ class Metric(Generic[TComputeReturn], ABC):
             state_dict[names.obi] = jnp.zeros((0,), jnp.int32)
             state_dict[names.obn] = jnp.zeros((), jnp.int32)
             state_dict[names.obh] = 0
+            if names.is_value_lane:
+                state_dict[names.obv] = jnp.zeros((0, len(names.states)))
+                state_dict[names.obb] = jnp.zeros((0,), jnp.int32)
+                state_dict[names.obc] = jnp.zeros((), jnp.int32)
+                state_dict[names.obbh] = 0
         return state_dict
 
     # ------------------------------------------------------------------ reset
@@ -1034,6 +1130,16 @@ class Metric(Generic[TComputeReturn], ABC):
                 buf = sd.get(names.obi)
                 if _is_array(buf) and buf.shape[0] > keep:
                     sd[names.obi] = buf[:keep]
+                if not names.is_value_lane:
+                    continue
+                vbuf = sd.get(names.obv)
+                if _is_array(vbuf) and vbuf.shape[0] > keep:
+                    sd[names.obv] = vbuf[:keep]
+                nb = int(getattr(self, names.obbh, 0))
+                bkeep = 1 << (nb - 1).bit_length() if nb > 0 else 0
+                bbuf = sd.get(names.obb)
+                if _is_array(bbuf) and bbuf.shape[0] > bkeep:
+                    sd[names.obb] = bbuf[:bkeep]
         return sd
 
     def load_state_dict(

@@ -3,11 +3,27 @@
 Parity: reference torcheval/metrics/ranking/weighted_calibration.py:20-123.
 Per-task counters (float32 on TPU; reference uses float64, see
 click_through_rate.py note).
+
+Beyond parity (ISSUE 12 satellite — the PR 9 "remaining" float lane):
+
+- a ROW update form ``update(input, target, weight, task_ids=...)`` for
+  serving streams that arrive as per-event ``(task, pred, label,
+  weight)`` rows — one fused segment-sum scatter per batch;
+- sharding over the TASK axis (``WeightedCalibration(num_tasks=T,
+  shard=ShardContext(rank, world))``): each rank persists ``T/world``
+  task rows. Row updates route through the float-payload outbox lane
+  (``shardspec.enable_value_routing``) — owned task rows scatter into
+  the local shard, foreign rows ship ``(task, w*x, w*t)`` outbox
+  entries whose per-batch boundaries make the reassembling merge
+  bit-identical to the replicated oracle (float addition order
+  preserved). Dense (full-``(T, B)``) updates on a sharded instance
+  follow the windowed family's owner-partitioned contract instead:
+  every rank must observe the same stream; each persists its rows.
 """
 
 from __future__ import annotations
 
-from typing import Optional, TypeVar, Union
+from typing import Any, Dict, Optional, TypeVar, Union
 
 import jax
 import jax.numpy as jnp
@@ -17,14 +33,84 @@ from torcheval_tpu.metrics.functional.ranking.weighted_calibration import (
     _wc_update_tensor,
     _weighted_calibration_input_check,
 )
-from torcheval_tpu.metrics.metric import MergeKind, Metric
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
+from torcheval_tpu.metrics.shardspec import (
+    ShardContext,
+    ShardSpec,
+    enable_value_routing,
+    route_scatter_values_kernel,
+    route_scatter_values_kernel_masked,
+)
 from torcheval_tpu.utils.convert import resolve_weight
 
 TWeightedCalibration = TypeVar("TWeightedCalibration", bound="WeightedCalibration")
 
 
+def _wc_route_rows(input, target, weight, task_ids):
+    """Row stream -> (flat task indices, (w*x, w*t) payloads) — the
+    ``row_fn`` of the float-value outbox lane."""
+    w = jnp.broadcast_to(
+        jnp.asarray(weight).astype(jnp.float32), jnp.shape(input)
+    )
+    return (
+        jnp.asarray(task_ids).astype(jnp.int32),
+        (w * input.astype(jnp.float32), w * target.astype(jnp.float32)),
+    )
+
+
+def _wc_scatter_rows(input, target, weight, task_ids, num_tasks):
+    """Dense per-task deltas from a row stream (replicated / logical
+    instances): one segment-sum per counter, ids outside the task range
+    dropped."""
+    from torcheval_tpu.ops import segment
+
+    idx, (wi, wt) = _wc_route_rows(input, target, weight, task_ids)
+    ids = segment.safe_ids(idx, num_tasks)
+    return (
+        segment.segment_sum(wi, ids, num_tasks),
+        segment.segment_sum(wt, ids, num_tasks),
+    )
+
+
+def _wc_scatter_rows_masked(input, target, weight, task_ids, valid, num_tasks):
+    """Shape-bucketing twin of ``_wc_scatter_rows``: padded rows are
+    forced to the ``-1`` drop id, so they contribute exactly zero."""
+    from torcheval_tpu.ops import segment
+
+    idx, (wi, wt) = _wc_route_rows(input, target, weight, task_ids)
+    row_ok = jnp.arange(idx.shape[0], dtype=jnp.int32) < valid[0]
+    ids = segment.safe_ids(jnp.where(row_ok, idx, -1), num_tasks)
+    return (
+        segment.segment_sum(wi, ids, num_tasks),
+        segment.segment_sum(wt, ids, num_tasks),
+    )
+
+
+# stable owner-partitioned (row-sliced) twins of the dense kernels for
+# sharded instances fed full-(T, B) updates — cache keyed like
+# window._base._window_transform so the _fuse jit caches hit
+_SLICED_KERNEL_CACHE: Dict[Any, Any] = {}
+
+
+def _sliced_kernel(kernel, start: int, stop: int):
+    key = (kernel, int(start), int(stop))
+    fn = _SLICED_KERNEL_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def sliced(*args):
+        deltas = kernel(*args)
+        return tuple(
+            d if jnp.ndim(d) == 0 else d[start:stop] for d in deltas
+        )
+
+    _SLICED_KERNEL_CACHE[key] = sliced
+    return sliced
+
+
 class WeightedCalibration(Metric[jax.Array]):
-    """sum(weight * input) / sum(weight * target), optionally multi-task.
+    """sum(weight * input) / sum(weight * target), optionally multi-task
+    (and optionally sharded over tasks — see the module docstring).
 
     Examples::
 
@@ -37,40 +123,152 @@ class WeightedCalibration(Metric[jax.Array]):
         Array([1.2], dtype=float32)
     """
 
+    # the row/scatter plans carry masked twins: host inputs stay
+    # host-side until padded to their bucket (the PR 1 input boundary)
+    _bucketed_update = True
+
     def __init__(
-        self, *, num_tasks: int = 1, device: Optional[jax.Device] = None
+        self,
+        *,
+        num_tasks: int = 1,
+        device: Optional[jax.Device] = None,
+        shard: Optional[ShardContext] = None,
     ) -> None:
-        super().__init__(device=device)
+        super().__init__(device=device, shard=shard)
         if num_tasks < 1:
             raise ValueError(
                 "`num_tasks` value should be greater than and equal to 1, "
                 f"but received {num_tasks}. "
             )
         self.num_tasks = num_tasks
+        spec = ShardSpec(axis=0) if shard is not None else None
         self._add_state(
-            "weighted_input_sum", jnp.zeros(num_tasks), merge=MergeKind.SUM
+            "weighted_input_sum",
+            jnp.zeros(num_tasks),
+            merge=MergeKind.SUM,
+            shard=spec,
         )
         self._add_state(
-            "weighted_target_sum", jnp.zeros(num_tasks), merge=MergeKind.SUM
+            "weighted_target_sum",
+            jnp.zeros(num_tasks),
+            merge=MergeKind.SUM,
+            shard=spec,
         )
+        if self._sharded_states:
+            enable_value_routing(
+                self, ("weighted_input_sum", "weighted_target_sum")
+            )
 
     def _update_plan(
         self: TWeightedCalibration,
         input,
         target,
         weight: Union[float, int, jax.Array] = 1.0,
+        *,
+        task_ids=None,
     ):
         input = self._input_float(input)
         target = self._input_float(target)
         if not isinstance(weight, (float, int)):
             weight = self._input_float(weight)
+        if task_ids is not None:
+            return self._rows_plan(input, target, weight, task_ids)
         _weighted_calibration_input_check(input, target, weight, self.num_tasks)
         is_scalar, weight_arr = resolve_weight(weight, input)
+        kernel = _wc_update_scalar if is_scalar else _wc_update_tensor
+        if self._sharded_states and self._own_shard_active():
+            # dense update on a sharded instance: owner-partitioned
+            # (every rank sees the same stream; each persists its rows)
+            start, stop = self._shard_ctx.shard_range(self.num_tasks)
+            kernel = _sliced_kernel(kernel, start, stop)
         # one fused dispatch: kernel + the two counter adds
         return (
-            _wc_update_scalar if is_scalar else _wc_update_tensor,
+            kernel,
             ("weighted_input_sum", "weighted_target_sum"),
             (input, target, weight_arr),
+        )
+
+    def _rows_plan(self, input, target, weight, task_ids):
+        """The per-event ROW form: ``input``/``target``/``task_ids`` are
+        row-aligned vectors (scalar or per-row ``weight``)."""
+        import numpy as np
+
+        task_ids = self._input(task_ids)
+        if np.ndim(input) != 1 or np.shape(input) != np.shape(target):
+            raise ValueError(
+                "row updates (task_ids=...) expect one-dimensional "
+                f"`input`/`target` of equal length, got shapes "
+                f"{np.shape(input)} and {np.shape(target)}"
+            )
+        if np.shape(task_ids) != np.shape(input):
+            raise ValueError(
+                f"`task_ids` shape ({np.shape(task_ids)}) must match "
+                f"`input` shape ({np.shape(input)})"
+            )
+        if isinstance(weight, (float, int)):
+            from torcheval_tpu.utils.convert import cached_scalar
+
+            is_scalar, weight_arr = True, cached_scalar(float(weight))
+        else:
+            # `weight` already passed _input_float, which keeps host
+            # arrays HOST-side under bucketing (resolve_weight would
+            # device-put it and re-open the per-shape pad retrace)
+            is_scalar, weight_arr = False, weight
+        if not is_scalar and np.shape(weight_arr) != np.shape(input):
+            raise ValueError(
+                "Weight must be either a float value or a tensor that "
+                f"matches the input tensor size. Got {weight} instead."
+            )
+        axes = (
+            ("n",),
+            ("n",),
+            ("n",) if not is_scalar else (),
+            ("n",),
+        )
+        if self._route_active("weighted_input_sum"):
+            from torcheval_tpu.metrics import shardspec
+
+            names = self._routed_states["weighted_input_sum"]
+            n = int(np.shape(input)[0])
+            shardspec.ensure_outbox_capacity(
+                self, "weighted_input_sum", n
+            )
+            start, stop = self._shard_ctx.shard_range(self.num_tasks)
+            obh, obbh = int(getattr(self, names.obh)), int(
+                getattr(self, names.obbh)
+            )
+
+            def finalize() -> None:
+                setattr(self, names.obh, obh + n)
+                setattr(self, names.obbh, obbh + 1)
+
+            return UpdatePlan(
+                route_scatter_values_kernel(_wc_route_rows, start, stop, 2),
+                (
+                    "weighted_input_sum",
+                    "weighted_target_sum",
+                    names.obi,
+                    names.obv,
+                    names.obn,
+                    names.obb,
+                    names.obc,
+                ),
+                (input, target, weight_arr, task_ids),
+                (),
+                transform=True,
+                finalize=finalize,
+                masked_kernel=route_scatter_values_kernel_masked(
+                    _wc_route_rows, start, stop, 2
+                ),
+                batch_axes=axes,
+            )
+        return UpdatePlan(
+            _wc_scatter_rows,
+            ("weighted_input_sum", "weighted_target_sum"),
+            (input, target, weight_arr, task_ids),
+            (self.num_tasks,),
+            masked_kernel=_wc_scatter_rows_masked,
+            batch_axes=axes,
         )
 
     def update(
@@ -78,13 +276,21 @@ class WeightedCalibration(Metric[jax.Array]):
         input,
         target,
         weight: Union[float, int, jax.Array] = 1.0,
+        *,
+        task_ids=None,
     ) -> TWeightedCalibration:
-        """Accumulate one batch of predictions / binary targets / weights."""
-        return self._apply_update_plan(self._update_plan(input, target, weight))
+        """Accumulate one batch of predictions / binary targets / weights
+        (optionally as per-event rows via ``task_ids=``)."""
+        return self._apply_update_plan(
+            self._update_plan(input, target, weight, task_ids=task_ids)
+        )
 
     def compute(self) -> jax.Array:
-        """Calibration per task; empty array if any task has zero target sum
-        (reference weighted_calibration.py:104-105)."""
-        if bool(jnp.any(self.weighted_target_sum == 0.0)):
+        """Calibration per task; empty array if any task has zero target
+        sum (reference weighted_calibration.py:104-105). A sharded
+        carrier computes over its LOCAL logical view (own rows + own
+        outbox) — sync first for the global value."""
+        target_sum = self._logical_state("weighted_target_sum")
+        if bool(jnp.any(target_sum == 0.0)):
             return jnp.zeros(0)
-        return self.weighted_input_sum / self.weighted_target_sum
+        return self._logical_state("weighted_input_sum") / target_sum

@@ -57,8 +57,11 @@ __all__ = [
     "ShardSpec",
     "ShardInfo",
     "enable_routing",
+    "enable_value_routing",
     "route_scatter_kernel",
     "route_scatter_kernel_masked",
+    "route_scatter_values_kernel",
+    "route_scatter_values_kernel_masked",
 ]
 
 _OUTBOX_MIN_CAPACITY = 64
@@ -217,7 +220,7 @@ class ShardContext:
 
 
 class RoutedInfo(NamedTuple):
-    """Outbox bookkeeping for one routed (scatter-counter) state.
+    """Outbox bookkeeping for one routed (scatter) state.
 
     ``obi`` — int32 device buffer of foreign FLAT indices (``-1`` =
     dropped slot: an owned entry, or an out-of-range index);
@@ -225,17 +228,52 @@ class RoutedInfo(NamedTuple):
     the steady-state update uploads nothing);
     ``obh`` — host int mirror of the cursor (advanced by the plan's
     ``finalize``), used for capacity growth and payload trimming.
+
+    The FLOAT-VALUE lane (``enable_value_routing`` — weighted states
+    whose routed contributions are f32 payloads, not occurrence counts)
+    adds: ``obv`` — f32 ``(capacity, len(states))`` payload buffer, one
+    column per member state; ``obb``/``obc``/``obbh`` — an int32
+    batch-boundary buffer with its device/host cursors. Boundaries are
+    what make float routing EXACT: the merge folds each batch's entries
+    as one segment-sum and adds batch sums in stream order, reproducing
+    the replicated metric's per-update addition order bit-for-bit
+    (integer counters never needed this — integer adds reassociate
+    freely). ``states`` names the member group sharing this outbox.
     """
 
     state: str
     obi: str
     obn: str
     obh: str
+    obv: Optional[str] = None
+    obb: Optional[str] = None
+    obc: Optional[str] = None
+    obbh: Optional[str] = None
+    states: Tuple[str, ...] = ()
+
+    @property
+    def is_value_lane(self) -> bool:
+        return self.obv is not None
 
 
 def routed_names(state: str) -> RoutedInfo:
     return RoutedInfo(
         state, f"{state}__obi", f"{state}__obn", f"{state}__obh"
+    )
+
+
+def value_routed_names(states: Tuple[str, ...]) -> RoutedInfo:
+    primary = states[0]
+    return RoutedInfo(
+        primary,
+        f"{primary}__obi",
+        f"{primary}__obn",
+        f"{primary}__obh",
+        obv=f"{primary}__obv",
+        obb=f"{primary}__obb",
+        obc=f"{primary}__obc",
+        obbh=f"{primary}__obbh",
+        states=tuple(states),
     )
 
 
@@ -273,6 +311,50 @@ def enable_routing(metric, state: str) -> Optional[RoutedInfo]:
     return names
 
 
+def enable_value_routing(metric, states) -> Optional[RoutedInfo]:
+    """Register a shared FLOAT-payload outbox for a group of sharded
+    states fed by the same row stream (PR 9 "remaining" item: routing
+    was int32-counts-only). Call right after the members'
+    ``_add_state(..., shard=ShardSpec(...))`` registrations. No-op
+    (returns ``None``) unless the metric has an EAGER shard context.
+
+    Exactness contract (stronger than the counter lane's): member
+    states are f32 accumulators, the outbox carries each foreign row's
+    f32 payload per member, and the per-batch boundary buffer lets the
+    merge reproduce the replicated oracle's addition order exactly —
+    see :class:`RoutedInfo`.
+    """
+    from torcheval_tpu.metrics.metric import MergeKind
+
+    states = tuple(states)
+    ctx = metric._shard_ctx
+    if ctx is None or ctx.is_mesh or not all(
+        s in metric._sharded_states for s in states
+    ):
+        return None
+    info = metric._sharded_states[states[0]]
+    if info.logical_size >= 2**31:
+        raise ValueError(
+            f"routed state {states[0]!r} has {info.logical_size} logical "
+            "cells; flat routing indices must fit int32"
+        )
+    names = value_routed_names(states)
+    # 0-size sentinels like the counter lane (world 1 registers too, so
+    # its snapshots interchange with multi-world payloads)
+    metric._add_state(names.obi, jnp.zeros((0,), jnp.int32), merge=MergeKind.CUSTOM)
+    metric._add_state(
+        names.obv, jnp.zeros((0, len(states))), merge=MergeKind.CUSTOM
+    )
+    metric._add_state(names.obn, jnp.zeros((), jnp.int32), merge=MergeKind.CUSTOM)
+    metric._add_state(names.obh, 0, merge=MergeKind.CUSTOM)
+    metric._add_state(names.obb, jnp.zeros((0,), jnp.int32), merge=MergeKind.CUSTOM)
+    metric._add_state(names.obc, jnp.zeros((), jnp.int32), merge=MergeKind.CUSTOM)
+    metric._add_state(names.obbh, 0, merge=MergeKind.CUSTOM)
+    for s in states:
+        metric._routed_states[s] = names
+    return names
+
+
 def _outbox_capacity(n: int) -> int:
     if n <= _OUTBOX_MIN_CAPACITY:
         return _OUTBOX_MIN_CAPACITY
@@ -300,14 +382,35 @@ def ensure_outbox_capacity(metric, state: str, n_new: int) -> None:
         width = bucket_length(width)
     needed = getattr(metric, names.obh) + width
     cap = buf.shape[0]
-    if needed <= cap:
-        return
-    new_cap = _outbox_capacity(needed)
-    setattr(
-        metric,
-        names.obi,
-        jnp.pad(buf, (0, new_cap - cap), constant_values=-1),
-    )
+    if needed > cap:
+        new_cap = _outbox_capacity(needed)
+        setattr(
+            metric,
+            names.obi,
+            jnp.pad(buf, (0, new_cap - cap), constant_values=-1),
+        )
+        if names.is_value_lane:
+            setattr(
+                metric,
+                names.obv,
+                jnp.pad(
+                    getattr(metric, names.obv),
+                    ((0, new_cap - cap), (0, 0)),
+                ),
+            )
+    if names.is_value_lane:
+        # one boundary slot per update batch (the host mirror advances
+        # by exactly one per finalize, so capacity is host-exact)
+        bbuf = getattr(metric, names.obb)
+        bneeded = getattr(metric, names.obbh) + 1
+        bcap = bbuf.shape[0]
+        if bneeded > bcap:
+            new_bcap = _outbox_capacity(bneeded)
+            setattr(
+                metric,
+                names.obb,
+                jnp.pad(bbuf, (0, new_bcap - bcap), constant_values=-1),
+            )
 
 
 # cached per (index_fn, start, stop, cfg): a STABLE kernel object per
@@ -424,3 +527,154 @@ def apply_outbox_counts(
         segment.safe_ids(entries, size), size
     )
     return logical_flat + counts.astype(logical_flat.dtype)
+
+
+def route_scatter_values_kernel(
+    row_fn, start: int, stop: int, n_states: int, cfg: Tuple = ()
+):
+    """Float-lane twin of :func:`route_scatter_kernel` for a group of
+    ``n_states`` weighted states sharing one row stream.
+
+    ``row_fn(*dynamic, *cfg) -> (idx, (v_0, ..., v_{n_states-1}))``
+    maps one batch to flat cell indices plus one f32 payload per member
+    state. The transform takes ``states = (shard_0, ..,
+    shard_{n-1}, outbox_idx, outbox_vals, cursor, bounds, bcursor)``
+    and, in ONE device program: segment-sums each member's owned
+    payloads into its local shard, appends foreign ``(idx, payloads)``
+    rows at the cursor (``-1``/zero rows for owned entries — trimmed by
+    ``_sync_state_dict``), and records the post-batch cursor as a batch
+    boundary (the merge's exact-fold marker).
+    """
+    key = (row_fn, int(start), int(stop), int(n_states), cfg, "values")
+    fn = _ROUTE_KERNEL_CACHE.get(key)
+    if fn is not None:
+        return fn
+    from torcheval_tpu.ops import segment
+
+    n_local = int(stop) - int(start)
+
+    def transform(states, *dynamic):
+        shards = states[:n_states]
+        obi, obv, obn, obb, obc = states[n_states:]
+        idx, vals = row_fn(*dynamic, *cfg)
+        idx = jnp.asarray(idx)
+        owned = (idx >= start) & (idx < stop)
+        local = jnp.where(owned, idx - start, n_local).astype(jnp.int32)
+        new_shards = tuple(
+            (
+                sh.reshape(-1)
+                + segment.segment_sum(
+                    v.astype(jnp.float32), local, n_local + 1
+                )[:n_local].astype(sh.dtype)
+            ).reshape(sh.shape)
+            for sh, v in zip(shards, vals)
+        )
+        foreign = jnp.where(owned, -1, idx).astype(jnp.int32)
+        stacked = jnp.where(
+            (foreign >= 0)[:, None],
+            jnp.stack([v.astype(jnp.float32) for v in vals], axis=-1),
+            0.0,
+        )
+        new_obi = lax.dynamic_update_slice(obi, foreign, (obn,))
+        new_obv = lax.dynamic_update_slice(obv, stacked, (obn, 0))
+        new_obn = obn + jnp.int32(idx.shape[0])
+        new_obb = lax.dynamic_update_slice(obb, new_obn[None], (obc,))
+        return new_shards + (new_obi, new_obv, new_obn, new_obb, obc + 1)
+
+    _ROUTE_KERNEL_CACHE[key] = transform
+    return transform
+
+
+def route_scatter_values_kernel_masked(
+    row_fn, start: int, stop: int, n_states: int, cfg: Tuple = ()
+):
+    """Mask-aware twin of :func:`route_scatter_values_kernel` for shape
+    bucketing: padded rows (position >= ``valid[0]``) are forced to the
+    ``-1`` drop sentinel with zero payloads, the outbox WRITE is the
+    padded width (static shape), and the cursor/boundary advance by the
+    VALID count only — the padded tail is scratch the next append
+    overwrites (the ``route_scatter_kernel_masked`` discipline)."""
+    key = (row_fn, int(start), int(stop), int(n_states), cfg, "values-masked")
+    fn = _ROUTE_KERNEL_CACHE.get(key)
+    if fn is not None:
+        return fn
+    from torcheval_tpu.ops import segment
+
+    n_local = int(stop) - int(start)
+
+    def transform(states, *dynamic_and_valid):
+        dynamic, valid = dynamic_and_valid[:-1], dynamic_and_valid[-1]
+        shards = states[:n_states]
+        obi, obv, obn, obb, obc = states[n_states:]
+        idx, vals = row_fn(*dynamic, *cfg)
+        idx = jnp.asarray(idx)
+        row_ok = jnp.arange(idx.shape[0], dtype=jnp.int32) < valid[0]
+        idx = jnp.where(row_ok, idx, -1)
+        owned = (idx >= start) & (idx < stop)
+        local = jnp.where(owned, idx - start, n_local).astype(jnp.int32)
+        new_shards = tuple(
+            (
+                sh.reshape(-1)
+                + segment.segment_sum(
+                    v.astype(jnp.float32), local, n_local + 1
+                )[:n_local].astype(sh.dtype)
+            ).reshape(sh.shape)
+            for sh, v in zip(shards, vals)
+        )
+        foreign = jnp.where(owned, -1, idx).astype(jnp.int32)
+        stacked = jnp.where(
+            (foreign >= 0)[:, None],
+            jnp.stack([v.astype(jnp.float32) for v in vals], axis=-1),
+            0.0,
+        )
+        new_obi = lax.dynamic_update_slice(obi, foreign, (obn,))
+        new_obv = lax.dynamic_update_slice(obv, stacked, (obn, 0))
+        new_obn = obn + valid[0]
+        new_obb = lax.dynamic_update_slice(obb, new_obn[None], (obc,))
+        return new_shards + (new_obi, new_obv, new_obn, new_obb, obc + 1)
+
+    _ROUTE_KERNEL_CACHE[key] = transform
+    return transform
+
+
+def complete_bounds(bounds, cnt: int):
+    """Normalize a recorded batch-boundary list so it COVERS ``cnt``
+    outbox entries: entries past the last recorded boundary (a snapshot
+    taken mid-discipline, a legacy payload) fold as one final batch.
+    The single home of this exactness-critical rule — the per-batch fold
+    (:func:`apply_outbox_values`) reproduces the replicated oracle's
+    float addition order only if every fold site completes bounds the
+    same way."""
+    out = [int(b) for b in bounds if int(b) <= int(cnt)]
+    if not out or out[-1] != int(cnt):
+        out.append(int(cnt))
+    return out
+
+
+def apply_outbox_values(
+    logical_flat: jax.Array,
+    entries: jax.Array,
+    values: jax.Array,
+    bounds,
+) -> jax.Array:
+    """Fold one rank's float-lane outbox into a flat logical state, ONE
+    BATCH AT A TIME in stream order: each batch slice contributes a
+    single segment-sum (the replicated metric's per-update delta), and
+    batch sums add sequentially — reproducing the oracle's float
+    addition order exactly. ``bounds`` are the recorded post-batch
+    cursor values; ``values`` is the matching payload column."""
+    from torcheval_tpu.ops import segment
+
+    size = logical_flat.shape[0]
+    out = logical_flat
+    start = 0
+    for stop in bounds:
+        stop = int(stop)
+        if stop <= start:
+            continue
+        ids = segment.safe_ids(entries[start:stop], size)
+        out = out + segment.segment_sum(
+            values[start:stop].astype(jnp.float32), ids, size
+        ).astype(out.dtype)
+        start = stop
+    return out

@@ -433,13 +433,26 @@ def get_synced_state_dict_collection(
     }
 
 
+def _adoptable(m: Metric) -> bool:
+    """Metrics whose merged state may be loaded back without
+    double-counting at the next sync: axis-sharded states (disjoint
+    shards re-slice) and hash-partitioned tables (disjoint key sets
+    re-slice). Replicated metrics are NOT adoptable — every rank would
+    hold the already-global totals and the next SUM sync would multiply
+    them by the world size."""
+    return bool(getattr(m, "_sharded_states", None)) or bool(
+        getattr(m, "_hash_partitioned", False)
+    )
+
+
 def adopt_synced(
-    metric: MetricOrReplicas,
+    metric: Union[MetricOrReplicas, Dict[str, Metric]],
     process_group: Optional[ProcessGroup] = None,
     on_failure: Optional[str] = None,
-) -> Metric:
+) -> Union[Metric, Dict[str, Metric]]:
     """Sync, then load the merged state back into the working metric —
-    the steady-state drain point for SHARDED metrics.
+    the steady-state drain point for SHARDED metrics and keyed
+    METRIC TABLES (``torcheval_tpu.table.MetricTable``).
 
     An eager-sharded metric's routed outbox accumulates foreign
     contributions between syncs (O(batch x steps) entries). A plain
@@ -449,31 +462,68 @@ def adopt_synced(
     shard and the outbox empties — per-rank bytes return to
     ``size/world + one-batch outbox``. Returns the synced (logical)
     metric so the caller can also ``compute()`` it without a second
-    exchange.
+    exchange. A metric table's adopt additionally runs its drain-time
+    finalization (windowed-epoch commit, TTL/occupancy eviction) on the
+    merged state via the ``_pre_adopt_commit`` hook, so those decisions
+    are identical on every rank.
 
-    SHARDED metrics only: the sharded adopt re-slices every rank to
-    DISJOINT shards, so later syncs stay exact. Loading the merged
-    state back into REPLICATED metrics would leave every rank holding
-    the already-global totals — the next SUM sync would multiply them
-    by the world size — so replicated metrics are rejected rather than
+    Accepts a single metric, a replica list, or a ``{name: Metric}``
+    collection (drained in ONE batched exchange). SHARDED / table
+    metrics only: the adopt re-slices every rank to DISJOINT shards (or
+    key sets), so later syncs stay exact. Loading the merged state back
+    into REPLICATED metrics would leave every rank holding the
+    already-global totals — the next SUM sync would multiply them by
+    the world size — so replicated members are rejected rather than
     silently double-counted.
     """
+    if isinstance(metric, dict):
+        for name, m in metric.items():
+            if not _adoptable(m):
+                raise TypeError(
+                    f"adopt_synced requires sharded or table metrics; "
+                    f"collection member {name!r} ({type(m).__name__}) is "
+                    "replicated — adopting the merged state would "
+                    "double-count it at the next sync (use "
+                    "sync_and_compute / get_synced_metric instead)"
+                )
+        synced_coll = get_synced_metric_collection(
+            metric, process_group, on_failure=on_failure
+        )
+        for name, synced in synced_coll.items():
+            commit = getattr(synced, "_pre_adopt_commit", None)
+            if commit is not None:
+                commit()
+            # read the provenance BEFORE loading: on the world-1 fast
+            # path `synced` IS the working metric, and load_state_dict
+            # drops the stale-provenance attribute
+            provenance = synced.sync_provenance
+            metric[name].load_state_dict(synced.state_dict())
+            metric[name].sync_provenance = provenance
+        return synced_coll
     targets = (
         metric if isinstance(metric, (list, tuple)) else [metric]
     )
     for m in targets:
-        if not getattr(m, "_sharded_states", None):
+        if not _adoptable(m):
             raise TypeError(
-                f"adopt_synced requires sharded metrics; "
+                f"adopt_synced requires sharded or table metrics; "
                 f"{type(m).__name__} is replicated — adopting the merged "
                 "state would double-count it at the next sync (use "
                 "sync_and_compute / get_synced_metric instead)"
             )
     synced = get_synced_metric(metric, process_group, on_failure=on_failure)
+    commit = getattr(synced, "_pre_adopt_commit", None)
+    if commit is not None:
+        # table drain finalization (windowed-epoch commit + eviction) on
+        # the MERGED state — deterministic, identical on every rank
+        commit()
     payload = synced.state_dict()
+    # read before loading: on the world-1 fast path `synced` IS the
+    # working metric, and load_state_dict drops the stale provenance
+    provenance = synced.sync_provenance
     for m in targets:
         m.load_state_dict(payload)
-        m.sync_provenance = synced.sync_provenance
+        m.sync_provenance = provenance
     return synced
 
 

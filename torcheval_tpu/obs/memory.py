@@ -114,11 +114,17 @@ def per_rank_state_bytes(metric) -> Dict[str, int]:
 def logical_state_bytes(metric) -> Dict[str, int]:
     """Per-state bytes of the LOGICAL (unsharded) state — what one
     replica would pin. Sharded states report their registered logical
-    shape (``Metric._sharded_states``); everything else equals the live
-    walk. Routed outbox buffers are per-rank overhead and count as-is
-    (the ``small constant`` in the size/world contract)."""
+    shape (``Metric._sharded_states``); hash-partitioned metrics (the
+    keyed ``table.MetricTable``) supply their own accounting via the
+    ``_logical_state_nbytes`` hook (per-key rows x the last-known
+    global key count); everything else equals the live walk. Routed
+    outbox buffers are per-rank overhead and count as-is (the ``small
+    constant`` in the size/world contract)."""
     import numpy as np
 
+    hook = getattr(metric, "_logical_state_nbytes", None)
+    if hook is not None:
+        return dict(hook())
     sharded = getattr(metric, "_sharded_states", None) or {}
     out: Dict[str, int] = {}
     for name in metric._state_name_to_default:

@@ -1,0 +1,15 @@
+"""Keyed multi-tenant metric table (ROADMAP item 3) — see ``table.py``
+for the subsystem docstring and docs/metric-table.md for the guide."""
+
+from torcheval_tpu.table._families import FAMILIES, TableFamily
+from torcheval_tpu.table._hash import hash_keys, owner_of
+from torcheval_tpu.table.table import MetricTable, TableValues
+
+__all__ = [
+    "FAMILIES",
+    "MetricTable",
+    "TableFamily",
+    "TableValues",
+    "hash_keys",
+    "owner_of",
+]

@@ -1,0 +1,281 @@
+"""Per-key metric families of the keyed metric table.
+
+A :class:`TableFamily` adapts one of the library's metric families to the
+table's ROW layout: instead of one metric instance per key (unaffordable
+python overhead at 100k+ keys), the table keeps each family's sufficient
+statistics as **columns** — one f32 accumulator array per field with a
+leading key-slot axis — and the family supplies three pure pieces:
+
+- ``prepare``: host-side validation/coercion of ``ingest``'s per-row
+  arguments (the ``_input`` boundary — under shape bucketing host inputs
+  stay host-side until padded);
+- ``row_kernel``: per-row payload columns, traced INTO the fused ingest
+  program (one f32 value per field per row; the table then segment-sums
+  owned rows into the slot columns and ships foreign rows through the
+  outbox). The per-row arithmetic is shared with the standalone family
+  (same kernels/formulas), which is what makes the per-key oracle pins
+  bit-exact;
+- ``compute``: the vectorized per-key finalization over the columns —
+  elementwise the same expression the standalone metric applies to its
+  scalar counters.
+
+Windowed families additionally declare ``window``: the table keeps a
+per-key ring of the last ``window`` DRAIN EPOCHS (one column per epoch
+with traffic, committed at the drain point — ``MetricTable.adopt`` /
+``toolkit.adopt_synced``), mirroring the
+``window.WindowedTaskCounterMetric`` ring discipline at per-key grain.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TableFamily", "resolve_family", "FAMILIES"]
+
+
+class TableFamily(NamedTuple):
+    """One per-key metric family (see module docstring).
+
+    ``fields`` name the f32 accumulator columns; ``prepare(table, *args,
+    **kwargs)`` returns the per-row dynamic argument tuple (row-aligned
+    with the keys) plus the hashable config tuple; ``row_kernel(*dynamic,
+    *config)`` returns one per-row f32 vector per field;
+    ``compute(cols)`` maps ``{field: values[n]}`` to the per-key result
+    array. ``window > 0`` marks an epoch-windowed family: its fields are
+    the PENDING (current-epoch) accumulators, committed into per-key
+    rings of ``window`` columns at each drain.
+    """
+
+    name: str
+    fields: Tuple[str, ...]
+    prepare: Callable[..., Tuple[Tuple, Tuple]]
+    row_kernel: Callable[..., Tuple[jax.Array, ...]]
+    compute: Callable[[Dict[str, jax.Array]], Any]
+    window: int = 0
+
+
+def _rows_1d(table, name: str, value, *, dtype=None):
+    arr = table._input(value, dtype=dtype)
+    import numpy as np
+
+    if np.ndim(arr) != 1:
+        raise ValueError(
+            f"table family {table.family.name!r}: `{name}` must be a "
+            f"one-dimensional per-row array, got shape {np.shape(arr)}"
+        )
+    return arr
+
+
+def _weight_rows(table, weights, like):
+    """Per-row weights: a scalar broadcasts on device inside the fused
+    kernel (shipped as a cached 0-d array so nothing uploads per call)."""
+    from torcheval_tpu.utils.convert import cached_scalar
+
+    if isinstance(weights, (int, float)):
+        return cached_scalar(float(weights))
+    return _rows_1d(table, "weights", weights)
+
+
+# ------------------------------------------------------------------- ctr
+
+
+def _ctr_rows(clicks, weights):
+    w = jnp.broadcast_to(
+        weights.astype(jnp.float32), clicks.shape
+    )
+    return clicks.astype(jnp.float32) * w, w
+
+
+def _ctr_prepare(table, clicks, weights=1.0):
+    clicks = _rows_1d(table, "clicks", clicks)
+    return (clicks, _weight_rows(table, weights, clicks)), ()
+
+
+def _ctr_compute(cols):
+    # the standalone formula (_click_through_rate_compute): tiny-eps
+    # guard so a keys with zero weight reads 0.0, not NaN
+    eps = jnp.finfo(jnp.float32).tiny
+    return cols["click"] / (cols["weight"] + eps)
+
+
+# ------------------------------------------------------ weighted calibration
+
+
+def _wc_rows(preds, targets, weights):
+    w = jnp.broadcast_to(weights.astype(jnp.float32), preds.shape)
+    return w * preds.astype(jnp.float32), w * targets.astype(jnp.float32)
+
+
+def _wc_prepare(table, preds, targets, weights=1.0):
+    preds = _rows_1d(table, "preds", preds)
+    targets = _rows_1d(table, "targets", targets)
+    import numpy as np
+
+    if np.shape(preds) != np.shape(targets):
+        raise ValueError(
+            f"`preds` shape ({np.shape(preds)}) is different from `targets` "
+            f"shape ({np.shape(targets)})"
+        )
+    return (preds, targets, _weight_rows(table, weights, preds)), ()
+
+
+def _wc_compute(cols):
+    wt = cols["weighted_target"]
+    # per-key calibration; a key with zero target mass reads 0.0 (the
+    # standalone metric returns an EMPTY result there — a per-key table
+    # needs a value per slot, so the degenerate case is pinned to 0)
+    return jnp.where(wt != 0.0, cols["weighted_input"] / wt, 0.0)
+
+
+# -------------------------------------------------------------- hit rate
+
+
+def _hit_rows(scores, targets, k):
+    # the standalone per-example kernel (functional.ranking.hit_rate
+    # _hit_rate_jit), inlined so it traces into the fused ingest program
+    if k is None or k >= scores.shape[-1]:
+        hits = jnp.ones(targets.shape, jnp.float32)
+    else:
+        y = jnp.take_along_axis(
+            scores, targets.astype(jnp.int32)[:, None], axis=-1
+        )
+        rank = jnp.sum(scores > y, axis=-1)
+        hits = (rank < k).astype(jnp.float32)
+    return hits, jnp.ones(targets.shape, jnp.float32)
+
+
+def _hit_prepare(table, scores, targets):
+    import numpy as np
+
+    scores = table._input(scores)
+    targets = _rows_1d(table, "targets", targets)
+    if np.ndim(scores) != 2:
+        raise ValueError(
+            "table family 'hit_rate': `scores` must be "
+            f"(num_rows, num_classes), got shape {np.shape(scores)}"
+        )
+    # the standalone _hit_rate_input_check conditions, on host shapes
+    # (no dummy device arrays on the ingest path)
+    if np.shape(scores)[0] != np.shape(targets)[0]:
+        raise ValueError(
+            "`input` and `target` should have the same minibatch "
+            f"dimension, got shapes {np.shape(scores)} and "
+            f"{np.shape(targets)}, respectively."
+        )
+    return (scores, targets), (table.k,)
+
+
+def _hit_compute(cols):
+    n = cols["count"]
+    return jnp.where(n != 0.0, cols["hit"] / n, 0.0)
+
+
+# ----------------------------------------------------------- windowed NE
+
+
+def _ne_rows(preds, targets, weights, from_logits):
+    from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+        _ne_ce_rows,
+    )
+
+    ce, t = _ne_ce_rows(preds, targets, from_logits)
+    w = jnp.broadcast_to(weights.astype(jnp.float32), t.shape)
+    return w * ce, w, w * t
+
+
+def _ne_prepare(table, preds, targets, weights=1.0):
+    preds = _rows_1d(table, "preds", preds)
+    targets = _rows_1d(table, "targets", targets)
+    import numpy as np
+
+    if np.shape(preds) != np.shape(targets):
+        raise ValueError(
+            f"`preds` shape ({np.shape(preds)}) is different from `targets` "
+            f"shape ({np.shape(targets)})"
+        )
+    return (
+        (preds, targets, _weight_rows(table, weights, preds)),
+        (table.from_logits,),
+    )
+
+
+def _ne_compute(cols):
+    from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+        _baseline_update,
+    )
+
+    ex = cols["num_examples"]
+    safe = jnp.where(ex != 0.0, ex, 1.0)
+    ne = (cols["total_entropy"] / safe) / _baseline_update(
+        cols["num_positive"], safe
+    )
+    return jnp.where(ex != 0.0, ne, 0.0)
+
+
+FAMILIES: Dict[str, TableFamily] = {
+    "ctr": TableFamily(
+        name="ctr",
+        fields=("click", "weight"),
+        prepare=_ctr_prepare,
+        row_kernel=_ctr_rows,
+        compute=_ctr_compute,
+    ),
+    "weighted_calibration": TableFamily(
+        name="weighted_calibration",
+        fields=("weighted_input", "weighted_target"),
+        prepare=_wc_prepare,
+        row_kernel=_wc_rows,
+        compute=_wc_compute,
+    ),
+    "hit_rate": TableFamily(
+        name="hit_rate",
+        fields=("hit", "count"),
+        prepare=_hit_prepare,
+        row_kernel=_hit_rows,
+        compute=_hit_compute,
+    ),
+    "windowed_ne": TableFamily(
+        name="windowed_ne",
+        fields=("total_entropy", "num_examples", "num_positive"),
+        prepare=_ne_prepare,
+        row_kernel=_ne_rows,
+        compute=_ne_compute,
+        window=1,  # placeholder; resolve_family applies the window size
+    ),
+}
+
+
+def resolve_family(family, **kwargs) -> Tuple[TableFamily, Dict[str, Any]]:
+    """``family`` (name or :class:`TableFamily`) plus family kwargs ->
+    the resolved adapter and the attribute dict the table stores (``k``,
+    ``from_logits``, window size...)."""
+    if isinstance(family, TableFamily):
+        fam = family
+    else:
+        fam = FAMILIES.get(str(family))
+        if fam is None:
+            raise ValueError(
+                f"unknown table family {family!r}; available: "
+                f"{sorted(FAMILIES)}"
+            )
+    attrs: Dict[str, Any] = {}
+    if fam.name == "hit_rate":
+        k = kwargs.pop("k", None)
+        if k is not None and int(k) <= 0:
+            raise ValueError(f"k should be None or positive, got {k}.")
+        attrs["k"] = None if k is None else int(k)
+    if fam.name == "windowed_ne":
+        attrs["from_logits"] = bool(kwargs.pop("from_logits", False))
+        window = int(kwargs.pop("window", 16))
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        fam = fam._replace(window=window)
+    if kwargs:
+        raise TypeError(
+            f"unexpected table family arguments for {fam.name!r}: "
+            f"{sorted(kwargs)}"
+        )
+    return fam, attrs

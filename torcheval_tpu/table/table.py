@@ -1,0 +1,1094 @@
+"""Sharded keyed metric table: online per-user/segment eval at serving
+scale (ROADMAP item 3).
+
+Every metric in the library holds ONE state per instance; the north-star
+workload — millions of users — needs a metric *per key* (user, segment,
+model version). :class:`MetricTable` is that keyed collection, built so
+per-rank cost scales as ``keys/world``:
+
+- **Hash partitioning.** Keys hash deterministically
+  (``table._hash.hash_keys``) and ``hash % world`` names the owning rank
+  (an eager :class:`~torcheval_tpu.metrics.shardspec.ShardContext`, so
+  the same declaration object as the PR 9 axis-sharded states). A rank's
+  table holds SLOTS only for keys it owns — per-rank state is
+  ``~keys/world`` rows (power-of-2 slot growth), the ZeRO-for-metrics
+  memory contract at per-key grain.
+- **Fused streaming ingest.** ``table.ingest(keys, ...)`` is ONE device
+  program per batch: key→slot resolution runs on device (a vectorized
+  branch-free binary search over the sorted key planes), owned rows
+  scatter into the slot columns through the PR 6 segment kernels, and
+  foreign rows append ``(key, float payload)`` entries to an outbox at a
+  device-carried cursor. Under ``config.shape_bucketing()`` a mask-aware
+  twin keeps ragged per-key traffic retrace-free (0 new programs on a
+  warmed table — the PR 1 contract).
+- **Exact drains.** The outbox records per-batch boundaries, and the
+  reassembling merge folds contributions per batch, per rank, in
+  ascending rank order — the same float addition order the replicated
+  toolkit merge of per-key standalone metrics produces, so per-key
+  ``compute()`` is bit-identical to the standalone oracle.
+  ``MetricTable.adopt`` / ``toolkit.adopt_synced`` is the steady-state
+  drain point: the merged logical table commits windowed epochs, applies
+  TTL/occupancy eviction (decided ON the merged state — deterministic
+  across ranks), and each rank re-slices to its owned keys.
+- **Integration surface.** The table IS a :class:`Metric`: it syncs
+  through ``toolkit``/``synclib`` (trimmed payloads), snapshots/restores
+  through ``elastic.ElasticSession`` (world-size-change resume re-hashes
+  keys bit-identically), scopes per-tenant syncs via PR 3 subgroups
+  (build the table over ``ShardContext.from_group(subgroup)`` and sync
+  on that subgroup), reports ``logical_bytes`` vs ``per_rank_bytes``
+  through ``obs.memory_report``, and scrapes occupancy/eviction counters
+  plus per-segment values through the ``obs`` Prometheus exporter.
+
+See docs/metric-table.md for the keying model, eviction semantics,
+tenancy scoping, and limits.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
+from torcheval_tpu.metrics.shardspec import ShardContext
+from torcheval_tpu.table._families import TableFamily, resolve_family
+from torcheval_tpu.table._hash import (
+    SENTINEL,
+    hash_keys,
+    owner_of,
+    split_planes,
+)
+
+__all__ = ["MetricTable", "TableValues"]
+
+_MIN_SLOTS = 8
+_MIN_OUTBOX = 64
+_SENT32 = np.uint32(0xFFFFFFFF)
+
+
+def _pow2(n: int, floor: int) -> int:
+    """Smallest power of two >= ``n`` floored at ``floor`` — the shared
+    growth policy (`_bucket.bucket_length` with an explicit floor)."""
+    from torcheval_tpu.metrics._bucket import bucket_length
+
+    return bucket_length(int(n), floor)
+
+
+class TableValues(NamedTuple):
+    """One ``compute()`` snapshot: per-key values over this table's live
+    slots (``keys`` are the uint64 key hashes in slot order — ascending;
+    ``reprs`` maps hashes back to original keys where known)."""
+
+    keys: np.ndarray
+    values: jax.Array
+    reprs: Dict[int, Any]
+
+    def as_dict(self) -> Dict[Any, float]:
+        """``{original_key_or_hash: float(value)}`` (host readback)."""
+        vals = np.asarray(self.values)
+        return {
+            self.reprs.get(int(k), int(k)): float(v)
+            for k, v in zip(self.keys, vals)
+        }
+
+
+# --------------------------------------------------------- device kernels
+
+
+def _device_owner(khi, klo, world: int):
+    """``hash % world`` from the uint32 planes (matches the host
+    ``_hash.owner_of`` bit-for-bit for world <= 65536)."""
+    w = jnp.uint32(world)
+    shift = jnp.uint32((1 << 32) % world)
+    return ((khi % w) * shift % w + klo % w) % w
+
+
+def _device_lookup(tbl_hi, tbl_lo, khi, klo):
+    """Vectorized branch-free binary search of each batch key in the
+    sorted ``(hi, lo)`` plane table: ``(slot, found)``. Sentinel-padded
+    tail slots sort last, so live keys resolve below ``n_keys``."""
+    cap = int(tbl_hi.shape[0])
+    n = int(khi.shape[0])
+    if cap == 0:
+        return (
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), bool),
+        )
+    lo_b = jnp.zeros((n,), jnp.int32)
+    hi_b = jnp.full((n,), cap, jnp.int32)
+    for _ in range(cap.bit_length()):
+        mid = (lo_b + hi_b) >> 1
+        mh, ml = tbl_hi[mid], tbl_lo[mid]
+        less = (mh < khi) | ((mh == khi) & (ml < klo))
+        lo_b = jnp.where(less, mid + 1, lo_b)
+        hi_b = jnp.where(less, hi_b, mid)
+    idx = jnp.minimum(lo_b, cap - 1)
+    found = (tbl_hi[idx] == khi) & (tbl_lo[idx] == klo)
+    return idx, found
+
+
+# one stable transform per (row_kernel, rank, world, n_fields, masked):
+# the _fuse jit caches key on the kernel OBJECT, so it must not be
+# rebuilt per call (the shardspec._ROUTE_KERNEL_CACHE discipline)
+_INGEST_KERNEL_CACHE: Dict[Any, Any] = {}
+
+
+def _ingest_kernel(
+    row_kernel, rank: int, world: int, n_fields: int, cfg: Tuple, masked: bool
+):
+    """The fused table-ingest transform (see module docstring).
+
+    ``states = (*field_columns, last_seen, out_hi, out_lo, out_val,
+    out_n)``; dynamic = ``(tbl_hi, tbl_lo, khi, klo, epoch,
+    *family_args)`` (+ the bucketing valid vector when ``masked``).
+    Family config (``cfg`` — hashable, e.g. hit_rate's ``k``) is baked
+    into the kernel like the shardspec route kernels bake their range,
+    so the masked twin's trailing ``valid`` vector is unambiguous. The
+    key-plane table is a read-only DYNAMIC argument — donation covers
+    only the accumulating states.
+    """
+    key = (row_kernel, rank, world, n_fields, cfg, masked)
+    fn = _INGEST_KERNEL_CACHE.get(key)
+    if fn is not None:
+        return fn
+    from torcheval_tpu.ops import segment
+
+    def transform(states, tbl_hi, tbl_lo, khi, klo, epoch, *rest):
+        if masked:
+            fam_args, valid = rest[:-1], rest[-1]
+        else:
+            fam_args, valid = rest, None
+        fam_args = fam_args + cfg
+        cols = states[:n_fields]
+        last_seen, out_hi, out_lo, out_val, out_n = states[n_fields:]
+        payload = row_kernel(*fam_args)  # cfg appended above
+        if not isinstance(payload, tuple):
+            payload = (payload,)
+        cap = int(tbl_hi.shape[0])
+        n = int(khi.shape[0])
+        row_ok = (
+            jnp.ones((n,), bool)
+            if valid is None
+            else jnp.arange(n, dtype=jnp.int32) < valid[0]
+        )
+        owned = row_ok & (_device_owner(khi, klo, world) == jnp.uint32(rank))
+        slot, found = _device_lookup(tbl_hi, tbl_lo, khi, klo)
+        seg = jnp.where(owned & found, slot, cap).astype(jnp.int32)
+        new_cols = tuple(
+            c + segment.segment_sum(p.astype(jnp.float32), seg, cap + 1)[:cap]
+            for c, p in zip(cols, payload)
+        )
+        touched = segment.segment_count(seg, cap + 1)[:cap] > 0
+        new_ls = jnp.where(touched, epoch, last_seen)
+        # COMPACTED foreign append: each foreign row scatters to
+        # cursor + its foreign-prefix rank (batch row order preserved —
+        # the per-batch fold order contract), owned/padded rows scatter
+        # nowhere (mode="drop"). The outbox therefore holds ONLY foreign
+        # entries — capacity and sync wire scale with foreign traffic,
+        # not total traffic. The host reserves capacity exactly (it
+        # knows each batch's foreign count from the ownership mask).
+        foreign = row_ok & ~owned
+        prefix = jnp.cumsum(foreign.astype(jnp.int32))
+        pos = jnp.where(foreign, out_n + prefix - 1, out_hi.shape[0])
+        new_out_hi = out_hi.at[pos].set(khi, mode="drop")
+        new_out_lo = out_lo.at[pos].set(klo, mode="drop")
+        new_out_val = out_val.at[pos].set(
+            jnp.stack([p.astype(jnp.float32) for p in payload], axis=-1),
+            mode="drop",
+        )
+        advance = prefix[-1] if n else jnp.int32(0)
+        return new_cols + (
+            new_ls, new_out_hi, new_out_lo, new_out_val, out_n + advance
+        )
+
+    _INGEST_KERNEL_CACHE[key] = transform
+    return transform
+
+
+class MetricTable(Metric[TableValues]):
+    """A hash-partitioned keyed collection of per-key metric states.
+
+    Args:
+        family: ``"ctr"`` | ``"hit_rate"`` | ``"weighted_calibration"``
+            | ``"windowed_ne"`` (or a custom
+            :class:`~torcheval_tpu.table.TableFamily`).
+        shard: eager :class:`ShardContext` naming this rank's position in
+            the table world (``None`` = world 1; build per-tenant tables
+            over ``ShardContext.from_group(subgroup)``). Mesh contexts
+            are not supported — the table is the rank-per-process
+            serving path.
+        ttl: drain epochs a key may stay silent before eviction
+            (``None`` = never).
+        max_keys: global logical occupancy bound enforced at each drain
+            (oldest ``last_seen`` evicted first, ties by ascending key
+            hash — deterministic on the merged state).
+        repr_limit: per-rank cap on retained original-key reprs (scrape
+            labels; unmapped keys render as their hex hash).
+        **family_kwargs: family knobs (``k=`` for hit_rate,
+            ``window=``/``from_logits=`` for windowed_ne).
+
+    Examples::
+
+        >>> import jax.numpy as jnp
+        >>> from torcheval_tpu.table import MetricTable
+        >>> t = MetricTable("ctr")
+        >>> _ = t.ingest([7, 7, 9], jnp.array([1.0, 0.0, 1.0]))
+        >>> sorted(t.compute().as_dict().items())
+        [(7, 0.5), (9, 1.0)]
+    """
+
+    # the fused ingest carries a masked twin: host inputs stay host-side
+    # until padded to their bucket (the PR 1 input-boundary contract)
+    _bucketed_update = True
+    # capability flag consulted by toolkit.adopt_synced / elastic /
+    # obs.memory: hash-partitioned tables reshard by key ownership, not
+    # by an axis slice (``_sharded_states`` stays empty)
+    _hash_partitioned = True
+
+    def __init__(
+        self,
+        family: Any = "ctr",
+        *,
+        shard: Optional[ShardContext] = None,
+        ttl: Optional[int] = None,
+        max_keys: Optional[int] = None,
+        repr_limit: int = 4096,
+        device: Optional[Any] = None,
+        **family_kwargs: Any,
+    ) -> None:
+        if shard is not None and shard.is_mesh:
+            raise NotImplementedError(
+                "MetricTable partitions by key hash across an eager rank "
+                "world; mesh ShardContexts are not supported"
+            )
+        super().__init__(device=device, shard=shard)
+        fam, attrs = resolve_family(family, **family_kwargs)
+        self.family: TableFamily = fam
+        for name, value in attrs.items():
+            setattr(self, name, value)
+        self.rank = shard.rank if shard is not None else 0
+        self.world = shard.world if shard is not None else 1
+        if self.world > 65536:
+            raise ValueError(
+                "MetricTable ownership math supports worlds up to 65536, "
+                f"got {self.world}"
+            )
+        if ttl is not None and int(ttl) < 1:
+            raise ValueError(f"ttl must be >= 1 epochs, got {ttl}")
+        if max_keys is not None and int(max_keys) < 1:
+            raise ValueError(f"max_keys must be >= 1, got {max_keys}")
+        self.ttl = None if ttl is None else int(ttl)
+        self.max_keys = None if max_keys is None else int(max_keys)
+        # best-effort original-key reprs (Prometheus scrape labels) are
+        # CAPPED per rank: at serving scale (100k+ integer keys) an
+        # unbounded host dict would dominate table memory and every sync
+        # payload; unmapped keys scrape as their hex hash
+        self.repr_limit = int(repr_limit)
+        self._payload_width = len(fam.fields)
+        # host mirrors: the sorted uint64 hashes live slots hold, the
+        # per-ingest outbox batch boundaries, and best-effort original
+        # key reprs (for the Prometheus scrape)
+        self._keys: np.ndarray = np.zeros((0,), np.uint64)
+        self._bounds: List[int] = []
+        self._reprs: Dict[int, Any] = {}
+        self._repr_hashes: np.ndarray = np.zeros((0,), np.uint64)
+        # device states (growable 0-size sentinels; capacity is pow2)
+        self._add_state("slot_hi", jnp.zeros((0,), jnp.uint32), merge=MergeKind.CUSTOM)
+        self._add_state("slot_lo", jnp.zeros((0,), jnp.uint32), merge=MergeKind.CUSTOM)
+        for f in fam.fields:
+            self._add_state(f"col_{f}", jnp.zeros((0,)), merge=MergeKind.CUSTOM)
+        if fam.window:
+            for f in fam.fields:
+                self._add_state(
+                    f"ring_{f}",
+                    jnp.zeros((0, fam.window)),
+                    merge=MergeKind.CUSTOM,
+                )
+            self._add_state(
+                "epochs_recorded", jnp.zeros((0,), jnp.int32), merge=MergeKind.CUSTOM
+            )
+        self._add_state("last_seen", jnp.zeros((0,), jnp.int32), merge=MergeKind.CUSTOM)
+        self._add_state("out_hi", jnp.zeros((0,), jnp.uint32), merge=MergeKind.CUSTOM)
+        self._add_state("out_lo", jnp.zeros((0,), jnp.uint32), merge=MergeKind.CUSTOM)
+        self._add_state(
+            "out_val",
+            jnp.zeros((0, self._payload_width)),
+            merge=MergeKind.CUSTOM,
+        )
+        self._add_state("out_n", jnp.zeros((), jnp.int32), merge=MergeKind.CUSTOM)
+        self._add_state("out_h", 0, merge=MergeKind.CUSTOM)
+        # host-int bookkeeping (all persisted/synced)
+        self._add_state("n_keys", 0, merge=MergeKind.CUSTOM)
+        self._add_state("epoch", 0, merge=MergeKind.CUSTOM)
+        self._add_state("global_keys", 0, merge=MergeKind.CUSTOM)
+        self._add_state("inserts_total", 0, merge=MergeKind.CUSTOM)
+        self._add_state("evictions_total", 0, merge=MergeKind.CUSTOM)
+        # carrier descriptor (the _shard_rank/_shard_world discipline):
+        # >= 0 while the live slots hold one rank's owned keys; -1 after
+        # a reassembling merge desharded the table to the logical union
+        self._add_state("_owner_rank", int(self.rank), merge=MergeKind.CUSTOM)
+        self._add_state("_owner_world", int(self.world), merge=MergeKind.CUSTOM)
+
+    # ------------------------------------------------------------ properties
+
+    @property
+    def occupancy(self) -> int:
+        """Live keys this rank's slots hold."""
+        return int(self.n_keys)
+
+    def _is_carrier(self) -> bool:
+        return int(self._owner_rank) >= 0
+
+    def _per_key_states(self) -> List[str]:
+        names = ["slot_hi", "slot_lo", "last_seen"]
+        names += [f"col_{f}" for f in self.family.fields]
+        if self.family.window:
+            names += [f"ring_{f}" for f in self.family.fields]
+            names.append("epochs_recorded")
+        return names
+
+    # ------------------------------------------------------------- admission
+
+    def _admit(self, new_hashes: np.ndarray, reprs: Dict[int, Any]) -> None:
+        """Insert new owned keys: recompute the sorted key set, grow slot
+        capacity (pow2), and permute every per-key state row to the new
+        slot order (slot == rank of the key hash in sorted order, so the
+        layout is deterministic for any arrival order)."""
+        merged = np.sort(
+            np.concatenate([self._keys, new_hashes.astype(np.uint64)])
+        )
+        n_new = merged.size
+        cap_new = _pow2(n_new, _MIN_SLOTS)
+        # where each OLD slot's row lands in the new order
+        dest = np.searchsorted(merged, self._keys).astype(np.int32)
+        src = np.full((cap_new,), int(self._keys.size), np.int32)
+        src[dest] = np.arange(self._keys.size, dtype=np.int32)
+        src_dev = jnp.asarray(src)
+        for name in self._per_key_states():
+            if name in ("slot_hi", "slot_lo"):
+                continue
+            old = getattr(self, name)
+            pad_shape = (1,) + tuple(old.shape[1:])
+            ext = jnp.concatenate(
+                [old, jnp.zeros(pad_shape, old.dtype)], axis=0
+            )
+            setattr(self, name, jnp.take(ext, src_dev, axis=0))
+        hi, lo = split_planes(merged)
+        pad = cap_new - n_new
+        setattr(
+            self,
+            "slot_hi",
+            jnp.asarray(np.concatenate([hi, np.full(pad, _SENT32, np.uint32)])),
+        )
+        setattr(
+            self,
+            "slot_lo",
+            jnp.asarray(np.concatenate([lo, np.full(pad, _SENT32, np.uint32)])),
+        )
+        self._keys = merged
+        self.n_keys = int(n_new)
+        self.global_keys = max(int(self.global_keys), int(n_new))
+        self.inserts_total = int(self.inserts_total) + int(new_hashes.size)
+        self._reprs.update(reprs)
+
+    def _ensure_outbox(self, n_foreign: int) -> None:
+        """Admit ``n_foreign`` more entries (the host knows each batch's
+        exact foreign count from the ownership mask; the compacted
+        scatter append needs no padded-width reservation)."""
+        needed = int(self.out_h) + int(n_foreign)
+        cap = int(self.out_hi.shape[0])
+        if needed <= cap:
+            return
+        new_cap = _pow2(needed, _MIN_OUTBOX)
+        grow = new_cap - cap
+        self.out_hi = jnp.pad(self.out_hi, (0, grow), constant_values=_SENT32)
+        self.out_lo = jnp.pad(self.out_lo, (0, grow), constant_values=_SENT32)
+        self.out_val = jnp.pad(self.out_val, ((0, grow), (0, 0)))
+
+    # ---------------------------------------------------------------- ingest
+
+    def update(self, keys: Any, *args: Any, **kwargs: Any) -> "MetricTable":
+        """Accumulate one batch of keyed rows — ONE fused device program
+        (slot resolution + owned scatter + foreign outbox append)."""
+        return self._apply_update_plan(self._update_plan(keys, *args, **kwargs))
+
+    # serving-door alias (the ISSUE-facing name)
+    def ingest(self, keys: Any, *args: Any, **kwargs: Any) -> "MetricTable":
+        """Alias of :meth:`update` — the streaming ingestion front door."""
+        return self.update(keys, *args, **kwargs)
+
+    def _update_plan(self, keys: Any, *args: Any, **kwargs: Any):
+        if not self._is_carrier():
+            raise RuntimeError(
+                "this MetricTable carries a merged (logical) key union — "
+                "it is a sync/restore intermediate; ingest on the working "
+                "per-rank table (load a logical payload to re-slice it)"
+            )
+        if int(self._owner_rank) != self.rank or int(self._owner_world) != self.world:
+            raise RuntimeError(
+                f"MetricTable holds rank {int(self._owner_rank)} of world "
+                f"{int(self._owner_world)} but is configured as rank "
+                f"{self.rank} of world {self.world}; foreign carriers are "
+                "merge/sync intermediates and cannot be updated"
+            )
+        hashed = hash_keys(keys)
+        fam_dynamic, fam_config = self.family.prepare(self, *args, **kwargs)
+        n = int(hashed.size)
+        # per-row arguments are row-aligned on axis 0 (scalars broadcast
+        # on device); the ragged-axis labels for shape bucketing follow
+        fam_axes = tuple(
+            ("n",) if np.ndim(arg) >= 1 else () for arg in fam_dynamic
+        )
+        for arg, labels in zip(fam_dynamic, fam_axes):
+            if labels and int(np.shape(arg)[0]) != n:
+                raise ValueError(
+                    f"table ingest: {n} keys but a per-row argument has "
+                    f"{int(np.shape(arg)[0])} rows"
+                )
+        # host intake: admit unseen OWNED keys (device programs only run
+        # with every owned key resolvable), stamp reprs, reserve outbox
+        owners = owner_of(hashed, self.world)
+        owned = hashed[owners == self.rank]
+        if owned.size:
+            pos = np.searchsorted(self._keys, owned)
+            pos_c = np.minimum(pos, max(self._keys.size - 1, 0))
+            known = (
+                (pos < self._keys.size) & (self._keys[pos_c] == owned)
+                if self._keys.size
+                else np.zeros(owned.shape, bool)
+            )
+            fresh = np.unique(owned[~known])
+            if fresh.size:
+                self._admit(fresh, {})
+        # best-effort reprs for EVERY observed key (owned or foreign —
+        # the owner may only ever see a foreign key through the outbox,
+        # so the observing rank's repr travels with the sync payload).
+        # The known-hash mirror keeps the steady state fully vectorized.
+        if len(self._reprs) >= self.repr_limit:
+            uniq = np.zeros((0,), np.uint64)
+        else:
+            uniq = np.unique(hashed)
+        pos = np.searchsorted(self._repr_hashes, uniq)
+        pos_c = np.minimum(pos, max(self._repr_hashes.size - 1, 0))
+        unseen = (
+            uniq[
+                ~(
+                    (pos < self._repr_hashes.size)
+                    & (self._repr_hashes[pos_c] == uniq)
+                )
+            ]
+            if self._repr_hashes.size
+            else uniq
+        )
+        if unseen.size:
+            room = max(self.repr_limit - len(self._reprs), 0)
+            self._reprs.update(
+                self._collect_reprs(keys, hashed, unseen[:room])
+            )
+            self._repr_hashes = np.asarray(sorted(self._reprs), np.uint64)
+        n_foreign = int((owners != self.rank).sum())
+        self._ensure_outbox(n_foreign)
+        khi, klo = split_planes(hashed)
+        epoch = int(self.epoch)
+        out_h = int(self.out_h)
+
+        def finalize() -> None:
+            if n_foreign:
+                self.out_h = out_h + n_foreign
+                self._bounds.append(out_h + n_foreign)
+
+        from torcheval_tpu.utils.convert import cached_index
+
+        state_names = tuple(
+            [f"col_{f}" for f in self.family.fields]
+            + ["last_seen", "out_hi", "out_lo", "out_val", "out_n"]
+        )
+        n_fields = len(self.family.fields)
+        dynamic = (
+            self.slot_hi,
+            self.slot_lo,
+            khi,
+            klo,
+            cached_index(epoch),
+        ) + tuple(fam_dynamic)
+        batch_axes = ((), (), ("n",), ("n",), ()) + fam_axes
+        return UpdatePlan(
+            kernel=_ingest_kernel(
+                self.family.row_kernel,
+                self.rank,
+                self.world,
+                n_fields,
+                fam_config,
+                False,
+            ),
+            state_names=state_names,
+            dynamic=dynamic,
+            config=(),
+            transform=True,
+            finalize=finalize,
+            masked_kernel=_ingest_kernel(
+                self.family.row_kernel,
+                self.rank,
+                self.world,
+                n_fields,
+                fam_config,
+                True,
+            ),
+            batch_axes=batch_axes,
+        )
+
+    def _collect_reprs(
+        self, keys: Any, hashed: np.ndarray, fresh: np.ndarray
+    ) -> Dict[int, Any]:
+        arr = np.asarray(keys).reshape(-1)
+        want = set(int(h) for h in fresh)
+        out: Dict[int, Any] = {}
+        for k, h in zip(arr.tolist(), hashed.tolist()):
+            if int(h) in want and int(h) not in out:
+                out[int(h)] = k
+        return out
+
+    # --------------------------------------------------------------- compute
+
+    def compute(self) -> TableValues:
+        """Per-key values over this table's live slots (a carrier covers
+        its OWNED keys — foreign traffic observed locally is in-flight in
+        the outbox until the next drain; a merged table covers the full
+        key union)."""
+        n = int(self.n_keys)
+        cols = {
+            f: (
+                jnp.sum(getattr(self, f"ring_{f}")[:n], axis=-1)
+                if self.family.window
+                else getattr(self, f"col_{f}")[:n]
+            )
+            for f in self.family.fields
+        }
+        values = self.family.compute(cols)
+        return TableValues(
+            keys=self._keys.copy(), values=values, reprs=dict(self._reprs)
+        )
+
+    # ----------------------------------------------------------------- merge
+
+    def merge_state(self, metrics: Any) -> "MetricTable":
+        """Reassemble the logical key union from per-rank carriers.
+
+        Per family field, per key: each carrier's contribution ``S_q`` is
+        its slot value (the owner) or the per-batch fold of its outbox
+        entries (everyone else), and the union folds ``S_0 + S_1 + ...``
+        in ascending carried-rank order — the exact float addition order
+        the replicated toolkit merge of per-key standalone metrics
+        produces, which is what makes the per-key oracle pins bit-exact.
+        Afterwards ``self`` is DESHARDED (``_owner_rank == -1``):
+        ``compute()`` covers every key, and loading its ``state_dict``
+        into a working table re-slices to that rank's owned keys.
+        """
+        from torcheval_tpu.ops import segment
+
+        carriers = sorted(
+            [self] + list(metrics), key=lambda c: int(c._owner_rank)
+        )
+        worlds = {int(c._owner_world) for c in carriers if int(c._owner_rank) >= 0}
+        if len(worlds) > 1:
+            raise RuntimeError(
+                f"cannot merge table carriers from different worlds "
+                f"{sorted(worlds)}"
+            )
+        # the union: every carrier's live keys plus every outbox key
+        parts = [c._keys[: int(c.n_keys)] for c in carriers]
+        for c in carriers:
+            cnt = int(c.out_h)
+            if cnt:
+                hi = np.asarray(c.out_hi[:cnt], np.uint64)
+                lo = np.asarray(c.out_lo[:cnt], np.uint64)
+                hk = (hi << np.uint64(32)) | lo
+                parts.append(hk[hk != SENTINEL])
+        union = np.unique(np.concatenate(parts)) if parts else np.zeros(
+            (0,), np.uint64
+        )
+        n_u = int(union.size)
+        fields = self.family.fields
+        logical = {f: jnp.zeros((n_u,)) for f in fields}
+        win = self.family.window
+        if win:
+            rings = {f: jnp.zeros((n_u, win)) for f in fields}
+            epochs_rec = jnp.zeros((n_u,), jnp.int32)
+        last_seen = np.zeros((n_u,), np.int64)
+        merged_epoch = max((int(c.epoch) for c in carriers), default=0)
+        for c in carriers:
+            n_c = int(c.n_keys)
+            pos_np = np.searchsorted(union, c._keys[:n_c])
+            pos = jnp.asarray(pos_np.astype(np.int32))
+            deltas = {f: jnp.zeros((n_u,)) for f in fields}
+            if n_c:
+                for f in fields:
+                    deltas[f] = deltas[f].at[pos].set(
+                        self._place_state(getattr(c, f"col_{f}"))[:n_c]
+                    )
+                np.maximum.at(
+                    last_seen,
+                    pos_np,
+                    np.asarray(c.last_seen[:n_c], np.int64),
+                )
+                if win:
+                    rings = {
+                        f: rings[f].at[pos].add(
+                            self._place_state(getattr(c, f"ring_{f}"))[:n_c]
+                        )
+                        for f in fields
+                    }
+                    epochs_rec = epochs_rec.at[pos].max(
+                        self._place_state(c.epochs_recorded)[:n_c]
+                    )
+            cnt = int(c.out_h)
+            if cnt:
+                hi = np.asarray(c.out_hi[:cnt], np.uint64)
+                lo = np.asarray(c.out_lo[:cnt], np.uint64)
+                hk = (hi << np.uint64(32)) | lo
+                live = hk != SENTINEL
+                ids = np.where(
+                    live, np.searchsorted(union, hk), n_u
+                ).astype(np.int32)
+                np.maximum.at(
+                    last_seen,
+                    np.minimum(ids, max(n_u - 1, 0))[live],
+                    merged_epoch,
+                )
+                vals = self._place_state(getattr(c, "out_val"))[:cnt]
+                from torcheval_tpu.metrics.shardspec import complete_bounds
+
+                bounds = complete_bounds(c._bounds, cnt)
+                start = 0
+                for stop in bounds:
+                    if stop <= start:
+                        continue
+                    seg_ids = jnp.asarray(ids[start:stop])
+                    for j, f in enumerate(fields):
+                        deltas[f] = (
+                            deltas[f]
+                            + segment.segment_sum(
+                                vals[start:stop, j], seg_ids, n_u + 1
+                            )[:n_u]
+                        )
+                    start = stop
+            for f in fields:
+                logical[f] = logical[f] + deltas[f]
+        # install the union as this table's live (desharded) state
+        cap = _pow2(n_u, _MIN_SLOTS)
+        pad = cap - n_u
+        hi_u, lo_u = split_planes(union)
+        self.slot_hi = jnp.asarray(
+            np.concatenate([hi_u, np.full(pad, _SENT32, np.uint32)])
+        )
+        self.slot_lo = jnp.asarray(
+            np.concatenate([lo_u, np.full(pad, _SENT32, np.uint32)])
+        )
+        for f in fields:
+            setattr(self, f"col_{f}", jnp.pad(logical[f], (0, pad)))
+            if win:
+                setattr(
+                    self, f"ring_{f}", jnp.pad(rings[f], ((0, pad), (0, 0)))
+                )
+        if win:
+            self.epochs_recorded = jnp.pad(epochs_rec, (0, pad))
+        self.last_seen = jnp.pad(
+            jnp.asarray(last_seen.astype(np.int32)), (0, pad)
+        )
+        self._keys = union
+        self.n_keys = n_u
+        self.global_keys = n_u
+        self.epoch = merged_epoch
+        # MAX, not sum: after an adopt every rank carries the same
+        # drain-global totals — summing would compound them world-fold
+        # at every subsequent merge. Max keeps them monotone and equal
+        # to the world-1 replay of the same logical stream.
+        self.inserts_total = max(
+            (int(c.inserts_total) for c in carriers), default=0
+        )
+        self.evictions_total = max(
+            (int(c.evictions_total) for c in carriers), default=0
+        )
+        reprs: Dict[int, Any] = {}
+        for c in carriers:
+            reprs.update(c._reprs)
+        self._set_reprs(reprs)
+        self._clear_table_outbox()
+        self._owner_rank = -1
+        self._owner_world = 0
+        return self
+
+    def _clear_table_outbox(self) -> None:
+        self.out_hi = jnp.zeros((0,), jnp.uint32)
+        self.out_lo = jnp.zeros((0,), jnp.uint32)
+        self.out_val = jnp.zeros((0, self._payload_width))
+        self.out_n = self._place_state(jnp.zeros((), jnp.int32))
+        self.out_h = 0
+        self._bounds = []
+
+    # ------------------------------------------------------- drain / adopt
+
+    def _pre_adopt_commit(self) -> None:
+        """Drain-time finalization on the MERGED (logical) table — called
+        by ``toolkit.adopt_synced`` before each rank adopts the payload,
+        so every decision here is a deterministic function of globally
+        merged state (identical on every rank):
+
+        1. windowed families commit the pending epoch accumulators as one
+           ring column per key WITH traffic this epoch;
+        2. the drain epoch advances;
+        3. TTL and occupancy eviction run (oldest ``last_seen`` first,
+           ties by ascending key hash).
+        """
+        n = int(self.n_keys)
+        win = self.family.window
+        if win and n:
+            fields = self.family.fields
+            ex_field = (
+                "num_examples" if "num_examples" in fields else fields[-1]
+            )
+            pend = {f: getattr(self, f"col_{f}")[:n] for f in fields}
+            has = pend[ex_field] != 0.0
+            cur = self.epochs_recorded[:n] % win
+            rows = jnp.arange(n, dtype=jnp.int32)
+            for f in fields:
+                ring = getattr(self, f"ring_{f}")
+                old = ring[rows, cur]
+                new_col = jnp.where(has, pend[f], old)
+                setattr(
+                    self, f"ring_{f}", ring.at[rows, cur].set(new_col)
+                )
+                setattr(
+                    self,
+                    f"col_{f}",
+                    getattr(self, f"col_{f}").at[:n].set(0.0),
+                )
+            self.epochs_recorded = self.epochs_recorded.at[:n].add(
+                has.astype(jnp.int32)
+            )
+        self.epoch = int(self.epoch) + 1
+        self._evict()
+
+    def _evict(self) -> None:
+        """TTL + occupancy eviction on the logical table (see
+        :meth:`_pre_adopt_commit`)."""
+        n = int(self.n_keys)
+        if n == 0 or (self.ttl is None and self.max_keys is None):
+            return
+        ls = np.asarray(self.last_seen[:n], np.int64)
+        keep = np.ones((n,), bool)
+        if self.ttl is not None:
+            keep &= ls > int(self.epoch) - 1 - int(self.ttl)
+        if self.max_keys is not None and int(keep.sum()) > self.max_keys:
+            # oldest first, ties broken by ascending key hash: both are
+            # merged-state quantities, so the order is deterministic
+            alive = np.flatnonzero(keep)
+            order = np.lexsort((self._keys[alive], ls[alive]))
+            keep[alive[order[: int(keep.sum()) - self.max_keys]]] = False
+        dropped = n - int(keep.sum())
+        if dropped == 0:
+            return
+        self._keep_subset(np.flatnonzero(keep))
+        self.evictions_total = int(self.evictions_total) + dropped
+
+    def _keep_subset(self, idx: np.ndarray) -> None:
+        """Retain only the slot rows at ``idx`` (ascending — slot order
+        is key order, and a subset of a sorted set stays sorted)."""
+        kept = self._keys[idx]
+        n_new = int(kept.size)
+        cap = _pow2(n_new, _MIN_SLOTS)
+        pad = cap - n_new
+        idx_dev = jnp.asarray(idx.astype(np.int32))
+        for name in self._per_key_states():
+            if name in ("slot_hi", "slot_lo"):
+                continue
+            old = getattr(self, name)
+            taken = jnp.take(old, idx_dev, axis=0)
+            pad_widths = ((0, pad),) + tuple(
+                (0, 0) for _ in range(old.ndim - 1)
+            )
+            setattr(self, name, jnp.pad(taken, pad_widths))
+        hi, lo = split_planes(kept)
+        self.slot_hi = jnp.asarray(
+            np.concatenate([hi, np.full(pad, _SENT32, np.uint32)])
+        )
+        self.slot_lo = jnp.asarray(
+            np.concatenate([lo, np.full(pad, _SENT32, np.uint32)])
+        )
+        self._keys = kept
+        self.n_keys = n_new
+        if self._reprs:
+            alive = set(int(x) for x in kept)
+            self._set_reprs(
+                {k: v for k, v in self._reprs.items() if k in alive}
+            )
+
+    def adopt(self, process_group: Optional[Any] = None) -> "MetricTable":
+        """Sync + drain in one call (``toolkit.adopt_synced(self, group)``):
+        outboxes fold to their owners, windowed epochs commit, eviction
+        runs, and this rank's table returns to ``owned keys + empty
+        outbox``. Returns the merged (logical) table for ``compute()``."""
+        from torcheval_tpu.metrics.toolkit import adopt_synced
+
+        return adopt_synced(self, process_group)
+
+    # --------------------------------------------------------- serialization
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Trimmed snapshot: live slots (not capacity), the outbox to its
+        power-of-2 covering bucket, plus the host bookkeeping (batch
+        boundaries, best-effort key reprs)."""
+        n = int(self.n_keys)
+        cnt = int(self.out_h)
+        keep = _pow2(cnt, 1) if cnt else 0
+        sd: Dict[str, Any] = {}
+        for name in self._per_key_states():
+            sd[name] = jnp.copy(getattr(self, name)[:n])
+        sd["out_hi"] = jnp.copy(self.out_hi[:keep])
+        sd["out_lo"] = jnp.copy(self.out_lo[:keep])
+        sd["out_val"] = jnp.copy(self.out_val[:keep])
+        sd["out_n"] = jnp.copy(self.out_n)
+        for name in (
+            "out_h", "n_keys", "epoch", "global_keys", "inserts_total",
+            "evictions_total", "_owner_rank", "_owner_world",
+        ):
+            sd[name] = int(getattr(self, name))
+        sd["out_bounds"] = jnp.asarray(
+            np.asarray(self._bounds, np.int32).reshape(-1)
+        )
+        sd["key_reprs"] = tuple(sorted(self._reprs.items()))
+        return sd
+
+    def load_state_dict(
+        self, state_dict: Dict[str, Any], strict: bool = True
+    ) -> None:
+        """Load a snapshot. A CARRIER payload (``_owner_rank >= 0``) is
+        adopted verbatim (sync clones, same-world restores); a LOGICAL
+        payload (``_owner_rank == -1``) re-slices to this rank's owned
+        keys under the configured world with an empty outbox — the
+        bit-identical re-hash of a drain or world-size-change resume."""
+        sd = dict(state_dict)
+        bounds = sd.pop("out_bounds", None)
+        reprs = sd.pop("key_reprs", ())
+        registered = set(self._state_name_to_default)
+        provided = set(sd)
+        if strict and registered != provided:
+            raise RuntimeError(
+                f"Error(s) in loading state_dict for {type(self).__name__}: "
+                f"missing keys: {sorted(registered - provided)}, "
+                f"unexpected keys: {sorted(provided - registered)}."
+            )
+        owner_rank = int(np.asarray(sd.get("_owner_rank", -1)))
+        hi = np.asarray(sd["slot_hi"], np.uint64)
+        lo = np.asarray(sd["slot_lo"], np.uint64)
+        keys = (hi << np.uint64(32)) | lo
+        n = int(np.asarray(sd.get("n_keys", keys.size)))
+        keys = keys[:n]
+        rows = {
+            name: np.asarray(sd[name])[:n]
+            for name in self._per_key_states()
+            if name not in ("slot_hi", "slot_lo") and name in sd
+        }
+        repr_map = {int(k): v for k, v in (reprs or ())}
+        if owner_rank < 0:
+            # logical payload: keep only the keys this rank owns NOW
+            mask = owner_of(keys, self.world) == self.rank
+            kept = np.flatnonzero(mask)
+            self.global_keys = int(keys.size)
+            keys = keys[kept]
+            rows = {name: v[kept] for name, v in rows.items()}
+            out_hi = np.zeros((0,), np.uint32)
+            out_lo = np.zeros((0,), np.uint32)
+            out_val = np.zeros((0, self._payload_width), np.float32)
+            out_h = 0
+            self._bounds = []
+        else:
+            self.global_keys = int(np.asarray(sd.get("global_keys", n)))
+            out_h = int(np.asarray(sd.get("out_h", 0)))
+            ocap = _pow2(out_h, _MIN_OUTBOX) if out_h else 0
+            out_hi = np.full((ocap,), _SENT32, np.uint32)
+            out_lo = np.full((ocap,), _SENT32, np.uint32)
+            out_val = np.zeros((ocap, self._payload_width), np.float32)
+            out_hi[:out_h] = np.asarray(sd["out_hi"], np.uint32)[:out_h]
+            out_lo[:out_h] = np.asarray(sd["out_lo"], np.uint32)[:out_h]
+            out_val[:out_h] = np.asarray(sd["out_val"], np.float32)[:out_h]
+            self._bounds = (
+                [int(b) for b in np.asarray(bounds).reshape(-1)]
+                if bounds is not None
+                else ([out_h] if out_h else [])
+            )
+        n_live = int(keys.size)
+        cap = _pow2(n_live, _MIN_SLOTS)
+        pad = cap - n_live
+        phi, plo = split_planes(keys)
+        self.slot_hi = self._place_state(
+            jnp.asarray(np.concatenate([phi, np.full(pad, _SENT32, np.uint32)]))
+        )
+        self.slot_lo = self._place_state(
+            jnp.asarray(np.concatenate([plo, np.full(pad, _SENT32, np.uint32)]))
+        )
+        for name, value in rows.items():
+            pad_widths = ((0, pad),) + tuple(
+                (0, 0) for _ in range(value.ndim - 1)
+            )
+            default_dtype = getattr(self, name).dtype
+            setattr(
+                self,
+                name,
+                self._place_state(
+                    jnp.asarray(
+                        np.pad(value.astype(default_dtype), pad_widths)
+                    )
+                ),
+            )
+        self.out_hi = self._place_state(jnp.asarray(out_hi))
+        self.out_lo = self._place_state(jnp.asarray(out_lo))
+        self.out_val = self._place_state(jnp.asarray(out_val))
+        self.out_n = self._place_state(jnp.asarray(out_h, jnp.int32))
+        self.out_h = out_h
+        self._keys = keys
+        self.n_keys = n_live
+        for name in ("epoch", "inserts_total", "evictions_total"):
+            if name in sd:
+                setattr(self, name, int(np.asarray(sd[name])))
+        if owner_rank < 0:
+            self._owner_rank = int(self.rank)
+            self._owner_world = int(self.world)
+            if repr_map:
+                hashes = np.asarray(sorted(repr_map), np.uint64)
+                mine = hashes[owner_of(hashes, self.world) == self.rank]
+                self._set_reprs({int(h): repr_map[int(h)] for h in mine})
+            else:
+                self._set_reprs({})
+        else:
+            self._owner_rank = owner_rank
+            self._owner_world = int(np.asarray(sd.get("_owner_world", 0)))
+            self._set_reprs(repr_map)
+        self.__dict__.pop("sync_provenance", None)
+        self.__dict__.pop("obs_step", None)
+
+    def _reshard_to_own(self) -> "MetricTable":
+        """Re-slice a DESHARDED (logical) table back to this rank's owned
+        keys — the tail step of a world-size-change elastic resume (key
+        re-hash is bit-identical: hashes are deterministic and ownership
+        is ``hash % new_world``)."""
+        if int(self._owner_rank) == self.rank and int(self._owner_world) == self.world:
+            return self
+        if int(self._owner_rank) >= 0:
+            if int(self._owner_world) == 1 and int(self.out_h) == 0:
+                # a world-1 carrier IS the logical table
+                self._owner_rank = -1
+                self._owner_world = 0
+            else:
+                raise RuntimeError(
+                    "reshard requires a desharded (merged) logical table; "
+                    f"live state carries rank {int(self._owner_rank)} of "
+                    f"world {int(self._owner_world)}"
+                )
+        self.load_state_dict(self.state_dict())
+        return self
+
+    def reset(self) -> "MetricTable":
+        super().reset()
+        self._keys = np.zeros((0,), np.uint64)
+        self._bounds = []
+        self._set_reprs({})
+        return self
+
+    def _set_reprs(self, reprs: Dict[int, Any]) -> None:
+        self._reprs = dict(reprs)
+        self._repr_hashes = np.asarray(sorted(self._reprs), np.uint64)
+
+    # ------------------------------------------------------------------- obs
+
+    def _logical_state_nbytes(self) -> Dict[str, int]:
+        """Per-state LOGICAL bytes for ``obs.memory_report``: per-key
+        states scale to the POW2 SLOT CAPACITY covering the last-known
+        global key count (``global_keys``, refreshed at every
+        merge/drain) — capacity, not live rows, because capacity is what
+        one world-1 replica would actually pin (and what the per-rank
+        walk reports), so world-1 tables read ``logical ==
+        per_rank``/unsharded and a world-``w`` rank reads exactly
+        ``1/w`` when the pow2 boundaries line up. Outbox/bookkeeping
+        count as live (the per-rank overhead constant)."""
+        from torcheval_tpu.obs.memory import _leaf_bytes
+
+        per_key = set(self._per_key_states())
+        n = _pow2(
+            max(int(self.global_keys), int(self.n_keys)), _MIN_SLOTS
+        )
+        out: Dict[str, int] = {}
+        for name in self._state_name_to_default:
+            value = getattr(self, name)
+            if name in per_key and isinstance(value, jax.Array):
+                row = int(
+                    np.prod(value.shape[1:], dtype=np.int64)
+                ) * value.dtype.itemsize if value.ndim else 0
+                row = row or value.dtype.itemsize
+                out[name] = n * row
+            else:
+                out[name] = _leaf_bytes(value)
+        return out
+
+    def counter_source(self) -> Dict[str, Any]:
+        """Occupancy / eviction / outbox / byte gauges for the
+        ``obs.CounterRegistry`` (pull-based; zero cost between scrapes)."""
+        from torcheval_tpu.obs.memory import per_rank_state_bytes
+
+        return {
+            "occupancy": int(self.n_keys),
+            "global_keys": max(int(self.global_keys), int(self.n_keys)),
+            "capacity": int(self.slot_hi.shape[0]),
+            "epoch": int(self.epoch),
+            "inserts_total": int(self.inserts_total),
+            "evictions_total": int(self.evictions_total),
+            "outbox_entries": int(self.out_h),
+            "per_rank_bytes": int(sum(per_rank_state_bytes(self).values())),
+            "logical_bytes": int(sum(self._logical_state_nbytes().values())),
+        }
+
+    def track(self, source: str = "metric_table", registry=None) -> None:
+        """Register :meth:`counter_source` on an ``obs`` counter registry
+        (default: the process registry every exporter scrapes)."""
+        from torcheval_tpu.obs.counters import default_registry
+
+        (registry or default_registry()).register(
+            source, self.counter_source
+        )
+
+    def scrape_values(self, limit: Optional[int] = None) -> Dict[str, float]:
+        """Per-segment values for the Prometheus exporter:
+        ``{value_<sanitized key>: float}`` over (up to ``limit``) live
+        slots. Reads values to the host — scrape-cadence only, never the
+        ingest path. Register via ``table.track_values()``."""
+        tv = self.compute()
+        vals = np.asarray(tv.values)
+        out: Dict[str, float] = {}
+        n = len(tv.keys) if limit is None else min(limit, len(tv.keys))
+        for k, v in zip(tv.keys[:n], vals[:n]):
+            label = tv.reprs.get(int(k), f"{int(k):016x}")
+            label = re.sub(r"[^a-zA-Z0-9_]", "_", str(label))
+            name = f"value_{label}"
+            if name in out:
+                # two keys sanitized to one name ("us-east"/"us_east"):
+                # disambiguate by hash rather than silently dropping one
+                name = f"value_{label}_{int(k) & 0xFFFFFFFF:08x}"
+            out[name] = float(v)
+        return out
+
+    def track_values(
+        self,
+        source: str = "metric_table_values",
+        registry=None,
+        limit: Optional[int] = 1024,
+    ) -> None:
+        """Register the per-segment value scrape (bounded cardinality —
+        ``limit`` keys per scrape) on an ``obs`` counter registry."""
+        from torcheval_tpu.obs.counters import default_registry
+
+        (registry or default_registry()).register(
+            source, lambda: self.scrape_values(limit)
+        )
