@@ -145,6 +145,43 @@ def test_merge_program_is_local_math(name):
     assert report.collectives == ()
 
 
+# ------------------------------------------ quality-watched (ISSUE 13)
+
+# plan-bearing families the quality layer can watch (buffered/plan-less
+# families are rejected by watch_inputs with a clear TypeError)
+_WATCHABLE = (
+    "MulticlassAccuracy",
+    "MeanSquaredError",
+    "Mean",
+    "MulticlassConfusionMatrix",
+    "WindowedMeanSquaredError",
+)
+
+
+@pytest.mark.parametrize("name", _WATCHABLE)
+def test_quality_watched_update_program_is_verified_statically(name):
+    """ISSUE 13 acceptance (static form): a ``watch_inputs``-armed
+    update — the family kernel plus the fused sketch folds (histogram,
+    Chan moments, anomaly counters, distinct registers) — keeps every
+    local-update contract: no host escapes, ZERO collectives,
+    dtype-safe, donation-alias-sound, for the plain AND the bucketed
+    masked program."""
+    from torcheval_tpu.obs import quality
+
+    make, args = CLASS_CASES[name]
+    metric = make()
+    watch = quality.watch_inputs(metric, bounds=(0.0, 1.0))
+    try:
+        report = verify_metric_update(metric, *args)
+        assert report is not None
+        assert report.ok, "\n" + report.format_text()
+        assert report.collectives == (), report.collectives
+        assert report.hlo_collectives == (), report.hlo_collectives
+        assert report.host_escapes == ()
+    finally:
+        watch.close()
+
+
 # ----------------------------------------------- sharded families (ISSUE 9)
 
 

@@ -151,6 +151,31 @@ def test_steady_state_update_is_transfer_free_monitoring_armed(name):
             metric.update(*args)
 
 
+@pytest.mark.parametrize(
+    "name", ["MulticlassAccuracy", "MeanSquaredError", "Mean"]
+)
+def test_steady_state_update_is_transfer_free_quality_watched(name):
+    """ISSUE 13 acceptance: a ``quality.watch_inputs``-armed update adds
+    ZERO host syncs — the sketch folds (histogram, moments, anomaly
+    counters, distinct registers) trace into the metric's own fused
+    program, and the combined plan's construction is host metadata only.
+    Non-vacuous: the sketch actually accumulated under the guard."""
+    from torcheval_tpu.obs import quality
+
+    make, args = CLASS_CASES[name]
+    metric = make()
+    watch = quality.watch_inputs(metric, bounds=(0.0, 1.0))
+    try:
+        for _ in range(6):
+            metric.update(*args)
+        before = int(np.asarray(metric._q0_cnt)[0])
+        with jax.transfer_guard("disallow"):
+            metric.update(*args)
+        assert int(np.asarray(metric._q0_cnt)[0]) > before
+    finally:
+        watch.close()
+
+
 def test_donated_update_is_transfer_free_and_in_place():
     """ISSUE 6 acceptance pin: with donation enabled, the update adds
     zero host syncs AND reuses the state buffer in place — the per-step

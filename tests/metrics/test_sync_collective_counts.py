@@ -246,6 +246,53 @@ def test_flight_watchdog_monitor_on_adds_zero_collectives(n_metrics):
     FLIGHT.reset()
 
 
+@pytest.mark.parametrize("n_metrics", [1, 4])
+def test_quality_watched_sync_adds_zero_collectives(n_metrics):
+    """ISSUE 13 acceptance: quality-watched metrics sync with EXACTLY
+    the bare gather counts — the sketch states are ordinary registered
+    states riding the packed payload the protocol already ships, never
+    extra collectives. Non-vacuous: the synced sketch states actually
+    merged (SUM counters doubled across the fake group's two identical
+    ranks, MAX registers idempotent)."""
+    from torcheval_tpu.obs import quality
+
+    def plannable(n):
+        # watchable members only (fusable update plans)
+        coll = {
+            "acc": M.MulticlassAccuracy(),
+            "f1": M.MulticlassF1Score(),
+            "mse": M.MeanSquaredError(),
+            "mean": M.Mean(),
+        }
+        return dict(list(coll.items())[:n])
+
+    bare_coll = plannable(n_metrics)
+    _feed(bare_coll)
+    bare = CountingGroup()
+    sync_and_compute_collection(bare_coll, bare)
+
+    watched = plannable(n_metrics)
+    watch = quality.watch_inputs(watched, bounds=(0.0, 1.0))
+    try:
+        _feed(watched)
+        counting = CountingGroup()
+        sync_and_compute_collection(watched, counting)
+        assert counting.object_gathers == bare.object_gathers == 1
+        assert counting.array_gathers == bare.array_gathers <= 1
+        # the payload carried the sketch states and the merge folded them
+        from torcheval_tpu.metrics.toolkit import get_synced_metric
+
+        synced = get_synced_metric(watched["acc"], CountingGroup())
+        assert int(synced._q0_cnt[0]) == 2 * int(
+            watched["acc"]._q0_cnt[0]
+        ) > 0
+        assert np.array_equal(
+            np.asarray(synced._q0_reg), np.asarray(watched["acc"]._q0_reg)
+        )
+    finally:
+        watch.close()
+
+
 def test_two_rank_sync_matches_per_metric_sync():
     """The batched path and K independent single-metric syncs agree."""
     from torcheval_tpu.metrics.toolkit import sync_and_compute

@@ -16,9 +16,20 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from torcheval_tpu.ops import bincount, histogram, segment_count, segment_sum, topk
+from torcheval_tpu.ops import (
+    bincount,
+    histogram,
+    segment_count,
+    segment_max,
+    segment_sum,
+    topk,
+)
 from torcheval_tpu.ops.histogram import _histogram_xla
-from torcheval_tpu.ops.segment import _segment_count_xla, _segment_sum_xla
+from torcheval_tpu.ops.segment import (
+    _segment_count_xla,
+    _segment_max_xla,
+    _segment_sum_xla,
+)
 from torcheval_tpu.ops.topk import _topk_xla
 
 RNG = np.random.default_rng(41)
@@ -116,6 +127,53 @@ def test_segment_count_float_mask_parity_and_native():
 def test_segment_count_empty():
     got = segment_count(jnp.zeros((0,), jnp.int32), 3)
     np.testing.assert_array_equal(np.asarray(got), np.zeros(3, np.int32))
+
+
+# ---------------------------------------------------------- segment_max
+
+
+@pytest.mark.parametrize("identity", [0, -5])
+def test_segment_max_parity(identity):
+    """Native vs dense-twin vs jax scatter-max: identical maxima, with
+    empty segments holding the caller's identity and out-of-range ids
+    dropped on every path."""
+    ids = jnp.asarray(
+        RNG.integers(-2, 12, size=256).astype(np.int32)
+    )  # some dropped
+    data = jnp.asarray(RNG.integers(-3, 30, size=256).astype(np.int32))
+    got = segment_max(data, ids, 16, identity=identity)
+    twin = _segment_max_xla(data, ids, 16, identity)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(twin))
+    # vs the scatter reference where segments are hit
+    ref = np.full(16, identity, np.int32)
+    for d, i in zip(np.asarray(data), np.asarray(ids)):
+        if 0 <= i < 16:
+            ref[i] = max(ref[i], d)
+    np.testing.assert_array_equal(np.asarray(got), ref)
+    # empty segments (10..15 unhit at size-16 with ids < 12) hold identity
+    assert np.asarray(got)[
+        np.setdiff1d(np.arange(16), np.asarray(ids))
+    ].tolist() == [
+        identity
+    ] * len(np.setdiff1d(np.arange(16), np.asarray(ids)))
+
+
+def test_segment_max_empty_and_fallback_dtypes():
+    # empty input: identity everywhere (XLA twin path — size 0 skips
+    # the native dispatch)
+    out = segment_max(
+        jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.int32), 4,
+        identity=7,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.full(4, 7, np.int32))
+
+
+def test_segment_max_under_jit_matches_eager():
+    ids = jnp.asarray(RNG.integers(0, 8, size=64).astype(np.int32))
+    data = jnp.asarray(RNG.integers(0, 100, size=64).astype(np.int32))
+    eager = segment_max(data, ids, 8)
+    jitted = jax.jit(lambda d, i: segment_max(d, i, 8))(data, ids)
+    np.testing.assert_array_equal(np.asarray(eager), np.asarray(jitted))
 
 
 # -------------------------------------------------------------- histogram

@@ -355,3 +355,84 @@ def test_sync_payload_ships_live_rows_not_capacity():
         for n in t._state_name_to_default
     )
     assert payload < capacity
+
+
+# -------------------------------------------- cluster-wide key reprs (ISSUE 13)
+
+
+def test_gather_key_reprs_resolves_past_per_rank_cap():
+    """ROADMAP item 3 remaining edge: each rank only retains reprs for
+    keys it observed (capped by ``repr_limit``), so cross-rank scrapes
+    show hex hashes. ONE ``allgather_object`` merges every rank's repr
+    table so string keys resolve cluster-wide — and the adopted table
+    scrapes them by name."""
+
+    def body(g):
+        t = MetricTable(
+            "ctr", shard=ShardContext(g.rank, WORLD), repr_limit=8
+        )
+        # disjoint per-rank tenant names: no rank observes the others'
+        keys = np.asarray([f"tenant-{g.rank}-{i}" for i in range(4)])
+        t.ingest(keys, np.ones(4, np.float32))
+        local = dict(t._reprs)
+        merged = t.gather_key_reprs(g)
+        # the gather is ONE collective and merges every rank's reprs
+        assert len(merged) == WORLD * 4
+        assert set(local) <= set(merged)
+        assert t.repr_limit >= len(merged)  # adoption lifted the cap
+        scraped = sync_and_compute(t, g)  # merged values for the scrape
+        return merged, local
+
+    results = ThreadWorld(WORLD).run(body)
+    want = {repr for merged, _ in results for repr in merged.values()}
+    assert want == {
+        f"tenant-{r}-{i}" for r in range(WORLD) for i in range(4)
+    }
+    # every rank ends with the identical cluster-wide mapping
+    assert all(merged == results[0][0] for merged, _ in results)
+
+
+def test_gather_key_reprs_is_one_allgather_and_adopt_opt_out():
+    class CountingGroup:
+        world_size, rank, is_member, ranks = 2, 0, True, (0, 1)
+
+        def __init__(self):
+            self.object_gathers = 0
+
+        def unwrap(self):
+            return self
+
+        def allgather_object(self, obj):
+            self.object_gathers += 1
+            other = {hash_keys(np.asarray(["peer"]))[0].item(): "peer"}
+            return [obj, other]
+
+    t = MetricTable("ctr", repr_limit=4)
+    t.ingest(np.asarray(["mine"]), np.ones(1, np.float32))
+    group = CountingGroup()
+    merged = t.gather_key_reprs(group, adopt=False)
+    assert group.object_gathers == 1
+    assert set(merged.values()) == {"mine", "peer"}
+    assert "peer" not in t._reprs.values()  # adopt=False left it alone
+    t.gather_key_reprs(group)
+    assert "peer" in t._reprs.values()  # default adopts
+
+
+def test_gather_key_reprs_non_member_short_circuits():
+    def body(g):
+        sub = g.new_subgroup([0, 1])
+        t = MetricTable(
+            "ctr",
+            shard=ShardContext(sub.rank if sub.is_member else 0, 2),
+        )
+        if not sub.is_member:
+            return t.gather_key_reprs(sub)
+        t.ingest(
+            np.asarray([f"k{g.rank}"]), np.ones(1, np.float32)
+        )
+        return t.gather_key_reprs(sub)
+
+    results = ThreadWorld(4).run(body)
+    assert results[2] == {} and results[3] == {}
+    assert set(results[0].values()) == {"k0", "k1"}
+    assert results[0] == results[1]

@@ -818,3 +818,71 @@ def test_metric_table_matches_capture():
     assert m and int(m.group(1).replace(",", "")) == wire["world1_full"]
     assert wire["world4_rank"] < wire["world1_full"]
     assert mt["retrace"]["fresh_ragged_programs"] == 0
+
+
+QL = _load("bench_r16_quality_cpu_20260804.json")
+
+
+def test_quality_table_matches_capture():
+    """ISSUE 13: the round-16 data-quality section in docs/benchmarks.md
+    traces to its committed capture, and the capture itself satisfies
+    the acceptance — the watch_inputs-armed serving step's cross-window
+    median paired increment under 2%."""
+    text = _read("docs/benchmarks.md")
+    q = QL["quality"]
+    m = re.search(
+        r"\| unwatched serving step \(forward \+ 3 updates\) \| "
+        r"([\d.]+) µs \| — \|\n"
+        r"\| both distinct inputs watched \| ([\d.]+) µs \| "
+        r"\*\*([\d.]+) µs = ([\d.]+)%\*\* cross-window median",
+        text,
+    )
+    assert m, "r16 off/watched rows not found"
+    assert float(m.group(1)) == pytest.approx(q["off_step_us"], abs=0.05)
+    assert float(m.group(2)) == pytest.approx(
+        q["watched_step_us"], abs=0.05
+    )
+    assert float(m.group(3)) == pytest.approx(
+        q["watched_vs_off_us"], abs=0.05
+    )
+    assert float(m.group(4)) == pytest.approx(
+        q["watched_increment_pct"], abs=0.005
+    )
+    assert float(m.group(4)) == pytest.approx(q["value"], abs=0.005)
+    # the published spread is the capture's per-window medians
+    m = re.search(
+        r"medians spread ([−\-\d.]+) / ([−\-\d.]+) / ([−\-\d.]+) / "
+        r"([−\-\d.]+) / ([−\-\d.]+) µs",
+        text,
+    )
+    assert m, "r16 window spread not found"
+    published = [
+        float(g.replace("−", "-")) for g in m.groups()
+    ]
+    assert published == q["window_median_us"]
+    # the absolute isolated-fold and scrape-path figures trace too
+    m = re.search(r"fold costs ([\d.]+) µs per\n2048-element input", text)
+    assert m and float(m.group(1)) == pytest.approx(
+        q["fold_us_per_input"], abs=0.05
+    )
+    m = re.search(r"pin (\d+) B per watched\ninput", text)
+    assert m and int(m.group(1)) == q["sketch_state_bytes_per_input"]
+    m = re.search(
+        r"`Monitor.check` costs ([\d.]+) µs per check, a full\n"
+        r"`/healthz` probe ([\d.]+) µs",
+        text,
+    )
+    assert m, "r16 scrape-path figures not found"
+    assert float(m.group(1)) == pytest.approx(q["drift_check_us"], abs=0.05)
+    assert float(m.group(2)) == pytest.approx(
+        q["healthz_scrape_us"], abs=0.05
+    )
+    m = re.search(r"measured ([\d.]+) µs per DRAIN", text)
+    assert m and float(m.group(1)) == pytest.approx(
+        q["sync_marginal_us"], abs=0.05
+    )
+    # the acceptance quantities hold in the capture itself
+    assert q["watched_increment_within_2pct"] is True
+    assert q["watched_increment_pct"] <= 2.0
+    assert q["watched_inputs"] == 2
+    assert q["sketched_elements_per_step"] == 4096

@@ -85,6 +85,65 @@ def _program_smoke() -> Report:
         combined.extend(verify_metric_merge(metric))
     combined.extend(_table_ingest_smoke())
     combined.extend(_flight_lockstep_smoke())
+    combined.extend(_quality_smoke())
+    return combined
+
+
+def _quality_smoke() -> Report:
+    """ISSUE 13 tentpole: a ``quality.watch_inputs``-armed update — the
+    watched metric's own kernel plus the sketch folds traced as ONE
+    program — must verify exactly like the unwatched family: zero
+    collectives, no host escapes, donation-sound, for the plain AND the
+    bucketed masked program. Also proves the off-gate: with
+    ``QUALITY.enabled`` False the watched plan IS the baseline plan."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from torcheval_tpu import metrics as M
+    from torcheval_tpu.analysis.program import (
+        verify_metric_compute,
+        verify_metric_update,
+    )
+    from torcheval_tpu.analysis.report import Finding
+    from torcheval_tpu.obs import quality
+
+    rng = np.random.default_rng(13)
+    x2 = jnp.asarray(rng.random((32, 5)).astype(np.float32))
+    t1 = jnp.asarray(rng.integers(0, 5, 32))
+    combined = Report(tool="program")
+    metric = M.MulticlassAccuracy()
+    baseline = metric._update_plan(x2, t1)
+    quality.watch_inputs(metric)
+    report = verify_metric_update(metric, x2, t1)
+    if report is not None:
+        combined.extend(report)
+    combined.extend(verify_metric_compute(metric))
+    prev = quality.QUALITY.enabled
+    quality.QUALITY.enabled = False
+    try:
+        paused = metric._update_plan(x2, t1)
+    finally:
+        quality.QUALITY.enabled = prev
+        for watch in quality.active_watches():
+            watch.close()
+    combined.checked += 1
+    if (
+        paused.kernel is not baseline.kernel
+        or paused.state_names != baseline.state_names
+    ):
+        combined.findings.append(
+            Finding(
+                tool="program",
+                rule="quality-off-gate",
+                path="<watched update plan>",
+                message=(
+                    "with QUALITY.enabled False a watched metric's "
+                    "update plan must be the baseline plan (one "
+                    "attribute read off-guard), got a rewritten plan"
+                ),
+            )
+        )
     return combined
 
 

@@ -371,6 +371,15 @@ class Metric(Generic[TComputeReturn], ABC):
     _routed_states: Dict[str, Any] = types.MappingProxyType({})
     _shard_bookkeeping_registered = False
 
+    # CUSTOM-kind states that must ALSO merge through the sharded
+    # reassembling merge (which by the owner-partitioned contract keeps
+    # CUSTOM non-sharded states at self's value — rank-identical config
+    # scalars). Instrumentation that attaches genuinely mergeable CUSTOM
+    # states to arbitrary metrics (obs/quality.py's input sketches)
+    # lists them here so `_merge_sharded` routes them through
+    # `_merge_custom_state` like the default merge does.
+    _custom_mergeable_states: frozenset = frozenset()
+
     def _donation_active(self) -> bool:
         return self._donated_update and config.update_donation_enabled()
 
@@ -815,7 +824,10 @@ class Metric(Generic[TComputeReturn], ABC):
             if other is self:
                 continue
             for name, kind in self._state_name_to_merge_kind.items():
-                if name in skip or kind is MergeKind.CUSTOM:
+                if name in skip or (
+                    kind is MergeKind.CUSTOM
+                    and name not in self._custom_mergeable_states
+                ):
                     continue
                 mine = getattr(self, name)
                 theirs = self._place_state(getattr(other, name))

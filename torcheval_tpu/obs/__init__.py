@@ -50,6 +50,7 @@ from torcheval_tpu.obs.events import (
     AnalysisEvent,
     CompileEvent,
     ComputeEvent,
+    DriftEvent,
     Event,
     MemoryEvent,
     RestoreEvent,
@@ -77,7 +78,40 @@ from torcheval_tpu.obs.monitor import (
     arm_monitor,
     current_monitor,
     disarm_monitor,
+    register_check_hook,
+    unregister_check_hook,
 )
+# The data-quality layer (obs/sketch.py, obs/quality.py) subclasses
+# Metric, and metric.py imports obs.recorder — importing it eagerly here
+# would close an import cycle whenever `torcheval_tpu.metrics` loads
+# first. PEP 562 lazy attributes break the cycle: the modules load on
+# first attribute access, by which point the metric core is initialized.
+_LAZY_QUALITY = {
+    "QUALITY": "quality",
+    "DriftSpec": "quality",
+    "QualityWatch": "quality",
+    "active_watches": "quality",
+    "watch_inputs": "quality",
+    "InputSketch": "sketch",
+    "SketchConfig": "sketch",
+    "SketchSummary": "sketch",
+    "chan_merge": "sketch",
+    "hll_estimate": "sketch",
+}
+
+
+def __getattr__(name):
+    module = _LAZY_QUALITY.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module 'torcheval_tpu.obs' has no attribute {name!r}"
+        )
+    import importlib
+
+    mod = importlib.import_module(f"torcheval_tpu.obs.{module}")
+    value = getattr(mod, name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
 from torcheval_tpu.obs.server import (
     ObsServer,
     current_server,
@@ -125,26 +159,33 @@ from torcheval_tpu.obs.trace import trace_path
 
 __all__ = [
     "FLIGHT",
+    "QUALITY",
     "SCHEMA_VERSION",
     "AlertEvent",
     "AnalysisEvent",
     "CompileEvent",
     "ComputeEvent",
     "CounterRegistry",
+    "DriftEvent",
+    "DriftSpec",
     "Event",
     "EventLog",
     "EwmaStat",
     "FlightDiff",
     "FlightRecord",
     "FlightRecorder",
+    "InputSketch",
     "JsonlWriter",
     "LatencyHistogram",
     "MemoryEvent",
     "Monitor",
     "ObsServer",
+    "QualityWatch",
     "Recorder",
     "RestoreEvent",
     "RetryEvent",
+    "SketchConfig",
+    "SketchSummary",
     "SloSpec",
     "SnapshotEvent",
     "SpanEvent",
@@ -152,8 +193,10 @@ __all__ = [
     "StallWatchdog",
     "SyncEvent",
     "UpdateEvent",
+    "active_watches",
     "arm_monitor",
     "arm_watchdog",
+    "chan_merge",
     "current_monitor",
     "current_server",
     "current_watchdog",
@@ -165,6 +208,7 @@ __all__ = [
     "enable",
     "enabled",
     "event_from_dict",
+    "hll_estimate",
     "export_chrome_trace",
     "format_flight",
     "format_report",
@@ -179,6 +223,7 @@ __all__ = [
     "program_costs",
     "read_jsonl",
     "recorder",
+    "register_check_hook",
     "render_prometheus",
     "span",
     "per_rank_state_bytes",
@@ -187,4 +232,6 @@ __all__ = [
     "stop_server",
     "trace_path",
     "track_metrics",
+    "unregister_check_hook",
+    "watch_inputs",
 ]

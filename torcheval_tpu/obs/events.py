@@ -41,6 +41,7 @@ __all__ = [
     "AnalysisEvent",
     "CompileEvent",
     "ComputeEvent",
+    "DriftEvent",
     "Event",
     "MemoryEvent",
     "RestoreEvent",
@@ -276,6 +277,27 @@ class StallEvent(Event):
 
 
 @dataclass
+class DriftEvent(Event):
+    """One data-quality drift scoring of a watched input series
+    (``obs/quality.py``), emitted per ``Monitor.check`` while the
+    recorder is on: the post-freeze window size vs the frozen
+    reference, the PSI / histogram-KS / Welch-z scores, and which
+    bounds (if any) the scoring breached (comma-joined, ``""`` when
+    in-bounds). Breaches additionally raise monitor ``AlertEvent``s
+    (cooldown-guarded); this event is the continuous score record."""
+
+    kind: ClassVar[str] = "drift"
+
+    series: str = ""
+    count: float = 0.0
+    ref_count: float = 0.0
+    psi: float = 0.0
+    ks: float = 0.0
+    z: float = 0.0
+    breach: str = ""
+
+
+@dataclass
 class AlertEvent(Event):
     """One SLO/anomaly monitor alert (``obs/monitor.py``): a streaming
     drift detection (``alert="drift"``, EWMA z-score over observed metric
@@ -299,6 +321,7 @@ _EVENT_TYPES: Dict[str, Type[Event]] = {
     cls.kind: cls
     for cls in (
         AlertEvent,
+        DriftEvent,
         AnalysisEvent,
         MemoryEvent,
         StallEvent,

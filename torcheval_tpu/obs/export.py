@@ -240,6 +240,58 @@ def _render_histograms(histograms, prefix: str) -> List[str]:
     return lines
 
 
+def _render_quality_histograms(prefix: str) -> List[str]:
+    """The armed quality watches' value sketches as Prometheus
+    ``histogram`` families: one labeled series set per watched input
+    (``input=<series>``), cumulative ``_bucket{le=<edge>}`` over the
+    sketch's value-space edges with the below-range lane folded into
+    every bucket and ``+Inf`` covering below + bins + above (finite,
+    binnable observations; NaN/Inf ride the ``quality`` gauge source).
+    ``_sum`` is reconstructed from the streaming moments (mean x count
+    over the finite samples — exact up to the moments' f32 precision).
+    Reads the (small) sketch states off-device — scrape cadence by
+    construction, never the step path."""
+    from torcheval_tpu.obs import quality as _quality
+    from torcheval_tpu.obs.sketch import _CNT_ABOVE, _CNT_BELOW
+
+    watches = _quality.active_watches()
+    if not watches:
+        return []
+    family = _prom_name(f"{prefix}_quality_value")
+    lines: List[str] = [f"# TYPE {family} histogram"]
+    emitted = False
+    for watch in watches:
+        edges = watch.config.edges()
+        for series in watch.series:
+            states = watch._states(series)
+            label = _prom_label_value(series)
+            below = float(states["cnt"][_CNT_BELOW])
+            above = float(states["cnt"][_CNT_ABOVE])
+            cumulative = below
+            for edge, count in zip(edges[1:], states["hist"]):
+                cumulative += float(count)
+                lines.append(
+                    f'{family}_bucket{{input="{label}",'
+                    f'le="{format(float(edge), ".9g")}"}} '
+                    f"{format(cumulative, '.12g')}"
+                )
+            total = cumulative + above
+            lines.append(
+                f'{family}_bucket{{input="{label}",le="+Inf"}} '
+                f"{format(total, '.12g')}"
+            )
+            mom = states["mom"]
+            lines.append(
+                f'{family}_sum{{input="{label}"}} '
+                f"{float(mom[0]) * float(mom[1])}"
+            )
+            lines.append(
+                f'{family}_count{{input="{label}"}} {format(total, ".12g")}'
+            )
+            emitted = True
+    return lines if emitted else []
+
+
 def render_prometheus(
     registry=None,
     *,
@@ -283,6 +335,7 @@ def render_prometheus(
             lines.append(f"# TYPE {name} {kind}")
             lines.append(f"{name} {value}")
     lines.extend(_render_histograms(histograms, prefix))
+    lines.extend(_render_quality_histograms(prefix))
     return "\n".join(lines) + "\n"
 
 
@@ -324,6 +377,7 @@ def format_report(
                 f"  {key:<{width}}  n={h.count}  mean={mean_us:.1f}us"
                 f"  p50<={p50:.1f}us  p99<={p99:.1f}us"
             )
+    lines.extend(_quality_report_lines())
     events = log.tail(tail)
     lines.append(f"\n[events] newest {len(events)} of {log.total} recorded")
     for ev in events:
@@ -336,6 +390,52 @@ def format_report(
         fields = " ".join(f"{k}={v}" for k, v in payload.items())
         lines.append(f"  {ev.t_mono:14.3f}  {ev.kind:<9} {fields}")
     return "\n".join(lines) + "\n"
+
+
+def _quality_report_lines() -> List[str]:
+    """The ``format_report`` input-quality table: one line per watched
+    input with count / mean±std / range / conservative p50/p99 /
+    NaN-zero tallies / distinct estimate, plus the last drift scores
+    with their breach flags. Empty when nothing is watched. Reads the
+    sketch states off-device (scrape cadence — this report is never on
+    the step path)."""
+    import math as _math
+
+    from torcheval_tpu.obs import quality as _quality
+
+    watches = _quality.active_watches()
+    if not watches:
+        return []
+    lines = ["\n[quality] (input sketches; p50/p99 conservative bin edges)"]
+    for watch in watches:
+        for series in watch.series:
+            sk = watch.sketch(series)
+            summary = sk.compute()
+            std = _math.sqrt(summary.var) if summary.count else 0.0
+            p50 = sk.quantile(0.5)
+            p99 = sk.quantile(0.99)
+            q = (
+                f"p50<={p50:.4g} p99<={p99:.4g}"
+                if p50 is not None
+                else "p50/p99=n/a"
+            )
+            lines.append(
+                f"  {series}  n={summary.count:.0f}"
+                f"  mean={summary.mean:.4g}±{std:.4g}"
+                f"  range=[{summary.min:.4g}, {summary.max:.4g}]  {q}"
+                f"  nan={summary.nan} inf={summary.posinf + summary.neginf}"
+                f" zero={summary.zero} neg={summary.negative}"
+                f"  distinct~{summary.distinct:.0f}"
+            )
+            scores = watch._scores.get(series)
+            if scores:
+                lines.append(
+                    f"    drift: psi={scores['psi']:.4g}"
+                    f" ks={scores['ks']:.4g} z={scores['z']:.4g}"
+                    f" (window n={scores['count']:.0f}"
+                    f" vs ref n={scores['ref_count']:.0f})"
+                )
+    return lines
 
 
 def _check_rank_scoped(group, what: str) -> Optional[Dict[str, Any]]:

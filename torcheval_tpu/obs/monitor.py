@@ -50,7 +50,34 @@ __all__ = [
     "arm_monitor",
     "current_monitor",
     "disarm_monitor",
+    "register_check_hook",
+    "unregister_check_hook",
 ]
+
+# Pluggable check hooks: subsystems with their own drift machinery (the
+# data-quality layer, obs/quality.py) register ``fn(monitor) -> [alert
+# dicts]`` here; EVERY Monitor.check runs them at its cadence (so
+# ``/healthz`` probes score them with zero loop code), regardless of
+# which Monitor instance runs — arming a scoped monitor must not drop
+# the process's quality checks. A raising hook is isolated (one broken
+# scorer must not fail the health probe), surfacing as a ``hook-error``
+# entry in that check's raised list instead.
+_CHECK_HOOKS: Dict[str, Any] = {}
+_HOOK_LOCK = threading.Lock()
+
+
+def register_check_hook(name: str, fn) -> None:
+    """Register ``fn(monitor) -> Optional[List[dict]]`` to run inside
+    every :meth:`Monitor.check` (replaces an existing hook of the same
+    name)."""
+    with _HOOK_LOCK:
+        _CHECK_HOOKS[name] = fn
+
+
+def unregister_check_hook(name: str) -> None:
+    """Remove a check hook (no-op when absent)."""
+    with _HOOK_LOCK:
+        _CHECK_HOOKS.pop(name, None)
 
 
 class EwmaStat:
@@ -345,6 +372,22 @@ class Monitor:
                     raised.append(alert)
             else:
                 self._clear(spec.name, "threshold")
+
+        # pluggable check hooks (quality drift scoring et al.) — isolated
+        # so one broken scorer cannot fail the health probe
+        with _HOOK_LOCK:
+            hooks = sorted(_CHECK_HOOKS.items())
+        for hook_name, fn in hooks:
+            try:
+                raised.extend(fn(self) or [])
+            except Exception as e:  # noqa: BLE001 — one hook, not the check
+                raised.append(
+                    {
+                        "name": f"hook/{hook_name}",
+                        "alert": "hook-error",
+                        "message": f"{type(e).__name__}: {e}",
+                    }
+                )
 
         # latency drift: feed the p99 of the NEW samples per digest key
         for key in sorted(histograms):

@@ -1,0 +1,771 @@
+# tev: scope=host — drift scoring, counter scrapes, and reference
+# freezing are host-side, scrape-cadence surfaces by design; the only
+# jit-reachable code here is the combined-kernel factory, whose bodies
+# are the sketch fold kernels (obs/sketch.py) plus the watched metric's
+# own kernel.
+"""Input-quality watching & drift scoring (data-quality telemetry).
+
+:func:`watch_inputs` arms DATA observability on an existing metric (or a
+``{name: Metric}`` collection): the four sketch state families of
+:class:`~torcheval_tpu.obs.sketch.InputSketch` are registered as
+ordinary states ON the watched metric (``_add_state`` — so they ride
+sync / merge / elastic snapshots / subgroup scoping / the sharded merge
+for free), and the metric's fusable update plan is extended so sketch
+accumulation happens INSIDE the same fused update program:
+
+- **zero extra dispatches**: the combined kernel traces the metric's own
+  kernel plus the sketch folds into one XLA program (``_fuse.py``);
+- **zero collectives, zero host syncs**: statically verified by the
+  ``analysis --programs`` ``_quality_smoke`` and pinned at runtime by
+  the quality-armed variants in tests/metrics/test_no_host_sync.py and
+  test_sync_collective_counts.py;
+- **one attribute-read off-guard**: accumulation is gated on
+  ``QUALITY.enabled`` — paused, a watched metric's ``_update_plan``
+  costs one attribute read over the unwatched path (and an UNwatched
+  metric pays literally nothing);
+- **bucketed masked twins**: when the watched plan declares a masked
+  kernel, the combined plan does too — padded rows contribute exactly
+  zero to every sketch state, so a warmed watched metric stays
+  retrace-proof under ``config.shape_bucketing()``.
+
+:class:`DriftSpec` scores the live sketches against a frozen reference
+window at the PR 10 ``Monitor.check()`` cadence (the health server runs
+it per ``/healthz`` probe): population-stability index (PSI) and
+histogram-KS over the quantile histogram (below/above-range lanes
+included), and a Welch z on the streaming means — all computed on the
+POST-FREEZE window (SUM states subtract exactly; the moments window is
+the exact Chan-merge inverse), so the reference does not dilute the
+signal. Breaches raise cooldown-guarded monitor alerts (degrading
+``/healthz`` to 503 like any SLO breach) and every scored check emits a
+typed :class:`~torcheval_tpu.obs.events.DriftEvent` while the recorder
+is on. The ``quality`` counter source publishes per-input gauges
+(count, NaN/zero/negative totals, mean/std, distinct estimate, drift
+scores, per-spec breach flags) and ``render_prometheus`` adds the value
+histograms as proper ``histogram`` families.
+
+Cost contract: the step path never reads a device value — scoring,
+scraping, and ``freeze_reference`` force readbacks of the (small)
+sketch states at check/scrape cadence only, the documented exception
+shared with ``MetricTable.scrape_values``.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import types
+import weakref
+from functools import lru_cache
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.metric import Metric, MergeKind, UpdatePlan
+from torcheval_tpu.obs.sketch import (
+    CNT_FIELDS,
+    InputSketch,
+    SketchConfig,
+    chan_merge,
+    default_config,
+    hll_estimate,
+    moment_default,
+    moments_window,
+    _fold_fns,
+)
+
+__all__ = [
+    "DriftSpec",
+    "QUALITY",
+    "QualityWatch",
+    "active_watches",
+    "watch_inputs",
+]
+
+_STATE_SUFFIXES = ("hist", "cnt", "mom", "reg")
+
+# per-metric extended-plan memo (see _watched_update_plan); weak keys so
+# a dropped metric never pins its plan (or the kernels it closes over)
+_PLAN_MEMO: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _q_names(i: int) -> Tuple[str, ...]:
+    return tuple(f"_q{i}_{s}" for s in _STATE_SUFFIXES)
+
+
+class _QualityState:
+    """The one-attribute-read accumulation gate (the ``FLIGHT.enabled``
+    idiom): watched metrics extend their update plans only while
+    ``enabled`` is True. Watching is the explicit per-metric opt-in, so
+    the gate defaults ON; pause it to measure or bypass accumulation
+    without un-watching."""
+
+    __slots__ = ("enabled",)
+
+    def __init__(self) -> None:
+        self.enabled = True
+
+
+QUALITY = _QualityState()
+
+
+class DriftSpec(NamedTuple):
+    """Drift bounds for one watched input (or ``series="*"`` for all).
+
+    Scores are computed on the post-freeze window vs the frozen
+    reference: ``psi`` bounds the population-stability index over the
+    histogram lanes (industry rule of thumb: 0.1 moderate, 0.2
+    significant shift), ``ks`` the max CDF distance, ``z`` the absolute
+    Welch z-statistic of the window mean vs the reference mean.
+    ``min_count`` gates scoring until the window holds that many finite
+    samples (a cold window cannot drift).
+    """
+
+    series: str = "*"
+    psi: float = 0.2
+    ks: float = 0.2
+    z: float = 6.0
+    min_count: int = 256
+
+
+class _WatchSpec(NamedTuple):
+    """Per-metric instrumentation record (hashable core only)."""
+
+    args: Tuple[int, ...]
+    sketch: SketchConfig
+
+
+# ------------------------------------------------------ combined kernels
+
+
+def _normalize(out: Any, n: int, kernel: Any) -> Tuple:
+    if not isinstance(out, tuple):
+        out = (out,)
+    if len(out) != n:
+        raise ValueError(
+            f"kernel {getattr(kernel, '__name__', kernel)} returned "
+            f"{len(out)} values for {n} states"
+        )
+    return out
+
+
+@lru_cache(maxsize=None)
+def _combined_kernels(
+    orig_kernel,
+    orig_masked,
+    transform: bool,
+    n_orig: int,
+    orig_config: Tuple,
+    arg_indices: Tuple[int, ...],
+    cfg: SketchConfig,
+    mask_pos: Tuple[int, ...],
+):
+    """(plain, masked) transform kernels running the watched metric's
+    own kernel plus one sketch fold per watched dynamic argument, as ONE
+    traced body. Cached per (kernel, config, watch geometry) so repeated
+    updates key the same jit entries — the cache-key discipline of
+    ``_fuse.py``. ``mask_pos[k]`` is the index of watched arg k's batch
+    label in the bucketed valid-extent vector (-1: no ragged axis — all
+    rows valid)."""
+    fold = _fold_fns(cfg)
+
+    def _orig_part(states, dyn, kernel):
+        orig_states = states[:n_orig]
+        if transform:
+            return _normalize(
+                kernel(orig_states, *dyn, *orig_config), n_orig, kernel
+            )
+        deltas = _normalize(kernel(*dyn, *orig_config), n_orig, kernel)
+        return tuple(s + d for s, d in zip(orig_states, deltas))
+
+    def _sketch_part(states, dyn, weights):
+        out = []
+        for k, i in enumerate(arg_indices):
+            s4 = states[n_orig + 4 * k : n_orig + 4 * (k + 1)]
+            out.extend(fold(s4, dyn[i], weights[k]))
+        return tuple(out)
+
+    def plain(states, *dyn):
+        ones = tuple(jnp.float32(1.0) for _ in arg_indices)
+        return _orig_part(states, dyn, orig_kernel) + _sketch_part(
+            states, dyn, ones
+        )
+
+    masked = None
+    if orig_masked is not None:
+
+        def masked(states, *args):
+            dyn, valid = args[:-1], args[-1]
+            weights = []
+            for k, i in enumerate(arg_indices):
+                pos = mask_pos[k]
+                if pos < 0:
+                    weights.append(jnp.float32(1.0))
+                    continue
+                x = jnp.asarray(dyn[i])
+                row = jnp.arange(x.shape[0], dtype=jnp.int32) < valid[pos]
+                w = row.astype(jnp.float32).reshape(
+                    (x.shape[0],) + (1,) * (x.ndim - 1)
+                )
+                weights.append(jnp.broadcast_to(w, x.shape))
+            # the original masked kernel keeps its own (*dyn, valid)
+            # signature; the sketch folds consume the same valid vector
+            if transform:
+                orig_states = states[:n_orig]
+                new_orig = _normalize(
+                    orig_masked(orig_states, *dyn, valid, *orig_config),
+                    n_orig,
+                    orig_masked,
+                )
+            else:
+                deltas = _normalize(
+                    orig_masked(*dyn, valid, *orig_config), n_orig, orig_masked
+                )
+                new_orig = tuple(
+                    s + d for s, d in zip(states[:n_orig], deltas)
+                )
+            return new_orig + _sketch_part(states, dyn, tuple(weights))
+
+        masked.__name__ = f"{getattr(orig_masked, '__name__', 'kernel')}_q"
+
+    plain.__name__ = f"{getattr(orig_kernel, '__name__', 'kernel')}_q"
+    return plain, masked
+
+
+def _extend_plan(plan, spec: _WatchSpec):
+    """Rewrite one fusable update plan into its quality-watched twin:
+    same dynamic arguments, original states first, four sketch states
+    per watched argument appended, one combined transform kernel (and
+    masked twin when the original declares one)."""
+    if not isinstance(plan, UpdatePlan):
+        kernel, names, dynamic, *rest = plan
+        plan = UpdatePlan(kernel, names, dynamic, rest[0] if rest else ())
+    bad = [i for i in spec.args if i >= len(plan.dynamic)]
+    if bad:
+        raise ValueError(
+            f"watch_inputs args {bad} are out of range for this "
+            f"metric's update plan ({len(plan.dynamic)} dynamic "
+            "argument(s)) — watched indices name positional update "
+            "arguments"
+        )
+    order: List[str] = []
+    for labels in plan.batch_axes:
+        for label in labels or ():
+            if label not in order:
+                order.append(label)
+    mask_pos = []
+    for i in spec.args:
+        labels = (
+            plan.batch_axes[i] if i < len(plan.batch_axes) else ()
+        ) or ()
+        mask_pos.append(order.index(labels[0]) if labels else -1)
+    combined, combined_masked = _combined_kernels(
+        plan.kernel,
+        plan.masked_kernel,
+        plan.transform,
+        len(plan.state_names),
+        plan.config,
+        spec.args,
+        spec.sketch,
+        tuple(mask_pos),
+    )
+    state_names = plan.state_names + tuple(
+        name for i in spec.args for name in _q_names(i)
+    )
+    return UpdatePlan(
+        combined,
+        state_names,
+        plan.dynamic,
+        (),
+        transform=True,
+        finalize=plan.finalize,
+        masked_kernel=combined_masked,
+        batch_axes=plan.batch_axes if combined_masked is not None else (),
+    )
+
+
+# module-level functions (not closures) so bound-method instance
+# attributes survive deepcopy (clone rebinds to the copy) and pickling
+def _watched_update_plan(self, *args: Any, **kwargs: Any):
+    plan = type(self)._update_plan(self, *args, **kwargs)
+    if plan is None or not QUALITY.enabled:  # the one-attribute-read gate
+        return plan
+    # steady-state fast path: a metric's plan shape (kernel/states/
+    # masked twin/config/axes) is stable across updates — memoize the
+    # rewrite per metric and only swap the per-call dynamic tuple.
+    # Keyed on every field the rewrite depends on, so a metric that
+    # switches plans (e.g. routed vs dense) still rewrites correctly.
+    # The memo lives OFF the instance (weak-keyed module table): the
+    # rewritten plan holds unpicklable kernel closures, and instance
+    # state must stay deepcopy/pickle-clean (clones just re-memoize).
+    if isinstance(plan, UpdatePlan):
+        memo_key = (
+            plan.kernel,
+            plan.masked_kernel,
+            plan.state_names,
+            plan.config,
+            plan.transform,
+            plan.batch_axes,
+        )
+        memo = _PLAN_MEMO.get(self)
+        if memo is not None and memo[0] == memo_key:
+            return memo[1]._replace(
+                dynamic=plan.dynamic, finalize=plan.finalize
+            )
+        extended = _extend_plan(plan, self._quality_spec)
+        # memoize WITHOUT the per-call fields (dynamic pins a batch's
+        # device arrays; finalize may close over per-call state)
+        _PLAN_MEMO[self] = (
+            memo_key,
+            extended._replace(dynamic=(), finalize=None),
+        )
+        return extended
+    return _extend_plan(plan, self._quality_spec)
+
+
+def _watched_merge_custom(self, name: str, mine, theirs):
+    if name.startswith("_q") and name.endswith("_mom"):
+        # pairwise in carrier (ascending-rank) order — the toolkit merge
+        # left-folds peers per state, so this IS Chan's
+        # pairwise-in-rank-order merge (obs/sketch.py)
+        return chan_merge(mine, theirs)
+    return type(self)._merge_custom_state(self, name, mine, theirs)
+
+
+def _validate_watchable(metric: Metric) -> None:
+    """The pre-instrumentation checks, separated so a COLLECTION watch
+    validates every member BEFORE instrumenting any — a TypeError on
+    the third member must not leave the first two permanently
+    instrumented with no handle to close or re-watch them."""
+    if getattr(metric, "_quality_spec", None) is not None:
+        raise ValueError(
+            f"{type(metric).__name__} is already quality-watched"
+        )
+    if type(metric)._update_plan is Metric._update_plan:
+        raise TypeError(
+            f"watch_inputs requires a metric with a fusable update plan "
+            f"({type(metric).__name__} has none — buffered/host-side "
+            "updates cannot fuse sketch accumulation)"
+        )
+
+
+def _instrument(metric: Metric, spec: _WatchSpec) -> None:
+    _validate_watchable(metric)
+    cfg = spec.sketch
+    for i in spec.args:
+        h, c, m, r = _q_names(i)
+        metric._add_state(
+            h, jnp.zeros((cfg.num_bins,), jnp.float32), merge=MergeKind.SUM
+        )
+        metric._add_state(c, jnp.zeros((8,), jnp.int32), merge=MergeKind.SUM)
+        metric._add_state(m, moment_default(), merge=MergeKind.CUSTOM)
+        metric._add_state(
+            r, jnp.zeros((cfg.registers,), jnp.int32), merge=MergeKind.MAX
+        )
+    metric._quality_spec = spec
+    # the moments state must ALSO merge through the sharded reassembly
+    # path, which by contract keeps CUSTOM non-sharded states at self's
+    # value unless they are declared custom-mergeable (metric.py)
+    metric._custom_mergeable_states = frozenset(
+        metric._custom_mergeable_states
+    ) | {_q_names(i)[2] for i in spec.args}
+    metric._update_plan = types.MethodType(_watched_update_plan, metric)
+    metric._merge_custom_state = types.MethodType(
+        _watched_merge_custom, metric
+    )
+
+
+# --------------------------------------------------------------- watching
+
+_WATCHES: "Dict[int, QualityWatch]" = {}
+_WATCH_LOCK = threading.Lock()
+_WATCH_SEQ = [0]
+
+
+def active_watches() -> List["QualityWatch"]:
+    """The live :class:`QualityWatch` handles (exporters iterate this)."""
+    with _WATCH_LOCK:
+        return list(_WATCHES.values())
+
+
+def _quality_counters() -> Dict[str, Any]:
+    out: Dict[str, Any] = {"watched_inputs": 0}
+    for watch in active_watches():
+        counters = watch.counters()
+        out["watched_inputs"] += counters.pop("watched_inputs", 0)
+        out.update(counters)
+    return out
+
+
+def _check_watches(monitor) -> List[Dict[str, Any]]:
+    raised: List[Dict[str, Any]] = []
+    for watch in active_watches():
+        raised.extend(watch.check(monitor))
+    return raised
+
+
+def _register_global_hooks() -> None:
+    from torcheval_tpu.obs.counters import default_registry
+    from torcheval_tpu.obs.monitor import register_check_hook
+
+    default_registry().register("quality", _quality_counters)
+    register_check_hook("quality", _check_watches)
+
+
+def _unregister_global_hooks() -> None:
+    from torcheval_tpu.obs.counters import default_registry
+    from torcheval_tpu.obs.monitor import unregister_check_hook
+
+    default_registry().unregister("quality")
+    unregister_check_hook("quality")
+
+
+def watch_inputs(
+    metric_or_collection,
+    *,
+    args: Tuple[int, ...] = (0,),
+    num_bins: Optional[int] = None,
+    bounds: Optional[Tuple[float, float]] = None,
+    log2_bounds: Tuple[int, int] = (-24, 24),
+    registers: int = 64,
+    label: Optional[str] = None,
+) -> "QualityWatch":
+    """Arm input-quality sketches on a metric or ``{name: Metric}``
+    collection (module docstring has the cost/fusion contract).
+
+    ``args`` names the watched positional update arguments (default: the
+    first — conventionally the prediction/input tensor); sketch geometry
+    knobs mirror :class:`~torcheval_tpu.obs.sketch.InputSketch`. Each
+    watched input becomes a series ``<label>/<arg index>`` (collection
+    members use their collection key as label).
+
+    Returns a :class:`QualityWatch` — the handle for reference freezing,
+    drift specs, sketch snapshots, and teardown (``close()``).
+    """
+    cfg = default_config(num_bins, bounds, log2_bounds, registers)
+    args = tuple(sorted(set(int(i) for i in args)))
+    if not args or any(i < 0 for i in args):
+        raise ValueError(f"args must be non-negative indices, got {args!r}")
+    spec = _WatchSpec(args=args, sketch=cfg)
+    if isinstance(metric_or_collection, dict):
+        members = list(metric_or_collection.items())
+        if not members:
+            raise ValueError("watch_inputs: empty collection")
+    else:
+        members = [
+            (label or type(metric_or_collection).__name__,
+             metric_or_collection)
+        ]
+    entries = []
+    for name, metric in members:
+        _validate_watchable(metric)
+        for i in args:
+            entries.append((f"{name}/{i}", metric, i))
+    # series names must be unique ACROSS watches: a collision silently
+    # merges two inputs' gauges in the quality counter source, emits
+    # duplicate Prometheus series, and lets one watch's in-bounds check
+    # clear the other's standing drift alert
+    with _WATCH_LOCK:
+        taken = {
+            series
+            for other in _WATCHES.values()
+            for series in other.series
+        }
+    clashes = sorted({s for s, _, _ in entries} & taken)
+    if clashes:
+        raise ValueError(
+            f"watch series {clashes} already exist on an active watch; "
+            "pass label= (or distinct collection keys) to disambiguate"
+        )
+    for name, metric in members:
+        _instrument(metric, spec)
+    watch = QualityWatch(entries, cfg)
+    with _WATCH_LOCK:
+        _WATCH_SEQ[0] += 1
+        watch._id = _WATCH_SEQ[0]
+        _WATCHES[watch._id] = watch
+        _register_global_hooks()
+    return watch
+
+
+class QualityWatch:
+    """Handle over a set of watched inputs (one per (metric, arg)).
+
+    ``series`` keys are ``<label>/<arg index>``. Reading methods
+    (``sketch``, ``summary``, ``counters``, ``check``) force a device
+    readback of the sketch states — scrape/check cadence only.
+    """
+
+    def __init__(self, entries, config: SketchConfig) -> None:
+        self._entries: Dict[str, Tuple[Metric, int]] = {
+            series: (metric, arg) for series, metric, arg in entries
+        }
+        self.config = config
+        self._id = 0
+        self._lock = threading.Lock()
+        self._refs: Dict[str, Dict[str, np.ndarray]] = {}
+        self._specs: List[DriftSpec] = []
+        self._scores: Dict[str, Dict[str, float]] = {}
+
+    @property
+    def series(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._entries))
+
+    def _states(self, series: str) -> Dict[str, np.ndarray]:
+        metric, arg = self._entries[series]
+        h, c, m, r = _q_names(arg)
+        return {
+            "hist": np.asarray(getattr(metric, h)),
+            "cnt": np.asarray(getattr(metric, c)),
+            "mom": np.asarray(getattr(metric, m)),
+            "reg": np.asarray(getattr(metric, r)),
+        }
+
+    def sketch(self, series: str) -> InputSketch:
+        """A standalone :class:`InputSketch` loaded from the live sketch
+        states of one watched input (an independent snapshot)."""
+        metric, arg = self._entries[series]
+        cfg = self.config
+        if cfg.log2:
+            sk = InputSketch(
+                num_bins=cfg.num_bins,
+                log2_bounds=(int(cfg.lo), int(cfg.hi)),
+                registers=cfg.registers,
+            )
+        else:
+            sk = InputSketch(
+                num_bins=cfg.num_bins,
+                bounds=(cfg.lo, cfg.hi),
+                registers=cfg.registers,
+            )
+        h, c, m, r = _q_names(arg)
+        sk.load_state_dict(
+            {
+                "hist": getattr(metric, h),
+                "counts": getattr(metric, c),
+                "moments": getattr(metric, m),
+                "registers": getattr(metric, r),
+            }
+        )
+        return sk
+
+    # ----------------------------------------------------------- drift
+
+    def freeze_reference(self) -> None:
+        """Snapshot every watched input's live sketch as the drift
+        reference window. Scoring compares the POST-freeze window
+        against this snapshot; call it after the reference traffic has
+        been observed (and again to re-baseline)."""
+        refs = {series: self._states(series) for series in self._entries}
+        with self._lock:
+            self._refs = refs
+
+    def add_drift(self, *specs: DriftSpec) -> None:
+        """Arm drift scoring: freezes a reference now if none exists and
+        registers the specs (``series="*"`` applies to every watched
+        input). Scoring runs inside ``Monitor.check()`` — whichever
+        monitor instance runs the check (the armed global one at
+        ``/healthz`` cadence, or a test-local instance)."""
+        specs = specs or (DriftSpec(),)
+        for spec in specs:
+            if spec.series != "*" and spec.series not in self._entries:
+                raise KeyError(
+                    f"DriftSpec series {spec.series!r} is not watched "
+                    f"(watched: {sorted(self._entries)})"
+                )
+        with self._lock:
+            need_ref = not self._refs
+            self._specs.extend(specs)
+        if need_ref:
+            self.freeze_reference()
+
+    def _series_specs(self) -> Dict[str, DriftSpec]:
+        with self._lock:
+            specs = list(self._specs)
+        out: Dict[str, DriftSpec] = {}
+        for spec in specs:
+            if spec.series == "*":
+                for series in self._entries:
+                    out.setdefault(series, spec)
+            else:
+                out[spec.series] = spec
+        return out
+
+    def score(self, series: str) -> Optional[Dict[str, float]]:
+        """PSI / KS / z of the post-freeze window vs the frozen
+        reference (None when no reference is frozen for ``series``)."""
+        with self._lock:
+            ref = self._refs.get(series)
+        if ref is None:
+            return None
+        live = self._states(series)
+        return _drift_scores(live, ref)
+
+    def check(self, monitor) -> List[Dict[str, Any]]:
+        """Score every specced series; raise cooldown-guarded monitor
+        alerts for breaches and emit a DriftEvent per scored series
+        (recorder-gated). Called by ``Monitor.check`` via the quality
+        check hook."""
+        from torcheval_tpu.obs.events import DriftEvent
+        from torcheval_tpu.obs.recorder import RECORDER
+
+        raised: List[Dict[str, Any]] = []
+        for series, spec in sorted(self._series_specs().items()):
+            scores = self.score(series)
+            if scores is None:
+                continue
+            with self._lock:
+                self._scores[series] = scores
+            if scores["count"] < spec.min_count:
+                # a re-baseline (freeze_reference / reset) shrinks the
+                # window below the gate: standing alerts from the OLD
+                # window must clear, or /healthz stays 503 until the
+                # new window warms (forever, if the stream stopped)
+                for kind in ("psi", "ks", "z"):
+                    monitor._clear(f"quality/{series}", f"drift-{kind}")
+                continue
+            breaches = []
+            for kind, bound in (
+                ("psi", spec.psi),
+                ("ks", spec.ks),
+                ("z", spec.z),
+            ):
+                value = abs(scores[kind])
+                name = f"quality/{series}"
+                if bound > 0 and value >= bound:
+                    breaches.append(kind)
+                    alert = monitor._alert(
+                        name,
+                        f"drift-{kind}",
+                        scores[kind],
+                        bound,
+                        f"{series} input drift: {kind}={scores[kind]:.4g} "
+                        f"breaches bound {bound:g} over a "
+                        f"{scores['count']:.0f}-sample window "
+                        f"(ref {scores['ref_count']:.0f})",
+                    )
+                    if alert:
+                        raised.append(alert)
+                else:
+                    monitor._clear(name, f"drift-{kind}")
+            RECORDER.record(
+                DriftEvent(
+                    series=series,
+                    count=float(scores["count"]),
+                    ref_count=float(scores["ref_count"]),
+                    psi=float(scores["psi"]),
+                    ks=float(scores["ks"]),
+                    z=float(scores["z"]),
+                    breach=",".join(breaches),
+                )
+            )
+        return raised
+
+    # -------------------------------------------------------- counters
+
+    def counters(self) -> Dict[str, Any]:
+        """The ``quality`` counter-source payload: per-series gauges
+        (device readback — scrape cadence) plus the last drift scores
+        and per-spec breach flags."""
+        out: Dict[str, Any] = {"watched_inputs": len(self._entries)}
+        with self._lock:
+            scores = dict(self._scores)
+        specs = self._series_specs()
+        for series in self.series:
+            s = self._states(series)
+            mom = s["mom"].astype(np.float64)
+            cnt = s["cnt"]
+            key = series
+            count = float(mom[0])
+            out[f"{key}_count"] = count
+            out[f"{key}_mean"] = float(mom[1]) if count else 0.0
+            out[f"{key}_std"] = (
+                math.sqrt(max(float(mom[2]) / count, 0.0)) if count else 0.0
+            )
+            for lane, field in enumerate(CNT_FIELDS):
+                if field in ("total", "nan", "posinf", "neginf", "zero",
+                             "negative"):
+                    out[f"{key}_{field}"] = int(cnt[lane])
+            out[f"{key}_distinct"] = hll_estimate(s["reg"])
+            sc = scores.get(series)
+            if sc is not None:
+                out[f"{key}_psi"] = sc["psi"]
+                out[f"{key}_ks"] = sc["ks"]
+                out[f"{key}_z"] = sc["z"]
+                spec = specs.get(series)
+                if spec is not None:
+                    out[f"{key}_breach_psi"] = int(
+                        spec.psi > 0 and abs(sc["psi"]) >= spec.psi
+                        and sc["count"] >= spec.min_count
+                    )
+                    out[f"{key}_breach_ks"] = int(
+                        spec.ks > 0 and abs(sc["ks"]) >= spec.ks
+                        and sc["count"] >= spec.min_count
+                    )
+                    out[f"{key}_breach_z"] = int(
+                        spec.z > 0 and abs(sc["z"]) >= spec.z
+                        and sc["count"] >= spec.min_count
+                    )
+        return out
+
+    def close(self) -> None:
+        """Detach this watch from the exporters and the check hook (the
+        sketch states REMAIN on the watched metrics — state removal
+        would break strict snapshot loads mid-stream). The emptiness
+        check and the hook unregister happen under ONE lock hold — a
+        concurrent ``watch_inputs`` between them could otherwise lose
+        its just-registered hooks."""
+        with _WATCH_LOCK:
+            _WATCHES.pop(self._id, None)
+            if not _WATCHES:
+                _unregister_global_hooks()
+
+
+def _drift_scores(
+    live: Dict[str, np.ndarray], ref: Dict[str, np.ndarray]
+) -> Dict[str, float]:
+    """PSI + histogram-KS + Welch z of the post-freeze window vs the
+    reference. Window lanes: [below, bin_0..bin_{B-1}, above] — the
+    out-of-range mass is part of the distribution (a shift past the
+    edges must not be invisible)."""
+    from torcheval_tpu.obs.sketch import _CNT_ABOVE, _CNT_BELOW  # lanes
+
+    def lanes(s):
+        return np.concatenate(
+            (
+                [float(s["cnt"][_CNT_BELOW])],
+                np.asarray(s["hist"], np.float64),
+                [float(s["cnt"][_CNT_ABOVE])],
+            )
+        )
+
+    ref_lanes = lanes(ref)
+    win_lanes = lanes(live) - ref_lanes
+    mom_w = moments_window(live["mom"], ref["mom"])
+    mom_r = np.asarray(ref["mom"], np.float64)
+    n_w, n_r = float(mom_w[0]), float(mom_r[0])
+    out = {
+        "count": n_w,
+        "ref_count": n_r,
+        "psi": 0.0,
+        "ks": 0.0,
+        "z": 0.0,
+    }
+    rt, wt = float(ref_lanes.sum()), float(win_lanes.sum())
+    if rt > 0 and wt > 0:
+        eps = 1e-6
+        p = np.maximum(ref_lanes / rt, eps)
+        q = np.maximum(win_lanes / wt, eps)
+        out["psi"] = float(np.sum((q - p) * np.log(q / p)))
+        out["ks"] = float(
+            np.max(np.abs(np.cumsum(win_lanes / wt - ref_lanes / rt)))
+        )
+    if n_w > 0 and n_r > 0:
+        var_w = max(float(mom_w[2]) / n_w, 0.0)
+        var_r = max(float(mom_r[2]) / n_r, 0.0)
+        denom = math.sqrt(var_w / n_w + var_r / n_r)
+        if denom > 0:
+            out["z"] = (float(mom_w[1]) - float(mom_r[1])) / denom
+        elif float(mom_w[1]) != float(mom_r[1]):
+            out["z"] = math.inf if mom_w[1] > mom_r[1] else -math.inf
+    return out

@@ -1,0 +1,614 @@
+"""Mergeable on-device input-distribution sketches (data-quality telemetry).
+
+PR 5/8/10 observe the eval's *execution* (latency, retries, stalls);
+nothing observes *what the eval is seeing* — input/prediction
+distributions, NaN rates, label skew, drift vs a reference window. That
+layer is also the prerequisite for the ROADMAP item 2 lossy wire
+encodings (EQuARX arXiv:2506.17615, Prime CCL arXiv:2505.14065):
+quantized / staleness-tolerant merges only ship safely when per-metric
+distribution and error budgets are continuously *measured*, not assumed.
+
+:class:`InputSketch` is a fixed-size, mergeable distribution sketch that
+is itself an ordinary :class:`~torcheval_tpu.metrics.metric.Metric` —
+its four state families are registered through ``_add_state`` with
+declarative merge kinds, so sync, merge, elastic snapshot/restore,
+subgroup scoping, and the bucketed masked-twin machinery all apply with
+ZERO new protocol code:
+
+- ``hist`` (f32 ``(num_bins,)``, SUM): a log₂ or fixed-edge quantile
+  histogram through the PR 6 ``ops.histogram`` kernel (native on the CPU
+  lowering, bit-identical XLA twin elsewhere). Fixed-edge mode bins
+  values over ``bounds=(lo, hi)``; log₂ mode (the default — no prior
+  knowledge of the value range needed) bins ``log2(|x|)`` over an
+  exponent range, so ~2x relative resolution everywhere. Counts are
+  integer-valued f32 — sums are exact (and therefore merge-order
+  invariant) below 2^24 per bin.
+- ``counts`` (int32 ``(8,)``, SUM): total / NaN / +Inf / -Inf / zero /
+  negative / below-range / above-range counters. Integer adds — exact
+  and associative, so every merge order is bit-identical.
+- ``moments`` (f32 ``(5,)``, CUSTOM): streaming ``[count, mean, M2,
+  min, max]`` over the finite samples. Updates fold each batch's
+  two-pass stats into the carried state with Chan's parallel merge; the
+  cross-replica merge applies the SAME formula pairwise in ascending
+  rank order (:func:`chan_merge`), with the empty-side identities exact
+  (``a ⊕ empty`` returns ``a``'s bits verbatim), so a left fold over
+  rank-ordered carriers replays the single-stream fold bit-for-bit when
+  the carriers partition the stream in rank order.
+- ``registers`` (int32 ``(registers,)``, MAX): a deterministic
+  register-array distinct-count sketch (Flajolet–Martin / HyperLogLog
+  family) over the raw f32 bit patterns, hashed with the murmur3
+  finalizer — NOT Python's salted ``hash``, so every rank and every
+  restart agrees. MAX merges are idempotent, commutative, associative:
+  bit-identical under any merge order, any world change, and double
+  counting (the one sketch that is safe under at-least-once delivery).
+
+``update(values)`` is ONE fused transform dispatch (``_fuse.py``) with a
+mask-aware twin, so sketches ride shape bucketing and donation like any
+counter metric; the fold kernels are shared with
+:func:`~torcheval_tpu.obs.quality.watch_inputs`, which fuses the same
+accumulation into a *watched* metric's own update program (zero extra
+dispatches, zero collectives, zero host syncs — statically verified by
+the ``analysis --programs`` sweep).
+
+Cost/exactness contract: nothing here reads the device on the update
+path. Reading a sketch (``compute()``, ``summary()``, drift scoring,
+Prometheus scrape) is a host readback — scrape-cadence territory, never
+step-path (docs/observability.md, "Input quality & drift").
+"""
+
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu._ffi import ffi as _ffi
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
+from torcheval_tpu.ops.histogram import histogram as _ops_histogram
+from torcheval_tpu.ops.segment import segment_max as _segment_max
+
+__all__ = [
+    "InputSketch",
+    "SketchConfig",
+    "SketchSummary",
+    "chan_merge",
+    "hll_estimate",
+]
+
+# counts lanes (int32 (8,) SUM state)
+_CNT_TOTAL = 0
+_CNT_NAN = 1
+_CNT_POSINF = 2
+_CNT_NEGINF = 3
+_CNT_ZERO = 4
+_CNT_NEG = 5
+_CNT_BELOW = 6
+_CNT_ABOVE = 7
+
+CNT_FIELDS = (
+    "total", "nan", "posinf", "neginf", "zero", "negative", "below", "above",
+)
+
+
+class SketchConfig(NamedTuple):
+    """Hashable sketch geometry (keys the fused-kernel jit caches).
+
+    ``log2=True`` bins ``log2(|x|)`` over integer exponent edges
+    ``lo..hi`` (one bin per exponent); ``log2=False`` bins values over
+    fixed edges ``lo..hi`` with ``num_bins`` equal-width bins.
+    ``registers`` is the distinct-sketch register count (power of two).
+    """
+
+    num_bins: int
+    lo: float
+    hi: float
+    log2: bool
+    registers: int
+
+    @property
+    def reg_bits(self) -> int:
+        return int(self.registers).bit_length() - 1
+
+    def edges(self) -> np.ndarray:
+        """The ``num_bins + 1`` histogram bin edges in VALUE space
+        (log₂ mode returns ``2**exponent`` edges of ``|x|``)."""
+        e = np.linspace(self.lo, self.hi, self.num_bins + 1)
+        return np.exp2(e) if self.log2 else e
+
+
+class SketchSummary(NamedTuple):
+    """``InputSketch.compute()`` result (host-friendly floats)."""
+
+    count: float        # finite samples folded into the moments
+    mean: float
+    var: float          # population variance (M2 / count)
+    min: float
+    max: float
+    total: int          # every observed sample (incl. NaN/Inf)
+    nan: int
+    posinf: int
+    neginf: int
+    zero: int
+    negative: int
+    below: int          # finite, non-zero-in-log2-mode, under the range
+    above: int
+    distinct: float     # register-array estimate over raw bit patterns
+    hist: Any           # (num_bins,) f32 counts (np.ndarray)
+
+
+def default_config(
+    num_bins: Optional[int] = None,
+    bounds: Optional[Tuple[float, float]] = None,
+    log2_bounds: Tuple[int, int] = (-24, 24),
+    registers: int = 64,
+) -> SketchConfig:
+    """Normalize the user-facing knobs into a :class:`SketchConfig`.
+
+    ``bounds=(lo, hi)`` selects fixed-edge mode (``num_bins`` defaults
+    to 32); ``bounds=None`` selects log₂ mode over integer exponents
+    ``log2_bounds`` (one bin per exponent — |x| in [2^-24, 2^24) by
+    default; zeros are counted separately, never binned).
+    """
+    registers = int(registers)
+    if registers < 16 or registers & (registers - 1):
+        raise ValueError(
+            f"registers must be a power of two >= 16, got {registers}"
+        )
+    if bounds is not None:
+        lo, hi = float(bounds[0]), float(bounds[1])
+        if not hi > lo:
+            raise ValueError(f"bounds must satisfy hi > lo, got ({lo}, {hi})")
+        bins = 32 if num_bins is None else int(num_bins)
+        if bins < 1:
+            raise ValueError(f"num_bins must be >= 1, got {bins}")
+        return SketchConfig(bins, lo, hi, False, registers)
+    lo_e, hi_e = int(log2_bounds[0]), int(log2_bounds[1])
+    if not hi_e > lo_e:
+        raise ValueError(
+            f"log2_bounds must satisfy hi > lo, got ({lo_e}, {hi_e})"
+        )
+    bins = (hi_e - lo_e) if num_bins is None else int(num_bins)
+    if bins != hi_e - lo_e:
+        raise ValueError(
+            "log2 mode bins values by INTEGER exponent — one bin per "
+            f"exponent (num_bins == hi - lo == {hi_e - lo_e}); widen "
+            "log2_bounds or use fixed-edge mode (bounds=) for custom "
+            "bin counts"
+        )
+    return SketchConfig(bins, float(lo_e), float(hi_e), True, registers)
+
+
+# ------------------------------------------------------------ fold kernels
+
+
+def _clz32(v: jax.Array) -> jax.Array:
+    """Branchless count-leading-zeros of a uint32 (smear + popcount)."""
+    v = v | (v >> 1)
+    v = v | (v >> 2)
+    v = v | (v >> 4)
+    v = v | (v >> 8)
+    v = v | (v >> 16)
+    return 32 - jax.lax.population_count(v).astype(jnp.int32)
+
+
+def _fmix32(h: jax.Array) -> jax.Array:
+    """murmur3's 32-bit finalizer: a deterministic, well-mixed hash of
+    the raw value bits (never Python's salted ``hash`` — every rank and
+    every restart must agree on register placement)."""
+    h = h ^ (h >> 16)
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * np.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+@jax.jit
+def chan_merge(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Chan's parallel moments merge of two ``[count, mean, M2, min,
+    max]`` vectors (Chan, Golub & LeVeque 1979), with EXACT empty-side
+    identities: merging with a zero-count side returns the other side's
+    bits verbatim, so a left fold over rank-ordered carriers that
+    partition the stream replays the single-stream fold bit-for-bit.
+    Used by both the fused update (state ⊕ batch, where the jit inlines
+    into the fold program) and the cross-replica merge (carrier ⊕
+    carrier, ascending rank order, where the jit keeps the eager merge
+    one dispatch instead of ~20 — measured 1.4 ms/merge eager on the
+    bench box)."""
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    na, nb = a[0], b[0]
+    n = na + nb
+    safe_n = jnp.maximum(n, 1.0)
+    delta = b[1] - a[1]
+    mean = a[1] + delta * (nb / safe_n)
+    m2 = a[2] + b[2] + delta * delta * (na * (nb / safe_n))
+    mean = jnp.where(na == 0, b[1], jnp.where(nb == 0, a[1], mean))
+    m2 = jnp.where(na == 0, b[2], jnp.where(nb == 0, a[2], m2))
+    return jnp.stack(
+        [n, mean, m2, jnp.minimum(a[3], b[3]), jnp.maximum(a[4], b[4])]
+    )
+
+
+def moments_window(live: np.ndarray, ref: np.ndarray) -> np.ndarray:
+    """The exact inverse of :func:`chan_merge`: the ``[count, mean, M2,
+    min, max]`` of the samples folded AFTER ``ref`` was snapshotted from
+    the same stream (drift scoring compares the post-freeze window, not
+    the diluted total). min/max cannot be un-merged — the live extrema
+    are returned (conservative)."""
+    live = np.asarray(live, np.float64)
+    ref = np.asarray(ref, np.float64)
+    n_w = live[0] - ref[0]
+    if n_w <= 0:
+        return np.asarray([0.0, 0.0, 0.0, live[3], live[4]], np.float64)
+    mean_w = (live[0] * live[1] - ref[0] * ref[1]) / n_w
+    delta = mean_w - ref[1]
+    m2_w = live[2] - ref[2] - delta * delta * ref[0] * n_w / max(live[0], 1.0)
+    return np.asarray(
+        [n_w, mean_w, max(m2_w, 0.0), live[3], live[4]], np.float64
+    )
+
+
+def _exponent_of(x: jax.Array) -> jax.Array:
+    """``floor(log2(|x|))`` as an INTEGER from the f32 bit pattern —
+    biased exponent for normals, mantissa bit length for subnormals.
+    No libm, so the native kernel (``ops/native/sketch.cc``) and this
+    twin agree bit-for-bit; callers mask out zeros and non-finites."""
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    mag = bits & np.uint32(0x7FFFFFFF)
+    eb = (mag >> np.uint32(23)).astype(jnp.int32)
+    sub = (32 - _clz32(mag)) - 1 - 149  # bit_length(mag) - 1 - 149
+    return jnp.where(eb > 0, eb - 127, sub)
+
+
+def _seq_sum(values: jax.Array) -> jax.Array:
+    """A SEQUENTIAL f32 sum: scatter-add into one segment. XLA:CPU
+    lowers scatter-add to an in-order per-element loop (the property
+    segment.cc's parity tests pin), so this matches the native kernel's
+    ascending-order f32 accumulation bit-for-bit — a plain ``jnp.sum``
+    may reduce in vectorized partial sums and differ in the last ulp."""
+    return jax.ops.segment_sum(
+        values, jnp.zeros(values.shape, jnp.int32), num_segments=1
+    )[0]
+
+
+def _sketch_fold_xla(cfg: SketchConfig, x: jax.Array, w: jax.Array):
+    """Pure-XLA twin of the native ``SketchFold`` kernel: returns the
+    per-batch deltas ``(hist, counts, stats, regs)``. Bit-identical to
+    ``ops/native/sketch.cc`` on CPU (pinned by tests/metrics/
+    test_quality.py): integer counters/registers/exponent bins, the
+    histogram.cc edge math in fixed mode, and sequential f32 moment
+    sums via :func:`_seq_sum`."""
+    lo32 = np.float32(cfg.lo)
+    hi32 = np.float32(cfg.hi)
+    p = cfg.reg_bits
+    # anomaly lanes by BIT pattern (float compares are ambiguous for
+    # subnormals under XLA's inconsistent flush-to-zero; integer tests
+    # match the native kernel deterministically)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    mag = bits & np.uint32(0x7FFFFFFF)
+    sign = (bits >> np.uint32(31)) != 0
+    is_nan = mag > np.uint32(0x7F800000)
+    is_inf = mag == np.uint32(0x7F800000)
+    finite = mag < np.uint32(0x7F800000)
+    is_zero = finite & (mag == 0)
+    nonzero = finite & (mag != 0)
+    wb = (w > 0).astype(jnp.float32)  # presence (counter semantics)
+    wf = w * finite.astype(jnp.float32)  # moment/histogram weights
+
+    if cfg.log2:
+        e = _exponent_of(x)
+        below = nonzero & (e < int(cfg.lo))
+        above = nonzero & (e >= int(cfg.hi))
+    else:
+        below = finite & (x < lo32)
+        above = finite & (x > hi32)
+    xz = jnp.where(wf > 0, x, 0.0)
+    # ONE stacked reduction for the counter lanes (integer-valued —
+    # exact in any reduce order below 2^24 samples per batch)
+    rows = jnp.stack(
+        [
+            wb,
+            wb * is_nan,
+            wb * (is_inf & ~sign),
+            wb * (is_inf & sign),
+            wb * is_zero,
+            wb * (nonzero & sign),
+            wb * below,
+            wb * above,
+        ]
+    )
+    delta_counts = jnp.sum(rows, axis=1).astype(jnp.int32)
+
+    # quantile histogram: fixed mode through ops.histogram (the pinned
+    # histogram.cc twin), log2 mode by integer exponent bin scatter
+    if cfg.log2:
+        ids = jnp.where(
+            nonzero & ~below & ~above,
+            (_exponent_of(x) - int(cfg.lo)).astype(jnp.int32),
+            -1,
+        )
+        delta_hist = jax.ops.segment_sum(
+            wf, ids, num_segments=cfg.num_bins
+        )
+    else:
+        delta_hist = _ops_histogram(
+            x, cfg.num_bins, bounds=(cfg.lo, cfg.hi), weights=wf
+        )
+
+    # streaming moments: two-pass batch stats, SEQUENTIAL f32 sums
+    bc = _seq_sum(wf)
+    bmean = _seq_sum(xz * wf) / jnp.maximum(bc, 1.0)
+    bm2 = _seq_sum(wf * jnp.square(jnp.where(wf > 0, x - bmean, 0.0)))
+    bmin = jnp.min(jnp.where(wf > 0, x, jnp.inf))
+    bmax = jnp.max(jnp.where(wf > 0, x, -jnp.inf))
+    stats = jnp.stack([bc, bmean, bm2, bmin, bmax])
+
+    # distinct-count registers over the raw bit patterns.
+    # ops.segment_max, NOT jax.ops.segment_max: XLA:CPU lowers
+    # scatter-max to a per-element update loop (the PR 6 class —
+    # measured ~120 µs at n=2048)
+    h = _fmix32(jax.lax.bitcast_convert_type(x, jnp.uint32))
+    j = (h & np.uint32(cfg.registers - 1)).astype(jnp.int32)
+    rho = _clz32(h >> np.uint32(p)) - p + 1
+    rho = jnp.where(w > 0, rho, 0).astype(jnp.int32)
+    delta_reg = _segment_max(rho, j, cfg.registers, identity=0)
+    return delta_hist, delta_counts, stats, delta_reg
+
+
+def _native_sketch_ready() -> bool:
+    from torcheval_tpu.ops import native
+
+    return native.ensure_registered()
+
+
+def _sketch_fold_deltas(cfg: SketchConfig, x: jax.Array, w: jax.Array):
+    """Dispatch one batch's sketch deltas: the fused native kernel
+    (``ops/native/sketch.cc`` — TWO data passes instead of ~12 XLA
+    reduce loops, measured ~5x on the bench box) on the CPU lowering,
+    the bit-identical pure-XLA twin elsewhere (the ``torcheval_tpu.ops``
+    fallback contract)."""
+    if not (x.size > 0 and _native_sketch_ready()):
+        return _sketch_fold_xla(cfg, x, w)
+
+    def native_fn(xv, wv):
+        from torcheval_tpu.metrics.functional.tensor_utils import _match_vma
+
+        call = _ffi.ffi_call(
+            "torcheval_sketch_fold",
+            (
+                jax.ShapeDtypeStruct((cfg.num_bins,), jnp.float32),
+                jax.ShapeDtypeStruct((8,), jnp.int32),
+                jax.ShapeDtypeStruct((5,), jnp.float32),
+                jax.ShapeDtypeStruct((cfg.registers,), jnp.int32),
+            ),
+            vmap_method="sequential",
+        )
+        out = call(
+            xv, wv, lo=cfg.lo, hi=cfg.hi, log2_mode=int(cfg.log2)
+        )
+        return tuple(_match_vma(o, xv) for o in out)
+
+    def xla_fn(xv, wv):
+        return _sketch_fold_xla(cfg, xv, wv)
+
+    return jax.lax.platform_dependent(
+        x, w, cpu=native_fn, default=xla_fn
+    )
+
+
+@lru_cache(maxsize=None)
+def _fold_fns(cfg: SketchConfig):
+    """The traceable fold for one sketch geometry:
+    ``fold(states4, x, w) -> states4`` where ``states4 = (hist, counts,
+    moments, registers)`` and ``w`` is a per-element f32 validity weight
+    (bucket-padding masks fold in here — a padded row's w=0 contributes
+    exactly zero to every state). Cached per config so repeated updates
+    key the same jit entry."""
+
+    def fold(states, x, w):
+        hist, counts, moments, registers = states
+        x = jnp.asarray(x).astype(jnp.float32)
+        w = jnp.broadcast_to(jnp.asarray(w, jnp.float32), x.shape)
+        x, w = x.reshape(-1), w.reshape(-1)
+        delta_hist, delta_counts, stats, delta_reg = _sketch_fold_deltas(
+            cfg, x, w
+        )
+        return (
+            hist + delta_hist,
+            counts + delta_counts,
+            chan_merge(moments, stats),
+            jnp.maximum(registers, delta_reg),
+        )
+
+    return fold
+
+
+@lru_cache(maxsize=None)
+def _sketch_kernels(cfg: SketchConfig):
+    """(plain, masked) transform kernels for :class:`InputSketch`'s own
+    update plan. The masked twin takes the bucket-padded values plus the
+    int32 valid-extent vector and rebuilds the row mask inside the fused
+    program (the ``_bucket.py`` contract: padded rows contribute exactly
+    zero to every state)."""
+    fold = _fold_fns(cfg)
+
+    def plain(states, x):
+        return fold(states, x, jnp.float32(1.0))
+
+    def masked(states, x, valid):
+        n = x.shape[0]
+        row = jnp.arange(n, dtype=jnp.int32) < valid[0]
+        w = row.astype(jnp.float32).reshape((n,) + (1,) * (x.ndim - 1))
+        return fold(states, x, jnp.broadcast_to(w, x.shape))
+
+    plain.__name__ = f"sketch_fold_{cfg.num_bins}"
+    masked.__name__ = f"sketch_fold_masked_{cfg.num_bins}"
+    return plain, masked
+
+
+def moment_default() -> jax.Array:
+    """The empty moments vector: zero count/mean/M2, inverted extrema
+    (the exact identity of :func:`chan_merge`)."""
+    return jnp.asarray([0.0, 0.0, 0.0, np.inf, -np.inf], jnp.float32)
+
+
+def hll_estimate(registers: np.ndarray) -> float:
+    """The register-array cardinality estimate (HyperLogLog with the
+    standard small-range linear-counting correction). Deterministic host
+    math over an int32 register snapshot."""
+    regs = np.asarray(registers, np.float64)
+    m = regs.size
+    if m == 0:
+        return 0.0
+    alpha = {16: 0.673, 32: 0.697, 64: 0.709}.get(m, 0.7213 / (1 + 1.079 / m))
+    est = alpha * m * m / float(np.sum(np.exp2(-regs)))
+    zeros = int(np.sum(regs == 0))
+    if est <= 2.5 * m and zeros > 0:
+        return m * math.log(m / zeros)
+    return float(est)
+
+
+class InputSketch(Metric[SketchSummary]):
+    """Fixed-size mergeable distribution sketch of a value stream.
+
+    See the module docstring for the four state families and their
+    merge/exactness contracts. ``update(values)`` accepts any array
+    (flattened); ``weights`` optionally down-weights/masks elements
+    (0/1 masks compose with shape bucketing's padding mask).
+
+    Examples::
+
+        >>> import jax.numpy as jnp
+        >>> from torcheval_tpu.obs import InputSketch
+        >>> sk = InputSketch(bounds=(0.0, 1.0), num_bins=4)
+        >>> _ = sk.update(jnp.array([0.1, 0.2, 0.6, 0.9]))
+        >>> int(sk.compute().count)
+        4
+    """
+
+    _bucketed_update = True
+
+    def __init__(
+        self,
+        *,
+        num_bins: Optional[int] = None,
+        bounds: Optional[Tuple[float, float]] = None,
+        log2_bounds: Tuple[int, int] = (-24, 24),
+        registers: int = 64,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        self.config = default_config(num_bins, bounds, log2_bounds, registers)
+        cfg = self.config
+        self._add_state(
+            "hist", jnp.zeros((cfg.num_bins,), jnp.float32), merge=MergeKind.SUM
+        )
+        self._add_state(
+            "counts", jnp.zeros((8,), jnp.int32), merge=MergeKind.SUM
+        )
+        self._add_state("moments", moment_default(), merge=MergeKind.CUSTOM)
+        self._add_state(
+            "registers",
+            jnp.zeros((cfg.registers,), jnp.int32),
+            merge=MergeKind.MAX,
+        )
+
+    def _update_plan(self, values, weights=None):
+        values = self._input(values, dtype=jnp.float32)
+        plain, masked = _sketch_kernels(self.config)
+        if weights is not None:
+            weights = self._input(weights, dtype=jnp.float32)
+            if np.shape(weights) != np.shape(values):
+                raise ValueError(
+                    f"weights shape {np.shape(weights)} != values "
+                    f"{np.shape(values)}"
+                )
+            # weighted updates skip bucketing (the weight IS the mask)
+            return UpdatePlan(
+                _weighted_kernel(self.config),
+                ("hist", "counts", "moments", "registers"),
+                (values, weights),
+                transform=True,
+            )
+        return UpdatePlan(
+            plain,
+            ("hist", "counts", "moments", "registers"),
+            (values,),
+            transform=True,
+            masked_kernel=masked,
+            batch_axes=(("batch",),),
+        )
+
+    def update(self, values, weights=None) -> "InputSketch":
+        return self._apply_update_plan(self._update_plan(values, weights))
+
+    def _merge_custom_state(self, name, mine, theirs):
+        if name == "moments":
+            # pairwise in carrier (ascending-rank) order: the toolkit
+            # merge loop left-folds peers, so this IS Chan's
+            # pairwise-in-rank-order merge
+            return chan_merge(mine, theirs)
+        return super()._merge_custom_state(name, mine, theirs)
+
+    def edges(self) -> np.ndarray:
+        """Histogram bin edges in value space (log₂ mode: |x| edges)."""
+        return self.config.edges()
+
+    def compute(self) -> SketchSummary:
+        """Host-readable summary (forces a device readback — scrape
+        cadence, never the step path)."""
+        mom = np.asarray(self.moments, np.float64)
+        cnt = np.asarray(self.counts)
+        count = float(mom[0])
+        return SketchSummary(
+            count=count,
+            mean=float(mom[1]) if count else 0.0,
+            var=float(mom[2] / count) if count else 0.0,
+            min=float(mom[3]),
+            max=float(mom[4]),
+            total=int(cnt[_CNT_TOTAL]),
+            nan=int(cnt[_CNT_NAN]),
+            posinf=int(cnt[_CNT_POSINF]),
+            neginf=int(cnt[_CNT_NEGINF]),
+            zero=int(cnt[_CNT_ZERO]),
+            negative=int(cnt[_CNT_NEG]),
+            below=int(cnt[_CNT_BELOW]),
+            above=int(cnt[_CNT_ABOVE]),
+            distinct=hll_estimate(np.asarray(self.registers)),
+            hist=np.asarray(self.hist),
+        )
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Approximate q-quantile from the histogram: the upper edge of
+        the bin holding the target sample (conservative — never
+        under-reports; within one bin of the truth by construction).
+        ``None`` while the histogram is empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        counts = np.asarray(self.hist, np.float64)
+        total = float(counts.sum())
+        if total <= 0:
+            return None
+        edges = self.edges()
+        target = max(1.0, math.ceil(q * total))
+        seen = 0.0
+        for i, c in enumerate(counts):
+            seen += float(c)
+            if seen >= target:
+                return float(edges[i + 1])
+        return float(edges[-1])
+
+
+@lru_cache(maxsize=None)
+def _weighted_kernel(cfg: SketchConfig):
+    fold = _fold_fns(cfg)
+
+    def weighted(states, x, w):
+        return fold(states, x, w)
+
+    weighted.__name__ = f"sketch_fold_weighted_{cfg.num_bins}"
+    return weighted

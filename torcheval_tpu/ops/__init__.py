@@ -4,7 +4,7 @@ from torcheval_tpu.ops.fused_auc import (
     fused_auc_histogram_accumulate,
 )
 from torcheval_tpu.ops.histogram import bincount, histogram
-from torcheval_tpu.ops.segment import segment_count, segment_sum
+from torcheval_tpu.ops.segment import segment_count, segment_max, segment_sum
 from torcheval_tpu.ops.topk import topk
 
 __all__ = [
@@ -14,6 +14,7 @@ __all__ = [
     "fused_auc_histogram_accumulate",
     "histogram",
     "segment_count",
+    "segment_max",
     "segment_sum",
     "topk",
 ]

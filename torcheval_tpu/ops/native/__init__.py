@@ -45,6 +45,8 @@ _TARGETS = {
     "Histogram": "torcheval_histogram",
     "SegmentSum": "torcheval_segment_sum",
     "SegmentCount": "torcheval_segment_count",
+    "SegmentMax": "torcheval_segment_max",
+    "SketchFold": "torcheval_sketch_fold",
     "TopK": "torcheval_topk",
 }
 
@@ -61,6 +63,12 @@ _EXTRA_FLAGS = {
     # the chunked prefilter's OR-fold only reaches SIMD width with the
     # host ISA available (the sidecar CPU fingerprint guards portability)
     "topk.cc": ["-march=native"],
+    # the per-element hash/classify work vectorizes only with the host
+    # ISA; float sums stay strictly ordered and UNCONTRACTED (gcc's
+    # default -ffp-contract=fast fuses `s += a*b` into fma, changing
+    # the rounding vs the XLA twin's separate mul+add — caught by the
+    # fuzzing round of tests/metrics/test_quality.py's parity pin)
+    "sketch.cc": ["-march=native", "-ffp-contract=off"],
 }
 
 _lock = threading.Lock()

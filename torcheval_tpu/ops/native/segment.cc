@@ -22,6 +22,11 @@
 //               (unit mask when absent). The confusion-matrix update is
 //               exactly this op: mask carries the shape-bucketing
 //               validity row.
+// SegmentMax:   data (N,) s32, ids (N,) s32 -> out (S,) s32, segments
+//               with no entries filled with `identity` (the caller's
+//               fold identity — the distinct-count register sketch
+//               passes 0 so empty registers stay empty). Max is
+//               order-invariant, so parity with the XLA twin is exact.
 //
 // Build: g++ -O3 -fPIC -shared (see native/__init__.py).
 
@@ -104,3 +109,39 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(SegmentCount, SegmentCountImpl,
                                   .Arg<ffi::Buffer<ffi::S32>>()
                                   .Ret<ffi::Buffer<ffi::S32>>()
                                   .Attr<int64_t>("has_mask"));
+
+static ffi::Error SegmentMaxImpl(ffi::Buffer<ffi::S32> data,
+                                 ffi::Buffer<ffi::S32> ids,
+                                 ffi::ResultBuffer<ffi::S32> out,
+                                 int64_t identity) {
+  const auto ddims = data.dimensions();
+  const auto idims = ids.dimensions();
+  if (ddims.size() != 1 || idims.size() != 1 || ddims[0] != idims[0]) {
+    return ffi::Error::InvalidArgument(
+        "data and ids must be rank 1 with equal length");
+  }
+  const auto odims = out->dimensions();
+  if (odims.size() != 1) {
+    return ffi::Error::InvalidArgument("out must be rank 1 (num_segments)");
+  }
+  const int64_t n = ddims[0];
+  const int64_t segments = odims[0];
+  const int32_t* d = data.typed_data();
+  const int32_t* s = ids.typed_data();
+  int32_t* o = out->typed_data();
+  std::fill(o, o + segments, static_cast<int32_t>(identity));
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t id = s[i];
+    if (id >= 0 && id < segments) {
+      o[id] = std::max(o[id], d[i]);
+    }
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(SegmentMax, SegmentMaxImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>()
+                                  .Attr<int64_t>("identity"));

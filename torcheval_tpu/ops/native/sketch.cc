@@ -1,0 +1,227 @@
+// Fused input-distribution sketch fold — C++ XLA custom-call (CPU).
+//
+// The data-quality layer (obs/sketch.py) folds every watched batch into
+// four sketch state families: a quantile histogram, anomaly counters,
+// streaming moments, and distinct-count registers. Expressed in XLA
+// that is ~12 separate elementwise+reduce loops over the batch
+// (measured ~45-55 µs at n=2048 on the bench box — reduce loops on
+// XLA:CPU pay per-loop overhead); this kernel is the two data passes
+// they always wanted to be: pass 1 computes the counters, histogram,
+// register maxima, weight/weighted-value sums and extrema; pass 2 the
+// centered second moment (it needs the batch mean from pass 1).
+//
+// Parity contract (shared with the pure-XLA twin `_sketch_fold_xla` in
+// obs/sketch.py, pinned by tests/metrics/test_quality.py): BIT-identical
+// on CPU —
+//  - counters and registers are integer arithmetic (exact, any order);
+//  - the histogram replicates histogram.cc's edge math exactly in fixed
+//    mode, and bins INTEGER exponents extracted from the f32 bit
+//    pattern in log2 mode (no libm — floor(log2|x|) from the exponent
+//    field, subnormals via bit length), so both paths agree exactly;
+//  - the f32 moment sums accumulate in ascending input order, and the
+//    twin computes them through sequential scatter-adds
+//    (jax.ops.segment_sum to one segment — XLA:CPU lowers that to a
+//    sequential loop, the property the segment.cc parity tests pin).
+//
+// SketchFold: x (N,) f32, w (N,) f32 ->
+//   hist (B,) f32, counts (8,) s32, stats (5,) f32 [count, mean, M2,
+//   min, max], regs (R,) s32.  Attrs: lo, hi (f64), log2 (s64).
+//   R must be a power of two (register index = low bits of the hash).
+//
+// Build: g++ -O3 -fPIC -shared (see native/__init__.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+inline uint32_t Fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return h;
+}
+
+inline int32_t Clz32(uint32_t v) {
+  return v == 0 ? 32 : __builtin_clz(v);
+}
+
+// floor(log2|x|) as an integer from the f32 bit pattern: biased
+// exponent for normals, bit length of the mantissa for subnormals.
+// Callers exclude zero / non-finite values first.
+inline int32_t FloorLog2(uint32_t bits) {
+  const uint32_t mag = bits & 0x7FFFFFFFu;
+  const int32_t eb = static_cast<int32_t>(mag >> 23);
+  if (eb > 0) return eb - 127;
+  return 31 - Clz32(mag) - 149;  // subnormal: mag * 2^-149
+}
+
+}  // namespace
+
+static ffi::Error SketchFoldImpl(ffi::Buffer<ffi::F32> x,
+                                 ffi::Buffer<ffi::F32> w,
+                                 ffi::ResultBuffer<ffi::F32> hist,
+                                 ffi::ResultBuffer<ffi::S32> counts,
+                                 ffi::ResultBuffer<ffi::F32> stats,
+                                 ffi::ResultBuffer<ffi::S32> regs,
+                                 double lo, double hi, int64_t log2_mode) {
+  const auto xdims = x.dimensions();
+  const auto wdims = w.dimensions();
+  if (xdims.size() != 1 || wdims.size() != 1 || xdims[0] != wdims[0]) {
+    return ffi::Error::InvalidArgument(
+        "x and w must be rank 1 with equal length");
+  }
+  if (counts->dimensions().size() != 1 || counts->dimensions()[0] != 8 ||
+      stats->dimensions().size() != 1 || stats->dimensions()[0] != 5 ||
+      hist->dimensions().size() != 1 || regs->dimensions().size() != 1) {
+    return ffi::Error::InvalidArgument(
+        "outputs must be hist (B,), counts (8,), stats (5,), regs (R,)");
+  }
+  const int64_t n = xdims[0];
+  const int64_t bins = hist->dimensions()[0];
+  const int64_t r = regs->dimensions()[0];
+  if (bins < 1 || r < 1 || (r & (r - 1)) != 0) {
+    return ffi::Error::InvalidArgument(
+        "hist needs >= 1 bin and regs a power-of-two length");
+  }
+  const int32_t reg_bits = 31 - Clz32(static_cast<uint32_t>(r));
+  if (log2_mode &&
+      bins != static_cast<int64_t>(hi) - static_cast<int64_t>(lo)) {
+    return ffi::Error::InvalidArgument(
+        "log2 mode requires one bin per exponent (bins == hi - lo)");
+  }
+  const float* xv = x.typed_data();
+  const float* wv = w.typed_data();
+  float* h = hist->typed_data();
+  int32_t* c = counts->typed_data();
+  float* s = stats->typed_data();
+  int32_t* rg = regs->typed_data();
+  std::fill(h, h + bins, 0.0f);
+  std::fill(c, c + 8, 0);
+  std::fill(rg, rg + r, 0);
+
+  // fixed-edge mode: the histogram.cc edge constants exactly (lo/hi to
+  // f32, span from the DOUBLE difference)
+  const float lo32 = static_cast<float>(lo);
+  const float hi32 = static_cast<float>(hi);
+  const float span32 = static_cast<float>(hi - lo);
+  const int32_t lo_e = static_cast<int32_t>(lo);
+  const int32_t hi_e = static_cast<int32_t>(hi);
+
+  float sw = 0.0f;   // sum of moment weights (sequential f32)
+  float sxw = 0.0f;  // sum of weighted values (sequential f32)
+  float mn = std::numeric_limits<float>::infinity();
+  float mx = -std::numeric_limits<float>::infinity();
+
+  for (int64_t i = 0; i < n; ++i) {
+    const float xi = xv[i];
+    const float wi = wv[i];
+    uint32_t bits;
+    std::memcpy(&bits, &xi, sizeof(bits));
+    const uint32_t mag = bits & 0x7FFFFFFFu;
+    const bool present = wi > 0.0f;
+    const bool is_nan = mag > 0x7F800000u;
+    const bool is_inf = mag == 0x7F800000u;
+    const bool finite = mag < 0x7F800000u;
+    const bool negative = (bits >> 31) != 0;
+    // zero/sign lanes by BIT pattern, exactly like the twin (float
+    // compares are ambiguous for subnormals under XLA's inconsistent
+    // flush-to-zero; integer tests are deterministic everywhere)
+    const bool is_zero = finite && mag == 0;
+    {  // branchless lane increments (the loop's common path)
+      const int32_t pres = present ? 1 : 0;
+      c[0] += pres;
+      c[1] += pres & (is_nan ? 1 : 0);
+      c[2] += pres & ((is_inf && !negative) ? 1 : 0);
+      c[3] += pres & ((is_inf && negative) ? 1 : 0);
+      c[4] += pres & (is_zero ? 1 : 0);
+      c[5] += pres & ((finite && negative && !is_zero) ? 1 : 0);
+    }
+    const float wf = finite ? wi : 0.0f;
+    // histogram + below/above lanes
+    if (log2_mode) {
+      if (present && finite && !is_zero) {
+        const int32_t e = FloorLog2(bits);
+        if (e < lo_e) {
+          ++c[6];
+        } else if (e >= hi_e) {
+          ++c[7];
+        }
+      }
+      if (wf != 0.0f && finite && !is_zero) {
+        const int32_t e = FloorLog2(bits);
+        if (e >= lo_e && e < hi_e) {
+          // unit-exponent bins (default_config pins bins == hi - lo)
+          h[e - lo_e] += wf;
+        }
+      }
+    } else {
+      const bool in_range = xi >= lo32 && xi <= hi32;  // NaN fails both
+      if (present && finite) {
+        if (!in_range && xi < lo32) ++c[6];
+        if (!in_range && xi > hi32) ++c[7];
+      }
+      if (in_range && wf != 0.0f) {
+        int64_t idx = static_cast<int64_t>((xi - lo32) / span32 *
+                                           static_cast<float>(bins));
+        idx = std::min<int64_t>(std::max<int64_t>(idx, 0), bins - 1);
+        h[idx] += wf;
+      }
+    }
+    // moment sums: the twin adds (wf>0 ? x*wf : 0) sequentially
+    sw += wf;
+    sxw += wf > 0.0f ? xi * wf : 0.0f;
+    if (wf > 0.0f) {
+      mn = std::min(mn, xi);
+      mx = std::max(mx, xi);
+    }
+    // distinct registers over the raw bit pattern
+    if (present) {
+      const uint32_t hash = Fmix32(bits);
+      const int64_t j = hash & (static_cast<uint32_t>(r) - 1);
+      const int32_t rho =
+          Clz32(hash >> reg_bits) - reg_bits + 1;
+      rg[j] = std::max(rg[j], rho);
+    }
+  }
+
+  const float bc = sw;
+  const float bmean = sxw / std::max(bc, 1.0f);
+  float m2 = 0.0f;
+  for (int64_t i = 0; i < n; ++i) {
+    const float xi = xv[i];
+    const float wi = wv[i];
+    uint32_t bits;
+    std::memcpy(&bits, &xi, sizeof(bits));
+    const bool finite = (bits & 0x7FFFFFFFu) < 0x7F800000u;
+    const float wf = finite ? wi : 0.0f;
+    const float d = wf > 0.0f ? xi - bmean : 0.0f;
+    m2 += wf * (d * d);  // the twin's association: wf * square(d)
+  }
+  s[0] = bc;
+  s[1] = bmean;
+  s[2] = m2;
+  s[3] = mn;
+  s[4] = mx;
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(SketchFold, SketchFoldImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>()
+                                  .Attr<double>("lo")
+                                  .Attr<double>("hi")
+                                  .Attr<int64_t>("log2_mode"));

@@ -1084,11 +1084,67 @@ class MetricTable(Metric[TableValues]):
         source: str = "metric_table_values",
         registry=None,
         limit: Optional[int] = 1024,
+        observe_drift: bool = False,
     ) -> None:
         """Register the per-segment value scrape (bounded cardinality —
-        ``limit`` keys per scrape) on an ``obs`` counter registry."""
+        ``limit`` keys per scrape) on an ``obs`` counter registry.
+
+        ``observe_drift=True`` additionally feeds every scraped
+        per-segment value into the armed SLO monitor's streaming EWMA
+        drift series (series key ``<source>/<segment gauge>``), so
+        multi-tenant drift is observable PER TENANT: a segment whose
+        metric moves past the monitor's z-threshold raises a ``drift``
+        alert naming that segment, with zero loop code — the scrape
+        cadence (``/metrics`` / ``/healthz``) is the feed. No-op while
+        no monitor is armed; never touches the ingest path."""
         from torcheval_tpu.obs.counters import default_registry
 
-        (registry or default_registry()).register(
-            source, lambda: self.scrape_values(limit)
-        )
+        def supplier():
+            values = self.scrape_values(limit)
+            if observe_drift:
+                from torcheval_tpu.obs.monitor import current_monitor
+
+                monitor = current_monitor()
+                if monitor is not None:
+                    for name, value in sorted(values.items()):
+                        monitor.observe(f"{source}/{name}", value)
+            return values
+
+        (registry or default_registry()).register(source, supplier)
+
+    def gather_key_reprs(
+        self, group, *, adopt: bool = True
+    ) -> Dict[int, Any]:
+        """Merge every rank's best-effort key reprs in ONE
+        ``allgather_object`` so scraped hex hashes resolve to original
+        keys CLUSTER-WIDE, past the per-rank ``repr_limit`` cap.
+
+        Each rank only retains reprs for keys it observed locally (and
+        only up to ``repr_limit``); a 64-rank deployment scraping rank
+        0's ``/metrics`` therefore sees hex hashes for every key rank 0
+        never ingested. This gather rides the ``gather_observability``
+        discipline: every member calls it in step (never on the
+        ingest/sync path — scrape or drain cadence), non-members issue
+        no collective and get ``{}`` back, and subgroup/reformed/
+        resilient groups all work. Rank payloads merge in ascending
+        rank order (first writer wins per hash — reprs of the same key
+        are identical by construction).
+
+        ``adopt=True`` (default) installs the merged mapping as this
+        rank's repr table and lifts ``repr_limit`` to cover it — the
+        explicit operator decision to hold cluster-wide reprs in host
+        memory (the cap exists to keep the steady state bounded, not to
+        forbid a deliberate resolution pass). ``adopt=False`` only
+        returns the mapping.
+        """
+        if not group.is_member:
+            return {}
+        gathered = group.allgather_object(dict(self._reprs))
+        merged: Dict[int, Any] = {}
+        for contrib in gathered:  # ascending rank order (group contract)
+            for h, r in contrib.items():
+                merged.setdefault(int(h), r)
+        if adopt:
+            self.repr_limit = max(self.repr_limit, len(merged))
+            self._set_reprs(merged)
+        return merged
