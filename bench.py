@@ -2284,6 +2284,266 @@ def run_metric_table():
     }
 
 
+def run_region_sync():
+    """Config 17: cross-region federation (ISSUE 14).
+
+    WAN-federation audit of ``torcheval_tpu.federation.Federation`` over
+    an in-process two-region world:
+
+    - ``intra_region``: the acceptance pin measured at the ProcessGroup
+      interface — with a federation ARMED on healthy links, one
+      intra-region collection sync issues EXACTLY the same gathers as
+      the federation-off sync (``zero_added_collectives``), and a
+      federation EXCHANGE costs the same sync plus exactly ONE
+      region-broadcast gather (``exchange_extra_collectives``);
+    - ``wire``: inter-region DELTA bytes vs full-snapshot bytes on the
+      serving shape deltas exist for — a large dense-but-mostly-static
+      state (a densely warmed 256-class confusion matrix, ~256 KiB
+      packed, a few dozen cells touched per round). A mostly-zero state
+      already ships tiny through synclib's sparse wire encoding, so the
+      full arm here is the honest dense baseline, not a strawman;
+    - ``exchange``: min-of-rounds wall cost of one ``federate`` round
+      (pack + post + poll + merge + bounded-staleness read) on
+      single-rank regions, vs the bare intra-region sync — the price of
+      a federated read at the exchange cadence, NOT on any step path.
+
+    Convergence bit-identity vs the flat toolkit oracle is pinned by
+    tier-1 (tests/metrics/test_federation.py), not re-proven here.
+    """
+    import threading
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from torcheval_tpu import metrics as M
+    from torcheval_tpu.distributed import ProcessGroup
+    from torcheval_tpu.federation import Federation, InProcessLinkBus
+    from torcheval_tpu.metrics.toolkit import sync_and_compute_collection
+    from torcheval_tpu.utils.test_utils import ThreadWorld
+
+    # ------------------------------------------------ intra-region parity
+    class _Counting(ProcessGroup):
+        """Two fake ranks holding this process's payload; counts calls
+        (the tests/metrics/test_sync_collective_counts.py shape)."""
+
+        def __init__(self):
+            self.gathers = 0
+
+        @property
+        def world_size(self):
+            return 2
+
+        @property
+        def rank(self):
+            return 0
+
+        def allgather_object(self, obj):
+            self.gathers += 1
+            import copy
+
+            return [obj, copy.deepcopy(obj)]
+
+        def allgather_array(self, x):
+            self.gathers += 1
+            x = np.asarray(x)
+            return [x, x.copy()]
+
+    rng = np.random.default_rng(17)
+
+    def _panel():
+        coll = {"acc": M.MulticlassAccuracy(), "mse": M.MeanSquaredError()}
+        coll["acc"].update(
+            jnp.asarray(np.float32(rng.uniform(size=(256, 16)))),
+            jnp.asarray(rng.integers(0, 16, 256)),
+        )
+        coll["mse"].update(
+            jnp.asarray(np.float32(rng.normal(size=256))),
+            jnp.asarray(np.float32(rng.normal(size=256))),
+        )
+        return coll
+
+    bare_counter = _Counting()
+    sync_and_compute_collection(_panel(), bare_counter)
+    armed_world = ThreadWorld(2)
+    fed_armed = Federation(
+        armed_world.views[0],
+        [("us", (0,)), ("eu", (1,))],
+        transport=InProcessLinkBus(),
+    )
+    armed_counter = _Counting()
+    sync_and_compute_collection(_panel(), armed_counter)
+    fed_armed.close()
+
+    # counting the whole federate round: wrap a ThreadWorld view so
+    # every subgroup gather (the intra-region sync AND the region
+    # broadcast) lands in one shared tally
+    class _CountingView(ProcessGroup):
+        def __init__(self, inner, tally):
+            self._inner, self._tally = inner, tally
+
+        @property
+        def world_size(self):
+            return self._inner.world_size
+
+        @property
+        def rank(self):
+            return self._inner.rank
+
+        @property
+        def is_member(self):
+            return self._inner.is_member
+
+        @property
+        def ranks(self):
+            return self._inner.ranks
+
+        def unwrap(self):
+            return self._inner.unwrap()
+
+        def new_subgroup(self, ranks):
+            return _CountingView(
+                self._inner.new_subgroup(ranks), self._tally
+            )
+
+        def allgather_object(self, obj):
+            self._tally["gathers"] += 1
+            return self._inner.allgather_object(obj)
+
+        def allgather_array(self, x):
+            self._tally["gathers"] += 1
+            return self._inner.allgather_array(x)
+
+    world = ThreadWorld(4)
+    tallies = [{"gathers": 0} for _ in range(4)]
+    bus = InProcessLinkBus()
+    barrier = threading.Barrier(4)
+    regions_2x2 = [("us", (0, 1)), ("eu", (2, 3))]
+    sync_gathers = {}
+    federate_gathers = {}
+
+    def drive(g):
+        view = _CountingView(g, tallies[g.rank])
+        fed = Federation(view, regions_2x2, transport=bus)
+        coll = _panel()
+        # one plain intra-region sync, counted
+        before = tallies[g.rank]["gathers"]
+        sync_and_compute_collection(coll, fed.region_group)
+        sync_gathers[g.rank] = tallies[g.rank]["gathers"] - before
+        barrier.wait()
+        # one federate round, counted (healthy links)
+        before = tallies[g.rank]["gathers"]
+        fed.federate(coll)
+        barrier.wait()
+        federate_gathers[g.rank] = tallies[g.rank]["gathers"] - before
+        fed.close()
+
+    world.run(drive)
+    exchange_extra = federate_gathers[0] - sync_gathers[0]
+
+    # --------------------------------------------------------- wire: deltas
+    warm_p, warm_t = np.meshgrid(np.arange(256), np.arange(256))
+    warm_p, warm_t = warm_p.reshape(-1), warm_t.reshape(-1)
+    wire_world = ThreadWorld(2)
+    wire_bus = InProcessLinkBus()
+    wire_barrier = threading.Barrier(2)
+    wire_feds = {}
+    rounds = 10
+
+    def wire_drive(g):
+        fed = Federation(
+            g,
+            [("us", (0,)), ("eu", (1,))],
+            transport=wire_bus,
+        )
+        wire_feds[g.rank] = fed
+        cm = M.MulticlassConfusionMatrix(256)
+        # dense warm: every (pred, target) cell counted once, so the
+        # packed snapshot is dense (sparse wire encoding does not engage)
+        cm.update(jnp.eye(256)[warm_p], jnp.asarray(warm_t))
+        coll = {"cm": cm}
+        lrng = np.random.default_rng(1000 + g.rank)
+        for rnd in range(rounds):
+            t = jnp.asarray(lrng.integers(0, 16, 32))
+            p = jnp.asarray(lrng.integers(0, 16, 32))
+            cm.update(jnp.eye(256)[p], t)
+            wire_barrier.wait()
+            fed.federate(coll)
+            wire_barrier.wait()
+
+    wire_world.run(wire_drive)
+    wh = wire_feds[0].link_health("eu")
+    full_per_msg = wh.full_bytes / max(wh.fulls_sent, 1)
+    delta_per_msg = wh.delta_bytes / max(wh.deltas_sent, 1)
+    wire_ratio = full_per_msg / max(delta_per_msg, 1e-9)
+
+    # ----------------------------------------------------- exchange timing
+    timing_world = ThreadWorld(2)
+    timing_bus = InProcessLinkBus()
+    timing_barrier = threading.Barrier(2)
+    best = {"sync": float("inf"), "federate": float("inf")}
+
+    def timing_drive(g):
+        fed = Federation(
+            g, [("us", (0,)), ("eu", (1,))], transport=timing_bus
+        )
+        coll = _panel()
+        fed.federate(coll)  # warm (compile + first pack)
+        timing_barrier.wait()
+        for _ in range(40):
+            timing_barrier.wait()
+            t0 = time.perf_counter()
+            sync_and_compute_collection(coll, fed.region_group)
+            dt_sync = time.perf_counter() - t0
+            timing_barrier.wait()
+            t0 = time.perf_counter()
+            fed.federate(coll)
+            dt_fed = time.perf_counter() - t0
+            if g.rank == 0:
+                best["sync"] = min(best["sync"], dt_sync)
+                best["federate"] = min(best["federate"], dt_fed)
+        fed.close()
+
+    timing_world.run(timing_drive)
+
+    zero_added = armed_counter.gathers == bare_counter.gathers
+    return {
+        "metric": (
+            "cross-region federation: healthy-link intra-region sync "
+            "parity + inter-region delta wire"
+        ),
+        "value": round(wire_ratio, 1),
+        "unit": "x full-snapshot bytes over delta bytes (higher is better)",
+        "intra_region": {
+            "sync_gathers_bare": bare_counter.gathers,
+            "sync_gathers_federation_armed": armed_counter.gathers,
+            "zero_added_collectives": zero_added,
+            "sync_gathers_per_region_sync": sync_gathers[0],
+            "federate_gathers": federate_gathers[0],
+            # the exchange pays the SAME region sync + exactly one
+            # broadcast gather — nothing rides the sync protocol itself
+            "exchange_extra_collectives": exchange_extra,
+        },
+        "wire": {
+            "rounds": rounds,
+            "fulls_sent": wh.fulls_sent,
+            "deltas_sent": wh.deltas_sent,
+            "full_bytes_per_msg": round(full_per_msg, 1),
+            "delta_bytes_per_msg": round(delta_per_msg, 1),
+            "full_over_delta": round(wire_ratio, 1),
+            "delta_beats_full": delta_per_msg * 4 < full_per_msg,
+        },
+        "exchange": {
+            "region_sync_us": round(best["sync"] * 1e6, 1),
+            "federate_us": round(best["federate"] * 1e6, 1),
+        },
+        "acceptance": {
+            "zero_added_collectives": zero_added,
+            "one_broadcast_per_exchange": exchange_extra == 1,
+            "delta_beats_full": delta_per_msg * 4 < full_per_msg,
+        },
+    }
+
+
 def run_probe():
     """Tiny op on the default backend — proves the platform is claimable."""
     import jax
@@ -3117,6 +3377,7 @@ CONFIGS = {
     "monitoring": (run_monitoring, None),  # live-diagnosis-overhead audit
     "metric_table": (run_metric_table, None),  # keyed-table serving audit
     "quality": (run_quality, None),  # data-quality-telemetry audit
+    "region_sync": (run_region_sync, None),  # cross-region federation audit
 }
 
 _NO_REF_NOTES = {
@@ -3165,6 +3426,11 @@ _NO_REF_NOTES = {
         "data-quality-telemetry audit — the reference has no input "
         "sketching layer, so the comparison is our own unwatched panel"
     ),
+    "region_sync": (
+        "cross-region federation audit — the reference has no WAN sync "
+        "layer, so the comparisons are our own federation-off sync "
+        "collective counts and the full-snapshot wire arm"
+    ),
 }
 
 REF_FNS = {
@@ -3196,7 +3462,7 @@ def _cache_env(env):
 _SINGLE_DEVICE_CONFIGS = {
     "accuracy_update", "auroc_compute", "text_eval", "fid", "kernels",
     "variable_batch", "sharded_state", "monitoring", "metric_table",
-    "quality",
+    "quality", "region_sync",
 }
 
 

@@ -1,0 +1,1187 @@
+"""Cross-region eval federation (ISSUE 14): staleness-tolerant WAN sync,
+partition tolerance & anti-entropy recovery.
+
+The acceptance criteria pinned here:
+
+- two regions partitioned for K exchange rounds then healed converge to
+  a global state BIT-IDENTICAL to the uninterrupted oracle, with
+  degradation provenance and a staleness alert emitted while partitioned
+  (``test_partition_heal_bit_identical_to_oracle``);
+- re-delivered and out-of-order inter-region epochs are idempotent /
+  commutative per the epoch ledger, pinned against the toolkit merge
+  oracle for SUM / MAX / EXTEND plus one sharded and one ``MetricTable``
+  family (``test_exactly_once_*``);
+- a ThreadWorld-8 two-region soak under a seeded randomized fault
+  schedule (drops, partitions, duplicates, delay jitter) converges
+  bit-identically after healing (``test_soak_*``);
+- the epoch ledger rides elastic snapshot bundles so a crash
+  mid-exchange neither double-counts nor drops a delta
+  (``test_federation_ledger_rides_elastic_bundle``).
+
+Float bit-identity note: the federation merges region-cumulative states
+in region order, so a two-level float fold ``(r0+r1)+(r2+r3)`` replaces
+the flat toolkit fold ``((r0+r1)+r2)+r3``. Tests comparing against the
+FLAT toolkit oracle therefore use integer-valued float data (every
+addition exact, fold-order-invariant — the PR 13 dyadic discipline);
+tests comparing a faulted federation run against a fault-free
+FEDERATION run need no such restriction.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu import metrics as M
+from torcheval_tpu import obs
+from torcheval_tpu.federation import (
+    Federation,
+    FederationProvenance,
+    InProcessLinkBus,
+    RegionPartitionError,
+    RegionSpec,
+    apply_delta,
+    encode_delta,
+)
+from torcheval_tpu.metrics.toolkit import sync_and_compute_collection
+from torcheval_tpu.utils.test_utils import (
+    ChaosLinkTransport,
+    LinkFaultSpec,
+    ThreadWorld,
+)
+
+REGIONS_2X2 = [("us", (0, 1)), ("eu", (2, 3))]
+REGIONS_1X2 = [("us", (0,)), ("eu", (1,))]
+REGIONS_4X2 = [("us", (0, 1, 2, 3)), ("eu", (4, 5, 6, 7))]
+
+
+@pytest.fixture(autouse=True)
+def _federation_cleanup():
+    yield
+    import torcheval_tpu.federation as fed_mod
+    from torcheval_tpu.obs.counters import default_registry
+    from torcheval_tpu.obs.flight import FLIGHT
+
+    with fed_mod._CURRENT_LOCK:
+        fed_mod._CURRENT = None
+    default_registry().unregister("federation")
+    FLIGHT.reset()
+
+
+def _make_metrics():
+    """SUM (float + int counters), MAX, EXTEND — the merge-kind zoo."""
+    return {
+        "acc": M.MulticlassAccuracy(),
+        "sum": M.Sum(),
+        "max": M.Max(),
+        "cat": M.Cat(),
+    }
+
+
+def _update(coll, rank, rnd):
+    """Integer-valued float data (exact addition at any fold order)."""
+    rng = np.random.default_rng(1000 * rank + rnd)
+    x = jnp.asarray(rng.random((8, 4)).astype(np.float32))
+    t = jnp.asarray(rng.integers(0, 4, 8))
+    s = jnp.asarray(rng.integers(0, 16, 8).astype(np.float32))
+    coll["acc"].update(x, t)
+    coll["sum"].update(s)
+    coll["max"].update(s)
+    coll["cat"].update(s)
+
+
+def _values(coll):
+    return {k: np.asarray(m.compute()) for k, m in coll.items()}
+
+
+def _flat_oracle(world_size, rounds, make=_make_metrics, update=_update):
+    """Flat toolkit sync over every rank's full stream — the
+    uninterrupted-oracle merge."""
+    world = ThreadWorld(world_size)
+
+    def run(g):
+        coll = make()
+        for rnd in range(rounds):
+            update(coll, g.rank, rnd)
+        return {
+            k: np.asarray(v)
+            for k, v in sync_and_compute_collection(coll, g).items()
+        }
+
+    return world.run(run)[0]
+
+
+def _run_federation(
+    world_size,
+    regions,
+    rounds,
+    *,
+    transport=None,
+    settle=2,
+    partition_after=2,
+    policy="quorum",
+    make=_make_metrics,
+    update=_update,
+    round_hook=None,
+    collect=None,
+):
+    """Drive one federation world: per round every rank updates, a
+    barrier lines the world up, ``round_hook(rnd)`` (rank 0 only)
+    mutates the chaos transport, then every rank runs one
+    ``federate``; ``settle`` extra no-data rounds propagate the final
+    epochs. Returns ``(results, feds)`` where results[rank] is the final
+    merged values + provenance (plus whatever ``collect`` grabbed)."""
+    world = ThreadWorld(world_size)
+    transport = transport if transport is not None else InProcessLinkBus()
+    barrier = threading.Barrier(world_size)
+    feds = {}
+
+    def run(g):
+        fed = Federation(
+            g,
+            regions,
+            transport=transport,
+            partition_after=partition_after,
+            policy=policy,
+        )
+        feds[g.rank] = fed
+        coll = make()
+        merged = None
+        extra = {}
+        for rnd in range(rounds + settle):
+            if rnd < rounds:
+                update(coll, g.rank, rnd)
+            barrier.wait()
+            if g.rank == 0 and round_hook is not None:
+                round_hook(rnd)
+            barrier.wait()
+            merged = fed.federate(coll)
+            barrier.wait()
+            if collect is not None:
+                collect(g.rank, rnd, fed, merged, extra)
+        out = _values(merged)
+        prov = merged[next(iter(merged))].federation_provenance
+        return out, prov, extra
+
+    return world.run(run), feds
+
+
+# ---------------------------------------------------------------------------
+# Construction contracts
+# ---------------------------------------------------------------------------
+
+
+def test_regions_must_partition_group_ranks():
+    world = ThreadWorld(4)
+    with pytest.raises(ValueError, match="partition"):
+        Federation(world.views[0], [("us", (0, 1)), ("eu", (2,))])
+    with pytest.raises(ValueError, match="unique"):
+        Federation(world.views[0], [("us", (0, 1)), ("us", (2, 3))])
+    with pytest.raises(ValueError, match="out of range"):
+        Federation(world.views[0], [("us", (0, 1)), ("eu", (2, 9))])
+    with pytest.raises(ValueError, match="policy"):
+        Federation(world.views[0], REGIONS_2X2, policy="shrug")
+
+
+def test_local_replica_group_rejected():
+    from torcheval_tpu.distributed import LocalReplicaGroup
+
+    with pytest.raises(TypeError, match="rank-per-process"):
+        Federation(LocalReplicaGroup(), [("solo", (0,))])
+
+
+def test_region_order_canonicalized_by_leader_rank():
+    world = ThreadWorld(4)
+    fed = Federation(
+        world.views[0],
+        [("eu", (2, 3)), ("us", (0, 1))],  # deliberately unsorted
+        transport=InProcessLinkBus(),
+    )
+    assert fed.region_names == ("us", "eu")
+    assert fed.regions[0] == RegionSpec("us", (0, 1))
+    fed.close()
+
+
+def test_word_delta_codec_roundtrip():
+    rng = np.random.default_rng(5)
+    base = rng.integers(0, 256, 4097, dtype=np.uint8)
+    cur = base.copy()
+    cur[13] ^= 0xFF
+    cur[4096] ^= 0x1
+    delta = encode_delta(base, cur)
+    assert delta is not None
+    assert np.array_equal(apply_delta(base, delta), cur)
+    # dense change: the diff does not pay — full wins
+    assert encode_delta(base, rng.integers(0, 256, 4097, dtype=np.uint8)) is None
+    # length change: never a delta
+    assert encode_delta(base, cur[:100]) is None
+
+
+# ---------------------------------------------------------------------------
+# Healthy-path convergence + staleness declarations
+# ---------------------------------------------------------------------------
+
+
+def test_two_region_convergence_bit_identical_to_flat_oracle():
+    """4 ranks, 2 regions, healthy links: after settle rounds every rank's
+    federated read is BIT-identical to the flat toolkit oracle (integer
+    data; EXTEND concatenation order is region order == rank order)."""
+    rounds = 3
+    results, _feds = _run_federation(4, REGIONS_2X2, rounds)
+    oracle = _flat_oracle(4, rounds)
+    for vals, prov, _ in results:
+        for k, want in oracle.items():
+            assert np.array_equal(vals[k], want), k
+        assert isinstance(prov, FederationProvenance)
+        assert not prov.degraded
+        assert prov.merged_regions == ("us", "eu")
+
+
+def test_three_region_full_mesh_convergence():
+    """Three regions (full leader mesh): region-order merge still equals
+    the flat oracle bit-for-bit, and every link keeps its own ledger."""
+    regions = [("us", (0,)), ("eu", (1,)), ("ap", (2,))]
+    rounds = 3
+    (results, feds) = _run_federation(3, regions, rounds, settle=2)
+    oracle = _flat_oracle(3, rounds)
+    for vals, prov, _ in results:
+        for k, want in oracle.items():
+            assert np.array_equal(vals[k], want), k
+        assert prov.merged_regions == ("us", "eu", "ap")
+    assert feds[0].link_health("eu").merges > 0
+    assert feds[0].link_health("ap").merges > 0
+
+
+def test_single_metric_and_value_forms():
+    world = ThreadWorld(2)
+    bus = InProcessLinkBus()
+    barrier = threading.Barrier(2)
+
+    def run(g):
+        fed = Federation(g, REGIONS_1X2, transport=bus)
+        m = M.Sum()
+        value = None
+        for rnd in range(3):
+            m.update(jnp.asarray(float(g.rank + rnd)))
+            barrier.wait()
+            value = fed.sync_and_compute(m)
+            barrier.wait()
+        return float(value), fed.last_provenance
+
+    results = world.run(run)
+    # both regions have merged everything through round 2's exchange
+    # except possibly the last round's peer batch; settle one more round
+    # is not needed here — just check the provenance shape and agreement
+    # on the read each rank declares
+    for value, prov in results:
+        assert isinstance(prov, FederationProvenance)
+        assert prov.epoch == 3
+
+
+def test_bounded_staleness_declared_per_region():
+    """The federated read declares, per region, the last merged epoch
+    and its age — and a healthy link's staleness stays <= 1 round."""
+
+    def collect(rank, rnd, fed, merged, extra):
+        extra.setdefault("staleness", []).append(
+            tuple(
+                (s.name, s.epoch, s.staleness_epochs)
+                for s in fed.last_provenance.regions
+            )
+        )
+
+    (results, _) = _run_federation(4, REGIONS_2X2, 3, collect=collect)
+    for vals, prov, extra in results[0:1]:
+        for statuses in extra["staleness"][1:]:
+            for name, epoch, stale in statuses:
+                assert stale <= 1, statuses
+        self_status = [s for s in prov.regions if s.is_self][0]
+        assert self_status.staleness_epochs == 0
+        assert self_status.age_seconds == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once: the epoch ledger under duplicates and reordering
+# ---------------------------------------------------------------------------
+
+
+def _dup_reorder_faults():
+    """Duplicate + reorder every early message on both directed links."""
+    out = []
+    for src, dst in (("us", "eu"), ("eu", "us")):
+        out.append(LinkFaultSpec(src, dst, 0, "duplicate", times=8))
+        out.append(LinkFaultSpec(src, dst, 1, "reorder", times=1))
+        out.append(LinkFaultSpec(src, dst, 4, "reorder", times=1))
+    return out
+
+
+def test_exactly_once_sum_max_extend_under_duplicates_and_reorder():
+    """ISSUE 14 satellite: re-delivered and out-of-order inter-region
+    epochs are idempotent/commutative per the epoch ledger — the chaotic
+    run's converged state is BIT-identical to the flat toolkit merge
+    oracle for SUM (float+int), MAX and EXTEND states, and the ledger
+    actually saw duplicates (non-vacuous)."""
+    rounds = 5
+    chaos = ChaosLinkTransport(InProcessLinkBus(), _dup_reorder_faults())
+    (results, feds) = _run_federation(
+        4, REGIONS_2X2, rounds, transport=chaos, settle=3
+    )
+    oracle = _flat_oracle(4, rounds)
+    for vals, prov, _ in results:
+        for k, want in oracle.items():
+            assert np.array_equal(vals[k], want), k
+    h_us = feds[0].link_health("eu")
+    h_eu = feds[2].link_health("us")
+    assert h_us.duplicates + h_eu.duplicates > 0
+    assert h_us.merges > 0 and h_eu.merges > 0
+
+
+def test_exactly_once_sharded_and_table_families():
+    """The ledger discipline holds for an intra-region SHARDED family
+    (MulticlassConfusionMatrix with a per-region ShardContext) and a
+    hash-partitioned ``MetricTable`` family: converged per-key /
+    per-cell state equals the replicated world-1 replay oracle exactly,
+    under duplicated + reordered delivery."""
+    from torcheval_tpu.metrics import ShardContext
+    from torcheval_tpu.table import MetricTable
+
+    rounds, world_size = 3, 4
+
+    def make_for(rank):
+        region_rank = rank % 2
+        return {
+            "cm": M.MulticlassConfusionMatrix(
+                16, shard=ShardContext(region_rank, 2)
+            ),
+            "tb": MetricTable("ctr", shard=ShardContext(region_rank, 2)),
+        }
+
+    def update(coll, rank, rnd):
+        rng = np.random.default_rng(31 * rank + rnd)
+        t = jnp.asarray(rng.integers(0, 16, 16))
+        p = jnp.asarray(rng.integers(0, 16, 16))
+        coll["cm"].update(jnp.eye(16)[p], t)
+        keys = rng.integers(0, 32, 16)
+        clicks = rng.integers(0, 2, 16).astype(np.float32)
+        coll["tb"].ingest(keys, clicks)
+
+    world = ThreadWorld(world_size)
+    chaos = ChaosLinkTransport(InProcessLinkBus(), _dup_reorder_faults())
+    barrier = threading.Barrier(world_size)
+    feds = {}
+
+    def run(g):
+        fed = Federation(g, REGIONS_2X2, transport=chaos)
+        feds[g.rank] = fed
+        coll = make_for(g.rank)
+        merged = None
+        for rnd in range(rounds + 3):
+            if rnd < rounds:
+                update(coll, g.rank, rnd)
+            barrier.wait()
+            merged = fed.federate(coll)
+            barrier.wait()
+        return (
+            np.asarray(merged["cm"].compute()),
+            merged["tb"].compute().as_dict(),
+        )
+
+    results = world.run(run)
+
+    cm_o = M.MulticlassConfusionMatrix(16)
+    tb_o = MetricTable("ctr")
+    for rank in range(world_size):
+        for rnd in range(rounds):
+            update({"cm": cm_o, "tb": tb_o}, rank, rnd)
+    want_cm = np.asarray(cm_o.compute())
+    want_tb = tb_o.compute().as_dict()
+    for cm, tb in results:
+        assert np.array_equal(cm, want_cm)
+        assert tb == want_tb
+    assert (
+        feds[0].link_health("eu").duplicates
+        + feds[2].link_health("us").duplicates
+        > 0
+    )
+
+
+def test_stale_redelivery_discarded_and_reacked():
+    """A message older than the ledger's epoch is discarded (idempotent)
+    and RE-ACKed so the sender's view converges — pinned by capturing a
+    real early message and re-posting it after later epochs merged."""
+    import pickle
+
+    captured = {}
+
+    class TapBus(InProcessLinkBus):
+        def post(self, src, dst, blob):
+            if (
+                src == "us"
+                and dst == "eu"
+                and "blob" not in captured
+                and pickle.loads(blob).get("kind") in ("full", "delta")
+            ):
+                captured["blob"] = blob
+            super().post(src, dst, blob)
+
+    bus = TapBus()
+
+    def round_hook(rnd):
+        if rnd == 3 and "blob" in captured:
+            # re-deliver round-1's us->eu snapshot long after eu merged
+            # newer epochs
+            bus.post("us", "eu", captured["blob"])
+
+    (results, feds) = _run_federation(
+        2, REGIONS_1X2, 4, transport=bus, round_hook=round_hook, settle=2
+    )
+    oracle = _flat_oracle(2, 4)
+    for vals, prov, _ in results:
+        for k, want in oracle.items():
+            assert np.array_equal(vals[k], want), k
+    assert feds[1].link_health("us").duplicates >= 1
+
+
+# ---------------------------------------------------------------------------
+# Partition tolerance + anti-entropy (the ISSUE acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+def test_partition_heal_bit_identical_to_oracle():
+    """THE acceptance criterion: two regions partitioned for K rounds
+    then healed converge to a global state bit-identical to the
+    uninterrupted oracle; while partitioned, reads carry degradation
+    provenance (dark region, growing staleness) and a staleness
+    ``AlertEvent`` is emitted."""
+    rounds, part_start, part_end = 8, 2, 6
+    chaos = ChaosLinkTransport(InProcessLinkBus())
+
+    def round_hook(rnd):
+        if rnd == part_start:
+            chaos.partition_both("us", "eu")
+        if rnd == part_end:
+            chaos.heal_both("us", "eu")
+
+    mid = {}
+
+    def collect(rank, rnd, fed, merged, extra):
+        if rnd == part_end - 1:
+            mid[rank] = fed.last_provenance
+
+    rec = obs.recorder()
+    prev = rec.enabled
+    rec.enable()
+    try:
+        (results, feds) = _run_federation(
+            4,
+            REGIONS_2X2,
+            rounds,
+            transport=chaos,
+            settle=3,
+            round_hook=round_hook,
+            collect=collect,
+        )
+        alerts = [
+            e
+            for e in rec.log.tail()
+            if e.kind == "alert" and e.alert == "region-staleness"
+        ]
+    finally:
+        if not prev:
+            rec.disable()
+
+    oracle = _flat_oracle(4, rounds)
+    for vals, prov, _ in results:
+        for k, want in oracle.items():
+            assert np.array_equal(vals[k], want), k
+        assert not prov.degraded  # healed
+
+    # degradation provenance while partitioned
+    prov = mid[0]
+    assert prov.degraded
+    assert prov.merged_regions == ("us",)
+    eu = [s for s in prov.regions if s.name == "eu"][0]
+    assert eu.dark and eu.staleness_epochs > 2
+    # the staleness alert fired while partitioned, naming the region
+    assert alerts and any(a.name == "federation/eu" for a in alerts)
+    # link health observed the partition and the heal
+    h = feds[0].link_health("eu")
+    assert h.partitions >= 1 and h.heals >= 1
+
+
+def test_partition_raise_policy():
+    """policy='raise' refuses a dark-region read with a typed error —
+    and accepts a healthy one (the error is partition-specific)."""
+    rounds, part_start = 6, 2
+    chaos = ChaosLinkTransport(InProcessLinkBus())
+    world = ThreadWorld(2)
+    barrier = threading.Barrier(2)
+
+    def run(g):
+        fed = Federation(
+            g, REGIONS_1X2, transport=chaos, partition_after=2,
+            policy="raise",
+        )
+        coll = _make_metrics()
+        healthy_read = None
+        for rnd in range(rounds):
+            _update(coll, g.rank, rnd)
+            barrier.wait()
+            if g.rank == 0 and rnd == part_start:
+                chaos.partition_both("us", "eu")
+            barrier.wait()
+            fed.exchange(coll)
+            barrier.wait()
+            if rnd == part_start - 1:
+                # healthy links, both regions contributed: raise-policy
+                # reads succeed
+                healthy_read = fed.federate(coll)
+            barrier.wait()
+        raised = None
+        try:
+            fed.federate(coll)
+        except RegionPartitionError as e:
+            raised = e
+        return healthy_read is not None, raised
+
+    results = world.run(run)
+    for healthy_ok, raised in results:
+        assert healthy_ok
+        assert isinstance(raised, RegionPartitionError)
+        assert "dark" in str(raised)
+
+
+def test_anti_entropy_one_cumulative_message_heals():
+    """While partitioned the sender BACKS OFF (posts fewer probes than
+    rounds); on heal, ONE cumulative snapshot re-converges the peer —
+    no replay of the dark window's epochs."""
+    rounds, part_start, part_end = 10, 1, 8
+    chaos = ChaosLinkTransport(InProcessLinkBus())
+
+    def round_hook(rnd):
+        if rnd == part_start:
+            chaos.partition_both("us", "eu")
+        if rnd == part_end:
+            chaos.heal_both("us", "eu")
+
+    settle = 4
+    (results, feds) = _run_federation(
+        2,
+        REGIONS_1X2,
+        rounds,
+        transport=chaos,
+        settle=settle,
+        round_hook=round_hook,
+        partition_after=2,
+    )
+    oracle = _flat_oracle(2, rounds)
+    for vals, prov, _ in results:
+        for k, want in oracle.items():
+            assert np.array_equal(vals[k], want), k
+    # backoff: at least one dark round SKIPPED posting (the exponential
+    # probe schedule), and everything posted into the window was dropped
+    dark_rounds = part_end - part_start
+    h = feds[0].link_health("eu")
+    assert h.posts < rounds + 4  # strictly fewer than one per round
+    dropped = chaos.dropped.get(("us", "eu"), 0)
+    assert 0 < dropped <= dark_rounds
+    # anti-entropy, not replay: the dark window's epochs were never
+    # individually merged — the total merge count stays bounded by the
+    # NON-dark rounds (plus slack for the healing cumulative message),
+    # far below one-merge-per-epoch replay
+    merges_total = feds[1].link_health("us").merges
+    assert 1 <= merges_total <= (rounds + settle) - dark_rounds + 2
+
+
+def test_asymmetric_partition_one_direction_dark():
+    """Asymmetric chaos: eu->us dropped, us->eu delivering. us sees eu
+    dark (no merges arrive); eu keeps merging us's snapshots — the two
+    sides' provenance disagree exactly as the link does."""
+    rounds = 7
+    chaos = ChaosLinkTransport(InProcessLinkBus())
+
+    def round_hook(rnd):
+        if rnd == 1:
+            chaos.partition("eu", "us")
+
+    (results, feds) = _run_federation(
+        2,
+        REGIONS_1X2,
+        rounds,
+        transport=chaos,
+        settle=0,
+        round_hook=round_hook,
+        partition_after=2,
+    )
+    us_prov = results[0][1]
+    eu_prov = results[1][1]
+    assert [s.dark for s in us_prov.regions if s.name == "eu"] == [True]
+    assert us_prov.degraded
+    # eu still merges us's data: us not dark from eu's side
+    assert [s.dark for s in eu_prov.regions if s.name == "us"] == [False]
+    assert not eu_prov.degraded
+    assert feds[1].link_health("us").merges > 0
+
+
+def test_chaos_schedule_replays_deterministically():
+    """Same seed + same call sequence => identical delivery outcomes
+    (the deterministic-replay contract of the link chaos harness)."""
+
+    def run_once():
+        chaos = ChaosLinkTransport(
+            InProcessLinkBus(),
+            [LinkFaultSpec("us", "eu", 2, "drop", times=2)],
+            jitter_polls=(0, 2),
+            seed=1234,
+        )
+        for i in range(10):
+            chaos.post("us", "eu", b"m%d" % i)
+            chaos.poll("eu")
+        # drain what is still held
+        tail = []
+        for _ in range(5):
+            tail.extend(chaos.poll("eu"))
+        return (dict(chaos.dropped), dict(chaos.delivered))
+
+    assert run_once() == run_once()
+
+
+# ---------------------------------------------------------------------------
+# ThreadWorld-8 soak under a seeded randomized fault schedule (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _soak(rounds, seed):
+    rng = np.random.default_rng(seed)
+    chaos = ChaosLinkTransport(
+        InProcessLinkBus(),
+        # seeded scripted duplicates on early message indices
+        [
+            LinkFaultSpec(src, dst, int(m), "duplicate")
+            for src, dst in (("us", "eu"), ("eu", "us"))
+            for m in rng.choice(rounds, size=3, replace=False)
+        ],
+        jitter_polls=(0, 2),
+        seed=seed,
+    )
+    # one seeded partition window per direction (possibly overlapping)
+    windows = {}
+    for src, dst in (("us", "eu"), ("eu", "us")):
+        a = int(rng.integers(1, rounds - 3))
+        b = int(rng.integers(a + 1, rounds - 1))
+        windows[(src, dst)] = (a, b)
+
+    def round_hook(rnd):
+        for (src, dst), (a, b) in windows.items():
+            if rnd == a:
+                chaos.partition(src, dst)
+            if rnd == b:
+                chaos.heal(src, dst)
+
+    (results, feds) = _run_federation(
+        8,
+        REGIONS_4X2,
+        rounds,
+        transport=chaos,
+        settle=4,
+        round_hook=round_hook,
+        partition_after=2,
+    )
+    oracle = _flat_oracle(8, rounds)
+    for rank, (vals, prov, _) in enumerate(results):
+        for k, want in oracle.items():
+            assert np.array_equal(vals[k], want), (rank, k, seed)
+        assert not prov.degraded
+    # the schedule was not vacuous: something was actually dropped
+    assert sum(chaos.dropped.values()) > 0
+
+
+def test_soak_threadworld8_two_regions_seeded_faults():
+    """ISSUE 14 satellite: 8 ranks in 2 regions under a seeded
+    randomized fault schedule (asymmetric partition windows, delivery
+    jitter, duplicates); after healing, every rank's global compute is
+    bit-identical to the fault-free flat oracle."""
+    _soak(rounds=8, seed=140)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [141, 142, 143])
+def test_soak_threadworld8_long(seed):
+    """Longer soak variant (slow tier): more rounds, more seeds."""
+    _soak(rounds=16, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Delta wire: cumulative deltas beat full snapshots on large static states
+# ---------------------------------------------------------------------------
+
+
+def test_delta_bytes_beat_full_bytes_on_sparse_touch():
+    """A large mostly-STATIC dense state (64-class confusion matrix
+    densely warmed, then touched on few cells per round) ships
+    word-sparse DELTAS between epochs — strictly smaller than the full
+    snapshot — and still converges bit-identically. (A mostly-ZERO
+    state would already ship tiny via synclib's sparse wire encoding;
+    the delta codec is the win for dense-but-stable payloads, where the
+    per-epoch change is sparse even though the values are not.)"""
+    warm_p, warm_t = np.meshgrid(np.arange(64), np.arange(64))
+    warm_p, warm_t = warm_p.reshape(-1), warm_t.reshape(-1)
+
+    def make():
+        return {"cm": M.MulticlassConfusionMatrix(64)}
+
+    def update(coll, rank, rnd):
+        if rnd == 0:
+            # every (pred, target) cell counted once: the packed state is
+            # DENSE (sparse wire encoding does not engage) and 16 KiB
+            coll["cm"].update(jnp.eye(64)[warm_p], jnp.asarray(warm_t))
+            return
+        rng = np.random.default_rng(17 * rank + rnd)
+        t = jnp.asarray(rng.integers(0, 8, 16))
+        p = jnp.asarray(rng.integers(0, 8, 16))
+        coll["cm"].update(jnp.eye(64)[p], t)
+
+    (results, feds) = _run_federation(
+        2, REGIONS_1X2, 5, make=make, update=update, settle=2
+    )
+    oracle = _flat_oracle(2, 5, make=make, update=update)
+    for vals, prov, _ in results:
+        assert np.array_equal(vals["cm"], oracle["cm"])
+    h = feds[0].link_health("eu")
+    assert h.deltas_sent >= 2
+    full_per_msg = h.full_bytes / max(h.fulls_sent, 1)
+    delta_per_msg = h.delta_bytes / h.deltas_sent
+    assert delta_per_msg < full_per_msg / 4, h.as_dict()
+
+
+# ---------------------------------------------------------------------------
+# Elastic integration: the ledger rides snapshot bundles
+# ---------------------------------------------------------------------------
+
+
+def test_federation_ledger_rides_elastic_bundle(tmp_path):
+    """Crash mid-exchange: the epoch ledger (merged snapshots + acked
+    epochs + history) rides the elastic bundle; the restored federation
+    discards a re-delivered old epoch (no double count) and re-derives
+    un-acked state from the cumulative snapshot (no dropped delta) —
+    global compute equals the oracle."""
+    from torcheval_tpu.elastic import ElasticSession
+
+    import pickle
+
+    rounds = 3
+    world = ThreadWorld(2)
+    barrier = threading.Barrier(2)
+    captured = {}
+
+    class TapBus(InProcessLinkBus):
+        def post(self, src, dst, blob):
+            if pickle.loads(blob).get("kind") in ("full", "delta"):
+                captured.setdefault((src, dst), []).append(blob)
+            super().post(src, dst, blob)
+
+    bus = TapBus()
+
+    def phase1(g):
+        fed = Federation(g, REGIONS_1X2, transport=bus, partition_after=3)
+        coll = _make_metrics()
+        session = ElasticSession(
+            coll, str(tmp_path), process_group=g, interval=1000,
+            federation=fed,
+        )
+        for rnd in range(rounds):
+            _update(coll, g.rank, rnd)
+            barrier.wait()
+            fed.federate(coll)
+            barrier.wait()
+        session.snapshot()
+        session.close()
+        fed.close()
+        return {
+            name: {k: np.asarray(v) for k, v in m.state_dict().items()}
+            for name, m in coll.items()
+        }
+
+    world.run(phase1)
+
+    # "crash": fresh processes — new federations with a fresh transport,
+    # restore from the bundle
+    world2 = ThreadWorld(2)
+    bus2 = InProcessLinkBus()
+    barrier2 = threading.Barrier(2)
+    feds2 = {}
+
+    def phase2(g):
+        fed = Federation(g, REGIONS_1X2, transport=bus2, partition_after=3)
+        feds2[g.rank] = fed
+        coll = _make_metrics()
+        session = ElasticSession(
+            coll, str(tmp_path), process_group=g, interval=1000,
+            federation=fed,
+        )
+        restored = session.restore()
+        assert restored is not None
+        # the restored ledger remembers the peer's merged epochs
+        peer = "eu" if g.rank == 0 else "us"
+        assert fed._links[peer].merged_epoch > 0
+        barrier2.wait()
+        if g.rank == 1:
+            # re-deliver the OLDEST pre-crash us->eu message: the ledger
+            # must discard it (double-count guard)
+            bus2.post("us", "eu", captured[("us", "eu")][0])
+        barrier2.wait()
+        merged = None
+        for _ in range(3):  # settle: anti-entropy fulls + the redelivery
+            barrier2.wait()
+            merged = fed.federate(coll)
+            barrier2.wait()
+        session.close()
+        return _values(merged)
+
+    results = world2.run(phase2)
+    oracle = _flat_oracle(2, rounds)
+    for vals in results:
+        for k, want in oracle.items():
+            assert np.array_equal(vals[k], want), k
+    assert feds2[1].link_health("us").duplicates >= 1
+
+
+def test_load_ledger_layout_mismatch_starts_fresh():
+    world = ThreadWorld(2)
+    fed = Federation(
+        world.views[0], REGIONS_1X2, transport=InProcessLinkBus()
+    )
+    with pytest.warns(RuntimeWarning, match="layout mismatch"):
+        fed.load_ledger({"schema": 1, "regions": [("x", (0,))], "epoch": 9})
+    assert fed.epoch == 0
+    fed.close()
+
+
+# ---------------------------------------------------------------------------
+# Observability: events, gauges, healthz, flight records
+# ---------------------------------------------------------------------------
+
+
+def test_region_sync_event_schema_roundtrip():
+    from torcheval_tpu.obs.events import RegionSyncEvent, event_from_dict
+
+    e = RegionSyncEvent(
+        rank=0, region="us", peer="eu", action="merge", epoch=4,
+        local_epoch=5, peer_epoch=4, nbytes=123, staleness_epochs=0,
+    )
+    d = e.as_dict()
+    assert d["kind"] == "region_sync" and d["schema"] == 1
+    assert event_from_dict(d) == e
+    d["future_field"] = "ignored"
+    assert event_from_dict(d) == e
+
+
+def test_exchange_emits_region_sync_events():
+    rec = obs.recorder()
+    prev = rec.enabled
+    rec.enable()
+    try:
+        (results, feds) = _run_federation(2, REGIONS_1X2, 2, settle=1)
+        events = [e for e in rec.log.tail() if e.kind == "region_sync"]
+    finally:
+        if not prev:
+            rec.disable()
+    actions = {e.action for e in events}
+    assert "merge" in actions and {"send-full", "send-delta"} & actions
+    merge = [e for e in events if e.action == "merge"][-1]
+    assert merge.region in ("us", "eu") and merge.peer in ("us", "eu")
+    assert merge.epoch >= 1 and merge.nbytes > 0
+
+
+def test_staleness_gauges_in_counter_registry_and_prometheus():
+    from torcheval_tpu.obs.counters import default_registry
+    from torcheval_tpu.obs.export import render_prometheus
+
+    (results, feds) = _run_federation(2, REGIONS_1X2, 2, settle=1)
+    import torcheval_tpu.federation as fed_mod
+
+    # ThreadWorld constructs one fed per rank; make rank 0's the armed one
+    with fed_mod._CURRENT_LOCK:
+        fed_mod._CURRENT = feds[0]
+    default_registry().register("federation", feds[0]._counter_source)
+    reading = default_registry().read()["federation"]
+    assert "region_staleness_epochs/eu" in reading
+    assert "region_last_merge_age/eu" in reading
+    assert reading["epoch"] == feds[0].epoch
+    text = render_prometheus()
+    assert "region_staleness_epochs" in text
+    for fed in feds.values():
+        fed.close()
+
+
+def test_healthz_degrades_to_503_past_staleness_bound():
+    """ISSUE 14 satellite: /healthz fails (healthy=False, status
+    'stale-region') once a region's staleness exceeds the configurable
+    bound, and recovers after heal."""
+    from torcheval_tpu.obs.server import healthz_payload
+
+    rounds, part_start = 7, 1
+    chaos = ChaosLinkTransport(InProcessLinkBus())
+
+    def round_hook(rnd):
+        if rnd == part_start:
+            chaos.partition_both("us", "eu")
+
+    (results, feds) = _run_federation(
+        2,
+        REGIONS_1X2,
+        rounds,
+        transport=chaos,
+        settle=0,
+        round_hook=round_hook,
+        partition_after=2,
+    )
+    import torcheval_tpu.federation as fed_mod
+
+    with fed_mod._CURRENT_LOCK:
+        fed_mod._CURRENT = feds[0]
+    payload = healthz_payload()
+    assert payload["status"] == "stale-region"
+    assert payload["healthy"] is False
+    eu = [r for r in payload["federation"]["regions"] if r["name"] == "eu"][0]
+    assert eu["staleness_epochs"] > feds[0].staleness_503
+    assert eu["dark"]
+
+    # heal: drive a few more healthy rounds through BOTH feds
+    chaos.heal_both("us", "eu")
+    world = ThreadWorld(2)  # fresh threads driving the same fed objects
+    barrier = threading.Barrier(2)
+    colls = {0: _make_metrics(), 1: _make_metrics()}
+
+    def resume(g):
+        for _ in range(3):
+            barrier.wait()
+            feds[g.rank].exchange(colls[g.rank])
+            barrier.wait()
+
+    # the feds hold subgroups of the ORIGINAL world's views; re-driving
+    # them needs the original rank threads — emulate by calling exchange
+    # from fresh threads bound to the same per-rank federation objects
+    threads = [
+        threading.Thread(target=resume, args=(type("G", (), {"rank": r})(),))
+        for r in (0, 1)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60.0)
+    payload = healthz_payload()
+    assert payload["status"] == "ok"
+    assert payload["healthy"] is True
+    for fed in feds.values():
+        fed.close()
+
+
+def test_flight_records_name_stalled_region():
+    """ISSUE 14 satellite: inter-region exchanges land in the flight
+    ring as long-lived records whose op NAMES the region
+    (``region_delta:us->eu``); during a partition the un-acked probe
+    record is RE-issued with no ack (attempts >= 2), which is what lets
+    ``diff_flight_rings`` name the stalled region without false-flagging
+    a healthy link's single un-acked interval."""
+    from torcheval_tpu.obs.flight import FLIGHT, diff_flight_rings
+
+    rounds, part_start = 9, 1
+    chaos = ChaosLinkTransport(InProcessLinkBus())
+
+    def round_hook(rnd):
+        if rnd == part_start:
+            chaos.partition_both("us", "eu")
+
+    FLIGHT.reset()
+    FLIGHT.enable("test-federation")
+    try:
+        (results, feds) = _run_federation(
+            2,
+            REGIONS_1X2,
+            rounds,
+            transport=chaos,
+            settle=0,
+            round_hook=round_hook,
+            partition_after=2,
+        )
+        per_rank = FLIGHT.per_rank()
+        # rank 0 (us leader) holds region_delta records; the un-acked
+        # probe is in flight
+        ops = {r["op"] for r in per_rank.get(0, ())}
+        assert "region_delta:us->eu" in ops
+        in_flight = [
+            r
+            for r in per_rank[0]
+            if r["op"] == "region_delta:us->eu"
+            and r["state"] in ("enqueued", "issued")
+        ]
+        assert in_flight
+        # the partitioned probe record was re-issued without an ack —
+        # the stall-arm qualification (a healthy link stays attempts 1)
+        assert max(r["attempts"] for r in in_flight) >= 2
+        diff = diff_flight_rings({0: per_rank[0]}, stall_after=0.0)
+        assert not diff.ok
+        assert "region_delta:us->eu" in diff.stalled_op
+        # the failed (partition-detected) record is also on the ring
+        failed = [
+            r
+            for r in per_rank[0]
+            if r["op"] == "region_delta:us->eu" and r["state"] == "failed"
+        ]
+        assert failed and "partitioned" in failed[0]["detail"]
+    finally:
+        FLIGHT.disable("test-federation")
+        FLIGHT.reset()
+    for fed in feds.values():
+        fed.close()
+
+
+def test_malformed_and_foreign_messages_never_poison_the_drain():
+    """Review-round regression: a blob that unpickles to a NON-DICT
+    (foreign traffic on a shared transport namespace) and a dict missing
+    its fields are both dropped without crashing exchange()."""
+    import pickle
+
+    bus = InProcessLinkBus()
+
+    def round_hook(rnd):
+        if rnd == 1:
+            bus.post("eu", "us", pickle.dumps([1, 2, 3]))  # non-dict
+            bus.post("eu", "us", b"\x00not pickle")  # torn
+            bus.post("eu", "us", pickle.dumps({"kind": "delta"}))  # fields
+    (results, feds) = _run_federation(
+        2, REGIONS_1X2, 3, transport=bus, round_hook=round_hook, settle=2
+    )
+    oracle = _flat_oracle(2, 3)
+    for vals, prov, _ in results:
+        for k, want in oracle.items():
+            assert np.array_equal(vals[k], want), k
+
+
+def test_healthy_links_no_flight_divergence_and_no_watchdog_aging():
+    """Review-round regression: tracked link records must not fabricate
+    a lockstep divergence across leaders (each direction has its own op
+    name) and must not be aged by the stall watchdog (they stay in
+    flight across the whole inter-exchange interval by design)."""
+    from torcheval_tpu.obs.flight import FLIGHT, diff_flight_rings
+
+    FLIGHT.reset()
+    FLIGHT.enable("test-federation-healthy")
+    try:
+        (results, feds) = _run_federation(2, REGIONS_1X2, 3, settle=1)
+        per_rank = FLIGHT.per_rank()
+        assert set(per_rank) >= {0, 1}
+        diff = diff_flight_rings(per_rank, stall_after=3600.0)
+        assert diff.diverged_rank is None, diff.format()
+        # the un-acked newest epoch IS in flight — and exempt from
+        # watchdog aging via the tracked flag
+        tracked = [
+            r
+            for r in FLIGHT.in_flight()
+            if r.op.startswith("region_delta:")
+        ]
+        assert tracked and all(r.tracked for r in tracked)
+        # the watchdog loop's selection: no un-tracked in-flight record
+        # exists to age, even at a zero deadline
+        stuck = [
+            r
+            for r in FLIGHT.in_flight()
+            if not getattr(r, "tracked", False) and r.age() >= 0.0
+        ]
+        assert stuck == []
+    finally:
+        FLIGHT.disable("test-federation-healthy")
+        FLIGHT.reset()
+    for fed in feds.values():
+        fed.close()
+
+
+def test_ledger_broadcast_ships_full_buffers_only_on_epoch_change():
+    """Review-round regression: the intra-region ledger broadcast ships
+    a link's full snapshot buffer only when its merged epoch advanced;
+    quiet rounds broadcast light stamps (the WAN side's delta economy
+    must not be undone by re-shipping full snapshots intra-region)."""
+    world = ThreadWorld(2)
+    fed = Federation(
+        world.views[0], REGIONS_1X2, transport=InProcessLinkBus()
+    )
+    link = fed._links["eu"]
+    link.merged_epoch = 3
+    link.merged_meta = ("order", "meta")
+    link.merged_buf = np.arange(16, dtype=np.uint8)
+    first = fed._ledger_view()["eu"]
+    assert "merged_buf" in first and "merged_meta" in first
+    second = fed._ledger_view()["eu"]
+    assert "merged_buf" not in second and "merged_meta" not in second
+    assert second["merged_epoch"] == 3
+    link.merged_epoch = 4
+    third = fed._ledger_view()["eu"]
+    assert "merged_buf" in third
+    # a member adopting a light entry keeps its buffer, updates stamps
+    link.merged_at_round = 9
+    fed._adopt_ledger_view({"eu": {
+        "merged_epoch": 4, "merged_at_round": 9, "merged_wall": 1.0,
+        "dark": False,
+    }})
+    assert link.merged_buf is not None and link.merged_at_round == 9
+    fed.close()
+
+
+def test_non_member_single_metric_keeps_caller_shape():
+    """Review-round regression: a non-member rank passing a single bare
+    Metric gets the SAME bare metric back (never the internal wrapping
+    dict)."""
+    world = ThreadWorld(2)
+    sub = world.views[0].new_subgroup([1])
+    fed = Federation(sub, [("solo", (0,))], transport=InProcessLinkBus())
+    m = M.Sum()
+    assert fed.exchange(m) is m
+    assert fed.federate(m) is m
+
+
+def test_out_of_order_close_keeps_current_federations_gauges():
+    """Review-round regression: closing an EARLIER federation must not
+    strip the counter source (or the current_federation slot) of a
+    later-armed one."""
+    from torcheval_tpu.federation import current_federation
+    from torcheval_tpu.obs.counters import default_registry
+
+    world_a, world_b = ThreadWorld(2), ThreadWorld(2)
+    fed_a = Federation(
+        world_a.views[0], REGIONS_1X2, transport=InProcessLinkBus()
+    )
+    fed_b = Federation(
+        world_b.views[0], REGIONS_1X2, transport=InProcessLinkBus()
+    )
+    assert current_federation() is fed_b
+    fed_a.close()
+    assert current_federation() is fed_b
+    assert "federation" in default_registry().sources
+    fed_b.close()
+    assert current_federation() is None
+    assert "federation" not in default_registry().sources
+
+
+def test_non_member_handle_is_inert():
+    """A process outside the group gets an inert federation handle —
+    the subgroup non-member contract."""
+    world = ThreadWorld(2)
+    view = world.views[0]
+
+    fed = Federation(view, REGIONS_1X2, transport=InProcessLinkBus())
+    assert fed.is_member
+    fed.close()
+
+    # non-membership via a subgroup handle this rank is not in
+    sub = view.new_subgroup([1])
+    assert not sub.is_member
+    fed2 = Federation(sub, [("solo", (0,))], transport=InProcessLinkBus())
+    assert not fed2.is_member
+    coll = _make_metrics()
+    assert fed2.exchange(coll) is coll
+    assert not fed2.stale_for_healthz()

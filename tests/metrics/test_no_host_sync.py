@@ -176,6 +176,40 @@ def test_steady_state_update_is_transfer_free_quality_watched(name):
         watch.close()
 
 
+@pytest.mark.parametrize(
+    "name", ["MulticlassAccuracy", "MulticlassConfusionMatrix", "Mean"]
+)
+def test_steady_state_update_is_transfer_free_federation_armed(name):
+    """ISSUE 14 acceptance: an ARMED cross-region federation adds ZERO
+    host syncs to the steady-state update path — the federation never
+    touches ``update()`` at all; its epoch ledger, links, and gauges
+    live entirely at the exchange cadence. Non-vacuous: the federation
+    is the process-current one while the guarded update runs."""
+    from torcheval_tpu.federation import (
+        Federation,
+        InProcessLinkBus,
+        current_federation,
+    )
+    from torcheval_tpu.utils.test_utils import ThreadWorld
+
+    make, args = CLASS_CASES[name]
+    metric = make()
+    for _ in range(6):
+        metric.update(*args)
+    world = ThreadWorld(2)
+    fed = Federation(
+        world.views[0],
+        [("us", (0,)), ("eu", (1,))],
+        transport=InProcessLinkBus(),
+    )
+    try:
+        assert current_federation() is fed
+        with jax.transfer_guard("disallow"):
+            metric.update(*args)
+    finally:
+        fed.close()
+
+
 def test_donated_update_is_transfer_free_and_in_place():
     """ISSUE 6 acceptance pin: with donation enabled, the update adds
     zero host syncs AND reuses the state buffer in place — the per-step

@@ -293,6 +293,50 @@ def test_quality_watched_sync_adds_zero_collectives(n_metrics):
         watch.close()
 
 
+@pytest.mark.parametrize("n_metrics", [1, 12])
+def test_federation_armed_adds_zero_collectives(n_metrics):
+    """ISSUE 14 acceptance: with a cross-region federation ARMED
+    (current_federation set, counter source registered), the
+    intra-region sync path issues EXACTLY the bare gather counts — the
+    federation lives entirely at its own exchange cadence (mailbox
+    links + one region broadcast per exchange), never inside the sync
+    or update protocol. Non-vacuous: the federation really is armed."""
+    from torcheval_tpu import obs
+    from torcheval_tpu.federation import (
+        Federation,
+        InProcessLinkBus,
+        current_federation,
+    )
+    from torcheval_tpu.utils.test_utils import ThreadWorld
+
+    coll = _collection(n_metrics)
+    _feed(coll)
+    bare = CountingGroup()
+    want = sync_and_compute_collection(
+        {k: copy.deepcopy(m) for k, m in coll.items()}, bare
+    )
+
+    world = ThreadWorld(2)
+    fed = Federation(
+        world.views[0],
+        [("us", (0,)), ("eu", (1,))],
+        transport=InProcessLinkBus(),
+    )
+    try:
+        assert current_federation() is fed
+        assert "federation" in obs.default_registry().sources
+        counting = CountingGroup()
+        synced = sync_and_compute_collection(coll, counting)
+        assert counting.object_gathers == bare.object_gathers == 1
+        assert counting.array_gathers == bare.array_gathers <= 1
+        np.testing.assert_allclose(
+            np.asarray(synced["acc"]), np.asarray(want["acc"]), atol=1e-6
+        )
+    finally:
+        fed.close()
+    assert current_federation() is None
+
+
 def test_two_rank_sync_matches_per_metric_sync():
     """The batched path and K independent single-metric syncs agree."""
     from torcheval_tpu.metrics.toolkit import sync_and_compute

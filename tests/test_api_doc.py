@@ -28,6 +28,7 @@ MODULES = [
     ("torcheval_tpu.distributed", "distributed"),
     ("torcheval_tpu.resilience", "resilience"),
     ("torcheval_tpu.elastic", "elastic"),
+    ("torcheval_tpu.federation", "federation"),
     ("torcheval_tpu.obs", "obs"),
     ("torcheval_tpu.analysis", "analysis"),
     ("torcheval_tpu.tools", "tools"),

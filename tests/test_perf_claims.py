@@ -886,3 +886,70 @@ def test_quality_table_matches_capture():
     assert q["watched_increment_pct"] <= 2.0
     assert q["watched_inputs"] == 2
     assert q["sketched_elements_per_step"] == 4096
+
+
+RS = _load("bench_r17_region_sync_cpu_20260804.json")
+
+
+def test_region_sync_table_matches_capture():
+    """ISSUE 14: the round-17 federation section in docs/benchmarks.md
+    traces to its committed capture, and the capture itself satisfies
+    the acceptance — zero collectives added to the intra-region sync on
+    healthy links, exactly ONE broadcast per exchange, and inter-region
+    deltas strictly beating full snapshots on the dense-stable shape."""
+    text = _read("docs/benchmarks.md")
+    rs = RS["region_sync"]
+    intra, wire, ex = rs["intra_region"], rs["wire"], rs["exchange"]
+    m = re.search(
+        r"federation off vs armed \| (\d+) vs (\d+) \(zero added\)", text
+    )
+    assert m, "r17 collective-parity row not found"
+    assert int(m.group(1)) == intra["sync_gathers_bare"]
+    assert int(m.group(2)) == intra["sync_gathers_federation_armed"]
+    m = re.search(
+        r"per plain region sync \| (\d+) vs (\d+) \(exactly ONE region "
+        r"broadcast extra\)",
+        text,
+    )
+    assert m, "r17 exchange-budget row not found"
+    assert int(m.group(1)) == intra["federate_gathers"]
+    assert int(m.group(2)) == intra["sync_gathers_per_region_sync"]
+    m = re.search(
+        r"per message \| ([\d.]+) B vs ([\d.]+) B \(\*\*([\d.]+)×\*\* "
+        r"smaller\)",
+        text,
+    )
+    assert m, "r17 wire row not found"
+    assert float(m.group(1)) == pytest.approx(
+        wire["full_bytes_per_msg"], abs=0.05
+    )
+    assert float(m.group(2)) == pytest.approx(
+        wire["delta_bytes_per_msg"], abs=0.05
+    )
+    assert float(m.group(3)) == pytest.approx(
+        wire["full_over_delta"], abs=0.05
+    )
+    m = re.search(
+        r"single-rank regions\) \| ([\d.]+) µs vs ([\d.]+) µs", text
+    )
+    assert m, "r17 exchange-cost row not found"
+    assert float(m.group(1)) == pytest.approx(rs["exchange"]["federate_us"], abs=0.05)
+    assert float(m.group(2)) == pytest.approx(
+        ex["region_sync_us"], abs=0.05
+    )
+    # the acceptance quantities hold in the capture itself
+    acc = rs["acceptance"]
+    assert acc["zero_added_collectives"] is True
+    assert acc["one_broadcast_per_exchange"] is True
+    assert acc["delta_beats_full"] is True
+    assert intra["exchange_extra_collectives"] == 1
+    assert wire["delta_bytes_per_msg"] * 4 < wire["full_bytes_per_msg"]
+    # fault-tolerance.md cites the same capture ratio — keep it in step
+    ft = _read("docs/fault-tolerance.md")
+    m = re.search(
+        r"`bench.py region_sync`: ([\d.]+)× in the\ncommitted capture", ft
+    )
+    assert m, "fault-tolerance.md delta-ratio citation not found"
+    assert float(m.group(1)) == pytest.approx(
+        wire["full_over_delta"], abs=0.05
+    )

@@ -86,6 +86,7 @@ def _program_smoke() -> Report:
     combined.extend(_table_ingest_smoke())
     combined.extend(_flight_lockstep_smoke())
     combined.extend(_quality_smoke())
+    combined.extend(_federation_lockstep_smoke())
     return combined
 
 
@@ -235,6 +236,62 @@ def _flight_lockstep_smoke() -> Report:
                     f"the eager sync plan: {baseline} -> {armed} — the "
                     "diagnosis layer must never add, drop, or reorder "
                     "collectives"
+                ),
+            )
+        )
+    return report
+
+
+def _federation_lockstep_smoke() -> Report:
+    """ISSUE 14: arming a cross-region federation must not change the
+    INTRA-REGION sync protocol at all — the federation exchanges happen
+    at their own cadence over mailbox links, never inside the eager
+    sync. With a federation armed (current_federation set, counter
+    source registered), the eager sync's ordered ProcessGroup op plan is
+    IDENTICAL to the federation-off plan on every rank."""
+    from torcheval_tpu import metrics as M
+    from torcheval_tpu.analysis.lockstep import (
+        check_eager_lockstep,
+        eager_sync_plan,
+    )
+    from torcheval_tpu.analysis.report import Finding
+    from torcheval_tpu.federation import Federation, InProcessLinkBus
+    from torcheval_tpu.utils.test_utils import ThreadWorld
+
+    import jax.numpy as jnp
+
+    coll = {"acc": M.MulticlassAccuracy(), "mean": M.Mean()}
+    coll["acc"].update(jnp.ones((4, 3)), jnp.zeros((4,), jnp.int32))
+    coll["mean"].update(jnp.ones((4,)))
+    baseline = {
+        r: eager_sync_plan(coll, world_size=2, rank=r) for r in range(2)
+    }
+    fed = Federation(
+        ThreadWorld(2).views[0],
+        [("us", (0,)), ("eu", (1,))],
+        transport=InProcessLinkBus(),
+    )
+    try:
+        armed = {
+            r: eager_sync_plan(coll, world_size=2, rank=r)
+            for r in range(2)
+        }
+    finally:
+        fed.close()
+    report = check_eager_lockstep(
+        {0: baseline[0], 1: armed[1]}, name="<federation-armed sync plan>"
+    )
+    report.checked += 1
+    if baseline != armed:
+        report.findings.append(
+            Finding(
+                tool="lockstep",
+                rule="eager-plan-divergence",
+                path="<federation-armed sync plan>",
+                message=(
+                    "arming a Federation changed the eager sync plan: "
+                    f"{baseline} -> {armed} — inter-region links must "
+                    "never add, drop, or reorder intra-region collectives"
                 ),
             )
         )

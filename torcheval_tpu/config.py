@@ -428,6 +428,37 @@ def set_sync_reform_after(syncs: int) -> None:
     _sync_reform_after = int(syncs)
 
 
+# -------------------------------------------------- cross-region federation
+
+_FEDERATION_STALENESS_DEFAULT = 4
+_federation_staleness: int = _env_int(
+    "TORCHEVAL_TPU_FEDERATION_STALENESS",
+    _FEDERATION_STALENESS_DEFAULT,
+    minimum=1,
+)
+
+
+def federation_staleness_epochs() -> int:
+    """Default staleness bound (in exchange rounds) for
+    ``federation.Federation``: a remote region whose snapshot has not
+    merged for more than this many rounds is declared DARK (partition
+    detection; the federated read degrades to the surviving regions),
+    and — unless the federation overrides ``staleness_503`` — the
+    ``/healthz`` probe degrades to 503 past the same bound
+    (docs/fault-tolerance.md, "Cross-region federation"). Env
+    ``TORCHEVAL_TPU_FEDERATION_STALENESS``."""
+    return _federation_staleness
+
+
+def set_federation_staleness_epochs(rounds: int) -> None:
+    global _federation_staleness
+    if int(rounds) < 1:
+        raise ValueError(
+            f"federation staleness bound must be >= 1 round, got {rounds}"
+        )
+    _federation_staleness = int(rounds)
+
+
 # ------------------------------------------------------- sync compression
 
 _COMPRESSION_POLICIES = ("off", "bf16")

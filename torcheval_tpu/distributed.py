@@ -79,6 +79,25 @@ def _check_subgroup_ranks(ranks: Sequence[int], world: int) -> Tuple[int, ...]:
     return out
 
 
+def coordination_client():
+    """The ``jax.distributed`` coordination-service client the job
+    rendezvoused through — the shared KV transport behind
+    :class:`MultiHostSubgroup` gathers and the federation's inter-region
+    mailboxes (``federation.KVLinkTransport``). Raises when the
+    coordination service was never initialized."""
+    from jax._src import distributed as jdist
+
+    client = getattr(jdist.global_state, "client", None)
+    if client is None:
+        raise RuntimeError(
+            "the jax.distributed coordination service is not initialized "
+            "(jax.distributed.initialize / "
+            "torcheval_tpu.launcher.init_from_env) — required for "
+            "MultiHostSubgroup collectives and KV link transports"
+        )
+    return client
+
+
 class ProcessGroup:
     """Minimal interface the sync layer needs from a replica group."""
 
@@ -383,16 +402,7 @@ class MultiHostSubgroup(ProcessGroup):
         )
 
     def _client(self):
-        from jax._src import distributed as jdist
-
-        client = getattr(jdist.global_state, "client", None)
-        if client is None:
-            raise RuntimeError(
-                "MultiHostSubgroup needs the jax.distributed coordination "
-                "service (jax.distributed.initialize / "
-                "torcheval_tpu.launcher.init_from_env) to be initialized"
-            )
-        return client
+        return coordination_client()
 
     def _kv_allgather(self, payload: bytes) -> List[bytes]:
         if self._member_index is None:

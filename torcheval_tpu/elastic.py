@@ -229,6 +229,15 @@ class ElasticSession:
             background writer thread (the step path only snapshots the
             state_dict references — jax arrays are immutable, so that is
             O(#states), not O(bytes)).
+        federation: a ``federation.Federation`` whose inter-region epoch
+            ledger (merged remote snapshots, acked epochs, the snapshot
+            history pending un-acked deltas diff against) should ride
+            every bundle. On a same-world restore the ledger is loaded
+            back, so a crash mid-exchange neither double-counts a
+            re-delivered epoch (the restored ledger discards it) nor
+            drops a delta (un-acked state re-derives from the cumulative
+            snapshot). A world-size-change restore starts a fresh ledger
+            with a warning — anti-entropy re-converges it.
         fault_hook: test-only crash-point hook
             ``hook(point, generation=..., rank=...)`` called at each of
             :data:`CRASH_POINTS` (see
@@ -256,6 +265,7 @@ class ElasticSession:
         retention: Optional[int] = None,
         async_writer: bool = False,
         fault_hook: Optional[Callable[..., None]] = None,
+        federation: Optional[Any] = None,
     ) -> None:
         from torcheval_tpu import config
 
@@ -298,6 +308,7 @@ class ElasticSession:
         if self.retention < 1:
             raise ValueError(f"retention must be >= 1, got {retention}")
         self._fault_hook = fault_hook
+        self._federation = federation
         os.makedirs(self.directory, exist_ok=True)
         self._cursor = 0  # completed steps covered by current state
         self._since_snapshot = 0
@@ -417,9 +428,17 @@ class ElasticSession:
         self._next_gen += 1
         self._since_snapshot = 0
         # snapshot the state references synchronously — jax arrays are
-        # immutable, so later updates cannot mutate what we captured
+        # immutable, so later updates cannot mutate what we captured.
+        # The federation ledger is likewise captured HERE on the caller
+        # thread (the async writer must not read the live mutable link
+        # state mid-exchange).
         states = {name: m.state_dict() for name, m in self.metrics.items()}
-        job = (generation, states, self._cursor, self._payload)
+        fed_payload = (
+            self._federation.ledger_payload()
+            if self._federation is not None
+            else None
+        )
+        job = (generation, states, self._cursor, self._payload, fed_payload)
         if self._writer is not None:
             self._writer.submit(job)
         else:
@@ -486,6 +505,7 @@ class ElasticSession:
         metric_states: Dict[str, Dict[str, Any]],
         cursor: int,
         payload: Any,
+        fed_payload: Any = None,
     ) -> None:
         """Two-phase commit of one generation (see module docstring).
 
@@ -502,7 +522,7 @@ class ElasticSession:
             "torcheval.snapshot", _OBS.enabled
         ) as snap_frame:
             shard_bytes = self._write_bundle_body(
-                generation, metric_states, cursor, payload
+                generation, metric_states, cursor, payload, fed_payload
             )
         seconds = time.monotonic() - write_t0
         # registry tallies accumulate whether or not event recording is
@@ -534,6 +554,7 @@ class ElasticSession:
         metric_states: Dict[str, Dict[str, Any]],
         cursor: int,
         payload: Any,
+        fed_payload: Any = None,
     ) -> int:
         """The commit itself; returns this rank's shard size in bytes."""
         group = self._comm
@@ -552,6 +573,10 @@ class ElasticSession:
             "step": int(cursor),
             "metrics": plain,
             "payload": payload,
+            # ISSUE 14: the federation epoch ledger (None when no
+            # federation rides this session). Readers that predate the
+            # key use .get() — the shard schema is unchanged.
+            "federation": fed_payload,
         }
         blob = pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL)
         # phase 1: the shard file. Written in place (torn writes allowed —
@@ -720,6 +745,23 @@ class ElasticSession:
             old_world = int(manifest["world_size"])
             assigned = _assign_shards(old_world, world)[rank]
             self._restore_metrics(shards, assigned, gen_dir)
+            if self._federation is not None:
+                if old_world == world:
+                    # same world: this rank's own old shard carries its
+                    # federation ledger (replacement-by-epoch makes any
+                    # staleness safe — peers' re-deliveries are discarded,
+                    # un-acked deltas re-derive from cumulative state)
+                    self._federation.load_ledger(
+                        shards[rank].get("federation")
+                    )
+                else:
+                    warnings.warn(
+                        "world size changed across restore "
+                        f"({old_world} -> {world}); starting a fresh "
+                        "federation ledger (anti-entropy re-converges it "
+                        "via full snapshots)",
+                        RuntimeWarning,
+                    )
             self._cursor = int(manifest["step"])
             self._since_snapshot = 0
             # pin the numbering by CONSENSUS: every rank walked the same

@@ -53,6 +53,7 @@ from torcheval_tpu.obs.events import (
     DriftEvent,
     Event,
     MemoryEvent,
+    RegionSyncEvent,
     RestoreEvent,
     RetryEvent,
     SnapshotEvent,
@@ -182,6 +183,7 @@ __all__ = [
     "ObsServer",
     "QualityWatch",
     "Recorder",
+    "RegionSyncEvent",
     "RestoreEvent",
     "RetryEvent",
     "SketchConfig",

@@ -44,6 +44,7 @@ __all__ = [
     "DriftEvent",
     "Event",
     "MemoryEvent",
+    "RegionSyncEvent",
     "RestoreEvent",
     "RetryEvent",
     "SnapshotEvent",
@@ -298,6 +299,33 @@ class DriftEvent(Event):
 
 
 @dataclass
+class RegionSyncEvent(Event):
+    """One inter-region federation link action (``federation.py``):
+    a posted snapshot (``send-delta``/``send-full``), an applied merge
+    (``merge``), an acknowledged epoch (``ack``), an idempotently
+    discarded re-delivery (``duplicate``), an anti-entropy trigger
+    (``resync``/``base-mismatch``/``crc-failure``), or a link
+    state change (``partition``/``heal``).
+
+    ``region``/``peer`` name the directed link; ``epoch`` is the
+    message's epoch stamp, ``local_epoch`` this region's exchange round,
+    ``peer_epoch`` the peer's highest merged epoch in the ledger after
+    the action; ``nbytes`` the wire payload (delta or full);
+    ``staleness_epochs`` the staleness that tripped a ``partition``."""
+
+    kind: ClassVar[str] = "region_sync"
+
+    region: str = ""
+    peer: str = ""
+    action: str = ""
+    epoch: int = 0
+    local_epoch: int = 0
+    peer_epoch: int = 0
+    nbytes: int = 0
+    staleness_epochs: int = 0
+
+
+@dataclass
 class AlertEvent(Event):
     """One SLO/anomaly monitor alert (``obs/monitor.py``): a streaming
     drift detection (``alert="drift"``, EWMA z-score over observed metric
@@ -324,6 +352,7 @@ _EVENT_TYPES: Dict[str, Type[Event]] = {
         DriftEvent,
         AnalysisEvent,
         MemoryEvent,
+        RegionSyncEvent,
         StallEvent,
         UpdateEvent,
         ComputeEvent,

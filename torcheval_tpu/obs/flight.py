@@ -80,7 +80,7 @@ class FlightRecord:
 
     __slots__ = (
         "seq", "op", "state", "payload_bytes", "ranks", "rank",
-        "world_size", "attempts", "flow", "tid", "detail",
+        "world_size", "attempts", "flow", "tid", "detail", "tracked",
         "t_enqueued", "t_issued", "t_done", "m_last",
     )
 
@@ -107,6 +107,13 @@ class FlightRecord:
         self.flow = getattr(_trace._TLS, "flow", 0)
         self.tid = threading.get_ident()
         self.detail = ""
+        # True for LONG-LIVED exchange records (FlightRecorder.open —
+        # inter-region links): deliberately in flight across many
+        # collectives, so the watchdog does not age them and the
+        # cross-rank lockstep diff does not compare them (each direction
+        # has its own op name); the federation's staleness gauges are
+        # their health authority
+        self.tracked = False
         self.t_enqueued = now
         # a record born directly in the issued state (plain groups: no
         # queueing layer above the gather) IS its first issue attempt
@@ -136,6 +143,7 @@ class FlightRecord:
             "flow": self.flow,
             "tid": self.tid,
             "detail": self.detail,
+            "tracked": self.tracked,
             "t_enqueued": self.t_enqueued,
             "t_issued": self.t_issued,
             "t_done": self.t_done,
@@ -174,8 +182,15 @@ class FlightRing:
 
     def append(self, record: FlightRecord) -> None:
         with self.lock:
-            record.seq = self.next_seq
-            self.next_seq += 1
+            if record.tracked:
+                # tracked exchanges stay OUT of the lockstep ordinal: a
+                # leader interleaving link records with collectives must
+                # not read "ahead" of its followers in last_completed
+                # comparisons (seq 0 = not a lockstep position)
+                record.seq = 0
+            else:
+                record.seq = self.next_seq
+                self.next_seq += 1
             self.records.append(record)
             if len(self.records) > self.capacity:
                 del self.records[0]
@@ -274,6 +289,63 @@ class FlightRecorder:
         self._ring().append(record)
         self.progress += 1
         return record
+
+    def open(
+        self,
+        op: str,
+        *,
+        payload_bytes: int = 0,
+        rank: int = 0,
+        world_size: int = 0,
+        state: str = "issued",
+    ) -> Optional[FlightRecord]:
+        """Open a LONG-LIVED tracked record (``None`` when disabled) —
+        the inter-region link shape (``federation.py``): an exchange that
+        stays in flight across many collectives on this thread, so it
+        must bypass the one-record-per-thread depth guard ``start`` uses
+        for wrapped collectives. Tracked records are exempt from the
+        stall watchdog's aging and from the lockstep divergence diff
+        (see :class:`FlightRecord`). Close with :meth:`close` (NOT
+        ``complete``/``fail``, whose depth bookkeeping belongs to
+        ``start``)."""
+        if not self.enabled:
+            return None
+        record = FlightRecord(
+            0, op, payload_bytes=payload_bytes, rank=rank,
+            world_size=world_size, state=state,
+        )
+        record.tracked = True
+        self._ring().append(record)
+        self.progress += 1
+        return record
+
+    def close(
+        self,
+        record: Optional[FlightRecord],
+        *,
+        failed: bool = False,
+        ranks: Tuple[int, ...] = (),
+        detail: str = "",
+    ) -> None:
+        """Finish a tracked record from :meth:`open` (completed or
+        failed) without touching the depth guard — safe to call even
+        while an ordinary collective record is open on this thread.
+        ``last_completed_seq`` is deliberately NOT advanced: that
+        ordinal encodes cross-rank LOCKSTEP progress, and tracked
+        exchanges are not lockstep collectives."""
+        if record is None:
+            return
+        record.t_done = time.time()
+        record.ranks = tuple(ranks)
+        if detail:
+            record.detail = detail
+        self._transition(record, "failed" if failed else "completed")
+        ring = self._ring()
+        with ring.lock:
+            if failed:
+                ring.failed += 1
+            else:
+                ring.completed += 1
 
     def _transition(self, record: FlightRecord, state: str) -> None:
         record.state = state
@@ -475,7 +547,11 @@ def _completed_ops(records: List[Dict]) -> List:
             provenance=f"seq {r['seq']}",
         )
         for r in records
-        if r["state"] == "completed"
+        # tracked exchanges (inter-region links) are not lockstep
+        # collectives: each direction carries its own op name, so
+        # comparing them across ranks would fabricate a divergence on
+        # perfectly healthy links
+        if r["state"] == "completed" and not r.get("tracked")
     ]
 
 
@@ -506,6 +582,14 @@ def diff_flight_rings(
       ``CollectiveOp`` plans (``analysis/lockstep.py`` shapes); the
       first mismatching position names a would-deadlock divergence
       (ranks issuing different collectives can never rendezvous).
+
+    TRACKED records (``FlightRecorder.open`` — federation link
+    exchanges) take neither path directly: they are excluded from the
+    lockstep ordinal and the divergence diff (each direction has its own
+    op name), and the stall arm counts one only once it was RE-issued
+    with no ack in between (``attempts >= 2``) AND aged past
+    ``stall_after`` — a healthy un-acked exchange waits out one interval
+    with ``attempts == 1``, a partitioned region's probe record does not.
     """
     diff = FlightDiff()
     norm: Dict[int, List[Dict]] = {}
@@ -517,7 +601,13 @@ def diff_flight_rings(
     if not norm:
         return diff
     for rank, records in sorted(norm.items()):
-        completed = [r["seq"] for r in records if r["state"] == "completed"]
+        # lockstep progress counts ordinary collectives only (tracked
+        # exchange records complete at link cadence, not in lockstep)
+        completed = [
+            r["seq"]
+            for r in records
+            if r["state"] == "completed" and not r.get("tracked")
+        ]
         diff.last_completed[rank] = max(completed, default=0)
 
     # stall: in-flight records, lowest-progress rank first
@@ -525,25 +615,34 @@ def diff_flight_rings(
         issued = rec.get("t_issued") or rec.get("t_enqueued") or 0.0
         return max(time.time() - issued, 0.0) if issued else 0.0
 
-    in_flight = {
-        rank: [r for r in records if r["state"] in ("enqueued", "issued")]
-        for rank, records in norm.items()
-    }
     max_completed = max(diff.last_completed.values())
+
+    def _stuck_records(rank: int) -> List[Dict]:
+        out = []
+        behind = diff.last_completed[rank] < max_completed
+        for rec in norm[rank]:
+            if rec["state"] not in ("enqueued", "issued"):
+                continue
+            if rec.get("tracked"):
+                # a tracked link exchange legitimately stays in flight
+                # for a whole inter-exchange interval; it is STUCK only
+                # once it was RE-issued with no ack in between (the
+                # federation probe path) AND has aged past the bound —
+                # that is the partitioned-region signature
+                if rec.get("attempts", 1) >= 2 and _age(rec) >= stall_after:
+                    out.append(rec)
+            elif behind or _age(rec) >= stall_after:
+                out.append(rec)
+        return out
+
+    stuck_by_rank = {r: _stuck_records(r) for r in norm}
     stuck_ranks = sorted(
-        (
-            r for r, recs in in_flight.items()
-            if recs
-            and (
-                diff.last_completed[r] < max_completed
-                or any(_age(rec) >= stall_after for rec in recs)
-            )
-        ),
+        (r for r, recs in stuck_by_rank.items() if recs),
         key=lambda r: (diff.last_completed[r], r),
     )
     if stuck_ranks:
         rank = stuck_ranks[0]
-        stuck = in_flight[rank][0]
+        stuck = stuck_by_rank[rank][0]
         diff.ok = False
         diff.stalled_rank = rank
         diff.stalled_seq = diff.last_completed[rank]

@@ -46,11 +46,16 @@ __all__ = [
 
 
 def healthz_payload() -> Dict[str, Any]:
-    """The ``/healthz`` body: watchdog + flight + quorum/sync + alert
-    status with an overall ``status`` of ``ok`` / ``stalled`` /
-    ``alerting`` / ``degraded`` (first match wins; only ``stalled`` and
-    ``alerting`` fail the probe). Usable without the server — tests and
-    non-HTTP health integrations call it directly."""
+    """The ``/healthz`` body: watchdog + flight + quorum/sync +
+    federation-staleness + alert status with an overall ``status`` of
+    ``ok`` / ``stalled`` / ``stale-region`` / ``alerting`` / ``degraded``
+    (first match wins; ``stalled``, ``stale-region`` and ``alerting``
+    fail the probe — a region staler than the federation's
+    ``staleness_503`` bound means the "global" numbers this process
+    serves silently exclude that region, which a load balancer must see).
+    Usable without the server — tests and non-HTTP health integrations
+    call it directly."""
+    from torcheval_tpu.federation import current_federation
     from torcheval_tpu.obs import flight as _flight
     from torcheval_tpu.obs import monitor as _monitor
     from torcheval_tpu.obs import watchdog as _watchdog
@@ -58,6 +63,7 @@ def healthz_payload() -> Dict[str, Any]:
 
     wd = _watchdog.current_watchdog()
     mon = _monitor.current_monitor()
+    fed = current_federation()
     alerts = []
     if mon is not None:
         mon.check()
@@ -72,10 +78,36 @@ def healthz_payload() -> Dict[str, Any]:
             "consecutive_missing": list(health.consecutive_missing),
             "reforms": health.reforms,
         }
+    federation: Dict[str, Any] = {"armed": 0}
+    stale_region = False
+    if fed is not None:
+        stale_region = fed.stale_for_healthz()
+        federation = {
+            "armed": 1,
+            "epoch": fed.epoch,
+            "staleness_503": fed.staleness_503,
+            "regions": [
+                {
+                    "name": s.name,
+                    "epoch": s.epoch,
+                    "staleness_epochs": s.staleness_epochs,
+                    "age_seconds": (
+                        -1.0
+                        if s.age_seconds == float("inf")
+                        else round(s.age_seconds, 3)
+                    ),
+                    "dark": s.dark,
+                    "self": s.is_self,
+                }
+                for s in fed.region_statuses()
+            ],
+        }
     stalled = wd is not None and wd.tripped
     degraded = bool(sync["consecutive_missing"])
     if stalled:
         status = "stalled"
+    elif stale_region:
+        status = "stale-region"
     elif alerts:
         status = "alerting"
     elif degraded:
@@ -84,10 +116,11 @@ def healthz_payload() -> Dict[str, Any]:
         status = "ok"
     return {
         "status": status,
-        "healthy": status not in ("stalled", "alerting"),
+        "healthy": status not in ("stalled", "stale-region", "alerting"),
         "watchdog": wd.status() if wd is not None else {"armed": 0},
         "flight": _flight.FLIGHT.counters(),
         "sync": sync,
+        "federation": federation,
         "alerts": alerts,
     }
 

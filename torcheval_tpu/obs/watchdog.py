@@ -150,7 +150,16 @@ class StallWatchdog:
             progress = fl.progress
             now = time.monotonic()
             stuck = [
-                r for r in fl.in_flight() if r.age(now) >= self.deadline
+                r
+                for r in fl.in_flight()
+                # tracked exchange records (inter-region federation
+                # links) are DESIGNED to stay in flight across the whole
+                # inter-exchange interval — on a healthy WAN cadence far
+                # longer than any collective deadline. Their health
+                # authority is the federation's staleness bound
+                # (/healthz "stale-region"), not the collective watchdog.
+                if not getattr(r, "tracked", False)
+                and r.age(now) >= self.deadline
             ]
             if not stuck:
                 if self.tripped and progress != self._progress_at_trip:

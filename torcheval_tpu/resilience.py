@@ -76,6 +76,8 @@ __all__ = [
     "SyncProvenance",
     "SyncTimeoutError",
     "TransientSyncError",
+    "backoff_delay",
+    "bounded_call",
     "default_sync_health",
 ]
 
@@ -365,6 +367,48 @@ def _still_in_flight(budget: float) -> bool:
             break
     _reclaim_finished()
     return stuck
+
+
+def backoff_delay(
+    attempt: int,
+    *,
+    base: float = 0.05,
+    maximum: float = 2.0,
+    jitter: float = 0.5,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """The ONE exponential-backoff law of the resilience stack:
+    ``min(base * 2**(attempt-1), maximum) * (1 + jitter * u)`` with ``u``
+    from ``rng`` (deterministic for a seeded ``random.Random``; 0 when
+    ``rng`` is None or ``jitter`` is 0). Shared by
+    :class:`ResilientGroup` retries and the federation's dark-region
+    probe schedule (``federation.py`` quantizes it to exchange rounds)."""
+    delay = min(base * (2 ** max(attempt - 1, 0)), maximum)
+    if jitter and rng is not None:
+        delay *= 1.0 + jitter * rng.random()
+    return delay
+
+
+def bounded_call(fn: Callable[[], Any], timeout: Optional[float]) -> Any:
+    """Run ``fn()`` under the resilience deadline machinery: the
+    per-caller-thread reusable daemon worker (:class:`_SyncWorker`), a
+    bounded wait, and worker poisoning on a miss — so a wedged blocking
+    call (a coordination-service RPC, a stuck collective probe) costs a
+    bounded wait instead of hanging the caller. Raises
+    :class:`SyncTimeoutError` on a miss; ``timeout=None`` runs inline.
+
+    This is the standalone form of :meth:`ResilientGroup._bounded` for
+    callers that are not a collective sequence (the federation's KV link
+    polls) — it does NOT interact with the in-flight collective fence.
+    """
+    if timeout is None:
+        return fn()
+    worker = _get_worker()
+    box, done = worker.submit(fn)
+    if done.wait(timeout):
+        return _harvest(box)
+    _poison_worker(worker, done)
+    raise SyncTimeoutError(f"bounded call missed its {timeout}s deadline")
 
 
 def quorum_count(fraction: float, world: int) -> int:
@@ -691,9 +735,14 @@ class ResilientGroup(ProcessGroup):
 
     def _next_backoff(self, attempt: int) -> float:
         """Deterministic exponential backoff with jitter for retry
-        ``attempt`` (1-based)."""
-        base = min(self.backoff_base * (2 ** (attempt - 1)), self.backoff_max)
-        return base * (1.0 + self.backoff_jitter * self._rng.random())
+        ``attempt`` (1-based) — the shared :func:`backoff_delay` law."""
+        return backoff_delay(
+            attempt,
+            base=self.backoff_base,
+            maximum=self.backoff_max,
+            jitter=self.backoff_jitter,
+            rng=self._rng,
+        )
 
     # ------------------------------------------------------------ collectives
 

@@ -4,9 +4,11 @@ from torcheval_tpu.utils.test_utils.dummy_metric import (
     DummySumMetric,
 )
 from torcheval_tpu.utils.test_utils.fault_injection import (
+    ChaosLinkTransport,
     FaultInjectionGroup,
     FaultSpec,
     InjectedCrash,
+    LinkFaultSpec,
     SnapshotCrashPlan,
     corrupt_manifest_digest,
     corrupt_shard,
@@ -21,12 +23,14 @@ from torcheval_tpu.utils.test_utils.thread_world import (
 )
 
 __all__ = [
+    "ChaosLinkTransport",
     "DummySumMetric",
     "DummySumListStateMetric",
     "DummySumDictStateMetric",
     "FaultInjectionGroup",
     "FaultSpec",
     "InjectedCrash",
+    "LinkFaultSpec",
     "SnapshotCrashPlan",
     "corrupt_manifest_digest",
     "corrupt_shard",
