@@ -3530,9 +3530,9 @@ class _KillableProcSlot:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._procs = []
-        self._killed = False
-        self._paused = False
+        self._procs = []  # tev: guarded-by=_lock
+        self._killed = False  # tev: guarded-by=_lock
+        self._paused = False  # tev: guarded-by=_lock
 
     def append(self, proc) -> None:  # duck-typed for _run_child's proc_slot
         with self._lock:
@@ -3674,7 +3674,7 @@ class RelayProber:
         print(f"# tpu probe: {rec}", file=sys.stderr)
         return rec["ok"]
 
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # tev: scope=worker
         timeout = self.first_timeout
         while not self._stop.is_set():
             if self._ok.is_set() or self._busy.is_set():

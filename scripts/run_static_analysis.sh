@@ -3,13 +3,18 @@
 # (.github/workflows/pre_commit.yaml `static_analysis` job; rule
 # catalogue and suppression syntax in docs/static-analysis.md).
 #
-#   scripts/run_static_analysis.sh            # lint (jax-free, seconds)
+#   scripts/run_static_analysis.sh            # lint + concurrency
+#                                             #   (jax-free, seconds)
 #   scripts/run_static_analysis.sh --full     # + program-verifier smoke
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# AST lint + the ISSUE 15 concurrency verifier (lock discipline,
+# lock-order cycles, blocking-under-lock, cross-thread collective
+# hazards) in one jax-free pass; the JSON artifact carries both tools'
+# findings, suppressed ones flagged with their reasons.
 python -m torcheval_tpu.analysis torcheval_tpu examples bench.py scripts \
-  --report json --output lint-report.json
+  --concurrency --report json --output lint-report.json
 
 if [[ "${1:-}" == "--full" ]]; then
   python -m torcheval_tpu.analysis --no-lint --programs \

@@ -1,0 +1,593 @@
+"""Concurrency verifier (ISSUE 15): every rule family FIRES on a seeded
+violation and passes CLEAN over the shipped library, plus the CLI gate,
+the suppression audit, the obs bridge, and regressions for the genuine
+races the first library-wide sweep surfaced (Monitor._alert torn
+return, SyncHealth.as_dict torn snapshot, LatencyHistogram.__eq__).
+
+Stdlib-only on the library side: none of these tests import jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import torcheval_tpu
+from torcheval_tpu.analysis.annotations import CONCURRENCY_RULE_IDS
+from torcheval_tpu.analysis.concurrency import (
+    DEFAULT_TARGETS,
+    check_concurrency,
+    thread_contexts,
+)
+from torcheval_tpu.analysis.locks import build_universe
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+PACKAGE_DIR = os.path.dirname(os.path.abspath(torcheval_tpu.__file__))
+
+
+def _check(tmp_path, sources):
+    if isinstance(sources, str):
+        sources = {"fixture.py": sources}
+    for name, source in sources.items():
+        (tmp_path / name).write_text(source)
+    return check_concurrency([str(tmp_path)], record=False)
+
+
+def _active(report):
+    return sorted({f.rule for f in report.findings if not f.suppressed})
+
+
+# ------------------------------------------------- seeded-violation fixtures
+
+SEEDED = {
+    # PR 10 class: a bound field touched outside its lock
+    "guarded-field": (
+        "import threading\n"
+        "class Ring:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []  # tev: guarded-by=_lock\n"
+        "    def bad(self):\n"
+        "        self.items.append(1)\n"
+    ),
+    # a lock-owning class mutating undeclared shared state
+    "unguarded-state": (
+        "import threading\n"
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.hits = []  # tev: guarded-by=_lock\n"
+        "        self.count = 0\n"
+        "    def bump(self):\n"
+        "        self.count += 1\n"
+    ),
+    # PR 3 class: opposite nested acquisition orders
+    "lock-order-cycle": (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def fence_then_ring():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+        "def ring_then_fence():\n"
+        "    with B:\n"
+        "        with A:\n"
+        "            pass\n"
+    ),
+    "blocking-under-lock": (
+        "import threading\n"
+        "import time\n"
+        "L = threading.Lock()\n"
+        "def hold_and_sleep():\n"
+        "    with L:\n"
+        "        time.sleep(0.1)\n"
+    ),
+    # PR 4 class: one collective site reachable from main AND a writer
+    "cross-thread-collective": (
+        "import threading\n"
+        "class Session:\n"
+        "    def __init__(self, group):\n"
+        "        self.group = group\n"
+        "        self._thread = threading.Thread(target=self._loop)\n"
+        "    def _loop(self):  # tev: scope=writer\n"
+        "        self._flush()\n"
+        "    def _flush(self):\n"
+        "        return self.group.allgather_object(1)\n"
+        "    def snapshot(self):\n"
+        "        return self._flush()\n"
+    ),
+    "unannotated-thread-target": (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._thread = threading.Thread(target=self._loop)\n"
+        "    def _loop(self):\n"
+        "        pass\n"
+    ),
+    "bad-annotation": (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.x = 0  # tev: guarded-by=_no_such_lock\n"
+    ),
+}
+
+CLEAN_TWINS = {
+    "guarded-field": (
+        "import threading\n"
+        "class Ring:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []  # tev: guarded-by=_lock\n"
+        "    def good(self):\n"
+        "        with self._lock:\n"
+        "            self.items.append(1)\n"
+    ),
+    "unguarded-state": (
+        "import threading\n"
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0  # tev: guarded-by=_lock\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.count += 1\n"
+    ),
+    # same two locks, one consistent order everywhere
+    "lock-order-cycle": (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def f():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+    ),
+    "blocking-under-lock": (
+        "import threading\n"
+        "import time\n"
+        "L = threading.Lock()\n"
+        "def sleep_outside():\n"
+        "    with L:\n"
+        "        pass\n"
+        "    time.sleep(0.1)\n"
+    ),
+    # the writer-owned collective is single-context: no main-path caller
+    "cross-thread-collective": (
+        "import threading\n"
+        "class Session:\n"
+        "    def __init__(self, group):\n"
+        "        self.group = group\n"
+        "        self._thread = threading.Thread(target=self._loop)\n"
+        "    def _loop(self):  # tev: scope=writer\n"
+        "        self._flush()\n"
+        "    def _flush(self):\n"
+        "        return self.group.allgather_object(1)\n"
+    ),
+    "unannotated-thread-target": (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._thread = threading.Thread(target=self._loop)\n"
+        "    def _loop(self):  # tev: scope=worker\n"
+        "        pass\n"
+    ),
+    "bad-annotation": (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.x = 0  # tev: guarded-by=_lock\n"
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(SEEDED))
+def test_rule_fires_on_seeded_violation(rule, tmp_path):
+    report = _check(tmp_path, SEEDED[rule])
+    assert rule in _active(report), (
+        f"rule {rule} did not fire on its seeded violation:\n"
+        + report.format_text()
+    )
+    assert not report.ok
+
+
+@pytest.mark.parametrize("rule", sorted(CLEAN_TWINS))
+def test_clean_twin_passes(rule, tmp_path):
+    report = _check(tmp_path, CLEAN_TWINS[rule])
+    assert rule not in _active(report), (
+        f"rule {rule} fired on its clean twin:\n" + report.format_text()
+    )
+
+
+def test_every_concurrency_rule_has_a_seeded_fixture():
+    """New concurrency rules must land with a firing fixture — the
+    acceptance bullet is per rule family."""
+    assert set(SEEDED) == set(CONCURRENCY_RULE_IDS)
+
+
+# ----------------------------------------------------------- rule semantics
+
+
+def test_lock_order_cycle_carries_both_acquisition_stacks(tmp_path):
+    report = _check(tmp_path, SEEDED["lock-order-cycle"])
+    (finding,) = [f for f in report.findings if f.rule == "lock-order-cycle"]
+    # both edges of the A/B cycle, each with its acquisition site chain
+    assert "A -> B" in finding.message and "B -> A" in finding.message
+    assert "fixture:5" in finding.message and "fixture:9" in finding.message
+
+
+def test_lock_order_cycle_detects_multi_item_with(tmp_path):
+    """``with A, B:`` acquires A then B exactly like nested withs — the
+    one-line idiom must feed the same acquisition edges."""
+    report = _check(
+        tmp_path,
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def f():\n"
+        "    with A, B:\n"
+        "        pass\n"
+        "def g():\n"
+        "    with B:\n"
+        "        with A:\n"
+        "            pass\n",
+    )
+    assert "lock-order-cycle" in _active(report)
+
+
+def test_unknown_rule_suppression_fails_closed(tmp_path):
+    """A suppression naming ANY unknown rule id suppresses nothing: the
+    underlying finding stays active (and the lint flags the typo as
+    bad-suppression) — a typo can never turn the gate green."""
+    source = SEEDED["blocking-under-lock"].replace(
+        "        time.sleep(0.1)\n",
+        "        time.sleep(0.1)  # tev: disable=blocking-under-lok,blocking-under-lock -- typo'd twin\n",
+    )
+    report = _check(tmp_path, source)
+    assert "blocking-under-lock" in _active(report)
+    assert not report.ok
+
+
+def test_lock_order_cycle_through_a_call_chain(tmp_path):
+    """The PR 3 shape: a process-global fence lock and an object lock
+    acquired in opposite orders THROUGH function calls, not just lexical
+    nesting."""
+    report = _check(
+        tmp_path,
+        "import threading\n"
+        "FENCE = threading.Lock()\n"
+        "class Group:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def sync(self):\n"
+        "        with self._lock:\n"
+        "            wait_fence()\n"
+        "    def note(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "def wait_fence():\n"
+        "    with FENCE:\n"
+        "        pass\n"
+        "def fence_all(group: Group):\n"
+        "    with FENCE:\n"
+        "        group.note()\n",
+    )
+    assert "lock-order-cycle" in _active(report)
+
+
+def test_closure_under_lock_inherits_the_lexical_lock_scope(tmp_path):
+    """A nested def inside a ``with <lock>`` body runs lock-held — it
+    must not re-check lock-free as its own function (and its accesses
+    outside any lock still flag via the enclosing walk)."""
+    report = _check(
+        tmp_path,
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []  # tev: guarded-by=_lock\n"
+        "    def use(self):\n"
+        "        with self._lock:\n"
+        "            def probe():\n"
+        "                return len(self.items)\n"
+        "            return probe()\n",
+    )
+    assert "guarded-field" not in _active(report), report.format_text()
+
+
+def test_blocking_under_lock_condition_wait_is_exempt(tmp_path):
+    """``Condition.wait_for`` on the HELD lock releases it — the one
+    legal blocking-while-holding shape (ThreadWorld.exchange)."""
+    report = _check(
+        tmp_path,
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Condition()\n"
+        "        self.ready = False  # tev: guarded-by=_lock\n"
+        "    def get(self):\n"
+        "        with self._lock:\n"
+        "            self._lock.wait_for(lambda: self.ready)\n",
+    )
+    assert "blocking-under-lock" not in _active(report)
+
+
+def test_collective_issue_under_lock_is_blocking(tmp_path):
+    report = _check(
+        tmp_path,
+        "import threading\n"
+        "L = threading.Lock()\n"
+        "def sync(group, x):\n"
+        "    with L:\n"
+        "        return group.allgather_object(x)\n",
+    )
+    assert "blocking-under-lock" in _active(report)
+
+
+def test_fence_routed_collective_is_exempt(tmp_path):
+    """A multi-context collective that routes through the resilience
+    in-flight fence names is safe by construction."""
+    source = SEEDED["cross-thread-collective"].replace(
+        "    def _flush(self):\n",
+        "    def _flush(self):\n"
+        "        _still_in_flight(0.0)\n",
+    )
+    source = "def _still_in_flight(budget):\n    return False\n" + source
+    report = _check(tmp_path, source)
+    assert "cross-thread-collective" not in _active(report)
+
+
+def test_thread_contexts_propagate_through_calls(tmp_path):
+    (tmp_path / "mod.py").write_text(SEEDED["cross-thread-collective"])
+    universe = build_universe([str(tmp_path)])
+    contexts = thread_contexts(universe)
+    flush = [v for k, v in contexts.items() if k[1] == "Session._flush"]
+    assert flush and flush[0] == {"main", "writer"}
+
+
+def test_suppression_with_reason_is_honored_and_audited(tmp_path):
+    source = SEEDED["blocking-under-lock"].replace(
+        "        time.sleep(0.1)\n",
+        "        time.sleep(0.1)  # tev: disable=blocking-under-lock -- fixture: deliberate hold\n",
+    )
+    report = _check(tmp_path, source)
+    assert report.ok
+    (finding,) = [
+        f for f in report.findings if f.rule == "blocking-under-lock"
+    ]
+    assert finding.suppressed
+    assert finding.suppress_reason == "fixture: deliberate hold"
+
+
+def test_reasonless_suppression_does_not_suppress(tmp_path):
+    source = SEEDED["blocking-under-lock"].replace(
+        "        time.sleep(0.1)\n",
+        "        time.sleep(0.1)  # tev: disable=blocking-under-lock\n",
+    )
+    report = _check(tmp_path, source)
+    assert "blocking-under-lock" in _active(report)
+    assert not report.ok
+
+
+# ------------------------------------------------------- library-wide sweep
+
+
+def test_library_sweep_is_clean():
+    """The ISSUE 15 acceptance gate: zero unsuppressed findings over the
+    shipped library."""
+    report = check_concurrency([PACKAGE_DIR], record=False)
+    assert report.checked > 0
+    active = [f for f in report.findings if not f.suppressed]
+    assert report.ok and not active, report.format_text(
+        include_suppressed=False
+    )
+
+
+def test_library_sweep_covers_the_issue_targets():
+    """The named sweep floor (obs/, resilience, elastic, federation,
+    utils/checkpoint) exists and is inside the default package sweep."""
+    for target in DEFAULT_TARGETS:
+        assert os.path.exists(os.path.join(PACKAGE_DIR, target)), target
+    universe = build_universe([PACKAGE_DIR])
+    names = set(universe.modules)
+    for needed in (
+        "torcheval_tpu.obs.flight",
+        "torcheval_tpu.resilience",
+        "torcheval_tpu.elastic",
+        "torcheval_tpu.federation",
+        "torcheval_tpu.utils.checkpoint",
+    ):
+        assert needed in names
+
+
+def test_library_suppressions_all_carry_reasons():
+    report = check_concurrency([PACKAGE_DIR], record=False)
+    for finding in report.findings:
+        if finding.suppressed:
+            assert finding.suppress_reason, finding.format()
+
+
+def test_library_thread_entries_are_annotated():
+    """The thread fleet the ISSUE names is modeled: the elastic writer,
+    the JSONL writer, the watchdog, and the resilience deadline worker
+    all carry thread-scope annotations."""
+    universe = build_universe([PACKAGE_DIR])
+    scopes = {
+        (fn.module, fn.qual): fn.thread_scope
+        for module in universe.modules.values()
+        for fn in module.all_functions()
+        if fn.thread_scope is not None
+    }
+    assert scopes[("torcheval_tpu.elastic", "_SnapshotWriter._loop")] == "writer"
+    assert scopes[("torcheval_tpu.obs.export", "JsonlWriter._loop")] == "writer"
+    assert (
+        scopes[("torcheval_tpu.obs.watchdog", "StallWatchdog._loop")]
+        == "watchdog"
+    )
+    assert scopes[("torcheval_tpu.resilience", "_SyncWorker._loop")] == "worker"
+
+
+def test_elastic_writer_collective_is_the_pr4_class():
+    """The PR 4 incident is VISIBLE to the model (the writer/main
+    multi-context collective is detected) and resolved by a reasoned
+    suppression documenting the dedicated communicator."""
+    report = check_concurrency([PACKAGE_DIR], record=False)
+    hits = [
+        f
+        for f in report.findings
+        if f.rule == "cross-thread-collective"
+        and f.path.endswith("elastic.py")
+    ]
+    assert hits, "the elastic writer gather is no longer modeled"
+    assert all(f.suppressed and "dedicated" in f.suppress_reason.lower()
+               for f in hits)
+
+
+# ----------------------------------------------------------------- CLI gate
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "torcheval_tpu.analysis", *args],
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def test_cli_concurrency_gate_passes_on_library(tmp_path):
+    out = tmp_path / "report.json"
+    proc = _run_cli(
+        "--no-lint",
+        "--concurrency",
+        PACKAGE_DIR,
+        "--report",
+        "json",
+        "--output",
+        str(out),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(out.read_text())
+    assert payload["ok"] is True
+    assert payload["counts"]["errors"] == 0
+    assert any(
+        f["tool"] == "concurrency" for f in payload["findings"]
+    ), "concurrency findings (suppressed) should appear in the artifact"
+
+
+@pytest.mark.parametrize(
+    "rule",
+    [
+        "guarded-field",
+        "lock-order-cycle",
+        "blocking-under-lock",
+        "cross-thread-collective",
+    ],
+)
+def test_cli_gate_fails_on_each_seeded_rule_family(rule, tmp_path):
+    """The acceptance bullet verbatim: each rule family has a committed
+    seeded-violation fixture the CI gate demonstrably fails on."""
+    (tmp_path / "fixture.py").write_text(SEEDED[rule])
+    proc = _run_cli("--no-lint", "--concurrency", str(tmp_path))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert rule in proc.stdout
+
+
+def test_cli_concurrency_composes_with_lint(tmp_path):
+    (tmp_path / "fixture.py").write_text(
+        "import threading\n_L = threading.Lock()\n"
+    )
+    proc = _run_cli("--concurrency", str(tmp_path))
+    # bare-lock (lint) fires even though the concurrency passes are clean
+    assert proc.returncode == 1
+    assert "bare-lock" in proc.stdout
+
+
+# ------------------------------------------------------------- obs bridge
+
+
+def test_active_findings_mirror_as_analysis_events(tmp_path):
+    from torcheval_tpu.obs.recorder import RECORDER
+
+    (tmp_path / "fixture.py").write_text(SEEDED["guarded-field"])
+    RECORDER.enable()
+    try:
+        check_concurrency([str(tmp_path)])
+        events = [
+            e for e in RECORDER.log.tail() if e.kind == "analysis"
+        ]
+        assert any(
+            e.rule == "guarded-field" and e.tool == "concurrency"
+            for e in events
+        )
+    finally:
+        RECORDER.disable()
+        RECORDER.reset()
+
+
+def test_last_report_is_recorded(tmp_path):
+    from torcheval_tpu.analysis import last_report
+
+    (tmp_path / "fixture.py").write_text(CLEAN_TWINS["guarded-field"])
+    report = check_concurrency([str(tmp_path)])
+    assert last_report() is report
+
+
+# ---------------------------------------- regressions for the genuine fixes
+
+
+def test_monitor_alert_returns_its_own_alert_dict():
+    """Monitor._alert used to re-read self._active[key] AFTER releasing
+    the lock — a concurrent checker's replacement could be returned as
+    this call's alert (caught by the guarded-field sweep). The alert is
+    now captured under the lock."""
+    from torcheval_tpu.obs.monitor import Monitor
+
+    m = Monitor(cooldown=0.0)
+    a1 = m._alert("slo", "threshold", 1.0, 0.5, "first")
+    a2 = m._alert("slo", "threshold", 2.0, 0.5, "second")
+    assert a1["value"] == 1.0 and a1["message"] == "first"
+    assert a2["value"] == 2.0 and a2["message"] == "second"
+
+
+def test_monitor_alert_concurrent_returns_are_not_torn():
+    """Two concurrent _alert calls on one key each get the dict THEY
+    recorded, under every explored interleaving (the schedule harness
+    drives the race the static finding described)."""
+    from torcheval_tpu.obs import monitor as monitor_mod
+    from torcheval_tpu.utils.test_utils import DeterministicScheduler
+
+    for seed in range(6):
+        m = monitor_mod.Monitor(cooldown=0.0)
+        sched = DeterministicScheduler(seed=seed, trace=[monitor_mod])
+        sched.spawn(m._alert, "k", "drift", 1.0, 0.0, "one")
+        sched.spawn(m._alert, "k", "drift", 2.0, 0.0, "two")
+        result = sched.run()
+        values = sorted(a["value"] for a in result.values)
+        assert values == [1.0, 2.0], (seed, result.values)
+
+
+def test_latency_histogram_eq_semantics_preserved():
+    from torcheval_tpu.obs.hist import LatencyHistogram
+
+    h1, h2 = LatencyHistogram(), LatencyHistogram()
+    assert h1 == h2
+    h1.observe(0.001)
+    assert h1 != h2
+    h2.observe(0.001)
+    assert h1 == h2
+    assert h1.__eq__(object()) is NotImplemented

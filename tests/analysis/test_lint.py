@@ -52,6 +52,10 @@ SEEDED = {
         "    return x * time.time()\n"
     ),
     "shard-map-import": "from jax import shard_map\n",
+    "bare-lock": (
+        "import threading\n"
+        "_HELPER_LOCK = threading.Lock()\n"
+    ),
 }
 
 

@@ -1,0 +1,315 @@
+"""Deterministic-schedule race harness (ISSUE 15): schedule determinism
+(same seed ⇒ same interleaving ⇒ same outcome), replay-by-id, deadlock
+detection with per-thread stacks — plus the library pins the satellites
+name: the PR 3 lock-cycle class as a replayed deadlock, the
+``FLIGHT.reset()`` generation-stamping vs cached TLS rings, the
+``ChaosLinkTransport`` seeded-jitter state, and the fixed
+``SyncHealth.as_dict`` torn-snapshot race.
+
+Stdlib + library host modules only: no jax.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+import pytest
+
+from torcheval_tpu.utils.test_utils import (
+    DeadlockError,
+    DeterministicScheduler,
+    ScheduleResult,
+)
+
+THIS = sys.modules[__name__]
+
+# ----------------------------------------------------------- race bodies
+# Module-level so spawn() can trace their file and replay re-declares
+# the identical bodies.
+
+def _bump_unguarded(box, n=5):
+    for _ in range(n):
+        tmp = box[0]
+        box[0] = tmp + 1
+
+
+# NB: the deadlock test hands each run its OWN lock pair — a schedule
+# that deadlocks parks its (daemon) threads holding the locks forever,
+# so module-level locks would poison every later run.
+
+
+def _ab(a, b):
+    with a:
+        with b:
+            pass
+
+
+def _ba(a, b):
+    with b:
+        with a:
+            pass
+
+
+def _find_seed(predicate, build, sweep=64):
+    for seed in range(sweep):
+        outcome = build(seed)
+        if predicate(outcome):
+            return seed, outcome
+    pytest.fail(f"no seed in range({sweep}) produced the outcome")
+
+
+# ----------------------------------------------------------- determinism
+
+
+def test_same_seed_same_interleaving_same_outcome():
+    def run(seed):
+        box = [0]
+        sched = DeterministicScheduler(seed=seed, trace=[THIS])
+        sched.spawn(_bump_unguarded, box)
+        sched.spawn(_bump_unguarded, box)
+        return sched.run(), box[0]
+
+    r1, v1 = run(11)
+    r2, v2 = run(11)
+    assert r1.decisions == r2.decisions
+    assert v1 == v2
+    r3, _ = run(12)
+    assert r1.schedule_id != r3.schedule_id  # seed rides the id
+
+
+def test_schedule_id_round_trips():
+    result = ScheduleResult(7, [0, 1, 1, 0], [None, None])
+    assert result.schedule_id == "s7:0,1,1,0"
+    assert ScheduleResult.parse_schedule_id(result.schedule_id) == [0, 1, 1, 0]
+
+
+def test_seed_sweep_finds_lost_update_and_replay_reproduces_it():
+    """The unguarded read-modify-write loses an update under SOME seeded
+    interleaving; replaying that schedule id reproduces the exact same
+    final value — a race becomes a pinned regression."""
+
+    def run(seed):
+        box = [0]
+        sched = DeterministicScheduler(seed=seed, trace=[THIS])
+        sched.spawn(_bump_unguarded, box)
+        sched.spawn(_bump_unguarded, box)
+        return sched.run(), box[0]
+
+    seed, (result, value) = _find_seed(lambda o: o[1] < 10, run)
+    assert value < 10
+    for _ in range(2):  # replay is itself deterministic
+        box = [0]
+        DeterministicScheduler.replay(
+            result.schedule_id,
+            spawns=[(_bump_unguarded, (box,)), (_bump_unguarded, (box,))],
+            trace=[THIS],
+        )
+        assert box[0] == value
+
+
+# ------------------------------------------------------ deadlock (PR 3 class)
+
+
+def test_opposite_lock_order_deadlocks_with_stacks_and_replays():
+    """The PR 3 fence-deadlock class, executed: two threads acquiring
+    the same two locks in opposite orders deadlock under some schedule;
+    the harness names both threads' stacks, and the failing schedule
+    REPLAYS deterministically — the acceptance criterion's historical
+    bug class as a replayed schedule."""
+
+    def run(seed):
+        a, b = threading.Lock(), threading.Lock()
+        sched = DeterministicScheduler(
+            seed=seed, trace=[THIS], deadlock_timeout=0.4
+        )
+        sched.spawn(_ab, a, b, name="fence-then-ring")
+        sched.spawn(_ba, a, b, name="ring-then-fence")
+        try:
+            sched.run()
+            return None
+        except DeadlockError as e:
+            return e
+
+    seed, error = _find_seed(lambda e: e is not None, run)
+    assert set(error.stacks) == {"fence-then-ring", "ring-then-fence"}
+    assert all("_ab" in s or "_ba" in s for s in error.stacks.values())
+    # replay the recorded decision prefix: the deadlock reproduces
+    a, b = threading.Lock(), threading.Lock()
+    with pytest.raises(DeadlockError):
+        DeterministicScheduler.replay(
+            error.decisions,
+            spawns=[(_ab, (a, b)), (_ba, (a, b))],
+            trace=[THIS],
+            deadlock_timeout=0.4,
+        )
+    # the clean (consistent) order never deadlocks, any seed
+    for clean_seed in range(8):
+        a, b = threading.Lock(), threading.Lock()
+        sched = DeterministicScheduler(
+            seed=clean_seed, trace=[THIS], deadlock_timeout=0.4
+        )
+        sched.spawn(_ab, a, b)
+        sched.spawn(_ab, a, b)
+        sched.run()
+
+
+# ------------------------------------- FLIGHT.reset vs cached TLS rings
+
+
+def _flight_worker(flags):
+    from torcheval_tpu.obs.flight import FLIGHT
+
+    rec = FLIGHT.start("op_a", rank=0)
+    FLIGHT.complete(rec)
+    flags["a_done"] = True
+    while not flags.get("reset_done"):
+        pass
+    rec = FLIGHT.start("op_b", rank=0)
+    FLIGHT.complete(rec)
+
+
+def _flight_resetter(flags):
+    from torcheval_tpu.obs.flight import FLIGHT
+
+    while not flags.get("a_done"):
+        pass
+    FLIGHT.reset()
+    flags["reset_done"] = True
+
+
+def test_flight_reset_vs_cached_tls_ring():
+    """The PR 10 class, executed: a worker thread's cached TLS ring must
+    detect a concurrent ``FLIGHT.reset()`` via the generation stamp — a
+    record made strictly AFTER the reset lands in a LIVE ring (without
+    the stamp it would append into the orphaned pre-reset ring and
+    vanish from every snapshot). Same seed replays the same schedule."""
+    from torcheval_tpu.obs import flight as flight_mod
+    from torcheval_tpu.obs.flight import FLIGHT
+
+    def run(seed):
+        FLIGHT.reset()
+        FLIGHT.enable("schedule-test")
+        try:
+            flags = {}
+            sched = DeterministicScheduler(
+                seed=seed, trace=[THIS, flight_mod]
+            )
+            sched.spawn(_flight_worker, flags)
+            sched.spawn(_flight_resetter, flags)
+            result = sched.run()
+            ops = [
+                rec["op"]
+                for ring in FLIGHT.snapshot().values()
+                for rec in ring["records"]
+            ]
+            return result, ops
+        finally:
+            FLIGHT.disable("schedule-test")
+            FLIGHT.reset()
+
+    for seed in range(4):
+        result, ops = run(seed)
+        # op_b is recorded strictly after the reset: it MUST be visible
+        assert "op_b" in ops, (seed, ops, result.schedule_id)
+        # op_a predates the wipe: never visible afterwards
+        assert "op_a" not in ops, (seed, ops)
+    # determinism of the library-code schedule itself
+    r1, _ = run(3)
+    r2, _ = run(3)
+    assert r1.decisions == r2.decisions
+
+
+# --------------------------------------- ChaosLinkTransport jitter state
+
+
+def _chaos_leader(chaos, me, peer, inbox, n=4):
+    for i in range(n):
+        chaos.post(me, peer, f"{me}-{i}".encode())
+        inbox.extend(chaos.poll(me))
+
+
+def test_chaos_link_transport_state_is_schedule_clean():
+    """Two region leaders drive one ``ChaosLinkTransport`` (the ISSUE 14
+    test-world shape: one poster and one poller per directed link).
+    Under the harness: no message is lost or duplicated beyond the
+    scripted jitter, every held message is eventually delivered, and a
+    replayed schedule reproduces byte-identical delivery tallies."""
+    from torcheval_tpu.federation import InProcessLinkBus
+    from torcheval_tpu.utils.test_utils import ChaosLinkTransport
+    from torcheval_tpu.utils.test_utils import fault_injection as fi_mod
+
+    def run(schedule):
+        chaos = ChaosLinkTransport(
+            InProcessLinkBus(), jitter_polls=(0, 2), seed=5
+        )
+        us, eu = [], []
+        spawns = [
+            (_chaos_leader, (chaos, "us", "eu", us)),
+            (_chaos_leader, (chaos, "eu", "us", eu)),
+        ]
+        if isinstance(schedule, int):
+            sched = DeterministicScheduler(
+                seed=schedule, trace=[THIS, fi_mod]
+            )
+            for fn, args in spawns:
+                sched.spawn(fn, *args)
+            result = sched.run()
+        else:
+            result = DeterministicScheduler.replay(
+                schedule, spawns=spawns, trace=[THIS, fi_mod]
+            )
+        # drain the jitter-held tail so conservation is checkable
+        for _ in range(4):
+            us.extend(chaos.poll("us"))
+            eu.extend(chaos.poll("eu"))
+        return result, sorted(us), sorted(eu), dict(chaos.delivered)
+
+    result, us1, eu1, delivered1 = run(9)
+    # conservation: every posted message arrives exactly once (no
+    # partition/drop faults are scripted — jitter only delays)
+    assert us1 == sorted(f"eu-{i}".encode() for i in range(4))
+    assert eu1 == sorted(f"us-{i}".encode() for i in range(4))
+    _, us2, eu2, delivered2 = run(result.schedule_id)
+    assert (us1, eu1, delivered1) == (us2, eu2, delivered2)
+
+
+# ------------------------------------------- SyncHealth.as_dict torn read
+
+
+def _health_writer(health, n=4):
+    for _ in range(n):
+        with health._lock:
+            health.attempts += 1
+            health.retries += 1
+
+
+def _health_reader(health, snapshots, n=6):
+    for _ in range(n):
+        snapshots.append(health.as_dict())
+
+
+def test_sync_health_as_dict_is_torn_free():
+    """Regression for the guarded-field fix: ``as_dict`` snapshots under
+    the lock, so a reader can never observe the paired counters
+    mid-update (attempts bumped, retries not yet) — under ANY explored
+    schedule. The old lock-free body tears under the harness."""
+    from torcheval_tpu import resilience as resilience_mod
+    from torcheval_tpu.resilience import SyncHealth
+
+    for seed in range(6):
+        health = SyncHealth()
+        snapshots = []
+        sched = DeterministicScheduler(
+            seed=seed, trace=[THIS, resilience_mod]
+        )
+        sched.spawn(_health_writer, health)
+        sched.spawn(_health_reader, health, snapshots)
+        result = sched.run()
+        assert snapshots, result.schedule_id
+        for snap in snapshots:
+            assert snap["attempts"] == snap["retries"], (
+                seed,
+                snap,
+                result.schedule_id,
+            )

@@ -12,7 +12,11 @@ Three layers, one :class:`Finding`/:class:`Report` schema
   soundness, and dtype safety — without executing a step;
 - ``analysis.lockstep`` — cross-rank collective lockstep: per-rank
   program diffs, branch-dependent-collective hazards, and eager
-  synclib call-plan diffs, reported as would-deadlock findings.
+  synclib call-plan diffs, reported as would-deadlock findings;
+- ``analysis.locks`` / ``analysis.concurrency`` — the host-threading
+  verifier (ISSUE 15): guarded-by lock discipline, lock-order cycles,
+  blocking-under-lock, and cross-thread collective hazards over the
+  threaded modules (stdlib-only, like the lint).
 
 CLI: ``python -m torcheval_tpu.analysis [paths...] --report json``.
 
@@ -24,6 +28,7 @@ import analysis`` in a jax-free process stays jax-free.
 
 from __future__ import annotations
 
+from torcheval_tpu.analysis.concurrency import check_concurrency
 from torcheval_tpu.analysis.lint import (
     RULES,
     LintRule,
@@ -31,6 +36,7 @@ from torcheval_tpu.analysis.lint import (
     lint_paths,
     register_rule,
 )
+from torcheval_tpu.analysis.locks import check_locks
 from torcheval_tpu.analysis.report import (
     Finding,
     Report,
@@ -64,6 +70,8 @@ __all__ = sorted(
         "LintRule",
         "RULES",
         "Report",
+        "check_concurrency",
+        "check_locks",
         "last_report",
         "lint_file",
         "lint_paths",

@@ -9,6 +9,12 @@ unsuppressed error-severity finding remains — the CI gate.
 representative metric family per merge kind, statically proving the
 no-host-escape / zero-collective / donation-aliasing contracts. That arm
 imports jax; the plain lint run never does.
+
+``--concurrency`` additionally runs the concurrency verifier
+(``analysis/locks.py`` + ``analysis/concurrency.py``): guarded-by lock
+discipline, lock-order cycles, blocking-under-lock, and the
+cross-thread collective hazard model over the threaded host modules.
+Stdlib-only, like the lint — the CI concurrency gate needs no jax.
 """
 
 from __future__ import annotations
@@ -87,7 +93,61 @@ def _program_smoke() -> Report:
     combined.extend(_flight_lockstep_smoke())
     combined.extend(_quality_smoke())
     combined.extend(_federation_lockstep_smoke())
+    combined.extend(_schedule_lockstep_smoke())
     return combined
+
+
+def _schedule_lockstep_smoke() -> Report:
+    """ISSUE 15: the deterministic-schedule harness
+    (``utils/test_utils/schedule.py``) must be telemetry-grade
+    instrumentation, not behavior — an eager sync plan extracted while
+    the harness's ``sys.settrace`` scheduler drives the sync protocol is
+    IDENTICAL to the uninstrumented plan on every rank (the harness adds
+    zero collectives and zero host syncs to the instrumented path)."""
+    from torcheval_tpu import metrics as M
+    from torcheval_tpu.analysis.lockstep import (
+        check_eager_lockstep,
+        eager_sync_plan,
+    )
+    from torcheval_tpu.analysis.report import Finding
+    from torcheval_tpu.metrics import synclib
+    from torcheval_tpu.utils.test_utils.schedule import (
+        DeterministicScheduler,
+    )
+
+    import jax.numpy as jnp
+
+    coll = {"acc": M.MulticlassAccuracy(), "mean": M.Mean()}
+    coll["acc"].update(jnp.ones((4, 3)), jnp.zeros((4,), jnp.int32))
+    coll["mean"].update(jnp.ones((4,)))
+    baseline = {
+        r: eager_sync_plan(coll, world_size=2, rank=r) for r in range(2)
+    }
+    instrumented = {}
+    for rank in range(2):
+        sched = DeterministicScheduler(seed=rank, trace=[synclib])
+        sched.spawn(eager_sync_plan, coll, world_size=2, rank=rank)
+        instrumented[rank] = sched.run().values[0]
+    report = check_eager_lockstep(
+        {0: baseline[0], 1: instrumented[1]},
+        name="<schedule-instrumented sync plan>",
+    )
+    report.checked += 1
+    if baseline != instrumented:
+        report.findings.append(
+            Finding(
+                tool="lockstep",
+                rule="eager-plan-divergence",
+                path="<schedule-instrumented sync plan>",
+                message=(
+                    "driving the sync protocol under the deterministic-"
+                    f"schedule harness changed the plan: {baseline} -> "
+                    f"{instrumented} — the race harness must never add, "
+                    "drop, or reorder collectives"
+                ),
+            )
+        )
+    return report
 
 
 def _quality_smoke() -> Report:
@@ -342,6 +402,14 @@ def main(argv=None) -> int:
         action="store_true",
         help="skip the AST lint (with --programs: verifier only)",
     )
+    parser.add_argument(
+        "--concurrency",
+        action="store_true",
+        help="also run the concurrency verifier (lock discipline, "
+        "lock-order cycles, blocking-under-lock, cross-thread "
+        "collective hazards — docs/static-analysis.md, 'Concurrency "
+        "rules'; stdlib-only, no jax)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -367,13 +435,28 @@ def main(argv=None) -> int:
                 "nothing was linted"
             )
         combined.extend(lint_report)
+    if args.concurrency:
+        from torcheval_tpu.analysis.concurrency import check_concurrency
+
+        concurrency_report = check_concurrency(
+            args.paths or _default_paths()
+        )
+        if concurrency_report.checked == 0:
+            parser.error(
+                "no Python files found under the given paths — "
+                "nothing was swept for concurrency"
+            )
+        combined.extend(concurrency_report)
     if args.programs:
         combined.extend(_program_smoke())
 
     if combined.checked == 0:
         # an analysis that examined nothing must not pass the CI gate
-        # (--no-lint without --programs leaves both arms disabled)
-        parser.error("nothing was checked — --no-lint requires --programs")
+        # (--no-lint without --programs/--concurrency disables every arm)
+        parser.error(
+            "nothing was checked — --no-lint requires --programs or "
+            "--concurrency"
+        )
 
     text = (
         combined.to_json()

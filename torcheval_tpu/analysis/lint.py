@@ -19,6 +19,11 @@ behind each rule):
 - ``shard-map-import``: bare ``from jax import shard_map`` breaks on
   pre-0.4.38 jax (the seed was shipped broken this way); the import must
   sit in a try/except with the ``jax.experimental.shard_map`` fallback.
+- ``bare-lock``: a ``threading.Lock/RLock/Condition`` construction with
+  no ``# tev: guarded-by=<lock>`` binding anywhere in its scope — a lock
+  nobody declares state for is a lock the concurrency verifier
+  (``analysis/locks.py``, ISSUE 15) cannot check, and every one of the
+  PR 2/3/4/10 thread bugs lived next to exactly such a lock.
 
 Scope model: ``host-sync`` and ``time-in-jit`` only apply to modules whose
 code is traced into XLA programs (``_JIT_REACHABLE``); host-side protocol
@@ -43,6 +48,12 @@ import re
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
+from torcheval_tpu.analysis.annotations import (
+    CONCURRENCY_RULE_IDS,
+    lock_ctor_kind,
+    parse_guarded_lines,
+    parse_suppressions,
+)
 from torcheval_tpu.analysis.report import Finding, Report, set_last_report
 
 __all__ = [
@@ -53,9 +64,6 @@ __all__ = [
     "register_rule",
 ]
 
-_SUPPRESS_RE = re.compile(
-    r"#\s*tev:\s*disable=([\w\-,]+)(?:\s*--\s*(.*\S))?\s*$"
-)
 _SCOPE_RE = re.compile(r"#\s*tev:\s*scope=(jit|host)\b")
 
 # Accepted boolean env spellings — mirrors config._TRUTHY/_FALSY (kept
@@ -406,43 +414,110 @@ register_rule(
 )
 
 
+# -------------------------------------------------------------- bare-lock
+
+def _check_bare_lock(ctx: _FileContext):
+    """Every lock construction must have a ``# tev: guarded-by=<lock>``
+    binding in its scope (class body + methods for ``self.<lock>``,
+    top level for module globals) declaring WHAT it protects — else the
+    concurrency verifier has nothing to enforce for it."""
+    guarded = parse_guarded_lines(ctx.lines)
+    class_ranges = [
+        (node, node.lineno, getattr(node, "end_lineno", node.lineno))
+        for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.ClassDef)
+    ]
+
+    def scope_locks_named(line: int) -> set:
+        """Lock names bound by guarded-by comments in the same scope as
+        a construction at ``line`` (innermost class, or module level)."""
+        enclosing = None
+        for node, lo, hi in class_ranges:
+            if lo <= line <= hi:
+                if enclosing is None or lo > enclosing[1]:
+                    enclosing = (node, lo, hi)
+        named = set()
+        for gline, lock in guarded.items():
+            if enclosing is not None:
+                if enclosing[1] <= gline <= enclosing[2]:
+                    named.add(lock)
+            else:
+                in_class = any(lo <= gline <= hi for _, lo, hi in class_ranges)
+                if not in_class:
+                    named.add(lock)
+        return named
+
+    assigned_ctors = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if value is None or lock_ctor_kind(value) is None:
+            continue
+        assigned_ctors.add(id(value))
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        name = None
+        for target in targets:
+            if isinstance(target, ast.Name):
+                name = target.id
+            elif (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                name = target.attr
+        if name is None:
+            continue  # exotic target: the anonymous arm below reports it
+        if name not in scope_locks_named(node.lineno):
+            yield (
+                node.lineno,
+                node.col_offset,
+                f"bare lock `{name}`: no `# tev: guarded-by={name}` "
+                "binding in its scope declares what this lock protects "
+                "— bind the guarded state (analysis/locks.py enforces "
+                "the binding), or this lock is unverifiable",
+            )
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and lock_ctor_kind(node) is not None
+            and id(node) not in assigned_ctors
+        ):
+            yield (
+                node.lineno,
+                node.col_offset,
+                "anonymous lock construction: a lock that is not bound "
+                "to a name (module global or self attribute) cannot "
+                "carry a guarded-by binding and cannot be verified",
+            )
+
+
+register_rule(
+    LintRule(
+        id="bare-lock",
+        description=(
+            "threading.Lock/RLock/Condition constructions must carry a "
+            "guarded-by binding naming what they protect"
+        ),
+        check=_check_bare_lock,
+    )
+)
+
+
 # ----------------------------------------------------------------- driver
 
 
 def _parse_suppressions(
     lines: List[str],
 ) -> Tuple[Dict[int, Tuple[set, str]], List[Tuple[int, int, str]]]:
-    """Per-line suppression map + bad (reasonless) suppression findings."""
-    suppressions: Dict[int, Tuple[set, str]] = {}
-    bad: List[Tuple[int, int, str]] = []
-    for i, line in enumerate(lines, start=1):
-        m = _SUPPRESS_RE.search(line)
-        if not m:
-            continue
-        ids = {part.strip() for part in m.group(1).split(",") if part.strip()}
-        reason = (m.group(2) or "").strip()
-        if not reason:
-            bad.append(
-                (
-                    i,
-                    m.start(),
-                    "suppression without a reason: write "
-                    "`# tev: disable=<rule> -- <why this is intentional>`",
-                )
-            )
-            continue
-        unknown = ids - set(RULES)
-        if unknown:
-            bad.append(
-                (
-                    i,
-                    m.start(),
-                    f"suppression names unknown rule(s) {sorted(unknown)}; "
-                    f"known: {sorted(RULES)}",
-                )
-            )
-        suppressions[i] = (ids, reason)
-    return suppressions, bad
+    """Per-line suppression map + bad (reasonless/unknown) suppression
+    findings — the shared ``annotations.py`` grammar, validated against
+    the lint registry PLUS the concurrency-verifier rule ids (a
+    ``# tev: disable=cross-thread-collective`` comment in a threaded
+    module must not read as a typo to the plain lint)."""
+    return parse_suppressions(lines, set(RULES) | CONCURRENCY_RULE_IDS)
 
 
 def _select_rules(rules: Optional[Iterable[str]]) -> List[LintRule]:

@@ -167,7 +167,7 @@ class _SnapshotWriter:
         )
         self._thread.start()
 
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # tev: scope=writer
         while True:
             job = self._q.get()
             try:
@@ -603,7 +603,7 @@ class ElasticSession:
             "step": int(cursor),
         }
         # phase 2: every rank reports its shard digest; the leader commits
-        entries = group.allgather_object(entry)
+        entries = group.allgather_object(entry)  # tev: disable=cross-thread-collective -- async snapshots run on a DEDICATED whole-world subgroup (self._comm) whose collective sequence nothing else shares (the PR 4 fix); sync mode runs on the caller thread
         self._fault("pre-manifest", generation)
         if rank == 0:
             self._commit_manifest(gen_dir, generation, entries, cursor, world)

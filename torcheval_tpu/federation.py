@@ -203,7 +203,7 @@ class InProcessLinkBus(LinkTransport):
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._mail: Dict[str, List[bytes]] = {}
+        self._mail: Dict[str, List[bytes]] = {}  # tev: guarded-by=_lock
 
     def post(self, src: str, dst: str, blob: bytes) -> None:
         with self._lock:
@@ -214,7 +214,7 @@ class InProcessLinkBus(LinkTransport):
             return self._mail.pop(dst, [])
 
 
-_DEFAULT_BUS: Optional[InProcessLinkBus] = None
+_DEFAULT_BUS: Optional[InProcessLinkBus] = None  # tev: guarded-by=_DEFAULT_BUS_LOCK
 _DEFAULT_BUS_LOCK = threading.Lock()
 
 
@@ -472,7 +472,7 @@ def _backoff_rounds(attempt: int, limit: int) -> int:
 # Federation
 # --------------------------------------------------------------------------
 
-_CURRENT: Optional["Federation"] = None
+_CURRENT: Optional["Federation"] = None  # tev: guarded-by=_CURRENT_LOCK
 _CURRENT_LOCK = threading.Lock()
 
 
@@ -481,7 +481,7 @@ def current_federation() -> Optional["Federation"]:
     by ``obs.server.healthz_payload`` for the staleness probe). One
     federation per process is the production shape (rank-per-process);
     in-process test worlds share this slot — last armed wins."""
-    return _CURRENT
+    return _CURRENT  # tev: disable=guarded-field -- single-reference read, atomic under the GIL; the healthz probe tolerates a one-scrape-stale federation
 
 
 class Federation:

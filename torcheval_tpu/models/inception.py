@@ -189,7 +189,7 @@ class InceptionV3(nn.Module):
         return jnp.mean(x, axis=(1, 2))
 
 
-_DEFAULT_INIT_CACHE: Optional[Dict[str, Any]] = None
+_DEFAULT_INIT_CACHE: Optional[Dict[str, Any]] = None  # tev: guarded-by=_DEFAULT_INIT_LOCK
 _DEFAULT_INIT_LOCK = threading.Lock()
 
 
@@ -209,14 +209,14 @@ def init_inception_params(
     cannot both pay the multi-second trace."""
     global _DEFAULT_INIT_CACHE
     if rng is None:
-        if _DEFAULT_INIT_CACHE is None:
+        if _DEFAULT_INIT_CACHE is None:  # tev: disable=guarded-field -- double-checked fast path: the locked re-check below makes a stale read safe (worst case one extra lock round trip)
             with _DEFAULT_INIT_LOCK:
                 if _DEFAULT_INIT_CACHE is None:
                     _DEFAULT_INIT_CACHE = InceptionV3().init(
                         jax.random.PRNGKey(0),
                         jnp.zeros((1, 299, 299, 3), dtype=jnp.float32),
                     )
-        return jax.tree_util.tree_map(jnp.array, _DEFAULT_INIT_CACHE)
+        return jax.tree_util.tree_map(jnp.array, _DEFAULT_INIT_CACHE)  # tev: disable=guarded-field -- the cache is write-once under the lock above; after the locked publish this read can only observe the final value
     dummy = jnp.zeros((1, 299, 299, 3), dtype=jnp.float32)
     return InceptionV3().init(rng, dummy)
 

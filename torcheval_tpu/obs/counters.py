@@ -40,7 +40,7 @@ __all__ = ["CounterRegistry", "default_registry"]
 
 # elastic snapshot/restore tallies (see module docstring for why these
 # accumulate independently of the recorder's enabled flag)
-_SNAPSHOT_STATS: Dict[str, Any] = {
+_SNAPSHOT_STATS: Dict[str, Any] = {  # tev: guarded-by=_SNAPSHOT_LOCK
     "snapshots_written": 0,
     "snapshot_secs_total": 0.0,
     "last_snapshot_secs": 0.0,
@@ -112,7 +112,7 @@ class CounterRegistry:
     """
 
     def __init__(self) -> None:
-        self._sources: Dict[str, Callable[[], Dict[str, Any]]] = {}
+        self._sources: Dict[str, Callable[[], Dict[str, Any]]] = {}  # tev: guarded-by=_lock
         self._lock = threading.Lock()
 
     def register(
@@ -151,8 +151,8 @@ class CounterRegistry:
         }
 
 
-_DEFAULT: Optional[CounterRegistry] = None
-_GLOBAL_COMPILE = None
+_DEFAULT: Optional[CounterRegistry] = None  # tev: guarded-by=_DEFAULT_LOCK
+_GLOBAL_COMPILE = None  # tev: guarded-by=_DEFAULT_LOCK
 _DEFAULT_LOCK = threading.Lock()
 
 

@@ -79,12 +79,12 @@ class JsonlWriter:
         self.path = path
         self.depth = int(depth)
         self.flush_interval = float(flush_interval)
-        self.error: Optional[BaseException] = None
+        self.error: Optional[BaseException] = None  # tev: disable=unguarded-state -- single-writer error ferry: only the writer thread sets it, the caller reads/clears it at drain/close; a reference swap is atomic under the GIL
         self._lock = threading.Lock()
-        self._buf: List[dict] = []
-        self._writing = False
-        self._stop = False
-        self._closed = False
+        self._buf: List[dict] = []  # tev: guarded-by=_lock
+        self._writing = False  # tev: guarded-by=_lock
+        self._stop = False  # tev: guarded-by=_lock
+        self._closed = False  # tev: disable=unguarded-state -- caller-thread-only lifecycle flag (close() is caller API; the writer thread never reads it)
         self._kick = threading.Event()  # "flush now" (drain/backpressure)
         # open on the caller's thread so a bad path fails at construction,
         # not silently inside the daemon
@@ -94,7 +94,7 @@ class JsonlWriter:
         )
         self._thread.start()
 
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # tev: scope=writer
         while True:
             self._kick.wait(self.flush_interval)
             self._kick.clear()

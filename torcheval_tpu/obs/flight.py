@@ -171,13 +171,14 @@ class FlightRing:
 
     def __init__(self, capacity: int, tid: int) -> None:
         self.capacity = int(capacity)
-        self.records: List[FlightRecord] = []
+        self.records: List[FlightRecord] = []  # tev: guarded-by=lock
         self.lock = threading.Lock()
-        self.next_seq = 1
-        self.last_completed_seq = 0
-        self.completed = 0
-        self.failed = 0
-        self.rank = 0  # last-known rank attribution of this thread
+        self.next_seq = 1  # tev: guarded-by=lock
+        self.last_completed_seq = 0  # tev: guarded-by=lock
+        self.completed = 0  # tev: guarded-by=lock
+        self.failed = 0  # tev: guarded-by=lock
+        # last-known rank attribution of this thread
+        self.rank = 0  # tev: guarded-by=lock
         self.tid = tid
 
     def append(self, record: FlightRecord) -> None:
@@ -214,18 +215,21 @@ class FlightRecorder:
     """
 
     def __init__(self, capacity: int = DEFAULT_RING_CAPACITY) -> None:
-        self.enabled: bool = False
+        # lock-free hot-path gate by design: every instrumented site
+        # pays exactly one attribute read when recording is off; the
+        # writers (enable/disable) serialize under _lock
+        self.enabled: bool = False  # tev: disable=unguarded-state -- lock-free hot-path gate; writers hold _lock, readers tolerate staleness by contract
         self.capacity = int(capacity)
-        self._sources: set = set()
-        self._rings: Dict[int, FlightRing] = {}
+        self._sources: set = set()  # tev: guarded-by=_lock
+        self._rings: Dict[int, FlightRing] = {}  # tev: guarded-by=_lock
         self._lock = threading.Lock()
         self._tls = threading.local()
         # bumped by reset(): other threads' cached TLS rings detect the
         # wipe on next use instead of writing into an orphaned ring
-        self._generation = 0
+        self._generation = 0  # tev: guarded-by=_lock
         # bumped on EVERY state transition: the watchdog's cheap
         # "did anything move since I last looked" probe
-        self.progress = 0
+        self.progress = 0  # tev: disable=unguarded-state -- monotonic progress probe; a racy lost increment only delays the watchdog one poll tick, never blocks
 
     # ------------------------------------------------------------ lifecycle
 
@@ -252,7 +256,7 @@ class FlightRecorder:
         ring = getattr(self._tls, "ring", None)
         if (
             ring is not None
-            and getattr(self._tls, "generation", -1) == self._generation
+            and getattr(self._tls, "generation", -1) == self._generation  # tev: disable=guarded-field -- racy fast-path generation probe; a stale read only defers fresh-ring adoption to the locked re-stamp below (pinned by tests/test_utils/test_schedule.py::test_flight_reset_vs_cached_tls_ring)
         ):
             return ring
         tid = threading.get_ident()

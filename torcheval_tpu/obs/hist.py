@@ -70,9 +70,9 @@ class LatencyHistogram:
     __slots__ = ("counts", "sum", "count", "_lock")
 
     def __init__(self) -> None:
-        self.counts = [0] * NUM_BUCKETS
-        self.sum = 0.0
-        self.count = 0
+        self.counts = [0] * NUM_BUCKETS  # tev: guarded-by=_lock
+        self.sum = 0.0  # tev: guarded-by=_lock
+        self.count = 0  # tev: guarded-by=_lock
         self._lock = threading.Lock()
 
     def observe(self, seconds: float) -> None:
@@ -139,18 +139,16 @@ class LatencyHistogram:
         return h
 
     def __eq__(self, other: object) -> bool:
+        # snapshot each side under its own lock: a racing insert must
+        # not tear the comparison (ISSUE 15 guarded-field sweep)
         if not isinstance(other, LatencyHistogram):
             return NotImplemented
-        return (
-            self.counts == other.counts
-            and self.sum == other.sum
-            and self.count == other.count
-        )
+        return self.as_dict() == other.as_dict()
 
 
 # --------------------------------------------------------- global registry
 
-_REGISTRY: Dict[str, LatencyHistogram] = {}
+_REGISTRY: Dict[str, LatencyHistogram] = {}  # tev: guarded-by=_REGISTRY_LOCK
 _REGISTRY_LOCK = threading.Lock()
 
 
@@ -161,7 +159,7 @@ def observe(key: str, seconds: float) -> None:
     on). Creates the histogram on first use. The insert is inlined
     (rather than delegating to :meth:`LatencyHistogram.observe`) — this
     sits on the recorder-ON update path, where call depth is budget."""
-    h = _REGISTRY.get(key)
+    h = _REGISTRY.get(key)  # tev: disable=guarded-field -- lock-free dict probe on the recorder-ON update path; two racers both fall through to the locked setdefault, which picks one winner
     if h is None:
         with _REGISTRY_LOCK:
             h = _REGISTRY.setdefault(key, LatencyHistogram())

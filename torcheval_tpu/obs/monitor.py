@@ -62,7 +62,7 @@ __all__ = [
 # the process's quality checks. A raising hook is isolated (one broken
 # scorer must not fail the health probe), surfacing as a ``hook-error``
 # entry in that check's raised list instead.
-_CHECK_HOOKS: Dict[str, Any] = {}
+_CHECK_HOOKS: Dict[str, Any] = {}  # tev: guarded-by=_HOOK_LOCK
 _HOOK_LOCK = threading.Lock()
 
 
@@ -174,17 +174,17 @@ class Monitor:
         self.alpha = float(alpha)
         self.warmup = int(warmup)
         self.cooldown = float(cooldown)
-        self.slos: List[SloSpec] = []
-        self.alerts_total = 0
+        self.slos: List[SloSpec] = []  # tev: guarded-by=_lock
+        self.alerts_total = 0  # tev: guarded-by=_lock
         self._lock = threading.Lock()
-        self._series: Dict[str, EwmaStat] = {}
-        self._last_alert: Dict[Tuple[str, str], float] = {}
+        self._series: Dict[str, EwmaStat] = {}  # tev: guarded-by=_lock
+        self._last_alert: Dict[Tuple[str, str], float] = {}  # tev: guarded-by=_lock
         # active breaches keyed by (name, kind) -> last AlertEvent dict
-        self._active: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._active: Dict[Tuple[str, str], Dict[str, Any]] = {}  # tev: guarded-by=_lock
         # burn-rate bookkeeping: per-spec deque of (t, err, tot)
-        self._burn: Dict[str, List[Tuple[float, float, float]]] = {}
+        self._burn: Dict[str, List[Tuple[float, float, float]]] = {}  # tev: guarded-by=_lock
         # latency-digest bookkeeping: previous counts per key
-        self._hist_prev: Dict[str, Any] = {}
+        self._hist_prev: Dict[str, Any] = {}  # tev: guarded-by=_lock
         for spec in slos:
             self.add_slo(spec)
 
@@ -223,7 +223,12 @@ class Monitor:
         now = time.monotonic() if now is None else now
         key = (name, kind)
         with self._lock:
-            self._active[key] = {
+            # the alert dict is captured HERE, under the lock: re-reading
+            # self._active[key] after release returned whatever a
+            # concurrent checker had replaced it with (caught by the
+            # ISSUE 15 guarded-field sweep; pinned in
+            # tests/analysis/test_concurrency.py)
+            alert = self._active[key] = {
                 "name": name,
                 "alert": kind,
                 "value": value,
@@ -242,7 +247,7 @@ class Monitor:
             bound=float(bound), z=float(z), message=message,
         )
         RECORDER.record(event)
-        return self._active[key]
+        return alert
 
     def _clear(self, name: str, kind: str) -> None:
         with self._lock:
@@ -442,13 +447,13 @@ class Monitor:
         return out
 
 
-_MONITOR: Optional[Monitor] = None
+_MONITOR: Optional[Monitor] = None  # tev: guarded-by=_MONITOR_LOCK
 _MONITOR_LOCK = threading.Lock()
 
 
 def current_monitor() -> Optional[Monitor]:
     """The armed process-global monitor, or ``None``."""
-    return _MONITOR
+    return _MONITOR  # tev: disable=guarded-field -- single-reference read, atomic under the GIL; /healthz probes tolerate a stale monitor for one scrape
 
 
 def arm_monitor(

@@ -86,7 +86,7 @@ _STATE_SUFFIXES = ("hist", "cnt", "mom", "reg")
 
 # per-metric extended-plan memo (see _watched_update_plan); weak keys so
 # a dropped metric never pins its plan (or the kernels it closes over)
-_PLAN_MEMO: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_PLAN_MEMO: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()  # tev: disable=unguarded-state -- keyed by the metric instance whose own (single-threaded) update call populates it; no cross-thread sharing by contract
 
 
 def _q_names(i: int) -> Tuple[str, ...]:
@@ -377,9 +377,9 @@ def _instrument(metric: Metric, spec: _WatchSpec) -> None:
 
 # --------------------------------------------------------------- watching
 
-_WATCHES: "Dict[int, QualityWatch]" = {}
+_WATCHES: "Dict[int, QualityWatch]" = {}  # tev: guarded-by=_WATCH_LOCK
 _WATCH_LOCK = threading.Lock()
-_WATCH_SEQ = [0]
+_WATCH_SEQ = [0]  # tev: guarded-by=_WATCH_LOCK
 
 
 def active_watches() -> List["QualityWatch"]:
@@ -503,9 +503,9 @@ class QualityWatch:
         self.config = config
         self._id = 0
         self._lock = threading.Lock()
-        self._refs: Dict[str, Dict[str, np.ndarray]] = {}
-        self._specs: List[DriftSpec] = []
-        self._scores: Dict[str, Dict[str, float]] = {}
+        self._refs: Dict[str, Dict[str, np.ndarray]] = {}  # tev: guarded-by=_lock
+        self._specs: List[DriftSpec] = []  # tev: guarded-by=_lock
+        self._scores: Dict[str, Dict[str, float]] = {}  # tev: guarded-by=_lock
 
     @property
     def series(self) -> Tuple[str, ...]:

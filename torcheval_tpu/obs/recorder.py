@@ -56,10 +56,10 @@ class EventLog:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
-        self._buf: deque = deque(maxlen=self.capacity)
+        self._buf: deque = deque(maxlen=self.capacity)  # tev: guarded-by=_lock
         self._lock = threading.Lock()
-        self.total = 0
-        self.counts: Dict[str, int] = {}
+        self.total = 0  # tev: guarded-by=_lock
+        self.counts: Dict[str, int] = {}  # tev: guarded-by=_lock
 
     def append(self, event: Event) -> None:
         with self._lock:

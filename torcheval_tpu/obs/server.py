@@ -232,13 +232,13 @@ class ObsServer:
         self._httpd.server_close()
 
 
-_SERVER: Optional[ObsServer] = None
+_SERVER: Optional[ObsServer] = None  # tev: guarded-by=_SERVER_LOCK
 _SERVER_LOCK = threading.Lock()
 
 
 def current_server() -> Optional[ObsServer]:
     """The running process-global server, or ``None``."""
-    srv = _SERVER
+    srv = _SERVER  # tev: disable=guarded-field -- single-reference read, atomic under the GIL; a probe racing stop_server tolerates one stale answer
     return srv if srv is not None and srv.running else None
 
 
@@ -249,7 +249,7 @@ def start_server(port: int = 0, host: str = "127.0.0.1") -> ObsServer:
     global _SERVER
     with _SERVER_LOCK:
         if _SERVER is not None:
-            _SERVER.stop()
+            _SERVER.stop()  # tev: disable=blocking-under-lock -- bounded serve-loop join (5 s); the HTTP threads never take _SERVER_LOCK, so this is a bounded wait, not a deadlock edge
         _SERVER = ObsServer(port, host).start()
         return _SERVER
 
@@ -259,5 +259,5 @@ def stop_server() -> None:
     global _SERVER
     with _SERVER_LOCK:
         if _SERVER is not None:
-            _SERVER.stop()
+            _SERVER.stop()  # tev: disable=blocking-under-lock -- bounded serve-loop join (5 s); the HTTP threads never take _SERVER_LOCK, so this is a bounded wait, not a deadlock edge
             _SERVER = None

@@ -144,7 +144,7 @@ class StallWatchdog:
 
     # ----------------------------------------------------------------- loop
 
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # tev: scope=watchdog
         fl = _flight.FLIGHT
         while not self._stop.wait(self.poll):
             progress = fl.progress
@@ -243,13 +243,13 @@ class StallWatchdog:
                 pass
 
 
-_WATCHDOG: Optional[StallWatchdog] = None
+_WATCHDOG: Optional[StallWatchdog] = None  # tev: guarded-by=_WATCHDOG_LOCK
 _WATCHDOG_LOCK = threading.Lock()
 
 
 def current_watchdog() -> Optional[StallWatchdog]:
     """The armed process-global watchdog, or ``None``."""
-    wd = _WATCHDOG
+    wd = _WATCHDOG  # tev: disable=guarded-field -- single-reference read, atomic under the GIL; liveness probes tolerate a one-scrape-stale watchdog
     return wd if wd is not None and wd.armed else None
 
 
@@ -268,7 +268,7 @@ def arm_watchdog(
     global _WATCHDOG
     with _WATCHDOG_LOCK:
         if _WATCHDOG is not None:
-            _WATCHDOG.disarm()
+            _WATCHDOG.disarm()  # tev: disable=blocking-under-lock -- bounded poll-thread join (<= 4 poll intervals); the poll loop never takes _WATCHDOG_LOCK, so this is a bounded wait, not a deadlock edge
         _WATCHDOG = StallWatchdog(
             deadline, poll=poll, sink=sink, jsonl=jsonl
         )
@@ -286,7 +286,7 @@ def disarm_watchdog() -> None:
     global _WATCHDOG
     with _WATCHDOG_LOCK:
         if _WATCHDOG is not None:
-            _WATCHDOG.disarm()
+            _WATCHDOG.disarm()  # tev: disable=blocking-under-lock -- bounded poll-thread join (<= 4 poll intervals); the poll loop never takes _WATCHDOG_LOCK, so this is a bounded wait, not a deadlock edge
             _WATCHDOG = None
             default_registry().unregister("watchdog")
 
@@ -303,7 +303,7 @@ def _restore_watchdog(previous: Optional[StallWatchdog]) -> None:
         return
     with _WATCHDOG_LOCK:
         if _WATCHDOG is not None and _WATCHDOG is not previous:
-            _WATCHDOG.disarm()
+            _WATCHDOG.disarm()  # tev: disable=blocking-under-lock -- bounded poll-thread join (<= 4 poll intervals); the poll loop never takes _WATCHDOG_LOCK, so this is a bounded wait, not a deadlock edge
         _WATCHDOG = previous
         previous.arm()
         default_registry().register("watchdog", previous.counters)

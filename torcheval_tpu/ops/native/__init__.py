@@ -72,7 +72,7 @@ _EXTRA_FLAGS = {
 }
 
 _lock = threading.Lock()
-_registered: Optional[bool] = None
+_registered: Optional[bool] = None  # tev: guarded-by=_lock
 
 
 def _sources():

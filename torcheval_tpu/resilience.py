@@ -168,46 +168,51 @@ class SyncHealth:
     trustworthy.
     """
 
-    attempts: int = 0  # collective attempts issued (retries included)
-    retries: int = 0  # attempts beyond the first, per collective
-    timeouts: int = 0  # attempts that missed the deadline
-    transient_errors: int = 0  # retryable wire glitches observed
-    partial_gathers: int = 0  # fault-aware partial completions observed
-    corrupt_payloads: int = 0  # checksum failures (synclib integrity check)
-    degraded_syncs: int = 0  # syncs that completed without full participation
-    full_syncs: int = 0  # syncs with every rank participating
-    last_good_sync: Optional[float] = None  # time.monotonic() of last full sync
-    participating_ranks: Tuple[int, ...] = ()  # most recent sync's ranks
+    attempts: int = 0  # tev: guarded-by=_lock
+    retries: int = 0  # tev: guarded-by=_lock
+    timeouts: int = 0  # tev: guarded-by=_lock
+    transient_errors: int = 0  # tev: guarded-by=_lock
+    partial_gathers: int = 0  # tev: guarded-by=_lock
+    corrupt_payloads: int = 0  # tev: guarded-by=_lock
+    degraded_syncs: int = 0  # tev: guarded-by=_lock
+    full_syncs: int = 0  # tev: guarded-by=_lock
+    last_good_sync: Optional[float] = None  # tev: guarded-by=_lock
+    participating_ranks: Tuple[int, ...] = ()  # tev: guarded-by=_lock
     world_size: int = 0
     policy: str = "raise"
     # survivor re-formation (persistent-failure escalation)
-    reforms: int = 0  # times the group re-formed onto survivors
-    reformed_to: Tuple[int, ...] = ()  # GLOBAL ranks of the active group
-    consecutive_missing: Tuple[int, ...] = ()  # current same-missing streak
-    consecutive_missing_count: int = 0  # length of that streak
+    reforms: int = 0  # tev: guarded-by=_lock
+    reformed_to: Tuple[int, ...] = ()  # tev: guarded-by=_lock
+    consecutive_missing: Tuple[int, ...] = ()  # tev: guarded-by=_lock
+    consecutive_missing_count: int = 0  # tev: guarded-by=_lock
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
     )
 
     def as_dict(self) -> Dict[str, Any]:
-        return {
-            "attempts": self.attempts,
-            "retries": self.retries,
-            "timeouts": self.timeouts,
-            "transient_errors": self.transient_errors,
-            "partial_gathers": self.partial_gathers,
-            "corrupt_payloads": self.corrupt_payloads,
-            "degraded_syncs": self.degraded_syncs,
-            "full_syncs": self.full_syncs,
-            "last_good_sync": self.last_good_sync,
-            "participating_ranks": list(self.participating_ranks),
-            "world_size": self.world_size,
-            "policy": self.policy,
-            "reforms": self.reforms,
-            "reformed_to": list(self.reformed_to),
-            "consecutive_missing": list(self.consecutive_missing),
-            "consecutive_missing_count": self.consecutive_missing_count,
-        }
+        # one consistent snapshot: readers used to see e.g. a bumped
+        # `attempts` next to a not-yet-bumped `timeouts` mid-update
+        # (caught by the ISSUE 15 guarded-field sweep; pinned by
+        # tests/test_utils/test_schedule.py::test_sync_health_as_dict_is_torn_free)
+        with self._lock:
+            return {
+                "attempts": self.attempts,
+                "retries": self.retries,
+                "timeouts": self.timeouts,
+                "transient_errors": self.transient_errors,
+                "partial_gathers": self.partial_gathers,
+                "corrupt_payloads": self.corrupt_payloads,
+                "degraded_syncs": self.degraded_syncs,
+                "full_syncs": self.full_syncs,
+                "last_good_sync": self.last_good_sync,
+                "participating_ranks": list(self.participating_ranks),
+                "world_size": self.world_size,
+                "policy": self.policy,
+                "reforms": self.reforms,
+                "reformed_to": list(self.reformed_to),
+                "consecutive_missing": list(self.consecutive_missing),
+                "consecutive_missing_count": self.consecutive_missing_count,
+            }
 
 
 class _SyncWorker:
@@ -228,7 +233,7 @@ class _SyncWorker:
         )
         self._thread.start()
 
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # tev: scope=worker
         while True:
             job = self._jobs.get()
             if job is None:  # stop sentinel: surplus reclaimed worker

@@ -17,6 +17,11 @@ from torcheval_tpu.utils.test_utils.fault_injection import (
 from torcheval_tpu.utils.test_utils.metric_class_tester import (
     MetricClassTester,
 )
+from torcheval_tpu.utils.test_utils.schedule import (
+    DeadlockError,
+    DeterministicScheduler,
+    ScheduleResult,
+)
 from torcheval_tpu.utils.test_utils.thread_world import (
     ThreadRankGroup,
     ThreadWorld,
@@ -24,6 +29,9 @@ from torcheval_tpu.utils.test_utils.thread_world import (
 
 __all__ = [
     "ChaosLinkTransport",
+    "DeadlockError",
+    "DeterministicScheduler",
+    "ScheduleResult",
     "DummySumMetric",
     "DummySumListStateMetric",
     "DummySumDictStateMetric",

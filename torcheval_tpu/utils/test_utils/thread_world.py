@@ -42,9 +42,9 @@ class ThreadWorld:
         self.world_size = world_size
         self.timeout = timeout
         self._lock = threading.Condition()
-        self._mail: Dict[Tuple, Dict[int, Any]] = {}
-        self._reads: Dict[Tuple, int] = {}
-        self._subgroup_seq: Dict[Tuple[int, ...], int] = {}
+        self._mail: Dict[Tuple, Dict[int, Any]] = {}  # tev: guarded-by=_lock
+        self._reads: Dict[Tuple, int] = {}  # tev: guarded-by=_lock
+        self._subgroup_seq: Dict[Tuple[int, ...], int] = {}  # tev: guarded-by=_lock
         self.views = [
             ThreadRankGroup(self, rank, tuple(range(world_size)))
             for rank in range(world_size)
@@ -95,7 +95,7 @@ class ThreadWorld:
         results: List[Any] = [None] * self.world_size
         errors: List[Optional[BaseException]] = [None] * self.world_size
 
-        def runner(rank: int) -> None:
+        def runner(rank: int) -> None:  # tev: scope=worker
             try:
                 results[rank] = fn(self.views[rank])
             except BaseException as e:  # noqa: BLE001 — ferried to caller
