@@ -2544,6 +2544,258 @@ def run_region_sync():
     }
 
 
+def run_async_sync():
+    """Config 18: zero-stall sync plane (ISSUE 16).
+
+    Serving-latency audit of ``torcheval_tpu.syncplane.SyncPlane`` on an
+    in-process two-rank world:
+
+    - ``latency``: per-update serving latency, three arms. The
+      precision-critical pair (sync OFF vs plane ARMED at a 0.5 s round
+      cadence) runs STEP-INTERLEAVED in one serving loop — two
+      identical collections, one armed, updated back to back each step
+      with alternating order — so scheduler/steal bursts on this shared
+      box hit both sample sets symmetrically and cancel in the ratio;
+      the BLOCKING arm (inline eager ``sync_and_compute_collection``
+      every CADENCE updates — the stall the plane removes) runs as its
+      own phase since its ratio needs no 2% precision. The pinned
+      statistic is the MEDIAN over TRIALS independent runs of the
+      per-run pooled-p99 ratio: a single p99 order statistic has ~±5%
+      sampling noise under this box's co-load, and the median across
+      runs is the stable estimator of the structural ratio (the same
+      reasoning as ``_timed_loop``'s best-of-windows);
+    - ``collectives``: the acceptance pin at the ProcessGroup
+      interface — with a plane ARMED over a counting fake group, a
+      serving burst of updates + snapshot publishes issues ZERO gathers
+      on the serving group (the plane's rounds are the only collective
+      traffic, and they live on the dedicated communicator), vs the
+      gathers ONE inline blocking sync costs at the same interface.
+
+    Bounded-staleness bit-identity vs the blocking oracle at the same
+    version is pinned by tier-1 (tests/metrics/test_syncplane.py), not
+    re-proven here. Provenance from a live read rides along as capture
+    context.
+    """
+    import threading
+    import warnings
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from torcheval_tpu import metrics as M
+    from torcheval_tpu.distributed import ProcessGroup
+    from torcheval_tpu.metrics.toolkit import sync_and_compute_collection
+    from torcheval_tpu.syncplane import SyncPlane
+    from torcheval_tpu.utils.test_utils import ThreadWorld
+
+    rng = np.random.default_rng(18)
+    xa = jnp.asarray(np.float32(rng.uniform(size=(256, 16))))
+    ta = jnp.asarray(rng.integers(0, 16, 256))
+    xm = jnp.asarray(np.float32(rng.normal(size=256)))
+    STEPS, CADENCE, TRIALS, INTERVAL = 4000, 25, 7, 0.5
+
+    def _panel():
+        coll = {"acc": M.MulticlassAccuracy(), "mean": M.Mean()}
+        coll["acc"].update(xa, ta)
+        coll["mean"].update(xm)
+        return coll
+
+    def _p(lat, q):
+        return float(np.percentile(lat, q) * 1e6)
+
+    # ------------------------------------------------------------ latency
+    def _trial():
+        world = ThreadWorld(2)
+        out = {}
+        bar = threading.Barrier(2)
+
+        def drive(g):
+            off, armed, blocked = _panel(), _panel(), _panel()
+            plane = SyncPlane(
+                armed, g, interval=INTERVAL, timeout=5.0, retries=0
+            )
+            plane.publish()
+            lat_off = np.empty(STEPS)
+            lat_plane = np.empty(STEPS)
+            publish_us = []
+
+            def seg_off():
+                t0 = time.perf_counter()
+                off["acc"].update(xa, ta)
+                off["mean"].update(xm)
+                return time.perf_counter() - t0
+
+            def seg_plane(duty):
+                t0 = time.perf_counter()
+                armed["acc"].update(xa, ta)
+                armed["mean"].update(xm)
+                if duty:
+                    t1 = time.perf_counter()
+                    plane.publish()
+                    publish_us.append((time.perf_counter() - t1) * 1e6)
+                return time.perf_counter() - t0
+
+            bar.wait()
+            for i in range(STEPS):
+                duty = (i + 1) % CADENCE == 0
+                # alternate segment order so burst noise lands on both
+                # arms' samples symmetrically
+                if i % 2:
+                    lat_off[i] = seg_off()
+                    lat_plane[i] = seg_plane(duty)
+                else:
+                    lat_plane[i] = seg_plane(duty)
+                    lat_off[i] = seg_off()
+            bar.wait()
+            version = plane.version
+            read = plane.read_metric(armed["mean"])
+            plane.close()
+            # blocking phase: the same serving loop paying the eager
+            # sync inline at the same cadence — the stall arm
+            sync_and_compute_collection(blocked, g)  # warm
+            lat_block = np.empty(STEPS // 2)
+            stall_us = []
+            bar.wait()
+            for i in range(STEPS // 2):
+                t0 = time.perf_counter()
+                blocked["acc"].update(xa, ta)
+                blocked["mean"].update(xm)
+                if (i + 1) % CADENCE == 0:
+                    t1 = time.perf_counter()
+                    sync_and_compute_collection(blocked, g)
+                    stall_us.append((time.perf_counter() - t1) * 1e6)
+                lat_block[i] = time.perf_counter() - t0
+            bar.wait()
+            if g.rank == 0:
+                prov = read.sync_provenance
+                out.update(
+                    off_p99=_p(lat_off, 99),
+                    off_p50=_p(lat_off, 50),
+                    plane_p99=_p(lat_plane, 99),
+                    plane_p50=_p(lat_plane, 50),
+                    block_p99=_p(lat_block, 99),
+                    block_p50=_p(lat_block, 50),
+                    publish_us=float(np.median(publish_us)),
+                    stall_us=float(np.median(stall_us)),
+                    rounds_merged=version,
+                    provenance={
+                        "version": prov.version,
+                        "rounds_behind": prov.rounds_behind,
+                        "wall_age_seconds": round(
+                            prov.wall_age_seconds, 3
+                        ),
+                        "ranks": list(prov.ranks),
+                    },
+                )
+
+        world.run(drive)
+        return out
+
+    trials = [_trial() for _ in range(TRIALS)]
+    ratio = float(
+        np.median([t["plane_p99"] / t["off_p99"] for t in trials])
+    )
+    ratio50 = float(
+        np.median([t["plane_p50"] / t["off_p50"] for t in trials])
+    )
+    block_ratio = float(
+        np.median([t["block_p99"] / t["off_p99"] for t in trials])
+    )
+    med = {
+        k: float(np.median([t[k] for t in trials]))
+        for k in (
+            "off_p99", "off_p50", "plane_p99", "plane_p50",
+            "block_p99", "block_p50", "publish_us", "stall_us",
+        )
+    }
+
+    # ------------------------------------------- serving-group collectives
+    class _Counting(ProcessGroup):
+        """Two fake ranks holding this process's payload; counts calls
+        (the tests/metrics/test_sync_collective_counts.py shape)."""
+
+        def __init__(self):
+            self.gathers = 0
+
+        @property
+        def world_size(self):
+            return 2
+
+        @property
+        def rank(self):
+            return 0
+
+        def allgather_object(self, obj):
+            self.gathers += 1
+            import copy
+
+            return [obj, copy.deepcopy(obj)]
+
+        def allgather_array(self, x):
+            self.gathers += 1
+            x = np.asarray(x)
+            return [x, x.copy()]
+
+    serving = _Counting()
+    coll = _panel()
+    with warnings.catch_warnings():
+        # the fake group cannot scope a dedicated subgroup; no round
+        # ever runs here, only the serving path is exercised
+        warnings.simplefilter("ignore", RuntimeWarning)
+        plane = SyncPlane(coll, serving)
+    for _ in range(100):
+        coll["acc"].update(xa, ta)
+        coll["mean"].update(xm)
+    for _ in range(4):
+        plane.publish()
+    armed_gathers = serving.gathers
+    plane.close()
+    blocking_counter = _Counting()
+    sync_and_compute_collection(_panel(), blocking_counter)
+
+    within = ratio <= 1.02
+    return {
+        "metric": (
+            "zero-stall sync plane: armed-vs-off serving p99 parity + "
+            "serving-group collective silence"
+        ),
+        "value": round(ratio, 4),
+        "unit": "x plane-armed over sync-off serving p99 (1.0 = parity)",
+        "lower_is_better": True,
+        "latency": {
+            "trials": TRIALS,
+            "steps_per_trial": STEPS,
+            "publish_cadence_steps": CADENCE,
+            "round_interval_s": INTERVAL,
+            "plane_over_off_p99": round(ratio, 4),
+            "plane_over_off_p50": round(ratio50, 4),
+            "blocking_over_off_p99": round(block_ratio, 2),
+            "median_us": {k: round(v, 1) for k, v in med.items()},
+            "rounds_merged_per_trial": [
+                t["rounds_merged"] for t in trials
+            ],
+            "per_trial_p99_ratio": [
+                round(t["plane_p99"] / t["off_p99"], 4) for t in trials
+            ],
+        },
+        "collectives": {
+            "armed_serving_gathers": armed_gathers,
+            "updates_counted": 100,
+            "publishes_counted": 4,
+            "one_blocking_sync_gathers": blocking_counter.gathers,
+        },
+        "provenance": trials[-1]["provenance"],
+        "acceptance": {
+            "plane_p99_within_2pct": within,
+            "zero_added_collectives": armed_gathers == 0,
+            "blocking_stall_visible": block_ratio > 1.5,
+            "rounds_merged_every_trial": all(
+                t["rounds_merged"] >= 1 for t in trials
+            ),
+        },
+    }
+
+
 def run_probe():
     """Tiny op on the default backend — proves the platform is claimable."""
     import jax
@@ -3378,6 +3630,7 @@ CONFIGS = {
     "metric_table": (run_metric_table, None),  # keyed-table serving audit
     "quality": (run_quality, None),  # data-quality-telemetry audit
     "region_sync": (run_region_sync, None),  # cross-region federation audit
+    "async_sync": (run_async_sync, None),  # zero-stall sync plane audit
 }
 
 _NO_REF_NOTES = {
@@ -3431,6 +3684,11 @@ _NO_REF_NOTES = {
         "layer, so the comparisons are our own federation-off sync "
         "collective counts and the full-snapshot wire arm"
     ),
+    "async_sync": (
+        "zero-stall sync-plane audit — the reference has no background "
+        "sync layer, so the comparisons are our own sync-off serving "
+        "loop and our own inline blocking-sync stall arm"
+    ),
 }
 
 REF_FNS = {
@@ -3462,7 +3720,7 @@ def _cache_env(env):
 _SINGLE_DEVICE_CONFIGS = {
     "accuracy_update", "auroc_compute", "text_eval", "fid", "kernels",
     "variable_batch", "sharded_state", "monitoring", "metric_table",
-    "quality", "region_sync",
+    "quality", "region_sync", "async_sync",
 }
 
 

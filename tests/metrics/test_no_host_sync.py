@@ -210,6 +210,34 @@ def test_steady_state_update_is_transfer_free_federation_armed(name):
         fed.close()
 
 
+@pytest.mark.parametrize(
+    "name", ["MulticlassAccuracy", "MulticlassConfusionMatrix", "Mean"]
+)
+def test_steady_state_update_is_transfer_free_plane_armed(name):
+    """ISSUE 16 acceptance: an ARMED sync plane adds ZERO host syncs to
+    the steady-state update path — publication is reference-snapshotting
+    of device arrays (host metadata only) and the background round runs
+    on its own communicator off the serving thread. Non-vacuous: the
+    plane is the process-current one, has published AND merged a round
+    before the guarded update runs."""
+    from torcheval_tpu.syncplane import SyncPlane, current_plane
+
+    make, args = CLASS_CASES[name]
+    metric = make()
+    for _ in range(6):
+        metric.update(*args)
+    with SyncPlane({"m": metric}) as plane:
+        plane.publish()
+        plane.run_round()
+        assert current_plane() is plane
+        assert plane.version == 1
+        with jax.transfer_guard("disallow"):
+            metric.update(*args)
+        # ...and publication itself is transfer-free too
+        with jax.transfer_guard("disallow"):
+            plane.publish()
+
+
 def test_donated_update_is_transfer_free_and_in_place():
     """ISSUE 6 acceptance pin: with donation enabled, the update adds
     zero host syncs AND reuses the state buffer in place — the per-step

@@ -1,0 +1,745 @@
+"""Zero-stall sync plane (ISSUE 16): versioned snapshot publication,
+background rounds on a dedicated communicator, bounded-staleness reads.
+
+The contract under test, end to end:
+
+- ``publish()`` swaps one fully-built immutable record — a concurrent
+  reader sees the old snapshot or the new one, never a torn mix
+  (DeterministicScheduler interleavings);
+- a bounded-staleness read at version V is BIT-IDENTICAL to a blocking
+  ``sync_and_compute`` over the states published for V (the
+  ThreadWorld-4 oracle pin), and carries version / rounds_behind /
+  wall-age provenance;
+- ``Metric.reset()`` / ``load_state_dict`` invalidate published
+  snapshot versions — a post-reset read never serves pre-reset merged
+  values;
+- the armed serving path (update + publish) issues ZERO collectives on
+  the serving group (counting-group pin);
+- the plane coexists with the elastic layer: snapshots capture under
+  ``quiesce()``, ``restore()`` invalidates, and the round thread shuts
+  down cleanly and idempotently;
+- ``exchange(plane=...)`` feeds the federation from retained snapshot
+  versions, falling back to the blocking sync when the plane cannot
+  serve one.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu import metrics as M
+from torcheval_tpu.distributed import ProcessGroup
+from torcheval_tpu.metrics.toolkit import (
+    get_synced_metric_collection,
+    sync_and_compute,
+    sync_and_compute_collection,
+)
+from torcheval_tpu.resilience import SyncProvenance
+from torcheval_tpu.syncplane import SyncPlane, current_plane
+from torcheval_tpu.utils.test_utils import ThreadWorld
+from torcheval_tpu.utils.test_utils.schedule import DeterministicScheduler
+
+
+def _mean_pair():
+    return {"a": M.Mean(), "b": M.Mean()}
+
+
+# --------------------------------------------------------------------------
+# SyncProvenance: schema + round-trip (satellite 1)
+# --------------------------------------------------------------------------
+
+
+def test_sync_provenance_schema_pinned():
+    """The bounded-staleness triple extends the tuple by APPENDED,
+    defaulted fields — positional construction sites and old pickles
+    stay valid, and the field order is part of the wire schema."""
+    assert SyncProvenance._fields == (
+        "ranks",
+        "world_size",
+        "degraded",
+        "policy",
+        "reformed",
+        "version",
+        "rounds_behind",
+        "wall_age_seconds",
+    )
+    legacy = SyncProvenance((0, 1), 2, False, "strict")
+    assert legacy.reformed is False
+    assert legacy.version == 0
+    assert legacy.rounds_behind == 0
+    assert legacy.wall_age_seconds == 0.0
+
+
+def test_sync_provenance_round_trips():
+    prov = SyncProvenance(
+        (0, 1, 2),
+        3,
+        True,
+        "quorum",
+        reformed=True,
+        version=7,
+        rounds_behind=2,
+        wall_age_seconds=1.25,
+    )
+    rebuilt = SyncProvenance(**prov._asdict())
+    assert rebuilt == prov
+    assert rebuilt._replace(version=8).version == 8
+    # tuple form survives a dict/json-ish round trip positionally too
+    assert SyncProvenance(*tuple(prov)) == prov
+
+
+# --------------------------------------------------------------------------
+# _state_epoch discipline (satellite 2)
+# --------------------------------------------------------------------------
+
+
+def test_state_epoch_bumps_on_reset_and_load_not_update():
+    m = M.Mean()
+    e0 = m._state_epoch
+    m.update(jnp.asarray([1.0, 2.0]))
+    assert m._state_epoch == e0  # updates never bump the epoch
+    m.reset()
+    assert m._state_epoch == e0 + 1
+    donor = M.Mean()
+    donor.update(jnp.asarray([3.0]))
+    m.load_state_dict(donor.state_dict())
+    assert m._state_epoch == e0 + 2
+
+
+# --------------------------------------------------------------------------
+# world-1 basics: publish / round / read / provenance
+# --------------------------------------------------------------------------
+
+
+def test_world1_read_before_any_round_is_cold_local():
+    coll = _mean_pair()
+    coll["a"].update(jnp.asarray([2.0]))
+    with SyncPlane(coll) as plane:
+        out = plane.read()
+        assert float(out["a"].compute()) == 2.0
+        prov = out["a"].sync_provenance
+        assert prov.version == 0
+        assert prov.degraded is False  # world-1: local IS complete
+        assert plane.version == 0
+
+
+def test_world1_publish_round_read_with_provenance():
+    coll = _mean_pair()
+    coll["a"].update(jnp.asarray([2.0]))
+    coll["b"].update(jnp.asarray([4.0]))
+    with SyncPlane(coll) as plane:
+        gen = plane.publish()
+        assert gen == 1
+        assert plane.run_round() == 1
+        # live metrics move on; the read serves the published version
+        coll["a"].update(jnp.asarray([100.0]))
+        plane.publish()
+        out = plane.read()
+        assert float(out["a"].compute()) == 2.0
+        assert float(out["b"].compute()) == 4.0
+        prov = out["a"].sync_provenance
+        assert prov.version == 1
+        assert prov.rounds_behind == 1  # one publish newer than the merge
+        assert prov.wall_age_seconds >= 0.0
+        assert tuple(prov.ranks) == (0,)
+        vals = plane.compute()
+        assert float(vals["a"]) == 2.0
+        single = plane.read_metric(coll["b"])
+        assert float(single.compute()) == 4.0
+
+
+def test_run_round_without_publish_returns_none():
+    with SyncPlane(_mean_pair()) as plane:
+        assert plane.run_round() is None
+        assert plane.version == 0
+
+
+def test_snapshot_history_retained_and_bounded():
+    coll = _mean_pair()
+    with SyncPlane(coll, history=2) as plane:
+        for k in range(1, 5):
+            coll["a"].update(jnp.asarray([float(k)]))
+            plane.publish()
+            plane.run_round()
+        retained = plane.retained()
+        assert sorted(retained) == [3, 4]  # history=2 evicts 1 and 2
+        assert plane.snapshot_at(4) is not None
+        assert plane.snapshot_at(1) is None
+
+
+# --------------------------------------------------------------------------
+# reset()/load_state_dict() invalidation (satellite 2)
+# --------------------------------------------------------------------------
+
+
+def test_reset_invalidates_published_versions():
+    coll = _mean_pair()
+    coll["a"].update(jnp.asarray([2.0]))
+    with SyncPlane(coll) as plane:
+        plane.publish()
+        plane.run_round()
+        assert float(plane.read()["a"].compute()) == 2.0
+        coll["a"].reset()
+        out = plane.read()
+        # the pre-reset merged 2.0 must NOT be served: cold local read
+        assert np.isnan(float(out["a"].compute()))
+        assert out["a"].sync_provenance.version == 0
+        # the next publish/round covers the post-reset state again
+        coll["a"].update(jnp.asarray([5.0]))
+        plane.publish()
+        plane.run_round()
+        out = plane.read()
+        assert float(out["a"].compute()) == 5.0
+        assert out["a"].sync_provenance.version == 2
+
+
+def test_load_state_dict_invalidates_published_versions():
+    coll = _mean_pair()
+    coll["a"].update(jnp.asarray([2.0]))
+    with SyncPlane(coll) as plane:
+        plane.publish()
+        plane.run_round()
+        donor = M.Mean()
+        donor.update(jnp.asarray([9.0]))
+        coll["a"].load_state_dict(donor.state_dict())
+        out = plane.read()
+        assert float(out["a"].compute()) == 9.0  # live, not stale 2.0
+        assert out["a"].sync_provenance.version == 0
+        assert plane.staleness()["version"] == 1  # versions never regress
+
+
+def test_partial_selection_validates_only_selected():
+    coll = _mean_pair()
+    coll["a"].update(jnp.asarray([2.0]))
+    coll["b"].update(jnp.asarray([4.0]))
+    with SyncPlane(coll) as plane:
+        plane.publish()
+        plane.run_round()
+        coll["a"].reset()  # invalidates "a" only
+        out_b = plane.read(["b"])
+        assert float(out_b["b"].compute()) == 4.0
+        assert out_b["b"].sync_provenance.version == 1
+        out_a = plane.read(["a"])
+        assert out_a["a"].sync_provenance.version == 0
+
+
+# --------------------------------------------------------------------------
+# torn-read proof: publish/read/swap under deterministic interleavings
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_publish_read_swap_interleavings_never_tear(seed):
+    """Publisher and reader race through every seeded interleaving of
+    the syncplane module's lines: the two metrics of a generation are
+    published together, so a read must observe a matched pair (or the
+    cold local pair) — never generation g's "a" with generation g's+1
+    "b"."""
+    import torcheval_tpu.syncplane as syncplane_mod
+
+    coll = _mean_pair()
+    plane = SyncPlane(coll)
+    try:
+
+        def publisher():
+            for k in (1.0, 2.0, 3.0):
+                coll["a"].reset()
+                coll["b"].reset()
+                coll["a"].update(jnp.asarray([k]))
+                coll["b"].update(jnp.asarray([k]))
+                plane.publish()
+                plane.run_round()
+
+        seen = []
+
+        def reader():
+            for _ in range(4):
+                out = plane.read()
+                seen.append(
+                    (float(out["a"].compute()), float(out["b"].compute()))
+                )
+
+        sched = DeterministicScheduler(seed=seed, trace=[syncplane_mod])
+        sched.spawn(publisher)
+        sched.spawn(reader)
+        sched.run()
+        for a, b in seen:
+            if np.isnan(a) or np.isnan(b):
+                # cold/invalidated read mid-reset: local pair, still a pair
+                continue
+            assert a == b, f"torn read: a={a} b={b} (seen={seen})"
+    finally:
+        plane.close()
+
+
+# --------------------------------------------------------------------------
+# ThreadWorld-4 oracle: bounded-staleness read == blocking sync at V
+# --------------------------------------------------------------------------
+
+
+def test_threadworld4_read_bit_identical_to_blocking_oracle():
+    """The acceptance pin: each rank publishes its local states, all
+    planes run one round in step, live metrics keep moving — a read at
+    version 1 equals a blocking ``get_synced_metric_collection`` over
+    clones holding EXACTLY the published states, bit for bit, and the
+    toolkit's ``plane=`` form serves the same answer."""
+    world = ThreadWorld(4)
+    reads = {}
+    oracle = {}
+    toolkit = {}
+    provs = {}
+
+    def drive(g):
+        coll = _mean_pair()
+        coll["a"].update(jnp.asarray([float(g.rank + 1)]))
+        coll["b"].update(jnp.asarray([10.0 * (g.rank + 1)]))
+        published = {
+            name: copy.deepcopy(m) for name, m in coll.items()
+        }
+        plane = SyncPlane(coll, g)
+        try:
+            plane.publish()
+            plane.run_round()
+            # serving moves on AFTER the publish: must not leak into V=1
+            coll["a"].update(jnp.asarray([777.0]))
+            out = plane.read()
+            reads[g.rank] = {k: m.compute() for k, m in out.items()}
+            provs[g.rank] = out["a"].sync_provenance
+            toolkit[g.rank] = sync_and_compute(coll["b"], plane=plane)
+            # blocking oracle over the very states published for V=1,
+            # on the same group
+            synced = get_synced_metric_collection(published, g)
+            oracle[g.rank] = {
+                k: m.compute() for k, m in synced.items()
+            }
+        finally:
+            plane.close()
+
+    world.run(drive)
+    for rank in range(4):
+        for name in ("a", "b"):
+            got = np.asarray(reads[rank][name])
+            want = np.asarray(oracle[rank][name])
+            assert got.tobytes() == want.tobytes(), (
+                f"rank {rank} {name}: plane read {got!r} != blocking "
+                f"oracle {want!r}"
+            )
+        assert np.asarray(toolkit[rank]).tobytes() == np.asarray(
+            oracle[rank]["b"]
+        ).tobytes()
+        prov = provs[rank]
+        assert prov.version == 1
+        assert tuple(prov.ranks) == (0, 1, 2, 3)
+        assert prov.world_size == 4
+        assert prov.degraded is False
+    assert float(np.asarray(oracle[0]["a"])) == pytest.approx(2.5)
+
+
+def test_sync_and_compute_collection_plane_form_world1():
+    coll = _mean_pair()
+    coll["a"].update(jnp.asarray([3.0]))
+    coll["b"].update(jnp.asarray([5.0]))
+    with SyncPlane(coll) as plane:
+        plane.publish()
+        plane.run_round()
+        vals = sync_and_compute_collection(coll, plane=plane)
+        assert float(vals["a"]) == 3.0
+        assert float(vals["b"]) == 5.0
+        with pytest.raises(ValueError, match="same live instance"):
+            plane.read_collection({"a": M.Mean()})
+
+
+# --------------------------------------------------------------------------
+# serving-group silence: zero collectives from the armed update path
+# --------------------------------------------------------------------------
+
+
+class _CountingGroup(ProcessGroup):
+    """Two fake ranks holding this process's payload; counts gathers
+    (the tests/metrics/test_sync_collective_counts.py shape)."""
+
+    def __init__(self):
+        self.gathers = 0
+
+    @property
+    def world_size(self):
+        return 2
+
+    @property
+    def rank(self):
+        return 0
+
+    def allgather_object(self, obj):
+        self.gathers += 1
+        return [obj, copy.deepcopy(obj)]
+
+    def allgather_array(self, x):
+        self.gathers += 1
+        x = np.asarray(x)
+        return [x, x.copy()]
+
+
+def test_armed_serving_path_issues_zero_gathers():
+    serving = _CountingGroup()
+    coll = _mean_pair()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        plane = SyncPlane(coll, serving)
+    try:
+        for k in range(50):
+            coll["a"].update(jnp.asarray([float(k)]))
+            coll["b"].update(jnp.asarray([float(k)]))
+        for _ in range(5):
+            plane.publish()
+        assert serving.gathers == 0, (
+            "the armed update/publish path must never touch the serving "
+            "group's collective sequence"
+        )
+    finally:
+        plane.close()
+    # contrast: ONE blocking sync on the same interface pays gathers
+    blocking = _CountingGroup()
+    sync_and_compute_collection(_mean_pair(), blocking)
+    assert blocking.gathers > 0
+
+
+def test_fake_group_without_subgroup_warns_about_shared_comm():
+    with pytest.warns(RuntimeWarning, match="dedicated plane communicator"):
+        plane = SyncPlane(_mean_pair(), _CountingGroup())
+    plane.close()
+
+
+# --------------------------------------------------------------------------
+# lifecycle: armed thread, shutdown/drain, quiesce, current_plane
+# --------------------------------------------------------------------------
+
+
+def test_armed_plane_thread_runs_rounds_and_drains_on_close():
+    coll = _mean_pair()
+    coll["a"].update(jnp.asarray([2.0]))
+    plane = SyncPlane(coll, interval=0.02, timeout=5.0, retries=0)
+    try:
+        assert plane.armed
+        assert current_plane() is plane
+        plane.publish()
+        deadline = time.time() + 10.0
+        while plane.version < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        assert plane.version >= 1, "armed thread never merged a round"
+        assert float(plane.read()["a"].compute()) == 2.0
+    finally:
+        thread = plane._thread
+        plane.close()
+    assert thread is not None and not thread.is_alive()
+    assert current_plane() is None
+    plane.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        plane.publish()
+    with pytest.raises(RuntimeError, match="closed"):
+        plane.read()
+
+
+def test_quiesce_excludes_rounds_until_released():
+    coll = _mean_pair()
+    coll["a"].update(jnp.asarray([1.0]))
+    with SyncPlane(coll) as plane:
+        plane.publish()
+        done = threading.Event()
+
+        def round_thread():
+            plane.run_round()
+            done.set()
+
+        with plane.quiesce():
+            t = threading.Thread(target=round_thread, daemon=True)
+            t.start()
+            assert not done.wait(0.15), "round ran inside quiesce()"
+        assert done.wait(5.0), "round never ran after quiesce release"
+        t.join(5.0)
+        assert plane.version == 1
+
+
+def test_invalidate_drops_snapshots_but_not_versions():
+    coll = _mean_pair()
+    coll["a"].update(jnp.asarray([2.0]))
+    with SyncPlane(coll) as plane:
+        plane.publish()
+        plane.run_round()
+        plane.invalidate()
+        assert plane.retained() == {}
+        out = plane.read()
+        assert out["a"].sync_provenance.version == 0  # cold local
+        coll["a"].update(jnp.asarray([4.0]))
+        plane.publish()
+        plane.run_round()
+        assert plane.version == 2  # versions never move backwards
+
+
+def test_staleness_surface_and_counter_source():
+    coll = _mean_pair()
+    with SyncPlane(coll) as plane:
+        s = plane.staleness()
+        assert s["version"] == 0
+        assert s["wall_age_seconds"] == -1.0
+        assert s["stale"] is False  # manual planes are never stale
+        coll["a"].update(jnp.asarray([1.0]))
+        plane.publish()
+        plane.run_round()
+        plane.read()
+        s = plane.staleness()
+        assert s["version"] == 1
+        assert s["rounds_behind"] == 0
+        assert s["wall_age_seconds"] >= 0.0
+        counters = plane._counter_source()
+        assert counters["rounds"] == 1
+        assert counters["reads"] == 1
+        assert counters["armed"] == 0
+
+
+def test_rejects_nonmember_and_replica_groups_and_bad_knobs():
+    from torcheval_tpu.distributed import LocalReplicaGroup
+
+    with pytest.raises(TypeError, match="one rank's metrics"):
+        SyncPlane(_mean_pair(), LocalReplicaGroup())
+    with pytest.raises(TypeError, match="non-empty"):
+        SyncPlane({})
+    with pytest.raises(ValueError, match="interval"):
+        SyncPlane(_mean_pair(), interval=0.0)
+    with pytest.raises(ValueError, match="history"):
+        SyncPlane(_mean_pair(), history=0)
+
+
+# --------------------------------------------------------------------------
+# elastic coexistence: quiesced snapshots, invalidating restores
+# --------------------------------------------------------------------------
+
+
+def test_elastic_restore_invalidates_plane(tmp_path):
+    from torcheval_tpu.elastic import ElasticSession
+
+    coll = {"mean": M.Mean()}
+    coll["mean"].update(jnp.asarray([2.0]))
+    with SyncPlane(coll) as plane:
+        session = ElasticSession(coll, str(tmp_path), plane=plane)
+        try:
+            plane.publish()
+            plane.run_round()
+            session.step_done(0)
+            session.snapshot()
+            # serving state and snapshots move past the checkpoint
+            coll["mean"].update(jnp.asarray([100.0]))
+            plane.publish()
+            plane.run_round()
+            assert float(plane.read()["mean"].compute()) == 51.0
+            result = session.restore()
+            assert result is not None
+            # the restore dropped every plane snapshot: reads are cold
+            # over the RESTORED state, never the pre-restore merge
+            out = plane.read()
+            assert float(out["mean"].compute()) == 2.0
+            assert out["mean"].sync_provenance.version == 0
+            assert plane.retained() == {}
+        finally:
+            session.close()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_plane_round_vs_elastic_snapshot_interleavings(seed):
+    """The writer-coexistence pin: a plane round and an elastic
+    snapshot (which captures under ``quiesce()``) interleave through
+    seeded schedules without deadlock, and every snapshot captures a
+    round-consistent state."""
+    import torcheval_tpu.syncplane as syncplane_mod
+
+    from torcheval_tpu.elastic import ElasticSession
+
+    coll = {"mean": M.Mean()}
+    coll["mean"].update(jnp.asarray([2.0]))
+    plane = SyncPlane(coll)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        session = ElasticSession(coll, tmp, plane=plane)
+        try:
+            plane.publish()
+
+            def rounds():
+                for _ in range(2):
+                    plane.run_round()
+
+            def snapshots():
+                session.step_done(0)
+                session.snapshot()
+
+            sched = DeterministicScheduler(
+                seed=seed, trace=[syncplane_mod]
+            )
+            sched.spawn(rounds)
+            sched.spawn(snapshots)
+            sched.run()  # DeadlockError here is the failure
+            assert plane.version == 2
+        finally:
+            session.close()
+            plane.close()
+
+
+# --------------------------------------------------------------------------
+# observability: PlaneSyncEvent + healthz stale-plane
+# --------------------------------------------------------------------------
+
+
+def test_round_records_plane_sync_event(obs_recorder):
+    from torcheval_tpu.obs.events import PlaneSyncEvent, event_from_dict
+
+    coll = _mean_pair()
+    coll["a"].update(jnp.asarray([1.0]))
+    with SyncPlane(coll) as plane:
+        plane.publish()
+        plane.run_round()
+    events = [
+        e for e in obs_recorder.log.tail() if e.kind == "plane_sync"
+    ]
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.version == 1
+    assert ev.generation == 1
+    assert ev.metrics == 2
+    assert not ev.error
+    assert ev.seconds >= 0.0
+    rebuilt = event_from_dict(ev.as_dict())
+    assert isinstance(rebuilt, PlaneSyncEvent)
+    assert rebuilt.version == ev.version
+
+
+def test_healthz_degrades_to_stale_plane_and_recovers():
+    from torcheval_tpu.obs.server import healthz_payload
+
+    coll = _mean_pair()
+    coll["a"].update(jnp.asarray([1.0]))
+    plane = SyncPlane(
+        coll, interval=30.0, timeout=5.0, retries=0, stale_after=0.05
+    )
+    try:
+        # armed, but no round has merged within stale_after: 503
+        time.sleep(0.1)
+        payload = healthz_payload()
+        assert payload["syncplane"]["armed"] == 1
+        assert payload["status"] == "stale-plane"
+        assert payload["healthy"] is False
+        # a merged round refreshes the plane inside the window
+        plane.publish()
+        plane.run_round()
+        payload = healthz_payload()
+        assert payload["status"] == "ok"
+        assert payload["healthy"] is True
+        assert payload["syncplane"]["version"] == 1
+    finally:
+        plane.close()
+    payload = healthz_payload()
+    assert payload["syncplane"] == {"armed": 0}
+
+
+# --------------------------------------------------------------------------
+# federation: plane-fed exchange + blocking fallback
+# --------------------------------------------------------------------------
+
+
+def _single_rank_regions():
+    return [("us", (0,)), ("eu", (1,))]
+
+
+def test_exchange_plane_fed_serves_retained_version():
+    from torcheval_tpu.federation import Federation, InProcessLinkBus
+
+    world = ThreadWorld(2)
+    bus = InProcessLinkBus()
+    results = {}
+
+    def drive(g):
+        fed = Federation(g, _single_rank_regions(), transport=bus)
+        coll = {"mean": M.Mean()}
+        coll["mean"].update(jnp.asarray([2.0 * (g.rank + 1)]))
+        plane = SyncPlane(coll, fed.region_group)
+        try:
+            plane.publish()
+            plane.run_round()
+            coll["mean"].update(jnp.asarray([999.0]))  # past the snapshot
+            synced = fed.exchange(coll, plane=plane)
+            results[g.rank] = (
+                float(synced["mean"].compute()),
+                synced["mean"].sync_provenance,
+            )
+        finally:
+            plane.close()
+            fed.close()
+
+    world.run(drive)
+    for rank in range(2):
+        value, prov = results[rank]
+        # region = one rank: the exchange serves the PUBLISHED state
+        assert value == 2.0 * (rank + 1)
+        assert prov.version == 1
+        assert prov.rounds_behind == 0
+
+
+def test_exchange_cold_plane_falls_back_to_blocking():
+    from torcheval_tpu.federation import Federation, InProcessLinkBus
+
+    world = ThreadWorld(2)
+    bus = InProcessLinkBus()
+    results = {}
+
+    def drive(g):
+        fed = Federation(g, _single_rank_regions(), transport=bus)
+        coll = {"mean": M.Mean()}
+        coll["mean"].update(jnp.asarray([2.0 * (g.rank + 1)]))
+        plane = SyncPlane(coll, fed.region_group)  # cold: no round ever
+        try:
+            synced = fed.exchange(coll, plane=plane)
+            results[g.rank] = (
+                float(synced["mean"].compute()),
+                synced["mean"].sync_provenance.version,
+            )
+        finally:
+            plane.close()
+            fed.close()
+
+    world.run(drive)
+    for rank in range(2):
+        value, version = results[rank]
+        assert value == 2.0 * (rank + 1)  # blocking path still syncs
+        assert version == 0  # and says so: no plane version served
+
+
+def test_exchange_rejects_foreign_plane():
+    from torcheval_tpu.federation import Federation, InProcessLinkBus
+
+    world = ThreadWorld(2)
+    bus = InProcessLinkBus()
+    errors = {}
+
+    def drive(g):
+        fed = Federation(g, _single_rank_regions(), transport=bus)
+        coll = {"mean": M.Mean()}
+        coll["mean"].update(jnp.asarray([1.0]))
+        # plane over the WHOLE world, not this federation's region
+        plane = SyncPlane(coll, g)
+        try:
+            fed.exchange(coll, plane=plane)
+        except ValueError as e:
+            errors[g.rank] = str(e)
+        finally:
+            plane.close()
+            fed.close()
+
+    world.run(drive)
+    assert "region group" in errors[0]
+    assert "region group" in errors[1]

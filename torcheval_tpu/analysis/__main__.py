@@ -94,6 +94,98 @@ def _program_smoke() -> Report:
     combined.extend(_quality_smoke())
     combined.extend(_federation_lockstep_smoke())
     combined.extend(_schedule_lockstep_smoke())
+    combined.extend(_sync_plane_smoke())
+    return combined
+
+
+def _sync_plane_smoke() -> Report:
+    """ISSUE 16 tentpole: the zero-stall sync plane must leave the
+    SERVING path untouched. With a plane armed over the live collection
+    (``current_plane`` set, counter source registered, a snapshot
+    published and merged), a watched metric's update program verifies
+    exactly like the plane-off family — zero collectives, no host
+    escapes, donation-sound — its update plan IS the baseline plan, and
+    the blocking eager sync's ordered op plan is IDENTICAL to the
+    plane-off plan on every rank (the plane's round collectives live on
+    its dedicated communicator, never the serving group's sequence)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from torcheval_tpu import metrics as M
+    from torcheval_tpu.analysis.lockstep import (
+        check_eager_lockstep,
+        eager_sync_plan,
+    )
+    from torcheval_tpu.analysis.program import (
+        verify_metric_compute,
+        verify_metric_update,
+    )
+    from torcheval_tpu.analysis.report import Finding
+    from torcheval_tpu.syncplane import SyncPlane
+
+    rng = np.random.default_rng(16)
+    xb = jnp.asarray(rng.random(32).astype(np.float32))
+    x2 = jnp.asarray(rng.random((32, 5)).astype(np.float32))
+    t1 = jnp.asarray(rng.integers(0, 5, 32))
+    combined = Report(tool="program")
+    coll = {"acc": M.MulticlassAccuracy(), "mean": M.Mean()}
+    coll["acc"].update(x2, t1)
+    coll["mean"].update(xb)
+    baseline_plan = coll["acc"]._update_plan(x2, t1)
+    baseline_sync = {
+        r: eager_sync_plan(coll, world_size=2, rank=r) for r in range(2)
+    }
+    with SyncPlane(coll) as plane:
+        plane.publish()
+        plane.run_round()
+        report = verify_metric_update(coll["mean"], xb)
+        if report is not None:
+            combined.extend(report)
+        combined.extend(verify_metric_compute(coll["mean"]))
+        armed_plan = coll["acc"]._update_plan(x2, t1)
+        armed_sync = {
+            r: eager_sync_plan(coll, world_size=2, rank=r)
+            for r in range(2)
+        }
+    combined.extend(
+        check_eager_lockstep(
+            {0: baseline_sync[0], 1: armed_sync[1]},
+            name="<plane-armed sync plan>",
+        )
+    )
+    combined.checked += 1
+    if (
+        armed_plan.kernel is not baseline_plan.kernel
+        or armed_plan.state_names != baseline_plan.state_names
+    ):
+        combined.findings.append(
+            Finding(
+                tool="program",
+                rule="plane-armed-update",
+                path="<plane-armed update plan>",
+                message=(
+                    "arming a SyncPlane rewrote the metric's update "
+                    "plan — the plane observes published snapshots "
+                    "only and must never touch the serving-step program"
+                ),
+            )
+        )
+    combined.checked += 1
+    if baseline_sync != armed_sync:
+        combined.findings.append(
+            Finding(
+                tool="lockstep",
+                rule="eager-plan-divergence",
+                path="<plane-armed sync plan>",
+                message=(
+                    "arming a SyncPlane changed the eager sync plan: "
+                    f"{baseline_sync} -> {armed_sync} — plane rounds "
+                    "run on the dedicated communicator and must never "
+                    "add, drop, or reorder serving-group collectives"
+                ),
+            )
+        )
     return combined
 
 

@@ -11,9 +11,10 @@ drift apart on what a suppression or a binding looks like:
   green).
 - ``# tev: scope=jit|host`` — file-level module classification (first
   lines; the lint's jit-reachability model).
-- ``# tev: scope=worker|writer|watchdog`` — on a ``def`` line: the
-  function is a background-THREAD entry point and everything reachable
-  from it runs in that thread context (the concurrency hazard model).
+- ``# tev: scope=worker|writer|watchdog|syncplane`` — on a ``def``
+  line: the function is a background-THREAD entry point and everything
+  reachable from it runs in that thread context (the concurrency hazard
+  model).
 - ``# tev: guarded-by=<lock>`` — on an attribute assignment (in
   ``__init__``, a dataclass field line, or a module-global assignment):
   the attribute is shared mutable state protected by ``<lock>`` (an
@@ -78,9 +79,11 @@ SUPPRESS_RE = re.compile(
     r"#\s*tev:\s*disable=([\w\-,]+)(?:\s*--\s*(.*\S))?\s*$"
 )
 GUARDED_RE = re.compile(r"#\s*tev:\s*guarded-by=([\w]+)\b")
-THREAD_SCOPE_RE = re.compile(r"#\s*tev:\s*scope=(worker|writer|watchdog)\b")
+THREAD_SCOPE_RE = re.compile(
+    r"#\s*tev:\s*scope=(worker|writer|watchdog|syncplane)\b"
+)
 
-THREAD_SCOPES = ("worker", "writer", "watchdog")
+THREAD_SCOPES = ("worker", "writer", "watchdog", "syncplane")
 
 # Rule ids of the concurrency verifier (docs/static-analysis.md,
 # "Concurrency rules"). Listed statically so the lint's suppression

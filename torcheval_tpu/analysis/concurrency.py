@@ -9,8 +9,9 @@ group's collective sequence; this pass proves that ownership:
 
 - **Thread contexts.** Every ``threading.Thread(target=...)`` whose
   target resolves inside the swept universe is a thread ENTRY POINT and
-  must carry a ``# tev: scope=worker|writer|watchdog`` annotation on its
-  ``def`` line (``unannotated-thread-target`` otherwise — the model must
+  must carry a ``# tev: scope=worker|writer|watchdog|syncplane``
+  annotation on its ``def`` line (``unannotated-thread-target``
+  otherwise — the model must
   stay complete as threads are added). Everything reachable from an
   entry point (name-based call graph, ``analysis/locks.py`` resolution
   rules) runs in that context; everything reachable from an un-called
@@ -60,6 +61,7 @@ DEFAULT_TARGETS = (
     "resilience.py",
     "elastic.py",
     "federation.py",
+    "syncplane.py",
     os.path.join("utils", "checkpoint.py"),
 )
 
@@ -100,8 +102,8 @@ def _thread_entries(universe: Universe) -> Tuple[List, List[Finding]]:
                     message=(
                         f"Thread target `{target.qual}` has no thread-"
                         "context annotation: add `# tev: scope=worker|"
-                        "writer|watchdog` on its def line so the "
-                        "cross-thread collective model stays complete"
+                        "writer|watchdog|syncplane` on its def line so "
+                        "the cross-thread collective model stays complete"
                     ),
                 )
                 entry = module.suppressions.get(line)

@@ -54,6 +54,7 @@ walkthrough and the crash matrix tier-1 proves.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
@@ -238,6 +239,16 @@ class ElasticSession:
             drops a delta (un-acked state re-derives from the cumulative
             snapshot). A world-size-change restore starts a fresh ledger
             with a warning — anti-entropy re-converges it.
+        plane: a ``syncplane.SyncPlane`` built over the same live
+            metrics. Snapshot capture then runs under the plane's
+            :meth:`~torcheval_tpu.syncplane.SyncPlane.quiesce` (no
+            background round in flight while the bundle's view of the
+            world is taken), and a successful :meth:`restore` calls
+            :meth:`~torcheval_tpu.syncplane.SyncPlane.invalidate` — the
+            restored state replaces everything any published or merged
+            snapshot describes (the ``_state_epoch`` bump already makes
+            stale reads fall back; invalidation makes it prompt and
+            keeps the next round from merging dead state).
         fault_hook: test-only crash-point hook
             ``hook(point, generation=..., rank=...)`` called at each of
             :data:`CRASH_POINTS` (see
@@ -266,6 +277,7 @@ class ElasticSession:
         async_writer: bool = False,
         fault_hook: Optional[Callable[..., None]] = None,
         federation: Optional[Any] = None,
+        plane: Optional[Any] = None,
     ) -> None:
         from torcheval_tpu import config
 
@@ -309,6 +321,7 @@ class ElasticSession:
             raise ValueError(f"retention must be >= 1, got {retention}")
         self._fault_hook = fault_hook
         self._federation = federation
+        self._plane = plane
         os.makedirs(self.directory, exist_ok=True)
         self._cursor = 0  # completed steps covered by current state
         self._since_snapshot = 0
@@ -431,13 +444,25 @@ class ElasticSession:
         # immutable, so later updates cannot mutate what we captured.
         # The federation ledger is likewise captured HERE on the caller
         # thread (the async writer must not read the live mutable link
-        # state mid-exchange).
-        states = {name: m.state_dict() for name, m in self.metrics.items()}
-        fed_payload = (
-            self._federation.ledger_payload()
-            if self._federation is not None
-            else None
+        # state mid-exchange). With a sync plane attached, the capture
+        # additionally quiesces plane rounds: the bundle's view of the
+        # world is taken with no background round in flight (a restore
+        # of this bundle invalidates the plane, so a half-merged round
+        # must not be what the pre-crash readers were serving from).
+        quiesce = (
+            self._plane.quiesce()
+            if self._plane is not None
+            else contextlib.nullcontext()
         )
+        with quiesce:
+            states = {
+                name: m.state_dict() for name, m in self.metrics.items()
+            }
+            fed_payload = (
+                self._federation.ledger_payload()
+                if self._federation is not None
+                else None
+            )
         job = (generation, states, self._cursor, self._payload, fed_payload)
         if self._writer is not None:
             self._writer.submit(job)
@@ -762,6 +787,13 @@ class ElasticSession:
                         "via full snapshots)",
                         RuntimeWarning,
                     )
+            if self._plane is not None:
+                # the restored state replaces what every published and
+                # merged plane snapshot describes; the metrics' epoch
+                # bump already fails stale reads closed — invalidation
+                # drops the dead records promptly so the next plane
+                # round starts from a post-restore publish
+                self._plane.invalidate()
             self._cursor = int(manifest["step"])
             self._since_snapshot = 0
             # pin the numbering by CONSENSUS: every rank walked the same

@@ -774,6 +774,7 @@ class Federation:
         metrics: Union[Dict[str, Any], Any],
         *,
         on_failure: Optional[str] = None,
+        plane: Optional[Any] = None,
     ) -> Dict[str, Any]:
         """One federation round: intra-region sync (the existing
         synchronous path, unchanged), advance this region's epoch, pack
@@ -786,6 +787,21 @@ class Federation:
         Returns the region-synced ``{name: Metric}`` collection (its
         ``sync_provenance`` is the intra-region sync's). Non-members
         return the input untouched.
+
+        ``plane`` (a :class:`~torcheval_tpu.syncplane.SyncPlane` built
+        over THIS region group and this live collection) replaces the
+        blocking intra-region state sync with the plane's freshest
+        merged snapshot: one tiny version-agreement gather (a tuple of
+        ints per member) picks the newest version every member still
+        retains VALIDLY (capture epochs matching the live metrics —
+        reset/restore invalidate), and each member packs that snapshot —
+        bit-identical across members, because a plane version is one
+        deterministic merge of one collective round. The returned
+        collection then carries the plane's bounded-staleness
+        ``sync_provenance``. Members that cannot agree on a valid
+        version (plane cold, snapshot evicted, post-reset) fall back to
+        the blocking sync — the decision is computed from the gathered
+        windows, so every member takes the same path.
         """
         from torcheval_tpu.metrics.metric import Metric
         from torcheval_tpu.metrics.toolkit import get_synced_metric_collection
@@ -798,9 +814,13 @@ class Federation:
             # Metric must not come back wrapped in the internal dict)
             return original
         self._check_open()
-        synced = get_synced_metric_collection(
-            metrics, self.region_group, on_failure=on_failure
-        )
+        synced = None
+        if plane is not None:
+            synced = self._plane_synced(plane, metrics)
+        if synced is None:
+            synced = get_synced_metric_collection(
+                metrics, self.region_group, on_failure=on_failure
+            )
         self.epoch += 1
         self.exchanges += 1
         self._history[self.epoch] = self._pack_region_snapshot(synced)
@@ -816,6 +836,69 @@ class Federation:
     def _check_open(self) -> None:
         if self._closed:
             raise RuntimeError("Federation is closed")
+
+    def _plane_synced(
+        self, plane: Any, metrics: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        """The region-synced collection off the sync plane — or ``None``
+        when the members cannot agree on a valid retained version (the
+        caller then runs the blocking sync; the decision is a pure
+        function of the gathered windows, so every member agrees on
+        WHICH path runs — divergence here would be a collective-sequence
+        split)."""
+        from torcheval_tpu.metrics.toolkit import clone_metric
+
+        if tuple(plane.ranks) != tuple(self.region_group.ranks):
+            raise ValueError(
+                "exchange(plane=...) needs a plane built over this "
+                f"federation's region group (plane ranks "
+                f"{tuple(plane.ranks)}, region ranks "
+                f"{tuple(self.region_group.ranks)}) — the plane's rounds "
+                "are the intra-region sync being replaced"
+            )
+        for name, m in metrics.items():
+            if plane.metrics.get(name) is not m:
+                raise ValueError(
+                    f"exchange(plane=...) metric {name!r} is not the live "
+                    "instance the plane was built over — snapshot "
+                    "invalidation validates against the plane's instances"
+                )
+        # snapshot the retained records BEFORE advertising them: a
+        # concurrent plane round cannot evict what this dict holds
+        retained = plane.retained()
+        valid = sorted(
+            version
+            for version, record in retained.items()
+            if all(
+                record.epochs.get(name) == m._state_epoch
+                for name, m in metrics.items()
+            )
+        )
+        window = (valid[0], valid[-1]) if valid else (0, 0)
+        # ONE tiny collective (a 2-int tuple per member) instead of the
+        # full state sync — the whole point of the plane-fed exchange
+        windows = self.region_group.allgather_object(window)
+        version = min(hi for _, hi in windows)
+        if any(lo == 0 for lo, _ in windows) or version < max(
+            lo for lo, _ in windows
+        ):
+            return None  # cold / evicted / invalidated somewhere: block
+        record = retained[version]
+        now = time.time()
+        provenance = record.base._replace(
+            version=version,
+            rounds_behind=max(0, plane.publishes - record.generation),
+            wall_age_seconds=max(0.0, now - record.wall),
+        )
+        # clones: the pack path below calls _prepare_for_merge_state on
+        # the synced collection, and the caller may merge into it — the
+        # plane's retained snapshot must stay immutable
+        synced = {
+            name: clone_metric(record.metrics[name]) for name in metrics
+        }
+        for m in synced.values():
+            m.sync_provenance = provenance
+        return synced
 
     def _pack_region_snapshot(
         self, synced: Dict[str, Any]
@@ -1186,6 +1269,7 @@ class Federation:
         metrics: Union[Dict[str, Any], Any],
         *,
         on_failure: Optional[str] = None,
+        plane: Optional[Any] = None,
     ) -> Dict[str, Any]:
         """One exchange round, then the bounded-staleness GLOBAL merge:
         every region's freshest snapshot (local region at this very
@@ -1199,11 +1283,15 @@ class Federation:
         skipped and flagged (policy ``"quorum"``); ``"raise"`` raises
         :class:`RegionPartitionError`; and once any region is DARK,
         fewer contributing regions than the quorum fraction raises too.
+
+        ``plane``: feed the exchange from a
+        :class:`~torcheval_tpu.syncplane.SyncPlane` instead of stalling
+        for the intra-region sync (see :meth:`exchange`).
         """
         from torcheval_tpu.metrics.metric import Metric
 
         single = isinstance(metrics, Metric)
-        synced = self.exchange(metrics, on_failure=on_failure)
+        synced = self.exchange(metrics, on_failure=on_failure, plane=plane)
         if not self.is_member:
             return synced
         merged = self._merge_global(synced)
@@ -1214,15 +1302,17 @@ class Federation:
         metrics: Union[Dict[str, Any], Any],
         *,
         on_failure: Optional[str] = None,
+        plane: Optional[Any] = None,
     ) -> Union[Dict[str, Any], Any]:
         """:meth:`federate`, then ``compute()`` on the merged result —
         the federated sibling of ``toolkit.sync_and_compute(_collection)``.
         Single metrics return the bare value; collections a
         ``{name: value}`` dict. ``self.last_provenance`` holds the
-        staleness declaration of this read."""
+        staleness declaration of this read; ``plane``: see
+        :meth:`exchange`."""
         from torcheval_tpu.metrics.metric import Metric
 
-        merged = self.federate(metrics, on_failure=on_failure)
+        merged = self.federate(metrics, on_failure=on_failure, plane=plane)
         if isinstance(merged, Metric):
             return merged.compute()
         return {name: m.compute() for name, m in merged.items()}

@@ -237,6 +237,16 @@ class Metric(Generic[TComputeReturn], ABC):
     registered merge kinds unless overridden.
     """
 
+    # Discontinuity counter: bumped by ``reset()`` and ``load_state_dict``
+    # (the two operations that REPLACE state rather than accumulate into
+    # it). A published sync-plane snapshot records the epoch it was
+    # captured at; a mismatch at read time means the snapshot describes
+    # state the metric no longer holds, so the plane must discard it
+    # instead of serving pre-reset merged values (ISSUE 16 satellite).
+    # Class-level default so pickles/clones from before this field simply
+    # read 0; updates never touch it (zero-cost on the serving path).
+    _state_epoch: int = 0
+
     def __init__(
         self,
         *,
@@ -1101,6 +1111,9 @@ class Metric(Generic[TComputeReturn], ABC):
         # it (same stale-attribute class as the PR 4 sync_provenance fix)
         self.__dict__.pop("sync_provenance", None)
         self.__dict__.pop("obs_step", None)
+        # ... and any PUBLISHED snapshot of it is now a lie: bump the
+        # state epoch so a sync plane discards pre-reset merged values
+        self._state_epoch = self._state_epoch + 1
         return self
 
     # ---------------------------------------------------------- serialization
@@ -1193,9 +1206,11 @@ class Metric(Generic[TComputeReturn], ABC):
             )
         # restored state replaces whatever a prior sync produced: drop the
         # stale provenance (the sync path re-attaches its own afterwards)
-        # and the stale observability step cursor alike
+        # and the stale observability step cursor alike — and invalidate
+        # any published sync-plane snapshot of the replaced state
         self.__dict__.pop("sync_provenance", None)
         self.__dict__.pop("obs_step", None)
+        self._state_epoch = self._state_epoch + 1
 
     # ---------------------------------------------------------------- devices
 

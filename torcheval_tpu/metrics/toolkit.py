@@ -155,6 +155,8 @@ def sync_and_compute(
     metric: MetricOrReplicas,
     process_group: Optional[ProcessGroup] = None,
     on_failure: Optional[str] = None,
+    *,
+    plane: Optional[Any] = None,
 ) -> Any:
     """Sync state across ranks/replicas and compute on the merged state
     (reference toolkit.py:34-67). Every rank returns the same value.
@@ -164,7 +166,21 @@ def sync_and_compute(
     dead host costs a bounded wait instead of a hang, and the returned
     value reflects the surviving ranks (provenance on
     ``get_synced_metric(...).sync_provenance`` and the resilient group's
-    ``health`` — see docs/fault-tolerance.md)."""
+    ``health`` — see docs/fault-tolerance.md).
+
+    ``plane`` (a :class:`~torcheval_tpu.syncplane.SyncPlane` built over
+    this live metric) switches to the NON-BLOCKING bounded-staleness
+    read: no collective, no stall — the freshest background-merged
+    snapshot is computed instead, its ``sync_provenance`` carrying
+    ``version`` / ``rounds_behind`` / ``wall_age_seconds``
+    (docs/fault-tolerance.md, "Zero-stall sync plane").
+    ``process_group``/``on_failure`` are ignored in that form: the
+    plane's own communicator and policy govern its rounds."""
+    if plane is not None:
+        synced = plane.read_metric(metric)
+        value = synced.compute()
+        _maybe_observe_computed(f"computed/{type(synced).__name__}", value)
+        return value
     synced = get_synced_metric(metric, process_group, on_failure=on_failure)
     value = synced.compute()
     _maybe_observe_computed(f"computed/{type(synced).__name__}", value)
@@ -175,13 +191,20 @@ def sync_and_compute_collection(
     metrics: Union[Dict[str, Metric], List[Dict[str, Metric]]],
     process_group: Optional[ProcessGroup] = None,
     on_failure: Optional[str] = None,
+    *,
+    plane: Optional[Any] = None,
 ) -> Dict[str, Any]:
     """Sync a ``{name: Metric}`` collection with ONE batched state exchange
     (reference toolkit.py:70-107, batching note :271). ``on_failure``: see
-    :func:`sync_and_compute`."""
-    synced = get_synced_metric_collection(
-        metrics, process_group, on_failure=on_failure
-    )
+    :func:`sync_and_compute`; ``plane``: the non-blocking
+    bounded-staleness form (see :func:`sync_and_compute` — the collection
+    must be the one the plane was built over)."""
+    if plane is not None:
+        synced = plane.read_collection(metrics)
+    else:
+        synced = get_synced_metric_collection(
+            metrics, process_group, on_failure=on_failure
+        )
     values = {name: m.compute() for name, m in synced.items()}
     for name, value in values.items():
         _maybe_observe_computed(f"computed/{name}", value)

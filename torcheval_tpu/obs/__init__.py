@@ -53,6 +53,7 @@ from torcheval_tpu.obs.events import (
     DriftEvent,
     Event,
     MemoryEvent,
+    PlaneSyncEvent,
     RegionSyncEvent,
     RestoreEvent,
     RetryEvent,
@@ -181,6 +182,7 @@ __all__ = [
     "MemoryEvent",
     "Monitor",
     "ObsServer",
+    "PlaneSyncEvent",
     "QualityWatch",
     "Recorder",
     "RegionSyncEvent",

@@ -44,6 +44,7 @@ __all__ = [
     "DriftEvent",
     "Event",
     "MemoryEvent",
+    "PlaneSyncEvent",
     "RegionSyncEvent",
     "RestoreEvent",
     "RetryEvent",
@@ -326,6 +327,33 @@ class RegionSyncEvent(Event):
 
 
 @dataclass
+class PlaneSyncEvent(Event):
+    """One background sync-plane round (``syncplane.py``).
+
+    ``version`` is the merged snapshot version the round produced,
+    ``generation`` the publish generation it consumed;
+    ``ranks``/``world_size``/``degraded``/``policy``/``reformed`` mirror
+    the round's :class:`~torcheval_tpu.resilience.SyncProvenance` (the
+    round's inner eager sync additionally records its own
+    :class:`SyncEvent` with wire-byte accounting). A FAILED round
+    records ``error`` with version 0 — the plane keeps serving the
+    previous snapshot."""
+
+    kind: ClassVar[str] = "plane_sync"
+
+    version: int = 0
+    generation: int = 0
+    ranks: Tuple[int, ...] = ()
+    world_size: int = 0
+    degraded: bool = False
+    policy: str = "raise"
+    reformed: bool = False
+    metrics: int = 0
+    seconds: float = 0.0
+    error: str = ""
+
+
+@dataclass
 class AlertEvent(Event):
     """One SLO/anomaly monitor alert (``obs/monitor.py``): a streaming
     drift detection (``alert="drift"``, EWMA z-score over observed metric
@@ -352,6 +380,7 @@ _EVENT_TYPES: Dict[str, Type[Event]] = {
         DriftEvent,
         AnalysisEvent,
         MemoryEvent,
+        PlaneSyncEvent,
         RegionSyncEvent,
         StallEvent,
         UpdateEvent,

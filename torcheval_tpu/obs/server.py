@@ -47,12 +47,16 @@ __all__ = [
 
 def healthz_payload() -> Dict[str, Any]:
     """The ``/healthz`` body: watchdog + flight + quorum/sync +
-    federation-staleness + alert status with an overall ``status`` of
-    ``ok`` / ``stalled`` / ``stale-region`` / ``alerting`` / ``degraded``
-    (first match wins; ``stalled``, ``stale-region`` and ``alerting``
-    fail the probe — a region staler than the federation's
-    ``staleness_503`` bound means the "global" numbers this process
-    serves silently exclude that region, which a load balancer must see).
+    federation-staleness + sync-plane-staleness + alert status with an
+    overall ``status`` of ``ok`` / ``stalled`` / ``stale-region`` /
+    ``stale-plane`` / ``alerting`` / ``degraded`` (first match wins;
+    ``stalled``, ``stale-region``, ``stale-plane`` and ``alerting`` fail
+    the probe — a region staler than the federation's ``staleness_503``
+    bound means the "global" numbers this process serves silently
+    exclude that region, and an armed sync plane whose freshest merged
+    snapshot has aged past its ``stale_after`` bound means every
+    bounded-staleness read this process serves is older than the
+    operator declared acceptable; a load balancer must see both).
     Usable without the server — tests and non-HTTP health integrations
     call it directly."""
     from torcheval_tpu.federation import current_federation
@@ -60,6 +64,7 @@ def healthz_payload() -> Dict[str, Any]:
     from torcheval_tpu.obs import monitor as _monitor
     from torcheval_tpu.obs import watchdog as _watchdog
     from torcheval_tpu.resilience import default_sync_health
+    from torcheval_tpu.syncplane import current_plane
 
     wd = _watchdog.current_watchdog()
     mon = _monitor.current_monitor()
@@ -102,12 +107,20 @@ def healthz_payload() -> Dict[str, Any]:
                 for s in fed.region_statuses()
             ],
         }
+    pln = current_plane()
+    plane: Dict[str, Any] = {"armed": 0}
+    stale_plane = False
+    if pln is not None:
+        stale_plane = pln.stale_for_healthz()
+        plane = {"armed": 1, **pln.staleness()}
     stalled = wd is not None and wd.tripped
     degraded = bool(sync["consecutive_missing"])
     if stalled:
         status = "stalled"
     elif stale_region:
         status = "stale-region"
+    elif stale_plane:
+        status = "stale-plane"
     elif alerts:
         status = "alerting"
     elif degraded:
@@ -116,11 +129,13 @@ def healthz_payload() -> Dict[str, Any]:
         status = "ok"
     return {
         "status": status,
-        "healthy": status not in ("stalled", "stale-region", "alerting"),
+        "healthy": status
+        not in ("stalled", "stale-region", "stale-plane", "alerting"),
         "watchdog": wd.status() if wd is not None else {"armed": 0},
         "flight": _flight.FLIGHT.counters(),
         "sync": sync,
         "federation": federation,
+        "syncplane": plane,
         "alerts": alerts,
     }
 

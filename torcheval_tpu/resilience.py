@@ -145,7 +145,17 @@ class PartialGatherError(RuntimeError):
 class SyncProvenance(NamedTuple):
     """Which ranks contributed to a synced result (attached to metrics
     returned by ``toolkit.get_synced_metric(_collection)`` as
-    ``metric.sync_provenance``)."""
+    ``metric.sync_provenance``).
+
+    The staleness triple (``version``/``rounds_behind``/
+    ``wall_age_seconds``) mirrors the per-region vocabulary of
+    :class:`torcheval_tpu.federation.FederationProvenance` so
+    intra-region and WAN reads speak ONE staleness model. Blocking syncs
+    are by definition fresh (the defaults); bounded-staleness reads off a
+    :class:`torcheval_tpu.syncplane.SyncPlane` stamp the snapshot's
+    merge version, how many publish generations the serving state has
+    advanced past it, and its wall age.
+    """
 
     ranks: Tuple[int, ...]
     world_size: int
@@ -155,6 +165,11 @@ class SyncProvenance(NamedTuple):
     # (persistent-failure escalation): ranks/world_size are then relative
     # to the REFORMED subgroup — map to global ranks via ``group.ranks``.
     reformed: bool = False
+    # bounded-staleness triple (syncplane reads; federation regions carry
+    # the same fields per region in FederationProvenance):
+    version: int = 0  # plane merge version this read observed (0 = blocking)
+    rounds_behind: int = 0  # publish generations newer than this version
+    wall_age_seconds: float = 0.0  # age of the merged snapshot at read time
 
 
 @dataclass
