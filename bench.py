@@ -3612,6 +3612,363 @@ def ref_text_eval():
 # Orchestration
 # ---------------------------------------------------------------------------
 
+def run_admission():
+    """Config 19: overload-tolerant serving intake (ISSUE 17).
+
+    Serving audit of ``torcheval_tpu.table.TablePanel`` (one-intake
+    multi-family panels) and ``AdmissionController`` (degradation
+    ladder) on a single-device world:
+
+    - ``panel``: steady-state wall per 32768-row batch of an N=4-family
+      one-intake panel (ctr + calibration + NE + a second ctr column)
+      vs ONE single-family table and vs FOUR separate single-family
+      tables fed the same rows. The acceptance pin is the tentpole
+      bound: panel ingest <= 1.3x single-family ingest (one hash, one
+      slot-resolve, one route amortized over 4 families); the
+      four-tables arm shows what the fusion replaces;
+    - ``overload``: a seeded 10x QPS + 10x key-cardinality sustained
+      spike (``OverloadSchedule``, replay-by-seed) against an armed
+      table drained every scripted step. The 10x QPS is realized as
+      10x ingest CALLS per step (same 512-row request size as the calm
+      baseline — a serving intake sees more requests, not magically
+      bigger ones), under ``config.shape_bucketing()`` so the ragged
+      admitted-row counts share power-of-two programs. The ladder
+      escalates on measured pressure and LATCHES at ``sampled`` (the
+      post-shed steady overflow sits above ``exit_pressure``, so no
+      drain counts calm until the spike ends). Pinned quantities:
+      per-call ingest p99 under overload over unloaded p99
+      (acceptance <= 2x — the whole point of shedding is that
+      per-request latency stays flat while 10x load turns into shed
+      fraction), peak slot occupancy vs the shared
+      ``ServingBudget.max_keys`` (admission and eviction read ONE
+      budget), and the undrained world-4 outbox under forced shed vs
+      unarmed (the inflow bound);
+    - ``sampling``: Horvitz-Thompson accuracy vs sampling rate — the
+      HT-reweighted column total's relative error at p in
+      {0.5, 0.1, 0.01} against the full-ingest oracle, each pinned
+      inside its 4-sigma Bernoulli CI;
+    - ``retrace``: CompileCounter over a warmed ARMED panel must stay 0
+      while the rung toggles 0 -> 1 -> 2 -> 1 -> 0 mid-stream — rung
+      changes ride the per-row ``inv_weight`` operand, never a new
+      program. The counted pass replays the warm pass's batch so the
+      ONLY varying input is the rung itself.
+
+    Statistical unbiasedness and bit-identical cross-rank shed are
+    pinned by tier-1 (tests/table/test_admission.py), not re-proven
+    here.
+    """
+    import jax
+    import numpy as np
+
+    from torcheval_tpu import config
+    from torcheval_tpu.metrics import ShardContext
+    from torcheval_tpu.table import (
+        AdmissionController,
+        MetricTable,
+        ServingBudget,
+        TablePanel,
+    )
+    from torcheval_tpu.table._admission import admission_keep
+    from torcheval_tpu.table._hash import hash_keys
+    from torcheval_tpu.utils import CompileCounter
+    from torcheval_tpu.utils.test_utils import OverloadSchedule
+
+    rng = np.random.default_rng(19)
+    batch = 32_768
+    rounds = 12
+    n_keys = 50_000
+    keys = rng.permutation(n_keys).astype(np.int64)
+    members = [
+        "ctr",
+        ("cal", "weighted_calibration"),
+        ("ne", "ne"),
+        ("conversions", "ctr"),
+    ]
+    out = {
+        "families": 4,
+        "keys": n_keys,
+        "batch_rows": batch,
+        "rounds": rounds,
+    }
+
+    def _rows(n):
+        idx = rng.integers(0, n_keys, n)
+        return (
+            keys[idx],
+            rng.integers(0, 2, n).astype(np.float32),
+            rng.uniform(0.05, 0.95, n).astype(np.float32),
+            rng.integers(0, 2, n).astype(np.float32),
+        )
+
+    def _bundle(c, p, t):
+        return dict(
+            ctr={"clicks": c},
+            cal={"preds": p, "targets": t},
+            ne={"preds": p, "targets": t},
+            conversions={"clicks": t},
+        )
+
+    def _timed(ingest, block):
+        walls = []
+        for _ in range(rounds):
+            b = _rows(batch)
+            t0 = time.perf_counter()
+            ingest(b)
+            jax.block_until_ready(block())
+            walls.append(time.perf_counter() - t0)
+        return min(walls)
+
+    # ---- panel fusion: admit every key up front, warm, then time
+    half = np.full(n_keys, 0.5, np.float32)
+    ones = np.ones(n_keys, np.float32)
+    single = MetricTable("ctr", repr_limit=0)
+    single.ingest(keys, ones)
+    panel = TablePanel(members, repr_limit=0)
+    panel.ingest(keys, **_bundle(ones, half, ones))
+    four = {
+        "ctr": MetricTable("ctr", repr_limit=0),
+        "cal": MetricTable("weighted_calibration", repr_limit=0),
+        "ne": MetricTable("ne", repr_limit=0),
+        "conversions": MetricTable("ctr", repr_limit=0),
+    }
+    for alias, t in four.items():
+        t.ingest(keys, **_bundle(ones, half, ones)[alias])
+    for _ in range(2):  # warm the batch-sized programs
+        k, c, p, t_ = _rows(batch)
+        single.ingest(k, c)
+        panel.ingest(k, **_bundle(c, p, t_))
+        for alias, t in four.items():
+            t.ingest(k, **_bundle(c, p, t_)[alias])
+
+    single_best = _timed(
+        lambda b: single.ingest(b[0], b[1]), lambda: single.col_click
+    )
+
+    def _four_ingest(b):
+        bd = _bundle(b[1], b[2], b[3])
+        for alias, t in four.items():
+            t.ingest(b[0], **bd[alias])
+
+    four_best = _timed(_four_ingest, lambda: four["ne"].col_num_examples)
+    panel_best = _timed(
+        lambda b: panel.ingest(b[0], **_bundle(b[1], b[2], b[3])),
+        lambda: panel.col_ne__num_examples,
+    )
+    out["panel"] = {
+        "single_family_us": round(single_best * 1e6, 1),
+        "panel_4fam_us": round(panel_best * 1e6, 1),
+        "four_tables_us": round(four_best * 1e6, 1),
+        "panel_over_single": round(panel_best / single_best, 3),
+        "four_tables_over_panel": round(four_best / panel_best, 3),
+        "panel_keys_per_sec": round(batch / panel_best),
+    }
+
+    # ---- 10x overload: armed table, 10x calls/step, drained per step
+    budget = ServingBudget(max_keys=2048, max_outbox=8192)
+    armed = MetricTable(
+        "ctr",
+        repr_limit=0,
+        admission=AdmissionController(
+            budget,
+            sample_p=0.1,
+            floor_p=0.01,
+            check_every=1,
+            cooldown_drains=2,
+            # the hysteresis band must straddle the POST-shed steady
+            # pressure (~0.2 overflow at rung 1 here): exit below it so
+            # the ladder latches for the whole spike instead of
+            # flapping back to full admission mid-overload
+            enter_pressure=0.9,
+            exit_pressure=0.1,
+        ),
+    )
+
+    def _drive(schedule, table, skip):
+        """Ingest the schedule as 512-row CALLS (qps multiplier = more
+        calls, not bigger ones) and drain every scripted step; return
+        post-skip per-call walls and the peak occupancy (the drain is
+        the world-1 commit hook — the same ladder step adopt_synced
+        runs on merged state)."""
+        walls, peak = [], 0
+        chunk = schedule.base_rows
+        for b in schedule.batches():
+            n = b.keys.shape[0]
+            for s in range(0, n, chunk):
+                sl = slice(s, min(s + chunk, n))
+                kw = {
+                    k: (v[sl] if isinstance(v, np.ndarray) else v)
+                    for k, v in b.kwargs.items()
+                }
+                t0 = time.perf_counter()
+                table.ingest(b.keys[sl], **kw)
+                jax.block_until_ready(table.col_click)
+                if b.step >= skip:
+                    walls.append(time.perf_counter() - t0)
+            table._pre_adopt_commit()
+            peak = max(peak, table.occupancy)
+        return walls, peak
+
+    def _warm_buckets(table, keyspace):
+        """Deterministically compile every (admitted-bucket, capacity)
+        pair the spike can produce: admission is a pure host function
+        of (key hash, epoch, p), so exactly-m admitted calls can be
+        crafted for each power-of-two bucket — rare binomial tails
+        (e.g. a 512-row call with only 30 admitted rows) must not pay
+        their first compile inside the measured window."""
+        p = table.admission.sampled_fraction(int(table.admission_rung))
+        epoch = int(table.admission_epoch)
+        admitted = keyspace[admission_keep(hash_keys(keyspace), epoch, p)]
+        sizes = (8, 16, 32, 64, 128, 256, 512)
+        for m in sizes:
+            if m <= admitted.size:
+                table.ingest(admitted[:m], np.ones(m, np.float32))
+        # force the spiked capacity, then re-warm each bucket there
+        table.ingest(admitted, np.ones(admitted.size, np.float32))
+        for m in sizes:
+            if m <= admitted.size:
+                table.ingest(admitted[:m], np.ones(m, np.float32))
+        jax.block_until_ready(table.col_click)
+
+    calm_sched = OverloadSchedule.sustained(
+        40, 1.0, base_rows=512, base_keys=2048, seed=20
+    )
+    spike_sched = OverloadSchedule.sustained(
+        48, 10.0, cardinality=10.0, base_rows=512, base_keys=2048, seed=21
+    )
+    with config.shape_bucketing():
+        calm_walls, _ = _drive(calm_sched, armed, skip=8)
+        rungs_before = int(armed.admission_transitions)
+        # escalate on a throwaway spike prefix, then pre-compile the
+        # admitted-row buckets at the latched rung
+        _drive(
+            OverloadSchedule.sustained(
+                4, 10.0, cardinality=10.0, base_rows=512, base_keys=2048,
+                seed=19,
+            ),
+            armed,
+            skip=99,
+        )
+        _warm_buckets(armed, np.arange(20_480, dtype=np.int64))
+        spike_walls, spike_peak = _drive(spike_sched, armed, skip=0)
+    unloaded_p99 = float(np.percentile(calm_walls, 99))
+    overload_p99 = float(np.percentile(spike_walls, 99))
+    out["overload"] = {
+        "qps_multiplier": 10.0,
+        "cardinality_multiplier": 10.0,
+        "unloaded_p99_us": round(unloaded_p99 * 1e6, 1),
+        "overload_p99_us": round(overload_p99 * 1e6, 1),
+        "p99_ratio": round(overload_p99 / unloaded_p99, 3),
+        "peak_occupancy": int(spike_peak),
+        "max_keys_budget": budget.max_keys,
+        "final_rung": int(armed.admission_rung),
+        "transitions": int(armed.admission_transitions) - rungs_before,
+        "shed_rows_total": int(armed.shed_rows_total),
+    }
+
+    # ---- undrained world-4 outbox: forced shed vs unarmed inflow
+    def _outbox(shed):
+        t = MetricTable(
+            "ctr",
+            shard=ShardContext(0, 4),
+            repr_limit=0,
+            admission=(
+                AdmissionController(budget, sample_p=0.1, floor_p=0.01)
+                if shed
+                else None
+            ),
+        )
+        if shed:
+            t.admission_rung = 2
+        for b in OverloadSchedule.sustained(
+            8, 10.0, cardinality=10.0, base_rows=512, base_keys=1024, seed=22
+        ).batches():
+            t.ingest(b.keys, **b.kwargs)
+        return int(t.out_h)
+
+    unarmed_out, armed_out = _outbox(False), _outbox(True)
+    out["overload"]["outbox_entries"] = {
+        "unarmed": unarmed_out,
+        "armed_shed": armed_out,
+        "max_outbox_budget": budget.max_outbox,
+    }
+
+    # ---- HT accuracy vs sampling rate
+    n_sample = 20_000
+    s_keys = np.arange(n_sample)
+    s_clicks = rng.integers(0, 2, n_sample).astype(np.float32)
+    sampling = []
+    for p in (0.5, 0.1, 0.01):
+        t = MetricTable(
+            "ctr",
+            repr_limit=0,
+            admission=AdmissionController(ServingBudget(), sample_p=p),
+        )
+        t.admission_rung = 1
+        t.ingest(s_keys, s_clicks)
+        ns = int(t.n_keys)
+        est = float(np.asarray(t.col_weight)[:ns].sum())
+        rel_err = abs(est - n_sample) / n_sample
+        bound = 4.0 * np.sqrt((1.0 - p) / p * n_sample) / n_sample
+        sampling.append(
+            {
+                "p": p,
+                "sampled_fraction": float(
+                    t.admission.sampled_fraction(1)
+                ),
+                "rel_err": round(rel_err, 5),
+                "ci_bound_rel": round(bound, 5),
+                "within_ci": bool(rel_err <= bound),
+            }
+        )
+    out["sampling"] = sampling
+
+    # ---- retrace audit: warmed ARMED panel, rung toggles mid-stream.
+    # The counted pass replays the warm pass's batch, so the only
+    # varying input is the rung — which rides the per-row inv_weight
+    # operand, never the program.
+    armed_panel = TablePanel(
+        members,
+        repr_limit=0,
+        admission=AdmissionController(ServingBudget(), sample_p=0.5),
+    )
+    armed_panel.ingest(keys, **_bundle(ones, half, ones))  # full keyset
+    k, c, p, t_ = _rows(batch)
+    with config.shape_bucketing():
+        for rung in (0, 1, 2):  # warm each rung's admitted-row bucket
+            armed_panel.admission_rung = rung
+            armed_panel.ingest(k, **_bundle(c, p, t_))
+        with CompileCounter() as cc:
+            for rung in (0, 1, 2, 1, 0):
+                armed_panel.admission_rung = rung
+                armed_panel.ingest(k, **_bundle(c, p, t_))
+    out["retrace"] = {
+        "programs_across_rung_changes": cc.programs,
+        "zero_retrace": cc.programs == 0,
+    }
+
+    out["acceptance"] = {
+        "panel_within_1_3x": out["panel"]["panel_over_single"] <= 1.3,
+        "overload_p99_within_2x": out["overload"]["p99_ratio"] <= 2.0,
+        "occupancy_within_budget": spike_peak <= budget.max_keys,
+        "outbox_reduced_under_shed": armed_out < unarmed_out
+        and armed_out <= budget.max_outbox,
+        "sampled_within_ci": all(s["within_ci"] for s in sampling),
+        "zero_retrace": out["retrace"]["zero_retrace"],
+        "ladder_engaged": out["overload"]["final_rung"] >= 1
+        or out["overload"]["shed_rows_total"] > 0,
+    }
+    return {
+        "metric": (
+            "overload-tolerant intake: 4-family one-intake panel over "
+            "single-family ingest + admission ladder under 10x overload"
+        ),
+        "value": out["panel"]["panel_over_single"],
+        "unit": "x single-family ingest (4-family panel, lower is better)",
+        "lower_is_better": True,
+        "admission": out,
+    }
+
+
 CONFIGS = {
     "accuracy_update": (run_accuracy_update, "ref_accuracy_update"),
     "auroc_compute": (run_auroc_compute, "ref_auroc_compute"),
@@ -3631,6 +3988,7 @@ CONFIGS = {
     "quality": (run_quality, None),  # data-quality-telemetry audit
     "region_sync": (run_region_sync, None),  # cross-region federation audit
     "async_sync": (run_async_sync, None),  # zero-stall sync plane audit
+    "admission": (run_admission, None),  # overload-tolerant intake audit
 }
 
 _NO_REF_NOTES = {
@@ -3689,6 +4047,11 @@ _NO_REF_NOTES = {
         "sync layer, so the comparisons are our own sync-off serving "
         "loop and our own inline blocking-sync stall arm"
     ),
+    "admission": (
+        "overload-tolerance audit — the reference has no keyed table or "
+        "admission layer, so the comparisons are our own single-family "
+        "table and our own unarmed/unloaded arms"
+    ),
 }
 
 REF_FNS = {
@@ -3720,7 +4083,7 @@ def _cache_env(env):
 _SINGLE_DEVICE_CONFIGS = {
     "accuracy_update", "auroc_compute", "text_eval", "fid", "kernels",
     "variable_batch", "sharded_state", "monitoring", "metric_table",
-    "quality", "region_sync", "async_sync",
+    "quality", "region_sync", "async_sync", "admission",
 }
 
 

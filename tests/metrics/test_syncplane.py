@@ -58,9 +58,10 @@ def _mean_pair():
 
 
 def test_sync_provenance_schema_pinned():
-    """The bounded-staleness triple extends the tuple by APPENDED,
-    defaulted fields — positional construction sites and old pickles
-    stay valid, and the field order is part of the wire schema."""
+    """The bounded-staleness triple — and now the admission triple —
+    extend the tuple by APPENDED, defaulted fields — positional
+    construction sites and old pickles stay valid, and the field order
+    is part of the wire schema."""
     assert SyncProvenance._fields == (
         "ranks",
         "world_size",
@@ -70,12 +71,20 @@ def test_sync_provenance_schema_pinned():
         "version",
         "rounds_behind",
         "wall_age_seconds",
+        "sampled_fraction",
+        "admission_rung",
+        "admission_epoch",
     )
     legacy = SyncProvenance((0, 1), 2, False, "strict")
     assert legacy.reformed is False
     assert legacy.version == 0
     assert legacy.rounds_behind == 0
     assert legacy.wall_age_seconds == 0.0
+    # the admission triple defaults read "full ingest" for every
+    # non-table / unarmed metric
+    assert legacy.sampled_fraction == 1.0
+    assert legacy.admission_rung == 0
+    assert legacy.admission_epoch == 0
 
 
 def test_sync_provenance_round_trips():

@@ -1,0 +1,508 @@
+"""Overload admission control (ISSUE 17): deterministic sampling,
+Horvitz-Thompson unbiasedness CI pins against the full-ingest oracle,
+ladder hysteresis under the seeded spike harness, bit-identical shed
+decisions across ThreadWorld ranks and elastic resume, provenance
+stamping/drop regressions, and the observability surface (prometheus
+gauge grammar, AdmissionEvent round trip, /healthz shedding rung,
+admission counter source, federation drain-cadence tightening)."""
+
+from __future__ import annotations
+
+import copy
+import re
+import tempfile
+
+import numpy as np
+import pytest
+
+from torcheval_tpu.elastic import ElasticSession
+from torcheval_tpu.metrics import ShardContext
+from torcheval_tpu.metrics.toolkit import adopt_synced
+from torcheval_tpu.table import (
+    AdmissionController,
+    AdmissionProvenance,
+    MetricTable,
+    ServingBudget,
+    admission_keep,
+    shedding_status,
+)
+from torcheval_tpu.table._hash import hash_keys
+from torcheval_tpu.utils.test_utils import OverloadSchedule, ThreadWorld
+
+
+def _armed(rung=0, sample_p=0.1, floor_p=0.01, **table_kwargs):
+    t = MetricTable(
+        "ctr",
+        admission=AdmissionController(
+            ServingBudget(), sample_p=sample_p, floor_p=floor_p
+        ),
+        **table_kwargs,
+    )
+    t.admission_rung = rung
+    return t
+
+
+# ------------------------------------------------------- pure decisions
+
+
+def test_admission_keep_is_pure_and_rate_calibrated():
+    rng = np.random.default_rng(3)
+    hashed = hash_keys(rng.integers(0, 1 << 40, 20000))
+    for p in (0.5, 0.1, 0.01):
+        keep = admission_keep(hashed, 7, p)
+        again = admission_keep(hashed, 7, p)
+        assert np.array_equal(keep, again)  # replay: pure in (key, epoch, p)
+        rate = keep.mean()
+        assert abs(rate - p) < 4.0 * np.sqrt(p * (1 - p) / hashed.size)
+    # a new epoch re-rolls the population (different keys survive)
+    k7 = admission_keep(hashed, 7, 0.5)
+    k8 = admission_keep(hashed, 8, 0.5)
+    assert not np.array_equal(k7, k8)
+    # p=1.0 admits everything
+    assert admission_keep(hashed, 7, 1.0).all()
+
+
+def test_controller_validation():
+    with pytest.raises(ValueError, match="sample_p"):
+        AdmissionController(ServingBudget(), sample_p=0.0)
+    with pytest.raises(ValueError, match="floor_p"):
+        AdmissionController(ServingBudget(), sample_p=0.1, floor_p=0.5)
+    with pytest.raises(ValueError, match="exit_pressure"):
+        AdmissionController(
+            ServingBudget(), enter_pressure=0.5, exit_pressure=0.9
+        )
+    with pytest.raises(ValueError, match="cooldown_drains"):
+        AdmissionController(ServingBudget(), cooldown_drains=0)
+    with pytest.raises(ValueError, match="max_keys"):
+        AdmissionController(ServingBudget(max_keys=0))
+    with pytest.raises(TypeError, match="AdmissionController"):
+        MetricTable("ctr").arm_admission(object())
+
+
+def test_budget_max_keys_is_shared_with_the_evictor():
+    t = MetricTable(
+        "ctr", admission=AdmissionController(ServingBudget(max_keys=16))
+    )
+    assert t.max_keys == 16
+    # the tighter of table/budget bounds wins
+    t2 = MetricTable(
+        "ctr",
+        max_keys=8,
+        admission=AdmissionController(ServingBudget(max_keys=16)),
+    )
+    assert t2.max_keys == 8
+
+
+# ------------------------------------------------ unbiasedness CI pins
+
+
+@pytest.mark.parametrize("p", [0.5, 0.1, 0.01])
+def test_sampled_ctr_totals_unbiased_within_ci(p):
+    """HT-reweighted column totals at rung=sampled match the full-ingest
+    oracle within 4-sigma Bernoulli bounds (per-key sampling: the
+    estimator is sum over admitted keys of s_k / p, variance
+    (1-p)/p * sum s_k^2)."""
+    n = 4000 if p == 0.01 else 1000
+    rng = np.random.default_rng(int(p * 1000))
+    keys = np.arange(n)
+    clicks = rng.integers(0, 2, n).astype(np.float32)
+    weights = np.ones(n, np.float32)
+
+    full = MetricTable("ctr")
+    full.ingest(keys, clicks, weights)
+    nf = int(full.n_keys)
+    true_click = float(np.asarray(full.col_click)[:nf].sum())
+    true_weight = float(np.asarray(full.col_weight)[:nf].sum())
+
+    t = _armed(rung=1, sample_p=p)
+    t.ingest(keys, clicks, weights)
+    ns = int(t.n_keys)
+    est_click = float(np.asarray(t.col_click)[:ns].sum())
+    est_weight = float(np.asarray(t.col_weight)[:ns].sum())
+
+    var_scale = (1.0 - p) / p
+    bound_w = 4.0 * np.sqrt(var_scale * np.sum(weights**2)) + 1e-6
+    bound_c = 4.0 * np.sqrt(var_scale * np.sum(clicks**2)) + 1e-6
+    assert abs(est_weight - true_weight) <= bound_w
+    assert abs(est_click - true_click) <= bound_c
+    # the aggregate CTR ratio estimator lands near the oracle too
+    assert abs(est_click / est_weight - true_click / true_weight) < 0.2
+    # provenance reflects the sampled regime
+    t.compute()
+    prov = t.admission_provenance
+    assert isinstance(prov, AdmissionProvenance)
+    assert prov.rung == 1 and prov.sampled_fraction == p
+    assert prov.shed_rows == int(t.shed_rows_total) > 0
+
+
+@pytest.mark.parametrize("p", [0.5, 0.1])
+def test_sampled_ne_totals_unbiased_within_ci(p):
+    """Same pin through the NE family's float lane (entropy/example/
+    positive columns are all HT-scaled by the shared intake)."""
+    n = 1500
+    rng = np.random.default_rng(5)
+    keys = np.arange(n)
+    preds = rng.uniform(0.05, 0.95, n).astype(np.float32)
+    targets = rng.integers(0, 2, n).astype(np.float32)
+
+    full = MetricTable("ne")
+    full.ingest(keys, preds, targets)
+    nf = int(full.n_keys)
+    true_ex = float(np.asarray(full.col_num_examples)[:nf].sum())
+
+    t = MetricTable(
+        "ne", admission=AdmissionController(ServingBudget(), sample_p=p)
+    )
+    t.admission_rung = 1
+    t.ingest(keys, preds, targets)
+    ns = int(t.n_keys)
+    est_ex = float(np.asarray(t.col_num_examples)[:ns].sum())
+    bound = 4.0 * np.sqrt((1.0 - p) / p * n)
+    assert abs(est_ex - true_ex) <= bound
+
+
+def test_admitted_keys_read_exact_per_key_values():
+    """Sampling is per (key, epoch): every row of an admitted key is
+    kept, so ADMITTED keys' ratio metrics equal the full-ingest oracle —
+    sampling only thins which keys report. (The HT 1/p scale rides both
+    numerator and denominator, so equality is exact up to f32 rounding
+    of the common factor, not bit-exact.)"""
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 200, 2000)
+    clicks = rng.integers(0, 2, 2000).astype(np.float32)
+
+    full = MetricTable("ctr")
+    full.ingest(keys, clicks)
+    oracle = full.compute().as_dict()
+
+    t = _armed(rung=1, sample_p=0.3)
+    t.ingest(keys, clicks)
+    sampled = t.compute().as_dict()
+    assert 0 < len(sampled) < len(oracle)
+    for k, v in sampled.items():
+        assert v == pytest.approx(oracle[k], rel=1e-5)
+
+
+def test_priority_keys_are_never_shed():
+    vips = [7, 13]
+    t = MetricTable(
+        "ctr",
+        admission=AdmissionController(
+            ServingBudget(), sample_p=0.05, priority_keys=vips
+        ),
+    )
+    t.admission_rung = 2  # priority-shed: only VIPs + floor_p survive
+    rng = np.random.default_rng(2)
+    keys = np.concatenate([rng.integers(20, 4000, 1000), vips])
+    t.ingest(keys, np.ones(keys.size, np.float32))
+    surviving = set(t.compute().as_dict())
+    assert set(vips) <= surviving
+    assert int(t.shed_rows_total) > 0
+
+
+# ------------------------------------------- cross-world determinism
+
+
+def test_shed_decisions_bit_identical_across_threadworld_ranks():
+    """Every rank of a ThreadWorld-4 sees the same batch and makes the
+    SAME per-row admission decisions (stateless splitmix64 — no RNG
+    state), so the adopted world-4 values are bit-identical to a
+    world-1 armed replay."""
+    rng = np.random.default_rng(23)
+    batches = [
+        (rng.integers(0, 120, 64), rng.integers(0, 2, 64).astype(np.float32))
+        for _ in range(4)
+    ]
+
+    def run_world(world):
+        def body(g):
+            t = MetricTable(
+                "ctr",
+                shard=ShardContext(g.rank, world),
+                admission=AdmissionController(ServingBudget(), sample_p=0.4),
+            )
+            t.admission_rung = 1
+            for keys, clicks in batches:  # every rank, the full stream
+                t.ingest(keys, clicks)
+            counts = (int(t.admitted_rows_total), int(t.shed_rows_total))
+            synced = adopt_synced(t, g)
+            return counts, synced.compute().as_dict()
+
+        return ThreadWorld(world).run(body)
+
+    results4 = run_world(4)
+    counts = {c for c, _ in results4}
+    assert len(counts) == 1  # bit-identical decisions on every rank
+
+    t1 = MetricTable(
+        "ctr", admission=AdmissionController(ServingBudget(), sample_p=0.4)
+    )
+    t1.admission_rung = 1
+    for keys, clicks in batches:
+        t1.ingest(keys, clicks)
+    assert (int(t1.admitted_rows_total), int(t1.shed_rows_total)) in counts
+
+
+def test_elastic_resume_sheds_identically_across_world_change():
+    """Ladder rung + epoch ride the snapshot: a world restored at 2 or 4
+    resumes on the same rung and admits the SAME key set for the next
+    batch (decisions are pure in (key, epoch, rung))."""
+    rng = np.random.default_rng(6)
+    warm = (rng.integers(0, 60, 48), np.ones(48, np.float32))
+    probe = (rng.integers(0, 5000, 256), np.ones(256, np.float32))
+
+    def make(rank, world):
+        t = MetricTable(
+            "ctr",
+            shard=ShardContext(rank, world),
+            admission=AdmissionController(ServingBudget(), sample_p=0.25),
+        )
+        return t
+
+    with tempfile.TemporaryDirectory() as d:
+
+        def writer(g):
+            t = make(g.rank, 2)
+            t.ingest(*warm)
+            t.admission_rung = 1
+            t.admission_epoch = 3
+            ElasticSession(t, d, process_group=g, interval=10**9).snapshot()
+
+        ThreadWorld(2).run(writer)
+
+        def resumed_counts(world):
+            def body(g):
+                t = make(g.rank, world)
+                sess = ElasticSession(t, d, process_group=g, interval=10**9)
+                assert sess.restore() is not None
+                assert int(t.admission_rung) == 1
+                assert int(t.admission_epoch) == 3
+                before = int(t.admitted_rows_total)
+                t.ingest(*probe)
+                return int(t.admitted_rows_total) - before
+
+            return set(ThreadWorld(world).run(body))
+
+        at2 = resumed_counts(2)
+        at4 = resumed_counts(4)
+        assert len(at2) == 1 and at2 == at4  # identical shed everywhere
+
+
+# ------------------------------------------------------ ladder dynamics
+
+
+def test_ladder_escalates_and_recovers_without_flapping():
+    """Under the seeded spike harness the ladder escalates while
+    overload persists, de-escalates only after the cooldown, and the
+    rung trajectory is unimodal — up-sweep, plateau, down-sweep, no
+    oscillation."""
+    spike = OverloadSchedule.ramp(
+        6, 12.0, cardinality=12.0, base_rows=48, base_keys=24, seed=9
+    )
+    t = MetricTable(
+        "ctr",
+        admission=AdmissionController(
+            ServingBudget(max_keys=32),
+            sample_p=0.3,
+            cooldown_drains=2,
+            check_every=1,
+        ),
+    )
+    trajectory = []
+    for batch in spike.batches():
+        t.ingest(batch.keys, **batch.kwargs)
+        adopt_synced(t)
+        trajectory.append(int(t.admission_rung))
+    calm = OverloadSchedule.sustained(
+        8, 1.0, base_rows=8, base_keys=8, seed=10
+    )
+    for batch in calm.batches():
+        t.ingest(batch.keys, **batch.kwargs)
+        adopt_synced(t)
+        trajectory.append(int(t.admission_rung))
+
+    assert max(trajectory) >= 1  # overload was noticed
+    assert trajectory[-1] == 0  # and fully recovered
+    # unimodal: once the rung starts descending it never climbs again
+    peak = trajectory.index(max(trajectory))
+    descent = trajectory[peak:]
+    assert all(a >= b for a, b in zip(descent, descent[1:]))
+    # hysteresis: one up-sweep + one down-sweep worth of transitions
+    assert int(t.admission_transitions) <= 2 * max(trajectory) + 1
+
+
+# ---------------------------------------------------------- provenance
+
+
+def test_provenance_dropped_on_reset_and_load():
+    t = _armed(rung=1)
+    t.ingest(np.arange(8), np.ones(8, np.float32))
+    t.compute()
+    assert isinstance(t.admission_provenance, AdmissionProvenance)
+    sd = copy.deepcopy(t.state_dict())
+    t.reset()
+    assert not hasattr(t, "admission_provenance")
+    t.compute()
+    assert t.admission_provenance.admitted_rows == 0  # fresh, not stale
+    t.load_state_dict(sd)
+    t2 = _armed(rung=1)
+    t2.ingest(np.arange(8), np.ones(8, np.float32))
+    t2.compute()
+    assert hasattr(t2, "admission_provenance")
+    t2.load_state_dict(sd)
+    assert not hasattr(t2, "admission_provenance")
+
+
+def test_state_dict_round_trips_ladder_state():
+    t = _armed(rung=2)
+    t.ingest(np.arange(300), np.ones(300, np.float32))
+    sd = t.state_dict()
+    for k in (
+        "admission_rung",
+        "admission_calm",
+        "admission_epoch",
+        "admitted_rows_total",
+        "shed_rows_total",
+        "admission_transitions",
+        "pressure_peak",
+    ):
+        assert k in sd
+    fresh = _armed(rung=0)
+    fresh.load_state_dict(sd)
+    assert int(fresh.admission_rung) == 2
+    assert int(fresh.shed_rows_total) == int(t.shed_rows_total)
+
+
+def test_sync_provenance_carries_admission_fields():
+    t = _armed(rung=1, sample_p=0.2)
+    t.ingest(np.arange(50), np.ones(50, np.float32))
+    adopt_synced(t)
+    prov = t.sync_provenance
+    assert prov.admission_rung == int(t.admission_rung)
+    assert prov.sampled_fraction in (1.0, 0.2, 0.01)
+    # plain metrics keep the appended defaults
+    from torcheval_tpu.metrics import Mean
+    from torcheval_tpu.metrics.toolkit import get_synced_metric
+
+    m = Mean()
+    m.update(np.asarray([1.0]))
+    s = get_synced_metric(m)
+    assert s.sync_provenance.sampled_fraction == 1.0
+    assert s.sync_provenance.admission_rung == 0
+
+
+# ------------------------------------------------------- observability
+
+
+def test_prometheus_gauges_grammar_pinned():
+    from torcheval_tpu.obs.counters import CounterRegistry
+    from torcheval_tpu.obs.export import render_prometheus
+
+    t = _armed(rung=1, sample_p=0.2)
+    t.ingest(np.arange(400), np.ones(400, np.float32))
+    reg = CounterRegistry()
+    t.track_values(registry=reg)
+    text = render_prometheus(reg, histograms={})
+    for gauge in ("shed_fraction", "admitted_keys"):
+        lines = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith(f"torcheval_tpu_metric_table_values_{gauge} ")
+        ]
+        assert len(lines) == 1, gauge
+        # exposition grammar: bare metric name, single space, float
+        assert re.fullmatch(
+            r"torcheval_tpu_metric_table_values_"
+            + gauge
+            + r" [0-9]+(\.[0-9]+)?([eE][+-][0-9]+)?",
+            lines[0],
+        ), lines[0]
+    vals = reg.read()["metric_table_values"]
+    assert 0.0 < vals["shed_fraction"] < 1.0
+    assert vals["admitted_keys"] == float(t.n_keys)
+
+
+def test_admission_counter_source_and_shedding_status():
+    from torcheval_tpu.obs.counters import default_registry
+
+    t = _armed(rung=2, sample_p=0.5, floor_p=0.05)
+    status = shedding_status()
+    assert status["armed"] and status["shedding"]
+    assert status["rung"] == 2 and status["rung_name"] == "shed"
+    assert status["sampled_fraction"] == 0.05
+    counters = default_registry().read()["admission"]
+    assert counters["rung"] == 2
+    t.disarm_admission()
+    assert not shedding_status()["armed"]
+    assert default_registry().read()["admission"]["armed"] == 0
+
+
+def test_healthz_gains_shedding_rung():
+    from torcheval_tpu.obs.server import healthz_payload
+
+    t = _armed(rung=1)
+    payload = healthz_payload()
+    assert payload["status"] == "shedding"
+    assert payload["healthy"]  # graceful: the probe stays 200
+    assert payload["admission"]["rung_name"] == "sampled"
+    t.admission_rung = 0
+    assert healthz_payload()["status"] == "ok"
+    t.disarm_admission()
+    assert healthz_payload()["admission"]["armed"] == 0
+
+
+def test_admission_event_emitted_and_round_trips():
+    from torcheval_tpu import config
+    from torcheval_tpu.obs.events import AdmissionEvent, event_from_dict
+    from torcheval_tpu.obs.recorder import RECORDER
+
+    evt = AdmissionEvent(
+        table="MetricTable",
+        prev_rung=0,
+        rung=1,
+        rung_name="sampled",
+        pressure=1.25,
+        sampled_fraction=0.1,
+        epoch=4,
+    )
+    back = event_from_dict(evt.as_dict())
+    assert isinstance(back, AdmissionEvent)
+    assert back == evt
+
+    spike = OverloadSchedule.sustained(
+        3, 14.0, cardinality=14.0, base_rows=64, base_keys=48, seed=4
+    )
+    with config.observability():
+        t = MetricTable(
+            "ctr",
+            admission=AdmissionController(
+                ServingBudget(max_keys=24), check_every=1
+            ),
+        )
+        for batch in spike.batches():
+            t.ingest(batch.keys, **batch.kwargs)
+            adopt_synced(t)
+        kinds = [e.kind for e in RECORDER.log]
+        assert "admission" in kinds
+        transition = next(e for e in RECORDER.log if e.kind == "admission")
+        assert transition.rung > transition.prev_rung
+        assert transition.pressure > 0.0
+
+
+def test_federation_drain_cadence_tightens_under_shed():
+    from torcheval_tpu.federation import Federation
+
+    class _F:
+        exchange_interval = Federation.exchange_interval
+
+    t = _armed(rung=0)
+    assert _F().exchange_interval(8) == 8
+    t.admission_rung = 1
+    assert _F().exchange_interval(8) == 4
+    t.admission_rung = 2
+    assert _F().exchange_interval(8) == 2
+    assert _F().exchange_interval(1) == 1  # floor
+    t.disarm_admission()
+    assert _F().exchange_interval(8) == 8
+    with pytest.raises(ValueError, match="base interval"):
+        _F().exchange_interval(0)

@@ -365,8 +365,11 @@ def test_counters_track_and_prometheus_scrape():
     assert counters["metric_table"]["evictions_total"] == 0
     assert counters["metric_table"]["per_rank_bytes"] > 0
     assert set(counters["metric_table_values"]) == {
-        "value_5", "value_6", "value_7"
+        "value_5", "value_6", "value_7",
+        "shed_fraction", "admitted_keys",
     }
+    assert counters["metric_table_values"]["shed_fraction"] == 0.0
+    assert counters["metric_table_values"]["admitted_keys"] == 3.0
     text = render_prometheus(reg, histograms={})
     assert "torcheval_tpu_metric_table_occupancy 3" in text
     assert "torcheval_tpu_metric_table_values_value_5 1" in text

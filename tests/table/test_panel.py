@@ -1,0 +1,234 @@
+"""One-intake table panels (ISSUE 17 tentpole): N family columns on ONE
+fused key intake. Per-alias values pinned against single-family oracle
+tables, one stable program across ragged batches (retrace-proof under
+shape bucketing), alias/windowed-member validation, distributed adopt,
+state/clone round trips, scrape naming, and the shared admission gate
+counting each row once."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from torcheval_tpu.metrics import ShardContext
+from torcheval_tpu.metrics.toolkit import adopt_synced, clone_metric
+from torcheval_tpu.table import (
+    AdmissionController,
+    MetricTable,
+    PanelValues,
+    ServingBudget,
+    TablePanel,
+)
+from torcheval_tpu.utils.test_utils import ThreadWorld
+
+RNG = np.random.default_rng(41)
+N = 96
+KEYS = RNG.integers(0, 40, N)
+CLICKS = RNG.integers(0, 2, N).astype(np.float32)
+PREDS = RNG.uniform(0.05, 0.95, N).astype(np.float32)
+TARGETS = RNG.integers(0, 2, N).astype(np.float32)
+WEIGHTS = (RNG.integers(1, 8, N) / 8).astype(np.float32)
+
+MEMBERS = [
+    "ctr",
+    ("cal", "weighted_calibration"),
+    ("ne", "ne", {"from_logits": False}),
+    ("conversions", "ctr"),
+]
+BUNDLE = dict(
+    ctr={"clicks": CLICKS, "weights": WEIGHTS},
+    cal={"preds": PREDS, "targets": TARGETS, "weights": WEIGHTS},
+    ne={"preds": PREDS, "targets": TARGETS, "weights": WEIGHTS},
+    conversions={"clicks": TARGETS, "weights": WEIGHTS},
+)
+
+
+def _oracles():
+    out = {}
+    for alias, family, kwargs in (
+        ("ctr", "ctr", {}),
+        ("cal", "weighted_calibration", {}),
+        ("ne", "ne", {}),
+        ("conversions", "ctr", {}),
+    ):
+        t = MetricTable(family, **kwargs)
+        args = BUNDLE[alias]
+        t.ingest(KEYS, **args)
+        out[alias] = t.compute().as_dict()
+    return out
+
+
+def test_panel_matches_single_family_oracles_bit_exact():
+    panel = TablePanel(MEMBERS)
+    panel.ingest(KEYS, **BUNDLE)
+    values = panel.compute()
+    assert isinstance(values, PanelValues)
+    assert panel.aliases == ("ctr", "cal", "ne", "conversions")
+    got = values.as_dict()
+    for alias, want in _oracles().items():
+        assert got[alias] == want, alias  # bit-exact, same row kernels
+
+
+def test_one_intake_means_one_key_set_and_one_program():
+    from torcheval_tpu.utils.compile_counter import CompileCounter
+
+    def feed(panel, rng):
+        for n in (96, 61, 96, 33):  # ragged sizes
+            keys = rng.integers(0, 40, n)
+            clicks = rng.integers(0, 2, n).astype(np.float32)
+            preds = rng.uniform(0.1, 0.9, n).astype(np.float32)
+            tgt = rng.integers(0, 2, n).astype(np.float32)
+            panel.ingest(
+                keys,
+                ctr={"clicks": clicks},
+                cal={"preds": preds, "targets": tgt},
+                ne={"preds": preds, "targets": tgt},
+                conversions={"clicks": tgt},
+            )
+
+    panel = TablePanel(MEMBERS)
+    panel.ingest(KEYS, **BUNDLE)
+    feed(panel, np.random.default_rng(7))  # warm every shape bucket
+    with CompileCounter() as warm:
+        feed(panel, np.random.default_rng(8))
+    assert warm.compiles == 0  # retrace-proof: ONE fused program
+    # the intake is shared: one key set, one insert per novel key
+    assert int(panel.inserts_total) == int(panel.n_keys)
+
+
+def test_member_bundles_are_validated():
+    panel = TablePanel(MEMBERS)
+    with pytest.raises(TypeError, match="per-member keyword arguments"):
+        panel.ingest(KEYS, CLICKS)
+    with pytest.raises(TypeError, match="missing"):
+        panel.ingest(KEYS, ctr={"clicks": CLICKS})
+    bad = dict(BUNDLE)
+    bad["typo"] = {}
+    with pytest.raises(TypeError, match="unexpected"):
+        panel.ingest(KEYS, **bad)
+
+
+def test_alias_and_member_validation():
+    with pytest.raises(ValueError, match="at least one member"):
+        TablePanel([])
+    with pytest.raises(ValueError, match="duplicate panel member alias"):
+        TablePanel(["ctr", ("ctr", "ctr")])
+    with pytest.raises(ValueError, match="alias"):
+        TablePanel([("bad-alias", "ctr")])
+    with pytest.raises(ValueError, match="windowed"):
+        TablePanel(["ctr", "windowed_ne"])
+    with pytest.raises(ValueError, match="unknown table family"):
+        TablePanel(["nope"])
+
+
+def test_panel_distributed_adopt_matches_world1():
+    batches = [
+        (
+            RNG.integers(0, 40, 32),
+            RNG.integers(0, 2, 32).astype(np.float32),
+            RNG.uniform(0.1, 0.9, 32).astype(np.float32),
+            RNG.integers(0, 2, 32).astype(np.float32),
+        )
+        for _ in range(4)
+    ]
+
+    def bundle(c, p, t):
+        return dict(
+            ctr={"clicks": c},
+            cal={"preds": p, "targets": t},
+            ne={"preds": p, "targets": t},
+            conversions={"clicks": t},
+        )
+
+    def body(g):
+        """The panel and its four single-family member tables see the
+        same sharded stream; post-adopt the panel's per-alias values
+        must be BIT-exact against each member table (same row kernels,
+        same merge order — the one-intake fusion changes no math)."""
+        panel = TablePanel(MEMBERS, shard=ShardContext(g.rank, 2))
+        singles = {
+            "ctr": MetricTable("ctr", shard=ShardContext(g.rank, 2)),
+            "cal": MetricTable(
+                "weighted_calibration", shard=ShardContext(g.rank, 2)
+            ),
+            "ne": MetricTable("ne", shard=ShardContext(g.rank, 2)),
+            "conversions": MetricTable("ctr", shard=ShardContext(g.rank, 2)),
+        }
+        for i in range(g.rank, len(batches), 2):
+            k, c, p, t = batches[i]
+            b = bundle(c, p, t)
+            panel.ingest(k, **b)
+            for alias, table in singles.items():
+                table.ingest(k, **b[alias])
+        got = adopt_synced(panel, g).compute().as_dict()
+        want = {
+            alias: adopt_synced(table, g).compute().as_dict()
+            for alias, table in singles.items()
+        }
+        assert got == want
+        return got
+
+    results = ThreadWorld(2).run(body)
+    assert results[0] == results[1]  # every rank returns the same value
+
+
+def test_panel_state_and_clone_round_trip():
+    panel = TablePanel(MEMBERS)
+    panel.ingest(KEYS, **BUNDLE)
+    want = panel.compute().as_dict()
+
+    fresh = TablePanel(MEMBERS)
+    fresh.load_state_dict(panel.state_dict())
+    assert fresh.compute().as_dict() == want
+
+    cloned = clone_metric(panel)  # _MemberView deepcopy regression
+    assert cloned.compute().as_dict() == want
+    cloned.ingest(KEYS, **BUNDLE)  # the clone is independently usable
+    assert panel.compute().as_dict() == want
+
+    merged = clone_metric(fresh)
+    merged.merge_state([clone_metric(fresh)])
+    doubled = merged.compute().as_dict()
+    # ratio families are scale-invariant under a doubled stream
+    for alias in ("ctr", "cal", "conversions"):
+        for k, v in doubled[alias].items():
+            assert v == pytest.approx(want[alias][k], rel=1e-5)
+
+
+def test_panel_scrape_names_carry_the_alias():
+    panel = TablePanel([("a", "ctr"), ("b", "ctr")])
+    panel.ingest([3, 4], a={"clicks": np.ones(2, np.float32)},
+                 b={"clicks": np.zeros(2, np.float32)})
+    values = panel.scrape_values(limit=8)
+    assert set(values) == {
+        "value_a_3", "value_a_4", "value_b_3", "value_b_4",
+    }
+    assert values["value_a_3"] == 1.0 and values["value_b_3"] == 0.0
+
+
+def test_admission_gate_is_shared_by_the_panel_intake():
+    panel = TablePanel(
+        MEMBERS,
+        admission=AdmissionController(ServingBudget(), sample_p=0.3),
+    )
+    panel.admission_rung = 1
+    rng = np.random.default_rng(3)
+    n = 600
+    keys = rng.integers(0, 3000, n)
+    c = rng.integers(0, 2, n).astype(np.float32)
+    p = rng.uniform(0.1, 0.9, n).astype(np.float32)
+    t = rng.integers(0, 2, n).astype(np.float32)
+    panel.ingest(
+        keys,
+        ctr={"clicks": c},
+        cal={"preds": p, "targets": t},
+        ne={"preds": p, "targets": t},
+        conversions={"clicks": t},
+    )
+    # each row decided ONCE for all 4 families, not 4x
+    assert int(panel.admitted_rows_total) + int(panel.shed_rows_total) == n
+    assert 0 < int(panel.shed_rows_total) < n
+    # all four aliases report the same (admitted) key set
+    got = panel.compute().as_dict()
+    keysets = {alias: set(vals) for alias, vals in got.items()}
+    assert len(set(map(frozenset, keysets.values()))) == 1

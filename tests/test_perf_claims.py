@@ -1039,3 +1039,88 @@ def test_async_sync_table_matches_capture():
     assert float(m.group(1)) == pytest.approx(
         round(lat["blocking_over_off_p99"], 1), abs=0.05
     )
+
+
+AD = _load("bench_r19_admission_cpu_20260807.json")
+
+
+def test_admission_table_matches_capture():
+    """ISSUE 17: the round-19 overload-tolerance section in
+    docs/benchmarks.md traces to its committed capture, and the capture
+    itself satisfies the acceptance — 4-family one-intake panel within
+    1.3x single-family ingest, per-call p99 under a seeded 10x overload
+    within 2x unloaded, peak occupancy never past the shared budget,
+    Horvitz-Thompson sampled totals inside their 4-sigma CIs, zero
+    fresh programs across rung changes, and the forced-shed outbox
+    bounded under the unarmed inflow."""
+    text = _read("docs/benchmarks.md")
+    a = AD["admission"]["admission"]
+    panel, over = a["panel"], a["overload"]
+
+    m = re.search(
+        r"4-family panel over single-family ingest \| "
+        r"\*\*([\d.]+)×\*\* \(acceptance bound ≤ 1.3×\)",
+        text,
+    )
+    assert m, "r19 panel-fusion row not found"
+    assert float(m.group(1)) == pytest.approx(
+        panel["panel_over_single"], abs=0.005
+    )
+    m = re.search(
+        r"four separate tables over the one-intake panel \| "
+        r"\*\*([\d.]+)×\*\*",
+        text,
+    )
+    assert m, "r19 four-tables row not found"
+    assert float(m.group(1)) == pytest.approx(
+        panel["four_tables_over_panel"], abs=0.005
+    )
+    m = re.search(
+        r"per-call ingest p99, 10× overload over unloaded \| "
+        r"\*\*([\d.]+)×\*\* \(acceptance bound ≤ 2×\)",
+        text,
+    )
+    assert m, "r19 overload-p99 row not found"
+    assert float(m.group(1)) == pytest.approx(over["p99_ratio"], abs=0.005)
+    m = re.search(
+        r"peak slot occupancy under 10× key cardinality \| "
+        r"\*\*(\d+) of (\d+)\*\* budgeted slots",
+        text,
+    )
+    assert m, "r19 occupancy row not found"
+    assert int(m.group(1)) == over["peak_occupancy"]
+    assert int(m.group(2)) == over["max_keys_budget"]
+    m = re.search(
+        r"undrained world-4 outbox, forced shed vs unarmed \| "
+        r"\*\*([\d,]+)\*\* vs ([\d,]+) entries",
+        text,
+    )
+    assert m, "r19 outbox row not found"
+    assert int(m.group(1).replace(",", "")) == (
+        over["outbox_entries"]["armed_shed"]
+    )
+    assert int(m.group(2).replace(",", "")) == (
+        over["outbox_entries"]["unarmed"]
+    )
+    m = re.search(
+        r"fresh programs across rung changes 0→1→2→1→0 \| \*\*(\d+)\*\*",
+        text,
+    )
+    assert m, "r19 retrace row not found"
+    assert int(m.group(1)) == a["retrace"]["programs_across_rung_changes"]
+    for s in a["sampling"]:
+        pct = f"{s['rel_err'] * 100:g}"
+        assert re.search(
+            rf"p={s['p']:g} \| {re.escape(pct)}% rel\. err", text
+        ), f"r19 sampling row for p={s['p']} not found"
+
+    # the capture itself must satisfy the ISSUE acceptance
+    assert all(a["acceptance"].values()), a["acceptance"]
+    assert panel["panel_over_single"] <= 1.3
+    assert over["p99_ratio"] <= 2.0
+    assert over["peak_occupancy"] <= over["max_keys_budget"]
+    assert a["retrace"]["programs_across_rung_changes"] == 0
+    for s in a["sampling"]:
+        assert s["rel_err"] <= s["ci_bound_rel"]
+    assert AD["admission"]["value"] <= 1.3
+    assert AD["admission"]["lower_is_better"] is True

@@ -90,6 +90,7 @@ def _program_smoke() -> Report:
         combined.extend(verify_metric_compute(metric))
         combined.extend(verify_metric_merge(metric))
     combined.extend(_table_ingest_smoke())
+    combined.extend(_admission_smoke())
     combined.extend(_flight_lockstep_smoke())
     combined.extend(_quality_smoke())
     combined.extend(_federation_lockstep_smoke())
@@ -335,6 +336,92 @@ def _table_ingest_smoke() -> Report:
         if report is not None:
             combined.extend(report)
         combined.extend(verify_metric_compute(table))
+    return combined
+
+
+def _admission_smoke() -> Report:
+    """ISSUE 17 tentpole: admission-armed one-intake panel ingest.
+
+    With an :class:`~torcheval_tpu.table.AdmissionController` armed over
+    a 4-family :class:`~torcheval_tpu.table.TablePanel`, the warmed
+    fused ingest program must verify exactly like the unarmed table —
+    zero collectives, no host escapes, donation-sound (the admission
+    gate is host-side; the only traced addition is the per-row
+    Horvitz-Thompson ``inv_weight`` scale). Also proves the off-gate: a
+    disarmed table's update plan IS the baseline plan — the same cached
+    ingest-kernel object, no extra dynamic argument."""
+    import numpy as np
+
+    from torcheval_tpu.analysis.program import (
+        verify_metric_compute,
+        verify_metric_update,
+    )
+    from torcheval_tpu.analysis.report import Finding
+    from torcheval_tpu.metrics import ShardContext
+    from torcheval_tpu.table import (
+        AdmissionController,
+        MetricTable,
+        ServingBudget,
+        TablePanel,
+    )
+
+    rng = np.random.default_rng(17)
+    keys = rng.integers(0, 64, 32)
+    clicks = rng.integers(0, 2, 32).astype(np.float32)
+    preds = rng.uniform(0.05, 0.95, 32).astype(np.float32)
+    targets = rng.integers(0, 2, 32).astype(np.float32)
+    combined = Report(tool="program")
+
+    panel = TablePanel(
+        ["ctr", "weighted_calibration", "ne", ("hits", "hit_rate")],
+        shard=ShardContext(1, 4),
+        admission=AdmissionController(
+            ServingBudget(max_keys=256), sample_p=0.5
+        ),
+    )
+    scores = rng.random((32, 8)).astype(np.float32)
+    ranks = rng.integers(0, 8, 32)
+    bundle = dict(
+        ctr={"clicks": clicks},
+        weighted_calibration={"preds": preds, "targets": targets},
+        ne={"preds": preds, "targets": targets},
+        hits={"scores": scores, "targets": ranks},
+    )
+    # warm the host intake so the verified program is steady-state
+    panel.ingest(keys, **bundle)
+    report = verify_metric_update(panel, keys, **bundle)
+    if report is not None:
+        combined.extend(report)
+    combined.extend(verify_metric_compute(panel))
+
+    # off-gate: never-armed vs armed-then-disarmed plans are identical
+    baseline = MetricTable("ctr", shard=ShardContext(1, 4))
+    toggled = MetricTable(
+        "ctr",
+        shard=ShardContext(1, 4),
+        admission=AdmissionController(ServingBudget(max_keys=256)),
+    )
+    toggled.disarm_admission()
+    base_plan = baseline._update_plan(keys, clicks)
+    off_plan = toggled._update_plan(keys, clicks)
+    combined.checked += 1
+    if (
+        off_plan.kernel is not base_plan.kernel
+        or len(off_plan.dynamic) != len(base_plan.dynamic)
+        or off_plan.batch_axes != base_plan.batch_axes
+    ):
+        combined.findings.append(
+            Finding(
+                tool="program",
+                rule="admission-off-gate",
+                path="<table update plan>",
+                message=(
+                    "a disarmed table's update plan must be the "
+                    "baseline plan (same cached ingest kernel, no "
+                    "inv_weight operand), got a rewritten plan"
+                ),
+            )
+        )
     return combined
 
 

@@ -62,6 +62,7 @@ DEFAULT_TARGETS = (
     "elastic.py",
     "federation.py",
     "syncplane.py",
+    "table",
     os.path.join("utils", "checkpoint.py"),
 )
 

@@ -917,7 +917,16 @@ class ElasticSession:
         shard (``_reshard_to_own``): slices partition the cells, so
         globally every contribution survives exactly once. At an
         UNCHANGED world size the per-rank shard is self-describing and
-        loads directly (no logical materialization)."""
+        loads directly (no logical materialization).
+
+        Admission-ladder state (``admission_rung`` / ``admission_epoch``
+        and the admitted/shed counters on a table armed with an
+        :class:`~torcheval_tpu.table.AdmissionController`) rides this
+        path as ordinary registered states: the shard merge folds rungs
+        by max, so a world restored at any new size resumes on the SAME
+        rung and epoch and sheds bit-identically to the world that
+        checkpointed (admission decisions are pure functions of
+        ``(key hash, epoch, rung)`` — no RNG state to carry)."""
         from torcheval_tpu.metrics.toolkit import (
             _restore_state_types,
             clone_metric,

@@ -767,6 +767,26 @@ class Federation:
             )
         return out
 
+    def exchange_interval(self, base: int) -> int:
+        """Steps between federation rounds under the current admission
+        ladder: ``base`` while ingest is healthy, halved per armed
+        degradation rung (``base >> rung``, floor 1).
+
+        Overload and WAN cadence pull the SAME lever in opposite
+        directions: an overloaded region is exactly the one whose
+        pending outbox/staleness grows fastest, so when any local table
+        escalates (:func:`torcheval_tpu.table.shedding_status`) the
+        region drains MORE often — shrinking both its own memory
+        pressure and the staleness its peers observe. Callers that run
+        ``exchange()`` on a step cadence poll this between rounds; the
+        decision is per-region local state, no collective."""
+        from torcheval_tpu.table._admission import max_armed_rung
+
+        base = int(base)
+        if base < 1:
+            raise ValueError(f"base interval must be >= 1, got {base}")
+        return max(1, base >> max_armed_rung())
+
     # -------------------------------------------------------------- exchange
 
     def exchange(

@@ -1108,9 +1108,11 @@ class Metric(Generic[TComputeReturn], ABC):
         # a provenance left by a prior (possibly degraded) sync — and the
         # observability step cursor stamped by the last recorded update —
         # describe state this reset just discarded; they must not outlive
-        # it (same stale-attribute class as the PR 4 sync_provenance fix)
+        # it (same stale-attribute class as the PR 4 sync_provenance fix);
+        # admission-ladder provenance describes the discarded stream too
         self.__dict__.pop("sync_provenance", None)
         self.__dict__.pop("obs_step", None)
+        self.__dict__.pop("admission_provenance", None)
         # ... and any PUBLISHED snapshot of it is now a lie: bump the
         # state epoch so a sync plane discards pre-reset merged values
         self._state_epoch = self._state_epoch + 1
@@ -1207,9 +1209,12 @@ class Metric(Generic[TComputeReturn], ABC):
         # restored state replaces whatever a prior sync produced: drop the
         # stale provenance (the sync path re-attaches its own afterwards)
         # and the stale observability step cursor alike — and invalidate
-        # any published sync-plane snapshot of the replaced state
+        # any published sync-plane snapshot of the replaced state. The
+        # admission-ladder provenance is stamped per compute() on the
+        # stream the restored state replaces, so it goes too.
         self.__dict__.pop("sync_provenance", None)
         self.__dict__.pop("obs_step", None)
+        self.__dict__.pop("admission_provenance", None)
         self._state_epoch = self._state_epoch + 1
 
     # ---------------------------------------------------------------- devices

@@ -58,6 +58,7 @@ __all__ = [
     "ShardInfo",
     "enable_routing",
     "enable_value_routing",
+    "ht_scale",
     "route_scatter_kernel",
     "route_scatter_kernel_masked",
     "route_scatter_values_kernel",
@@ -635,6 +636,23 @@ def route_scatter_values_kernel_masked(
 
     _ROUTE_KERNEL_CACHE[key] = transform
     return transform
+
+
+def ht_scale(payload, inv_weight):
+    """Horvitz–Thompson reweighting on the float value lane: scale every
+    per-row payload column by the row's inverse inclusion probability
+    (``1/p`` for sampled rows, 1 for always-admitted priority rows).
+    Because every table/value-lane column is a LINEAR sufficient
+    statistic (a sum over rows), scaling rows by ``1/p`` makes each
+    accumulated column an unbiased estimator of its full-ingest value —
+    the property the admission ladder (``table._admission``) leans on to
+    degrade *measured*, not *wrong*. Traced inside the fused ingest
+    kernel; ``inv_weight`` rides as a per-row dynamic argument so rung
+    changes never retrace."""
+    return tuple(
+        p.astype(jnp.float32) * inv_weight.astype(jnp.float32)
+        for p in payload
+    )
 
 
 def complete_bounds(bounds, cnt: int):

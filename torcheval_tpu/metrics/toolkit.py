@@ -261,6 +261,24 @@ def _wrap_collection(metric: MetricOrReplicas):
     return {"_metric": metric}
 
 
+def _with_admission(provenance: SyncProvenance, metric: Metric) -> SyncProvenance:
+    """Stamp a metric table's admission ladder onto its provenance.
+
+    Per-metric: one synced collection may mix armed tables with plain
+    metrics, so the shared sync provenance is specialized per target.
+    Unarmed metrics (and every non-table) keep the appended defaults
+    (``sampled_fraction=1.0``, rung/epoch 0 = full ingest)."""
+    controller = getattr(metric, "_admission", None)
+    if controller is None:
+        return provenance
+    rung = int(metric.admission_rung)
+    return provenance._replace(
+        sampled_fraction=float(controller.sampled_fraction(rung)),
+        admission_rung=rung,
+        admission_epoch=int(metric.admission_epoch),
+    )
+
+
 def get_synced_metric_collection(
     metrics: Union[Dict[str, Metric], List[Dict[str, Metric]]],
     process_group: Optional[ProcessGroup] = None,
@@ -284,7 +302,7 @@ def get_synced_metric_collection(
             policy=getattr(group, "degradation_policy", "raise"),
         )
         for m in coll.values():
-            m.sync_provenance = provenance
+            m.sync_provenance = _with_admission(provenance, m)
         return coll
 
     if group.world_size == 1 and not _is_local_replica(group):
@@ -302,7 +320,7 @@ def get_synced_metric_collection(
             policy=getattr(group, "degradation_policy", "raise"),
         )
         for m in coll.values():
-            m.sync_provenance = provenance
+            m.sync_provenance = _with_admission(provenance, m)
         return coll
 
     if _is_local_replica(group):
@@ -403,7 +421,7 @@ def get_synced_metric_collection(
             rank_metrics.append(clone)
         target = rank_metrics[0].to(base.device)
         target.merge_state(rank_metrics[1:])
-        target.sync_provenance = provenance
+        target.sync_provenance = _with_admission(provenance, target)
         merged[name] = target
     return merged
 
@@ -518,8 +536,11 @@ def adopt_synced(
                 commit()
             # read the provenance BEFORE loading: on the world-1 fast
             # path `synced` IS the working metric, and load_state_dict
-            # drops the stale-provenance attribute
-            provenance = synced.sync_provenance
+            # drops the stale-provenance attribute. Re-stamp admission
+            # fields AFTER the commit — that is where the degradation
+            # ladder steps, and the adopted provenance must carry the
+            # rung the NEXT epoch ingests under.
+            provenance = _with_admission(synced.sync_provenance, synced)
             metric[name].load_state_dict(synced.state_dict())
             metric[name].sync_provenance = provenance
         return synced_coll
@@ -542,8 +563,10 @@ def adopt_synced(
         commit()
     payload = synced.state_dict()
     # read before loading: on the world-1 fast path `synced` IS the
-    # working metric, and load_state_dict drops the stale provenance
-    provenance = synced.sync_provenance
+    # working metric, and load_state_dict drops the stale provenance.
+    # Admission fields are re-stamped post-commit (the ladder steps
+    # inside _pre_adopt_commit).
+    provenance = _with_admission(synced.sync_provenance, synced)
     for m in targets:
         m.load_state_dict(payload)
         m.sync_provenance = provenance

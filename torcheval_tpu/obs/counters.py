@@ -84,6 +84,12 @@ def _flight_source() -> Dict[str, Any]:
     return FLIGHT.counters()
 
 
+def _admission_source() -> Dict[str, Any]:
+    from torcheval_tpu.table._admission import armed_counter_source
+
+    return armed_counter_source()
+
+
 def _events_source() -> Dict[str, Any]:
     from torcheval_tpu.obs.recorder import RECORDER
 
@@ -185,5 +191,8 @@ def default_registry() -> CounterRegistry:
             # flight-recorder ring stats (ISSUE 11); the watchdog and
             # SLO monitor register "watchdog"/"slo" sources when armed
             registry.register("flight", _flight_source)
+            # overload admission ladder across armed metric tables
+            # (worst rung wins; zeros while nothing is armed)
+            registry.register("admission", _admission_source)
             _DEFAULT = registry
         return _DEFAULT

@@ -373,9 +373,30 @@ class AlertEvent(Event):
     message: str = ""
 
 
+@dataclass
+class AdmissionEvent(Event):
+    """One admission-ladder rung transition (``table._admission``): the
+    drain-time controller stepped ``prev_rung → rung`` on merged
+    pressure. ``sampled_fraction`` is the NEW rung's admission
+    probability; ``epoch`` the drain epoch at which it takes effect.
+    Recorded once per transition per rank (transitions are computed on
+    merged state, so every rank records the same step)."""
+
+    kind: ClassVar[str] = "admission"
+
+    table: str = ""
+    prev_rung: int = 0
+    rung: int = 0
+    rung_name: str = "full"
+    pressure: float = 0.0
+    sampled_fraction: float = 1.0
+    epoch: int = 0
+
+
 _EVENT_TYPES: Dict[str, Type[Event]] = {
     cls.kind: cls
     for cls in (
+        AdmissionEvent,
         AlertEvent,
         DriftEvent,
         AnalysisEvent,

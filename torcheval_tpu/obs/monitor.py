@@ -21,6 +21,13 @@ off the step path:
   over an error/total counter pair (the classic error-budget form:
   alert when the windowed error rate burns the budget ``bound`` times
   too fast).
+- **Admission pressure**: a metric table armed with an
+  :class:`~torcheval_tpu.table.AdmissionController` feeds its measured
+  ingest pressure into the ``admission/pressure`` series at every drain
+  commit, so drift alerting covers the overload signal itself; the
+  ladder's counters (``admission`` registry source: rung,
+  ``sampled_fraction``, admitted/shed totals) are SLO-able like any
+  other counter.
 
 Alerts are typed :class:`~torcheval_tpu.obs.events.AlertEvent`\\ s — they
 ride the event ring/JSONL when the recorder is on — and the active-alert

@@ -47,9 +47,13 @@ __all__ = [
 
 def healthz_payload() -> Dict[str, Any]:
     """The ``/healthz`` body: watchdog + flight + quorum/sync +
-    federation-staleness + sync-plane-staleness + alert status with an
-    overall ``status`` of ``ok`` / ``stalled`` / ``stale-region`` /
-    ``stale-plane`` / ``alerting`` / ``degraded`` (first match wins;
+    federation-staleness + sync-plane-staleness + admission-ladder +
+    alert status with an overall ``status`` of ``ok`` / ``stalled`` /
+    ``stale-region`` / ``stale-plane`` / ``alerting`` / ``shedding`` /
+    ``degraded`` (first match wins; ``shedding`` — an armed
+    :class:`~torcheval_tpu.table.AdmissionController` above the full
+    rung — does NOT fail the probe: a shedding intake still serves
+    reweighted numbers;
     ``stalled``, ``stale-region``, ``stale-plane`` and ``alerting`` fail
     the probe — a region staler than the federation's ``staleness_503``
     bound means the "global" numbers this process serves silently
@@ -113,6 +117,9 @@ def healthz_payload() -> Dict[str, Any]:
     if pln is not None:
         stale_plane = pln.stale_for_healthz()
         plane = {"armed": 1, **pln.staleness()}
+    from torcheval_tpu.table._admission import shedding_status
+
+    admission = shedding_status()
     stalled = wd is not None and wd.tripped
     degraded = bool(sync["consecutive_missing"])
     if stalled:
@@ -123,6 +130,12 @@ def healthz_payload() -> Dict[str, Any]:
         status = "stale-plane"
     elif alerts:
         status = "alerting"
+    elif admission["shedding"]:
+        # overload degradation is GRACEFUL by design: a shedding intake
+        # still serves (Horvitz-Thompson reweighted) numbers, so the
+        # probe stays 200 — but the rung is visible to dashboards and
+        # the status string tells an operator why variance grew
+        status = "shedding"
     elif degraded:
         status = "degraded"
     else:
@@ -136,6 +149,7 @@ def healthz_payload() -> Dict[str, Any]:
         "sync": sync,
         "federation": federation,
         "syncplane": plane,
+        "admission": admission,
         "alerts": alerts,
     }
 

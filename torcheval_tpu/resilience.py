@@ -170,6 +170,17 @@ class SyncProvenance(NamedTuple):
     version: int = 0  # plane merge version this read observed (0 = blocking)
     rounds_behind: int = 0  # publish generations newer than this version
     wall_age_seconds: float = 0.0  # age of the merged snapshot at read time
+    # admission-control triple (appended-defaulted-field discipline, like
+    # the staleness triple above): a metric table armed with an
+    # :class:`torcheval_tpu.table.AdmissionController` stamps the ladder
+    # rung its merged state was ingested under, so every consumer of a
+    # synced value can see whether it reflects full ingest or a sampled /
+    # shedding regime (Horvitz-Thompson reweighted — aggregates stay
+    # unbiased, but variance grows as ``sampled_fraction`` shrinks).
+    # Defaults read "full ingest" for every non-table / unarmed metric.
+    sampled_fraction: float = 1.0  # Bernoulli keep probability at this rung
+    admission_rung: int = 0  # 0=full 1=sampled 2=priority-shed
+    admission_epoch: int = 0  # drain epoch the rung last changed
 
 
 @dataclass

@@ -1,15 +1,34 @@
-"""Keyed multi-tenant metric table (ROADMAP item 3) — see ``table.py``
-for the subsystem docstring and docs/metric-table.md for the guide."""
+"""Keyed multi-tenant metric table (ROADMAP items 3 & 4) — see
+``table.py`` for the subsystem docstring, ``panel.py`` for one-intake
+multi-family panels, ``_admission.py`` for overload admission control,
+and docs/metric-table.md for the guide."""
 
+from torcheval_tpu.table._admission import (
+    RUNG_NAMES,
+    AdmissionController,
+    AdmissionProvenance,
+    ServingBudget,
+    admission_keep,
+    shedding_status,
+)
 from torcheval_tpu.table._families import FAMILIES, TableFamily
 from torcheval_tpu.table._hash import hash_keys, owner_of
+from torcheval_tpu.table.panel import PanelValues, TablePanel
 from torcheval_tpu.table.table import MetricTable, TableValues
 
 __all__ = [
     "FAMILIES",
+    "AdmissionController",
+    "AdmissionProvenance",
     "MetricTable",
+    "PanelValues",
+    "RUNG_NAMES",
+    "ServingBudget",
     "TableFamily",
+    "TablePanel",
     "TableValues",
+    "admission_keep",
     "hash_keys",
     "owner_of",
+    "shedding_status",
 ]

@@ -245,6 +245,16 @@ FAMILIES: Dict[str, TableFamily] = {
         compute=_ne_compute,
         window=1,  # placeholder; resolve_family applies the window size
     ),
+    # cumulative (windowless) NE — same rows/compute as windowed_ne with
+    # no epoch ring, so it can join a TablePanel next to the other
+    # cumulative families (panels require one shared window policy)
+    "ne": TableFamily(
+        name="ne",
+        fields=("total_entropy", "num_examples", "num_positive"),
+        prepare=_ne_prepare,
+        row_kernel=_ne_rows,
+        compute=_ne_compute,
+    ),
 }
 
 
@@ -267,8 +277,9 @@ def resolve_family(family, **kwargs) -> Tuple[TableFamily, Dict[str, Any]]:
         if k is not None and int(k) <= 0:
             raise ValueError(f"k should be None or positive, got {k}.")
         attrs["k"] = None if k is None else int(k)
-    if fam.name == "windowed_ne":
+    if fam.name in ("windowed_ne", "ne"):
         attrs["from_logits"] = bool(kwargs.pop("from_logits", False))
+    if fam.name == "windowed_ne":
         window = int(kwargs.pop("window", 16))
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")

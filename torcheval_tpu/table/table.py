@@ -54,6 +54,13 @@ import numpy as np
 
 from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 from torcheval_tpu.metrics.shardspec import ShardContext
+from torcheval_tpu.table._admission import (
+    RUNG_NAMES,
+    AdmissionController,
+    AdmissionProvenance,
+    _register_armed,
+    _unregister_armed,
+)
 from torcheval_tpu.table._families import TableFamily, resolve_family
 from torcheval_tpu.table._hash import (
     SENTINEL,
@@ -208,6 +215,33 @@ def _ingest_kernel(
     return transform
 
 
+# one stable wrapper per row kernel (same identity discipline as
+# _INGEST_KERNEL_CACHE: the jit cache keys on the kernel object)
+_ADMISSION_KERNEL_CACHE: Dict[Any, Any] = {}
+
+
+def _admission_row_kernel(row_kernel):
+    """Wrap a family row kernel with the Horvitz–Thompson reweight: the
+    admission-armed ingest passes a per-row ``inv_weight`` vector as the
+    leading family argument and every payload column is scaled by it
+    (``shardspec.ht_scale`` — the float value lane), keeping admitted
+    rows unbiased estimators of the full stream. The wrapper is cached
+    so the armed table runs ONE stable program across rung changes."""
+    fn = _ADMISSION_KERNEL_CACHE.get(row_kernel)
+    if fn is not None:
+        return fn
+    from torcheval_tpu.metrics.shardspec import ht_scale
+
+    def wrapped(inv_weight, *fam_args):
+        payload = row_kernel(*fam_args)
+        if not isinstance(payload, tuple):
+            payload = (payload,)
+        return ht_scale(payload, inv_weight)
+
+    _ADMISSION_KERNEL_CACHE[row_kernel] = wrapped
+    return wrapped
+
+
 class MetricTable(Metric[TableValues]):
     """A hash-partitioned keyed collection of per-key metric states.
 
@@ -227,6 +261,10 @@ class MetricTable(Metric[TableValues]):
             hash — deterministic on the merged state).
         repr_limit: per-rank cap on retained original-key reprs (scrape
             labels; unmapped keys render as their hex hash).
+        admission: an :class:`~torcheval_tpu.table.AdmissionController`
+            to arm at construction (equivalent to
+            :meth:`arm_admission`; its budget's ``max_keys`` installs
+            the shared eviction bound).
         **family_kwargs: family knobs (``k=`` for hit_rate,
             ``window=``/``from_logits=`` for windowed_ne).
 
@@ -256,6 +294,7 @@ class MetricTable(Metric[TableValues]):
         ttl: Optional[int] = None,
         max_keys: Optional[int] = None,
         repr_limit: int = 4096,
+        admission: Optional[AdmissionController] = None,
         device: Optional[Any] = None,
         **family_kwargs: Any,
     ) -> None:
@@ -326,11 +365,25 @@ class MetricTable(Metric[TableValues]):
         self._add_state("global_keys", 0, merge=MergeKind.CUSTOM)
         self._add_state("inserts_total", 0, merge=MergeKind.CUSTOM)
         self._add_state("evictions_total", 0, merge=MergeKind.CUSTOM)
+        # admission-ladder states (persisted/synced/merged like the rest
+        # of the host bookkeeping, so elastic resume and drains carry
+        # the rung + epoch and a restored world sheds identically; all
+        # zero while no controller is armed)
+        self._add_state("admission_rung", 0, merge=MergeKind.CUSTOM)
+        self._add_state("admission_calm", 0, merge=MergeKind.CUSTOM)
+        self._add_state("admission_epoch", 0, merge=MergeKind.CUSTOM)
+        self._add_state("admitted_rows_total", 0, merge=MergeKind.CUSTOM)
+        self._add_state("shed_rows_total", 0, merge=MergeKind.CUSTOM)
+        self._add_state("admission_transitions", 0, merge=MergeKind.CUSTOM)
+        self._add_state("pressure_peak", 0.0, merge=MergeKind.CUSTOM)
         # carrier descriptor (the _shard_rank/_shard_world discipline):
         # >= 0 while the live slots hold one rank's owned keys; -1 after
         # a reassembling merge desharded the table to the logical union
         self._add_state("_owner_rank", int(self.rank), merge=MergeKind.CUSTOM)
         self._add_state("_owner_world", int(self.world), merge=MergeKind.CUSTOM)
+        self._admission: Optional[AdmissionController] = None
+        if admission is not None:
+            self.arm_admission(admission)
 
     # ------------------------------------------------------------ properties
 
@@ -341,6 +394,51 @@ class MetricTable(Metric[TableValues]):
 
     def _is_carrier(self) -> bool:
         return int(self._owner_rank) >= 0
+
+    # --------------------------------------------------- admission control
+
+    @property
+    def admission(self) -> Optional[AdmissionController]:
+        """The armed controller (``None`` = admit everything)."""
+        return self._admission
+
+    def arm_admission(
+        self, controller: AdmissionController
+    ) -> "MetricTable":
+        """Arm overload admission control on this table's intake.
+
+        The controller's ``budget.max_keys`` (when set) installs the
+        SHARED occupancy bound: the drain-time evictor and the admission
+        pressure signal read the same number, so admission bounds the
+        inflow while eviction bounds the stock. Every rank of a sharded
+        table must arm an identically-configured controller (rung
+        transitions are computed rank-locally on merged state). The
+        armed table registers on the process-wide admission registry —
+        ``/healthz`` gains the ``shedding`` rung and the ``admission``
+        counter source reports it."""
+        if not isinstance(controller, AdmissionController):
+            raise TypeError(
+                "arm_admission expects an AdmissionController, got "
+                f"{type(controller).__name__}"
+            )
+        budget_keys = controller.budget.max_keys
+        if budget_keys is not None:
+            self.max_keys = (
+                int(budget_keys)
+                if self.max_keys is None
+                else min(int(self.max_keys), int(budget_keys))
+            )
+        self._admission = controller
+        self._admission_calls = 0
+        _register_armed(self)
+        return self
+
+    def disarm_admission(self) -> "MetricTable":
+        """Return the intake to admit-everything (ladder states keep
+        their values for provenance; the eviction bound stays)."""
+        self._admission = None
+        _unregister_armed(self)
+        return self
 
     def _per_key_states(self) -> List[str]:
         names = ["slot_hi", "slot_lo", "last_seen"]
@@ -448,6 +546,29 @@ class MetricTable(Metric[TableValues]):
                     f"table ingest: {n} keys but a per-row argument has "
                     f"{int(np.shape(arg)[0])} rows"
                 )
+        # admission gate: a stateless splitmix64(key, epoch) Bernoulli
+        # keep mask sheds rows on the HOST before any slot growth,
+        # outbox reservation, or device work — overload never reaches
+        # the device program. Kept rows carry their Horvitz-Thompson
+        # 1/p reweight as a per-row dynamic argument.
+        ctrl = self._admission
+        inv_weight: Optional[np.ndarray] = None
+        if ctrl is not None:
+            keep, inv_weight = ctrl.decide(
+                hashed, int(self.epoch), int(self.admission_rung)
+            )
+            n_keep = int(keep.sum())
+            self.admitted_rows_total = int(self.admitted_rows_total) + n_keep
+            self.shed_rows_total = int(self.shed_rows_total) + (n - n_keep)
+            if n_keep < n:
+                keys = np.asarray(keys).reshape(-1)[keep]
+                hashed = hashed[keep]
+                inv_weight = inv_weight[keep]
+                fam_dynamic = tuple(
+                    np.asarray(arg)[keep] if labels else arg
+                    for arg, labels in zip(fam_dynamic, fam_axes)
+                )
+                n = n_keep
         # host intake: admit unseen OWNED keys (device programs only run
         # with every owned key resolvable), stamp reprs, reserve outbox
         owners = owner_of(hashed, self.world)
@@ -491,6 +612,13 @@ class MetricTable(Metric[TableValues]):
             self._repr_hashes = np.asarray(sorted(self._reprs), np.uint64)
         n_foreign = int((owners != self.rank).sum())
         self._ensure_outbox(n_foreign)
+        if ctrl is not None:
+            self.pressure_peak = max(
+                float(self.pressure_peak),
+                ctrl.local_pressure(
+                    self, pending_outbox=int(self.out_h) + n_foreign
+                ),
+            )
         khi, klo = split_planes(hashed)
         epoch = int(self.epoch)
         out_h = int(self.out_h)
@@ -507,17 +635,31 @@ class MetricTable(Metric[TableValues]):
             + ["last_seen", "out_hi", "out_lo", "out_val", "out_n"]
         )
         n_fields = len(self.family.fields)
+        # armed intake wraps the row kernel with the HT reweight and
+        # rides inv_weight as a per-row dynamic — same ONE stable
+        # program across rung changes (an unarmed table returns the
+        # exact baseline plan: same cached kernel object, no extra arg)
+        if ctrl is not None:
+            row_kernel = _admission_row_kernel(self.family.row_kernel)
+            admit_dynamic: Tuple[Any, ...] = (
+                np.asarray(inv_weight, np.float32),
+            )
+            admit_axes: Tuple[Any, ...] = (("n",),)
+        else:
+            row_kernel = self.family.row_kernel
+            admit_dynamic = ()
+            admit_axes = ()
         dynamic = (
             self.slot_hi,
             self.slot_lo,
             khi,
             klo,
             cached_index(epoch),
-        ) + tuple(fam_dynamic)
-        batch_axes = ((), (), ("n",), ("n",), ()) + fam_axes
+        ) + admit_dynamic + tuple(fam_dynamic)
+        batch_axes = ((), (), ("n",), ("n",), ()) + admit_axes + fam_axes
         return UpdatePlan(
             kernel=_ingest_kernel(
-                self.family.row_kernel,
+                row_kernel,
                 self.rank,
                 self.world,
                 n_fields,
@@ -530,7 +672,7 @@ class MetricTable(Metric[TableValues]):
             transform=True,
             finalize=finalize,
             masked_kernel=_ingest_kernel(
-                self.family.row_kernel,
+                row_kernel,
                 self.rank,
                 self.world,
                 n_fields,
@@ -568,8 +710,26 @@ class MetricTable(Metric[TableValues]):
             for f in self.family.fields
         }
         values = self.family.compute(cols)
+        self._stamp_admission_provenance()
         return TableValues(
             keys=self._keys.copy(), values=values, reprs=dict(self._reprs)
+        )
+
+    def _stamp_admission_provenance(self) -> None:
+        """Every armed ``compute()`` carries ladder provenance — the
+        "how degraded was this number" contract (dropped by ``reset()``
+        and ``load_state_dict()`` like ``sync_provenance``)."""
+        ctrl = self._admission
+        if ctrl is None:
+            return
+        rung = int(self.admission_rung)
+        self.admission_provenance = AdmissionProvenance(
+            rung=rung,
+            rung_name=RUNG_NAMES[rung],
+            sampled_fraction=ctrl.sampled_fraction(rung),
+            epoch=int(self.epoch),
+            admitted_rows=int(self.admitted_rows_total),
+            shed_rows=int(self.shed_rows_total),
         )
 
     # ----------------------------------------------------------------- merge
@@ -712,6 +872,24 @@ class MetricTable(Metric[TableValues]):
         self.evictions_total = max(
             (int(c.evictions_total) for c in carriers), default=0
         )
+        # admission ladder: rung/calm/epoch are identical on every rank
+        # after an adopt (max = that shared value); row totals follow
+        # the inserts_total MAX discipline; pressure_peak folds each
+        # rank's since-last-drain peak — the merged overload signal the
+        # drain-time ladder step consumes
+        for name in (
+            "admission_rung", "admission_calm", "admission_epoch",
+            "admitted_rows_total", "shed_rows_total",
+            "admission_transitions",
+        ):
+            setattr(
+                self,
+                name,
+                max((int(getattr(c, name)) for c in carriers), default=0),
+            )
+        self.pressure_peak = max(
+            (float(c.pressure_peak) for c in carriers), default=0.0
+        )
         reprs: Dict[int, Any] = {}
         for c in carriers:
             reprs.update(c._reprs)
@@ -739,8 +917,11 @@ class MetricTable(Metric[TableValues]):
 
         1. windowed families commit the pending epoch accumulators as one
            ring column per key WITH traffic this epoch;
-        2. the drain epoch advances;
-        3. TTL and occupancy eviction run (oldest ``last_seen`` first,
+        2. the armed admission ladder steps (escalate on merged pressure,
+           de-escalate after the hysteresis cooldown — identical on
+           every rank because inputs are merged state + shared config);
+        3. the drain epoch advances;
+        4. TTL and occupancy eviction run (oldest ``last_seen`` first,
            ties by ascending key hash).
         """
         n = int(self.n_keys)
@@ -769,8 +950,16 @@ class MetricTable(Metric[TableValues]):
             self.epochs_recorded = self.epochs_recorded.at[:n].add(
                 has.astype(jnp.int32)
             )
+        if self._admission is not None:
+            self._admission.commit(self)
         self.epoch = int(self.epoch) + 1
         self._evict()
+        # this table is the merged/logical view here (or a world-1
+        # working table, where local IS global): refresh the global key
+        # count to the post-eviction union so the next epoch's pressure
+        # and memory signals track the live stock, not the spike-era
+        # high-water mark
+        self.global_keys = int(self.n_keys)
 
     def _evict(self) -> None:
         """TTL + occupancy eviction on the logical table (see
@@ -853,9 +1042,12 @@ class MetricTable(Metric[TableValues]):
         sd["out_n"] = jnp.copy(self.out_n)
         for name in (
             "out_h", "n_keys", "epoch", "global_keys", "inserts_total",
-            "evictions_total", "_owner_rank", "_owner_world",
+            "evictions_total", "admission_rung", "admission_calm",
+            "admission_epoch", "admitted_rows_total", "shed_rows_total",
+            "admission_transitions", "_owner_rank", "_owner_world",
         ):
             sd[name] = int(getattr(self, name))
+        sd["pressure_peak"] = float(self.pressure_peak)
         sd["out_bounds"] = jnp.asarray(
             np.asarray(self._bounds, np.int32).reshape(-1)
         )
@@ -951,9 +1143,16 @@ class MetricTable(Metric[TableValues]):
         self.out_h = out_h
         self._keys = keys
         self.n_keys = n_live
-        for name in ("epoch", "inserts_total", "evictions_total"):
+        for name in (
+            "epoch", "inserts_total", "evictions_total",
+            "admission_rung", "admission_calm", "admission_epoch",
+            "admitted_rows_total", "shed_rows_total",
+            "admission_transitions",
+        ):
             if name in sd:
                 setattr(self, name, int(np.asarray(sd[name])))
+        if "pressure_peak" in sd:
+            self.pressure_peak = float(np.asarray(sd["pressure_peak"]))
         if owner_rank < 0:
             self._owner_rank = int(self.rank)
             self._owner_world = int(self.world)
@@ -969,6 +1168,7 @@ class MetricTable(Metric[TableValues]):
             self._set_reprs(repr_map)
         self.__dict__.pop("sync_provenance", None)
         self.__dict__.pop("obs_step", None)
+        self.__dict__.pop("admission_provenance", None)
         # replaced state invalidates any published sync-plane snapshot
         # (this override does not call super().load_state_dict)
         self._state_epoch = self._state_epoch + 1
@@ -1041,6 +1241,8 @@ class MetricTable(Metric[TableValues]):
         ``obs.CounterRegistry`` (pull-based; zero cost between scrapes)."""
         from torcheval_tpu.obs.memory import per_rank_state_bytes
 
+        rung = int(self.admission_rung)
+        ctrl = self._admission
         return {
             "occupancy": int(self.n_keys),
             "global_keys": max(int(self.global_keys), int(self.n_keys)),
@@ -1051,6 +1253,14 @@ class MetricTable(Metric[TableValues]):
             "outbox_entries": int(self.out_h),
             "per_rank_bytes": int(sum(per_rank_state_bytes(self).values())),
             "logical_bytes": int(sum(self._logical_state_nbytes().values())),
+            # admission ladder (all zero / 1.0 while unarmed)
+            "admission_rung": rung,
+            "sampled_fraction": (
+                1.0 if ctrl is None else ctrl.sampled_fraction(rung)
+            ),
+            "admitted_rows_total": int(self.admitted_rows_total),
+            "shed_rows_total": int(self.shed_rows_total),
+            "admission_transitions_total": int(self.admission_transitions),
         }
 
     def track(self, source: str = "metric_table", registry=None) -> None:
@@ -1104,6 +1314,17 @@ class MetricTable(Metric[TableValues]):
 
         def supplier():
             values = self.scrape_values(limit)
+            # overload gauges ride the same scrape: the measured shed
+            # fraction (rows dropped / rows offered, cumulative) and the
+            # live admitted key count — grammar-pinned in
+            # export.render_prometheus by tests/table/test_admission.py
+            offered = int(self.admitted_rows_total) + int(
+                self.shed_rows_total
+            )
+            values["shed_fraction"] = (
+                float(self.shed_rows_total) / offered if offered else 0.0
+            )
+            values["admitted_keys"] = float(self.n_keys)
             if observe_drift:
                 from torcheval_tpu.obs.monitor import current_monitor
 

@@ -17,6 +17,11 @@ from torcheval_tpu.utils.test_utils.fault_injection import (
 from torcheval_tpu.utils.test_utils.metric_class_tester import (
     MetricClassTester,
 )
+from torcheval_tpu.utils.test_utils.overload import (
+    OverloadBatch,
+    OverloadPhase,
+    OverloadSchedule,
+)
 from torcheval_tpu.utils.test_utils.schedule import (
     DeadlockError,
     DeterministicScheduler,
@@ -44,6 +49,9 @@ __all__ = [
     "corrupt_shard",
     "truncate_shard",
     "MetricClassTester",
+    "OverloadBatch",
+    "OverloadPhase",
+    "OverloadSchedule",
     "ThreadRankGroup",
     "ThreadWorld",
 ]
