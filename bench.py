@@ -3969,6 +3969,186 @@ def run_admission():
     }
 
 
+def run_wire_quant():
+    """Config 21: the quantized wire ladder's bytes x accuracy frontier.
+
+    ISSUE 18 acceptance: for each metric family and each rung of the
+    ``exact | bf16 | int8-blockwise`` ladder this config reports the
+    per-rank wire bytes, the max absolute STATE error of the packed
+    wire's roundtrip against the raw states, the codec's published hard
+    bound (``amax(block)/254``), and the absolute error of the world-4
+    synced ``compute()`` against the eager ``merge_state`` oracle. The
+    pins: the int8 rung ships >= 3x fewer payload bytes than exact on
+    every dense float family, every measured state error stays inside
+    the codec bound, and integer-counter states are BIT-exact at every
+    rung.
+    """
+    import copy
+
+    import jax
+    import numpy as np
+
+    from torcheval_tpu import config as te_config
+    from torcheval_tpu import wire
+    from torcheval_tpu.distributed import LocalReplicaGroup
+    from torcheval_tpu.metrics import (
+        BinaryAUROC,
+        Cat,
+        MulticlassAccuracy,
+        WindowedBinaryAUROC,
+    )
+    from torcheval_tpu.metrics import synclib
+    from torcheval_tpu.metrics.toolkit import sync_and_compute
+
+    world, n = 4, 2000
+
+    def auroc_feed(metric, rank):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(100 + rank)
+        metric.update(
+            jnp.asarray(rng.random(n).astype(np.float32)),
+            jnp.asarray((rng.random(n) < 0.5).astype(np.float32)),
+        )
+        return metric
+
+    def cat_feed(metric, rank):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(300 + rank)
+        metric.update(jnp.asarray(rng.normal(size=n).astype(np.float32)))
+        return metric
+
+    def acc_feed(metric, rank):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(200 + rank)
+        metric.update(
+            jnp.asarray(rng.uniform(size=(256, 8)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 8, size=256)),
+        )
+        return metric
+
+    families = {
+        "buffered_auroc": (lambda: BinaryAUROC(), auroc_feed, True),
+        "windowed_auroc": (
+            lambda: WindowedBinaryAUROC(max_num_samples=4096),
+            auroc_feed,
+            True,
+        ),
+        "cat": (lambda: Cat(), cat_feed, True),
+        "counters": (lambda: MulticlassAccuracy(), acc_feed, False),
+    }
+    block = te_config.wire_block_size()
+
+    per_family = {}
+    for name, (factory, feeder, is_float) in families.items():
+        replicas = [feeder(factory(), r) for r in range(world)]
+        states = replicas[0]._sync_state_dict()
+        payload = {"_m": states}
+        order = synclib.metrics_traversal_order(payload)
+        codec_bound = 0.0
+        for v in jax.tree_util.tree_leaves(states):
+            a = np.asarray(v)
+            if a.dtype.kind == "f" and a.nbytes > 1024:
+                codec_bound = max(
+                    codec_bound, wire.int8_error_bound(a, block)
+                )
+        oracle = copy.deepcopy(replicas[0])
+        oracle.merge_state([copy.deepcopy(r) for r in replicas[1:]])
+        oracle_value = np.asarray(oracle.compute())
+        group = LocalReplicaGroup(jax.devices()[:1] * world)
+        rungs = {}
+        for rung in wire.RUNGS:
+            meta, flat = synclib._pack_rank_states(payload, order, rung)
+            decoded = synclib._unpack_rank_states(
+                payload, order, meta, flat
+            )
+            state_err = 0.0
+            bit_exact = True
+            for (m_, s_), dec in (
+                (k, decoded[k[0]][k[1]]) for k in order
+            ):
+                raw = np.asarray(states[s_])
+                got = np.asarray(dec)
+                if not np.array_equal(got, raw):
+                    bit_exact = False
+                if raw.dtype.kind == "f" and raw.size:
+                    # measure over finite slots only (non-finite neutral
+                    # fill reconstructs exactly via the -128 sentinel
+                    # side list, and inf - inf would read NaN here);
+                    # non-finite slots must match bit-for-bit instead
+                    fin = np.isfinite(raw)
+                    state_err = max(
+                        state_err,
+                        float(
+                            np.max(np.abs(np.where(fin, got - raw, 0.0)))
+                        ),
+                    )
+                    assert np.array_equal(got[~fin], raw[~fin]), (
+                        name,
+                        s_,
+                        rung,
+                    )
+            with te_config.wire_ladder_mode(rung):
+                synced_value = np.asarray(
+                    sync_and_compute(
+                        [copy.deepcopy(r) for r in replicas], group
+                    )
+                )
+            rungs[rung] = {
+                "bytes_per_rank": int(flat.size),
+                "max_abs_state_err": state_err,
+                "bit_exact": bit_exact,
+                "compute_abs_err": float(
+                    np.max(np.abs(synced_value - oracle_value))
+                ),
+            }
+        exact_b = rungs["exact"]["bytes_per_rank"]
+        int8_b = rungs["int8"]["bytes_per_rank"]
+        per_family[name] = {
+            "float_family": is_float,
+            "codec_bound": codec_bound,
+            "rungs": rungs,
+            "int8_reduction_x": round(exact_b / max(int8_b, 1), 2),
+        }
+
+    float_names = [k for k, v in per_family.items() if v["float_family"]]
+    acceptance = {
+        "int8_3x_on_all_float_families": all(
+            per_family[k]["rungs"]["int8"]["bytes_per_rank"] * 3
+            <= per_family[k]["rungs"]["exact"]["bytes_per_rank"]
+            for k in float_names
+        ),
+        "float_families_counted": len(float_names),
+        "state_err_within_codec_bound": all(
+            per_family[k]["rungs"]["int8"]["max_abs_state_err"]
+            <= per_family[k]["codec_bound"]
+            for k in float_names
+        ),
+        "exact_rung_bit_exact": all(
+            v["rungs"]["exact"]["bit_exact"] for v in per_family.values()
+        ),
+        "counters_bit_exact_at_every_rung": all(
+            per_family["counters"]["rungs"][r]["bit_exact"]
+            for r in wire.RUNGS
+        ),
+    }
+    wa = per_family["windowed_auroc"]
+    return {
+        "metric": (
+            "quantized wire ladder: int8-blockwise payload reduction vs "
+            "exact (windowed-AUROC family, world 4)"
+        ),
+        "value": wa["int8_reduction_x"],
+        "unit": "x fewer wire bytes than exact (higher is better)",
+        "lower_is_better": False,
+        "block_size": block,
+        "families": per_family,
+        "acceptance": acceptance,
+    }
+
+
 CONFIGS = {
     "accuracy_update": (run_accuracy_update, "ref_accuracy_update"),
     "auroc_compute": (run_auroc_compute, "ref_auroc_compute"),
@@ -3989,6 +4169,7 @@ CONFIGS = {
     "region_sync": (run_region_sync, None),  # cross-region federation audit
     "async_sync": (run_async_sync, None),  # zero-stall sync plane audit
     "admission": (run_admission, None),  # overload-tolerant intake audit
+    "wire_quant": (run_wire_quant, None),  # quantized-wire-ladder audit
 }
 
 _NO_REF_NOTES = {
@@ -4052,6 +4233,10 @@ _NO_REF_NOTES = {
         "admission layer, so the comparisons are our own single-family "
         "table and our own unarmed/unloaded arms"
     ),
+    "wire_quant": (
+        "quantized-wire audit — the reference has no wire codec, so the "
+        "comparison is our own exact-rung payload per family"
+    ),
 }
 
 REF_FNS = {
@@ -4083,7 +4268,7 @@ def _cache_env(env):
 _SINGLE_DEVICE_CONFIGS = {
     "accuracy_update", "auroc_compute", "text_eval", "fid", "kernels",
     "variable_batch", "sharded_state", "monitoring", "metric_table",
-    "quality", "region_sync", "async_sync", "admission",
+    "quality", "region_sync", "async_sync", "admission", "wire_quant",
 }
 
 

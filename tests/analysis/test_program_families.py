@@ -369,3 +369,14 @@ def test_replicated_vs_sharded_sync_collective_sequences_differ_as_declared():
         expect_hlo_collectives=["all-reduce"],
     )
     assert report.ok, "\n" + report.format_text()
+
+
+def test_wire_quant_smoke_has_no_findings():
+    """ISSUE 18: the CLI ``--programs`` arm's quantized-sync smoke —
+    int8 in-jit sync adds zero collectives over exact, no host escapes,
+    donated carry stays alias-sound — must hold on the 8-device mesh."""
+    from torcheval_tpu.analysis.__main__ import _wire_quant_smoke
+
+    report = _wire_quant_smoke()
+    assert report.ok, "\n" + report.format_text()
+    assert report.checked >= 5

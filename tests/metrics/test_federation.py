@@ -1185,3 +1185,87 @@ def test_non_member_handle_is_inert():
     coll = _make_metrics()
     assert fed2.exchange(coll) is coll
     assert not fed2.stale_for_healthz()
+
+
+# ---------------------------------------------------------------------------
+# The quantized WAN wire (ISSUE 18): int8 rung stays epoch-idempotent
+# ---------------------------------------------------------------------------
+
+
+def _make_dense_float():
+    """One big dense float family (rides int8) + one tiny counter
+    (stays exact under any rung — below the lossy byte floor)."""
+    return {"cat": M.Cat(), "sum": M.Sum()}
+
+
+def _update_dense_float(coll, rank, rnd):
+    rng = np.random.default_rng(7000 + 100 * rank + rnd)
+    x = jnp.asarray(rng.normal(size=512).astype(np.float32))
+    coll["cat"].update(x)
+    coll["sum"].update(x)
+
+
+def test_partition_heal_at_int8_rung_is_epoch_idempotent():
+    """ISSUE 18: the WAN wire at the int8 rung keeps the federation's
+    exactly-once discipline — a partitioned-then-healed chaos run with
+    duplicate delivery converges per-rank BIT-identical to the
+    fault-free federation run at the same rung. Replacement-by-max-epoch
+    of cumulative snapshots makes the lossy wire deterministic (a healed
+    replay re-ships the same quantized bytes; the crc pins the canonical
+    post-dequantize payload), so chaos cannot compound quantization
+    error."""
+    from torcheval_tpu import config as te_config
+
+    rounds = 6
+    faults = [
+        LinkFaultSpec("us", "eu", 0, "duplicate", times=4),
+        LinkFaultSpec("eu", "us", 1, "duplicate", times=4),
+    ]
+    chaos = ChaosLinkTransport(InProcessLinkBus(), faults)
+
+    def round_hook(rnd):
+        if rnd == 2:
+            chaos.partition_both("us", "eu")
+        if rnd == 4:
+            chaos.heal_both("us", "eu")
+
+    with te_config.wire_ladder_mode("int8"):
+        (chaotic, feds) = _run_federation(
+            4,
+            REGIONS_2X2,
+            rounds,
+            transport=chaos,
+            settle=3,
+            round_hook=round_hook,
+            make=_make_dense_float,
+            update=_update_dense_float,
+        )
+        h = (
+            feds[0].link_health("eu").duplicates
+            + feds[2].link_health("us").duplicates
+        )
+        (clean, _) = _run_federation(
+            4,
+            REGIONS_2X2,
+            rounds,
+            settle=3,
+            make=_make_dense_float,
+            update=_update_dense_float,
+        )
+    (exact, _) = _run_federation(
+        4,
+        REGIONS_2X2,
+        rounds,
+        settle=3,
+        make=_make_dense_float,
+        update=_update_dense_float,
+    )
+    assert h > 0  # the ledger actually absorbed duplicates
+    for (cv, cp, _), (fv, _, _) in zip(chaotic, clean):
+        assert not cp.degraded  # healed
+        for k, want in fv.items():
+            assert np.array_equal(cv[k], want), k
+    # non-vacuous: the rung was actually lossy for the dense family
+    assert not np.array_equal(chaotic[0][0]["cat"], exact[0][0]["cat"])
+    # ... while the tiny counter below the byte floor stayed exact
+    np.testing.assert_array_equal(chaotic[0][0]["sum"], exact[0][0]["sum"])

@@ -280,3 +280,78 @@ def test_extend_bf16_compression_opt_in():
     np.testing.assert_array_equal(
         np.asarray(lossy["buf"]), x.astype(jnp.bfloat16).astype(np.float32)
     )
+
+
+def test_extend_int8_compression_within_codec_bound():
+    """compression="int8" quantizes the EXTEND gather INSIDE the jitted
+    program (one uint8 all-gather replaces the float one): every shard's
+    gathered values land within the codec's published hard bound, while
+    the integer counter synced alongside stays bit-exact."""
+    from torcheval_tpu import config as te_config
+    from torcheval_tpu import wire
+
+    mesh = _mesh(4)
+    rng = np.random.default_rng(7)
+    shards = [
+        (rng.normal(size=512) * 3.0).astype(np.float32) for _ in range(4)
+    ]
+    x = np.concatenate(shards)
+    n = np.arange(1, 5, dtype=np.int32)
+    specs = {"buf": MergeKind.EXTEND, "n": MergeKind.SUM}
+
+    @jax.jit
+    @partial(
+        shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P()
+    )
+    def step(xs, ns):
+        return sync_states_in_jit(
+            {"buf": xs, "n": ns[0]}, "dp", specs, compression="int8"
+        )
+
+    out = step(
+        jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("dp"))),
+        jax.device_put(jnp.asarray(n), NamedSharding(mesh, P("dp"))),
+    )
+    assert out["buf"].dtype == jnp.float32  # dequantized after the wire
+    assert int(out["n"]) == int(n.sum())  # integer counter untouched
+    got = np.asarray(out["buf"]).reshape(4, 512)
+    block = te_config.wire_block_size()
+    for r in range(4):
+        bound = wire.int8_error_bound(shards[r], block)
+        assert float(np.max(np.abs(got[r] - shards[r]))) <= bound
+        assert bound < 0.04  # the bound itself is meaningfully tight
+
+
+def test_shard_spec_int8_reduce_scatter_matches_oracle_within_bound():
+    """Owner-partitioned SUM at the int8 rung: the quantized all_to_all
+    exchange lands each owner's block within the COMPOUNDED bound (one
+    codec error per contributing rank), and the result stays sharded."""
+    from torcheval_tpu import config as te_config
+    from torcheval_tpu import wire
+    from torcheval_tpu.metrics import ShardSpec
+
+    mesh = _mesh(4)
+    rng = np.random.default_rng(8)
+    deltas = rng.normal(size=(4, 1024)).astype(np.float32)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    def step(d):
+        out = sync_states_in_jit(
+            {"hist": d[0]},
+            "dp",
+            {"hist": MergeKind.SUM},
+            compression="int8",
+            shard_specs={"hist": ShardSpec(axis=0)},
+        )
+        return out["hist"]
+
+    owned = step(
+        jax.device_put(jnp.asarray(deltas), NamedSharding(mesh, P("dp")))
+    )
+    assert owned.shape == (1024,)
+    assert not owned.sharding.is_fully_replicated  # stays partitioned
+    oracle = deltas.astype(np.float64).sum(axis=0)
+    block = te_config.wire_block_size()
+    bound = sum(wire.int8_error_bound(deltas[r], block) for r in range(4))
+    assert float(np.max(np.abs(np.asarray(owned) - oracle))) <= bound

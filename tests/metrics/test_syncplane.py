@@ -58,10 +58,10 @@ def _mean_pair():
 
 
 def test_sync_provenance_schema_pinned():
-    """The bounded-staleness triple — and now the admission triple —
-    extend the tuple by APPENDED, defaulted fields — positional
-    construction sites and old pickles stay valid, and the field order
-    is part of the wire schema."""
+    """The bounded-staleness triple — then the admission triple, then
+    the wire tier — extend the tuple by APPENDED, defaulted fields —
+    positional construction sites and old pickles stay valid, and the
+    field order is part of the wire schema."""
     assert SyncProvenance._fields == (
         "ranks",
         "world_size",
@@ -74,6 +74,7 @@ def test_sync_provenance_schema_pinned():
         "sampled_fraction",
         "admission_rung",
         "admission_epoch",
+        "wire_tier",
     )
     legacy = SyncProvenance((0, 1), 2, False, "strict")
     assert legacy.reformed is False

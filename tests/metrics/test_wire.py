@@ -1,0 +1,489 @@
+"""Quantized wire ladder (ISSUE 18): codec, per-family policy, fallback.
+
+Four layers under test:
+
+- the blockwise int8 codec itself (``torcheval_tpu.wire``): roundtrip
+  inside the published hard bound (``amax(block)/254`` per element), the
+  traceable jnp twins bit-matching the numpy wire, and the wire-bytes
+  arithmetic behind the >= 3x acceptance claim;
+- the eager packed wire (``metrics.synclib``) at all three rungs,
+  pinned per family against the merge oracle: bytes shrink, error stays
+  inside the codec bound, integer-counter states are BIT-exact at every
+  rung, and sparse trimming composes with quantization (trim first,
+  then quantize the trimmed payload);
+- the process-wide :class:`~torcheval_tpu.wire.WireLadder` fallback
+  registry: a measured ``DriftSpec`` budget breach steps the family one
+  rung toward ``exact``, emits a typed ``WireTierEvent``, and the NEXT
+  sync observably rides the mercy rung (``SyncProvenance.wire_tier``);
+- schema discipline: ``SyncProvenance.wire_tier`` is appended-defaulted
+  (legacy positional construction keeps working) and the new/extended
+  events round-trip through schema-1 JSONL dicts.
+
+In-jit int8 (EXTEND gather + owner-partitioned reduce-scatter) is
+pinned in tests/metrics/test_sharded.py; the federation WAN wire at
+int8 in tests/metrics/test_federation.py.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu import config as te_config
+from torcheval_tpu import obs, wire
+from torcheval_tpu.distributed import LocalReplicaGroup
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    Cat,
+    MulticlassAccuracy,
+    StreamingBinaryAUROC,
+    WindowedBinaryAUROC,
+)
+from torcheval_tpu.metrics import synclib
+from torcheval_tpu.metrics.synclib import (
+    _pack_rank_states,
+    metrics_traversal_order,
+)
+from torcheval_tpu.metrics.toolkit import (
+    get_synced_metric_collection,
+    sync_and_compute,
+)
+from torcheval_tpu.obs import quality
+
+RNG = np.random.default_rng(18)
+
+
+@pytest.fixture(autouse=True)
+def _clean_ladder():
+    """No ladder policy, breach cap, or quality watch leaks across
+    tests."""
+    yield
+    wire.LADDER.reset()
+    te_config.set_wire_ladder("exact")
+    for watch in quality.active_watches():
+        watch.close()
+
+
+# --------------------------------------------------------------- the codec
+
+
+@pytest.mark.parametrize("size", [1, 5, 31, 32, 33, 1000, 4096])
+def test_quantize_roundtrip_within_hard_bound(size):
+    a = RNG.normal(size=size).astype(np.float32) * 3.0
+    q, scales = wire.quantize_blockwise(a, 32)
+    out = wire.dequantize_blockwise(q, scales, size)
+    bound = wire.int8_error_bound(a, 32)
+    assert out.shape == (size,)
+    assert float(np.max(np.abs(out - a))) <= bound
+    # the bound itself is tight-ish: amax/254 of the worst block
+    assert bound <= float(np.abs(a).max()) / 254.0 + 1e-12
+
+
+def test_quantize_zero_blocks_exact():
+    a = np.zeros(128, np.float32)
+    a[70] = 5.0  # one nonzero block, three all-zero blocks
+    q, scales = wire.quantize_blockwise(a, 32)
+    assert scales[0] == 0.0 and scales[3] == 0.0
+    out = wire.dequantize_blockwise(q, scales, a.size)
+    np.testing.assert_array_equal(out[:64], 0.0)
+    assert abs(out[70] - 5.0) <= wire.int8_error_bound(a, 32)
+
+
+def test_jit_twins_match_numpy_codec():
+    """The traceable quantize/pack/unpack must be the SAME wire as the
+    numpy codec — every tier dequantizes to identical values."""
+    a = RNG.normal(size=333).astype(np.float32)
+    q_np, s_np = wire.quantize_blockwise(a, 32)
+    q_j, s_j = jax.jit(lambda x: wire.quantize_blockwise_jit(x, 32))(
+        jnp.asarray(a)
+    )
+    np.testing.assert_array_equal(np.asarray(q_j), q_np)
+    np.testing.assert_array_equal(np.asarray(s_j), s_np)
+    packed = jax.jit(wire.pack_wire)(q_j, s_j)
+    assert packed.dtype == jnp.uint8
+    assert packed.size == wire.int8_wire_bytes(a.size, 32)
+    unpacked = jax.jit(
+        lambda w: wire.unpack_wire(w, s_np.size, 32)
+    )(packed)
+    np.testing.assert_array_equal(
+        np.asarray(unpacked)[: a.size],
+        wire.dequantize_blockwise(q_np, s_np, a.size),
+    )
+
+
+def test_wire_bytes_ratio_and_rungs():
+    # the arithmetic behind the >= 3x acceptance claim: 1 + 4/32 bytes
+    # per element vs 4 exact bytes -> 3.55x at the default block
+    assert wire.int8_wire_bytes(4096, 32) == 4096 + 4 * 128
+    assert 4 * 4096 / wire.int8_wire_bytes(4096, 32) > 3.5
+    assert wire.RUNGS == ("exact", "bf16", "int8")
+    assert wire.rung_index("off") == 0  # legacy spelling
+    assert wire.normalize_rung("off") == "exact"
+    with pytest.raises(ValueError, match="unknown wire rung"):
+        wire.rung_index("fp4")
+
+
+# ------------------------------------------------- config: the ladder policy
+
+
+def test_wire_ladder_config_and_legacy_views():
+    te_config.set_wire_ladder("*=bf16,MulticlassAUROC=int8")
+    assert te_config.wire_rung_for("MulticlassAUROC") == "int8"
+    assert te_config.wire_rung_for("Mean") == "bf16"  # the default family
+    # the legacy single-policy API is a view over the "*" entry
+    assert te_config.sync_compression() == "bf16"
+    te_config.set_sync_compression("off")
+    assert te_config.wire_rung_for("Mean") == "exact"
+    assert te_config.wire_rung_for("MulticlassAUROC") == "int8"
+    with te_config.wire_ladder_mode("int8"):
+        assert te_config.wire_rung_for("anything") == "int8"
+    assert te_config.wire_rung_for("Mean") == "exact"  # restored
+    with pytest.raises(ValueError):
+        te_config.set_wire_ladder("fp4")
+
+
+# ------------------------- eager wire: per-family bytes x error vs oracle
+
+
+def _wire_bytes_at(metric, rung) -> int:
+    payload = {"_m": metric._sync_state_dict()}
+    order = metrics_traversal_order(payload)
+    _, flat = _pack_rank_states(payload, order, rung)
+    return int(flat.size)
+
+
+def _state_bound(metric, block) -> float:
+    """The codec's hard bound over every float state the metric ships."""
+    bound = 0.0
+    for v in jax.tree_util.tree_leaves(metric._sync_state_dict()):
+        a = np.asarray(v)
+        if a.dtype.kind == "f" and a.nbytes > 1024:
+            bound = max(bound, wire.int8_error_bound(a, block))
+    return bound
+
+
+def _auroc_replicas(factory, world=4, n=2000):
+    out = []
+    for r in range(world):
+        rng = np.random.default_rng(200 + r)
+        m = factory()
+        m.update(
+            jnp.asarray(rng.random(n).astype(np.float32)),
+            jnp.asarray((rng.random(n) < 0.5).astype(np.float32)),
+        )
+        out.append(m)
+    return out
+
+
+FLOAT_FAMILIES = [
+    ("BinaryAUROC", lambda: BinaryAUROC()),
+    ("WindowedBinaryAUROC", lambda: WindowedBinaryAUROC(max_num_samples=4096)),
+    ("Cat", lambda: Cat()),
+]
+
+
+@pytest.mark.parametrize("name,factory", FLOAT_FAMILIES)
+def test_float_family_bytes_and_error_ladder(name, factory):
+    """THE acceptance table, one float family per row: at each rung the
+    synced result stays within the codec's hard bound of the merge
+    oracle, and the int8 rung ships >= 3x fewer payload bytes than
+    exact."""
+    if name == "Cat":
+        ms = []
+        for r in range(4):
+            rng = np.random.default_rng(300 + r)
+            m = Cat()
+            m.update(jnp.asarray(rng.normal(size=2000).astype(np.float32)))
+            ms.append(m)
+    else:
+        ms = _auroc_replicas(factory)
+    group = LocalReplicaGroup(jax.devices("cpu")[:1] * 4)
+    block = te_config.wire_block_size()
+
+    bytes_at = {}
+    vals = {}
+    for rung in wire.RUNGS:
+        bytes_at[rung] = sum(_wire_bytes_at(m, rung) for m in ms)
+        with te_config.wire_ladder_mode(rung):
+            vals[rung] = np.asarray(
+                sync_and_compute([copy.deepcopy(m) for m in ms], group)
+            )
+    oracle = copy.deepcopy(ms[0])
+    oracle.merge_state([copy.deepcopy(m) for m in ms[1:]])
+    want = np.asarray(oracle.compute())
+
+    np.testing.assert_array_equal(vals["exact"], want)  # rung 0: bit-exact
+    assert bytes_at["bf16"] < bytes_at["exact"]
+    # acceptance: >= 3x fewer payload bytes at the int8 rung
+    assert bytes_at["int8"] * 3 <= bytes_at["exact"], (
+        name,
+        bytes_at,
+    )
+    # error pinned to the CODEC bound, not a vibes tolerance: each
+    # shipped element is quantized exactly once, so the synced states
+    # sit within max-over-ranks amax(block)/254 of the oracle's
+    bound = max(_state_bound(m, block) for m in ms)
+    assert bound > 0.0
+    if name == "Cat":  # identity compute: value error == state error
+        assert float(np.max(np.abs(vals["int8"] - want))) <= bound
+    else:
+        # AUROC is a rank statistic of the states; perturbations
+        # bounded by the grid step move it by o(1)
+        assert abs(float(vals["int8"]) - float(want)) < 0.02
+    assert np.all(np.isfinite(vals["int8"]))
+
+
+def test_integer_counter_states_bit_exact_at_every_rung():
+    """Acceptance: pure-integer-counter states are BIT-exact at every
+    rung — the quantizer never touches them — and their wire bytes do
+    not change."""
+    ms = []
+    for r in range(4):
+        rng = np.random.default_rng(400 + r)
+        m = MulticlassAccuracy(num_classes=4, average=None)
+        m.update(
+            jnp.asarray(rng.uniform(size=(512, 4)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 4, size=512)),
+        )
+        ms.append(m)
+    group = LocalReplicaGroup(jax.devices("cpu")[:1] * 4)
+    oracle = copy.deepcopy(ms[0])
+    oracle.merge_state([copy.deepcopy(m) for m in ms[1:]])
+    want = np.asarray(oracle.compute())
+    base = _wire_bytes_at(ms[0], "exact")
+    for rung in wire.RUNGS:
+        with te_config.wire_ladder_mode(rung):
+            got = np.asarray(
+                sync_and_compute([copy.deepcopy(m) for m in ms], group)
+            )
+        np.testing.assert_array_equal(got, want)
+        assert _wire_bytes_at(ms[0], rung) == base
+
+
+def test_sparse_trim_composes_with_int8():
+    """Trim-then-quantize: a mostly-zero histogram rides the sparse
+    encoding FIRST, then only the surviving values quantize (the
+    ``sparse8`` composition) — fewer bytes than sparse alone, and the
+    nonzero sites reconstruct within the codec bound."""
+    a = np.zeros(16384, np.float32)
+    idx = RNG.choice(16384, size=900, replace=False)
+    a[idx] = RNG.normal(size=900).astype(np.float32)
+    entry_exact, chunks_exact = synclib._encode_array(a, "exact")
+    entry_int8, chunks_int8 = synclib._encode_array(a, "int8")
+    assert entry_exact[2][0] == "sparse"
+    assert entry_int8[2][0] == "sparse8"
+    exact_bytes = sum(c.size for c in chunks_exact)
+    int8_bytes = sum(c.size for c in chunks_int8)
+    assert int8_bytes < exact_bytes
+    buf = np.concatenate([c.reshape(-1) for c in chunks_int8])
+    out, off = synclib._decode_array(buf, 0, entry_int8)
+    assert off == buf.size
+    vals = a[np.sort(idx)]
+    bound = wire.int8_error_bound(vals, 32)
+    assert float(np.max(np.abs(out - a))) <= bound
+    assert not np.any(out[a == 0.0])  # trimmed (original-zero) sites stay zero
+
+
+def test_provenance_reports_actual_wire_tier_per_metric():
+    """``SyncProvenance.wire_tier`` reports what the wire DID, not what
+    was configured: under an int8 policy a big float family stamps
+    "int8" while a tiny integer-counter metric in the SAME collection
+    stays "exact"."""
+    def _replica(r):
+        rng = np.random.default_rng(500 + r)
+        big = BinaryAUROC()
+        big.update(
+            jnp.asarray(rng.random(2000).astype(np.float32)),
+            jnp.asarray((rng.random(2000) < 0.5).astype(np.float32)),
+        )
+        small = MulticlassAccuracy()
+        small.update(
+            jnp.asarray(rng.uniform(size=(32, 4)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 4, size=32)),
+        )
+        return {"big": big, "small": small}
+
+    group = LocalReplicaGroup(jax.devices("cpu")[:1] * 2)
+    with te_config.wire_ladder_mode("int8"):
+        synced = get_synced_metric_collection(
+            [_replica(0), _replica(1)], group
+        )
+    assert synced["big"].sync_provenance.wire_tier == "int8"
+    assert synced["small"].sync_provenance.wire_tier == "exact"
+
+    # the per-rank meta fold behind it: lossiest tier across ranks wins
+    order = [("m", "x")]
+    int8_meta = [("tensor", [((2016,), "float32", ("int8block", 32, 63, 0))], None)]
+    raw_meta = [("tensor", [((2016,), "float32", None)], None)]
+    sparse_meta = [("tensor", [((2016,), "float32", ("sparse", 3, "<f4"))], None)]
+    assert synclib._meta_wire_tiers(order, [int8_meta, raw_meta]) == {
+        "m": "int8"
+    }
+    assert synclib._meta_wire_tiers(order, [raw_meta, sparse_meta]) == {
+        "m": "exact"
+    }
+
+
+# ----------------------------- ladder registry: breach -> fallback -> event
+
+
+def test_breach_steps_cap_and_emits_event(obs_recorder):
+    te_config.set_wire_ladder("int8")
+    assert wire.effective_rung("BinaryAUROC") == "int8"
+    step1 = wire.note_budget_breach(
+        "BinaryAUROC", series="score/0", breach="psi"
+    )
+    assert step1 == ("int8", "bf16")
+    assert wire.LADDER.cap("BinaryAUROC") == "bf16"
+    assert wire.effective_rung("BinaryAUROC") == "bf16"
+    step2 = wire.note_budget_breach("BinaryAUROC", breach="ks")
+    assert step2 == ("bf16", "exact")
+    assert wire.effective_rung("BinaryAUROC") == "exact"
+    # already exact: nothing left to fall back to, no event
+    assert wire.note_budget_breach("BinaryAUROC") is None
+    events = [e for e in obs_recorder.log.tail() if e.kind == "wire_tier"]
+    assert [(e.prev_tier, e.tier) for e in events] == [
+        ("int8", "bf16"),
+        ("bf16", "exact"),
+    ]
+    assert events[0].family == "BinaryAUROC"
+    assert events[0].series == "score/0"
+    assert events[0].breach == "psi"
+    # other families are untouched
+    assert wire.effective_rung("Cat") == "int8"
+    # counters surface the fallback
+    counters = obs.default_registry().read()["wire"]
+    assert counters["fallback_families"] == 1
+    assert counters["cap_BinaryAUROC"] == "exact"
+    wire.LADDER.reset("BinaryAUROC")
+    assert wire.effective_rung("BinaryAUROC") == "int8"
+
+
+def test_drift_budget_breach_falls_back_next_sync_rides_mercy_rung(
+    obs_recorder,
+):
+    """The end-to-end fallback contract (seeded, deterministic): a
+    DriftSpec budget breach on a watched metric steps its family from
+    int8 to bf16, emits the WireTierEvent, and the NEXT sync observably
+    rides bf16 (``SyncProvenance.wire_tier``)."""
+    te_config.set_wire_ladder("int8")
+    rng = np.random.default_rng(11)
+    metric = WindowedBinaryAUROC(max_num_samples=4096)
+    # plan arg 0 is the ring-buffer column index; the scores are arg 1
+    watch = quality.watch_inputs(
+        metric, bounds=(-4.0, 4.0), num_bins=16, label="score", args=(1,)
+    )
+    for _ in range(4):
+        metric.update(
+            jnp.asarray(rng.normal(size=512).astype(np.float32)),
+            jnp.asarray((rng.random(512) < 0.5).astype(np.float32)),
+        )
+    watch.add_drift(
+        quality.DriftSpec(psi=0.2, ks=0.15, z=6.0, min_count=128)
+    )
+    monitor = obs.Monitor(cooldown=0.0)
+    assert monitor.check() == []  # in-bounds replay, no breach
+    assert wire.effective_rung("WindowedBinaryAUROC") == "int8"
+    for _ in range(4):
+        metric.update(
+            jnp.asarray((rng.normal(size=512) + 1.5).astype(np.float32)),
+            jnp.asarray((rng.random(512) < 0.5).astype(np.float32)),
+        )
+    raised = monitor.check()
+    assert raised  # drift alerts fired
+    assert wire.effective_rung("WindowedBinaryAUROC") == "bf16"
+    events = [e for e in obs_recorder.log.tail() if e.kind == "wire_tier"]
+    assert events and events[-1].tier == "bf16"
+    assert events[-1].family == "WindowedBinaryAUROC"
+    assert events[-1].series == "score/1"
+    assert set(events[-1].breach.split(",")) <= {"psi", "ks", "z"}
+
+    # the NEXT sync rides the mercy rung, visible in provenance
+    group = LocalReplicaGroup(jax.devices("cpu")[:1] * 2)
+    synced = get_synced_metric_collection(
+        [{"m": copy.deepcopy(metric)}, {"m": copy.deepcopy(metric)}],
+        group,
+    )
+    prov = synced["m"].sync_provenance
+    assert prov.wire_tier == "bf16"
+    sync_events = [e for e in obs_recorder.log.tail() if e.kind == "sync"]
+    assert sync_events and sync_events[-1].wire_tier == "bf16"
+
+
+# ------------------------------------- schema discipline: provenance/events
+
+
+def test_sync_provenance_legacy_positional_construction():
+    from torcheval_tpu.resilience import SyncProvenance
+
+    legacy = SyncProvenance((0, 1), 2, False, "all")  # PR 2 arity
+    assert legacy.wire_tier == "exact"
+    assert legacy.admission_rung == 0 and legacy.version == 0
+    staleness = SyncProvenance((0,), 2, True, "quorum", True, 3, 1, 0.5)
+    assert staleness.wire_tier == "exact"
+    full = SyncProvenance(
+        (0, 1), 2, False, "all", False, 0, 0, 0.0, 1.0, 0, 0, "int8"
+    )
+    assert full.wire_tier == "int8"
+    assert legacy._replace(wire_tier="bf16").wire_tier == "bf16"
+
+
+def test_wire_tier_event_schema1_jsonl_roundtrip():
+    from torcheval_tpu.obs.events import (
+        SyncEvent,
+        WireTierEvent,
+        event_from_dict,
+    )
+
+    ev = WireTierEvent(
+        family="BinaryAUROC",
+        series="score/0",
+        prev_tier="int8",
+        tier="bf16",
+        breach="psi,ks",
+    )
+    d = ev.as_dict()
+    assert d["schema"] == 1  # new event type, SAME schema version
+    assert d["kind"] == "wire_tier"
+    assert event_from_dict(d) == ev
+    d["future_field"] = "x"  # newer-writer tolerance
+    assert event_from_dict(d).tier == "bf16"
+
+    # SyncEvent.wire_tier is a new OPTIONAL field: legacy dicts without
+    # it (schema-1 JSONL written before this PR) still reconstruct
+    s = SyncEvent(metrics=2, world_size=4, wire_tier="int8")
+    sd = s.as_dict()
+    assert sd["schema"] == 1
+    assert event_from_dict(sd) == s
+    del sd["wire_tier"]
+    assert event_from_dict(sd).wire_tier == "exact"
+
+
+def test_canonical_crc_symmetric_across_rungs():
+    """Federation's crc moves to POST-DEQUANTIZE canonical bytes: the
+    crc of a wire packed at any rung equals the crc of its decoded
+    canonical re-pack — so sender (packs lossy) and receiver (holds
+    decoded arrays) agree without shipping a second checksum."""
+    states = {
+        "m": {
+            "buf": jnp.asarray(RNG.normal(size=2000).astype(np.float32)),
+            "n": jnp.asarray(7, jnp.int32),
+        }
+    }
+    order = metrics_traversal_order(states)
+    for rung in wire.RUNGS:
+        meta, flat = _pack_rank_states(
+            {"m": dict(states["m"])}, order, rung
+        )
+        crc1 = synclib.canonical_crc(order, meta, flat)
+        # decode, re-pack exact, crc again: must be the same number
+        decoded = synclib._unpack_rank_states(
+            {"m": dict(states["m"])}, order, meta, flat
+        )
+        meta2, flat2 = _pack_rank_states(decoded, order, "exact")
+        assert crc1 == synclib.canonical_crc(order, meta2, flat2)

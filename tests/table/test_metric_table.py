@@ -23,6 +23,7 @@ from torcheval_tpu.metrics import (
 )
 from torcheval_tpu.table import MetricTable, TableValues, hash_keys, owner_of
 from torcheval_tpu.utils import CompileCounter
+from torcheval_tpu.utils.test_utils import OverloadSchedule
 
 RNG = np.random.default_rng(12)
 N_KEYS = 24
@@ -194,43 +195,45 @@ def test_slot_growth_and_arrival_order_independence():
 def test_warmed_table_processes_fresh_ragged_batches_with_zero_compiles():
     """ISSUE 12 acceptance: a warmed table (keys admitted, buckets seen,
     outbox capacity grown) pays ZERO new compiled programs for fresh
-    ragged batch sizes under shape bucketing."""
+    ragged batch sizes. No ``config.shape_bucketing()`` context here —
+    the serving door (``ingest``) arms bucketing itself (ROADMAP 4d);
+    ``update`` remains the raw, caller-controlled path (the control)."""
     rng = np.random.default_rng(5)
     keyspace = rng.integers(0, 1000, 2000)
 
-    def feed(t, n):
+    def feed(t, n, door):
         keys = keyspace[rng.integers(0, keyspace.size, n)]
-        t.ingest(
+        door(
             keys,
             rng.integers(0, 2, n).astype(np.float32),
             (rng.integers(1, 8, n) / 8).astype(np.float32),
         )
 
-    with config.shape_bucketing():
-        t = MetricTable("ctr", shard=ShardContext(1, 4))
-        # admit the keyspace and pre-grow the outbox past the test sizes
-        big = np.concatenate([keyspace, keyspace])
-        t.ingest(
-            big,
-            np.zeros(big.size, np.float32),
-            np.ones(big.size, np.float32),
-        )
-        for n in (8, 16, 32, 64):
-            feed(t, n)
-        with CompileCounter() as warmed:
-            for n in (6, 10, 18, 34, 57):
-                feed(t, n)
-        assert warmed.programs == 0, (
-            f"fresh ragged sizes retraced {warmed.programs} programs"
-        )
-    # control: without bucketing every fresh size retraces
-    t2 = MetricTable("ctr", shard=ShardContext(1, 4))
-    t2.ingest(big, np.zeros(big.size, np.float32), np.ones(big.size, np.float32))
+    t = MetricTable("ctr", shard=ShardContext(1, 4))
+    # admit the keyspace and pre-grow the outbox past the test sizes
+    big = np.concatenate([keyspace, keyspace])
+    t.ingest(
+        big,
+        np.zeros(big.size, np.float32),
+        np.ones(big.size, np.float32),
+    )
     for n in (8, 16, 32, 64):
-        feed(t2, n)
+        feed(t, n, t.ingest)
+    with CompileCounter() as warmed:
+        for n in (6, 10, 18, 34, 57):
+            feed(t, n, t.ingest)
+    assert warmed.programs == 0, (
+        f"fresh ragged sizes retraced {warmed.programs} programs"
+    )
+    # control: the raw update path without bucketing retraces every
+    # fresh size
+    t2 = MetricTable("ctr", shard=ShardContext(1, 4))
+    t2.update(big, np.zeros(big.size, np.float32), np.ones(big.size, np.float32))
+    for n in (8, 16, 32, 64):
+        feed(t2, n, t2.update)
     with CompileCounter() as cold:
         for n in (6, 10, 18, 34):
-            feed(t2, n)
+            feed(t2, n, t2.update)
     assert cold.programs == 4
 
 
@@ -242,11 +245,11 @@ def test_bucketed_ingest_bit_identical_to_unbucketed():
     ]
     plain = MetricTable("ctr", shard=ShardContext(0, 2))
     for b in batches:
-        plain.ingest(*b)
-    with config.shape_bucketing():
-        bucketed = MetricTable("ctr", shard=ShardContext(0, 2))
-        for b in batches:
-            bucketed.ingest(*b)
+        plain.update(*b)  # raw path: no bucketing
+    # no context manager: ingest (the serving door) arms bucketing itself
+    bucketed = MetricTable("ctr", shard=ShardContext(0, 2))
+    for b in batches:
+        bucketed.ingest(*b)
     a, b = plain.compute(), bucketed.compute()
     assert np.array_equal(a.keys, b.keys)
     assert np.asarray(a.values).tobytes() == np.asarray(b.values).tobytes()
@@ -257,6 +260,38 @@ def test_bucketed_ingest_bit_identical_to_unbucketed():
         np.asarray(plain.out_hi[: int(plain.out_h)]),
         np.asarray(bucketed.out_hi[: int(bucketed.out_h)]),
     )
+
+
+def test_ingest_program_set_finite_under_overload_churn():
+    """ROADMAP 4d regression pin: serving-door ingest buckets by
+    default, so an :class:`OverloadSchedule` ramp — a fresh ragged
+    batch size nearly every step — demands only a FINITE program set
+    (one fused update program per power-of-two bucket), and a second
+    schedule over fresh keys at the same load shape compiles NOTHING."""
+    sched = OverloadSchedule.ramp(20, 3.0, base_rows=48, base_keys=200, seed=11)
+    sizes = {sched.rows_at(s) for s in range(len(sched))}
+    assert len(sizes) >= 15  # genuine churn: ~every step is a new size
+    buckets = {1 << (int(n) - 1).bit_length() for n in sizes}
+
+    t = MetricTable("ctr", shard=ShardContext(0, 1))
+    # pre-admit the keyspace so slot growth never charges the churn count
+    t.ingest(np.arange(200), np.ones(200, np.float32))
+    with CompileCounter() as cc:
+        for batch in sched.batches():
+            t.ingest(batch.keys, **batch.kwargs)
+    assert cc.programs < len(sizes), (
+        f"{cc.programs} programs for {len(sizes)} ragged sizes — the "
+        "serving door is not bucketing by default"
+    )
+    assert cc.programs <= 2 * len(buckets)
+    # warmed: same load shape, fresh keys (new seed) — zero programs
+    replay = OverloadSchedule.ramp(
+        20, 3.0, base_rows=48, base_keys=200, seed=12
+    )
+    with CompileCounter() as warmed:
+        for batch in replay.batches():
+            t.ingest(batch.keys, **batch.kwargs)
+    assert warmed.programs == 0
 
 
 def test_outbox_holds_only_foreign_traffic():

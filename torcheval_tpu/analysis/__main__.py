@@ -96,6 +96,7 @@ def _program_smoke() -> Report:
     combined.extend(_federation_lockstep_smoke())
     combined.extend(_schedule_lockstep_smoke())
     combined.extend(_sync_plane_smoke())
+    combined.extend(_wire_quant_smoke())
     return combined
 
 
@@ -187,6 +188,104 @@ def _sync_plane_smoke() -> Report:
                 ),
             )
         )
+    return combined
+
+
+def _wire_quant_smoke() -> Report:
+    """ISSUE 18: the quantized in-jit sync must cost nothing in program
+    structure. At the int8 rung the EXTEND sync traces with no host
+    escapes and its ordered HLO collective sequence adds ZERO ops over
+    the exact step (the quantized wire rides the SAME collectives as
+    bit-packed uint8 payloads), and the donated owner-partitioned carry
+    at int8 stays donation-sound (state buffers aliased in the
+    optimized module)."""
+    from functools import partial
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.4.38 jax keeps it under experimental
+        from jax.experimental.shard_map import shard_map
+
+    from torcheval_tpu.analysis.program import (
+        compare_collective_sequences,
+        verify_program,
+    )
+    from torcheval_tpu.metrics import ShardSpec
+    from torcheval_tpu.metrics.metric import MergeKind
+    from torcheval_tpu.metrics.sharded import sync_states_in_jit
+
+    devices = np.array(jax.devices())
+    world = 4 if devices.size >= 4 else (2 if devices.size >= 2 else 1)
+    mesh = Mesh(devices[:world], ("dp",))
+    specs = {"buf": MergeKind.EXTEND, "n": MergeKind.SUM}
+
+    def extend_step(rung):
+        @partial(
+            shard_map, mesh=mesh, in_specs=(P("dp"), P()), out_specs=P()
+        )
+        def fn(xs, n):
+            return sync_states_in_jit(
+                {"buf": xs, "n": n}, "dp", specs, compression=rung
+            )
+
+        return fn
+
+    x = jax.ShapeDtypeStruct((world * 512,), jnp.float32)
+    n = jax.ShapeDtypeStruct((), jnp.int32)
+    combined = Report(tool="program")
+    for rung in ("exact", "int8"):
+        combined.extend(
+            verify_program(
+                extend_step(rung),
+                x,
+                n,
+                name=f"wire_quant.extend[{rung}]",
+                compile_hlo=False,
+            )
+        )
+    combined.extend(
+        compare_collective_sequences(
+            extend_step("exact"),
+            (x, n),
+            extend_step("int8"),
+            (x, n),
+            name="wire_quant.extend.zero-added-collectives",
+            allow_added=0,
+        )
+    )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("dp"), P("dp")),
+        out_specs=P("dp"),
+        check_rep=False,
+    )
+    def carry(state, delta):
+        owned = sync_states_in_jit(
+            {"hist": delta[0]},
+            "dp",
+            {"hist": MergeKind.SUM},
+            compression="int8",
+            shard_specs={"hist": ShardSpec(axis=0)},
+        )
+        return state + owned["hist"]
+
+    combined.extend(
+        verify_program(
+            carry,
+            jax.ShapeDtypeStruct((1024,), jnp.float32),
+            jax.ShapeDtypeStruct((world, 1024), jnp.float32),
+            name="wire_quant.reduce_scatter[int8].donated",
+            donate_argnums=(0,),
+        )
+    )
     return combined
 
 

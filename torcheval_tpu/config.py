@@ -459,60 +459,186 @@ def set_federation_staleness_epochs(rounds: int) -> None:
     _federation_staleness = int(rounds)
 
 
-# ------------------------------------------------------- sync compression
+# -------------------------------------------------- quantized wire ladder
 
-_COMPRESSION_POLICIES = ("off", "bf16")
+# Least -> most lossy; mirrored (and lint-/drift-guarded) by
+# torcheval_tpu/wire.py RUNGS. "off" is the legacy sync_compression
+# spelling of the exact rung.
+_WIRE_RUNGS = ("exact", "bf16", "int8")
+_LEGACY_RUNGS = {"off": "exact", "bf16": "bf16", "int8": "int8"}
 
-_sync_compression: str = _env_choice(
-    "TORCHEVAL_TPU_SYNC_COMPRESSION", "off", _COMPRESSION_POLICIES
+_WIRE_BLOCK_DEFAULT = 32
+_wire_block: int = _env_int(
+    "TORCHEVAL_TPU_WIRE_BLOCK", _WIRE_BLOCK_DEFAULT, minimum=2
 )
 
 
-def sync_compression() -> str:
-    """Wire compression for LARGE float metric-state payloads during sync:
-    ``"off"`` (default — every sync is exactness-preserving) or ``"bf16"``
-    (EQuARX-spirit lossy compression, arxiv 2506.17615: float buffers over
-    ~1 KiB travel as bfloat16 and are cast back on arrival, halving gather
-    bandwidth at ~3 significant decimal digits of score precision).
+def _coerce_rung(rung: str) -> str:
+    rung = str(rung).strip().lower()
+    rung = _LEGACY_RUNGS.get(rung, rung)
+    if rung not in _WIRE_RUNGS:
+        raise ValueError(
+            f"wire rung must be one of {_WIRE_RUNGS} (or legacy 'off'), "
+            f"got {rung!r}"
+        )
+    return rung
 
-    Consumed by both sync paths: the in-jit EXTEND gather
-    (``metrics.sharded.sync_states_in_jit``) and the eager packed protocol
-    (``metrics.synclib``). Counter scalars and integer payloads are never
-    compressed. Env ``TORCHEVAL_TPU_SYNC_COMPRESSION``.
 
-    Scope caveat: the EAGER path reads this knob per sync call; the
-    IN-JIT path reads it at TRACE time, baking the choice into the
-    compiled step — a toggle after tracing does not affect cached
-    programs (pass ``compression=`` to ``sync_states_in_jit`` explicitly
-    to be unambiguous under jit).
+def _parse_wire_ladder(raw: str) -> "dict[str, str]":
+    """``"int8"`` (default rung) or ``"*=bf16,MulticlassAUROC=int8"``
+    (per-family overrides; families are metric CLASS names)."""
+    raw = raw.strip()
+    if "=" not in raw:
+        return {"*": _coerce_rung(raw)}
+    policy: "dict[str, str]" = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        family, _, rung = part.partition("=")
+        policy[family.strip()] = _coerce_rung(rung)
+    policy.setdefault("*", "exact")
+    return policy
+
+
+def _env_wire_ladder() -> "dict[str, str]":
+    # legacy env keeps working as the default-family rung
+    legacy = _env_choice(
+        "TORCHEVAL_TPU_SYNC_COMPRESSION", "off", ("off", "bf16", "int8")
+    )
+    default = {"*": _LEGACY_RUNGS[legacy]}
+    raw = os.environ.get("TORCHEVAL_TPU_WIRE_LADDER", "").strip()
+    if not raw:
+        return default
+    try:
+        return _parse_wire_ladder(raw)
+    except ValueError:
+        _env_invalid(
+            "TORCHEVAL_TPU_WIRE_LADDER",
+            raw,
+            f"rungs must be one of {_WIRE_RUNGS}",
+            default,
+        )
+        return default
+
+
+_wire_ladder: "dict[str, str]" = _env_wire_ladder()
+
+
+def wire_ladder() -> "dict[str, str]":
+    """The CONFIGURED per-family wire-compression ladder policy:
+    ``{"*": default_rung, family: rung, ...}`` with rungs from
+    ``exact | bf16 | int8`` (least -> most lossy; see
+    ``torcheval_tpu/wire.py`` and docs/distributed.md, "Quantized wire
+    ladder"). Families are metric CLASS names
+    (``type(metric).__name__``). The EFFECTIVE rung a family actually
+    rides is this, capped by any measured drift-budget fallback —
+    read it via ``wire.effective_rung(family)``. Env
+    ``TORCHEVAL_TPU_WIRE_LADDER`` (``"int8"`` or
+    ``"*=bf16,MulticlassAUROC=int8"``); legacy
+    ``TORCHEVAL_TPU_SYNC_COMPRESSION`` still sets the default rung.
+
+    Scope caveat (unchanged from sync_compression): the EAGER and
+    federation tiers read the policy per sync call; the IN-JIT tier
+    reads it at TRACE time, baking the rung into the compiled step —
+    pass ``compression=`` to ``sync_states_in_jit`` explicitly to be
+    unambiguous under jit.
     """
-    return _sync_compression
+    return dict(_wire_ladder)
+
+
+def wire_rung_for(family: str) -> str:
+    """``family``'s configured rung (its entry, else the ``"*"``
+    default). Fallback caps are NOT applied here — use
+    ``wire.effective_rung``."""
+    return _wire_ladder.get(family, _wire_ladder.get("*", "exact"))
+
+
+def set_wire_ladder(policy) -> None:
+    """Set the ladder policy: a single rung name (``"int8"`` — applies
+    to every family), a ``family=rung`` spec string, or a mapping
+    ``{family: rung}`` (missing ``"*"`` defaults to ``exact``)."""
+    global _wire_ladder
+    if isinstance(policy, str):
+        _wire_ladder = _parse_wire_ladder(policy)
+        return
+    parsed = {str(k): _coerce_rung(v) for k, v in dict(policy).items()}
+    parsed.setdefault("*", "exact")
+    _wire_ladder = parsed
+
+
+@contextmanager
+def wire_ladder_mode(policy) -> Iterator[None]:
+    """Context manager scoping the wire-ladder policy.
+
+    >>> with wire_ladder_mode("int8"):
+    ...     value = sync_and_compute(metric)   # ~3.6x fewer float bytes
+    """
+    global _wire_ladder
+    prev = _wire_ladder
+    set_wire_ladder(policy)
+    try:
+        yield
+    finally:
+        _wire_ladder = prev
+
+
+def wire_block_size() -> int:
+    """int8-rung quantization block: elements sharing one f32 scale
+    (default 32 — wire is ``size * (1 + 4/block)`` bytes vs ``4*size``
+    exact, i.e. ~3.6x smaller, with max error ``amax(block)/254``).
+    Env ``TORCHEVAL_TPU_WIRE_BLOCK``."""
+    return _wire_block
+
+
+def set_wire_block_size(block: int) -> None:
+    global _wire_block
+    if int(block) < 2:
+        raise ValueError(f"wire block size must be >= 2, got {block}")
+    _wire_block = int(block)
+
+
+# Legacy single-policy views of the ladder (pre-ISSUE-18 API): the
+# compression policy IS the ladder's default-family rung now.
+_COMPRESSION_POLICIES = ("off", "bf16", "int8")
+
+
+def sync_compression() -> str:
+    """Legacy view of the ladder's DEFAULT-family rung (``"off"`` for
+    ``exact``). Prefer :func:`wire_ladder` — this survives for callers
+    of the pre-ladder single-policy API."""
+    rung = _wire_ladder.get("*", "exact")
+    return "off" if rung == "exact" else rung
 
 
 def set_sync_compression(policy: str) -> None:
-    global _sync_compression
+    """Legacy setter: sets the ladder's ``"*"`` default rung, keeping
+    any per-family overrides."""
+    global _wire_ladder
     if policy not in _COMPRESSION_POLICIES:
         raise ValueError(
             f"sync_compression must be one of {_COMPRESSION_POLICIES}, "
             f"got {policy!r}"
         )
-    _sync_compression = policy
+    ladder = dict(_wire_ladder)
+    ladder["*"] = _LEGACY_RUNGS[policy]
+    _wire_ladder = ladder
 
 
 @contextmanager
 def sync_compression_mode(policy: str = "bf16") -> Iterator[None]:
-    """Context manager scoping the sync wire-compression policy.
+    """Context manager scoping the legacy default-rung policy.
 
     >>> with sync_compression_mode("bf16"):
     ...     value = sync_and_compute(metric)   # halved float payloads
     """
-    global _sync_compression
-    prev = _sync_compression
+    global _wire_ladder
+    prev = _wire_ladder
     set_sync_compression(policy)
     try:
         yield
     finally:
-        _sync_compression = prev
+        _wire_ladder = prev
 
 
 # -------------------------------------------------------- input guardrails

@@ -18,6 +18,13 @@ distinct distributed regimes, both covered here behind one small interface:
 Object gathers use the pickle->uint8->pad->allgather trick: XLA collectives
 need static shapes, so lengths are exchanged first — the same protocol the
 reference implements with dummy-tensor padding (reference synclib.py:159-178).
+
+Groups are codec-agnostic: the bytes they ship are whatever the eager
+packer produced, so the quantized wire ladder (``torcheval_tpu/wire.py``,
+``exact | bf16 | int8-blockwise`` per metric family — docs/distributed.md,
+"Quantized wire ladder") compresses payloads *before* they reach any
+group's gather, and the length exchange above automatically sizes the
+collective to the post-codec byte count.
 """
 
 from __future__ import annotations

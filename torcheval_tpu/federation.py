@@ -80,7 +80,6 @@ import pickle
 import threading
 import time
 import warnings
-import zlib
 from dataclasses import dataclass, field
 from typing import (
     Any,
@@ -925,8 +924,11 @@ class Federation:
     ) -> Tuple[Any, np.ndarray]:
         """Pack the region-merged collection with the synclib codec —
         every member packs bit-identical bytes (the intra-region sync's
-        merged states are rank-identical by construction)."""
-        from torcheval_tpu import config
+        merged states are rank-identical by construction, and the
+        per-family wire-ladder rungs are rank-consistent: the configured
+        ladder is process-global config and breach caps derive from the
+        merged drift sketches every member shares)."""
+        from torcheval_tpu import wire as wirelib
         from torcheval_tpu.metrics import synclib
 
         for m in synced.values():
@@ -934,10 +936,12 @@ class Federation:
         states = {
             name: m._sync_state_dict() for name, m in synced.items()
         }
+        rungs = {
+            name: wirelib.effective_rung(type(m).__name__)
+            for name, m in synced.items()
+        }
         order = synclib.metrics_traversal_order(states)
-        meta, flat = synclib._pack_rank_states(
-            states, order, config.sync_compression()
-        )
+        meta, flat = synclib._pack_rank_states(states, order, rungs)
         return (order, meta), np.asarray(flat, dtype=np.uint8)
 
     def _unpack_region_snapshot(
@@ -957,8 +961,15 @@ class Federation:
         self.transport.post(self.my_region.name, dst, pickle.dumps(msg))
 
     def _post_updates(self) -> None:
+        from torcheval_tpu.metrics import synclib
+
         me = self.my_region.name
         meta, buf = self._history[self.epoch]
+        # integrity rides the POST-DEQUANTIZE canonical bytes, not the
+        # wire bytes: under a lossy ladder rung the receiver merges the
+        # dequantized reconstruction, so that is what the crc must pin
+        # (synclib.canonical_crc; one per epoch, shared by every peer)
+        crc = synclib.canonical_crc(meta[0], meta[1], buf)
         for peer, link in self._links.items():
             if link.dark and self.epoch < link.next_probe_round:
                 continue  # backed off: probe later
@@ -970,7 +981,7 @@ class Federation:
                 # piggyback ack: the highest of THEIR epochs I merged
                 "ack": link.merged_epoch,
                 "meta": meta,
-                "crc": zlib.crc32(buf.tobytes()),
+                "crc": crc,
             }
             delta = None
             base = self._history.get(link.acked)
@@ -1098,8 +1109,13 @@ class Federation:
             buf = apply_delta(link.merged_buf, msg["delta"])
         else:
             buf = np.asarray(msg["buf"], dtype=np.uint8)
-        if zlib.crc32(buf.tobytes()) != int(msg["crc"]):
+        from torcheval_tpu.metrics import synclib
+
+        meta = msg["meta"]
+        if synclib.canonical_crc(meta[0], meta[1], buf) != int(msg["crc"]):
             # a corrupt (or wrongly-based) payload must never merge; the
+            # check runs on the POST-DEQUANTIZE canonical bytes (what
+            # this region will actually merge — see _post_updates); the
             # sender will ship a full once it sees our stale ack
             link.health.crc_failures += 1
             self._note_event(src, "crc-failure", epoch=epoch)

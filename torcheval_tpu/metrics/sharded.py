@@ -72,11 +72,74 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from torcheval_tpu import wire as wirelib
 from torcheval_tpu.metrics.metric import MergeKind, Metric
 from torcheval_tpu.metrics.shardspec import ShardSpec
 from torcheval_tpu.utils.vma import gather_replicated
 
 AxisNames = Union[str, Tuple[str, ...]]
+
+# lossy rungs skip tiny payloads (counters) — same gate as the eager
+# wire (synclib._BF16_MIN_BYTES/_INT8_MIN_BYTES)
+_LOSSY_MIN_BYTES = 1024
+
+
+def _wants_lossy(value, compression: str) -> bool:
+    return (
+        compression in ("bf16", "int8")
+        and jnp.issubdtype(value.dtype, jnp.floating)
+        and value.dtype != jnp.bfloat16
+        and value.size * value.dtype.itemsize > _LOSSY_MIN_BYTES
+    )
+
+
+def _quantized_gather(value, axis_name: AxisNames, block: int):
+    """EXTEND gather at the int8 rung, fully inside the jitted program:
+    quantize the (already-trimmed) local shard blockwise, bit-pack the
+    int8 values and f32 scales into ONE uint8 buffer, gather THAT — one
+    uint8 all-gather replaces one float all-gather (zero added
+    collectives; ~3.6x fewer bytes at block 32) — then per-shard
+    unpack/dequantize on the receive side."""
+    q, scales = wirelib.quantize_blockwise_jit(value, block)
+    gathered = gather_replicated(wirelib.pack_wire(q, scales), axis_name)
+    # psum of 1 constant-folds to the STATIC axis size at trace time
+    # (the utils/vma.py shape trick), so the reshape below is static
+    world = int(lax.psum(1, axis_name))
+    rows = jnp.reshape(gathered, (world, q.size + 4 * scales.size))
+    deq = jax.vmap(
+        lambda row: wirelib.unpack_wire(row, scales.size, block)
+    )(rows)
+    deq = deq[:, : value.size].astype(value.dtype)
+    return jnp.reshape(deq, (world,) + tuple(value.shape))
+
+
+def _quantized_reduce_scatter(value, axis: str, spec_axis: int, block: int):
+    """Owner-partitioned SUM at the int8 rung: split the full-size local
+    delta into per-owner blocks, quantize+bit-pack each, exchange with
+    ONE ``lax.all_to_all`` (replacing the one ``psum_scatter`` — zero
+    added collectives), then dequantize and locally sum the world's
+    contributions to this owner's block."""
+    delta = jnp.moveaxis(value, spec_axis, 0)
+    world = lax.psum(1, axis)
+    if delta.shape[0] % world:
+        raise ValueError(
+            f"owner-partitioned state of size {delta.shape[0]} along axis "
+            f"{spec_axis} does not divide the world size {world}"
+        )
+    rest = tuple(delta.shape[1:])
+    blocks = jnp.reshape(delta, (world, -1))
+    q, scales = jax.vmap(
+        lambda b: wirelib.quantize_blockwise_jit(b, block)
+    )(blocks)
+    wirebuf = jax.vmap(wirelib.pack_wire)(q, scales)
+    exchanged = lax.all_to_all(wirebuf, axis, split_axis=0, concat_axis=0)
+    deq = jax.vmap(
+        lambda row: wirelib.unpack_wire(row, scales.shape[1], block)
+    )(exchanged)
+    deq = deq[:, : blocks.shape[1]]
+    owned = jnp.sum(deq, axis=0, dtype=jnp.float32).astype(value.dtype)
+    owned = jnp.reshape(owned, (delta.shape[0] // world,) + rest)
+    return jnp.moveaxis(owned, 0, spec_axis)
 
 
 def _single_axis(axis_name: AxisNames, what: str) -> str:
@@ -138,18 +201,25 @@ def sync_states_in_jit(
             the host-side pmax). Each named buffer is sliced to the
             smallest power-of-2 bucket covering its bound before the
             gather (module docstring, "Payload trimming").
-        compression: ``"bf16"`` casts float EXTEND payloads (> 1 KiB) to
-            bfloat16 across the wire and back, halving gather bandwidth at
-            ~3 decimal digits of score precision (EQuARX-style lossy
-            compression — arxiv 2506.17615). Defaults to the process-wide
-            ``config.sync_compression()`` knob, which is ``"off"``:
-            exactness is the default, compression is opt-in. TRACE-TIME
-            constant: this function runs inside the caller's jitted step,
-            so the choice is baked into the compiled program — toggling
-            the config after the step is traced has NO effect until the
-            step retraces. To be unambiguous under jit, pass
-            ``compression=`` explicitly rather than relying on the
-            context manager.
+        compression: a wire-ladder rung (``"off"``/``"exact"`` |
+            ``"bf16"`` | ``"int8"``) for float payloads over 1 KiB.
+            ``"bf16"`` casts EXTEND payloads to bfloat16 across the wire
+            and back (~2x fewer bytes, ~3 decimal digits);  ``"int8"``
+            quantizes blockwise against per-block f32 scales
+            (EQuARX-style, arxiv 2506.17615 — ``torcheval_tpu.wire``)
+            with the quantize/bit-pack/dequantize fused INSIDE the step
+            program: one uint8 gather (or one ``all_to_all`` on the
+            owner-partitioned path) replaces the one float collective,
+            zero collectives added (pinned by ``analysis --programs``'s
+            wire-quant smoke). Integer payloads never quantize. Defaults
+            to the process-wide ladder's default-family rung
+            (``config.sync_compression()``), which is exact: lossiness
+            is opt-in. TRACE-TIME constant: this function runs inside
+            the caller's jitted step, so the rung is baked into the
+            compiled program — toggling the config after the step is
+            traced has NO effect until the step retraces. To be
+            unambiguous under jit, pass ``compression=`` explicitly
+            rather than relying on the context manager.
         shard_specs: ``{name: ShardSpec}`` for OWNER-PARTITIONED big
             states (the ZeRO-for-metrics layout, ROADMAP item 1): the
             named SUM state's local value is the full-size per-replica
@@ -190,12 +260,21 @@ def sync_states_in_jit(
                     "reduce-scatter lowering"
                 )
             axis = _single_axis(axis_name, "shard_specs sync")
+            value = jnp.asarray(value)
+            if compression == "int8" and _wants_lossy(value, compression):
+                synced[name] = _quantized_reduce_scatter(
+                    value, axis, spec.axis, config.wire_block_size()
+                )
+                continue
+            wire = value
+            if _wants_lossy(value, compression):  # the bf16 rung
+                wire = value.astype(jnp.bfloat16)
             # one reduce-scatter: each owner receives the global sum of
             # its block — O(size) wire, size/world output per replica
-            synced[name] = lax.psum_scatter(
-                jnp.asarray(value), axis,
-                scatter_dimension=spec.axis, tiled=True,
+            owned = lax.psum_scatter(
+                wire, axis, scatter_dimension=spec.axis, tiled=True,
             )
+            synced[name] = owned.astype(value.dtype)
             continue
         if kind in reducers:
             value = jnp.asarray(value)
@@ -211,13 +290,18 @@ def sync_states_in_jit(
                 # the counts; a traced bound cannot size an XLA shape)
                 keep = min(_pow2_cover(bound), value.shape[0])
                 value = lax.slice_in_dim(value, 0, keep, axis=0)
+            if compression == "int8" and _wants_lossy(value, compression):
+                # trim FIRST (the slice above), then quantize the trimmed
+                # payload — the in-jit trim-then-quantize composition
+                gathered = _quantized_gather(
+                    value, axis_name, config.wire_block_size()
+                )
+                synced[name] = jnp.reshape(
+                    gathered, (-1,) + tuple(value.shape[1:])
+                )
+                continue
             wire = value
-            if (
-                compression == "bf16"
-                and jnp.issubdtype(value.dtype, jnp.floating)
-                and value.dtype != jnp.bfloat16
-                and value.size * value.dtype.itemsize > 1024
-            ):
+            if _wants_lossy(value, compression):  # the bf16 rung
                 wire = value.astype(jnp.bfloat16)
             gathered = gather_replicated(wire, axis_name)
             if wire.dtype != value.dtype:

@@ -45,11 +45,12 @@ is then a deterministic function of the surviving-rank subset alone.
 from __future__ import annotations
 
 import zlib
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from torcheval_tpu import wire as wirelib
 from torcheval_tpu.distributed import LocalReplicaGroup, ProcessGroup
 from torcheval_tpu.metrics.metric import TState
 from torcheval_tpu.resilience import (
@@ -74,13 +75,18 @@ class SyncedStates(List[MetricStates]):
     - ``sent_bytes``/``recv_bytes``: packed wire payload this rank
       shipped / the surviving ranks' payloads combined (byte accounting
       for the observability layer's ``SyncEvent`` — free, read off the
-      metadata the protocol already exchanged).
+      metadata the protocol already exchanged);
+    - ``wire_tiers``: per-metric ladder rung ACTUALLY ridden (the
+      lossiest encoding any surviving rank applied — ``"exact"`` when
+      every payload stayed raw/sparse), read off the survivors' wire
+      metadata for ``SyncProvenance.wire_tier`` stamping.
     """
 
     ranks: Tuple[int, ...] = ()
     world_size: int = 0
     sent_bytes: int = 0
     recv_bytes: int = 0
+    wire_tiers: Dict[str, str] = {}
 
     @property
     def degraded(self) -> bool:
@@ -107,8 +113,8 @@ def _is_array(x: Any) -> bool:
 # reference synclib.py:181-198) or the object value itself for "obj".
 # An array entry is (shape, dtype, enc) — enc describes the WIRE encoding:
 #   None                      raw bytes (zero-copy view on unpack);
-#   ("dense", wire_dtype)     dense cast (bf16 compression, lossy, opt-in
-#                             via config.sync_compression);
+#   ("dense", wire_dtype)     dense cast (bf16 rung, lossy, opt-in via
+#                             config.wire_ladder);
 #   ("sparse", nnz, wire_dtype)
 #                             zero-suppressed: uint32 bit-nonzero indices +
 #                             their values. LOSSLESS (bit-exact restore,
@@ -116,30 +122,99 @@ def _is_array(x: Any) -> bool:
 #                             it is always on for large mostly-zero states
 #                             — a streaming-AUROC histogram after 100
 #                             samples ships ~KBs instead of 64 KiB
-#                             (bench.py sync_payload).
+#                             (bench.py sync_payload);
+#   ("int8block", block, nblocks, nexc)
+#                             EQuARX-style blockwise int8 (wire.py): int8
+#                             values (padded to whole blocks) + one f32
+#                             scale per block — ~3.6x fewer float bytes at
+#                             block 32, max error amax(block)/254. ``nexc``
+#                             non-finite elements (±inf neutral fills, NaN)
+#                             ride as -128 sentinels + an exact-f32 side
+#                             list appended after the scales;
+#   ("sparse8", nnz, block, nexc)
+#                             the trim-then-quantize composition (ISSUE 18):
+#                             sparse uint32 indices first (the PR 3 trim),
+#                             then the TRIMMED nnz values ride the int8
+#                             blockwise codec instead of full-width floats
+#                             (same -128/side-list non-finite handling).
 _StateMeta = Tuple[str, List[Tuple[Tuple[int, ...], str, Any]], Any]
 
 # sparse is worth the nonzero scan only for payloads at least this large,
 # and only when it at least halves the wire bytes
 _SPARSE_MIN_BYTES = 4096
-# bf16 compression skips tiny payloads (counters): halving 8 bytes is noise
+# lossy rungs skip tiny payloads (counters): halving 8 bytes is noise
 _BF16_MIN_BYTES = 1024
+_INT8_MIN_BYTES = 1024
 
 _BIT_VIEWS = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
 
+# enc tag -> ladder rung actually ridden (sparse is lossless => exact)
+_ENC_TIERS = {
+    None: "exact",
+    "sparse": "exact",
+    "dense": "bf16",
+    "int8block": "int8",
+    "sparse8": "int8",
+}
+
 
 def _encode_array(
-    a: np.ndarray, compression: str
+    a: np.ndarray, compression: str, block: int = 32
 ) -> Tuple[Tuple[Tuple[int, ...], str, Any], List[np.ndarray]]:
-    """One array -> (meta entry, wire chunks). See ``_StateMeta``."""
+    """One array -> (meta entry, wire chunks). ``compression`` is a
+    ladder rung (``exact``/``off`` | ``bf16`` | ``int8``); integer
+    arrays never quantize (bit-exact at every rung). See ``_StateMeta``."""
     shape = tuple(a.shape)  # before ascontiguousarray: it promotes 0-d to 1-d
     dtype = str(a.dtype)
+    is_float = a.dtype in (np.float32, np.float64)
+    if compression == "int8" and is_float and a.nbytes >= _INT8_MIN_BYTES:
+        flat = np.ascontiguousarray(a).reshape(-1)
+        bits = _BIT_VIEWS[flat.dtype.itemsize]
+        if flat.nbytes >= _SPARSE_MIN_BYTES and flat.size < 2**32:
+            idx = np.flatnonzero(flat.view(bits))
+            if idx.size * (4 + flat.dtype.itemsize) * 2 <= flat.nbytes:
+                # trim FIRST (lossless zero-suppression), then quantize
+                # the trimmed payload — unless int8 would not shrink it
+                vals = np.ascontiguousarray(flat[idx])
+                idx32 = idx.astype(np.uint32)
+                exc = wirelib.nonfinite_exceptions(vals)
+                if (
+                    wirelib.int8_wire_bytes(idx.size, block)
+                    + 4 * exc.size
+                    < vals.nbytes
+                ):
+                    q, scales = wirelib.quantize_blockwise(vals, block)
+                    enc = (
+                        "sparse8", int(idx.size), int(block), int(exc.size)
+                    )
+                    return (shape, dtype, enc), [
+                        idx32.view(np.uint8),
+                        q.view(np.uint8),
+                        scales.view(np.uint8),
+                        exc.view(np.uint8),
+                    ]
+                enc = ("sparse", int(idx.size), str(flat.dtype))
+                return (shape, dtype, enc), [
+                    idx32.view(np.uint8),
+                    vals.view(np.uint8),
+                ]
+        # non-finite elements (neutral fills, NaN sentinels) travel as
+        # -128 sentinels + an exact-f32 side list (wire.py); quantize
+        # only while that side list keeps the encoding a net win
+        exc = wirelib.nonfinite_exceptions(flat)
+        if (
+            wirelib.int8_wire_bytes(flat.size, block) + 4 * exc.size
+            < flat.nbytes
+        ):
+            q, scales = wirelib.quantize_blockwise(flat, block)
+            enc = ("int8block", int(block), int(scales.size), int(exc.size))
+            return (shape, dtype, enc), [
+                q.view(np.uint8),
+                scales.view(np.uint8),
+                exc.view(np.uint8),
+            ]
     wire = a
-    if (
-        compression == "bf16"
-        and a.dtype in (np.float32, np.float64)
-        and a.nbytes >= _BF16_MIN_BYTES
-    ):
+    if compression == "bf16" and is_float and a.nbytes >= _BF16_MIN_BYTES:
         import ml_dtypes
 
         wire = a.astype(ml_dtypes.bfloat16)
@@ -203,18 +278,55 @@ def _decode_array(
         out = np.zeros(size, dtype=dtype)
         out[idx] = vals.astype(dtype)
         return out.reshape(shape), offset
+    if enc[0] == "int8block":
+        _, block, nblocks, nexc = enc
+        qbytes = nblocks * block
+        q = buf[offset : offset + qbytes].view(np.int8)
+        offset += qbytes
+        scales = buf[offset : offset + 4 * nblocks].view(np.float32)
+        offset += 4 * nblocks
+        exc = buf[offset : offset + 4 * nexc].view(np.float32)
+        offset += 4 * nexc
+        out = wirelib.dequantize_blockwise(q, scales, size, dtype, exc)
+        return out.reshape(shape), offset
+    if enc[0] == "sparse8":
+        _, nnz, block, nexc = enc
+        idx = buf[offset : offset + nnz * 4].view(np.uint32)
+        offset += nnz * 4
+        nblocks = -(-max(nnz, 1) // block)
+        qbytes = nblocks * block
+        q = buf[offset : offset + qbytes].view(np.int8)
+        offset += qbytes
+        scales = buf[offset : offset + 4 * nblocks].view(np.float32)
+        offset += 4 * nblocks
+        exc = buf[offset : offset + 4 * nexc].view(np.float32)
+        offset += 4 * nexc
+        out = np.zeros(size, dtype=dtype)
+        out[idx] = wirelib.dequantize_blockwise(q, scales, nnz, dtype, exc)
+        return out.reshape(shape), offset
     raise ValueError(f"unknown wire encoding {enc!r}")
 
 
 def _pack_rank_states(
     metric_states: MetricStates,
     order: List[Tuple[str, str]],
-    compression: str = "off",
+    compression: Any = "off",
 ) -> Tuple[List[_StateMeta], np.ndarray]:
     """Pack one rank's states, in traversal order, into (metadata, flat
     uint8 payload). Every tensor is flattened, wire-encoded (see
     ``_StateMeta``), and byte-concatenated; its shape/dtype/encoding ride
-    the metadata, so the payload needs no framing."""
+    the metadata, so the payload needs no framing.
+
+    ``compression`` is one ladder rung for every metric (a string — the
+    legacy single-policy form) or a per-metric ``{metric_name: rung}``
+    mapping (missing names ride ``exact``)."""
+    from torcheval_tpu import config
+
+    block = config.wire_block_size()
+    if isinstance(compression, str):
+        rung_of = dict.fromkeys({m for m, _ in order}, compression)
+    else:
+        rung_of = dict(compression)
     meta: List[_StateMeta] = []
     chunks: List[np.ndarray] = []
     for metric_name, state_name in order:
@@ -231,8 +343,9 @@ def _pack_rank_states(
         else:  # int/float (and any other picklable scalar state)
             kind, arrs, extra = "obj", [], value
         entries = []
+        rung = rung_of.get(metric_name, "exact")
         for a in arrs:
-            entry, wire_chunks = _encode_array(a, compression)
+            entry, wire_chunks = _encode_array(a, rung, block)
             entries.append(entry)
             chunks.extend(wire_chunks)
         meta.append((kind, entries, extra))
@@ -240,6 +353,27 @@ def _pack_rank_states(
         np.concatenate(chunks) if chunks else np.zeros(0, dtype=np.uint8)
     )
     return meta, flat
+
+
+def _meta_wire_tiers(
+    order: List[Tuple[str, str]], metas: List[List[_StateMeta]]
+) -> Dict[str, str]:
+    """Per-metric rung ACTUALLY ridden across the given ranks' metas:
+    the lossiest encoding any rank applied to any of the metric's
+    arrays (a metric whose payloads all stayed raw/sparse reads
+    ``"exact"`` even under an int8 policy — provenance reports what the
+    wire did, not what the config asked)."""
+    tiers: Dict[str, str] = {m: "exact" for m, _ in order}
+    for meta in metas:
+        for (metric_name, _), (_kind, entries, _extra) in zip(order, meta):
+            for entry in entries:
+                enc = entry[2]
+                tier = _ENC_TIERS[enc[0] if isinstance(enc, tuple) else None]
+                if wirelib.rung_index(tier) > wirelib.rung_index(
+                    tiers[metric_name]
+                ):
+                    tiers[metric_name] = tier
+    return tiers
 
 
 def _unpack_rank_states(
@@ -268,8 +402,30 @@ def _unpack_rank_states(
     return out
 
 
+def canonical_crc(
+    order: List[Tuple[str, str]], meta: List[_StateMeta], buf: np.ndarray
+) -> int:
+    """crc32 over the POST-DEQUANTIZE canonical bytes of a packed
+    payload: decode the wire, then re-pack at the exact rung and crc
+    that. Under a lossy wire rung the raw bytes no longer determine
+    state equality symmetrically (sender quantized, receiver
+    dequantizes), so integrity checks — federation's epoch ledger — must
+    verify what the receiver will actually MERGE, not what travelled.
+    Both sides run decode -> exact-repack on the same wire bytes, so the
+    check stays deterministic and zero-communication."""
+    template: MetricStates = {m: {} for m, _ in order}
+    states = _unpack_rank_states(
+        template, order, meta, np.asarray(buf, dtype=np.uint8)
+    )
+    _, flat = _pack_rank_states(states, order, "exact")
+    return zlib.crc32(flat.tobytes())
+
+
 def sync_states(
-    metric_states: Any, process_group: ProcessGroup
+    metric_states: Any,
+    process_group: ProcessGroup,
+    *,
+    families: Optional[Dict[str, str]] = None,
 ) -> SyncedStates:
     """Gather every rank's metric states to every rank.
 
@@ -289,10 +445,22 @@ def sync_states(
     Returns a :class:`SyncedStates`: the surviving ranks' states in
     ascending rank order, with ``.ranks``/``.degraded`` recording partial
     participation when the group degraded (see module docstring).
+
+    ``families`` maps metric names to their ladder FAMILY (metric class
+    name): each metric then rides ``wire.effective_rung(family)`` — its
+    configured ``config.wire_ladder()`` rung capped by any measured
+    drift-budget fallback. Without it every metric rides the ladder's
+    default-family rung (legacy single-policy behavior).
     """
     from torcheval_tpu import config
 
-    compression = config.sync_compression()
+    if families is None:
+        compression: Any = config.wire_rung_for("*")
+    else:
+        compression = {
+            name: wirelib.effective_rung(family)
+            for name, family in families.items()
+        }
     local_mode = isinstance(process_group.unwrap(), LocalReplicaGroup)
     template = metric_states[0] if local_mode else metric_states
     order = metrics_traversal_order(template)
@@ -401,4 +569,7 @@ def _assemble(
     out.ranks = tuple(survivors)
     out.world_size = world
     out.recv_bytes = sum(meta_by_rank[r][1] for r in survivors)
+    out.wire_tiers = _meta_wire_tiers(
+        order, [meta_by_rank[r][0] for r in survivors]
+    )
     return out

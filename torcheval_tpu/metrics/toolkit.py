@@ -279,6 +279,20 @@ def _with_admission(provenance: SyncProvenance, metric: Metric) -> SyncProvenanc
     )
 
 
+def _with_wire_tier(
+    provenance: SyncProvenance, per_rank_states, name: str
+) -> SyncProvenance:
+    """Stamp the wire-ladder rung this metric's payload ACTUALLY rode
+    (``synclib.SyncedStates.wire_tiers`` — the lossiest encoding any
+    surviving rank applied). Per-metric, like ``_with_admission``: one
+    collection may mix int8-riding histogram families with bit-exact
+    counters, and each result must name its own precision."""
+    tier = getattr(per_rank_states, "wire_tiers", {}).get(name, "exact")
+    if tier == "exact":
+        return provenance
+    return provenance._replace(wire_tier=tier)
+
+
 def get_synced_metric_collection(
     metrics: Union[Dict[str, Metric], List[Dict[str, Metric]]],
     process_group: Optional[ProcessGroup] = None,
@@ -353,8 +367,14 @@ def get_synced_metric_collection(
     if sync_on:
         sync_flow = _obs_trace.next_flow_id()
         sync_t0 = time.monotonic()
+    # per-family wire-ladder resolution (ISSUE 18): each metric rides
+    # wire.effective_rung(type name) — its configured config.wire_ladder
+    # rung capped by any measured drift-budget fallback
+    families = {name: type(m).__name__ for name, m in template.items()}
     with _obs_trace.scope_or_null("torcheval.sync", sync_on) as sync_frame:
-        per_rank_states = synclib.sync_states(payload, group)
+        per_rank_states = synclib.sync_states(
+            payload, group, families=families
+        )
 
     # degraded-result provenance: which ranks actually contributed (full
     # participation unless a ResilientGroup degraded the exchange). The
@@ -389,6 +409,12 @@ def get_synced_metric_collection(
         from torcheval_tpu.obs import hist as _obs_hist
         from torcheval_tpu.obs.events import SyncEvent
 
+        from torcheval_tpu import wire as _wire
+
+        wire_tiers = getattr(per_rank_states, "wire_tiers", {})
+        sync_tier = max(
+            wire_tiers.values(), key=_wire.rung_index, default="exact"
+        )
         sync_seconds = time.monotonic() - sync_t0
         _obs_hist.observe("sync", sync_seconds)
         _OBS.record(
@@ -403,6 +429,7 @@ def get_synced_metric_collection(
                 recv_bytes=getattr(per_rank_states, "recv_bytes", 0),
                 metrics=len(template),
                 seconds=sync_seconds,
+                wire_tier=sync_tier,
                 flow=sync_flow,
                 trace=sync_frame.trace_id,
                 span=sync_frame.span_id,
@@ -421,7 +448,9 @@ def get_synced_metric_collection(
             rank_metrics.append(clone)
         target = rank_metrics[0].to(base.device)
         target.merge_state(rank_metrics[1:])
-        target.sync_provenance = _with_admission(provenance, target)
+        target.sync_provenance = _with_wire_tier(
+            _with_admission(provenance, target), per_rank_states, name
+        )
         merged[name] = target
     return merged
 

@@ -62,6 +62,7 @@ from torcheval_tpu.obs.events import (
     StallEvent,
     SyncEvent,
     UpdateEvent,
+    WireTierEvent,
     event_from_dict,
 )
 from torcheval_tpu.obs.flight import (
@@ -197,6 +198,7 @@ __all__ = [
     "StallWatchdog",
     "SyncEvent",
     "UpdateEvent",
+    "WireTierEvent",
     "active_watches",
     "arm_monitor",
     "arm_watchdog",

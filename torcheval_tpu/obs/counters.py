@@ -90,6 +90,12 @@ def _admission_source() -> Dict[str, Any]:
     return armed_counter_source()
 
 
+def _wire_source() -> Dict[str, Any]:
+    from torcheval_tpu.wire import LADDER
+
+    return LADDER.counters()
+
+
 def _events_source() -> Dict[str, Any]:
     from torcheval_tpu.obs.recorder import RECORDER
 
@@ -194,5 +200,7 @@ def default_registry() -> CounterRegistry:
             # overload admission ladder across armed metric tables
             # (worst rung wins; zeros while nothing is armed)
             registry.register("admission", _admission_source)
+            # quantized wire ladder: configured rung + drift-breach caps
+            registry.register("wire", _wire_source)
             _DEFAULT = registry
         return _DEFAULT

@@ -53,6 +53,7 @@ __all__ = [
     "StallEvent",
     "SyncEvent",
     "UpdateEvent",
+    "WireTierEvent",
     "event_from_dict",
 ]
 
@@ -140,6 +141,10 @@ class SyncEvent(Event):
     # merged traces can link the same collective across ranks with zero
     # communication. 0 = no flow recorded.
     flow: int = 0
+    # lossiest quantized-wire-ladder rung any metric in this sync rode
+    # (wire.py: "exact" | "bf16" | "int8"); per-metric rungs ride each
+    # metric's SyncProvenance.wire_tier. New OPTIONAL field — schema 1.
+    wire_tier: str = "exact"
 
 
 @dataclass
@@ -374,6 +379,26 @@ class AlertEvent(Event):
 
 
 @dataclass
+class WireTierEvent(Event):
+    """One quantized-wire-ladder fallback (``torcheval_tpu/wire.py``): a
+    MEASURED drift-budget breach (``obs/quality.py`` ``DriftSpec``)
+    stepped ``family``'s effective wire rung one rung toward exact
+    (``prev_tier -> tier``, e.g. ``int8 -> bf16``). ``series`` names the
+    watched input series whose scoring breached; ``breach`` the
+    comma-joined breached bound kinds (``psi``/``ks``/``z``). Later
+    syncs of the family ride the new rung until
+    ``wire.LADDER.reset()`` lifts the cap (e.g. after a re-baseline)."""
+
+    kind: ClassVar[str] = "wire_tier"
+
+    family: str = ""
+    series: str = ""
+    prev_tier: str = ""
+    tier: str = ""
+    breach: str = ""
+
+
+@dataclass
 class AdmissionEvent(Event):
     """One admission-ladder rung transition (``table._admission``): the
     drain-time controller stepped ``prev_rung → rung`` on merged
@@ -399,6 +424,7 @@ _EVENT_TYPES: Dict[str, Type[Event]] = {
         AdmissionEvent,
         AlertEvent,
         DriftEvent,
+        WireTierEvent,
         AnalysisEvent,
         MemoryEvent,
         PlaneSyncEvent,

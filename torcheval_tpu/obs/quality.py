@@ -648,6 +648,19 @@ class QualityWatch:
                         raised.append(alert)
                 else:
                     monitor._clear(name, f"drift-{kind}")
+            if breaches:
+                # measured-error-budget gate for the quantized wire
+                # ladder (ISSUE 18): a drifting input family forfeits
+                # its lossy wire rung — step it toward exact and emit a
+                # WireTierEvent (no-op once already exact)
+                from torcheval_tpu import wire
+
+                metric, _arg = self._entries[series]
+                wire.note_budget_breach(
+                    type(metric).__name__,
+                    series=series,
+                    breach=",".join(breaches),
+                )
             RECORDER.record(
                 DriftEvent(
                     series=series,

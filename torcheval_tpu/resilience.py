@@ -181,6 +181,14 @@ class SyncProvenance(NamedTuple):
     sampled_fraction: float = 1.0  # Bernoulli keep probability at this rung
     admission_rung: int = 0  # 0=full 1=sampled 2=priority-shed
     admission_epoch: int = 0  # drain epoch the rung last changed
+    # quantized-wire-ladder rung the synced payload ACTUALLY rode
+    # (torcheval_tpu/wire.py; appended-defaulted like the triples above
+    # so legacy positional construction keeps working): "exact" |
+    # "bf16" | "int8" — the lossiest encoding any surviving rank
+    # applied to this metric's states. "exact" means bit-exact wire,
+    # including when a lossy policy was configured but every payload
+    # stayed raw/sparse (integer counters, tiny states).
+    wire_tier: str = "exact"
 
 
 @dataclass

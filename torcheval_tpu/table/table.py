@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torcheval_tpu import config
 from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
 from torcheval_tpu.metrics.shardspec import ShardContext
 from torcheval_tpu.table._admission import (
@@ -513,10 +514,18 @@ class MetricTable(Metric[TableValues]):
         (slot resolution + owned scatter + foreign outbox append)."""
         return self._apply_update_plan(self._update_plan(keys, *args, **kwargs))
 
-    # serving-door alias (the ISSUE-facing name)
     def ingest(self, keys: Any, *args: Any, **kwargs: Any) -> "MetricTable":
-        """Alias of :meth:`update` — the streaming ingestion front door."""
-        return self.update(keys, *args, **kwargs)
+        """The streaming ingestion front door: :meth:`update` with shape
+        bucketing armed (ROADMAP 4d). Serving traffic is ragged by
+        nature — every distinct batch length would otherwise demand its
+        own XLA program — so the serving door pads batch axes up to
+        power-of-two buckets itself instead of relying on the caller to
+        remember ``config.shape_bucketing()``. :meth:`update` remains
+        the raw, caller-controlled path."""
+        if config.shape_bucketing_enabled():
+            return self.update(keys, *args, **kwargs)
+        with config.shape_bucketing(True):
+            return self.update(keys, *args, **kwargs)
 
     def _update_plan(self, keys: Any, *args: Any, **kwargs: Any):
         if not self._is_carrier():

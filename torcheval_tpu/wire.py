@@ -1,0 +1,350 @@
+"""Quantized wire ladder: the ``exact | bf16 | int8`` payload codecs.
+
+Every sync tier ships metric state over some wire — the eager packed
+protocol (``metrics.synclib``), the in-jit EXTEND/reduce-scatter path
+(``metrics.sharded``), and the cross-region federation deltas
+(``federation.py``). This module is the ONE place that knows how a float
+payload is narrowed for that wire, as a three-rung ladder:
+
+- ``"exact"``  — raw bytes, bit-exact (the default; every sync is
+  exactness-preserving unless a family opts down the ladder);
+- ``"bf16"``   — dense bfloat16 cast, ~2x fewer bytes, ~3 significant
+  decimal digits (the historical ``config.sync_compression`` policy);
+- ``"int8"``   — EQuARX-style blockwise int8 (arxiv 2506.17615): values
+  quantize to int8 against a PER-BLOCK float32 scale
+  (``scale = amax(block) / 127``), ~3.6x fewer bytes at the default
+  32-element block, with a HARD per-element error bound of
+  ``amax(block) / 254`` (round-to-nearest of ``x / scale``).
+
+Integer payloads NEVER quantize — pure-integer counter states are
+bit-exact at every rung (the quantizer is a pass-through for them), so
+only score/histogram-bearing float families pay any precision at all.
+
+Rungs are chosen PER FAMILY (metric class name) via
+``config.wire_ladder()``; the process-wide :data:`LADDER` registry then
+caps each family's effective rung from MEASURED evidence: a
+``DriftSpec`` budget breach (``obs/quality.py``) calls
+:func:`note_budget_breach`, which steps the family one rung up the
+ladder toward ``exact`` (int8 -> bf16 -> exact) and emits a typed
+:class:`~torcheval_tpu.obs.events.WireTierEvent`. Lossiness is opt-in
+and evidence-revoked — the EQuARX posture gated by PR 13's continuously
+measured error budgets instead of assumed bounds.
+
+The numpy codec here is the eager/federation wire; the ``jnp`` twins
+(``quantize_blockwise_jit`` / ``pack_wire`` / ``unpack_wire``) are
+traceable and live INSIDE the jitted step program so the in-jit tier
+quantizes with zero added collectives (one uint8 gather replaces one
+float gather — ``metrics/sharded.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "RUNGS",
+    "LADDER",
+    "WireLadder",
+    "dequantize_blockwise",
+    "effective_rung",
+    "int8_error_bound",
+    "int8_wire_bytes",
+    "note_budget_breach",
+    "quantize_blockwise",
+    "rung_index",
+]
+
+# Least -> most lossy. "Falling back UP the ladder" means moving left.
+RUNGS: Tuple[str, ...] = ("exact", "bf16", "int8")
+
+_RUNG_INDEX = {rung: i for i, rung in enumerate(RUNGS)}
+# legacy config.sync_compression spelling for the exact rung
+_RUNG_INDEX["off"] = 0
+
+
+def rung_index(rung: str) -> int:
+    """Ladder position (0 = exact/lossless, higher = lossier).
+    Accepts the legacy ``"off"`` spelling for ``"exact"``."""
+    try:
+        return _RUNG_INDEX[rung]
+    except KeyError:
+        raise ValueError(
+            f"unknown wire rung {rung!r}; expected one of {RUNGS}"
+        ) from None
+
+
+def normalize_rung(rung: str) -> str:
+    """Canonical rung name (maps legacy ``"off"`` -> ``"exact"``)."""
+    return RUNGS[rung_index(rung)]
+
+
+# ------------------------------------------------------------ int8 codec
+
+def _nblocks(size: int, block: int) -> int:
+    return -(-max(int(size), 1) // int(block))
+
+
+def int8_wire_bytes(size: int, block: int) -> int:
+    """Wire bytes the int8 rung ships for ``size`` elements: one int8
+    per element (padded to a whole block) plus one f32 scale per block."""
+    nb = _nblocks(size, block)
+    return nb * int(block) + 4 * nb
+
+
+# The codec's scale is defined as a MULTIPLY by this f32 constant (not
+# a divide by 127): IEEE-754 pins a single multiply bit-exactly across
+# numpy and XLA, whereas XLA strength-reduces division-by-constant into
+# a reciprocal multiply that lands one ULP away from numpy's divide.
+_RECIP127 = np.float32(1.0 / 127.0)
+
+
+def quantize_blockwise(
+    a: np.ndarray, block: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Blockwise int8 quantization of a float array (numpy, eager wire).
+
+    Returns ``(q, scales)``: ``q`` int8 of shape ``[nblocks * block]``
+    (the input flattened and zero-padded to whole blocks) and ``scales``
+    float32 of shape ``[nblocks]`` with ``scale = amax(block) / 127``
+    (0.0 for all-zero blocks). Dequantization is ``q * scale``; the
+    per-element error is bounded by ``scale / 2 = amax / 254``.
+
+    Quantized codes live on ``[-127, 127]``; ``-128`` is reserved as
+    the NON-FINITE sentinel. A ``±inf`` slot (a buffer's neutral fill)
+    or NaN quantizes to ``-128``, is excluded from the block's amax (one
+    fill slot must not poison its block's scale), and its exact float32
+    value travels in a scan-order side list
+    (:func:`nonfinite_exceptions`) that
+    :func:`dequantize_blockwise` splices back — non-finite payloads
+    reconstruct EXACTLY at the int8 rung.
+    """
+    block = int(block)
+    flat = np.ascontiguousarray(a, dtype=np.float32).reshape(-1)
+    nb = _nblocks(flat.size, block)
+    padded = np.zeros(nb * block, dtype=np.float32)
+    padded[: flat.size] = flat
+    blocks = padded.reshape(nb, block)
+    finite = np.isfinite(blocks)
+    amax = np.abs(np.where(finite, blocks, 0.0)).max(axis=1)
+    scales = (amax * _RECIP127).astype(np.float32)
+    safe = np.where(scales > 0, scales, np.float32(1.0))
+    ratio = np.round(np.where(finite, blocks, 0.0) / safe[:, None])
+    q = np.clip(ratio, -127, 127).astype(np.int8)
+    q = np.where(finite, q, np.int8(-128))
+    return q.reshape(-1), scales
+
+
+def nonfinite_exceptions(a: np.ndarray) -> np.ndarray:
+    """The scan-order float32 side list of ``a``'s non-finite elements —
+    the values :func:`quantize_blockwise` marked ``-128``."""
+    flat = np.ascontiguousarray(a, dtype=np.float32).reshape(-1)
+    return flat[~np.isfinite(flat)]
+
+
+def dequantize_blockwise(
+    q: np.ndarray,
+    scales: np.ndarray,
+    size: int,
+    dtype: Any = np.float32,
+    exceptions: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Inverse of :func:`quantize_blockwise` — returns the first
+    ``size`` elements as a flat array of ``dtype``. ``exceptions`` is
+    the scan-order non-finite side list (``-128`` sentinels splice
+    their exact values back; without it sentinels read NaN)."""
+    nb = int(scales.size)
+    block = q.size // nb if nb else 0
+    out = (
+        q.reshape(nb, block).astype(np.float32)
+        * scales.astype(np.float32)[:, None]
+    ).reshape(-1)[: int(size)]
+    sentinel = np.asarray(q).reshape(-1)[: int(size)] == -128
+    if sentinel.any():
+        out[sentinel] = (
+            np.asarray(exceptions, dtype=np.float32)
+            if exceptions is not None and np.size(exceptions)
+            else np.float32(np.nan)
+        )
+    return out.astype(dtype)
+
+
+def int8_error_bound(a: np.ndarray, block: int) -> float:
+    """The codec's hard max-abs-error bound for ``a``: the largest
+    per-block ``amax / 254`` (what a round-to-nearest int8 grid with
+    ``scale = amax / 127`` can be off by, per element)."""
+    flat = np.ascontiguousarray(a, dtype=np.float32).reshape(-1)
+    if flat.size == 0:
+        return 0.0
+    nb = _nblocks(flat.size, int(block))
+    padded = np.zeros(nb * int(block), dtype=np.float32)
+    padded[: flat.size] = flat
+    blocks = padded.reshape(nb, int(block))
+    # finite-masked, mirroring quantize_blockwise: the bound claims
+    # nothing for non-finite elements (which never ride int8)
+    amax = np.abs(np.where(np.isfinite(blocks), blocks, 0.0)).max(axis=1)
+    return float(np.float64(amax.max()) / 254.0)
+
+
+# ------------------------------------------------- in-jit (traceable) twins
+
+def quantize_blockwise_jit(x, block: int):
+    """Traceable twin of :func:`quantize_blockwise` (shapes static at
+    trace time). Returns ``(q int8 [nb*block], scales f32 [nb])``."""
+    import jax.numpy as jnp
+
+    block = int(block)
+    flat = jnp.reshape(x.astype(jnp.float32), (-1,))
+    nb = _nblocks(flat.size, block)
+    padded = jnp.pad(flat, (0, nb * block - flat.size))
+    blocks = jnp.reshape(padded, (nb, block))
+    # finite-masked like the numpy twin, but with no exceptions side
+    # list (a traced shape cannot depend on the non-finite count): a
+    # non-finite element quantizes to 0. In-jit int8 therefore wants
+    # finite payloads — which EXTEND trim guarantees for the valid
+    # prefix; only neutral-fill pad slots are affected.
+    finite = jnp.isfinite(blocks)
+    amax = jnp.max(jnp.abs(jnp.where(finite, blocks, 0.0)), axis=1)
+    scales = amax * jnp.float32(_RECIP127)
+    safe = jnp.where(scales > 0, scales, jnp.float32(1.0))
+    ratio = jnp.round(jnp.where(finite, blocks, 0.0) / safe[:, None])
+    q = jnp.clip(ratio, -127, 127).astype(jnp.int8)
+    return jnp.reshape(q, (-1,)), scales
+
+
+def pack_wire(q, scales):
+    """Bit-pack ``(q int8 [n], scales f32 [nb])`` into ONE flat uint8
+    buffer (``n + 4 * nb`` bytes) — the single-gather wire layout the
+    in-jit tier ships, so quantization adds zero collectives."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    qb = lax.bitcast_convert_type(q, jnp.uint8)
+    sb = jnp.reshape(lax.bitcast_convert_type(scales, jnp.uint8), (-1,))
+    return jnp.concatenate([qb, sb])
+
+
+def unpack_wire(wire, nblocks: int, block: int):
+    """Inverse of :func:`pack_wire` for one replica's row. Returns the
+    dequantized flat float32 array of ``nblocks * block`` elements."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    n = int(nblocks) * int(block)
+    q = lax.bitcast_convert_type(wire[:n], jnp.int8)
+    sb = jnp.reshape(wire[n : n + 4 * int(nblocks)], (int(nblocks), 4))
+    scales = lax.bitcast_convert_type(sb, jnp.float32)
+    return (
+        jnp.reshape(q.astype(jnp.float32), (int(nblocks), int(block)))
+        * scales[:, None]
+    ).reshape(-1)
+
+
+# -------------------------------------------------- the fallback registry
+
+class WireLadder:
+    """Process-wide per-family effective-rung registry.
+
+    The CONFIGURED rung comes from ``config.wire_ladder()``; this
+    registry holds the measured-evidence CAP a drift-budget breach
+    imposes on top of it. ``effective_rung`` is the least lossy of the
+    two — a family never rides a lossier wire than either its
+    configuration or its error budget allows. Thread-safe: syncs read
+    while the monitor's check hook writes.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._caps: Dict[str, int] = {}  # tev: guarded-by=_lock
+
+    def effective_rung(self, family: str, configured: str) -> str:
+        ci = rung_index(configured)
+        with self._lock:
+            cap = self._caps.get(family, len(RUNGS) - 1)
+        return RUNGS[min(ci, cap)]
+
+    def cap(self, family: str) -> Optional[str]:
+        """The family's breach-imposed cap (None = never breached)."""
+        with self._lock:
+            idx = self._caps.get(family)
+        return None if idx is None else RUNGS[idx]
+
+    def note_budget_breach(
+        self, family: str, *, series: str = "", breach: str = ""
+    ) -> Optional[Tuple[str, str]]:
+        """A measured error budget was breached for ``family``: step its
+        effective rung one rung toward ``exact`` and emit a
+        :class:`~torcheval_tpu.obs.events.WireTierEvent`. Returns
+        ``(from_rung, to_rung)``, or None when already at ``exact``
+        (nothing left to fall back to — no event)."""
+        from torcheval_tpu import config
+
+        configured = config.wire_rung_for(family)
+        with self._lock:
+            cur = min(
+                rung_index(configured),
+                self._caps.get(family, len(RUNGS) - 1),
+            )
+            if cur <= 0:
+                return None
+            self._caps[family] = cur - 1
+        prev_rung, new_rung = RUNGS[cur], RUNGS[cur - 1]
+        from torcheval_tpu.obs.events import WireTierEvent
+        from torcheval_tpu.obs.recorder import RECORDER
+
+        RECORDER.record(
+            WireTierEvent(
+                family=family,
+                series=series,
+                prev_tier=prev_rung,
+                tier=new_rung,
+                breach=breach,
+            )
+        )
+        return prev_rung, new_rung
+
+    def reset(self, family: Optional[str] = None) -> None:
+        """Lift the breach cap for ``family`` (or every family) — e.g.
+        after a re-baseline (``freeze_reference``) re-arms the budget."""
+        with self._lock:
+            if family is None:
+                self._caps.clear()
+            else:
+                self._caps.pop(family, None)
+
+    def counters(self) -> Dict[str, Any]:
+        """The ``wire`` counter-source payload (flat, exporter-ready):
+        the configured ladder plus every breach-imposed family cap."""
+        from torcheval_tpu import config
+
+        with self._lock:
+            caps = dict(self._caps)
+        out: Dict[str, Any] = {
+            "default_rung": config.wire_rung_for("*"),
+            "block_size": config.wire_block_size(),
+            "fallback_families": len(caps),
+        }
+        for family, idx in sorted(caps.items()):
+            out[f"cap_{family}"] = RUNGS[idx]
+        return out
+
+
+LADDER = WireLadder()
+
+
+def effective_rung(family: str) -> str:
+    """The rung ``family`` rides RIGHT NOW: its configured ladder rung
+    (``config.wire_ladder()``) capped by any drift-breach fallback."""
+    from torcheval_tpu import config
+
+    return LADDER.effective_rung(family, config.wire_rung_for(family))
+
+
+def note_budget_breach(
+    family: str, *, series: str = "", breach: str = ""
+) -> Optional[Tuple[str, str]]:
+    """Module-level convenience for :meth:`WireLadder.note_budget_breach`
+    on the process-wide :data:`LADDER`."""
+    return LADDER.note_budget_breach(family, series=series, breach=breach)
