@@ -4149,6 +4149,205 @@ def run_wire_quant():
     }
 
 
+def run_failover():
+    """Config 21: rank-loss autopilot (ISSUE 19).
+
+    Serving-latency audit of ``torcheval_tpu.failover.FailureDomain``
+    on an in-process two-rank world:
+
+    - ``latency``: per-update serving latency, two arms run
+      STEP-INTERLEAVED in one serving loop — an unarmed collection and
+      an identical collection with a FailureDomain polling for rank
+      loss EVERY step, updated back to back with alternating order so
+      scheduler bursts hit both sample sets symmetrically. The pinned
+      statistic is the MEDIAN over TRIALS runs of the per-run
+      pooled-p99 ratio (acceptance bound ≤ 1.05×): detection rides the
+      serving update path, so arming it must be ~free;
+    - ``collectives``: the acceptance pin at the ProcessGroup
+      interface — a domain armed over a counting fake group issues
+      ZERO gathers across an update + every-step ``poll()`` +
+      ``status()`` burst. Detection reads local signals only; the
+      recovery epoch's collectives live on survivor-only subgroups
+      (pinned by tier-1, tests/metrics/test_failover.py).
+
+    Recovery/rejoin bit-identity to the elastic world-change oracle and
+    the exactly-zero-loss-on-a-committed-generation contract are tier-1
+    pins, not bench claims.
+    """
+    import threading
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from torcheval_tpu import metrics as M
+    from torcheval_tpu.distributed import ProcessGroup
+    from torcheval_tpu.failover import FailureDomain
+    from torcheval_tpu.resilience import ResilientGroup
+    from torcheval_tpu.utils.test_utils import ThreadWorld
+
+    rng = np.random.default_rng(21)
+    xa = jnp.asarray(np.float32(rng.uniform(size=(256, 16))))
+    ta = jnp.asarray(rng.integers(0, 16, 256))
+    xm = jnp.asarray(np.float32(rng.normal(size=256)))
+    STEPS, TRIALS = 4000, 7
+
+    def _panel():
+        coll = {"acc": M.MulticlassAccuracy(), "mean": M.Mean()}
+        coll["acc"].update(xa, ta)
+        coll["mean"].update(xm)
+        return coll
+
+    def _p(lat, q):
+        return float(np.percentile(lat, q) * 1e6)
+
+    # ------------------------------------------------------------ latency
+    def _trial():
+        world = ThreadWorld(2)
+        out = {}
+        bar = threading.Barrier(2)
+
+        def drive(g):
+            rg = ResilientGroup(g, timeout=5.0, retries=0)
+            off, armed = _panel(), _panel()
+            domain = FailureDomain(armed, rg, detect_after=2)
+            lat_off = np.empty(STEPS)
+            lat_armed = np.empty(STEPS)
+            poll_us = []
+
+            def seg_off():
+                t0 = time.perf_counter()
+                off["acc"].update(xa, ta)
+                off["mean"].update(xm)
+                return time.perf_counter() - t0
+
+            def seg_armed():
+                t0 = time.perf_counter()
+                armed["acc"].update(xa, ta)
+                armed["mean"].update(xm)
+                t1 = time.perf_counter()
+                dead = domain.poll()
+                poll_us.append((time.perf_counter() - t1) * 1e6)
+                assert dead == ()
+                return time.perf_counter() - t0
+
+            bar.wait()
+            for i in range(STEPS):
+                # alternate segment order so burst noise lands on both
+                # arms' samples symmetrically
+                if i % 2:
+                    lat_off[i] = seg_off()
+                    lat_armed[i] = seg_armed()
+                else:
+                    lat_armed[i] = seg_armed()
+                    lat_off[i] = seg_off()
+            bar.wait()
+            polls = domain.status()
+            domain.close()
+            if g.rank == 0:
+                out.update(
+                    off_p99=_p(lat_off, 99),
+                    off_p50=_p(lat_off, 50),
+                    armed_p99=_p(lat_armed, 99),
+                    armed_p50=_p(lat_armed, 50),
+                    poll_us=float(np.median(poll_us)),
+                    armed_state=polls["state"],
+                )
+
+        world.run(drive)
+        return out
+
+    trials = [_trial() for _ in range(TRIALS)]
+    ratio = float(
+        np.median([t["armed_p99"] / t["off_p99"] for t in trials])
+    )
+    ratio50 = float(
+        np.median([t["armed_p50"] / t["off_p50"] for t in trials])
+    )
+    med = {
+        k: float(np.median([t[k] for t in trials]))
+        for k in ("off_p99", "off_p50", "armed_p99", "armed_p50", "poll_us")
+    }
+
+    # ------------------------------------------- serving-group collectives
+    class _Counting(ProcessGroup):
+        """Two fake ranks holding this process's payload; counts calls
+        (the tests/metrics/test_sync_collective_counts.py shape)."""
+
+        def __init__(self):
+            self.gathers = 0
+
+        @property
+        def world_size(self):
+            return 2
+
+        @property
+        def rank(self):
+            return 0
+
+        @property
+        def is_member(self):
+            return True
+
+        def allgather_object(self, obj):
+            self.gathers += 1
+            import copy
+
+            return [obj, copy.deepcopy(obj)]
+
+        def allgather_array(self, x):
+            self.gathers += 1
+            x = np.asarray(x)
+            return [x, x.copy()]
+
+    serving = _Counting()
+    coll = _panel()
+    domain = FailureDomain(coll, serving, detect_after=2)
+    for _ in range(100):
+        coll["acc"].update(xa, ta)
+        coll["mean"].update(xm)
+        domain.poll()
+    domain.status()
+    armed_gathers = serving.gathers
+    domain.close()
+
+    within = ratio <= 1.05
+    return {
+        "metric": (
+            "rank-loss autopilot: detection-armed vs unarmed serving "
+            "p99 parity + serving-group collective silence"
+        ),
+        "value": round(ratio, 4),
+        "unit": "x detection-armed over unarmed serving p99 (1.0 = parity)",
+        "lower_is_better": True,
+        "latency": {
+            "trials": TRIALS,
+            "steps_per_trial": STEPS,
+            "polls_per_step": 1,
+            "armed_over_off_p99": round(ratio, 4),
+            "armed_over_off_p50": round(ratio50, 4),
+            "median_us": {k: round(v, 1) for k, v in med.items()},
+            "per_trial_p99_ratio": [
+                round(t["armed_p99"] / t["off_p99"], 4) for t in trials
+            ],
+            "armed_state_every_trial": [
+                t["armed_state"] for t in trials
+            ],
+        },
+        "collectives": {
+            "armed_serving_gathers": armed_gathers,
+            "updates_counted": 100,
+            "polls_counted": 100,
+        },
+        "acceptance": {
+            "armed_p99_within_5pct": within,
+            "zero_detection_collectives": armed_gathers == 0,
+            "armed_every_trial": all(
+                t["armed_state"] == "armed" for t in trials
+            ),
+        },
+    }
+
+
 CONFIGS = {
     "accuracy_update": (run_accuracy_update, "ref_accuracy_update"),
     "auroc_compute": (run_auroc_compute, "ref_auroc_compute"),
@@ -4170,6 +4369,7 @@ CONFIGS = {
     "async_sync": (run_async_sync, None),  # zero-stall sync plane audit
     "admission": (run_admission, None),  # overload-tolerant intake audit
     "wire_quant": (run_wire_quant, None),  # quantized-wire-ladder audit
+    "failover": (run_failover, None),  # rank-loss autopilot audit
 }
 
 _NO_REF_NOTES = {
@@ -4237,6 +4437,11 @@ _NO_REF_NOTES = {
         "quantized-wire audit — the reference has no wire codec, so the "
         "comparison is our own exact-rung payload per family"
     ),
+    "failover": (
+        "rank-loss-autopilot audit — the reference has no failure-domain "
+        "layer, so the comparison is our own detection-unarmed serving "
+        "loop"
+    ),
 }
 
 REF_FNS = {
@@ -4269,6 +4474,7 @@ _SINGLE_DEVICE_CONFIGS = {
     "accuracy_update", "auroc_compute", "text_eval", "fid", "kernels",
     "variable_batch", "sharded_state", "monitoring", "metric_table",
     "quality", "region_sync", "async_sync", "admission", "wire_quant",
+    "failover",
 }
 
 

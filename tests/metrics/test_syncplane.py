@@ -58,10 +58,11 @@ def _mean_pair():
 
 
 def test_sync_provenance_schema_pinned():
-    """The bounded-staleness triple — then the admission triple, then
-    the wire tier — extend the tuple by APPENDED, defaulted fields —
-    positional construction sites and old pickles stay valid, and the
-    field order is part of the wire schema."""
+    """The bounded-staleness triple — then the admission triple, the
+    wire tier, and the failover loss bound — extend the tuple by
+    APPENDED, defaulted fields — positional construction sites and old
+    pickles stay valid, and the field order is part of the wire
+    schema."""
     assert SyncProvenance._fields == (
         "ranks",
         "world_size",
@@ -75,6 +76,7 @@ def test_sync_provenance_schema_pinned():
         "admission_rung",
         "admission_epoch",
         "wire_tier",
+        "loss",
     )
     legacy = SyncProvenance((0, 1), 2, False, "strict")
     assert legacy.reformed is False
@@ -86,6 +88,8 @@ def test_sync_provenance_schema_pinned():
     assert legacy.sampled_fraction == 1.0
     assert legacy.admission_rung == 0
     assert legacy.admission_epoch == 0
+    # no failure domain armed: no declared loss
+    assert legacy.loss is None
 
 
 def test_sync_provenance_round_trips():

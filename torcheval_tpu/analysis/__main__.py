@@ -97,6 +97,7 @@ def _program_smoke() -> Report:
     combined.extend(_schedule_lockstep_smoke())
     combined.extend(_sync_plane_smoke())
     combined.extend(_wire_quant_smoke())
+    combined.extend(_failover_smoke())
     return combined
 
 
@@ -185,6 +186,123 @@ def _sync_plane_smoke() -> Report:
                     f"{baseline_sync} -> {armed_sync} — plane rounds "
                     "run on the dedicated communicator and must never "
                     "add, drop, or reorder serving-group collectives"
+                ),
+            )
+        )
+    return combined
+
+
+def _failover_smoke() -> Report:
+    """ISSUE 19 tentpole: the rank-loss autopilot must leave the serving
+    program untouched. With a :class:`~torcheval_tpu.failover.
+    FailureDomain` armed over the live collection, a detection poll and
+    a status read issue ZERO collectives (detection is local-signal
+    reads by contract), the watched metric's update plan is the
+    unarmed plan, and the SURVIVOR world's eager sync plan — the plan
+    serving runs on after a reform — is identical to a fresh world of
+    that size on every rank (recovery collectives live on dedicated
+    survivor subgroups, never the serving sequence)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from torcheval_tpu import metrics as M
+    from torcheval_tpu.analysis.lockstep import (
+        check_eager_lockstep,
+        eager_sync_plan,
+    )
+    from torcheval_tpu.analysis.report import Finding
+    from torcheval_tpu.failover import FailureDomain
+    from torcheval_tpu.utils.test_utils import ThreadWorld
+
+    class _Counting:
+        """Collective counter around one ThreadWorld rank view."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.calls = 0
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def allgather_object(self, obj):
+            self.calls += 1
+            return self._inner.allgather_object(obj)
+
+        def allgather_array(self, x):
+            self.calls += 1
+            return self._inner.allgather_array(x)
+
+    rng = np.random.default_rng(19)
+    x2 = jnp.asarray(rng.random((32, 5)).astype(np.float32))
+    t1 = jnp.asarray(rng.integers(0, 5, 32))
+    combined = Report(tool="program")
+    coll = {"acc": M.MulticlassAccuracy(), "mean": M.Mean()}
+    coll["acc"].update(x2, t1)
+    baseline_plan = coll["acc"]._update_plan(x2, t1)
+    survivor_world = 3  # a 4-world that lost one rank
+    fresh_sync = {
+        r: eager_sync_plan(coll, world_size=survivor_world, rank=r)
+        for r in range(survivor_world)
+    }
+    group = _Counting(ThreadWorld(1).views[0])
+    with FailureDomain({"mean": M.Mean()}, group) as domain:
+        domain.poll()
+        domain.status()
+        armed_plan = coll["acc"]._update_plan(x2, t1)
+        armed_sync = {
+            r: eager_sync_plan(coll, world_size=survivor_world, rank=r)
+            for r in range(survivor_world)
+        }
+    combined.extend(
+        check_eager_lockstep(
+            {0: fresh_sync[0], 1: armed_sync[1], 2: armed_sync[2]},
+            name="<survivor-world sync plan>",
+        )
+    )
+    combined.checked += 1
+    if group.calls != 0:
+        combined.findings.append(
+            Finding(
+                tool="program",
+                rule="failover-detect-collective",
+                path="<failover detection>",
+                message=(
+                    f"FailureDomain.poll()/status() issued {group.calls} "
+                    "collective(s) — detection must read local signals "
+                    "only, never touch the serving group's sequence"
+                ),
+            )
+        )
+    combined.checked += 1
+    if (
+        armed_plan.kernel is not baseline_plan.kernel
+        or armed_plan.state_names != baseline_plan.state_names
+    ):
+        combined.findings.append(
+            Finding(
+                tool="program",
+                rule="failover-armed-update",
+                path="<failover-armed update plan>",
+                message=(
+                    "arming a FailureDomain rewrote the metric's update "
+                    "plan — the domain subscribes to existing failure "
+                    "signals and must never touch the serving-step program"
+                ),
+            )
+        )
+    combined.checked += 1
+    if fresh_sync != armed_sync:
+        combined.findings.append(
+            Finding(
+                tool="lockstep",
+                rule="eager-plan-divergence",
+                path="<survivor-world sync plan>",
+                message=(
+                    "a FailureDomain changed the survivor-world eager "
+                    f"sync plan: {fresh_sync} -> {armed_sync} — a "
+                    "reformed world must serve the exact plan a fresh "
+                    "world of that size would"
                 ),
             )
         )

@@ -60,6 +60,7 @@ DEFAULT_TARGETS = (
     "obs",
     "resilience.py",
     "elastic.py",
+    "failover.py",
     "federation.py",
     "syncplane.py",
     "table",

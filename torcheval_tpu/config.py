@@ -428,6 +428,58 @@ def set_sync_reform_after(syncs: int) -> None:
     _sync_reform_after = int(syncs)
 
 
+# ------------------------------------------------------ rank-loss failover
+
+_FAILOVER_DETECT_AFTER_DEFAULT = 2
+_failover_detect_after: int = _env_int(
+    "TORCHEVAL_TPU_FAILOVER_DETECT_AFTER",
+    _FAILOVER_DETECT_AFTER_DEFAULT,
+    minimum=1,
+)
+
+
+def failover_detect_after() -> int:
+    """Consecutive missing-rank syncs before ``failover.FailureDomain``
+    confirms a rank loss and arms the recovery epoch (default 2 — one
+    missed sync is routinely a transient; a tripped stall watchdog
+    alongside a missing streak escalates immediately regardless).
+    Env ``TORCHEVAL_TPU_FAILOVER_DETECT_AFTER``."""
+    return _failover_detect_after
+
+
+def set_failover_detect_after(syncs: int) -> None:
+    global _failover_detect_after
+    if int(syncs) < 1:
+        raise ValueError(
+            f"failover_detect_after must be >= 1 sync, got {syncs}"
+        )
+    _failover_detect_after = int(syncs)
+
+
+_tenant_staleness: int = _env_int(
+    "TORCHEVAL_TPU_TENANT_STALENESS", 0, minimum=0
+)
+
+
+def tenant_staleness_epochs() -> int:
+    """Default per-tenant staleness budget (in drain epochs) stamped on
+    tables constructed WITHOUT an explicit ``staleness_epochs=``:
+    ``Federation.exchange_interval`` honors the tightest armed budget,
+    so one latency-sensitive tenant pulls exchanges forward for the
+    whole region. ``0`` (default) means unbudgeted — only the global
+    shed rung governs. Env ``TORCHEVAL_TPU_TENANT_STALENESS``."""
+    return _tenant_staleness
+
+
+def set_tenant_staleness_epochs(epochs: int) -> None:
+    global _tenant_staleness
+    if int(epochs) < 0:
+        raise ValueError(
+            f"tenant staleness budget must be >= 0 (0 disables), got {epochs}"
+        )
+    _tenant_staleness = int(epochs)
+
+
 # -------------------------------------------------- cross-region federation
 
 _FEDERATION_STALENESS_DEFAULT = 4

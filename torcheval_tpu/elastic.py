@@ -83,7 +83,13 @@ from torcheval_tpu.utils.checkpoint import (
     validate_state_dict,
 )
 
-__all__ = ["ElasticSession", "RestoreResult", "SCHEMA_VERSION"]
+__all__ = [
+    "ElasticSession",
+    "RestoreResult",
+    "SCHEMA_VERSION",
+    "load_shard_states",
+    "newest_committed_generation",
+]
 
 SCHEMA_VERSION = 1
 
@@ -146,6 +152,90 @@ def _assign_shards(old_world: int, new_world: int) -> List[Tuple[int, ...]]:
         out.append(tuple(range(start, start + n)))
         start += n
     return out
+
+
+def newest_committed_generation(directory: str) -> Optional[Tuple[int, str]]:
+    """The newest COMMITTED generation under an elastic snapshot
+    directory as ``(generation, path)``, or ``None`` when nothing has
+    committed. Commitment is the manifest's existence — the same atomic
+    ``os.replace`` edge :meth:`ElasticSession.restore` trusts. A reader
+    that holds no session can still locate recovery state this way
+    (``failover.FailureDomain`` rebuilds dead ranks' shards from it)."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return None
+    newest: Optional[Tuple[int, str]] = None
+    for name in names:
+        m = _GEN_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(directory, name)
+        if not os.path.exists(os.path.join(path, MANIFEST_NAME)):
+            continue
+        if newest is None or int(m.group(1)) > newest[0]:
+            newest = (int(m.group(1)), path)
+    return newest
+
+
+def load_shard_states(
+    gen_dir: str, rank: int
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Validate and load ONE rank's shard of a committed generation:
+    ``(manifest, shard tree)`` with ``tree["metrics"]`` left in plain
+    (JSON-safe) form. Runs the same checks restore applies per shard —
+    schema, manifest/rank consistency, byte length + sha256, pickle
+    decode, state digest, step agreement — but for a single rank, so a
+    failover reconstruction can pull just the dead ranks' shards without
+    paying for (or requiring the integrity of) the survivors' files.
+    Raises ``RuntimeError`` when the shard or manifest is unusable."""
+    try:
+        with open(os.path.join(gen_dir, MANIFEST_NAME)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise _BundleError(f"manifest unreadable: {e}")
+    if manifest.get("schema") != SCHEMA_VERSION:
+        raise _BundleError(
+            f"unsupported schema {manifest.get('schema')!r} "
+            f"(this build speaks {SCHEMA_VERSION})"
+        )
+    old_world = int(manifest.get("world_size", 0))
+    entries = manifest.get("shards", [])
+    if old_world < 1 or len(entries) != old_world:
+        raise _BundleError(
+            f"manifest lists {len(entries)} shards for world_size "
+            f"{old_world}"
+        )
+    entry = next(
+        (e for e in entries if int(e["rank"]) == int(rank)), None
+    )
+    if entry is None:
+        raise _BundleError(f"manifest has no shard for rank {rank}")
+    shard = os.path.join(gen_dir, ElasticSession._shard_name(int(rank)))
+    try:
+        with open(shard, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise _BundleError(f"shard {rank} unreadable: {e}")
+    if len(blob) != int(entry["bytes"]) or (
+        hashlib.sha256(blob).hexdigest() != entry["sha256"]
+    ):
+        raise _BundleError(
+            f"shard {rank} is torn or corrupt "
+            f"({len(blob)} bytes vs manifest {entry['bytes']})"
+        )
+    try:
+        tree = pickle.loads(blob)
+    except Exception as e:  # noqa: BLE001 — torn pickle
+        raise _BundleError(f"shard {rank} fails to decode: {e}")
+    if _digest(_from_plain(tree["metrics"])) != entry["state_digest"]:
+        raise _BundleError(f"shard {rank} fails its state digest")
+    if int(tree.get("step", -1)) != int(manifest["step"]):
+        raise _BundleError(
+            f"shard {rank} records step {tree.get('step')} but the "
+            f"manifest committed step {manifest['step']}"
+        )
+    return manifest, tree
 
 
 class _SnapshotWriter:

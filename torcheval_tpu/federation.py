@@ -571,6 +571,9 @@ class Federation:
         # unsorted spec list would merge regions differently per caller)
         specs.sort(key=lambda s: s.ranks[0])
         self.regions: Tuple[RegionSpec, ...] = tuple(specs)
+        # the construction-time membership (reform() filters from this,
+        # so a rejoin at the full world restores the original regions)
+        self._full_specs: Tuple[RegionSpec, ...] = self.regions
         self._group = group
         if policy not in ("quorum", "raise"):
             raise ValueError(
@@ -691,6 +694,70 @@ class Federation:
         if self.transport is not None and self._owns_transport:
             self.transport.close()
 
+    # --------------------------------------------------------------- reform
+
+    def reform(
+        self,
+        survivors: Sequence[int],
+        process_group: Optional[ProcessGroup] = None,
+    ) -> None:
+        """Re-form region membership onto the surviving ranks — the
+        federation leg of a ``failover.FailureDomain`` recovery (and of
+        the later rejoin, where ``survivors`` is the full rank range
+        again and the construction-time regions are restored).
+
+        Every surviving member calls this with the same survivor set.
+        Each region keeps its surviving ranks (ranks stay numbered in
+        the CONSTRUCTION group — subgroups re-derive from it, so a
+        shrunken region's intra-region sync simply excludes the dead); a
+        region whose ranks all died leaves the federation entirely.
+        Leadership falls to each region's lowest surviving rank. A
+        (re)installed leader marks every link ``force_full``: its delta
+        bases died with the old leader, and the existing ``resync``
+        anti-entropy + full-snapshot first contact rebuild them — no new
+        protocol. Barrier-free: no collective is issued here; the next
+        ``exchange()`` runs the first one on the re-formed region group.
+
+        ``process_group`` is accepted for interface symmetry with
+        :meth:`torcheval_tpu.syncplane.SyncPlane.reform` and ignored:
+        region specs are bound to construction-group numbering."""
+        del process_group
+        if not self.is_member:
+            return
+        self._check_open()
+        alive = tuple(sorted(int(r) for r in survivors))
+        me = self._group.rank
+        if me not in alive:
+            raise ValueError(
+                f"rank {me} is not among the surviving ranks {alive}"
+            )
+        specs = [
+            RegionSpec(s.name, tuple(r for r in s.ranks if r in alive))
+            for s in self._full_specs
+        ]
+        specs = [s for s in specs if s.ranks]
+        specs.sort(key=lambda s: s.ranks[0])
+        self.regions = tuple(specs)
+        mine = next(s for s in self.regions if me in s.ranks)
+        self.my_region = mine
+        self.region_group = self._group.new_subgroup(mine.ranks)
+        self.is_leader = me == mine.ranks[0]
+        peers = tuple(s.name for s in self.regions if s.name != mine.name)
+        self._links = {
+            name: self._links.get(name) or _LinkState(name)
+            for name in peers
+        }
+        if self.is_leader:
+            # whether newly installed or continuing: ship full snapshots
+            # until fresh acks re-establish delta bases (a continuing
+            # leader's bases may predate the peers' own reforms)
+            for link in self._links.values():
+                link.force_full = True
+            self._last_broadcast = {}
+            register = getattr(self.transport, "register_peers", None)
+            if register is not None:
+                register(mine.name, list(peers))
+
     # ---------------------------------------------------------- status reads
 
     def region_statuses(self) -> Tuple[RegionStatus, ...]:
@@ -768,8 +835,13 @@ class Federation:
 
     def exchange_interval(self, base: int) -> int:
         """Steps between federation rounds under the current admission
-        ladder: ``base`` while ingest is healthy, halved per armed
-        degradation rung (``base >> rung``, floor 1).
+        ladder AND the tightest armed per-tenant staleness budget:
+        ``base`` while ingest is healthy, halved per armed degradation
+        rung (``base >> rung``), then capped at the smallest
+        ``staleness_epochs=`` any armed table declared
+        (:func:`torcheval_tpu.table.tightest_staleness_budget`) — a
+        latency-sensitive tenant pulls exchanges forward for its whole
+        region instead of riding the global shed rung only. Floor 1.
 
         Overload and WAN cadence pull the SAME lever in opposite
         directions: an overloaded region is exactly the one whose
@@ -780,11 +852,16 @@ class Federation:
         ``exchange()`` on a step cadence poll this between rounds; the
         decision is per-region local state, no collective."""
         from torcheval_tpu.table._admission import max_armed_rung
+        from torcheval_tpu.table.table import tightest_staleness_budget
 
         base = int(base)
         if base < 1:
             raise ValueError(f"base interval must be >= 1, got {base}")
-        return max(1, base >> max_armed_rung())
+        interval = max(1, base >> max_armed_rung())
+        budget = tightest_staleness_budget()
+        if budget:
+            interval = min(interval, max(1, int(budget)))
+        return interval
 
     # -------------------------------------------------------------- exchange
 

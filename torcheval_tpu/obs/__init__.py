@@ -52,6 +52,7 @@ from torcheval_tpu.obs.events import (
     ComputeEvent,
     DriftEvent,
     Event,
+    FailoverEvent,
     MemoryEvent,
     PlaneSyncEvent,
     RegionSyncEvent,
@@ -174,6 +175,7 @@ __all__ = [
     "Event",
     "EventLog",
     "EwmaStat",
+    "FailoverEvent",
     "FlightDiff",
     "FlightRecord",
     "FlightRecorder",

@@ -43,6 +43,7 @@ __all__ = [
     "ComputeEvent",
     "DriftEvent",
     "Event",
+    "FailoverEvent",
     "MemoryEvent",
     "PlaneSyncEvent",
     "RegionSyncEvent",
@@ -418,12 +419,36 @@ class AdmissionEvent(Event):
     epoch: int = 0
 
 
+@dataclass
+class FailoverEvent(Event):
+    """One phase of a ``failover.FailureDomain`` rank-loss recovery:
+    ``action`` walks ``detected`` (loss confirmed from local signals) →
+    ``reconstructed`` (dead ranks' partitioned state rebuilt over the
+    survivors, loss bound declared) → ``reformed`` (every communicator
+    re-formed to the survivor world) → ``rejoined`` (live re-entry at
+    the full world, no process restart). ``world_size`` is the world the
+    domain serves AFTER the phase; ``loss_steps``/``loss_epochs`` and
+    the source ``generation`` mirror the declared ``LossBound``."""
+
+    kind: ClassVar[str] = "failover"
+
+    action: str = ""
+    dead_ranks: Tuple[int, ...] = ()
+    survivors: Tuple[int, ...] = ()
+    world_size: int = 0
+    generation: int = -1
+    loss_steps: int = 0
+    loss_epochs: int = 0
+    seconds: float = 0.0
+
+
 _EVENT_TYPES: Dict[str, Type[Event]] = {
     cls.kind: cls
     for cls in (
         AdmissionEvent,
         AlertEvent,
         DriftEvent,
+        FailoverEvent,
         WireTierEvent,
         AnalysisEvent,
         MemoryEvent,

@@ -48,12 +48,16 @@ __all__ = [
 def healthz_payload() -> Dict[str, Any]:
     """The ``/healthz`` body: watchdog + flight + quorum/sync +
     federation-staleness + sync-plane-staleness + admission-ladder +
-    alert status with an overall ``status`` of ``ok`` / ``stalled`` /
-    ``stale-region`` / ``stale-plane`` / ``alerting`` / ``shedding`` /
-    ``degraded`` (first match wins; ``shedding`` — an armed
+    failover + alert status with an overall ``status`` of ``ok`` /
+    ``stalled`` / ``stale-region`` / ``stale-plane`` / ``alerting`` /
+    ``shedding`` / ``degraded-world`` / ``degraded`` (first match wins;
+    ``shedding`` — an armed
     :class:`~torcheval_tpu.table.AdmissionController` above the full
     rung — does NOT fail the probe: a shedding intake still serves
-    reweighted numbers;
+    reweighted numbers; ``degraded-world`` — a
+    :class:`~torcheval_tpu.failover.FailureDomain` recovery in flight or
+    a world re-formed onto survivors — likewise stays 200: the
+    survivors serve with the loss declared in provenance;
     ``stalled``, ``stale-region``, ``stale-plane`` and ``alerting`` fail
     the probe — a region staler than the federation's ``staleness_503``
     bound means the "global" numbers this process serves silently
@@ -86,6 +90,7 @@ def healthz_payload() -> Dict[str, Any]:
             "full_syncs": health.full_syncs,
             "consecutive_missing": list(health.consecutive_missing),
             "reforms": health.reforms,
+            "reformed_to": list(health.reformed_to),
         }
     federation: Dict[str, Any] = {"armed": 0}
     stale_region = False
@@ -120,6 +125,18 @@ def healthz_payload() -> Dict[str, Any]:
     from torcheval_tpu.table._admission import shedding_status
 
     admission = shedding_status()
+    from torcheval_tpu.failover import current_domain
+
+    domain = current_domain()
+    failover: Dict[str, Any] = (
+        domain.status() if domain is not None else {"armed": 0}
+    )
+    # a rank-loss recovery in flight (or a world serving on a reformed
+    # survivor subgroup) is GRACEFUL like shedding: the survivors still
+    # serve, with loss declared in provenance — the probe stays 200
+    world_degraded = bool(sync["reformed_to"]) or (
+        domain is not None and domain.state != "armed"
+    )
     stalled = wd is not None and wd.tripped
     degraded = bool(sync["consecutive_missing"])
     if stalled:
@@ -136,6 +153,8 @@ def healthz_payload() -> Dict[str, Any]:
         # probe stays 200 — but the rung is visible to dashboards and
         # the status string tells an operator why variance grew
         status = "shedding"
+    elif world_degraded:
+        status = "degraded-world"
     elif degraded:
         status = "degraded"
     else:
@@ -150,6 +169,7 @@ def healthz_payload() -> Dict[str, Any]:
         "federation": federation,
         "syncplane": plane,
         "admission": admission,
+        "failover": failover,
         "alerts": alerts,
     }
 

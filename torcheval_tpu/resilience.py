@@ -189,6 +189,18 @@ class SyncProvenance(NamedTuple):
     # including when a lossy policy was configured but every payload
     # stayed raw/sparse (integer counters, tiny states).
     wire_tier: str = "exact"
+    # rank-loss declaration (appended-defaulted like the fields above):
+    # a :class:`torcheval_tpu.failover.LossBound` once a FailureDomain
+    # recovery rebuilt state after losing ranks — steps/epochs of the
+    # dead ranks' updates that were unrecoverable since the committed
+    # generation the reconstruction drew from. ``None`` means no rank
+    # was ever lost; a bound with ``exact=True`` means ranks WERE lost
+    # but the kill landed on a generation boundary and nothing is
+    # missing. The bound is permanent: post-recovery drains re-stamp it
+    # (FailureDomain.stamp), so every later compute() carries honest
+    # loss provenance. Typed ``Any`` to keep this module free of a
+    # failover import; the value is always None or a LossBound.
+    loss: Any = None
 
 
 @dataclass

@@ -180,14 +180,17 @@ class SyncPlane:
             raise ValueError(
                 "this process is not a member of the given process group"
             )
-        self._group = group
-        self._comm: ProcessGroup = self._dedicated_comm(
+        self._group = group  # tev: disable=unguarded-state -- reassigned only by reform() under the _round_lock quiesce fence (no round in flight across the swap); every other write is __init__
+        # kept so a failover reform can derive a fresh dedicated
+        # communicator for the survivor world with IDENTICAL semantics
+        self._comm_knobs: Dict[str, Any] = dict(
             timeout=timeout,
             retries=retries,
             policy=policy,
             quorum=quorum,
             reform_after=reform_after,
         )
+        self._comm: ProcessGroup = self._dedicated_comm(**self._comm_knobs)  # tev: disable=unguarded-state -- reassigned only by reform() under the _round_lock quiesce fence; the round thread reads it inside the same fence
         if interval is not None and interval <= 0:
             raise ValueError(f"interval must be > 0 seconds, got {interval}")
         self.interval = interval
@@ -572,6 +575,25 @@ class SyncPlane:
             self._published = None
             self._merged = None
             self._history.clear()
+
+    def reform(self, process_group: ProcessGroup) -> None:
+        """Move the plane onto a new world (``failover.FailureDomain``
+        reform: the survivor subgroup after a rank loss, or the full
+        group again at rejoin). Holds the quiesce fence so no round is
+        in flight across the swap, derives a fresh dedicated
+        communicator with the SAME resilience knobs the plane was
+        constructed with, and invalidates every snapshot — they describe
+        a world that no longer exists. Barrier-free: the swap itself
+        issues no collective (the new communicator's first rendezvous is
+        the next round's readiness gather)."""
+        if not process_group.is_member:
+            raise ValueError(
+                "this process is not a member of the new process group"
+            )
+        with self._round_lock:
+            self._group = process_group
+            self._comm = self._dedicated_comm(**self._comm_knobs)
+            self.invalidate()
 
     def staleness(self) -> Dict[str, Any]:
         """The plane's staleness surface (healthz / counters): freshest

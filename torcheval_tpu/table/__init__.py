@@ -14,7 +14,11 @@ from torcheval_tpu.table._admission import (
 from torcheval_tpu.table._families import FAMILIES, TableFamily
 from torcheval_tpu.table._hash import hash_keys, owner_of
 from torcheval_tpu.table.panel import PanelValues, TablePanel
-from torcheval_tpu.table.table import MetricTable, TableValues
+from torcheval_tpu.table.table import (
+    MetricTable,
+    TableValues,
+    tightest_staleness_budget,
+)
 
 __all__ = [
     "FAMILIES",
@@ -31,4 +35,5 @@ __all__ = [
     "hash_keys",
     "owner_of",
     "shedding_status",
+    "tightest_staleness_budget",
 ]

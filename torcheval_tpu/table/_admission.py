@@ -230,6 +230,15 @@ class AdmissionController:
         priority_keys: keys admitted at EVERY rung with probability 1
             (and HT weight 1 — they are never reweighted). Hashed once,
             membership-tested per batch.
+        priority_reservoir: when > 0, the priority set is LEARNED online
+            instead of (or on top of) the static seed: at every drain
+            commit a weighted reservoir (Efraimidis–Spirakis, splitmix64
+            keyed on the merged drain epoch — stateless and so
+            bit-identical on every rank and across world sizes) draws
+            the top-``priority_reservoir`` keys by traffic from the
+            merged table and REPLACES the priority hash set. The static
+            ``priority_keys`` seed only governs drains before the first
+            commit. 0 (default) keeps the static set forever.
         enter_pressure: pressure at or above which the ladder escalates
             one rung at the next drain.
         exit_pressure: pressure at or below which a drain counts as calm
@@ -252,6 +261,7 @@ class AdmissionController:
         sample_p: float = 0.1,
         floor_p: float = 0.01,
         priority_keys: Any = None,
+        priority_reservoir: int = 0,
         enter_pressure: float = 0.9,
         exit_pressure: float = 0.6,
         cooldown_drains: int = 2,
@@ -282,7 +292,12 @@ class AdmissionController:
             raise ValueError(
                 f"cooldown_drains must be >= 1, got {cooldown_drains}"
             )
+        if int(priority_reservoir) < 0:
+            raise ValueError(
+                f"priority_reservoir must be >= 0, got {priority_reservoir}"
+            )
         self.budget = budget
+        self.priority_reservoir = int(priority_reservoir)
         self.sample_p = float(sample_p)
         self.floor_p = float(floor_p)
         self.enter_pressure = float(enter_pressure)
@@ -395,6 +410,8 @@ class AdmissionController:
         table.admission_rung = rung
         table.admission_calm = calm
         table.pressure_peak = 0.0
+        if self.priority_reservoir > 0:
+            self._refresh_reservoir(table)
         if rung != prev:
             # the new rung takes effect at the post-drain epoch
             table.admission_epoch = int(table.epoch) + 1
@@ -407,6 +424,69 @@ class AdmissionController:
         monitor = current_monitor()
         if monitor is not None:
             monitor.observe("admission/pressure", pressure)
+
+    def _refresh_reservoir(self, table: Any) -> None:
+        """Online priority set: one weighted-reservoir draw over the
+        MERGED pre-eviction key union (Efraimidis–Spirakis — each key
+        scores ``log(u)/w`` for a splitmix64 uniform ``u`` keyed on the
+        drain epoch; the top ``priority_reservoir`` scores win). Inputs
+        are merged state + the stateless hash, so every rank — and any
+        world size replaying the same traffic — draws the same set."""
+        n = int(table.n_keys)
+        if n == 0:
+            return
+        keys = np.asarray(table._keys[:n], np.uint64)
+        fields = table.family.fields
+        for name in ("weight", "count", "num_examples"):
+            if name in fields:
+                w_field = name
+                break
+        else:
+            w_field = fields[-1]
+        if table.family.window:
+            # windowed commit already folded the pending columns into
+            # the ring (and zeroed them) — weight by window-total traffic
+            w = np.abs(
+                np.asarray(getattr(table, f"ring_{w_field}")[:n], np.float64)
+            ).sum(axis=1)
+        else:
+            w = np.abs(
+                np.asarray(getattr(table, f"col_{w_field}")[:n], np.float64)
+            )
+        salt = _splitmix64(
+            np.asarray(
+                [int(table.epoch) & 0xFFFFFFFFFFFFFFFF], np.uint64
+            )
+        )[0]
+        u = (_splitmix64(keys ^ salt).astype(np.float64) + 1.0) / _TWO64
+        score = np.where(w > 0.0, np.log(u) / np.maximum(w, 1e-300), -np.inf)
+        k = min(self.priority_reservoir, n)
+        top = np.argsort(score, kind="stable")[n - k :]
+        winners = keys[top]
+        winners = winners[np.isfinite(score[top])]
+        self._priority_hashes = np.sort(winners)
+
+    def rescale_world(self, old_world: int, new_world: int) -> None:
+        """Rescale the outbox budget to a reformed world (failover
+        reform / rejoin). The outbox holds rows bound for FOREIGN
+        owners — an expected ``(world-1)/world`` fraction of uniform
+        traffic — so the same per-rank intake fills it in proportion
+        to that fraction. Keys and p99 budgets are world-independent
+        and untouched. No-op for unset budgets or degenerate worlds."""
+        b = self.budget
+        old_world = int(old_world)
+        new_world = int(new_world)
+        if (
+            b.max_outbox is None
+            or old_world == new_world
+            or old_world <= 1
+            or new_world <= 1
+        ):
+            return
+        ratio = ((new_world - 1) / new_world) / ((old_world - 1) / old_world)
+        self.budget = b._replace(
+            max_outbox=max(1, int(round(int(b.max_outbox) * ratio)))
+        )
 
     def _record_transition(
         self, table: Any, prev: int, rung: int, pressure: float

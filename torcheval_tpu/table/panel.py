@@ -230,6 +230,7 @@ class TablePanel(MetricTable):
         max_keys: Optional[int] = None,
         repr_limit: int = 4096,
         admission: Optional[AdmissionController] = None,
+        staleness_epochs: Optional[int] = None,
         device: Optional[Any] = None,
     ) -> None:
         parsed = _parse_members(families)
@@ -290,6 +291,7 @@ class TablePanel(MetricTable):
             max_keys=max_keys,
             repr_limit=repr_limit,
             admission=admission,
+            staleness_epochs=staleness_epochs,
             device=device,
         )
         self._members = [

@@ -46,6 +46,8 @@ tenancy scoping, and limits.
 from __future__ import annotations
 
 import re
+import threading
+import weakref
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -70,11 +72,31 @@ from torcheval_tpu.table._hash import (
     split_planes,
 )
 
-__all__ = ["MetricTable", "TableValues"]
+__all__ = ["MetricTable", "TableValues", "tightest_staleness_budget"]
 
 _MIN_SLOTS = 8
 _MIN_OUTBOX = 64
 _SENT32 = np.uint32(0xFFFFFFFF)
+
+# tables that declared a per-tenant staleness budget, for
+# federation.exchange_interval (mirrors the _admission._ARMED registry)
+_BUDGETED_LOCK = threading.Lock()
+_BUDGETED: "weakref.WeakSet[Any]" = weakref.WeakSet()  # tev: guarded-by=_BUDGETED_LOCK
+
+
+def tightest_staleness_budget() -> int:
+    """The smallest ``staleness_epochs=`` any LIVE table declared (0
+    when none did — weakly held, so GC'd tenants stop constraining the
+    cadence). ``Federation.exchange_interval`` caps its answer at this
+    budget: the tightest tenant's tolerance governs the whole region's
+    drain cadence, not just the global shed rung."""
+    with _BUDGETED_LOCK:
+        budgets = [
+            int(t.staleness_epochs)
+            for t in _BUDGETED
+            if getattr(t, "staleness_epochs", None)
+        ]
+    return min(budgets, default=0)
 
 
 def _pow2(n: int, floor: int) -> int:
@@ -141,7 +163,7 @@ def _device_lookup(tbl_hi, tbl_lo, khi, klo):
 # one stable transform per (row_kernel, rank, world, n_fields, masked):
 # the _fuse jit caches key on the kernel OBJECT, so it must not be
 # rebuilt per call (the shardspec._ROUTE_KERNEL_CACHE discipline)
-_INGEST_KERNEL_CACHE: Dict[Any, Any] = {}
+_INGEST_KERNEL_CACHE: Dict[Any, Any] = {}  # tev: disable=unguarded-state -- idempotent memo keyed by immutable config: two racers compute the same transform and one insert wins, worst case a duplicate build
 
 
 def _ingest_kernel(
@@ -218,7 +240,7 @@ def _ingest_kernel(
 
 # one stable wrapper per row kernel (same identity discipline as
 # _INGEST_KERNEL_CACHE: the jit cache keys on the kernel object)
-_ADMISSION_KERNEL_CACHE: Dict[Any, Any] = {}
+_ADMISSION_KERNEL_CACHE: Dict[Any, Any] = {}  # tev: disable=unguarded-state -- idempotent memo keyed by the kernel object: racers build identical wrappers and one insert wins
 
 
 def _admission_row_kernel(row_kernel):
@@ -266,6 +288,12 @@ class MetricTable(Metric[TableValues]):
             to arm at construction (equivalent to
             :meth:`arm_admission`; its budget's ``max_keys`` installs
             the shared eviction bound).
+        staleness_epochs: per-tenant staleness budget in drain epochs —
+            the most federated-exchange rounds this tenant tolerates
+            between drains. ``Federation.exchange_interval`` honors the
+            TIGHTEST live budget (0 = unbudgeted; ``None`` defers to
+            ``config.tenant_staleness_epochs()``, env
+            ``TORCHEVAL_TPU_TENANT_STALENESS``).
         **family_kwargs: family knobs (``k=`` for hit_rate,
             ``window=``/``from_logits=`` for windowed_ne).
 
@@ -296,6 +324,7 @@ class MetricTable(Metric[TableValues]):
         max_keys: Optional[int] = None,
         repr_limit: int = 4096,
         admission: Optional[AdmissionController] = None,
+        staleness_epochs: Optional[int] = None,
         device: Optional[Any] = None,
         **family_kwargs: Any,
     ) -> None:
@@ -322,6 +351,21 @@ class MetricTable(Metric[TableValues]):
             raise ValueError(f"max_keys must be >= 1, got {max_keys}")
         self.ttl = None if ttl is None else int(ttl)
         self.max_keys = None if max_keys is None else int(max_keys)
+        # per-tenant staleness budget (drain epochs this tenant will
+        # tolerate between federated exchanges; configuration, not
+        # state — it does not sync or persist). None defers to the
+        # config default; 0 means unbudgeted.
+        if staleness_epochs is None:
+            staleness_epochs = config.tenant_staleness_epochs()
+        if int(staleness_epochs) < 0:
+            raise ValueError(
+                "staleness_epochs must be >= 0 (0 disables), got "
+                f"{staleness_epochs}"
+            )
+        self.staleness_epochs = int(staleness_epochs)
+        if self.staleness_epochs:
+            with _BUDGETED_LOCK:
+                _BUDGETED.add(self)
         # best-effort original-key reprs (Prometheus scrape labels) are
         # CAPPED per rank: at serving scale (100k+ integer keys) an
         # unbounded host dict would dominate table memory and every sync

@@ -14,6 +14,12 @@ from torcheval_tpu.utils.test_utils.fault_injection import (
     corrupt_shard,
     truncate_shard,
 )
+from torcheval_tpu.utils.test_utils.kill_schedule import (
+    KILL_POINTS,
+    KillGroup,
+    KillSchedule,
+    KillSpec,
+)
 from torcheval_tpu.utils.test_utils.metric_class_tester import (
     MetricClassTester,
 )
@@ -43,6 +49,10 @@ __all__ = [
     "FaultInjectionGroup",
     "FaultSpec",
     "InjectedCrash",
+    "KILL_POINTS",
+    "KillGroup",
+    "KillSchedule",
+    "KillSpec",
     "LinkFaultSpec",
     "SnapshotCrashPlan",
     "corrupt_manifest_digest",
