@@ -2284,6 +2284,194 @@ def run_metric_table():
     }
 
 
+def run_decode_stream():
+    """Config 22: streaming decode-step table (ISSUE 20).
+
+    Serving-scale audit of ``torcheval_tpu.table.StreamTable`` at the
+    acceptance size — 10,000 concurrent requests:
+
+    - ``decode``: steady-state decode rows/sec of the one-dispatch
+      fused step ingest on a WARMED table, 4096 active rows per step
+      drawn from the 10k in-flight set, min-of-rounds wall with the
+      result blocked. Two arms: ``logprob_edit`` (perplexity + token
+      edit — the pure device path) and ``with_ngram_mirror`` (adds the
+      ngram member, whose per-request count planes are host-mirror
+      folds by design — the honest host-side cost of BLEU-style
+      overlap on the decode path);
+    - ``retrace``: CompileCounter over fresh ragged active-set sizes —
+      including finish retirements and the empty decode tail — on a
+      warmed bucketed table must stay 0 (the acceptance pin);
+    - ``memory``: ``logical_bytes`` vs ``per_rank_bytes`` through
+      ``obs.memory_report`` at the post-adopt world-4 steady state
+      under per-request rank affinity, with ``per_rank_within_band``
+      pinning per-rank state inside ``[logical/(2*world),
+      2*logical/world]`` (the pow2 slot-capacity band, as the
+      metric_table config).
+
+    Bit-identity of keyed values vs the standalone streaming metrics is
+    pinned by tier-1 (tests/table/test_stream_table.py), not re-proven
+    here.
+    """
+    import jax
+    import numpy as np
+
+    from torcheval_tpu import config as tev_config
+    from torcheval_tpu.metrics import ShardContext
+    from torcheval_tpu.obs.memory import (
+        logical_state_bytes,
+        per_rank_state_bytes,
+    )
+    from torcheval_tpu.table import StreamTable, hash_keys, owner_of
+    from torcheval_tpu.utils import CompileCounter
+
+    n_requests = 10_000
+    batch = 4096
+    rounds = 20
+    world = 4
+    rng = np.random.default_rng(22)
+    ids = np.arange(n_requests, dtype=np.int64)
+    out = {
+        "concurrent_requests": n_requests,
+        "batch_rows": batch,
+        "rounds": rounds,
+        "world": world,
+    }
+
+    def _step_batch(n):
+        return (
+            rng.integers(0, n_requests, n),
+            rng.integers(0, 50, n).astype(np.int32),
+            (-rng.uniform(0.01, 3.0, n)).astype(np.float32),
+            rng.integers(0, 50, n).astype(np.int32),
+        )
+
+    def _decode_rate(members):
+        t = StreamTable(members=members, repr_limit=0)
+        # admit the whole in-flight set up front (steady state: every
+        # request already has a slot and a host-mirror entry), then warm
+        # the 4096-row step program
+        t.ingest(
+            ids,
+            step_tokens=np.zeros(n_requests, np.int32),
+            logprobs=np.zeros(n_requests, np.float32),
+            ref_tokens=np.zeros(n_requests, np.int32),
+        )
+        for _ in range(2):
+            b = _step_batch(batch)
+            t.ingest(
+                b[0], step_tokens=b[1], logprobs=b[2], ref_tokens=b[3]
+            )
+        walls = []
+        for _ in range(rounds):
+            b = _step_batch(batch)
+            t0 = time.perf_counter()
+            t.ingest(
+                b[0], step_tokens=b[1], logprobs=b[2], ref_tokens=b[3]
+            )
+            jax.block_until_ready(t.col_logprob__nll)
+            walls.append(time.perf_counter() - t0)
+        best = min(walls)
+        return {
+            "min_us_per_step": round(best * 1e6, 1),
+            "rows_per_sec": round(batch / best),
+            "active_requests": t.active_requests,
+        }
+
+    out["decode"] = {
+        "logprob_edit": _decode_rate(("logprob", "token_edit")),
+        "with_ngram_mirror": _decode_rate(
+            ("logprob", "token_edit", "ngram")
+        ),
+    }
+
+    # ---- retrace audit: warmed bucketed table, fresh ragged active
+    # sets with finish retirements and an empty tail mixed in
+    keyspace = 400
+    with tev_config.shape_bucketing():
+        t = StreamTable(
+            members=("logprob", "token_edit", "ngram"), repr_limit=0
+        )
+
+        def feed(r, sizes):
+            for n in sizes:
+                rq = r.integers(0, keyspace, n)
+                t.ingest(
+                    rq,
+                    step_tokens=r.integers(0, 50, n).astype(np.int32),
+                    logprobs=(-r.uniform(0.01, 3.0, n)).astype(np.float32),
+                    ref_tokens=r.integers(0, 50, n).astype(np.int32),
+                )
+                if n > 8:
+                    t.finish(rq[: n // 3])
+
+        t.ingest(
+            np.arange(keyspace),
+            step_tokens=np.zeros(keyspace, np.int32),
+            logprobs=np.zeros(keyspace, np.float32),
+            ref_tokens=np.zeros(keyspace, np.int32),
+        )
+        feed(
+            np.random.default_rng(1),
+            (64, 33, 17, 128, 5, 1, 0, 200, 96, 48, 7),
+        )
+        with CompileCounter() as cc:
+            feed(np.random.default_rng(2), (77, 3, 0, 250, 19, 1, 130, 42))
+        fresh_programs = cc.programs
+    out["retrace"] = {
+        "fresh_ragged_programs": fresh_programs,
+        "zero_retrace": fresh_programs == 0,
+    }
+
+    # ---- memory at the post-adopt world-4 steady state (in-process
+    # emulation under per-request rank affinity)
+    import copy as _copy
+
+    hk = hash_keys(ids)
+    tables = []
+    for r in range(world):
+        t = StreamTable(
+            members=("logprob", "token_edit"),
+            shard=ShardContext(r, world),
+            repr_limit=0,
+        )
+        mine = ids[owner_of(hk, world) == r]
+        t.ingest(
+            mine,
+            step_tokens=np.zeros(mine.size, np.int32),
+            logprobs=np.full(mine.size, -0.5, np.float32),
+            ref_tokens=np.zeros(mine.size, np.int32),
+        )
+        tables.append(t)
+    merged = _copy.deepcopy(tables[0])
+    merged.merge_state([_copy.deepcopy(x) for x in tables[1:]])
+    payload = merged.state_dict()
+    tables[0].load_state_dict(payload)
+    logical = sum(logical_state_bytes(tables[0]).values())
+    per_rank = sum(per_rank_state_bytes(tables[0]).values())
+    out["memory"] = {
+        "logical_bytes": logical,
+        "per_rank_bytes": per_rank,
+        "per_rank_over_logical": round(per_rank / logical, 3),
+        "per_rank_within_band": (
+            logical // (2 * world) <= per_rank <= 2 * logical // world
+        ),
+    }
+
+    out["acceptance"] = {
+        "zero_retrace": out["retrace"]["zero_retrace"],
+        "per_rank_within_band": out["memory"]["per_rank_within_band"],
+    }
+    return {
+        "metric": (
+            f"streaming decode-step table: rows/sec at {n_requests:,} "
+            "concurrent requests + zero-retrace ragged active sets"
+        ),
+        "value": out["decode"]["logprob_edit"]["rows_per_sec"],
+        "unit": "decode rows/sec (4096-row steps, logprob+edit members)",
+        "decode_stream": out,
+    }
+
+
 def run_region_sync():
     """Config 17: cross-region federation (ISSUE 14).
 
@@ -4370,6 +4558,7 @@ CONFIGS = {
     "admission": (run_admission, None),  # overload-tolerant intake audit
     "wire_quant": (run_wire_quant, None),  # quantized-wire-ladder audit
     "failover": (run_failover, None),  # rank-loss autopilot audit
+    "decode_stream": (run_decode_stream, None),  # streaming decode-step audit
 }
 
 _NO_REF_NOTES = {
@@ -4442,6 +4631,11 @@ _NO_REF_NOTES = {
         "layer, so the comparison is our own detection-unarmed serving "
         "loop"
     ),
+    "decode_stream": (
+        "streaming decode-step audit — the reference has no keyed "
+        "streaming collection, so the comparison is our own "
+        "ngram-mirror-off arm"
+    ),
 }
 
 REF_FNS = {
@@ -4474,7 +4668,7 @@ _SINGLE_DEVICE_CONFIGS = {
     "accuracy_update", "auroc_compute", "text_eval", "fid", "kernels",
     "variable_batch", "sharded_state", "monitoring", "metric_table",
     "quality", "region_sync", "async_sync", "admission", "wire_quant",
-    "failover",
+    "failover", "decode_stream",
 }
 
 

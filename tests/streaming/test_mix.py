@@ -1,0 +1,54 @@
+"""The streaming n-gram hash mixer: the host fold (``mix_fold_int``,
+used by StreamTable's per-request mirror) and the device fold
+(``mix_step_jnp``, used by StreamingNgramOverlap's kernel) must agree
+bit-for-bit — keyed finals vs standalone metrics compare through this
+equality."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.streaming._mix import (
+    MIX_SEED,
+    mix_fold_int,
+    mix_seed_jnp,
+    mix_step_jnp,
+)
+
+
+def _device_fold(tokens):
+    h = mix_seed_jnp()
+    for t in tokens:
+        h = mix_step_jnp(h, jnp.asarray(t, jnp.int32))
+    return int(h)
+
+
+def test_host_and_device_folds_agree_bitwise():
+    rng = np.random.default_rng(0)
+    for length in (1, 2, 3, 4, 7, 16):
+        for _ in range(8):
+            toks = rng.integers(0, 2**31 - 1, length).tolist()
+            assert mix_fold_int(toks) == _device_fold(toks), toks
+
+
+def test_fold_under_jit_matches_host():
+    @jax.jit
+    def fold(arr):
+        def body(i, h):
+            return mix_step_jnp(h, arr[i])
+
+        return jax.lax.fori_loop(0, arr.shape[0], body, mix_seed_jnp())
+
+    toks = [3, 99999, 7, 2**30, 0]
+    got = int(fold(jnp.asarray(toks, jnp.int32)))
+    assert got == mix_fold_int(toks)
+
+
+def test_fold_is_order_sensitive_and_seeded():
+    assert mix_fold_int([1, 2]) != mix_fold_int([2, 1])
+    assert mix_fold_int([]) == MIX_SEED
+    # 32-bit range: usable as a bucket-mask input everywhere
+    for toks in ([5], [1, 2, 3], [2**31 - 1] * 4):
+        assert 0 <= mix_fold_int(toks) < 2**32

@@ -1,0 +1,269 @@
+"""Streaming generative metrics (ISSUE 20): offline-oracle bit-identity.
+
+The O(1)-state contract is only worth having if it costs NOTHING in
+precision: feeding a stream ONE token at a time must produce the exact
+result of handing the whole sequence over at once — bitwise, not
+approximately — because both paths run the same sequential fold kernel.
+Pinned here per family: plain and under shape bucketing, replicated
+merge, ThreadWorld-4 sync, and an elastic resume mid-stream."""
+
+from __future__ import annotations
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from torcheval_tpu import config
+from torcheval_tpu.elastic import ElasticSession
+from torcheval_tpu.metrics.toolkit import clone_metric, sync_and_compute
+from torcheval_tpu.streaming import (
+    StreamingNgramOverlap,
+    StreamingPerplexity,
+    StreamingTokenAccuracy,
+    StreamingTokenEditStats,
+)
+from torcheval_tpu.utils.compile_counter import CompileCounter
+from torcheval_tpu.utils.test_utils import ThreadWorld
+
+RNG = np.random.default_rng(7)
+STEPS = 57
+LOGPROBS = (-RNG.uniform(0.01, 4.0, STEPS)).astype(np.float32)
+HYP = RNG.integers(0, 30, STEPS).astype(np.int32)
+REF = np.where(
+    RNG.uniform(size=STEPS) < 0.6, HYP, RNG.integers(0, 30, STEPS)
+).astype(np.int32)
+REF[-5:] = -1  # reference exhausted before the hypothesis
+
+
+def _families():
+    """(name, fresh(), feed_step, feed_offline) per streaming family."""
+
+    def ppl():
+        return (
+            StreamingPerplexity(),
+            lambda m, i: m.update(LOGPROBS[i : i + 1]),
+            lambda m: m.update(LOGPROBS),
+        )
+
+    def acc():
+        return (
+            StreamingTokenAccuracy(),
+            lambda m, i: m.update(HYP[i : i + 1], REF[i : i + 1]),
+            lambda m: m.update(HYP, REF),
+        )
+
+    def edit():
+        return (
+            StreamingTokenEditStats(),
+            lambda m, i: m.update(HYP[i : i + 1], REF[i : i + 1]),
+            lambda m: m.update(HYP, REF),
+        )
+
+    def ngram():
+        return (
+            StreamingNgramOverlap(n_gram=4),
+            lambda m, i: m.update(HYP[i : i + 1], REF[i : i + 1]),
+            lambda m: m.update(HYP, REF),
+        )
+
+    return [("perplexity", ppl), ("accuracy", acc), ("edit", edit),
+            ("ngram", ngram)]
+
+
+FAMILIES = _families()
+
+
+def _result(m):
+    out = m.compute()
+    if isinstance(out, tuple):  # NamedTuple families
+        return tuple(np.asarray(v).tolist() for v in out)
+    return np.asarray(out).tolist()
+
+
+def _run(build, *, stepwise, bucketed=False, finish=True):
+    m, feed_step, feed_offline = build()
+    ctx = config.shape_bucketing(True) if bucketed else _null()
+    with ctx:
+        if stepwise:
+            for i in range(STEPS):
+                feed_step(m, i)
+        else:
+            feed_offline(m)
+    if finish and hasattr(m, "finish"):
+        m.finish()
+    return m
+
+
+class _null:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+@pytest.mark.parametrize("name,build", FAMILIES)
+@pytest.mark.parametrize("bucketed", [False, True])
+def test_step_by_step_equals_whole_sequence_bitwise(name, build, bucketed):
+    step = _run(build, stepwise=True, bucketed=bucketed)
+    offline = _run(build, stepwise=False, bucketed=bucketed)
+    assert _result(step) == _result(offline), name
+
+
+@pytest.mark.parametrize("name,build", FAMILIES)
+def test_bucketed_equals_unbucketed_bitwise(name, build):
+    assert _result(_run(build, stepwise=True, bucketed=True)) == _result(
+        _run(build, stepwise=True, bucketed=False)
+    ), name
+
+
+@pytest.mark.parametrize("name,build", FAMILIES)
+def test_replicated_merge_preserves_step_offline_identity(name, build):
+    """Merging replicas that streamed step-by-step == merging replicas
+    that saw whole sequences: per-carrier states are bitwise equal, so
+    the rank-ordered merge fold is too."""
+    m_step = _run(build, stepwise=True)
+    m_step.merge_state([clone_metric(_run(build, stepwise=True))])
+    m_off = _run(build, stepwise=False)
+    m_off.merge_state([clone_metric(_run(build, stepwise=False))])
+    assert _result(m_step) == _result(m_off), name
+    # and the merge itself doubled the stream (scale sanity, not bits)
+    single = _result(_run(build, stepwise=True))
+    if name == "perplexity":
+        assert m_step.num_total == 2 * STEPS
+    elif name == "ngram":
+        assert int(np.asarray(m_step.num_sequences)) == 2
+    assert _result(m_step) is not None and single is not None
+
+
+@pytest.mark.parametrize("name,build", FAMILIES)
+def test_threadworld4_step_and_offline_sync_identically(name, build):
+    """World-4, one stream per rank: the synced compute of step-fed
+    replicas equals the synced compute of offline-fed replicas bitwise
+    (identical per-rank states -> identical rank-ordered fold)."""
+
+    def stream(rank):
+        rng = np.random.default_rng(100 + rank)
+        lp = (-rng.uniform(0.01, 4.0, 20)).astype(np.float32)
+        hyp = rng.integers(0, 20, 20).astype(np.int32)
+        ref = np.where(
+            rng.uniform(size=20) < 0.5, hyp, rng.integers(0, 20, 20)
+        ).astype(np.int32)
+        return lp, hyp, ref
+
+    def body_factory(stepwise):
+        def body(g):
+            m, _, _ = build()
+            lp, hyp, ref = stream(g.rank)
+            src = lp if name == "perplexity" else hyp
+            if stepwise:
+                for i in range(len(src)):
+                    if name == "perplexity":
+                        m.update(lp[i : i + 1])
+                    else:
+                        m.update(hyp[i : i + 1], ref[i : i + 1])
+            else:
+                if name == "perplexity":
+                    m.update(lp)
+                else:
+                    m.update(hyp, ref)
+            if hasattr(m, "finish"):
+                m.finish()
+            out = sync_and_compute(m, g)
+            if isinstance(out, tuple):
+                return tuple(np.asarray(v).tolist() for v in out)
+            return np.asarray(out).tolist()
+
+        return body
+
+    stepped = ThreadWorld(4).run(body_factory(True))
+    offline = ThreadWorld(4).run(body_factory(False))
+    assert stepped == offline, name
+    assert all(r == stepped[0] for r in stepped)
+
+
+@pytest.mark.parametrize("name,build", FAMILIES)
+def test_elastic_resume_mid_stream_bit_identical(name, build):
+    """Snapshot after 23 decode steps, restore into a fresh process
+    image, stream the remaining steps: compute equals the uninterrupted
+    run bitwise — mid-stream state (including the ngram tail windows)
+    rides the checkpoint."""
+    cut = 23
+    with tempfile.TemporaryDirectory() as d:
+        m, feed_step, _ = build()
+        sess = ElasticSession(m, d, interval=10**9)
+        for i in range(cut):
+            feed_step(m, i)
+        sess.snapshot()
+        sess.close()
+
+        fresh, fresh_step, _ = build()
+        sess2 = ElasticSession(fresh, d, interval=10**9)
+        assert sess2.restore() is not None
+        for i in range(cut, STEPS):
+            fresh_step(fresh, i)
+        if hasattr(fresh, "finish"):
+            fresh.finish()
+        sess2.close()
+
+    want = _run(build, stepwise=True)
+    assert _result(fresh) == _result(want), name
+
+
+def test_state_is_o1_in_stream_length():
+    """The whole point: state size must not grow with the stream."""
+    for _, build in FAMILIES:
+        short, feed, _ = build()
+        long_, feed2, _ = build()
+        for i in range(3):
+            feed(short, i)
+        for i in range(STEPS):
+            feed2(long_, i % STEPS)
+        for _ in range(4):  # several times around the stream
+            for i in range(STEPS):
+                feed2(long_, i)
+        nb_short = sum(
+            np.asarray(v).nbytes for v in short.state_dict().values()
+        )
+        nb_long = sum(
+            np.asarray(v).nbytes for v in long_.state_dict().values()
+        )
+        assert nb_short == nb_long
+
+
+def test_warmed_stepping_is_retrace_proof_under_bucketing():
+    """Ragged whole-chunk updates after warming: zero fresh programs."""
+    m = StreamingPerplexity()
+    e = StreamingTokenEditStats()
+    g = StreamingNgramOverlap(n_gram=3)
+    rng = np.random.default_rng(5)
+    with config.shape_bucketing(True):
+        for n in (8, 3, 16, 1, 9):  # warm the pow2 buckets
+            lp = (-rng.uniform(0.1, 1.0, n)).astype(np.float32)
+            toks = rng.integers(0, 9, n).astype(np.int32)
+            m.update(lp)
+            e.update(toks, toks)
+            g.update(toks, toks)
+        with CompileCounter() as cc:
+            for n in (5, 2, 12, 7, 1):
+                lp = (-rng.uniform(0.1, 1.0, n)).astype(np.float32)
+                toks = rng.integers(0, 9, n).astype(np.int32)
+                m.update(lp)
+                e.update(toks, toks)
+                g.update(toks, toks)
+        assert cc.programs == 0
+
+
+def test_edit_stream_length_mismatch_raises():
+    with pytest.raises(ValueError, match="sentinel"):
+        StreamingTokenEditStats().update(
+            np.array([1, 2], np.int32), np.array([1], np.int32)
+        )
+
+
+def test_ngram_validation():
+    with pytest.raises(ValueError):
+        StreamingNgramOverlap(n_gram=0)
+    with pytest.raises(ValueError):
+        StreamingNgramOverlap(buckets=100)  # not a power of two

@@ -115,8 +115,13 @@ def test_alias_and_member_validation():
         TablePanel(["ctr", ("ctr", "ctr")])
     with pytest.raises(ValueError, match="alias"):
         TablePanel([("bad-alias", "ctr")])
-    with pytest.raises(ValueError, match="windowed"):
-        TablePanel(["ctr", "windowed_ne"])
+    with pytest.raises(ValueError, match="one window size"):
+        TablePanel(
+            [
+                ("a", "windowed_ne", {"window": 4}),
+                ("b", "windowed_ne", {"window": 8}),
+            ]
+        )
     with pytest.raises(ValueError, match="unknown table family"):
         TablePanel(["nope"])
 

@@ -1,0 +1,472 @@
+"""StreamTable (ISSUE 20 tentpole): decode-step quality keyed by
+request id. Pins the acceptance contracts: per-key values bitwise equal
+to the standalone streaming-metric oracles fed the same per-request
+streams, ZERO fresh programs on a warmed table across ragged (batch,
+active-set) shapes, finish/drain retirement into distribution sketches,
+ThreadWorld-4 adopt parity under per-request rank affinity, mid-stream
+state round trips and a 2->4 elastic world change, admission shedding
+that never drops retirement finals, and watch_inputs drift sketches on
+the logprob stream."""
+
+from __future__ import annotations
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from torcheval_tpu.elastic import ElasticSession
+from torcheval_tpu.metrics import ShardContext
+from torcheval_tpu.metrics.toolkit import adopt_synced, clone_metric
+from torcheval_tpu.streaming import (
+    StreamingNgramOverlap,
+    StreamingPerplexity,
+    StreamingTokenAccuracy,
+    StreamingTokenEditStats,
+)
+from torcheval_tpu.table import (
+    AdmissionController,
+    MetricTable,
+    ServingBudget,
+    StreamTable,
+    TablePanel,
+    stream_logprob_family,
+)
+from torcheval_tpu.utils.compile_counter import CompileCounter
+from torcheval_tpu.utils.test_utils import ThreadWorld
+
+ALL_MEMBERS = ("logprob", "token_edit", "token_accuracy", "ngram")
+
+
+def _streams(n_requests=6, seed=3, max_len=14):
+    """Per-request (logprobs, hyp, ref) token streams, ragged lengths."""
+    rng = np.random.default_rng(seed)
+    out = {}
+    for rid in range(n_requests):
+        n = int(rng.integers(4, max_len))
+        hyp = rng.integers(0, 25, n).astype(np.int32)
+        ref = np.where(
+            rng.uniform(size=n) < 0.6, hyp, rng.integers(0, 25, n)
+        ).astype(np.int32)
+        lp = (-rng.uniform(0.05, 3.0, n)).astype(np.float32)
+        out[rid] = (lp, hyp, ref)
+    return out
+
+
+def _drive(table, streams):
+    """Interleaved decode: at step s every still-active request
+    contributes ONE row — ragged active sets, one row per request per
+    batch (the decode regime)."""
+    horizon = max(len(lp) for lp, _, _ in streams.values())
+    for s in range(horizon):
+        ids = [r for r, (lp, _, _) in streams.items() if s < len(lp)]
+        if not ids:
+            continue
+        table.ingest(
+            np.asarray(ids),
+            step_tokens=np.asarray(
+                [streams[r][1][s] for r in ids], np.int32
+            ),
+            logprobs=np.asarray([streams[r][0][s] for r in ids], np.float32),
+            ref_tokens=np.asarray(
+                [streams[r][2][s] for r in ids], np.int32
+            ),
+        )
+    return table
+
+
+def test_keyed_values_match_standalone_oracles_bitwise():
+    streams = _streams()
+    t = _drive(StreamTable(members=ALL_MEMBERS, n_gram=3), streams)
+    t.finish(np.asarray(sorted(streams)))
+    got = t.compute().as_dict()
+    for rid, (lp, hyp, ref) in streams.items():
+        ppl = StreamingPerplexity()
+        edit = StreamingTokenEditStats()
+        acc = StreamingTokenAccuracy()
+        ngram = StreamingNgramOverlap(n_gram=3)
+        for s in range(len(lp)):
+            ppl.update(lp[s : s + 1])
+            edit.update(hyp[s : s + 1], ref[s : s + 1])
+            acc.update(hyp[s : s + 1], ref[s : s + 1])
+            ngram.update(hyp[s : s + 1], ref[s : s + 1])
+        ngram.finish()
+        assert got["logprob"][rid] == float(ppl.compute()), rid
+        assert got["token_edit"][rid] == float(edit.compute().error_rate)
+        assert got["token_accuracy"][rid] == float(acc.compute())
+        assert got["ngram"][rid] == float(ngram.compute().overlap), rid
+
+
+def test_single_family_table_equals_panel_member_bitwise():
+    """``MetricTable("stream_logprob")`` (registered family) and the
+    StreamTable member ride the SAME row kernel — one-intake fusion
+    changes no math."""
+    streams = _streams(seed=9)
+    panel = _drive(StreamTable(members=("logprob",)), streams)
+    single = MetricTable("stream_logprob")
+    horizon = max(len(lp) for lp, _, _ in streams.values())
+    for s in range(horizon):
+        ids = [r for r, (lp, _, _) in streams.items() if s < len(lp)]
+        single.ingest(
+            np.asarray(ids),
+            np.asarray([streams[r][0][s] for r in ids], np.float32),
+        )
+    assert (
+        panel.compute().as_dict()["logprob"] == single.compute().as_dict()
+    )
+
+
+def test_warmed_table_is_retrace_proof_across_ragged_active_sets():
+    """THE acceptance pin: a warmed StreamTable processes fresh (batch,
+    active-set) shapes — including the finish commit and the empty
+    decode tail — with zero new compiled programs."""
+    keyspace = 400
+    t = StreamTable(members=ALL_MEMBERS, n_gram=4)
+    rng = np.random.default_rng(0)
+
+    def feed(rng, sizes):
+        for n in sizes:
+            ids = rng.integers(0, keyspace, n)
+            t.ingest(
+                ids,
+                step_tokens=rng.integers(0, 50, n).astype(np.int32),
+                logprobs=(-rng.uniform(0.01, 3.0, n)).astype(np.float32),
+                ref_tokens=rng.integers(0, 50, n).astype(np.int32),
+            )
+            if n > 8:
+                t.finish(ids[: n // 3])
+
+    # steady state: admit the whole keyspace, then warm the pow2 buckets
+    t.ingest(
+        np.arange(keyspace),
+        step_tokens=np.zeros(keyspace, np.int32),
+        logprobs=np.zeros(keyspace, np.float32),
+        ref_tokens=np.zeros(keyspace, np.int32),
+    )
+    feed(np.random.default_rng(1), (64, 33, 17, 128, 5, 1, 0, 200, 96, 48, 7))
+    with CompileCounter() as cc:
+        feed(np.random.default_rng(2), (77, 3, 0, 250, 19, 1, 130, 42))
+    assert cc.programs == 0
+
+
+def test_empty_decode_batch_is_a_host_side_noop():
+    t = StreamTable(members=("logprob", "token_edit"))
+    t.ingest([5], step_tokens=np.array([3]), logprobs=np.array([-0.5]))
+    before = t.compute().as_dict()
+    with CompileCounter() as cc:
+        t.ingest(
+            np.zeros(0, np.int64),
+            step_tokens=np.zeros(0, np.int32),
+            logprobs=np.zeros(0, np.float32),
+        )
+        t.finish(np.zeros(0, np.int64))
+    assert cc.programs == 0
+    assert t.compute().as_dict() == before
+
+
+def test_finish_and_drain_retire_requests_into_sketches():
+    streams = _streams(n_requests=5)
+    t = _drive(StreamTable(members=("logprob", "token_edit")), streams)
+    assert t.active_requests == 5
+    t.finish([0, 1, 2])
+    assert t.active_requests == 2  # finished streams leave the mirror
+    assert int(t.n_keys) == 5  # rows retire at the DRAIN, not at finish
+    t._pre_adopt_commit()
+    assert int(t.n_keys) == 2
+    assert t.counter_source()["finished_requests_total"] == 3
+    summ = t.finished_summary()
+    assert int(summ["length"]["counts"].sum()) == 3
+    assert int(summ["latency"]["counts"].sum()) == 3
+    assert int(summ["final_logprob"]["counts"].sum()) == 3
+    assert int(summ["final_token_edit"]["counts"].sum()) == 3
+    # lengths landed in the right bins: each request's step count
+    edges = summ["length"]["edges"]
+    for rid in (0, 1, 2):
+        n = len(streams[rid][0])
+        b = np.searchsorted(edges, n, side="right") - 1
+        assert summ["length"]["counts"][b] >= 1
+    # double finish is idempotent
+    t.finish([0, 1, 2])
+    t._pre_adopt_commit()
+    assert t.counter_source()["finished_requests_total"] == 3
+
+
+def test_world4_adopt_matches_world1_under_request_affinity():
+    """Decode serving pins a request to one observing rank; under that
+    affinity the world-4 adopt is bitwise the world-1 run — same per-key
+    float fold, same sketches (latency excluded: wall clock)."""
+    batches = []
+    for i in range(8):
+        rng = np.random.default_rng(100 + i)
+        ids = rng.integers(0, 15, 32) * 4 + (i % 4)  # observing rank i%4
+        batches.append(
+            (
+                ids,
+                rng.integers(0, 50, 32).astype(np.int32),
+                (-rng.uniform(0.1, 2.0, 32)).astype(np.float32),
+                rng.integers(0, 50, 32).astype(np.int32),
+            )
+        )
+    fin = {r: np.unique(batches[r][0])[:5] for r in range(4)}
+
+    def run_world1():
+        t = StreamTable(members=("logprob", "token_edit"))
+        for r in range(4):
+            for i in range(r, len(batches), 4):
+                k, s, lp, rr = batches[i]
+                t.ingest(k, step_tokens=s, logprobs=lp, ref_tokens=rr)
+            t.finish(fin[r])
+        t._pre_adopt_commit()
+        return t
+
+    w1 = run_world1()
+    want = w1.compute().as_dict()
+    want_hist = {
+        k: v["counts"].tolist()
+        for k, v in w1.finished_summary().items()
+        if k != "latency"
+    }
+
+    def body(g):
+        t = StreamTable(
+            members=("logprob", "token_edit"), shard=ShardContext(g.rank, 4)
+        )
+        for i in range(g.rank, len(batches), 4):
+            k, s, lp, r = batches[i]
+            t.ingest(k, step_tokens=s, logprobs=lp, ref_tokens=r)
+        t.finish(fin[g.rank])
+        merged = adopt_synced(t, g)
+        return (
+            merged.compute().as_dict(),
+            {
+                k: v["counts"].tolist()
+                for k, v in merged.finished_summary().items()
+                if k != "latency"
+            },
+        )
+
+    results = ThreadWorld(4).run(body)
+    assert all(r == results[0] for r in results)
+    got, got_hist = results[0]
+    assert got == want
+    assert got_hist == want_hist
+
+
+def test_state_round_trip_mid_stream_then_finish():
+    """A snapshot taken MID-stream carries the host mirror (ngram tails,
+    count planes, span clocks): finishing after the restore produces the
+    same finals as finishing the original."""
+    streams = _streams(seed=5)
+    t = _drive(StreamTable(members=("logprob", "ngram"), n_gram=3), streams)
+    sd = t.state_dict()
+    fresh = StreamTable(members=("logprob", "ngram"), n_gram=3)
+    fresh.load_state_dict(sd)
+    assert fresh.active_requests == t.active_requests
+    assert fresh.compute().as_dict() == t.compute().as_dict()
+    ids = sorted(streams)
+    t.finish(ids)
+    fresh.finish(ids)
+    assert fresh.compute().as_dict() == t.compute().as_dict()
+    # clone_metric path (deepcopy of the mirror) stays independent
+    c = clone_metric(t)
+    c.ingest([999], step_tokens=np.array([1]), logprobs=np.array([-0.1]))
+    assert 999 not in [
+        k for k in t.compute().as_dict()["logprob"]
+    ]
+
+
+def test_elastic_world_change_2_to_4_mid_stream_bit_identical():
+    """Phase 1 streams at world 2, snapshot, resume at world 4 (fresh
+    processes), phase 2 streams to completion: per-key values equal the
+    world-1 uninterrupted run bitwise. In-flight mirrors rehome through
+    the checkpoint; affinity is per phase (id%2 then id%4)."""
+
+    def phase_batches(phase, world):
+        out = []
+        for i in range(6):
+            rng = np.random.default_rng(1000 * phase + i)
+            ids = rng.integers(0, 12, 16) * world + (i % world)
+            out.append(
+                (
+                    ids,
+                    rng.integers(0, 40, 16).astype(np.int32),
+                    (-rng.uniform(0.1, 2.0, 16)).astype(np.float32),
+                    rng.integers(0, 40, 16).astype(np.int32),
+                )
+            )
+        return out
+
+    p1 = phase_batches(1, 2)
+    p2 = phase_batches(2, 4)
+    fin = np.unique(p2[0][0])[:6]
+
+    def feed(t, batches, rank, world):
+        for i in range(rank, len(batches), world):
+            k, s, lp, r = batches[i]
+            t.ingest(k, step_tokens=s, logprobs=lp, ref_tokens=r)
+
+    def world1():
+        t = StreamTable(members=("logprob", "ngram"), n_gram=3)
+        for r in range(2):
+            feed(t, p1, r, 2)
+        t._pre_adopt_commit()  # the snapshot drain
+        for r in range(4):
+            feed(t, p2, r, 4)
+        t.finish(fin)
+        t._pre_adopt_commit()
+        return t.compute().as_dict()
+
+    want = world1()
+
+    with tempfile.TemporaryDirectory() as d:
+
+        def writer(g):
+            t = StreamTable(
+                members=("logprob", "ngram"),
+                n_gram=3,
+                shard=ShardContext(g.rank, 2),
+            )
+            sess = ElasticSession(t, d, process_group=g, interval=10**9)
+            feed(t, p1, g.rank, 2)
+            sess.snapshot()
+            sess.close()
+
+        ThreadWorld(2).run(writer)
+
+        def resume(g):
+            t = StreamTable(
+                members=("logprob", "ngram"),
+                n_gram=3,
+                shard=ShardContext(g.rank, 4),
+            )
+            sess = ElasticSession(t, d, process_group=g, interval=10**9)
+            assert sess.restore() is not None
+            feed(t, p2, g.rank, 4)
+            if g.rank == 0:
+                t.finish(fin)
+            merged = adopt_synced(t, g)
+            sess.close()
+            return merged.compute().as_dict()
+
+        results = ThreadWorld(4).run(resume)
+    assert all(r == results[0] for r in results)
+    assert results[0] == want
+
+
+def test_admission_sheds_decode_rows_but_never_finals():
+    t = StreamTable(
+        members=("logprob", "ngram"),
+        admission=AdmissionController(ServingBudget(), sample_p=0.25),
+    )
+    t.admission_rung = 1
+    rng = np.random.default_rng(2)
+    n = 400
+    ids = rng.integers(0, 4000, n)
+    t.ingest(
+        ids,
+        step_tokens=rng.integers(0, 40, n).astype(np.int32),
+        logprobs=(-rng.uniform(0.1, 2.0, n)).astype(np.float32),
+        ref_tokens=rng.integers(0, 40, n).astype(np.int32),
+    )
+    shed = int(t.shed_rows_total)
+    assert 0 < shed < n  # decode rows carry HT weights through the gate
+    assert int(t.admitted_rows_total) + shed == n
+    # retirement commits bypass the gate: every finished request's finals
+    # land even at a shedding rung (finish rows are one-per-lifetime)
+    done = np.unique(ids)[:50]
+    t.finish(done)
+    assert int(t.shed_rows_total) == shed  # unchanged by the commit
+    t._pre_adopt_commit()
+    assert t.counter_source()["finished_requests_total"] > 0
+
+
+def test_watch_inputs_sketches_the_logprob_stream():
+    """Output-distribution drift rides the generic quality watch: the
+    logprob stream is positional arg 1 of the single-family ingest."""
+    from torcheval_tpu.obs import quality
+
+    t = MetricTable("stream_logprob")
+    # watched indices address the fused plan's dynamic tuple: the table
+    # intake rides 5 leading args (slot/key planes + epoch), so the
+    # logprob stream is index 5 on an unarmed table
+    watch = quality.watch_inputs(t, args=(5,), log2_bounds=(-8, 8))
+    try:
+        rng = np.random.default_rng(0)
+        for _ in range(4):
+            t.ingest(
+                rng.integers(0, 9, 16),
+                (-rng.uniform(0.05, 2.0, 16)).astype(np.float32),
+            )
+        (series,) = watch.series
+        assert int(watch.sketch(series).compute().count) == 64
+    finally:
+        watch.close()
+
+
+def test_member_validation_and_required_kwargs():
+    with pytest.raises(ValueError, match="at least one member"):
+        StreamTable(members=())
+    with pytest.raises(ValueError, match="unknown StreamTable members"):
+        StreamTable(members=("logprob", "bleu"))
+    with pytest.raises(ValueError, match="duplicate"):
+        StreamTable(members=("logprob", "logprob"))
+    with pytest.raises(ValueError, match="power of two"):
+        StreamTable(members=("ngram",), ngram_buckets=100)
+    t = StreamTable(members=("logprob",))
+    with pytest.raises(ValueError, match="logprobs"):
+        t.ingest([1], step_tokens=np.array([2]))
+    t2 = StreamTable(members=("token_edit",))
+    with pytest.raises(ValueError, match="step_tokens"):
+        t2.ingest([1], logprobs=np.array([-0.1]))
+
+
+def test_finish_emits_span_events_when_recorder_on():
+    from torcheval_tpu import obs
+
+    r = obs.recorder()
+    prev = r.enabled
+    r.reset()
+    r.enable()
+    try:
+        t = StreamTable(members=("logprob",))
+        t.ingest([1, 2], logprobs=np.array([-0.5, -1.0], np.float32))
+        t.finish([1, 2])
+        spans = [
+            e
+            for e in r.log.tail()
+            if getattr(e, "name", "") == "stream_request"
+        ]
+        assert len(spans) == 2
+        assert all(e.seconds >= 0.0 for e in spans)
+    finally:
+        r.reset()
+        if not prev:
+            r.disable()
+
+
+def test_stream_families_join_mixed_panels_with_windowed_members():
+    """Satellite 1 payoff: a streaming family and a WINDOWED family share
+    one fused panel intake (one key set, one program, one window clock)."""
+    panel = TablePanel(
+        [
+            ("lp", stream_logprob_family()),
+            ("wne", "windowed_ne", {"window": 4}),
+        ]
+    )
+    single_lp = MetricTable("stream_logprob")
+    single_ne = MetricTable("windowed_ne", window=4)
+    rng = np.random.default_rng(4)
+    for _ in range(3):
+        keys = rng.integers(0, 10, 24)
+        lp = (-rng.uniform(0.05, 2.0, 24)).astype(np.float32)
+        preds = rng.uniform(0.1, 0.9, 24).astype(np.float32)
+        tgt = rng.integers(0, 2, 24).astype(np.float32)
+        panel.ingest(keys, lp=(lp,), wne=(preds, tgt))
+        single_lp.ingest(keys, lp)
+        single_ne.ingest(keys, preds, tgt)
+        panel._pre_adopt_commit()
+        single_lp._pre_adopt_commit()
+        single_ne._pre_adopt_commit()
+    got = panel.compute().as_dict()
+    assert got["lp"] == single_lp.compute().as_dict()
+    assert got["wne"] == single_ne.compute().as_dict()

@@ -98,6 +98,7 @@ def _program_smoke() -> Report:
     combined.extend(_sync_plane_smoke())
     combined.extend(_wire_quant_smoke())
     combined.extend(_failover_smoke())
+    combined.extend(_streaming_smoke())
     return combined
 
 
@@ -553,6 +554,71 @@ def _table_ingest_smoke() -> Report:
         if report is not None:
             combined.extend(report)
         combined.extend(verify_metric_compute(table))
+    return combined
+
+
+def _streaming_smoke() -> Report:
+    """ISSUE 20 tentpole: the streaming decode-step ingest. A warmed
+    :class:`~torcheval_tpu.table.StreamTable` over the logprob +
+    token-edit + ngram member families must verify exactly like any
+    table — zero collectives, no host escapes, donation-sound — on both
+    the plain fused program and the masked bucketed twin production
+    runs under ``config.shape_bucketing()`` (the twin is what makes a
+    warmed table retrace-proof across ragged decode active sets). The
+    standalone streaming metrics' sequential-fold updates verify the
+    same way."""
+    import numpy as np
+
+    from torcheval_tpu.analysis.program import (
+        verify_metric_compute,
+        verify_metric_update,
+    )
+    from torcheval_tpu.metrics import ShardContext
+    from torcheval_tpu.streaming import (
+        StreamingNgramOverlap,
+        StreamingPerplexity,
+        StreamingTokenEditStats,
+    )
+    from torcheval_tpu.table import StreamTable
+    from torcheval_tpu.table.streaming import _ngram_fields
+
+    rng = np.random.default_rng(20)
+    ids = rng.integers(0, 64, 32)
+    lp = (-rng.uniform(0.05, 2.0, 32)).astype(np.float32)
+    hyp = rng.integers(0, 30, 32).astype(np.int32)
+    ref = rng.integers(0, 30, 32).astype(np.int32)
+    combined = Report(tool="program")
+
+    table = StreamTable(
+        ("logprob", "token_edit", "ngram"),
+        n_gram=4,
+        shard=ShardContext(1, 4),
+    )
+    # warm the host intake so the verified program is the steady-state
+    # decode-step ingest
+    table.ingest(ids, step_tokens=hyp, logprobs=lp, ref_tokens=ref)
+    payload = np.zeros((32, len(_ngram_fields(4))), np.float32)
+    report = verify_metric_update(
+        table,
+        ids,
+        logprob={"logprobs": lp},
+        token_edit={"step_tokens": hyp, "ref_tokens": ref},
+        ngram={"payload": payload},
+    )
+    if report is not None:
+        combined.extend(report)
+    combined.extend(verify_metric_compute(table))
+
+    for metric, args in (
+        (StreamingPerplexity(), (lp,)),
+        (StreamingTokenEditStats(), (hyp, ref)),
+        (StreamingNgramOverlap(n_gram=4), (hyp, ref)),
+    ):
+        metric.update(*args)
+        report = verify_metric_update(metric, *args)
+        if report is not None:
+            combined.extend(report)
+        combined.extend(verify_metric_compute(metric))
     return combined
 
 

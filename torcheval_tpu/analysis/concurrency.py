@@ -62,6 +62,7 @@ DEFAULT_TARGETS = (
     "elastic.py",
     "failover.py",
     "federation.py",
+    "streaming",
     "syncplane.py",
     "table",
     os.path.join("utils", "checkpoint.py"),

@@ -1,0 +1,48 @@
+"""Streaming generative eval: O(1) decode-step metrics.
+
+Token-streaming quality metrics that accept ONE decode step at a time
+and carry constant-size state — the metric-side analogue of an O(1)
+autoregressive decode cache (docs/streaming-eval.md):
+
+- :class:`StreamingPerplexity` — running NLL sum + token count.
+- :class:`StreamingTokenAccuracy` / :class:`StreamingTokenEditStats` —
+  positional WER/CER-core substitution/insertion/deletion counters
+  against a reference stream.
+- :class:`StreamingNgramOverlap` — bounded n-gram tail + hashed clipped-
+  match count planes, the BLEU precision core without sequence storage.
+
+Each is a standard :class:`~torcheval_tpu.metrics.metric.Metric`, so
+sync, subgroups, elastic checkpointing, ShardSpec and the wire ladder
+apply unchanged. For MANY concurrent streams keyed by request id, use
+:class:`StreamTable` (``torcheval_tpu.table.streaming``): one fused
+device ingest per decode batch, per-request slots, TTL/eviction
+lifecycle and drain-time distribution sketches.
+"""
+
+from torcheval_tpu.streaming.edit import (
+    StreamingTokenAccuracy,
+    StreamingTokenEditStats,
+    TokenEditStats,
+)
+from torcheval_tpu.streaming.ngram import NgramOverlap, StreamingNgramOverlap
+from torcheval_tpu.streaming.perplexity import StreamingPerplexity
+
+__all__ = [
+    "NgramOverlap",
+    "StreamTable",
+    "StreamingNgramOverlap",
+    "StreamingPerplexity",
+    "StreamingTokenAccuracy",
+    "StreamingTokenEditStats",
+    "TokenEditStats",
+]
+
+
+def __getattr__(name):
+    # lazy: table.streaming imports streaming._mix, so an eager import
+    # here would be circular whenever table.streaming loads first
+    if name == "StreamTable":
+        from torcheval_tpu.table.streaming import StreamTable
+
+        return StreamTable
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

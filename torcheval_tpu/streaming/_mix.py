@@ -1,0 +1,62 @@
+"""Deterministic 32-bit n-gram window mixer.
+
+One left-fold hash shared by BOTH n-gram count planes in the streaming
+subsystem: the device fold inside ``StreamingNgramOverlap``'s decode-step
+kernel (jax.numpy, uint32 wraparound) and the host mirror inside
+``StreamTable``'s per-request stream state (plain python ints). The two
+implementations must agree bit-for-bit — the keyed table's finals are
+pinned against the standalone metric's counters in the test suite — so
+the constants live here, once, and tests/streaming/test_mix.py sweeps
+the pair for equality.
+
+The mix itself is a murmur3-finalizer-style avalanche over each token of
+the (<= n)-token window, folded left to right from a fixed seed. Token
+ids are assumed non-negative int32 (the streaming sentinel for "no token
+this step" is -1 and is never hashed). Collisions between distinct
+n-grams are expected and harmless for the BLEU-precision core: clipped
+matching ``min(candidate_count, reference_count)`` is computed per
+bucket, so a collision can only *under*- or *over*-credit by the
+colliding mass, bounded by the table width — widen ``buckets`` to
+tighten it.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+__all__ = ["MIX_SEED", "mix_fold_int", "mix_step_jnp", "mix_seed_jnp"]
+
+# golden-ratio odd multiplier + murmur3-finalizer avalanche constant;
+# seed is the FNV-1a 32-bit offset basis. All arithmetic is mod 2^32.
+_M1 = 0x9E3779B1
+_M2 = 0x85EBCA77
+MIX_SEED = 0x811C9DC5
+_MASK32 = 0xFFFFFFFF
+
+
+def mix_fold_int(tokens: Sequence[int], seed: int = MIX_SEED) -> int:
+    """Host fold: hash a whole token window with python ints (exact
+    uint32 wraparound via masking). Mirror of the device fold below."""
+    h = seed & _MASK32
+    for tok in tokens:
+        h = ((h ^ (int(tok) & _MASK32)) * _M1) & _MASK32
+        h ^= h >> 15
+        h = (h * _M2) & _MASK32
+        h ^= h >> 13
+    return h
+
+
+def mix_seed_jnp() -> jnp.ndarray:
+    """The fold seed as a device uint32 scalar."""
+    return jnp.uint32(MIX_SEED)
+
+
+def mix_step_jnp(h: jnp.ndarray, tok: jnp.ndarray) -> jnp.ndarray:
+    """Device fold step: absorb one int32 token into a uint32 hash.
+    uint32 multiply wraps in XLA, matching the masked host fold."""
+    h = (h ^ tok.astype(jnp.uint32)) * jnp.uint32(_M1)
+    h = h ^ (h >> jnp.uint32(15))
+    h = h * jnp.uint32(_M2)
+    return h ^ (h >> jnp.uint32(13))

@@ -1,0 +1,171 @@
+"""Streaming token-edit counters: aligned WER/CER core, O(1) state.
+
+Decode-time quality against a reference stream without ever holding
+either sequence: each ``update`` takes the hypothesis token(s) of ONE
+decode step plus the reference token(s) aligned to the same position(s),
+and bumps six int32 counters — matches, substitutions, insertions,
+deletions, hypothesis tokens, reference tokens. The alignment is
+POSITIONAL (teacher-forced / same-length streams), the regime where the
+streaming counters equal the true edit distance; ``-1`` on either side
+marks "this stream has no token at this step", so a hypothesis that
+runs past its reference accrues insertions and one that stops short
+accrues deletions — the WER numerator (S+I+D) without a DP table.
+
+Integer adds are associative, so step-by-step feeding, whole-sequence
+feeding, shape-bucketed padding, and any merge order all produce
+bit-identical counters by construction.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
+
+TTokenEdit = TypeVar("TTokenEdit", bound="_StreamingTokenEditBase")
+
+__all__ = ["StreamingTokenAccuracy", "StreamingTokenEditStats", "TokenEditStats"]
+
+_STATE_NAMES = (
+    "matches",
+    "substitutions",
+    "insertions",
+    "deletions",
+    "num_hyp_tokens",
+    "num_ref_tokens",
+)
+
+
+class TokenEditStats(NamedTuple):
+    """``StreamingTokenEditStats.compute()`` result (device scalars)."""
+
+    error_rate: jax.Array
+    matches: jax.Array
+    substitutions: jax.Array
+    insertions: jax.Array
+    deletions: jax.Array
+    num_hyp_tokens: jax.Array
+    num_ref_tokens: jax.Array
+
+
+def _edit_counts(hyp, ref, live):
+    hyp_valid = (hyp >= 0) & live
+    ref_valid = (ref >= 0) & live
+    both = hyp_valid & ref_valid
+    count = lambda m: jnp.sum(m.astype(jnp.int32))  # noqa: E731
+    return (
+        count(both & (hyp == ref)),
+        count(both & (hyp != ref)),
+        count(hyp_valid & ~ref_valid),
+        count(ref_valid & ~hyp_valid),
+        count(hyp_valid),
+        count(ref_valid),
+    )
+
+
+def _edit_update_kernel(hyp, ref):
+    return _edit_counts(hyp, ref, jnp.ones(hyp.shape, dtype=bool))
+
+
+def _edit_update_kernel_masked(hyp, ref, valid):
+    return _edit_counts(hyp, ref, jnp.arange(hyp.shape[0]) < valid[0])
+
+
+class _StreamingTokenEditBase(Metric[jax.Array]):
+    _bucketed_update = True
+
+    def __init__(self, *, device: Optional[jax.Device] = None) -> None:
+        super().__init__(device=device)
+        for name in _STATE_NAMES:
+            self._add_state(
+                name, jnp.zeros((), dtype=jnp.int32), merge=MergeKind.SUM
+            )
+
+    def update(
+        self: TTokenEdit, step_tokens, ref_tokens=None
+    ) -> TTokenEdit:
+        """Fold one aligned decode step.
+
+        Args:
+            step_tokens: hypothesis token id(s) — scalar or 1-D int array;
+                ``-1`` where the hypothesis stream has ended.
+            ref_tokens: reference token id(s) aligned to the same
+                position(s); ``-1`` where the reference has ended. ``None``
+                means no reference tokens at these positions (all ``-1``,
+                i.e. pure insertions).
+        """
+        plan = self._update_plan(step_tokens, ref_tokens)
+        return self._apply_update_plan(plan)
+
+    def _update_plan(self, step_tokens, ref_tokens=None):
+        hyp = self._input(step_tokens, dtype=jnp.int32).reshape((-1,))
+        if ref_tokens is None:
+            ref = (
+                jnp.full(hyp.shape, -1, dtype=jnp.int32)
+                if isinstance(hyp, jax.Array)
+                else np.full(hyp.shape, -1, dtype=np.int32)
+            )
+        else:
+            ref = self._input(ref_tokens, dtype=jnp.int32).reshape((-1,))
+        if np.shape(hyp) != np.shape(ref):
+            raise ValueError(
+                "step_tokens and ref_tokens must align position-for-position "
+                f"(got {np.shape(hyp)} vs {np.shape(ref)}); pad the shorter "
+                "stream with the -1 sentinel."
+            )
+        return UpdatePlan(
+            _edit_update_kernel,
+            _STATE_NAMES,
+            (hyp, ref),
+            masked_kernel=_edit_update_kernel_masked,
+            batch_axes=(("n",), ("n",)),
+        )
+
+
+class StreamingTokenAccuracy(_StreamingTokenEditBase):
+    """Fraction of reference tokens the hypothesis matched, streamed.
+
+    Examples::
+
+        >>> from torcheval_tpu.streaming import StreamingTokenAccuracy
+        >>> metric = StreamingTokenAccuracy()
+        >>> for hyp, ref in [(5, 5), (9, 7), (3, 3)]:
+        ...     _ = metric.update(hyp, ref)
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
+
+    def compute(self) -> jax.Array:
+        """matches / reference tokens (0.0 before any reference token)."""
+        ref = self.num_ref_tokens.astype(jnp.float32)
+        return jnp.where(
+            ref > 0, self.matches.astype(jnp.float32) / jnp.maximum(ref, 1.0), 0.0
+        )
+
+
+class StreamingTokenEditStats(_StreamingTokenEditBase):
+    """Positional substitution/insertion/deletion counters, streamed.
+
+    ``compute()`` returns the full :class:`TokenEditStats` tuple;
+    ``error_rate`` is the WER-style ``(S + I + D) / reference tokens``.
+    """
+
+    def compute(self) -> TokenEditStats:
+        ref = self.num_ref_tokens.astype(jnp.float32)
+        errors = (
+            self.substitutions + self.insertions + self.deletions
+        ).astype(jnp.float32)
+        rate = jnp.where(ref > 0, errors / jnp.maximum(ref, 1.0), 0.0)
+        return TokenEditStats(
+            error_rate=rate,
+            matches=self.matches,
+            substitutions=self.substitutions,
+            insertions=self.insertions,
+            deletions=self.deletions,
+            num_hyp_tokens=self.num_hyp_tokens,
+            num_ref_tokens=self.num_ref_tokens,
+        )

@@ -1,0 +1,303 @@
+"""Streaming n-gram overlap: the O(1)-state BLEU precision core.
+
+BLEU's clipped n-gram matching normally wants both full sequences in
+hand. The streaming form carries a CONSTANT-size cache through the
+decode scan instead (the arXiv:2603.09555 posture): the last ``n-1``
+tokens of each stream (the n-gram "tail"), one bounded count plane of
+``(n, buckets)`` hashed n-gram counters per side, and the running
+lengths. Each decode step extends both tails, hashes every n-gram the
+new token completes into its order's bucket row, and moves on — no
+token is ever stored beyond the tail window.
+
+``finish()`` closes the in-flight stream pair: clipped matches are
+``min(candidate_counts, reference_counts)`` summed per order (computed
+bucket-wise, so hash collisions can shift credit between colliding
+n-grams but the mass stays bounded by the plane; widen ``buckets`` to
+tighten), possible counts come from the hypothesis length, and both
+fold into cumulative corpus-level counters. ``compute()`` reads ONLY
+the cumulative counters — a stream contributes once finished — and
+returns the BLEU-style geometric-mean precision with brevity penalty.
+
+Bit-identity: the update kernel threads the tail/count state through a
+sequential ``fori_loop``, and every counter is int32 — token-by-token
+vs whole-sequence feeding is exactly the same integer fold, so finished
+counters (and everything ``compute`` derives from them) are bitwise
+equal. Merging: cumulative counters are plain SUMs; the in-flight plane
+merges exactly when at most one rank has a stream open (tails merge by
+elementwise MAX over the ``-1`` "empty" sentinel) — the keyed
+many-request regime lives in ``table.StreamTable``, which gives every
+request its own tail/plane slot.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import NamedTuple, Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
+from torcheval_tpu.streaming._mix import mix_seed_jnp, mix_step_jnp
+
+TStreamingNgramOverlap = TypeVar(
+    "TStreamingNgramOverlap", bound="StreamingNgramOverlap"
+)
+
+__all__ = ["NgramOverlap", "StreamingNgramOverlap"]
+
+_INFLIGHT_STATES = (
+    "cand_counts",
+    "ref_counts",
+    "hyp_tail",
+    "ref_tail",
+    "hyp_len",
+    "ref_len",
+)
+
+
+class NgramOverlap(NamedTuple):
+    """``StreamingNgramOverlap.compute()`` result (device values)."""
+
+    overlap: jax.Array
+    brevity_penalty: jax.Array
+    precision_by_order: jax.Array
+    matches_by_order: jax.Array
+    possible_by_order: jax.Array
+    hyp_len_total: jax.Array
+    ref_len_total: jax.Array
+    num_sequences: jax.Array
+
+
+def _fold_token(counts, tail, length, tok, n_gram, buckets):
+    """Absorb one (possibly ``-1``/absent) token into one side's state."""
+    valid = tok >= 0
+    new_len = length + valid.astype(jnp.int32)
+    window = jnp.concatenate([tail, tok[None]]) if n_gram > 1 else tok[None]
+    for k in range(1, n_gram + 1):
+        h = mix_seed_jnp()
+        for j in range(n_gram - k, n_gram):
+            h = mix_step_jnp(h, window[j])
+        bucket = (h & jnp.uint32(buckets - 1)).astype(jnp.int32)
+        hit = valid & (new_len >= k)
+        counts = counts.at[k - 1, bucket].add(hit.astype(jnp.int32))
+    if n_gram > 1:
+        shifted = jnp.concatenate([tail[1:], tok[None]])
+        tail = jnp.where(valid, shifted, tail)
+    return counts, tail, new_len
+
+
+@lru_cache(maxsize=None)
+def _ngram_update_kernel(n_gram: int, buckets: int, masked: bool):
+    def kernel(states, hyp, ref, *rest):
+        valid = rest[0] if masked else None
+
+        def body(i, carry):
+            cand, refc, htail, rtail, hlen, rlen = carry
+            ht, rt = hyp[i], ref[i]
+            if masked:
+                # padded steps become the -1 sentinel: an exact no-op
+                live = i < valid[0]
+                ht = jnp.where(live, ht, jnp.int32(-1))
+                rt = jnp.where(live, rt, jnp.int32(-1))
+            cand, htail, hlen = _fold_token(cand, htail, hlen, ht, n_gram, buckets)
+            refc, rtail, rlen = _fold_token(refc, rtail, rlen, rt, n_gram, buckets)
+            return (cand, refc, htail, rtail, hlen, rlen)
+
+        return jax.lax.fori_loop(0, hyp.shape[0], body, tuple(states))
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _ngram_finish_kernel(n_gram: int):
+    @jax.jit
+    def finish(matches, possible, hyp_total, ref_total, num_seq, cand, refc, hlen, rlen):
+        clipped = jnp.sum(jnp.minimum(cand, refc), axis=1)
+        orders = jnp.arange(1, n_gram + 1, dtype=jnp.int32)
+        poss = jnp.maximum(hlen - orders + 1, 0)
+        zero = jnp.zeros((), dtype=jnp.int32)
+        return (
+            matches + clipped,
+            possible + poss,
+            hyp_total + hlen,
+            ref_total + rlen,
+            num_seq + jnp.int32(1),
+            jnp.zeros_like(cand),
+            jnp.zeros_like(refc),
+            zero,
+            zero,
+        )
+
+    return finish
+
+
+@jax.jit
+def _ngram_compute(matches, possible, hyp_total, ref_total, num_seq):
+    m = matches.astype(jnp.float32)
+    p = possible.astype(jnp.float32)
+    used = p > 0
+    safe_p = jnp.where(used, p, 1.0)
+    precision = jnp.where(used, m / safe_p, 0.0)
+    log_prec = jnp.where(used & (m > 0), jnp.log(jnp.where(m > 0, m, 1.0) / safe_p), 0.0)
+    n_used = jnp.sum(used.astype(jnp.float32))
+    geo = jnp.exp(jnp.sum(log_prec) / jnp.maximum(n_used, 1.0))
+    # any used order with zero matches zeroes the geometric mean, as in BLEU
+    geo = jnp.where(jnp.any(used & (m == 0)) | (n_used == 0), 0.0, geo)
+    h = hyp_total.astype(jnp.float32)
+    r = ref_total.astype(jnp.float32)
+    bp = jnp.where(h >= r, 1.0, jnp.exp(1.0 - r / jnp.where(h > 0, h, 1.0)))
+    bp = jnp.where(h > 0, bp, 0.0)
+    overlap = jnp.where(num_seq > 0, geo * bp, 0.0)
+    return NgramOverlap(
+        overlap=overlap,
+        brevity_penalty=bp,
+        precision_by_order=precision,
+        matches_by_order=matches,
+        possible_by_order=possible,
+        hyp_len_total=hyp_total,
+        ref_len_total=ref_total,
+        num_sequences=num_seq,
+    )
+
+
+class StreamingNgramOverlap(Metric[NgramOverlap]):
+    """Corpus-level clipped n-gram precision over token streams.
+
+    One in-flight hypothesis/reference stream pair at a time (per
+    metric instance): feed decode steps with ``update``, close the pair
+    with ``finish()``, repeat for the next sequence. Token ids must be
+    non-negative; ``-1`` means "no token on this side at this step".
+
+    Args:
+        n_gram: maximum n-gram order (default 4, as in BLEU-4).
+        buckets: hashed count-plane width per order; power of two.
+
+    Examples::
+
+        >>> from torcheval_tpu.streaming import StreamingNgramOverlap
+        >>> metric = StreamingNgramOverlap(n_gram=2)
+        >>> for hyp, ref in [(1, 1), (2, 2), (7, 3)]:
+        ...     _ = metric.update(hyp, ref)
+        >>> _ = metric.finish()
+        >>> float(metric.compute().overlap)  # doctest: +ELLIPSIS
+        0.5...
+    """
+
+    _bucketed_update = True
+
+    def __init__(
+        self,
+        *,
+        n_gram: int = 4,
+        buckets: int = 128,
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        super().__init__(device=device)
+        if n_gram < 1:
+            raise ValueError(f"n_gram must be >= 1, got {n_gram}")
+        if buckets < 1 or (buckets & (buckets - 1)) != 0:
+            raise ValueError(f"buckets must be a power of two, got {buckets}")
+        self.n_gram = int(n_gram)
+        self.buckets = int(buckets)
+        zeros = lambda shape: jnp.zeros(shape, dtype=jnp.int32)  # noqa: E731
+        # cumulative (finished-streams) counters: plain distributive sums
+        self._add_state("matches_by_order", zeros((n_gram,)), merge=MergeKind.SUM)
+        self._add_state("possible_by_order", zeros((n_gram,)), merge=MergeKind.SUM)
+        self._add_state("hyp_len_total", zeros(()), merge=MergeKind.SUM)
+        self._add_state("ref_len_total", zeros(()), merge=MergeKind.SUM)
+        self._add_state("num_sequences", zeros(()), merge=MergeKind.SUM)
+        # in-flight stream state: O(1) in sequence length by construction.
+        # Tails merge by elementwise MAX over the -1 sentinel — exact when
+        # at most one rank has a stream open (the single-stream contract).
+        self._add_state("cand_counts", zeros((n_gram, buckets)), merge=MergeKind.SUM)
+        self._add_state("ref_counts", zeros((n_gram, buckets)), merge=MergeKind.SUM)
+        tail = jnp.full((n_gram - 1,), -1, dtype=jnp.int32)
+        self._add_state("hyp_tail", tail, merge=MergeKind.MAX)
+        self._add_state("ref_tail", tail, merge=MergeKind.MAX)
+        self._add_state("hyp_len", zeros(()), merge=MergeKind.SUM)
+        self._add_state("ref_len", zeros(()), merge=MergeKind.SUM)
+
+    def update(
+        self: TStreamingNgramOverlap, step_tokens, ref_tokens=None
+    ) -> TStreamingNgramOverlap:
+        """Fold one decode step into the in-flight stream pair.
+
+        Args:
+            step_tokens: hypothesis token id(s) — scalar or 1-D; ``-1``
+                where the hypothesis emitted nothing.
+            ref_tokens: reference token id(s) for the same step(s), or
+                ``None`` when the reference emits nothing here.
+        """
+        plan = self._update_plan(step_tokens, ref_tokens)
+        return self._apply_update_plan(plan)
+
+    def _update_plan(self, step_tokens, ref_tokens=None):
+        hyp = self._input(step_tokens, dtype=jnp.int32).reshape((-1,))
+        if ref_tokens is None:
+            ref = (
+                jnp.full(hyp.shape, -1, dtype=jnp.int32)
+                if isinstance(hyp, jax.Array)
+                else np.full(hyp.shape, -1, dtype=np.int32)
+            )
+        else:
+            ref = self._input(ref_tokens, dtype=jnp.int32).reshape((-1,))
+        if np.shape(hyp) != np.shape(ref):
+            raise ValueError(
+                "step_tokens and ref_tokens must cover the same steps "
+                f"(got {np.shape(hyp)} vs {np.shape(ref)}); pad the shorter "
+                "stream with the -1 sentinel."
+            )
+        return UpdatePlan(
+            _ngram_update_kernel(self.n_gram, self.buckets, False),
+            _INFLIGHT_STATES,
+            (hyp, ref),
+            transform=True,
+            masked_kernel=_ngram_update_kernel(self.n_gram, self.buckets, True),
+            batch_axes=(("n",), ("n",)),
+        )
+
+    def finish(self: TStreamingNgramOverlap) -> TStreamingNgramOverlap:
+        """Close the in-flight stream pair and fold its clipped matches
+        into the cumulative counters. No-op when nothing is in flight
+        (host-checked, so an idle ``finish`` costs no dispatch)."""
+        if int(self.hyp_len) == 0 and int(self.ref_len) == 0:
+            return self
+        out = _ngram_finish_kernel(self.n_gram)(
+            self.matches_by_order,
+            self.possible_by_order,
+            self.hyp_len_total,
+            self.ref_len_total,
+            self.num_sequences,
+            self.cand_counts,
+            self.ref_counts,
+            self.hyp_len,
+            self.ref_len,
+        )
+        (
+            self.matches_by_order,
+            self.possible_by_order,
+            self.hyp_len_total,
+            self.ref_len_total,
+            self.num_sequences,
+            self.cand_counts,
+            self.ref_counts,
+            self.hyp_len,
+            self.ref_len,
+        ) = out
+        tail = jnp.full((self.n_gram - 1,), -1, dtype=jnp.int32)
+        self.hyp_tail = tail
+        self.ref_tail = tail
+        return self
+
+    def compute(self) -> NgramOverlap:
+        """BLEU-style overlap over all FINISHED streams (in-flight state
+        contributes after its ``finish()``)."""
+        return _ngram_compute(
+            self.matches_by_order,
+            self.possible_by_order,
+            self.hyp_len_total,
+            self.ref_len_total,
+            self.num_sequences,
+        )

@@ -1,0 +1,110 @@
+"""Streaming perplexity: one decode step at a time, O(1) state.
+
+The decode loop hands over the log-probability the model assigned to
+each token AS IT IS SAMPLED — a scalar (or a small vector for a batched
+step) per call — and the metric carries exactly two scalars of state:
+the running negative-log-likelihood sum and the token count. There is
+no per-sequence buffer and no re-materialization of the prefix, so the
+per-step cost is constant regardless of how long the stream has run
+(the O(1)-autoregressive-cache posture of arXiv:2603.09555 applied to
+the metric side of the decode scan).
+
+Bit-identity contract: the update kernel folds the step's tokens into
+the NLL state SEQUENTIALLY (``lax.fori_loop`` threading the running
+sum), so feeding a sequence token-by-token and feeding it as one array
+execute the *same* chain of float adds in the *same* order — step-by-
+step ``compute()`` equals the offline full-sequence oracle bitwise, not
+just approximately. The masked bucket twin passes the carry through
+unchanged on padded rows (a ``select``, not an add-zero), preserving
+the chain under shape bucketing too.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.text.perplexity import _perplexity_compute
+from torcheval_tpu.metrics.metric import MergeKind, Metric, UpdatePlan
+
+TStreamingPerplexity = TypeVar("TStreamingPerplexity", bound="StreamingPerplexity")
+
+__all__ = ["StreamingPerplexity"]
+
+
+def _stream_ppl_kernel(states, log_probs):
+    nll, count = states
+
+    def body(i, carry):
+        return carry + (-log_probs[i])
+
+    nll = jax.lax.fori_loop(0, log_probs.shape[0], body, nll)
+    return nll, count + jnp.int32(log_probs.shape[0])
+
+
+def _stream_ppl_kernel_masked(states, log_probs, valid):
+    nll, count = states
+
+    def body(i, carry):
+        # select, not add-zero: padded slots must leave the carry
+        # bit-identical (adding -0.0 would not)
+        return jax.lax.select(i < valid[0], carry + (-log_probs[i]), carry)
+
+    nll = jax.lax.fori_loop(0, log_probs.shape[0], body, nll)
+    return nll, count + valid[0].astype(jnp.int32)
+
+
+class StreamingPerplexity(Metric[jax.Array]):
+    """exp(NLL sum / token count) over a token stream fed step-by-step.
+
+    ``update`` takes the per-token log-probabilities of ONE decode step —
+    a scalar for a single sampled token, or a 1-D array when several
+    tokens land at once (speculative decoding, a whole prompt, or the
+    offline oracle replaying the full sequence). Any shape is flattened;
+    the fold order is the flattened order.
+
+    Examples::
+
+        >>> import jax.numpy as jnp
+        >>> from torcheval_tpu.streaming import StreamingPerplexity
+        >>> metric = StreamingPerplexity()
+        >>> for lp in [-0.1, -2.3, -0.7]:   # one decode step at a time
+        ...     _ = metric.update(lp)
+        >>> metric.compute()
+        Array(2.8094876, dtype=float32)
+    """
+
+    _bucketed_update = True
+
+    def __init__(self, *, device: Optional[jax.Device] = None) -> None:
+        super().__init__(device=device)
+        self._add_state("sum_log_probs", jnp.zeros(()), merge=MergeKind.SUM)
+        # exact int32 token counter (float32 would saturate at 2^24)
+        self._add_state(
+            "num_total", jnp.zeros((), dtype=jnp.int32), merge=MergeKind.SUM
+        )
+
+    def update(
+        self: TStreamingPerplexity, token_log_probs
+    ) -> TStreamingPerplexity:
+        """Fold one decode step (scalar or array of per-token log-probs)."""
+        plan = self._update_plan(token_log_probs)
+        return self._apply_update_plan(plan)
+
+    def _update_plan(self, token_log_probs):
+        lp = self._input_float(token_log_probs)
+        lp = lp.reshape((-1,))
+        return UpdatePlan(
+            _stream_ppl_kernel,
+            ("sum_log_probs", "num_total"),
+            (lp,),
+            transform=True,
+            masked_kernel=_stream_ppl_kernel_masked,
+            batch_axes=(("n",),),
+        )
+
+    def compute(self) -> jax.Array:
+        """Running perplexity over every token folded so far."""
+        return _perplexity_compute(self.sum_log_probs, self.num_total)

@@ -14,6 +14,13 @@ from torcheval_tpu.table._admission import (
 from torcheval_tpu.table._families import FAMILIES, TableFamily
 from torcheval_tpu.table._hash import hash_keys, owner_of
 from torcheval_tpu.table.panel import PanelValues, TablePanel
+from torcheval_tpu.table.streaming import (
+    StreamTable,
+    stream_logprob_family,
+    stream_ngram_family,
+    stream_token_accuracy_family,
+    stream_token_edit_family,
+)
 from torcheval_tpu.table.table import (
     MetricTable,
     TableValues,
@@ -28,6 +35,7 @@ __all__ = [
     "PanelValues",
     "RUNG_NAMES",
     "ServingBudget",
+    "StreamTable",
     "TableFamily",
     "TablePanel",
     "TableValues",
@@ -35,5 +43,9 @@ __all__ = [
     "hash_keys",
     "owner_of",
     "shedding_status",
+    "stream_logprob_family",
+    "stream_ngram_family",
+    "stream_token_accuracy_family",
+    "stream_token_edit_family",
     "tightest_staleness_budget",
 ]

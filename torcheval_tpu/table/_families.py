@@ -33,7 +33,13 @@ from typing import Any, Callable, Dict, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["TableFamily", "resolve_family", "FAMILIES"]
+__all__ = [
+    "TableFamily",
+    "resolve_family",
+    "FAMILIES",
+    "windowed_fields",
+    "traffic_fields",
+]
 
 
 class TableFamily(NamedTuple):
@@ -47,6 +53,16 @@ class TableFamily(NamedTuple):
     array. ``window > 0`` marks an epoch-windowed family: its fields are
     the PENDING (current-epoch) accumulators, committed into per-key
     rings of ``window`` columns at each drain.
+
+    ``window_fields`` (panel-wide window clock, ROADMAP 4b) restricts the
+    ring treatment to a SUBSET of ``fields`` — empty means "all fields"
+    when ``window > 0`` (the original all-or-nothing behavior). This is
+    what lets a composite panel family hold windowed and cumulative
+    member columns side by side under one epoch-advance clock.
+    ``traffic_fields`` names the columns whose nonzero pending value
+    marks "this key saw traffic this epoch" (OR-combined); empty applies
+    the historical default — ``num_examples`` if present among the
+    windowed fields, else the last windowed field.
     """
 
     name: str
@@ -55,6 +71,25 @@ class TableFamily(NamedTuple):
     row_kernel: Callable[..., Tuple[jax.Array, ...]]
     compute: Callable[[Dict[str, jax.Array]], Any]
     window: int = 0
+    window_fields: Tuple[str, ...] = ()
+    traffic_fields: Tuple[str, ...] = ()
+
+
+def windowed_fields(family: "TableFamily") -> Tuple[str, ...]:
+    """The fields that keep per-key epoch rings (empty when windowless)."""
+    if not family.window:
+        return ()
+    return tuple(family.window_fields) or tuple(family.fields)
+
+
+def traffic_fields(family: "TableFamily") -> Tuple[str, ...]:
+    """The fields whose nonzero pending column marks epoch traffic."""
+    wf = windowed_fields(family)
+    if not wf:
+        return ()
+    if family.traffic_fields:
+        return tuple(family.traffic_fields)
+    return ("num_examples",) if "num_examples" in wf else (wf[-1],)
 
 
 def _rows_1d(table, name: str, value, *, dtype=None):

@@ -81,6 +81,10 @@ def hash_keys(keys: Any) -> np.ndarray:
     world sizes — the foundation of the table's ownership and elastic
     re-hash contracts."""
     arr = np.asarray(keys)
+    if arr.size == 0:
+        # an empty key batch carries no dtype signal (np.asarray([]) is
+        # float64) — and has nothing to hash either way
+        return np.zeros((0,), np.uint64)
     if arr.dtype.kind in ("i", "u"):
         hashed = _splitmix64(arr.astype(np.uint64).reshape(-1))
     elif arr.dtype.kind in ("U", "S"):

@@ -24,10 +24,12 @@ it once:
   fused program, so a warmed panel stays retrace-proof across ragged
   traffic (and across admission rung changes when armed).
 
-Panels are CUMULATIVE: every member must be windowless (use the ``"ne"``
-family, not ``"windowed_ne"`` — the epoch-ring commit is keyed on
-uniform per-family traffic semantics a shared intake cannot provide).
-Ingest feeds every member per batch::
+Members may mix cumulative and WINDOWED families (ROADMAP 4b): the panel
+runs one shared window clock — a single per-key epoch cursor advanced at
+each drain when ANY windowed member's traffic column is nonzero — and
+every windowed member must agree on one window size. Only the windowed
+members' columns get epoch rings; cumulative members accumulate forever,
+exactly as standalone. Ingest feeds every member per batch::
 
     >>> panel = TablePanel(["ctr", ("conversions", "ctr"), "ne"])
     >>> panel.ingest(keys, ctr=(clicks,), conversions=(conv,),
@@ -202,7 +204,7 @@ class TablePanel(MetricTable):
     Args:
         families: the member list — e.g. ``["ctr", ("conversions",
             "ctr"), ("cal", "weighted_calibration"), "ne"]``. Aliases
-            must be unique; members must be windowless.
+            must be unique; windowed members must share one window size.
         shard / ttl / max_keys / repr_limit / admission / device: as
             :class:`MetricTable` (the panel IS a table; one admission
             controller gates the one shared intake).
@@ -254,14 +256,37 @@ class TablePanel(MetricTable):
                 )
             seen[alias] = True
             fam, attrs = resolve_family(spec, **kwargs)
-            if fam.window:
-                raise ValueError(
-                    f"panel member {alias!r}: windowed families cannot "
-                    "share a panel intake (use the cumulative 'ne' "
-                    "family instead of 'windowed_ne')"
-                )
             members.append((alias, fam, attrs))  # view built post-init
             attrs_by_alias[alias] = attrs
+        # panel-wide window clock (ROADMAP 4b): windowed members join the
+        # fused intake as long as they agree on ONE window size — their
+        # prefixed fields become the composite's window_fields, their
+        # per-member traffic columns OR into one shared epoch-advance
+        # decision, and the single MetricTable ring commit serves all of
+        # them (cumulative members' columns keep accumulating untouched)
+        window_sizes = sorted({fam.window for _, fam, _ in members if fam.window})
+        if len(window_sizes) > 1:
+            raise ValueError(
+                "panel windowed members must share one window size (the "
+                "panel has a single epoch-advance clock), got windows "
+                f"{window_sizes}"
+            )
+        window = window_sizes[0] if window_sizes else 0
+        from torcheval_tpu.table._families import (
+            traffic_fields as _fam_traffic,
+            windowed_fields as _fam_windowed,
+        )
+
+        window_fields = tuple(
+            f"{alias}__{f}"
+            for alias, fam, _ in members
+            for f in _fam_windowed(fam)
+        )
+        trf_fields = tuple(
+            f"{alias}__{f}"
+            for alias, fam, _ in members
+            for f in _fam_traffic(fam)
+        )
         fields = tuple(
             f"{alias}__{f}" for alias, fam, _ in members for f in fam.fields
         )
@@ -283,6 +308,9 @@ class TablePanel(MetricTable):
                 tuple(fam.row_kernel for _, fam, _ in members)
             ),
             compute=_compute,
+            window=window,
+            window_fields=window_fields,
+            traffic_fields=trf_fields,
         )
         super().__init__(
             composite,

@@ -64,7 +64,12 @@ from torcheval_tpu.table._admission import (
     _register_armed,
     _unregister_armed,
 )
-from torcheval_tpu.table._families import TableFamily, resolve_family
+from torcheval_tpu.table._families import (
+    TableFamily,
+    resolve_family,
+    traffic_fields,
+    windowed_fields,
+)
 from torcheval_tpu.table._hash import (
     SENTINEL,
     hash_keys,
@@ -384,8 +389,11 @@ class MetricTable(Metric[TableValues]):
         self._add_state("slot_lo", jnp.zeros((0,), jnp.uint32), merge=MergeKind.CUSTOM)
         for f in fam.fields:
             self._add_state(f"col_{f}", jnp.zeros((0,)), merge=MergeKind.CUSTOM)
-        if fam.window:
-            for f in fam.fields:
+        # rings cover the family's WINDOWED fields only (all fields for
+        # classic windowed families; a panel composite may mix windowed
+        # and cumulative member columns under one shared window clock)
+        if windowed_fields(fam):
+            for f in windowed_fields(fam):
                 self._add_state(
                     f"ring_{f}",
                     jnp.zeros((0, fam.window)),
@@ -488,8 +496,9 @@ class MetricTable(Metric[TableValues]):
     def _per_key_states(self) -> List[str]:
         names = ["slot_hi", "slot_lo", "last_seen"]
         names += [f"col_{f}" for f in self.family.fields]
-        if self.family.window:
-            names += [f"ring_{f}" for f in self.family.fields]
+        wf = windowed_fields(self.family)
+        if wf:
+            names += [f"ring_{f}" for f in wf]
             names.append("epochs_recorded")
         return names
 
@@ -555,8 +564,14 @@ class MetricTable(Metric[TableValues]):
 
     def update(self, keys: Any, *args: Any, **kwargs: Any) -> "MetricTable":
         """Accumulate one batch of keyed rows — ONE fused device program
-        (slot resolution + owned scatter + foreign outbox append)."""
-        return self._apply_update_plan(self._update_plan(keys, *args, **kwargs))
+        (slot resolution + owned scatter + foreign outbox append).
+        An EMPTY key batch is a host-side no-op (``_update_plan`` returns
+        ``None``): streaming decode loops hit empty tails constantly, and
+        each would otherwise trace a degenerate 0-row program."""
+        plan = self._update_plan(keys, *args, **kwargs)
+        if plan is None:
+            return self
+        return self._apply_update_plan(plan)
 
     def ingest(self, keys: Any, *args: Any, **kwargs: Any) -> "MetricTable":
         """The streaming ingestion front door: :meth:`update` with shape
@@ -599,6 +614,12 @@ class MetricTable(Metric[TableValues]):
                     f"table ingest: {n} keys but a per-row argument has "
                     f"{int(np.shape(arg)[0])} rows"
                 )
+        if n == 0:
+            # empty decode tail: nothing to admit, scatter, or ship —
+            # short-circuit before any device dispatch so no 0-row
+            # program is ever traced (argument validation above still
+            # ran, so misuse raises identically for empty batches)
+            return None
         # admission gate: a stateless splitmix64(key, epoch) Bernoulli
         # keep mask sheds rows on the HOST before any slot growth,
         # outbox reservation, or device work — overload never reaches
@@ -754,10 +775,11 @@ class MetricTable(Metric[TableValues]):
         the outbox until the next drain; a merged table covers the full
         key union)."""
         n = int(self.n_keys)
+        wf = set(windowed_fields(self.family))
         cols = {
             f: (
                 jnp.sum(getattr(self, f"ring_{f}")[:n], axis=-1)
-                if self.family.window
+                if f in wf
                 else getattr(self, f"col_{f}")[:n]
             )
             for f in self.family.fields
@@ -827,8 +849,9 @@ class MetricTable(Metric[TableValues]):
         fields = self.family.fields
         logical = {f: jnp.zeros((n_u,)) for f in fields}
         win = self.family.window
-        if win:
-            rings = {f: jnp.zeros((n_u, win)) for f in fields}
+        wfields = windowed_fields(self.family)
+        if wfields:
+            rings = {f: jnp.zeros((n_u, win)) for f in wfields}
             epochs_rec = jnp.zeros((n_u,), jnp.int32)
         last_seen = np.zeros((n_u,), np.int64)
         merged_epoch = max((int(c.epoch) for c in carriers), default=0)
@@ -847,12 +870,12 @@ class MetricTable(Metric[TableValues]):
                     pos_np,
                     np.asarray(c.last_seen[:n_c], np.int64),
                 )
-                if win:
+                if wfields:
                     rings = {
                         f: rings[f].at[pos].add(
                             self._place_state(getattr(c, f"ring_{f}"))[:n_c]
                         )
-                        for f in fields
+                        for f in wfields
                     }
                     epochs_rec = epochs_rec.at[pos].max(
                         self._place_state(c.epochs_recorded)[:n_c]
@@ -902,11 +925,11 @@ class MetricTable(Metric[TableValues]):
         )
         for f in fields:
             setattr(self, f"col_{f}", jnp.pad(logical[f], (0, pad)))
-            if win:
-                setattr(
-                    self, f"ring_{f}", jnp.pad(rings[f], ((0, pad), (0, 0)))
-                )
-        if win:
+        for f in wfields:
+            setattr(
+                self, f"ring_{f}", jnp.pad(rings[f], ((0, pad), (0, 0)))
+            )
+        if wfields:
             self.epochs_recorded = jnp.pad(epochs_rec, (0, pad))
         self.last_seen = jnp.pad(
             jnp.asarray(last_seen.astype(np.int32)), (0, pad)
@@ -979,16 +1002,20 @@ class MetricTable(Metric[TableValues]):
         """
         n = int(self.n_keys)
         win = self.family.window
-        if win and n:
-            fields = self.family.fields
-            ex_field = (
-                "num_examples" if "num_examples" in fields else fields[-1]
-            )
-            pend = {f: getattr(self, f"col_{f}")[:n] for f in fields}
-            has = pend[ex_field] != 0.0
+        wfields = windowed_fields(self.family)
+        if wfields and n:
+            # ONE panel-wide window clock (ROADMAP 4b): every windowed
+            # field shares the same per-key epoch cursor and the same
+            # traffic decision — the OR over the family's traffic
+            # fields — so windowed members of a composite panel advance
+            # in lockstep with their standalone twins
+            pend = {f: getattr(self, f"col_{f}")[:n] for f in wfields}
+            has = jnp.zeros((n,), bool)
+            for f in traffic_fields(self.family):
+                has = has | (pend[f] != 0.0)
             cur = self.epochs_recorded[:n] % win
             rows = jnp.arange(n, dtype=jnp.int32)
-            for f in fields:
+            for f in wfields:
                 ring = getattr(self, f"ring_{f}")
                 old = ring[rows, cur]
                 new_col = jnp.where(has, pend[f], old)
